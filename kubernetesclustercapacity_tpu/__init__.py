@@ -1,0 +1,45 @@
+"""kubernetesclustercapacity_tpu — a TPU-native cluster-capacity simulation framework.
+
+A brand-new JAX/XLA framework with the capabilities of the reference Go CLI
+``AshutoshNirkhe/KubernetesClusterCapacity`` (see ``SURVEY.md``): given a pod
+spec (CPU/memory requests + limits) and a replica count, compute how many
+replicas a Kubernetes cluster can still schedule.  Instead of the reference's
+sequential per-node loop against a live apiserver
+(``src/KubeAPI/ClusterCapacity.go:105-140``), this framework snapshots cluster
+state once into dense ``(nodes, resources)`` arrays and evaluates thousands of
+what-if ``(cpuRequests, memRequests, replicas)`` scenarios in parallel as a
+vectorized bin-packing kernel, sharded over a TPU device mesh.
+
+Layer map (TPU-first redesign of SURVEY.md §1):
+
+===========  ====================================================================
+Layer        Module
+===========  ====================================================================
+L4 CLI       :mod:`kubernetesclustercapacity_tpu.cli` (6 reference flags + TPU flags)
+L3 codecs    :mod:`kubernetesclustercapacity_tpu.utils.quantity`
+L2 snapshot  :mod:`kubernetesclustercapacity_tpu.snapshot` (dense arrays; fixture /
+             synthetic / live constructors — 2 paginated Lists, not N+1)
+L1 kernel    :mod:`kubernetesclustercapacity_tpu.ops.fit` (vmap/jit fit kernel),
+             :mod:`kubernetesclustercapacity_tpu.parallel` (Mesh + shard_map + psum)
+L0 report    :mod:`kubernetesclustercapacity_tpu.report` (verdict + structured output)
+oracle       :mod:`kubernetesclustercapacity_tpu.oracle` (bug-for-bug reference
+             semantics — the bit-exactness gate)
+===========  ====================================================================
+
+Integer exactness: replica counts are 64-bit integer math (Go ``uint64``/
+``int64`` in the reference).  JAX's x64 mode is enabled at import so int64
+survives tracing; on TPU, XLA lowers int64 to 32-bit pairs — the optional
+Pallas fast path (:mod:`.ops.pallas_fit`) avoids that via exactness-checked
+KiB rescaling to int32.
+"""
+
+import jax as _jax
+
+# Must happen before any jnp array is created anywhere in the framework:
+# without x64, jnp silently downcasts int64 -> int32 and memory-bytes
+# arithmetic (node memory ~2^34) overflows, breaking bit-exactness.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from kubernetesclustercapacity_tpu.utils import quantity  # noqa: E402,F401

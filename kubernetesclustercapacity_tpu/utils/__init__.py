@@ -1,0 +1,1 @@
+"""Utility layer: quantity codecs, timing/profiling, snapshot IO."""

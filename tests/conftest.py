@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU platform before JAX loads.
+
+The framework targets TPU meshes, but tests run anywhere by simulating 8
+devices on host CPU (SURVEY.md §4 "multi-device tests without a pod slice").
+These environment variables must be set before the first ``import jax``
+anywhere in the test process, which is why they live at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,205 @@
+"""Table-driven codec tests covering SURVEY.md §2.2 edge cases."""
+
+import pytest
+
+from kubernetesclustercapacity_tpu.utils.quantity import (
+    Quantity,
+    QuantityParseError,
+    byte_size,
+    cpu_to_milli_reference,
+    cpu_to_milli_strict,
+    mem_to_bytes_strict,
+    parse_quantity,
+    to_bytes_reference,
+    to_megabytes,
+)
+
+KIB = 1024
+MIB = 1024 * KIB
+GIB = 1024 * MIB
+TIB = 1024 * GIB
+
+
+class TestCpuToMilliReference:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("100m", 100),  # m-suffix: value as-is
+            ("250m", 250),
+            ("0m", 0),
+            ("2", 2000),  # cores -> x1000
+            ("4", 4000),
+            ("0", 0),
+            ("+3", 3000),  # Go Atoi accepts a leading sign
+            ("1000m", 1000),
+        ],
+    )
+    def test_valid(self, s, expected):
+        assert cpu_to_milli_reference(s) == expected
+
+    @pytest.mark.parametrize(
+        "s",
+        ["0.5", "2.5", "", "m", "100Mi", "1e2", "abc", " 2", "2 ", "1_0", "٢"],
+    )
+    def test_parse_failure_yields_zero(self, s):
+        # ClusterCapacity.go:314-317 — failure prints an error and returns 0.
+        assert cpu_to_milli_reference(s) == 0
+
+    def test_negative_wraps_like_go_uint64(self):
+        # uint64(int(-5 * 1000)) in Go.
+        assert cpu_to_milli_reference("-5") == 2**64 - 5000
+        assert cpu_to_milli_reference("-5m") == 2**64 - 5
+
+    def test_double_m_suffix(self):
+        # "5mm" -> strip one m -> "5m" -> Atoi fails -> 0.
+        assert cpu_to_milli_reference("5mm") == 0
+
+    def test_int64_range_error_yields_zero(self):
+        # Go strconv.Atoi errors outside int64 range -> reference returns 0.
+        assert cpu_to_milli_reference("9" * 30) == 0
+        assert cpu_to_milli_reference(str(2**63)) == 0
+        assert cpu_to_milli_reference(str(2**63 - 1)) == ((2**63 - 1) * 1000) % 2**64
+
+
+class TestToBytesReference:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("100mb", 100 * MIB),  # ALL prefixes base-2: MB == MiB
+            ("100MB", 100 * MIB),
+            ("100M", 100 * MIB),
+            ("100MiB", 100 * MIB),
+            ("100Mi", 100 * MIB),  # "MI" accepted
+            ("1k", KIB),
+            ("3500Ki", 3500 * KIB),  # kubelet-style allocatable
+            ("1KB", KIB),
+            ("2g", 2 * GIB),
+            ("2GB", 2 * GIB),
+            ("2GiB", 2 * GIB),
+            ("1T", TIB),
+            ("1TiB", TIB),
+            ("5B", 5),
+            ("  250mb  ", 250 * MIB),  # whitespace trimmed
+            ("0.5M", MIB // 2),  # float value allowed
+            ("1.5K", 1536),
+        ],
+    )
+    def test_valid(self, s, expected):
+        assert to_bytes_reference(s) == expected
+
+    @pytest.mark.parametrize(
+        "s",
+        [
+            "16Gi",  # "GI" missing from suffix table (bytes.go:91-104)
+            "1Ti",  # "TI" missing too
+            "1073741824",  # no letter suffix -> error
+            "0Ki",  # value <= 0 -> error
+            "-5M",
+            "",
+            "MB",
+            "1XB",
+            "nanB",
+            "infM",
+            "2 GB",  # internal space: Go ParseFloat("2 ") errors
+            "9" * 400 + "M",  # float64 overflow -> Go ErrRange -> error
+        ],
+    )
+    def test_invalid(self, s):
+        with pytest.raises(QuantityParseError):
+            to_bytes_reference(s)
+
+    def test_truncation_toward_zero(self):
+        # int64(value * mult) truncates: 0.0009765625KiB < 1 byte.
+        assert to_bytes_reference("1.0009765625K") == 1025
+        assert to_bytes_reference("0.3B") == 0
+
+
+class TestByteSizeFormat:
+    @pytest.mark.parametrize(
+        "n,expected",
+        [
+            (0, "0"),
+            (5, "5B"),
+            (KIB, "1K"),
+            (int(100.5 * MIB), "100.5M"),
+            (GIB, "1G"),
+            (int(1.5 * TIB), "1.5T"),
+            (1536, "1.5K"),
+        ],
+    )
+    def test_format(self, n, expected):
+        assert byte_size(n) == expected
+
+    def test_to_megabytes(self):
+        assert to_megabytes("2048K") == 2
+        assert to_megabytes("1536K") == 1  # floor
+
+
+class TestStrictQuantity:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("1", 1),
+            ("100", 100),
+            ("1Ki", 1024),
+            ("16Gi", 16 * GIB),  # strict parser fixes the GI gap
+            ("1Ti", TIB),
+            ("1M", 10**6),  # decimal SI is base-10 in strict mode
+            ("1k", 1000),
+            ("1e3", 1000),
+            ("1E3", 1000),
+            ("12e-1", 2),  # 1.2 rounds UP to 2
+            ("100m", 1),  # Value() rounds up: 0.1 -> 1
+            ("1500m", 2),
+            ("0.5", 1),
+            ("1.5Gi", 1610612736),
+            ("0", 0),
+            ("-1500m", -1),  # ceil toward +inf
+        ],
+    )
+    def test_value(self, s, expected):
+        assert parse_quantity(s).value() == expected
+
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("100m", 100),
+            ("0.5", 500),
+            ("2", 2000),
+            ("1u", 1),  # 1e-6 cores -> ceil to 1 milli
+            ("250m", 250),
+        ],
+    )
+    def test_milli_value(self, s, expected):
+        assert parse_quantity(s).milli_value() == expected
+
+    @pytest.mark.parametrize(
+        "s",
+        [
+            "",
+            "K",
+            "1K",
+            "1KB",
+            "1MiB",
+            "abc",
+            "1.2.3",
+            ".",
+            "1e",
+            "1ee3",
+            "--1",
+            "1e1000000000",  # unbounded exponent must not materialize 10**exp
+        ],
+    )
+    def test_invalid(self, s):
+        with pytest.raises(QuantityParseError):
+            parse_quantity(s)
+
+    def test_exact_decimal_no_float_drift(self):
+        # 0.1 is exactly 1/10, so 0.1 * 3 * 10 == 3 exactly.
+        q = parse_quantity("0.1")
+        assert (q.amount * 30).denominator == 1
+        assert isinstance(q, Quantity)
+
+    def test_helpers(self):
+        assert cpu_to_milli_strict("0.5") == 500
+        assert mem_to_bytes_strict("16Gi") == 16 * GIB
