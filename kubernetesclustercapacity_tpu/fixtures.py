@@ -1,0 +1,150 @@
+"""Synthetic cluster fixture generation — the framework's fake-cluster backend.
+
+The reference can only run against a live apiserver (SURVEY.md §4: it has no
+tests, no fixtures, no fake clientset).  This module is the new framework's
+replacement: deterministic, seedable generators of node/pod fixtures in the
+oracle's schema (see :mod:`kubernetesclustercapacity_tpu.oracle.reference`),
+shaped like what a real kubelet reports (memory in ``Ki``, the legacy
+5-condition layout, ~110-pod capacity), so no cluster is ever needed.
+
+Scales to the BASELINE.json evaluation ladder: config 1 is the checked-in
+3-node kind-style JSON under ``tests/fixtures/``; configs 2-3 use
+:func:`synthetic_fixture` at 1k / 10k nodes.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+__all__ = ["synthetic_fixture", "load_fixture", "save_fixture"]
+
+# Legacy 5-condition layout the reference's health check hardcodes
+# (SURVEY.md §2.2 C3): the first four must be "False" for a node to count.
+_CONDITION_TYPES = (
+    "OutOfDisk",
+    "MemoryPressure",
+    "DiskPressure",
+    "PIDPressure",
+    "Ready",
+)
+
+_CPU_CORES_CHOICES = (2, 4, 8, 16, 32, 64)
+_CONTAINER_CPU_REQ = ("50m", "100m", "250m", "500m", "1", "2")
+_CONTAINER_MEM_REQ = ("64Mi", "128Mi", "256Mi", "512Mi", "1Gi", "2Gi")
+
+
+def synthetic_fixture(
+    n_nodes: int,
+    *,
+    seed: int = 0,
+    pods_per_node: int = 12,
+    unhealthy_frac: float = 0.05,
+    unparseable_mem_frac: float = 0.02,
+    unscheduled_running_pods: int = 0,
+    taint_frac: float = 0.0,
+) -> dict:
+    """Generate a deterministic fixture of ``n_nodes`` nodes and their pods.
+
+    * ``unhealthy_frac`` of nodes get a pressure condition ``"True"`` → the
+      reference health check skips them, leaving phantom zero-nodes (Q4).
+    * ``unparseable_mem_frac`` of nodes advertise memory as ``"<n>Gi"`` —
+      which ``bytefmt`` rejects, zeroing that node's memory (Q5).
+    * ``unscheduled_running_pods`` adds Running pods with an empty
+      ``nodeName`` — these bind to phantom nodes through the degenerate field
+      selector (Q4).
+    * ``taint_frac`` of nodes carry a NoSchedule taint (used by the
+      constraint-mask layer; invisible to reference semantics).
+
+    Pod phases are mostly Running with a sprinkle of every excluded phase, so
+    the Running-only field-selector semantics (Q7) are exercised.
+    """
+    rng = random.Random(seed)
+    nodes = []
+    pods = []
+
+    for i in range(n_nodes):
+        name = f"node-{i:05d}"
+        cores = rng.choice(_CPU_CORES_CHOICES)
+        # Kubelet-style: a little less than the round GiB figure, in Ki.
+        mem_kib = cores * 4 * 1024 * 1024 - rng.randrange(0, 2**18)
+        unhealthy = rng.random() < unhealthy_frac
+        unparseable = rng.random() < unparseable_mem_frac
+
+        conditions = [
+            {"type": t, "status": "False"} for t in _CONDITION_TYPES[:4]
+        ] + [{"type": "Ready", "status": "True"}]
+        if unhealthy:
+            conditions[rng.randrange(4)]["status"] = "True"
+
+        node = {
+            "name": name,
+            "allocatable": {
+                "cpu": str(cores),
+                "memory": f"{mem_kib // 1024**2}Gi" if unparseable else f"{mem_kib}Ki",
+                "pods": "110",
+            },
+            "conditions": conditions,
+            "labels": {
+                "kubernetes.io/hostname": name,
+                "zone": f"zone-{i % 3}",
+                "pool": "default" if i % 4 else "highmem",
+            },
+            "taints": [],
+        }
+        if rng.random() < taint_frac:
+            node["taints"].append(
+                {"key": "dedicated", "value": "batch", "effect": "NoSchedule"}
+            )
+        nodes.append(node)
+
+        for j in range(rng.randrange(0, pods_per_node * 2)):
+            phase = rng.choices(
+                ("Running", "Pending", "Succeeded", "Failed", "Unknown"),
+                weights=(88, 4, 4, 2, 2),
+            )[0]
+            pods.append(
+                _make_pod(rng, f"pod-{i:05d}-{j:03d}", node_name=name, phase=phase)
+            )
+
+    for k in range(unscheduled_running_pods):
+        pods.append(
+            _make_pod(rng, f"orphan-{k:03d}", node_name="", phase="Running")
+        )
+
+    return {"nodes": nodes, "pods": pods}
+
+
+def _make_pod(rng: random.Random, name: str, *, node_name: str, phase: str) -> dict:
+    containers = []
+    for _ in range(rng.choices((1, 2, 3), weights=(70, 20, 10))[0]):
+        resources: dict = {}
+        if rng.random() < 0.9:  # some containers set no requests at all
+            cpu = rng.choice(_CONTAINER_CPU_REQ)
+            mem = rng.choice(_CONTAINER_MEM_REQ)
+            resources["requests"] = {"cpu": cpu, "memory": mem}
+            if rng.random() < 0.7:
+                resources["limits"] = {"cpu": cpu, "memory": mem}
+        containers.append({"resources": resources})
+    pod = {
+        "name": name,
+        "namespace": rng.choice(("default", "kube-system", "batch", "web")),
+        "nodeName": node_name,
+        "phase": phase,
+        "containers": containers,
+    }
+    if rng.random() < 0.1:  # init containers exist but must be ignored (Q7)
+        pod["initContainers"] = [
+            {"resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+        ]
+    return pod
+
+
+def load_fixture(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_fixture(fixture: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(fixture, f, indent=1)

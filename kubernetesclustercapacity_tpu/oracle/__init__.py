@@ -1,0 +1,17 @@
+"""Bug-for-bug reference-semantics oracle — the bit-exactness gate.
+
+The Go toolchain is absent in this environment (SURVEY.md §4), so this pure
+Python walk of the reference's exact control flow stands in for
+``go run ClusterCapacity.go`` when validating the JAX/TPU kernels.
+"""
+
+from kubernetesclustercapacity_tpu.oracle.reference import (  # noqa: F401
+    NodeView,
+    OracleResult,
+    PerNodeResult,
+    ReferencePanic,
+    healthy_nodes,
+    non_terminated_pods_for_node,
+    pod_requests_limits,
+    reference_run,
+)

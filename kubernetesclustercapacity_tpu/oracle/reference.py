@@ -1,0 +1,366 @@
+"""Pure-Python oracle reproducing the reference CLI's semantics bug-for-bug.
+
+This module re-implements the exact observable behavior of the reference's
+``main`` pipeline (``src/KubeAPI/ClusterCapacity.go:48-150``) over an offline
+*fixture* (a JSON-able dict of node/pod lists — the shape a Kubernetes List
+response carries, minus everything the reference never reads).  It exists so
+the vectorized JAX kernels have a sequential ground truth to be bit-exact
+against ("bit-exact replica counts vs. the Go CPU path", BASELINE.json).
+
+Reproduced quirks (SURVEY.md §2.4) — each is deliberate:
+
+* Q1  conditional pod cap: applied only when ``fit >= allocatablePods``
+      (``:134-136``), and it then *overwrites* the min with
+      ``allocatablePods - len(pods)`` — which can be NEGATIVE.
+* Q3  "healthy" = the first FOUR conditions ALL have ``Status == "False"``;
+      any of them being non-``"False"`` marks the node unhealthy
+      (``:212-219``) — on the legacy 5-condition layout the pressure
+      conditions come first, so "no pressure reported" reads as healthy.
+      Running out of conditions before j=4 (all seen being ``"False"``) is an
+      index panic.
+* Q4  unhealthy nodes are skipped but NOT removed: a zero-valued phantom node
+      stays in the slice (``:221-226``), and its pod query matches pods with
+      an empty ``nodeName`` (``:236``).  The ``make([]node, n, 3)`` crash for
+      n > 3 (``:176``) is reproducible via ``emulate_slice_bug=True``.
+* Q5  parse-fail→0: node memory that ``bytefmt`` rejects becomes 0
+      (``:202-206``); CPU strings that ``Atoi`` rejects become 0 (``:314-317``).
+* Q7  only ``Running`` pods consume capacity (field selector ``:236``); all
+      namespaces; regular containers only (``:276-277``) — init containers,
+      ephemeral containers and pod overhead are invisible.
+
+Fixture schema (all quantity values are strings, as the API serves them)::
+
+    {"nodes": [{"name": str,
+                "allocatable": {"cpu": "4", "memory": "16158816Ki", "pods": "110"},
+                "conditions": [{"type": str, "status": "False"|"True"|"Unknown"}, ...],
+                "labels": {str: str},                  # used by constraint masks
+                "taints": [{"key","value","effect"}]}, # used by constraint masks
+               ...],
+     "pods":  [{"name": str, "namespace": str, "nodeName": str, "phase": str,
+                "containers": [{"resources": {"requests": {"cpu","memory"},
+                                               "limits":   {"cpu","memory"}}}],
+                "initContainers": [...],               # ignored (Q7)
+                "nodeSelector": {...}, "tolerations": [...]},  # masks
+               ...]}
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from kubernetesclustercapacity_tpu.scenario import Scenario
+from kubernetesclustercapacity_tpu.utils.quantity import (
+    QuantityParseError,
+    cpu_to_milli_reference,
+    parse_quantity,
+    to_bytes_reference,
+)
+
+__all__ = [
+    "ReferencePanic",
+    "NodeView",
+    "PerNodeResult",
+    "OracleResult",
+    "healthy_nodes",
+    "non_terminated_pods_for_node",
+    "pod_requests_limits",
+    "reference_run",
+]
+
+_UINT64_MOD = 1 << 64
+_INT64_MOD = 1 << 63
+
+# The four phases the field selector excludes (ClusterCapacity.go:236); only
+# "Running" — or any novel phase string — survives it.
+_EXCLUDED_PHASES = frozenset({"Pending", "Succeeded", "Failed", "Unknown"})
+
+
+class ReferencePanic(RuntimeError):
+    """The oracle's analog of a Go runtime panic in the reference."""
+
+
+def _to_go_int(u: int) -> int:
+    """Reinterpret an arbitrary Python int as a Go 64-bit signed int."""
+    u %= _UINT64_MOD
+    return u - _UINT64_MOD if u >= _INT64_MOD else u
+
+
+def _go_div(num: int, den: int) -> int:
+    """Go integer division: truncates toward zero (Python ``//`` floors)."""
+    q = abs(num) // abs(den)
+    return -q if (num < 0) != (den < 0) else q
+
+
+def _go_float_div(num: float, den: float) -> float:
+    """Go float64 division: x/0 is ±Inf, 0/0 is NaN — never a trap."""
+    if den == 0.0:
+        if num == 0.0:
+            return math.nan
+        return math.inf if num > 0 else -math.inf
+    return num / den
+
+
+@dataclass
+class NodeView:
+    """The reference's ``type node`` (``ClusterCapacity.go:41-46``).
+
+    A phantom (skipped-unhealthy) node is the zero value: empty name, zero
+    allocatables — exactly what the reference leaves in its slice.
+    """
+
+    name: str = ""
+    allocatable_cpu: int = 0  # uint64 millicores
+    allocatable_memory: int = 0  # int64 bytes
+    allocatable_pods: int = 0
+
+
+@dataclass
+class PerNodeResult:
+    """Everything the reference prints/accumulates per node (``:105-140``)."""
+
+    node: NodeView
+    pods_count: int
+    cpu_limits_milli: int
+    cpu_requests_milli: int
+    mem_limits_bytes: int
+    mem_requests_bytes: int
+    cpu_request_used_percent: float
+    mem_request_used_percent: float
+    cpu_limit_used_percent: float
+    mem_limit_used_percent: float
+    max_replicas: int
+
+
+@dataclass
+class OracleResult:
+    """Aggregate outcome of one reference-semantics run."""
+
+    per_node: list[PerNodeResult] = field(default_factory=list)
+    total_possible_replicas: int = 0
+    replicas_requested: int = 0
+
+    @property
+    def schedulable(self) -> bool:
+        # ClusterCapacity.go:144
+        return self.total_possible_replicas >= self.replicas_requested
+
+    @property
+    def fits(self) -> list[int]:
+        return [r.max_replicas for r in self.per_node]
+
+
+def healthy_nodes(
+    fixture: dict, *, emulate_slice_bug: bool = False
+) -> list[NodeView]:
+    """Replicates ``getHealthyNodes`` (``ClusterCapacity.go:166-230``).
+
+    * allocatable CPU via the reference CPU codec (``:196-197``);
+    * allocatable memory via ``bytefmt`` with error→0 (``:199-206``);
+    * allocatable pods via the real Quantity grammar (``.Pods().Value()``,
+      ``:208``);
+    * health: the first four conditions must all be ``"False"`` — any other
+      status marks the node unhealthy (``:212-219``); running out of
+      conditions before the fourth is an index-out-of-range panic;
+    * unhealthy nodes leave a zero-valued phantom entry (``:221-226``).
+
+    With ``emulate_slice_bug=True``, reproduces the ``make([]node, n, 3)``
+    len>cap crash for clusters of more than 3 nodes (``:176``) — the default
+    diverges and succeeds (SURVEY.md §2.4 Q4).
+    """
+    raw_nodes = fixture.get("nodes", [])
+    if emulate_slice_bug and len(raw_nodes) > 3:
+        raise ReferencePanic(
+            f"makeslice: len out of range (len {len(raw_nodes)} > cap 3, "
+            "ClusterCapacity.go:176)"
+        )
+
+    result = [NodeView() for _ in raw_nodes]
+    for i, raw in enumerate(raw_nodes):
+        allocatable = raw.get("allocatable", {})
+        cpu_milli = cpu_to_milli_reference(allocatable.get("cpu", "0"))
+        try:
+            mem_bytes = to_bytes_reference(allocatable.get("memory", ""))
+        except QuantityParseError:
+            mem_bytes = 0  # :202-206 — silent zero
+        pods_str = allocatable.get("pods", "0")
+        try:
+            alloc_pods = parse_quantity(pods_str).value()
+        except QuantityParseError:
+            alloc_pods = 0
+
+        conditions = raw.get("conditions", [])
+        flag_healthy = True
+        for j in range(4):  # :212 — hardcoded first four
+            if j >= len(conditions):
+                raise ReferencePanic(
+                    f"index out of range [{j}] with length {len(conditions)} "
+                    f"(node {raw.get('name', '?')!r}, ClusterCapacity.go:213)"
+                )
+            if conditions[j].get("status") != "False":
+                flag_healthy = False
+                break
+
+        if flag_healthy:
+            result[i] = NodeView(
+                name=raw.get("name", ""),
+                allocatable_cpu=cpu_milli,
+                allocatable_memory=mem_bytes,
+                allocatable_pods=alloc_pods,
+            )
+    return result
+
+
+def non_terminated_pods_for_node(fixture: dict, node_name: str) -> list[dict]:
+    """Replicates the field-selector pod list (``ClusterCapacity.go:232-253``).
+
+    Matches pods whose ``spec.nodeName`` equals ``node_name`` and whose phase
+    is none of Pending/Succeeded/Failed/Unknown, across ALL namespaces.  For a
+    phantom node (``node_name == ""``) this matches unscheduled pods — the
+    selector degenerates to ``spec.nodeName=`` (Q4).
+    """
+    return [
+        p
+        for p in fixture.get("pods", [])
+        if p.get("nodeName", "") == node_name
+        and p.get("phase") not in _EXCLUDED_PHASES
+    ]
+
+
+def pod_requests_limits(pods: list[dict]) -> tuple[int, int, int, int]:
+    """Replicates ``getPodCPUMemoryRequestsLimits`` (``ClusterCapacity.go:255-299``).
+
+    Sums over regular containers only.  CPU strings go through the reference
+    codec (an absent resource is the zero Quantity whose ``String()`` is
+    ``"0"`` → 0); memory uses the real Quantity grammar (``Memory().Value()``,
+    ``:285-286``) with absent → 0.  Returns
+    ``(cpu_limits, cpu_requests, mem_limits, mem_requests)`` in the
+    reference's order, with Go integer wrapping on the running sums.
+    """
+    cpu_req_total = cpu_lim_total = 0  # uint64 in Go
+    mem_req_total = mem_lim_total = 0  # int64 in Go
+    for pod in pods:
+        for container in pod.get("containers", []):
+            resources = container.get("resources", {})
+            limits = resources.get("limits", {})
+            requests = resources.get("requests", {})
+            cpu_lim_total = (
+                cpu_lim_total + cpu_to_milli_reference(limits.get("cpu", "0"))
+            ) % _UINT64_MOD
+            cpu_req_total = (
+                cpu_req_total + cpu_to_milli_reference(requests.get("cpu", "0"))
+            ) % _UINT64_MOD
+            mem_lim_total = _to_go_int(
+                mem_lim_total + _mem_value(limits.get("memory"))
+            )
+            mem_req_total = _to_go_int(
+                mem_req_total + _mem_value(requests.get("memory"))
+            )
+    return cpu_lim_total, cpu_req_total, mem_lim_total, mem_req_total
+
+
+def _mem_value(s: str | None) -> int:
+    """``Quantity.Value()`` of a container memory string; absent/invalid → 0.
+
+    (An invalid quantity cannot exist in a real API object — the apiserver
+    validates — so zero matches what the zero Quantity would report.)
+    """
+    if s is None:
+        return 0
+    try:
+        return parse_quantity(s).value()
+    except QuantityParseError:
+        return 0
+
+
+def reference_run(
+    fixture: dict,
+    scenario: Scenario,
+    *,
+    emulate_slice_bug: bool = False,
+) -> OracleResult:
+    """Full bug-for-bug run of the reference ``main`` over a fixture.
+
+    The per-node loop (``ClusterCapacity.go:105-140``)::
+
+        cpuFit = 0 if allocCPU <= usedCPUreq else (allocCPU - usedCPUreq) / cpuReq
+        memFit = 0 if allocMem <= usedMemReq else (allocMem - usedMemReq) / memReq
+        fit    = min(cpuFit, memFit)
+        if fit >= allocatablePods: fit = allocatablePods - len(pods)   # Q1
+        total += fit
+
+    Integer division floors (all operands non-negative after the guards);
+    ``cpuReq == 0`` panics exactly where the reference does (``:123``).
+    """
+    nodes = healthy_nodes(fixture, emulate_slice_bug=emulate_slice_bug)
+    result = OracleResult(replicas_requested=scenario.replicas)
+
+    # One pass over the pod list instead of the reference's per-node rescan
+    # (its field-selector List at :238 is a fresh apiserver query per node);
+    # per-node ordering is preserved, so the sums are identical.
+    pods_by_node: dict[str, list[dict]] = {}
+    for p in fixture.get("pods", []):
+        if p.get("phase") not in _EXCLUDED_PHASES:
+            pods_by_node.setdefault(p.get("nodeName", ""), []).append(p)
+
+    for node in nodes:
+        pods = pods_by_node.get(node.name, [])
+        cpu_lim, cpu_req_used, mem_lim, mem_req_used = pod_requests_limits(pods)
+
+        per = PerNodeResult(
+            node=node,
+            pods_count=len(pods),
+            cpu_limits_milli=cpu_lim,
+            cpu_requests_milli=cpu_req_used,
+            mem_limits_bytes=mem_lim,
+            mem_requests_bytes=mem_req_used,
+            cpu_request_used_percent=_go_float_div(
+                float(cpu_req_used) * 100, float(node.allocatable_cpu)
+            ),
+            mem_request_used_percent=_go_float_div(
+                float(mem_req_used) * 100, float(node.allocatable_memory)
+            ),
+            cpu_limit_used_percent=_go_float_div(
+                float(cpu_lim) * 100, float(node.allocatable_cpu)
+            ),
+            mem_limit_used_percent=_go_float_div(
+                float(mem_lim) * 100, float(node.allocatable_memory)
+            ),
+            max_replicas=0,
+        )
+
+        if node.allocatable_cpu <= cpu_req_used:
+            cpu_fit = 0  # :119-121
+        else:
+            if scenario.cpu_request_milli == 0:
+                raise ReferencePanic(
+                    "integer divide by zero (ClusterCapacity.go:123)"
+                )
+            cpu_fit = _to_go_int(
+                (node.allocatable_cpu - cpu_req_used) // scenario.cpu_request_milli
+            )
+
+        if node.allocatable_memory <= mem_req_used:
+            mem_fit = 0  # :125-127
+        else:
+            if scenario.mem_request_bytes == 0:
+                raise ReferencePanic(
+                    "integer divide by zero (ClusterCapacity.go:129)"
+                )
+            # int64 subtraction wraps (mem_req_used can be negative after a
+            # wrapped sum, making the exact difference exceed int64), and Go
+            # division truncates toward zero.
+            mem_fit = _go_div(
+                _to_go_int(node.allocatable_memory - mem_req_used),
+                scenario.mem_request_bytes,
+            )
+
+        max_replicas = cpu_fit if cpu_fit <= mem_fit else mem_fit  # findMin :159-164
+        if max_replicas >= node.allocatable_pods:  # Q1, :134-136
+            max_replicas = node.allocatable_pods - len(pods)
+
+        per.max_replicas = max_replicas
+        result.per_node.append(per)
+        result.total_possible_replicas += max_replicas
+
+    return result

@@ -1,0 +1,194 @@
+"""Scenario types: the what-if pod specs the capacity kernel evaluates.
+
+The reference evaluates exactly ONE scenario per process run — the six CLI
+flags at ``ClusterCapacity.go:50-62`` parsed at ``:64-83``.  Here a scenario
+is a first-class value, and a :class:`ScenarioGrid` batches thousands of them
+into dense arrays for the vectorized TPU kernel (the "scenario axis" of
+SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from kubernetesclustercapacity_tpu.utils.quantity import (
+    QuantityParseError,
+    cpu_to_milli_reference,
+    go_atoi,
+    to_bytes_reference,
+)
+
+__all__ = [
+    "Scenario",
+    "ScenarioGrid",
+    "ScenarioError",
+    "scenario_from_flags",
+    "random_scenario_grid",
+]
+
+# Reference CLI defaults (ClusterCapacity.go:57-61).
+DEFAULT_CPU_REQUESTS = "100m"
+DEFAULT_CPU_LIMITS = "200m"
+DEFAULT_MEM_REQUESTS = "100mb"
+DEFAULT_MEM_LIMITS = "200mb"
+DEFAULT_REPLICAS = "1"
+
+
+class ScenarioError(ValueError):
+    """Invalid scenario flags — the analog of the reference's ``os.Exit(1)``."""
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One what-if pod spec: resource requests/limits + desired replicas.
+
+    Units are the kernel's native integers: millicores and bytes.  Limits are
+    carried for reporting parity only — like the reference, they never gate
+    capacity (``ClusterCapacity.go:109-117``, SURVEY.md §2.4 Q2).
+    """
+
+    cpu_request_milli: int
+    mem_request_bytes: int
+    replicas: int
+    cpu_limit_milli: int = 0
+    mem_limit_bytes: int = 0
+
+    def validate(self) -> None:
+        """Reject requests the reference would crash on.
+
+        ``cpuRequests=0`` (or an unparseable value that the reference codec
+        zeroed) causes an integer divide-by-zero panic at
+        ``ClusterCapacity.go:123`` in the reference; ``memRequests`` cannot
+        reach zero there because ``bytefmt.ToBytes`` rejects ≤ 0.  Divergence
+        (SURVEY.md §2.4 Q8): we validate instead of panicking.
+        """
+        if self.cpu_request_milli <= 0:
+            raise ScenarioError(
+                "cpuRequests must be > 0 (the reference integer-divides by it "
+                "and would panic on zero)"
+            )
+        if self.mem_request_bytes <= 0:
+            raise ScenarioError("memRequests must be > 0")
+        if self.replicas < 0:
+            raise ScenarioError("replicas must be >= 0")
+
+
+def scenario_from_flags(
+    cpuRequests: str = DEFAULT_CPU_REQUESTS,
+    cpuLimits: str = DEFAULT_CPU_LIMITS,
+    memRequests: str = DEFAULT_MEM_REQUESTS,
+    memLimits: str = DEFAULT_MEM_LIMITS,
+    replicas: str = DEFAULT_REPLICAS,
+) -> Scenario:
+    """Parse flag strings exactly as the reference ``main`` does (``:64-83``).
+
+    * CPU flags go through the reference codec — parse failure silently
+      yields 0 there (it would then panic at division time; we defer to
+      :meth:`Scenario.validate`).
+    * Memory flags: a ``bytefmt`` parse error is fatal (``os.Exit(1)`` at
+      ``:68-77``) → :class:`ScenarioError` here.
+    * Replicas: Go ``strconv.Atoi`` failure is fatal (``:79-83``).
+    """
+    cpu_req = cpu_to_milli_reference(cpuRequests)
+    cpu_lim = cpu_to_milli_reference(cpuLimits)
+    try:
+        mem_req = to_bytes_reference(memRequests)
+    except QuantityParseError as e:
+        raise ScenarioError(f"Invalid input memRequests: {e}") from e
+    try:
+        mem_lim = to_bytes_reference(memLimits)
+    except QuantityParseError as e:
+        raise ScenarioError(f"Invalid input memLimits: {e}") from e
+    n_replicas = go_atoi(replicas)  # Go strconv.Atoi acceptance rules (:79)
+    if n_replicas is None:
+        raise ScenarioError(f"Invalid input replicas: {replicas!r}")
+    return Scenario(
+        cpu_request_milli=cpu_req,
+        mem_request_bytes=mem_req,
+        replicas=n_replicas,
+        cpu_limit_milli=cpu_lim,
+        mem_limit_bytes=mem_lim,
+    )
+
+
+@dataclass(frozen=True)
+class ScenarioGrid:
+    """A batch of S scenarios as dense arrays — the kernel's scenario axis.
+
+    ``cpu_request_milli`` and ``mem_request_bytes`` are int64 ``[S]`` arrays;
+    ``replicas`` is int64 ``[S]``.  This is what ``vmap``/``pjit`` map over.
+    """
+
+    cpu_request_milli: np.ndarray
+    mem_request_bytes: np.ndarray
+    replicas: np.ndarray
+
+    def __post_init__(self) -> None:
+        for name in ("cpu_request_milli", "mem_request_bytes", "replicas"):
+            arr = np.asarray(getattr(self, name), dtype=np.int64)
+            object.__setattr__(self, name, arr)
+        if not (
+            self.cpu_request_milli.shape
+            == self.mem_request_bytes.shape
+            == self.replicas.shape
+        ) or self.cpu_request_milli.ndim != 1:
+            raise ScenarioError("scenario arrays must be equal-length 1-D")
+
+    @property
+    def size(self) -> int:
+        return int(self.cpu_request_milli.shape[0])
+
+    def validate(self) -> None:
+        if (self.cpu_request_milli <= 0).any():
+            raise ScenarioError("all cpu requests must be > 0")
+        if (self.mem_request_bytes <= 0).any():
+            raise ScenarioError("all mem requests must be > 0")
+
+    @classmethod
+    def from_scenarios(cls, scenarios: list[Scenario]) -> "ScenarioGrid":
+        return cls(
+            cpu_request_milli=np.array(
+                [s.cpu_request_milli for s in scenarios], dtype=np.int64
+            ),
+            mem_request_bytes=np.array(
+                [s.mem_request_bytes for s in scenarios], dtype=np.int64
+            ),
+            replicas=np.array([s.replicas for s in scenarios], dtype=np.int64),
+        )
+
+    def __getitem__(self, i: int) -> Scenario:
+        return Scenario(
+            cpu_request_milli=int(self.cpu_request_milli[i]),
+            mem_request_bytes=int(self.mem_request_bytes[i]),
+            replicas=int(self.replicas[i]),
+        )
+
+
+def random_scenario_grid(
+    n_scenarios: int,
+    *,
+    seed: int = 0,
+    cpu_milli_range: tuple[int, int] = (50, 4000),
+    mem_mib_range: tuple[int, int] = (64, 8192),
+    replicas_range: tuple[int, int] = (1, 500),
+) -> ScenarioGrid:
+    """Random what-if grid (BASELINE config 3: "1k random (cpu,mem) grid").
+
+    Memory requests are drawn in whole MiB so the fast int32 KiB-rescaled
+    kernel path stays eligible; the exact path accepts arbitrary bytes.
+    """
+    rng = np.random.default_rng(seed)
+    return ScenarioGrid(
+        cpu_request_milli=rng.integers(
+            cpu_milli_range[0], cpu_milli_range[1], size=n_scenarios, dtype=np.int64
+        ),
+        mem_request_bytes=rng.integers(
+            mem_mib_range[0], mem_mib_range[1], size=n_scenarios, dtype=np.int64
+        )
+        * (1024 * 1024),
+        replicas=rng.integers(
+            replicas_range[0], replicas_range[1], size=n_scenarios, dtype=np.int64
+        ),
+    )

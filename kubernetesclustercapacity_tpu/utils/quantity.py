@@ -37,6 +37,7 @@ from fractions import Fraction
 
 __all__ = [
     "QuantityParseError",
+    "go_atoi",
     "cpu_to_milli_reference",
     "to_bytes_reference",
     "byte_size",
@@ -65,7 +66,7 @@ class QuantityParseError(ValueError):
     """Raised when a quantity string cannot be parsed."""
 
 
-def _go_atoi(s: str) -> int | None:
+def go_atoi(s: str) -> int | None:
     """Base-10 integer parse with Go ``strconv.Atoi`` acceptance rules.
 
     Optional single leading ``+``/``-``, then one or more ASCII digits.  No
@@ -100,7 +101,7 @@ def cpu_to_milli_reference(cpu: str) -> int:
     has_m = cpu.endswith("m")
     if has_m:
         cpu = cpu[:-1]
-    value = _go_atoi(cpu)
+    value = go_atoi(cpu)
     if value is None:
         return 0
     if not has_m:
@@ -178,7 +179,13 @@ def to_bytes_reference(s: str) -> int:
     else:
         raise QuantityParseError(_INVALID_BYTE_QUANTITY_MSG)
 
-    return int(value * mult)
+    result = int(value * mult)
+    # Go's int64(float64) conversion is unspecified when out of range; on
+    # amd64/arm64 it produces math.MinInt64, which is what a node advertising
+    # absurd memory would get in the reference.
+    if not (-(1 << 63) <= result < (1 << 63)):
+        result = -(1 << 63)
+    return result
 
 
 def byte_size(n_bytes: int) -> str:

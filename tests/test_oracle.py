@@ -1,0 +1,227 @@
+"""Oracle tests: hand-computed expectations for the kind fixture + quirk coverage."""
+
+import math
+
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import load_fixture, synthetic_fixture
+from kubernetesclustercapacity_tpu.oracle import (
+    ReferencePanic,
+    healthy_nodes,
+    non_terminated_pods_for_node,
+    pod_requests_limits,
+    reference_run,
+)
+from kubernetesclustercapacity_tpu.scenario import (
+    Scenario,
+    ScenarioError,
+    scenario_from_flags,
+)
+
+MIB = 1024 * 1024
+KIND_ALLOC_MEM = 16368832 * 1024  # "16368832Ki"
+
+
+@pytest.fixture(scope="module")
+def kind_fixture():
+    return load_fixture("tests/fixtures/kind-3node.json")
+
+
+# The reference sample-run spec (README.md:40): 200m/400m CPU, 250mb/500mb mem.
+SAMPLE_SCENARIO = scenario_from_flags(
+    cpuRequests="200m", cpuLimits="400m", memRequests="250mb", memLimits="500mb",
+    replicas="10",
+)
+
+
+class TestScenarioParsing:
+    def test_sample_flags(self):
+        assert SAMPLE_SCENARIO.cpu_request_milli == 200
+        assert SAMPLE_SCENARIO.mem_request_bytes == 250 * MIB
+        assert SAMPLE_SCENARIO.replicas == 10
+        assert SAMPLE_SCENARIO.cpu_limit_milli == 400
+        assert SAMPLE_SCENARIO.mem_limit_bytes == 500 * MIB
+
+    def test_defaults_match_reference(self):
+        s = scenario_from_flags()
+        assert (s.cpu_request_milli, s.cpu_limit_milli) == (100, 200)
+        assert (s.mem_request_bytes, s.mem_limit_bytes) == (100 * MIB, 200 * MIB)
+        assert s.replicas == 1
+
+    def test_bad_mem_is_fatal(self):
+        with pytest.raises(ScenarioError):
+            scenario_from_flags(memRequests="garbage")
+
+    def test_bad_replicas_is_fatal(self):
+        with pytest.raises(ScenarioError):
+            scenario_from_flags(replicas="ten")
+
+    def test_bad_cpu_silently_zero_then_validate_rejects(self):
+        # Reference: unparseable CPU -> 0 -> later div-by-zero panic.  We
+        # surface it at validate() instead (SURVEY §2.4 Q8).
+        s = scenario_from_flags(cpuRequests="half")
+        assert s.cpu_request_milli == 0
+        with pytest.raises(ScenarioError):
+            s.validate()
+
+
+class TestHealthyNodes:
+    def test_kind_nodes_all_healthy(self, kind_fixture):
+        nodes = healthy_nodes(kind_fixture)
+        assert [n.name for n in nodes] == [
+            "kind-control-plane", "kind-worker", "kind-worker2",
+        ]
+        for n in nodes:
+            assert n.allocatable_cpu == 8000
+            assert n.allocatable_memory == KIND_ALLOC_MEM
+            assert n.allocatable_pods == 110
+
+    def test_unhealthy_leaves_phantom_zero_node(self, kind_fixture):
+        fx = load_fixture("tests/fixtures/kind-3node.json")
+        fx["nodes"][1]["conditions"][1]["status"] = "True"  # MemoryPressure
+        nodes = healthy_nodes(fx)
+        assert nodes[1].name == ""
+        assert nodes[1].allocatable_cpu == 0
+        assert nodes[1].allocatable_pods == 0
+
+    def test_fewer_than_four_conditions_panics(self):
+        # All-False conditions that run out before j=4: Go indexes past the
+        # slice end.  (A non-"False" first condition would break early and
+        # NOT panic — matching Go's loop order.)
+        fx = {"nodes": [{"name": "n", "allocatable": {}, "conditions": [
+            {"type": "MemoryPressure", "status": "False"},
+            {"type": "DiskPressure", "status": "False"}]}], "pods": []}
+        with pytest.raises(ReferencePanic, match="index out of range"):
+            healthy_nodes(fx)
+
+    def test_early_break_on_unhealthy_avoids_index_panic(self):
+        fx = {"nodes": [{"name": "n", "allocatable": {}, "conditions": [
+            {"type": "Ready", "status": "True"}]}], "pods": []}
+        nodes = healthy_nodes(fx)  # breaks at j=0, no panic
+        assert nodes[0].name == ""
+
+    def test_slice_bug_emulation(self):
+        fx = synthetic_fixture(4, seed=1)
+        with pytest.raises(ReferencePanic, match="makeslice"):
+            healthy_nodes(fx, emulate_slice_bug=True)
+        assert len(healthy_nodes(fx)) == 4  # default mode diverges: succeeds
+
+    def test_gi_memory_zeroes_node(self):
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "4", "memory": "16Gi", "pods": "110"},
+            "conditions": [{"type": t, "status": "False"} for t in "abcd"]}],
+            "pods": []}
+        nodes = healthy_nodes(fx)
+        assert nodes[0].allocatable_memory == 0  # Q5: bytefmt rejects Gi -> 0
+        assert nodes[0].allocatable_cpu == 4000
+
+
+class TestPodListing:
+    def test_running_only_and_all_namespaces(self, kind_fixture):
+        pods = non_terminated_pods_for_node(kind_fixture, "kind-worker")
+        names = sorted(p["name"] for p in pods)
+        # Succeeded batch job excluded; kube-system + default both included.
+        assert names == [
+            "coredns-565d847f94-9ttqk", "kube-proxy-kind-worker",
+            "web-7f5b8c9d4-abcde",
+        ]
+
+    def test_phantom_node_matches_unscheduled(self):
+        fx = synthetic_fixture(2, seed=3, unscheduled_running_pods=2)
+        orphans = non_terminated_pods_for_node(fx, "")
+        assert len(orphans) == 2
+
+
+class TestPodSums:
+    def test_kind_worker_sums(self, kind_fixture):
+        pods = non_terminated_pods_for_node(kind_fixture, "kind-worker")
+        cpu_lim, cpu_req, mem_lim, mem_req = pod_requests_limits(pods)
+        # coredns 100m/70Mi (lim mem 170Mi), proxy nothing,
+        # web: containers (500m,512Mi lim 1cpu/1Gi) + (50m,64Mi); init ignored.
+        assert cpu_req == 100 + 500 + 50
+        assert mem_req == (70 + 512 + 64) * MIB
+        assert cpu_lim == 1000
+        assert mem_lim == 170 * MIB + 1024 * MIB
+
+
+class TestReferenceRun:
+    def test_kind_sample_run(self, kind_fixture):
+        result = reference_run(kind_fixture, SAMPLE_SCENARIO)
+        # Hand-computed (see SURVEY §2.2 C8 semantics):
+        # control-plane: cpu (8000-650)//200=36, mem (alloc-100Mi)//250Mi=63 -> 36
+        # worker:        cpu (8000-650)//200=36, mem (alloc-646Mi)//250Mi=61 -> 36
+        # worker2:       cpu (8000-600)//200=37, mem (alloc-582Mi)//250Mi=61 -> 37
+        assert result.fits == [36, 36, 37]
+        assert result.total_possible_replicas == 109
+        assert result.schedulable  # 109 >= 10
+
+    def test_pod_cap_quirk_triggers(self):
+        # Empty node, tiny pod budget: fit >= allocatablePods -> capped to
+        # allocatablePods - len(pods).
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "8", "memory": "1048576Ki", "pods": "5"},
+            "conditions": [{"type": t, "status": "False"} for t in "abcd"]}],
+            "pods": []}
+        r = reference_run(fx, Scenario(100, MIB, 1))
+        assert r.fits == [5]
+
+    def test_pod_cap_quirk_not_applied_below_threshold(self):
+        # SURVEY §2.4 Q1: cap only when fit >= allocatablePods.  110 alloc
+        # pods, 50 running 0-request pods, cpu fit 100 -> returns 100 even
+        # though only 60 pod slots remain.
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "10", "memory": "104857600Ki", "pods": "110"},
+            "conditions": [{"type": t, "status": "False"} for t in "abcd"]}],
+            "pods": [{"name": f"p{i}", "namespace": "default", "nodeName": "n",
+                      "phase": "Running", "containers": [{"resources": {}}]}
+                     for i in range(50)]}
+        r = reference_run(fx, Scenario(100, MIB, 1))
+        assert r.fits == [100]
+
+    def test_negative_fit_from_cap(self):
+        # alloc_pods=2 but 5 running pods: fit -> 2 - 5 = -3.
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "64", "memory": "104857600Ki", "pods": "2"},
+            "conditions": [{"type": t, "status": "False"} for t in "abcd"]}],
+            "pods": [{"name": f"p{i}", "namespace": "d", "nodeName": "n",
+                      "phase": "Running", "containers": [{"resources": {}}]}
+                     for i in range(5)]}
+        r = reference_run(fx, Scenario(100, MIB, 1))
+        assert r.fits == [-3]
+        assert r.total_possible_replicas == -3
+
+    def test_phantom_node_with_orphan_pods_goes_negative(self):
+        fx = synthetic_fixture(
+            3, seed=7, unhealthy_frac=1.0, unscheduled_running_pods=4)
+        r = reference_run(fx, Scenario(100, MIB, 1))
+        # All nodes phantom: fit = min(0,0)=0 >= alloc_pods(0) -> 0 - 4 orphans.
+        assert r.fits == [-4, -4, -4]
+
+    def test_full_node_yields_zero_without_division(self):
+        # alloc <= used guards the division, so cpu_request=0 does NOT panic
+        # when every node is already full (guard order parity).
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "1", "memory": "1024Ki", "pods": "110"},
+            "conditions": [{"type": t, "status": "False"} for t in "abcd"]}],
+            "pods": [{"name": "p", "namespace": "d", "nodeName": "n",
+                      "phase": "Running", "containers": [{"resources": {
+                          "requests": {"cpu": "2", "memory": "1Gi"}}}]}]}
+        r = reference_run(fx, Scenario(0, 0, 1))  # zero requests, but guarded
+        assert r.fits == [0]
+
+    def test_zero_cpu_request_panics_on_headroom(self, kind_fixture):
+        with pytest.raises(ReferencePanic, match="divide by zero"):
+            reference_run(kind_fixture, Scenario(0, MIB, 1))
+
+    def test_percentages_use_go_float_semantics(self):
+        fx = synthetic_fixture(2, seed=9, unhealthy_frac=1.0)
+        r = reference_run(fx, Scenario(100, MIB, 1))
+        # Phantom nodes: 0*100/0 -> NaN (not a crash).
+        assert math.isnan(r.per_node[0].cpu_request_used_percent)
+
+    def test_verdict_threshold(self, kind_fixture):
+        assert reference_run(kind_fixture, SAMPLE_SCENARIO).schedulable
+        big = Scenario(200, 250 * MIB, 110)
+        assert not reference_run(kind_fixture, big).schedulable  # 109 < 110
+        edge = Scenario(200, 250 * MIB, 109)
+        assert reference_run(kind_fixture, edge).schedulable  # >= is inclusive
