@@ -43,3 +43,21 @@ _jax.config.update("jax_enable_x64", True)
 __version__ = "0.1.0"
 
 from kubernetesclustercapacity_tpu.utils import quantity  # noqa: E402,F401
+from kubernetesclustercapacity_tpu.snapshot import (  # noqa: E402,F401
+    ClusterSnapshot,
+    load_snapshot,
+    snapshot_from_fixture,
+    synthetic_snapshot,
+)
+from kubernetesclustercapacity_tpu.scenario import (  # noqa: E402,F401
+    Scenario,
+    ScenarioGrid,
+    random_scenario_grid,
+    scenario_from_flags,
+)
+from kubernetesclustercapacity_tpu.ops.fit import (  # noqa: E402,F401
+    fit_per_node,
+    fit_totals,
+    sweep_grid,
+    sweep_snapshot,
+)

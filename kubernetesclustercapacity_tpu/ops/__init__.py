@@ -1,0 +1,1 @@
+"""Compute kernels (L1): the vectorized capacity-fit ops."""

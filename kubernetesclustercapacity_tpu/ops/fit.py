@@ -1,0 +1,234 @@
+"""The capacity-fit kernel: the reference's per-node loop, vectorized.
+
+The reference computes one scenario with a sequential Go loop
+(``ClusterCapacity.go:105-140``).  Here the same arithmetic is a branchless
+elementwise kernel over the node axis, ``vmap``-ed over a scenario axis and
+``jit``-compiled — XLA fuses the whole thing into a couple of elementwise
+passes, and the sharded variants in :mod:`..parallel` lay it out across a TPU
+mesh.
+
+Bit-exactness notes (the "hard parts" of SURVEY.md §7):
+
+* CPU math is Go ``uint64``: comparison and division happen on uint64 views
+  (int64 bit patterns reinterpreted), so wrapped values from the reference
+  codec compare/divide exactly as Go does, and the quotient is cast back to
+  int64 the way Go's ``int(...)`` cast does.
+* Memory math is Go ``int64``: subtraction relies on two's-complement wrap
+  (both Go and XLA wrap), and division truncates toward zero (Go) rather
+  than flooring (default ``//``) — emulated branchlessly with a sign split.
+* The conditional pod cap (Q1) is a ``where``, not a 3-way min: it OVERWRITES
+  the fit with ``alloc_pods - pods_count`` (which may be negative) only when
+  ``fit >= alloc_pods``.
+
+Modes (SURVEY.md §2.4 parity decisions):
+
+* ``"reference"`` — bug-compatible; bit-exact vs. the oracle.
+* ``"strict"``    — corrected semantics: 3-way min including remaining pod
+  slots, clamped at 0, unhealthy nodes contribute nothing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+
+__all__ = [
+    "fit_per_node",
+    "fit_totals",
+    "sweep_grid",
+    "sweep_snapshot",
+    "snapshot_device_arrays",
+]
+
+MODES = ("reference", "strict")
+
+
+def _trunc_div(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """Go int64 division: truncate toward zero (``//`` floors for negatives)."""
+    q = jnp.abs(num) // jnp.abs(den)
+    return jnp.where((num < 0) != (den < 0), -q, q)
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def fit_per_node(
+    alloc_cpu: jnp.ndarray,
+    alloc_mem: jnp.ndarray,
+    alloc_pods: jnp.ndarray,
+    used_cpu: jnp.ndarray,
+    used_mem: jnp.ndarray,
+    pods_count: jnp.ndarray,
+    healthy: jnp.ndarray,
+    cpu_req,
+    mem_req,
+    *,
+    mode: str = "reference",
+) -> jnp.ndarray:
+    """Per-node replica fit for ONE scenario — ``[N]`` int64.
+
+    Inputs are the snapshot's int64 node arrays and scalar int64 requests.
+    ``cpu_req``/``mem_req`` must be nonzero (validated upstream — the
+    reference would panic, SURVEY.md §2.4 Q8); the kernel itself is total.
+    """
+    alloc_cpu = jnp.asarray(alloc_cpu, jnp.int64)
+    alloc_mem = jnp.asarray(alloc_mem, jnp.int64)
+    alloc_pods = jnp.asarray(alloc_pods, jnp.int64)
+    used_cpu = jnp.asarray(used_cpu, jnp.int64)
+    used_mem = jnp.asarray(used_mem, jnp.int64)
+    pods_count = jnp.asarray(pods_count, jnp.int64)
+    cpu_req = jnp.asarray(cpu_req, jnp.int64)
+    mem_req = jnp.asarray(mem_req, jnp.int64)
+
+    # --- CPU: Go uint64 compare/divide on the raw bit patterns (:119-123).
+    alloc_cpu_u = alloc_cpu.astype(jnp.uint64)
+    used_cpu_u = used_cpu.astype(jnp.uint64)
+    cpu_req_u = jnp.maximum(cpu_req.astype(jnp.uint64), jnp.uint64(1))
+    cpu_fit = jnp.where(
+        alloc_cpu_u <= used_cpu_u,
+        jnp.uint64(0),
+        (alloc_cpu_u - used_cpu_u) // cpu_req_u,
+    ).astype(jnp.int64)
+
+    # --- Memory: Go int64 wrap-around subtraction + truncating div (:125-129).
+    mem_head = alloc_mem - used_mem  # wraps like Go int64
+    mem_fit = jnp.where(
+        alloc_mem <= used_mem,
+        jnp.int64(0),
+        _trunc_div(mem_head, jnp.where(mem_req == 0, jnp.int64(1), mem_req)),
+    )
+
+    fit = jnp.minimum(cpu_fit, mem_fit)  # findMin (:159-164)
+
+    if mode == "reference":
+        # Q1: conditional overwrite — only when fit >= allocatablePods, and
+        # the replacement ignores that cpu/mem may bind tighter (:134-136).
+        fit = jnp.where(fit >= alloc_pods, alloc_pods - pods_count, fit)
+    elif mode == "strict":
+        slots = jnp.maximum(alloc_pods - pods_count, 0)
+        fit = jnp.maximum(jnp.minimum(fit, slots), 0)
+        fit = jnp.where(jnp.asarray(healthy, jnp.bool_), fit, 0)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    return fit
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def fit_totals(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_req,
+    mem_req,
+    *,
+    mode: str = "reference",
+):
+    """Cluster total for one scenario: ``sum_n fit[n]`` — scalar int64."""
+    return jnp.sum(
+        fit_per_node(
+            alloc_cpu,
+            alloc_mem,
+            alloc_pods,
+            used_cpu,
+            used_mem,
+            pods_count,
+            healthy,
+            cpu_req,
+            mem_req,
+            mode=mode,
+        )
+    )
+
+
+@partial(jax.jit, static_argnames=("mode", "return_per_node"))
+def sweep_grid(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+    return_per_node: bool = False,
+):
+    """Evaluate S scenarios against N nodes in one compiled program.
+
+    ``vmap`` over the scenario axis of ``(cpu_reqs[S], mem_reqs[S])``;
+    returns ``(totals[S], schedulable[S])`` — and ``fits[S, N]`` too when
+    ``return_per_node`` (kept optional so the 10k×1k sweep reduces in-register
+    instead of materializing a 10M-cell intermediate in HBM).
+    """
+    per_scenario = jax.vmap(
+        lambda c, m: fit_per_node(
+            alloc_cpu,
+            alloc_mem,
+            alloc_pods,
+            used_cpu,
+            used_mem,
+            pods_count,
+            healthy,
+            c,
+            m,
+            mode=mode,
+        )
+    )
+    fits = per_scenario(jnp.asarray(cpu_reqs, jnp.int64), jnp.asarray(mem_reqs, jnp.int64))
+    totals = jnp.sum(fits, axis=1)
+    schedulable = totals >= jnp.asarray(replicas, jnp.int64)
+    if return_per_node:
+        return totals, schedulable, fits
+    return totals, schedulable
+
+
+def snapshot_device_arrays(snapshot: ClusterSnapshot) -> tuple:
+    """Put a snapshot's kernel inputs on device once (reused across sweeps)."""
+    return tuple(
+        jnp.asarray(a)
+        for a in (
+            snapshot.alloc_cpu_milli,
+            snapshot.alloc_mem_bytes,
+            snapshot.alloc_pods,
+            snapshot.used_cpu_req_milli,
+            snapshot.used_mem_req_bytes,
+            snapshot.pods_count,
+            snapshot.healthy,
+        )
+    )
+
+
+def sweep_snapshot(
+    snapshot: ClusterSnapshot,
+    grid: ScenarioGrid,
+    *,
+    mode: str = "reference",
+    return_per_node: bool = False,
+):
+    """Convenience wrapper: ``ClusterSnapshot`` × ``ScenarioGrid`` → results.
+
+    Validates the grid the way the reference's flag layer would (nonzero
+    requests), then dispatches the jitted sweep.  Returns numpy arrays.
+    """
+    grid.validate()
+    arrays = snapshot_device_arrays(snapshot)
+    out = sweep_grid(
+        *arrays,
+        grid.cpu_request_milli,
+        grid.mem_request_bytes,
+        grid.replicas,
+        mode=mode,
+        return_per_node=return_per_node,
+    )
+    return tuple(np.asarray(o) for o in out)

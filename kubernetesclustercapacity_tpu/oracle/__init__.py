@@ -10,6 +10,7 @@ from kubernetesclustercapacity_tpu.oracle.reference import (  # noqa: F401
     OracleResult,
     PerNodeResult,
     ReferencePanic,
+    fit_arrays_python,
     healthy_nodes,
     non_terminated_pods_for_node,
     pod_requests_limits,

@@ -66,6 +66,7 @@ __all__ = [
     "non_terminated_pods_for_node",
     "pod_requests_limits",
     "reference_run",
+    "fit_arrays_python",
 ]
 
 _UINT64_MOD = 1 << 64
@@ -211,6 +212,11 @@ def healthy_nodes(
     return result
 
 
+def _survives_field_selector(pod: dict) -> bool:
+    """The phase half of the field selector (``ClusterCapacity.go:236``)."""
+    return pod.get("phase") not in _EXCLUDED_PHASES
+
+
 def non_terminated_pods_for_node(fixture: dict, node_name: str) -> list[dict]:
     """Replicates the field-selector pod list (``ClusterCapacity.go:232-253``).
 
@@ -222,9 +228,23 @@ def non_terminated_pods_for_node(fixture: dict, node_name: str) -> list[dict]:
     return [
         p
         for p in fixture.get("pods", [])
-        if p.get("nodeName", "") == node_name
-        and p.get("phase") not in _EXCLUDED_PHASES
+        if p.get("nodeName", "") == node_name and _survives_field_selector(p)
     ]
+
+
+def pods_by_node_index(fixture: dict) -> dict[str, list[dict]]:
+    """Group field-selector-surviving pods by nodeName in one pass.
+
+    Per-node list order matches :func:`non_terminated_pods_for_node` (both
+    preserve fixture order), so sums computed either way are identical — this
+    just avoids the reference's per-node rescan (a fresh apiserver List per
+    node at ``:238``).
+    """
+    index: dict[str, list[dict]] = {}
+    for p in fixture.get("pods", []):
+        if _survives_field_selector(p):
+            index.setdefault(p.get("nodeName", ""), []).append(p)
+    return index
 
 
 def pod_requests_limits(pods: list[dict]) -> tuple[int, int, int, int]:
@@ -273,6 +293,55 @@ def _mem_value(s: str | None) -> int:
         return 0
 
 
+def fit_arrays_python(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    cpu_req: int,
+    mem_req: int,
+) -> list[int]:
+    """Go-semantics fit over raw int64 arrays — the array-level ground truth.
+
+    Same arithmetic as :func:`reference_run`'s per-node loop, but taking the
+    snapshot's packed int64 arrays directly (bit patterns: CPU values are
+    uint64 reinterpreted).  Lets parity tests feed the JAX kernel and this
+    scalar loop identical adversarial arrays — including wrapped negatives —
+    without constructing fixtures.
+    """
+    fits = []
+    cr = int(cpu_req) % _UINT64_MOD
+    mr = int(mem_req)
+    for i in range(len(alloc_cpu)):
+        ac = int(alloc_cpu[i]) % _UINT64_MOD  # uint64 view of the bit pattern
+        uc = int(used_cpu[i]) % _UINT64_MOD
+        if ac <= uc:
+            cpu_fit = 0
+        else:
+            if cr == 0:
+                raise ReferencePanic(
+                    "integer divide by zero (ClusterCapacity.go:123)"
+                )
+            cpu_fit = _to_go_int((ac - uc) // cr)
+        am, um = int(alloc_mem[i]), int(used_mem[i])
+        if am <= um:
+            mem_fit = 0
+        else:
+            if mr == 0:
+                raise ReferencePanic(
+                    "integer divide by zero (ClusterCapacity.go:129)"
+                )
+            mem_fit = _go_div(_to_go_int(am - um), mr)
+        fit = cpu_fit if cpu_fit <= mem_fit else mem_fit
+        ap = int(alloc_pods[i])
+        if fit >= ap:
+            fit = ap - int(pods_count[i])
+        fits.append(fit)
+    return fits
+
+
 def reference_run(
     fixture: dict,
     scenario: Scenario,
@@ -295,13 +364,7 @@ def reference_run(
     nodes = healthy_nodes(fixture, emulate_slice_bug=emulate_slice_bug)
     result = OracleResult(replicas_requested=scenario.replicas)
 
-    # One pass over the pod list instead of the reference's per-node rescan
-    # (its field-selector List at :238 is a fresh apiserver query per node);
-    # per-node ordering is preserved, so the sums are identical.
-    pods_by_node: dict[str, list[dict]] = {}
-    for p in fixture.get("pods", []):
-        if p.get("phase") not in _EXCLUDED_PHASES:
-            pods_by_node.setdefault(p.get("nodeName", ""), []).append(p)
+    pods_by_node = pods_by_node_index(fixture)
 
     for node in nodes:
         pods = pods_by_node.get(node.name, [])

@@ -60,9 +60,11 @@ class Scenario:
 
         ``cpuRequests=0`` (or an unparseable value that the reference codec
         zeroed) causes an integer divide-by-zero panic at
-        ``ClusterCapacity.go:123`` in the reference; ``memRequests`` cannot
-        reach zero there because ``bytefmt.ToBytes`` rejects ≤ 0.  Divergence
-        (SURVEY.md §2.4 Q8): we validate instead of panicking.
+        ``ClusterCapacity.go:123`` in the reference; ``memRequests`` can reach
+        zero too — ``bytefmt`` checks positivity on the pre-multiplication
+        float, so ``"0.5B"`` passes the check and truncates to 0 bytes,
+        panicking at ``:129``.  Divergence (SURVEY.md §2.4 Q8): we validate
+        instead of panicking.
         """
         if self.cpu_request_milli <= 0:
             raise ScenarioError(
@@ -145,6 +147,8 @@ class ScenarioGrid:
             raise ScenarioError("all cpu requests must be > 0")
         if (self.mem_request_bytes <= 0).any():
             raise ScenarioError("all mem requests must be > 0")
+        if (self.replicas < 0).any():
+            raise ScenarioError("all replicas must be >= 0")
 
     @classmethod
     def from_scenarios(cls, scenarios: list[Scenario]) -> "ScenarioGrid":

@@ -1,0 +1,474 @@
+"""Cluster snapshot (L2): dense node-resource arrays the kernels consume.
+
+The reference re-queries the apiserver ``1 + 2N + ΣP`` times per run
+(SURVEY.md §3.4) and holds cluster state as a ``[]node`` of Go structs.  Here
+the cluster is snapshotted ONCE into dense int64 arrays — the TPU-native
+representation: every downstream evaluation (one scenario or a 1k-scenario
+sweep) is pure array math with zero API calls on the hot path.
+
+Two ingestion semantics, pinned by SURVEY.md §2.4:
+
+* ``reference`` — bug-compatible: built on the oracle's own walk
+  (:mod:`..oracle.reference`), so phantom zero-nodes, parse-fail→0 memory and
+  the first-4-conditions health check land in the arrays exactly as the Go
+  code would see them.  Kernel output on these arrays is bit-exact against
+  the oracle by construction.
+* ``strict`` — correct-mode: full Kubernetes quantity grammar, health =
+  ``Ready == True`` and no pressure condition ``True``, pod usage counts all
+  pods assigned to the node that are not Succeeded/Failed, and per-pod
+  effective requests follow the scheduler rule
+  ``max(sum(containers), max(initContainers))``.  Unhealthy nodes keep their
+  real allocatables but are masked out via ``healthy``.
+
+Extended resources (BASELINE config 4) ride along as extra named columns
+parsed with the strict grammar (the reference has no concept of them).
+
+The snapshot doubles as the framework's *checkpoint*: :meth:`ClusterSnapshot.save`
+/ :func:`load_snapshot` serialize the arrays to ``.npz`` so sweeps re-run
+offline and reproducibly (SURVEY.md §5 "checkpoint/resume").
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, fields
+
+import numpy as np
+
+from kubernetesclustercapacity_tpu.oracle import reference as _oracle
+from kubernetesclustercapacity_tpu.utils import quantity as _q
+
+__all__ = [
+    "ClusterSnapshot",
+    "snapshot_from_fixture",
+    "synthetic_snapshot",
+    "load_snapshot",
+    "snapshot_from_live_cluster",
+]
+
+# Phases that never consume node capacity in strict mode (terminated pods).
+_STRICT_TERMINATED = frozenset({"Succeeded", "Failed"})
+
+# Default extended resources for config 4 (strict mode only).
+DEFAULT_EXTENDED_RESOURCES = ("ephemeral-storage", "nvidia.com/gpu")
+
+
+@dataclass
+class ClusterSnapshot:
+    """Dense ``(nodes,)`` arrays of allocatable vs. requested resources.
+
+    All resource arrays are int64 (CPU in millicores, memory in bytes —
+    matching the reference's unit choices at ``ClusterCapacity.go:41-46``).
+    ``healthy`` is the first-class node-health mask (SURVEY.md §5 "failure
+    detection"): in reference semantics unhealthy rows are ALSO zeroed
+    (phantom nodes), in strict semantics they carry real values and the mask
+    alone excludes them.
+
+    ``extended`` maps resource name → ``(allocatable[N], used_requests[N])``
+    int64 pairs in the resource's native unit (bytes for ephemeral-storage,
+    count for GPUs).
+    """
+
+    names: list[str]
+    alloc_cpu_milli: np.ndarray
+    alloc_mem_bytes: np.ndarray
+    alloc_pods: np.ndarray
+    used_cpu_req_milli: np.ndarray
+    used_cpu_lim_milli: np.ndarray
+    used_mem_req_bytes: np.ndarray
+    used_mem_lim_bytes: np.ndarray
+    pods_count: np.ndarray
+    healthy: np.ndarray
+    semantics: str = "reference"
+    extended: dict[str, tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
+    labels: list[dict] = field(default_factory=list)
+    taints: list[list] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        n = len(self.names)
+        for f in (
+            "alloc_cpu_milli",
+            "alloc_mem_bytes",
+            "alloc_pods",
+            "used_cpu_req_milli",
+            "used_cpu_lim_milli",
+            "used_mem_req_bytes",
+            "used_mem_lim_bytes",
+            "pods_count",
+        ):
+            arr = np.asarray(getattr(self, f), dtype=np.int64)
+            if arr.shape != (n,):
+                raise ValueError(f"{f}: expected shape ({n},), got {arr.shape}")
+            setattr(self, f, arr)
+        self.healthy = np.asarray(self.healthy, dtype=np.bool_)
+        if self.healthy.shape != (n,):
+            raise ValueError("healthy mask shape mismatch")
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.names)
+
+    def resource_matrix(
+        self, resources: tuple[str, ...] = ("cpu", "memory")
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Assemble ``(alloc[R, N], used_req[R, N])`` for the R-dim fit kernel.
+
+        Row order follows ``resources``; ``"cpu"`` and ``"memory"`` name the
+        core columns, anything else must be a key of :attr:`extended`.
+        """
+        alloc_rows, used_rows = [], []
+        for r in resources:
+            if r == "cpu":
+                alloc_rows.append(self.alloc_cpu_milli)
+                used_rows.append(self.used_cpu_req_milli)
+            elif r == "memory":
+                alloc_rows.append(self.alloc_mem_bytes)
+                used_rows.append(self.used_mem_req_bytes)
+            else:
+                alloc, used = self.extended[r]
+                alloc_rows.append(alloc)
+                used_rows.append(used)
+        return np.stack(alloc_rows), np.stack(used_rows)
+
+    def save(self, path: str) -> None:
+        """Checkpoint to ``.npz`` (arrays + JSON metadata), reproducibly."""
+        meta = {
+            "names": self.names,
+            "semantics": self.semantics,
+            "labels": self.labels,
+            "taints": self.taints,
+            "extended_names": sorted(self.extended),
+            "version": 1,
+        }
+        arrays = {
+            f.name: getattr(self, f.name)
+            for f in fields(self)
+            if f.name
+            not in ("names", "semantics", "extended", "labels", "taints")
+        }
+        for r_name, (alloc, used) in self.extended.items():
+            arrays[f"ext_alloc::{r_name}"] = alloc
+            arrays[f"ext_used::{r_name}"] = used
+        np.savez_compressed(path, __meta__=json.dumps(meta), **arrays)
+
+
+def load_snapshot(path: str) -> ClusterSnapshot:
+    with np.load(path, allow_pickle=False) as data:
+        meta = json.loads(str(data["__meta__"]))
+        extended = {
+            r: (data[f"ext_alloc::{r}"], data[f"ext_used::{r}"])
+            for r in meta["extended_names"]
+        }
+        return ClusterSnapshot(
+            names=meta["names"],
+            alloc_cpu_milli=data["alloc_cpu_milli"],
+            alloc_mem_bytes=data["alloc_mem_bytes"],
+            alloc_pods=data["alloc_pods"],
+            used_cpu_req_milli=data["used_cpu_req_milli"],
+            used_cpu_lim_milli=data["used_cpu_lim_milli"],
+            used_mem_req_bytes=data["used_mem_req_bytes"],
+            used_mem_lim_bytes=data["used_mem_lim_bytes"],
+            pods_count=data["pods_count"],
+            healthy=data["healthy"],
+            semantics=meta["semantics"],
+            extended=extended,
+            labels=meta["labels"],
+            taints=meta["taints"],
+        )
+
+
+def snapshot_from_fixture(
+    fixture: dict,
+    *,
+    semantics: str = "reference",
+    extended_resources: tuple[str, ...] = (),
+) -> ClusterSnapshot:
+    """Pack a node/pod fixture into dense arrays under the chosen semantics."""
+    if semantics == "reference":
+        return _pack_reference(fixture)
+    if semantics == "strict":
+        return _pack_strict(fixture, extended_resources)
+    raise ValueError(f"unknown semantics {semantics!r} (want 'reference'|'strict')")
+
+
+def _pack_reference(fixture: dict) -> ClusterSnapshot:
+    """Reference-semantics packing, built on the oracle's own primitives.
+
+    Phantom nodes (unhealthy → zero-valued, ``ClusterCapacity.go:221-226``)
+    keep their zero allocatables AND accumulate usage from pods with an empty
+    ``nodeName`` — exactly what the degenerate field selector matches (Q4).
+    """
+    nodes = _oracle.healthy_nodes(fixture)
+    pods_by_node = _oracle.pods_by_node_index(fixture)
+
+    n = len(nodes)
+    snap = _empty_arrays(n)
+    names, labels, taints = [], [], []
+    raw_nodes = fixture.get("nodes", [])
+    for i, node in enumerate(nodes):
+        pods = pods_by_node.get(node.name, [])
+        cpu_lim, cpu_req, mem_lim, mem_req = _oracle.pod_requests_limits(pods)
+        names.append(node.name)
+        snap["alloc_cpu_milli"][i] = _clamp_i64(node.allocatable_cpu)
+        snap["alloc_mem_bytes"][i] = _clamp_i64(node.allocatable_memory)
+        snap["alloc_pods"][i] = node.allocatable_pods
+        snap["used_cpu_req_milli"][i] = _clamp_i64(cpu_req)
+        snap["used_cpu_lim_milli"][i] = _clamp_i64(cpu_lim)
+        snap["used_mem_req_bytes"][i] = mem_req
+        snap["used_mem_lim_bytes"][i] = mem_lim
+        snap["pods_count"][i] = len(pods)
+        snap["healthy"][i] = bool(node.name)  # phantom = zero node = ""
+        labels.append(raw_nodes[i].get("labels", {}))
+        taints.append(raw_nodes[i].get("taints", []))
+
+    return ClusterSnapshot(
+        names=names, semantics="reference", labels=labels, taints=taints, **snap
+    )
+
+
+def _pack_strict(
+    fixture: dict, extended_resources: tuple[str, ...]
+) -> ClusterSnapshot:
+    """Correct-mode packing: real quantity grammar, scheduler-rule pod usage."""
+    raw_nodes = fixture.get("nodes", [])
+    n = len(raw_nodes)
+    snap = _empty_arrays(n)
+    ext = {
+        r: (np.zeros(n, dtype=np.int64), np.zeros(n, dtype=np.int64))
+        for r in extended_resources
+    }
+    names, labels, taints = [], [], []
+    index = {}
+    for i, raw in enumerate(raw_nodes):
+        name = raw.get("name", "")
+        names.append(name)
+        index[name] = i
+        labels.append(raw.get("labels", {}))
+        taints.append(raw.get("taints", []))
+        allocatable = raw.get("allocatable", {})
+        snap["alloc_cpu_milli"][i] = _strict_parse(allocatable.get("cpu"), milli=True)
+        snap["alloc_mem_bytes"][i] = _strict_parse(allocatable.get("memory"))
+        snap["alloc_pods"][i] = _strict_parse(allocatable.get("pods"))
+        snap["healthy"][i] = _strict_healthy(raw.get("conditions", []))
+        for r in extended_resources:
+            ext[r][0][i] = _strict_parse(allocatable.get(r))
+
+    for pod in fixture.get("pods", []):
+        node_name = pod.get("nodeName", "")
+        if not node_name or node_name not in index:
+            continue
+        if pod.get("phase") in _STRICT_TERMINATED:
+            continue
+        i = index[node_name]
+        snap["pods_count"][i] += 1
+        eff = _effective_pod_resources(pod, extended_resources)
+        snap["used_cpu_req_milli"][i] += eff["cpu_req"]
+        snap["used_cpu_lim_milli"][i] += eff["cpu_lim"]
+        snap["used_mem_req_bytes"][i] += eff["mem_req"]
+        snap["used_mem_lim_bytes"][i] += eff["mem_lim"]
+        for r in extended_resources:
+            ext[r][1][i] += eff["ext"][r]
+
+    return ClusterSnapshot(
+        names=names,
+        semantics="strict",
+        extended=ext,
+        labels=labels,
+        taints=taints,
+        **snap,
+    )
+
+
+def _effective_pod_resources(
+    pod: dict, extended_resources: tuple[str, ...]
+) -> dict:
+    """Scheduler-rule effective requests: ``max(sum(containers), max(inits))``.
+
+    The reference ignores init containers entirely (Q7); real kube-scheduler
+    reserves the max of the init-container peak and the steady-state sum.
+    """
+
+    def container_vals(c: dict) -> dict:
+        res = c.get("resources", {})
+        req, lim = res.get("requests", {}), res.get("limits", {})
+        return {
+            "cpu_req": _strict_parse(req.get("cpu"), milli=True),
+            "cpu_lim": _strict_parse(lim.get("cpu"), milli=True),
+            "mem_req": _strict_parse(req.get("memory")),
+            "mem_lim": _strict_parse(lim.get("memory")),
+            "ext": {r: _strict_parse(req.get(r)) for r in extended_resources},
+        }
+
+    totals = {
+        "cpu_req": 0,
+        "cpu_lim": 0,
+        "mem_req": 0,
+        "mem_lim": 0,
+        "ext": dict.fromkeys(extended_resources, 0),
+    }
+    for c in pod.get("containers", []):
+        v = container_vals(c)
+        for k in ("cpu_req", "cpu_lim", "mem_req", "mem_lim"):
+            totals[k] += v[k]
+        for r in extended_resources:
+            totals["ext"][r] += v["ext"][r]
+    for c in pod.get("initContainers", []):
+        v = container_vals(c)
+        for k in ("cpu_req", "cpu_lim", "mem_req", "mem_lim"):
+            totals[k] = max(totals[k], v[k])
+        for r in extended_resources:
+            totals["ext"][r] = max(totals["ext"][r], v["ext"][r])
+    return totals
+
+
+def _strict_healthy(conditions: list[dict]) -> bool:
+    """Correct health predicate: Ready is True, no pressure condition is True."""
+    ready = False
+    for c in conditions:
+        ctype, status = c.get("type", ""), c.get("status", "")
+        if ctype == "Ready":
+            ready = status == "True"
+        elif status == "True":  # any pressure/problem condition firing
+            return False
+    return ready
+
+
+def _strict_parse(s: str | None, *, milli: bool = False) -> int:
+    if s is None:
+        return 0
+    try:
+        q = _q.parse_quantity(s)
+    except _q.QuantityParseError:
+        return 0
+    return q.milli_value() if milli else q.value()
+
+
+def _clamp_i64(u: int) -> int:
+    """Reinterpret a Go uint64 as int64 (the kernels' array dtype)."""
+    u %= 1 << 64
+    return u - (1 << 64) if u >= 1 << 63 else u
+
+
+def _empty_arrays(n: int) -> dict:
+    return {
+        "alloc_cpu_milli": np.zeros(n, dtype=np.int64),
+        "alloc_mem_bytes": np.zeros(n, dtype=np.int64),
+        "alloc_pods": np.zeros(n, dtype=np.int64),
+        "used_cpu_req_milli": np.zeros(n, dtype=np.int64),
+        "used_cpu_lim_milli": np.zeros(n, dtype=np.int64),
+        "used_mem_req_bytes": np.zeros(n, dtype=np.int64),
+        "used_mem_lim_bytes": np.zeros(n, dtype=np.int64),
+        "pods_count": np.zeros(n, dtype=np.int64),
+        "healthy": np.zeros(n, dtype=np.bool_),
+    }
+
+
+def synthetic_snapshot(
+    n_nodes: int,
+    *,
+    seed: int = 0,
+    mean_utilization: float = 0.4,
+    alloc_pods: int = 110,
+    kib_quantized: bool = True,
+) -> ClusterSnapshot:
+    """Array-level synthetic cluster — fast path for 1k/10k-node benches.
+
+    Generates realistic allocatable/used distributions directly as arrays
+    (no fixture objects), in O(N).  With ``kib_quantized=True`` all memory
+    values are multiples of 1024 so the int32 KiB-rescaled fast kernel stays
+    eligible; the values match what kubelets report (they publish ``Ki``).
+    """
+    rng = np.random.default_rng(seed)
+    cores = rng.choice(np.array([2, 4, 8, 16, 32, 64]), size=n_nodes)
+    alloc_cpu = cores.astype(np.int64) * 1000
+    mem_kib = cores.astype(np.int64) * 4 * 1024 * 1024 - rng.integers(
+        0, 2**18, size=n_nodes
+    )
+    alloc_mem = mem_kib * 1024
+    if not kib_quantized:
+        alloc_mem += rng.integers(0, 1024, size=n_nodes)
+
+    util_cpu = rng.beta(2, 3, size=n_nodes) * 2 * mean_utilization
+    util_mem = rng.beta(2, 3, size=n_nodes) * 2 * mean_utilization
+    used_cpu = (alloc_cpu * util_cpu).astype(np.int64)
+    used_mem_kib = (mem_kib * util_mem).astype(np.int64)
+    used_mem = used_mem_kib * 1024
+    if not kib_quantized:
+        used_mem += rng.integers(0, 1024, size=n_nodes)
+    pods = rng.integers(0, 60, size=n_nodes).astype(np.int64)
+
+    return ClusterSnapshot(
+        names=[f"node-{i:05d}" for i in range(n_nodes)],
+        alloc_cpu_milli=alloc_cpu,
+        alloc_mem_bytes=alloc_mem,
+        alloc_pods=np.full(n_nodes, alloc_pods, dtype=np.int64),
+        used_cpu_req_milli=used_cpu,
+        used_cpu_lim_milli=used_cpu * 2,
+        used_mem_req_bytes=used_mem,
+        used_mem_lim_bytes=used_mem * 2,
+        pods_count=pods,
+        healthy=np.ones(n_nodes, dtype=np.bool_),
+        semantics="reference",
+    )
+
+
+def snapshot_from_live_cluster(
+    kubeconfig: str | None = None, *, semantics: str = "strict"
+) -> ClusterSnapshot:
+    """Snapshot a live cluster via the Kubernetes Python client.
+
+    Fixes the reference's N+1 query pattern (``1 + 2N + ΣP`` requests,
+    SURVEY.md §3.4): exactly TWO paginated List calls — nodes and pods —
+    then pure local packing.  Requires the optional ``kubernetes`` package;
+    everything else in the framework works offline from fixtures/snapshots.
+    """
+    try:
+        from kubernetes import client, config  # type: ignore[import-not-found]
+    except ImportError as e:  # pragma: no cover - optional dependency
+        raise RuntimeError(
+            "live-cluster ingestion needs the 'kubernetes' package; use "
+            "snapshot_from_fixture()/load_snapshot() for offline operation"
+        ) from e
+
+    config.load_kube_config(config_file=kubeconfig)  # pragma: no cover
+    v1 = client.CoreV1Api()  # pragma: no cover
+
+    fixture: dict = {"nodes": [], "pods": []}  # pragma: no cover
+    for n in v1.list_node(limit=500).items:  # pragma: no cover
+        fixture["nodes"].append(
+            {
+                "name": n.metadata.name,
+                "allocatable": dict(n.status.allocatable or {}),
+                "conditions": [
+                    {"type": c.type, "status": c.status}
+                    for c in (n.status.conditions or [])
+                ],
+                "labels": dict(n.metadata.labels or {}),
+                "taints": [
+                    {"key": t.key, "value": t.value or "", "effect": t.effect}
+                    for t in (n.spec.taints or [])
+                ],
+            }
+        )
+    for p in v1.list_pod_for_all_namespaces(limit=500).items:  # pragma: no cover
+        containers = []
+        for c in p.spec.containers or []:
+            res = c.resources
+            containers.append(
+                {
+                    "resources": {
+                        "requests": dict(res.requests or {}) if res else {},
+                        "limits": dict(res.limits or {}) if res else {},
+                    }
+                }
+            )
+        fixture["pods"].append(
+            {
+                "name": p.metadata.name,
+                "namespace": p.metadata.namespace,
+                "nodeName": p.spec.node_name or "",
+                "phase": p.status.phase,
+                "containers": containers,
+            }
+        )
+    return snapshot_from_fixture(fixture, semantics=semantics)  # pragma: no cover

@@ -1,0 +1,191 @@
+"""JAX fit-kernel parity: bit-exact vs the oracle, fixture- and array-level."""
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import load_fixture, synthetic_fixture
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python, reference_run
+from kubernetesclustercapacity_tpu.ops.fit import (
+    fit_per_node,
+    fit_totals,
+    sweep_grid,
+    sweep_snapshot,
+)
+from kubernetesclustercapacity_tpu.scenario import (
+    Scenario,
+    ScenarioGrid,
+    ScenarioError,
+    random_scenario_grid,
+    scenario_from_flags,
+)
+from kubernetesclustercapacity_tpu.snapshot import (
+    snapshot_from_fixture,
+    synthetic_snapshot,
+)
+
+MIB = 1024 * 1024
+
+
+def _kernel_args(snap):
+    return (
+        snap.alloc_cpu_milli,
+        snap.alloc_mem_bytes,
+        snap.alloc_pods,
+        snap.used_cpu_req_milli,
+        snap.used_mem_req_bytes,
+        snap.pods_count,
+        snap.healthy,
+    )
+
+
+class TestKindParity:
+    def test_sample_run(self):
+        fx = load_fixture("tests/fixtures/kind-3node.json")
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        s = scenario_from_flags(
+            cpuRequests="200m", memRequests="250mb", replicas="10"
+        )
+        fits = np.asarray(
+            fit_per_node(*_kernel_args(snap), s.cpu_request_milli, s.mem_request_bytes)
+        )
+        np.testing.assert_array_equal(fits, [36, 36, 37])
+        total = int(fit_totals(*_kernel_args(snap), 200, 250 * MIB))
+        assert total == 109
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+class TestRandomizedFixtureParity:
+    """The bit-exactness gate: kernel vs oracle on quirk-rich random clusters."""
+
+    def test_parity(self, seed):
+        fx = synthetic_fixture(
+            80,
+            seed=seed,
+            unhealthy_frac=0.15,
+            unparseable_mem_frac=0.1,
+            unscheduled_running_pods=seed,  # exercises phantom matching
+        )
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        scenarios = [
+            scenario_from_flags(),
+            Scenario(200, 250 * MIB, 10),
+            Scenario(1, 1, 1),  # extreme: 1 millicore / 1 byte
+            Scenario(50_000, 1024**4, 5),  # bigger than any node
+            Scenario(137, 7 * MIB + 13, 3),  # non-round divisors
+        ]
+        args = _kernel_args(snap)
+        for s in scenarios:
+            oracle = reference_run(fx, s)
+            fits = np.asarray(
+                fit_per_node(*args, s.cpu_request_milli, s.mem_request_bytes)
+            )
+            np.testing.assert_array_equal(
+                fits, oracle.fits, err_msg=f"seed={seed} scenario={s}"
+            )
+            assert int(fits.sum()) == oracle.total_possible_replicas
+
+
+class TestAdversarialArrayParity:
+    """Raw-array fuzz incl. wrapped/negative bit patterns vs the scalar oracle."""
+
+    @pytest.mark.parametrize("seed", [10, 11, 12])
+    def test_fuzz(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 257
+        # Mix realistic magnitudes with hostile bit patterns (wrapped
+        # negatives from Go uint64/int64 arithmetic).
+        def mixed(lo, hi):
+            vals = rng.integers(lo, hi, size=n, dtype=np.int64)
+            hostile = rng.random(n) < 0.1
+            vals = np.where(
+                hostile,
+                rng.integers(-(2**62), 2**62, size=n, dtype=np.int64),
+                vals,
+            )
+            return vals
+
+        alloc_cpu = mixed(0, 10**6)
+        used_cpu = mixed(0, 10**6)
+        alloc_mem = mixed(0, 2**45)
+        used_mem = mixed(0, 2**45)
+        alloc_pods = rng.integers(0, 200, size=n, dtype=np.int64)
+        pods_count = rng.integers(0, 300, size=n, dtype=np.int64)
+        healthy = np.ones(n, dtype=bool)
+
+        for cpu_req, mem_req in [(100, MIB), (1, 1), (123457, 987654321)]:
+            expected = fit_arrays_python(
+                alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+                pods_count, cpu_req, mem_req,
+            )
+            got = np.asarray(
+                fit_per_node(
+                    alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+                    pods_count, healthy, cpu_req, mem_req,
+                )
+            )
+            np.testing.assert_array_equal(got, expected)
+
+
+class TestSweepGrid:
+    def test_grid_matches_per_scenario(self):
+        snap = synthetic_snapshot(200, seed=3, mean_utilization=0.5)
+        grid = random_scenario_grid(37, seed=4)
+        totals, sched = sweep_snapshot(snap, grid)
+        args = _kernel_args(snap)
+        for i in range(grid.size):
+            one = int(
+                fit_totals(
+                    *args,
+                    int(grid.cpu_request_milli[i]),
+                    int(grid.mem_request_bytes[i]),
+                )
+            )
+            assert totals[i] == one
+            assert sched[i] == (one >= int(grid.replicas[i]))
+
+    def test_per_node_option(self):
+        snap = synthetic_snapshot(50, seed=6)
+        grid = random_scenario_grid(8, seed=7)
+        totals, sched, fits = sweep_snapshot(snap, grid, return_per_node=True)
+        assert fits.shape == (8, 50)
+        np.testing.assert_array_equal(fits.sum(axis=1), totals)
+
+    def test_grid_validation(self):
+        snap = synthetic_snapshot(10, seed=1)
+        bad = ScenarioGrid(
+            cpu_request_milli=np.array([100, 0]),
+            mem_request_bytes=np.array([MIB, MIB]),
+            replicas=np.array([1, 1]),
+        )
+        with pytest.raises(ScenarioError):
+            sweep_snapshot(snap, bad)
+
+
+class TestStrictMode:
+    def test_strict_caps_and_masks(self):
+        fx = synthetic_fixture(40, seed=9, unhealthy_frac=0.3,
+                               unscheduled_running_pods=5)
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        fits = np.asarray(
+            fit_per_node(*_kernel_args(snap), 100, MIB, mode="strict")
+        )
+        assert (fits >= 0).all()
+        slots = np.maximum(snap.alloc_pods - snap.pods_count, 0)
+        assert (fits <= slots).all()
+        assert (fits[~snap.healthy] == 0).all()
+
+    def test_strict_three_way_min(self):
+        # 110 alloc pods, 50 running: strict caps at 60 where reference
+        # returns 100 (SURVEY §2.4 Q1).
+        alloc_cpu = np.array([10_000], dtype=np.int64)
+        alloc_mem = np.array([100 * 1024**3], dtype=np.int64)
+        alloc_pods = np.array([110], dtype=np.int64)
+        used = np.zeros(1, dtype=np.int64)
+        pods = np.array([50], dtype=np.int64)
+        healthy = np.ones(1, dtype=bool)
+        ref = fit_per_node(alloc_cpu, alloc_mem, alloc_pods, used, used, pods,
+                           healthy, 100, MIB, mode="reference")
+        strict = fit_per_node(alloc_cpu, alloc_mem, alloc_pods, used, used,
+                              pods, healthy, 100, MIB, mode="strict")
+        assert int(ref[0]) == 100
+        assert int(strict[0]) == 60

@@ -1,0 +1,193 @@
+"""Snapshot-layer tests: packing parity vs oracle, strict mode, checkpointing."""
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import load_fixture, synthetic_fixture
+from kubernetesclustercapacity_tpu.oracle import reference_run
+from kubernetesclustercapacity_tpu.scenario import scenario_from_flags
+from kubernetesclustercapacity_tpu.snapshot import (
+    ClusterSnapshot,
+    load_snapshot,
+    snapshot_from_fixture,
+    synthetic_snapshot,
+)
+
+MIB = 1024 * 1024
+
+
+@pytest.fixture(scope="module")
+def kind_fixture():
+    return load_fixture("tests/fixtures/kind-3node.json")
+
+
+class TestReferencePacking:
+    def test_kind_arrays(self, kind_fixture):
+        snap = snapshot_from_fixture(kind_fixture, semantics="reference")
+        assert snap.n_nodes == 3
+        assert snap.names == ["kind-control-plane", "kind-worker", "kind-worker2"]
+        np.testing.assert_array_equal(snap.alloc_cpu_milli, [8000, 8000, 8000])
+        np.testing.assert_array_equal(
+            snap.alloc_mem_bytes, [16368832 * 1024] * 3
+        )
+        np.testing.assert_array_equal(snap.alloc_pods, [110, 110, 110])
+        np.testing.assert_array_equal(snap.used_cpu_req_milli, [650, 650, 600])
+        np.testing.assert_array_equal(snap.pods_count, [4, 3, 3])
+        assert snap.healthy.all()
+
+    def test_packing_matches_oracle_intermediates(self):
+        """The packed arrays must equal what the oracle computes per node."""
+        fx = synthetic_fixture(
+            60, seed=11, unhealthy_frac=0.2, unparseable_mem_frac=0.1,
+            unscheduled_running_pods=3,
+        )
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        result = reference_run(fx, scenario_from_flags())
+        assert snap.n_nodes == len(result.per_node)
+        for i, pn in enumerate(result.per_node):
+            assert snap.names[i] == pn.node.name
+            assert snap.alloc_cpu_milli[i] == pn.node.allocatable_cpu
+            assert snap.alloc_mem_bytes[i] == pn.node.allocatable_memory
+            assert snap.alloc_pods[i] == pn.node.allocatable_pods
+            assert snap.used_cpu_req_milli[i] == pn.cpu_requests_milli
+            assert snap.used_cpu_lim_milli[i] == pn.cpu_limits_milli
+            assert snap.used_mem_req_bytes[i] == pn.mem_requests_bytes
+            assert snap.used_mem_lim_bytes[i] == pn.mem_limits_bytes
+            assert snap.pods_count[i] == pn.pods_count
+
+    def test_phantom_nodes_zeroed_with_orphan_usage(self):
+        fx = synthetic_fixture(
+            5, seed=2, unhealthy_frac=1.0, unscheduled_running_pods=2
+        )
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        assert not snap.healthy.any()
+        assert (snap.alloc_cpu_milli == 0).all()
+        # Phantom rows carry the orphan pods (empty nodeName match, Q4).
+        assert (snap.pods_count == 2).all()
+
+
+class TestStrictPacking:
+    def test_gi_memory_parses(self):
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "4", "memory": "16Gi", "pods": "110"},
+            "conditions": [
+                {"type": "MemoryPressure", "status": "False"},
+                {"type": "Ready", "status": "True"}]}],
+            "pods": []}
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        assert snap.alloc_mem_bytes[0] == 16 * 1024**3
+        assert snap.healthy[0]
+
+    def test_modern_four_condition_node_is_healthy(self):
+        # The reference marks EVERY healthy modern node unhealthy (SURVEY
+        # §2.2 C3); strict mode gets it right.
+        fx = {"nodes": [{"name": "n", "allocatable": {"cpu": "4"},
+            "conditions": [
+                {"type": "MemoryPressure", "status": "False"},
+                {"type": "DiskPressure", "status": "False"},
+                {"type": "PIDPressure", "status": "False"},
+                {"type": "Ready", "status": "True"}]}],
+            "pods": []}
+        assert snapshot_from_fixture(fx, semantics="strict").healthy[0]
+        ref = snapshot_from_fixture(fx, semantics="reference")
+        assert not ref.healthy[0]  # Conditions[3] == Ready=True -> "unhealthy"
+
+    def test_init_containers_scheduler_rule(self):
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "8", "memory": "32Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}]}],
+            "pods": [{
+                "name": "p", "namespace": "d", "nodeName": "n",
+                "phase": "Running",
+                "containers": [
+                    {"resources": {"requests": {"cpu": "200m", "memory": "256Mi"}}},
+                    {"resources": {"requests": {"cpu": "300m", "memory": "256Mi"}}},
+                ],
+                "initContainers": [
+                    {"resources": {"requests": {"cpu": "2", "memory": "128Mi"}}},
+                ]}]}
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        # cpu: max(200+300, 2000) = 2000; mem: max(512Mi, 128Mi) = 512Mi.
+        assert snap.used_cpu_req_milli[0] == 2000
+        assert snap.used_mem_req_bytes[0] == 512 * MIB
+
+    def test_pending_assigned_pods_count_in_strict(self):
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "8", "memory": "32Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}]}],
+            "pods": [
+                {"name": "p1", "namespace": "d", "nodeName": "n",
+                 "phase": "Pending", "containers": [
+                     {"resources": {"requests": {"cpu": "1"}}}]},
+                {"name": "p2", "namespace": "d", "nodeName": "n",
+                 "phase": "Succeeded", "containers": [
+                     {"resources": {"requests": {"cpu": "1"}}}]},
+            ]}
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        assert snap.pods_count[0] == 1  # Pending counts, Succeeded doesn't
+        assert snap.used_cpu_req_milli[0] == 1000
+
+    def test_extended_resources(self):
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "8", "memory": "32Gi", "pods": "110",
+            "ephemeral-storage": "100Gi", "nvidia.com/gpu": "8"},
+            "conditions": [{"type": "Ready", "status": "True"}]}],
+            "pods": [{"name": "p", "namespace": "d", "nodeName": "n",
+                      "phase": "Running", "containers": [{"resources": {
+                          "requests": {"cpu": "1", "nvidia.com/gpu": "2",
+                                       "ephemeral-storage": "10Gi"}}}]}]}
+        snap = snapshot_from_fixture(
+            fx, semantics="strict",
+            extended_resources=("ephemeral-storage", "nvidia.com/gpu"))
+        alloc, used = snap.extended["nvidia.com/gpu"]
+        assert alloc[0] == 8 and used[0] == 2
+        alloc_es, used_es = snap.extended["ephemeral-storage"]
+        assert alloc_es[0] == 100 * 1024**3 and used_es[0] == 10 * 1024**3
+        # resource_matrix stacks rows in request order
+        a, u = snap.resource_matrix(("cpu", "memory", "nvidia.com/gpu"))
+        assert a.shape == (3, 1) and a[2, 0] == 8
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path, kind_fixture):
+        snap = snapshot_from_fixture(kind_fixture, semantics="reference")
+        p = str(tmp_path / "snap.npz")
+        snap.save(p)
+        loaded = load_snapshot(p)
+        assert loaded.names == snap.names
+        np.testing.assert_array_equal(loaded.alloc_mem_bytes, snap.alloc_mem_bytes)
+        np.testing.assert_array_equal(loaded.healthy, snap.healthy)
+        assert loaded.semantics == "reference"
+        assert loaded.labels[0]["kubernetes.io/hostname"] == "kind-control-plane"
+
+    def test_roundtrip_with_extended(self, tmp_path):
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "8", "memory": "32Gi", "pods": "110", "nvidia.com/gpu": "4"},
+            "conditions": [{"type": "Ready", "status": "True"}]}], "pods": []}
+        snap = snapshot_from_fixture(
+            fx, semantics="strict", extended_resources=("nvidia.com/gpu",))
+        p = str(tmp_path / "s.npz")
+        snap.save(p)
+        loaded = load_snapshot(p)
+        assert loaded.extended["nvidia.com/gpu"][0][0] == 4
+
+
+class TestSynthetic:
+    def test_deterministic(self):
+        a = synthetic_snapshot(100, seed=5)
+        b = synthetic_snapshot(100, seed=5)
+        np.testing.assert_array_equal(a.alloc_mem_bytes, b.alloc_mem_bytes)
+
+    def test_kib_quantized(self):
+        s = synthetic_snapshot(100, seed=5)
+        assert (s.alloc_mem_bytes % 1024 == 0).all()
+        assert (s.used_mem_req_bytes % 1024 == 0).all()
+        s2 = synthetic_snapshot(100, seed=5, kib_quantized=False)
+        assert (s2.alloc_mem_bytes % 1024 != 0).any()
+
+    def test_shapes_and_sanity(self):
+        s = synthetic_snapshot(1000, seed=0)
+        assert s.n_nodes == 1000
+        assert (s.used_cpu_req_milli <= s.alloc_cpu_milli).all()
+        assert (s.used_mem_req_bytes <= s.alloc_mem_bytes).all()
+        assert isinstance(s, ClusterSnapshot)
