@@ -1,0 +1,109 @@
+"""Benchmark: the BASELINE.json north-star sweep on real hardware.
+
+Workload (BASELINE config 3): a 10k-node cluster snapshot × 1k random
+``(cpuRequests, memRequests, replicas)`` scenarios, evaluated by the jitted
+reference-semantics fit kernel on the local accelerator.
+
+The reference publishes no numbers (BASELINE.md): its cost model is
+``1 + 2N + ΣP`` sequential apiserver round-trips for ONE scenario — at 10k
+nodes that is tens of thousands of HTTPS requests (minutes, network-bound).
+The BASELINE target for this framework is the whole 10k × 1k sweep in < 1 s
+on TPU, so ``vs_baseline`` reports how many times faster than that 1-second
+target budget the measured p50 sweep latency is (> 1.0 = beating the target).
+
+Prints exactly one JSON line:
+``{"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ...}``
+plus auxiliary fields (scenarios/sec, device, correctness gate).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> None:
+    import jax
+
+    import kubernetesclustercapacity_tpu as kcc
+    from kubernetesclustercapacity_tpu.fixtures import load_fixture
+    from kubernetesclustercapacity_tpu.ops.fit import snapshot_device_arrays, sweep_grid
+    from kubernetesclustercapacity_tpu.oracle import reference_run
+
+    # --- correctness gate: never bench a wrong kernel.  kind fixture +
+    # sample scenario must match the oracle exactly.
+    fixture = load_fixture(
+        os.path.join(_REPO_ROOT, "tests", "fixtures", "kind-3node.json")
+    )
+    snap_small = kcc.snapshot_from_fixture(fixture, semantics="reference")
+    scenario = kcc.scenario_from_flags(
+        cpuRequests="200m", memRequests="250mb", replicas="10"
+    )
+    oracle = reference_run(fixture, scenario)
+    grid_small = kcc.ScenarioGrid.from_scenarios([scenario])
+    totals_small, _ = kcc.sweep_snapshot(snap_small, grid_small)
+    gate_ok = int(totals_small[0]) == oracle.total_possible_replicas
+    if not gate_ok:
+        print(
+            json.dumps(
+                {
+                    "metric": "sweep_10k_nodes_x_1k_scenarios_p50",
+                    "value": None,
+                    "unit": "ms",
+                    "vs_baseline": 0.0,
+                    "error": "correctness gate failed",
+                }
+            )
+        )
+        return
+
+    # --- the north-star workload.
+    n_nodes, n_scenarios = 10_000, 1_000
+    snap = kcc.synthetic_snapshot(n_nodes, seed=1)
+    grid = kcc.random_scenario_grid(n_scenarios, seed=2)
+    arrays = snapshot_device_arrays(snap)  # device-resident once, like a real sweep service
+    cpu_d = jax.device_put(grid.cpu_request_milli)
+    mem_d = jax.device_put(grid.mem_request_bytes)
+    rep_d = jax.device_put(grid.replicas)
+
+    def run():
+        totals, sched = sweep_grid(*arrays, cpu_d, mem_d, rep_d, mode="reference")
+        jax.block_until_ready(totals)
+        return totals, sched
+
+    run()  # compile
+    lat_ms = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        run()
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+    p50 = float(np.percentile(lat_ms, 50))
+    scenarios_per_sec = n_scenarios / (p50 / 1e3)
+
+    print(
+        json.dumps(
+            {
+                "metric": "sweep_10k_nodes_x_1k_scenarios_p50",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(1000.0 / p50, 2),
+                "scenarios_per_sec": round(scenarios_per_sec),
+                "node_scenario_cells_per_sec": round(
+                    n_nodes * scenarios_per_sec
+                ),
+                "p10_ms": round(float(np.percentile(lat_ms, 10)), 3),
+                "p90_ms": round(float(np.percentile(lat_ms, 90)), 3),
+                "device": str(jax.devices()[0]),
+                "correctness_gate": "oracle-exact",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

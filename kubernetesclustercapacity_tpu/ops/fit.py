@@ -50,9 +50,17 @@ MODES = ("reference", "strict")
 
 
 def _trunc_div(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
-    """Go int64 division: truncate toward zero (``//`` floors for negatives)."""
-    q = jnp.abs(num) // jnp.abs(den)
-    return jnp.where((num < 0) != (den < 0), -q, q)
+    """Go int64 division: truncate toward zero (``//`` floors for negatives).
+
+    Implemented as floor-div plus a remainder correction rather than via
+    ``abs`` — ``abs(INT64_MIN)`` would wrap back to INT64_MIN and flip the
+    result sign, which matters because wrapped memory headrooms can land
+    exactly on INT64_MIN.
+    """
+    q = num // den
+    r = num - q * den
+    fixup = ((r != 0) & ((num < 0) != (den < 0))).astype(q.dtype)
+    return q + fixup
 
 
 @partial(jax.jit, static_argnames=("mode",))

@@ -1,0 +1,20 @@
+"""Distributed layer: device meshes, shardings, and collective reductions.
+
+The reference has no parallelism at all — one goroutine, one sequential node
+loop (SURVEY.md §2.3).  This package is its TPU-native counterpart: the sweep
+is laid out over a 2-D ``jax.sharding.Mesh`` with a **scenario** axis (the
+embarrassingly-parallel what-if grid — the data-parallel analog) and a
+**node** axis (cluster nodes sharded across devices with a ``psum`` reduction
+of per-shard replica counts — the sequence-parallel analog).  Collectives are
+XLA-inserted and ride ICI within a slice; multi-host deployments extend the
+same mesh over DCN via ``jax.distributed.initialize``.
+"""
+
+from kubernetesclustercapacity_tpu.parallel.mesh import (  # noqa: F401
+    MeshPlan,
+    make_mesh,
+)
+from kubernetesclustercapacity_tpu.parallel.sweep import (  # noqa: F401
+    sweep_gspmd,
+    sweep_shard_map,
+)

@@ -1,0 +1,78 @@
+"""Mesh construction: how devices are arranged for capacity sweeps.
+
+Axis semantics:
+
+* ``"scenario"`` — shards the what-if grid.  No cross-device traffic at all
+  (each device owns complete results for its scenarios); scales over DCN as
+  well as ICI, so multi-host sweeps partition here first.
+* ``"node"``     — shards the cluster's node axis.  Each device computes
+  partial per-scenario replica sums over its node shard; one int64 ``psum``
+  per sweep reduces them over ICI.  Use when a single cluster snapshot is too
+  big for one device's HBM (≥ millions of nodes) or to cut per-device work
+  for latency.
+
+For the 10k-node × 1k-scenario north-star on a v4-8, scenario-only sharding
+is optimal (zero collectives); the node axis exists for the scale beyond.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["MeshPlan", "make_mesh"]
+
+SCENARIO_AXIS = "scenario"
+NODE_AXIS = "node"
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A mesh plus the padding arithmetic sweeps need to fit on it."""
+
+    mesh: Mesh
+
+    @property
+    def scenario_shards(self) -> int:
+        return self.mesh.shape[SCENARIO_AXIS]
+
+    @property
+    def node_shards(self) -> int:
+        return self.mesh.shape[NODE_AXIS]
+
+    def pad_scenarios(self, s: int) -> int:
+        """Padded scenario count (next multiple of the scenario axis)."""
+        k = self.scenario_shards
+        return -(-s // k) * k
+
+    def pad_nodes(self, n: int) -> int:
+        k = self.node_shards
+        return -(-n // k) * k
+
+
+def make_mesh(
+    scenario_parallel: int | None = None,
+    node_parallel: int = 1,
+    *,
+    devices: list | None = None,
+) -> MeshPlan:
+    """Build a ``(scenario, node)`` mesh over the available devices.
+
+    Defaults to all devices on the scenario axis (the collective-free
+    layout).  ``scenario_parallel × node_parallel`` must cover the device
+    count exactly; pass explicit values to trade grid-parallelism for
+    node-shard parallelism.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n_dev = len(devices)
+    if scenario_parallel is None:
+        scenario_parallel = n_dev // node_parallel
+    if scenario_parallel * node_parallel != n_dev:
+        raise ValueError(
+            f"mesh {scenario_parallel}x{node_parallel} != {n_dev} devices"
+        )
+    grid = np.array(devices).reshape(scenario_parallel, node_parallel)
+    return MeshPlan(mesh=Mesh(grid, (SCENARIO_AXIS, NODE_AXIS)))

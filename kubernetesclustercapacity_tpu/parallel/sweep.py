@@ -1,0 +1,154 @@
+"""Sharded capacity sweeps: the fit kernel laid out over a device mesh.
+
+Two equivalent paths, both bit-exact against the single-device kernel:
+
+* :func:`sweep_gspmd` — the idiomatic JAX path: inputs are ``device_put`` with
+  ``NamedSharding``s (nodes → ``"node"`` axis, scenarios → ``"scenario"``
+  axis) and the already-jitted kernel runs under GSPMD, letting XLA insert
+  the cross-device reduction for the node-sharded sum.
+* :func:`sweep_shard_map` — explicit SPMD: per-device shards compute local
+  partial replica sums and an explicit ``lax.psum`` over the ``"node"`` axis
+  reduces them over ICI.  This is the path whose collective schedule we
+  control (and the one the multi-chip dry-run exercises).
+
+Padding: node arrays pad with zero rows — a zero row yields fit 0 in both
+modes (alloc ≤ used guards to 0, then the Q1 cap rewrites ``0 ≥ 0`` to
+``0 − 0``) — and scenario arrays pad with a harmless ``(1 milli, 1 byte)``
+probe whose outputs are sliced off.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map_mod  # type: ignore[attr-defined]
+
+    shard_map = jax.shard_map
+except (ImportError, AttributeError):  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from kubernetesclustercapacity_tpu.ops.fit import fit_per_node, sweep_grid
+from kubernetesclustercapacity_tpu.parallel.mesh import (
+    MeshPlan,
+    NODE_AXIS,
+    SCENARIO_AXIS,
+)
+
+__all__ = ["sweep_gspmd", "sweep_shard_map"]
+
+
+def _pad_node_arrays(arrays: tuple, n_padded: int) -> tuple:
+    """Zero-pad the 7 snapshot arrays along the node axis."""
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        pad = n_padded - a.shape[0]
+        out.append(np.pad(a, (0, pad)) if pad else a)
+    return tuple(out)
+
+
+def _pad_scenarios(cpu_reqs, mem_reqs, replicas, s_padded: int):
+    cpu_reqs = np.asarray(cpu_reqs, dtype=np.int64)
+    mem_reqs = np.asarray(mem_reqs, dtype=np.int64)
+    replicas = np.asarray(replicas, dtype=np.int64)
+    pad = s_padded - cpu_reqs.shape[0]
+    if pad:
+        cpu_reqs = np.pad(cpu_reqs, (0, pad), constant_values=1)
+        mem_reqs = np.pad(mem_reqs, (0, pad), constant_values=1)
+        replicas = np.pad(replicas, (0, pad), constant_values=0)
+    return cpu_reqs, mem_reqs, replicas
+
+
+def sweep_gspmd(
+    plan: MeshPlan,
+    snapshot_arrays: tuple,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+):
+    """GSPMD sweep: sharding annotations in, XLA chooses the collectives."""
+    s = np.asarray(cpu_reqs).shape[0]
+    n = np.asarray(snapshot_arrays[0]).shape[0]
+    node_arrays = _pad_node_arrays(snapshot_arrays, plan.pad_nodes(n))
+    cpu_p, mem_p, rep_p = _pad_scenarios(
+        cpu_reqs, mem_reqs, replicas, plan.pad_scenarios(s)
+    )
+
+    mesh = plan.mesh
+    node_sharding = NamedSharding(mesh, P(NODE_AXIS))
+    scen_sharding = NamedSharding(mesh, P(SCENARIO_AXIS))
+    node_dev = tuple(jax.device_put(a, node_sharding) for a in node_arrays)
+    cpu_d = jax.device_put(cpu_p, scen_sharding)
+    mem_d = jax.device_put(mem_p, scen_sharding)
+    rep_d = jax.device_put(rep_p, scen_sharding)
+
+    totals, sched = sweep_grid(*node_dev, cpu_d, mem_d, rep_d, mode=mode)
+    return np.asarray(totals)[:s], np.asarray(sched)[:s]
+
+
+def sweep_shard_map(
+    plan: MeshPlan,
+    snapshot_arrays: tuple,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+):
+    """Explicit-SPMD sweep: local partial sums + ``psum`` over the node axis.
+
+    Each device holds a ``[N/node_shards]`` slice of every snapshot array and
+    a ``[S/scenario_shards]`` slice of the grid; it computes
+    ``fits[s_local, n_local]``, reduces locally over its node slice, and one
+    ``psum`` over ``"node"`` (ICI) produces replicated per-scenario totals.
+    """
+    s = np.asarray(cpu_reqs).shape[0]
+    n = np.asarray(snapshot_arrays[0]).shape[0]
+    node_arrays = _pad_node_arrays(snapshot_arrays, plan.pad_nodes(n))
+    cpu_p, mem_p, rep_p = _pad_scenarios(
+        cpu_reqs, mem_reqs, replicas, plan.pad_scenarios(s)
+    )
+
+    totals, sched = _compiled_shard_fn(plan.mesh, mode)(
+        *[jnp.asarray(a) for a in node_arrays],
+        jnp.asarray(cpu_p),
+        jnp.asarray(mem_p),
+        jnp.asarray(rep_p),
+    )
+    return np.asarray(totals)[:s], np.asarray(sched)[:s]
+
+
+@lru_cache(maxsize=None)
+def _compiled_shard_fn(mesh, mode: str):
+    """Jitted shard_map sweep, cached per (mesh, mode).
+
+    ``Mesh`` is hashable, so repeated sweeps on the same mesh hit the jit
+    cache instead of re-tracing a fresh closure each call (the intended
+    service pattern: one mesh, many sweeps).
+    """
+    node_spec = P(NODE_AXIS)
+    scen_spec = P(SCENARIO_AXIS)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(node_spec,) * 7 + (scen_spec,) * 3,
+        out_specs=(scen_spec, scen_spec),
+    )
+    def _shard_fn(ac, am, ap, uc, um, pc, h, cr, mr, rep):
+        local_fits = jax.vmap(
+            lambda c, m: fit_per_node(ac, am, ap, uc, um, pc, h, c, m, mode=mode)
+        )(cr, mr)
+        partial_totals = jnp.sum(local_fits, axis=1)  # [s_local]
+        totals = jax.lax.psum(partial_totals, NODE_AXIS)
+        return totals, totals >= rep
+
+    return jax.jit(_shard_fn)

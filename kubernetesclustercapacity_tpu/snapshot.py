@@ -433,8 +433,31 @@ def snapshot_from_live_cluster(
     config.load_kube_config(config_file=kubeconfig)  # pragma: no cover
     v1 = client.CoreV1Api()  # pragma: no cover
 
+    def paginate(list_fn):  # pragma: no cover
+        token = None
+        while True:
+            page = list_fn(limit=500, _continue=token)
+            yield from page.items
+            token = page.metadata._continue
+            if not token:
+                return
+
+    def serialize_containers(containers):  # pragma: no cover
+        out = []
+        for c in containers or []:
+            res = c.resources
+            out.append(
+                {
+                    "resources": {
+                        "requests": dict(res.requests or {}) if res else {},
+                        "limits": dict(res.limits or {}) if res else {},
+                    }
+                }
+            )
+        return out
+
     fixture: dict = {"nodes": [], "pods": []}  # pragma: no cover
-    for n in v1.list_node(limit=500).items:  # pragma: no cover
+    for n in paginate(v1.list_node):  # pragma: no cover
         fixture["nodes"].append(
             {
                 "name": n.metadata.name,
@@ -450,25 +473,15 @@ def snapshot_from_live_cluster(
                 ],
             }
         )
-    for p in v1.list_pod_for_all_namespaces(limit=500).items:  # pragma: no cover
-        containers = []
-        for c in p.spec.containers or []:
-            res = c.resources
-            containers.append(
-                {
-                    "resources": {
-                        "requests": dict(res.requests or {}) if res else {},
-                        "limits": dict(res.limits or {}) if res else {},
-                    }
-                }
-            )
+    for p in paginate(v1.list_pod_for_all_namespaces):  # pragma: no cover
         fixture["pods"].append(
             {
                 "name": p.metadata.name,
                 "namespace": p.metadata.namespace,
                 "nodeName": p.spec.node_name or "",
                 "phase": p.status.phase,
-                "containers": containers,
+                "containers": serialize_containers(p.spec.containers),
+                "initContainers": serialize_containers(p.spec.init_containers),
             }
         )
     return snapshot_from_fixture(fixture, semantics=semantics)  # pragma: no cover

@@ -8,9 +8,17 @@ anywhere in the test process, which is why they live at conftest import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the environment may pin JAX_PLATFORMS to the real TPU
+# platform (and a sitecustomize may re-pin jax.config at interpreter
+# startup); tests must run on the virtual CPU mesh regardless.  Both the env
+# var and the config knob are set, before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -126,6 +126,29 @@ class TestAdversarialArrayParity:
             np.testing.assert_array_equal(got, expected)
 
 
+class TestInt64Edges:
+    def test_int64_min_headroom(self):
+        # alloc=0, used=INT64_MIN: headroom wraps to INT64_MIN exactly;
+        # abs()-based trunc division would flip the sign.
+        alloc_cpu = np.array([10_000], dtype=np.int64)
+        used_cpu = np.array([0], dtype=np.int64)
+        alloc_mem = np.array([0], dtype=np.int64)
+        used_mem = np.array([-(2**63)], dtype=np.int64)
+        alloc_pods = np.array([10**12], dtype=np.int64)
+        pods = np.array([0], dtype=np.int64)
+        healthy = np.ones(1, dtype=bool)
+        for mem_req in (3, 7, 1024):
+            expected = fit_arrays_python(
+                alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods,
+                100, mem_req,
+            )
+            got = np.asarray(
+                fit_per_node(alloc_cpu, alloc_mem, alloc_pods, used_cpu,
+                             used_mem, pods, healthy, 100, mem_req)
+            )
+            np.testing.assert_array_equal(got, expected)
+
+
 class TestSweepGrid:
     def test_grid_matches_per_scenario(self):
         snap = synthetic_snapshot(200, seed=3, mean_utilization=0.5)

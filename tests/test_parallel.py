@@ -1,0 +1,77 @@
+"""Sharded-sweep tests on the 8-device virtual CPU mesh (conftest sets it up)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.ops.fit import snapshot_device_arrays, sweep_snapshot
+from kubernetesclustercapacity_tpu.parallel import make_mesh, sweep_gspmd, sweep_shard_map
+from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+
+@pytest.fixture(scope="module")
+def snap():
+    return synthetic_snapshot(503, seed=21)  # prime: forces node padding
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return random_scenario_grid(97, seed=22)  # prime: forces scenario padding
+
+
+@pytest.fixture(scope="module")
+def baseline(snap, grid):
+    return sweep_snapshot(snap, grid)
+
+
+def _arrays(snap):
+    return tuple(np.asarray(a) for a in snapshot_device_arrays(snap))
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("sp,np_", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_shard_map_matches_unsharded(snap, grid, baseline, sp, np_):
+    plan = make_mesh(sp, np_)
+    totals, sched = sweep_shard_map(
+        plan, _arrays(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+        grid.replicas,
+    )
+    np.testing.assert_array_equal(totals, baseline[0])
+    np.testing.assert_array_equal(sched, baseline[1])
+
+
+@pytest.mark.parametrize("sp,np_", [(8, 1), (2, 4)])
+def test_gspmd_matches_unsharded(snap, grid, baseline, sp, np_):
+    plan = make_mesh(sp, np_)
+    totals, sched = sweep_gspmd(
+        plan, _arrays(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+        grid.replicas,
+    )
+    np.testing.assert_array_equal(totals, baseline[0])
+    np.testing.assert_array_equal(sched, baseline[1])
+
+
+def test_strict_mode_sharded(snap, grid):
+    plan = make_mesh(4, 2)
+    ref_totals, _ = sweep_snapshot(snap, grid, mode="strict")
+    totals, _ = sweep_shard_map(
+        plan, _arrays(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+        grid.replicas, mode="strict",
+    )
+    np.testing.assert_array_equal(totals, ref_totals)
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError):
+        make_mesh(3, 2)  # 6 != 8 devices
+
+
+def test_mesh_padding_math():
+    plan = make_mesh(4, 2)
+    assert plan.pad_scenarios(97) == 100
+    assert plan.pad_nodes(503) == 504
+    assert plan.pad_nodes(504) == 504
