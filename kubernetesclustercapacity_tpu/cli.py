@@ -1,0 +1,240 @@
+"""CLI (L4): the reference's six flags, plus the TPU-era surface.
+
+Flag parity with the reference (``ClusterCapacity.go:50-62``): same names,
+same single-dash style (``-cpuRequests=200m``), same defaults, same fatal
+error text for invalid memory/replicas values.  Added flags gate the new
+capabilities:
+
+* ``-backend {tpu,cpu}`` — the jitted JAX kernel (default) or the sequential
+  CPU walk (the reference's algorithm, for cross-checking);
+* ``-snapshot PATH`` — offline operation from a fixture (``.json``) or a
+  checkpointed snapshot (``.npz``) instead of a live apiserver;
+* ``-semantics {reference,strict}`` — bug-compatible vs corrected semantics
+  (SURVEY.md §2.4);
+* ``-output {reference,json,table}`` — the reference's transcript
+  (byte-parity), structured JSON, or a compact table;
+* ``-grid N`` / ``-seed`` — evaluate a random N-scenario what-if sweep
+  instead of a single spec.
+
+Examples::
+
+    kccap -snapshot tests/fixtures/kind-3node.json \
+          -cpuRequests=200m -memRequests=250mb -replicas=10
+    kccap -snapshot cluster.npz -grid 1000 -output json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+__all__ = ["main", "build_parser"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kccap",
+        description="TPU-native Kubernetes cluster-capacity simulator",
+    )
+    home = os.environ.get("HOME", "") or os.environ.get("USERPROFILE", "")
+    default_kubeconfig = os.path.join(home, ".kube", "config") if home else ""
+    # The reference's six flags (same defaults, ClusterCapacity.go:50-62).
+    p.add_argument("-kubeconfig", default=default_kubeconfig,
+                   help="(optional) absolute path to the kubeconfig file")
+    p.add_argument("-cpuRequests", default="100m",
+                   help="CPU Requests either in cores(1) or milicores(250m)")
+    p.add_argument("-cpuLimits", default="200m",
+                   help="CPU Limits either in cores(2) or milicores(500m)")
+    p.add_argument("-memRequests", default="100mb",
+                   help="Memory requests either in GB(1) or megabytes(250mb)")
+    p.add_argument("-memLimits", default="200mb",
+                   help="Memory limits either in GB(2) or megabytes(500mb)")
+    p.add_argument("-replicas", default="1", help="No of pod replicas")
+    # New surface.
+    p.add_argument("-backend", choices=("tpu", "cpu"), default="tpu",
+                   help="vectorized JAX kernel (tpu) or sequential walk (cpu)")
+    p.add_argument("-snapshot", default="",
+                   help="offline source: fixture .json or checkpoint .npz")
+    p.add_argument("-semantics", choices=("reference", "strict"),
+                   default="reference",
+                   help="bug-compatible reference semantics or corrected mode")
+    p.add_argument("-output", choices=("reference", "json", "table"),
+                   default="reference", help="report format")
+    p.add_argument("-grid", type=int, default=0, metavar="N",
+                   help="evaluate a random N-scenario sweep instead of one spec")
+    p.add_argument("-seed", type=int, default=0, help="sweep RNG seed")
+    p.add_argument("-save-snapshot", default="", metavar="PATH",
+                   help="checkpoint the packed snapshot to PATH (.npz)")
+    return p
+
+
+def _split_single_dash_eq(argv: list[str]) -> list[str]:
+    """Support Go-style ``-flag=value`` (argparse only splits ``--flag=``)."""
+    out = []
+    for a in argv:
+        if a.startswith("-") and not a.startswith("--") and "=" in a:
+            flag, _, val = a.partition("=")
+            out += [flag, val]
+        else:
+            out.append(a)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    from kubernetesclustercapacity_tpu.scenario import (
+        ScenarioError,
+        scenario_from_flags,
+    )
+
+    args = build_parser().parse_args(
+        _split_single_dash_eq(sys.argv[1:] if argv is None else list(argv))
+    )
+
+    try:
+        scenario = scenario_from_flags(
+            cpuRequests=args.cpuRequests,
+            cpuLimits=args.cpuLimits,
+            memRequests=args.memRequests,
+            memLimits=args.memLimits,
+            replicas=args.replicas,
+        )
+    except ScenarioError as e:
+        # The reference prints an ERROR line and exits 1 (:68-83).
+        print(f"ERROR : {e} ...exiting")
+        return 1
+
+    if args.grid <= 0:
+        try:
+            scenario.validate()
+        except ScenarioError as e:
+            print(f"ERROR : {e} ...exiting")
+            return 1
+
+    fixture, snapshot = _load_source(args)
+    if snapshot is None:
+        return 1
+
+    if args.save_snapshot:
+        snapshot.save(args.save_snapshot)
+        print(f"snapshot checkpointed to {args.save_snapshot}", file=sys.stderr)
+
+    if args.grid > 0:
+        return _run_grid(args, snapshot)
+    return _run_single(args, fixture, snapshot, scenario)
+
+
+def _load_source(args):
+    """Resolve the cluster source: fixture JSON, npz checkpoint, or live."""
+    from kubernetesclustercapacity_tpu.fixtures import load_fixture
+    from kubernetesclustercapacity_tpu.snapshot import (
+        load_snapshot,
+        snapshot_from_fixture,
+        snapshot_from_live_cluster,
+    )
+
+    if args.snapshot:
+        if not os.path.exists(args.snapshot):
+            print(f"ERROR : snapshot file not found: {args.snapshot}")
+            return None, None
+        if args.snapshot.endswith(".npz"):
+            return None, load_snapshot(args.snapshot)
+        fixture = load_fixture(args.snapshot)
+        return fixture, snapshot_from_fixture(fixture, semantics=args.semantics)
+    try:
+        return None, snapshot_from_live_cluster(
+            args.kubeconfig or None, semantics=args.semantics
+        )
+    except Exception as e:  # mirrors the reference's panic on bad kubeconfig
+        print(f"ERROR : cannot snapshot live cluster: {e}")
+        print("hint: use -snapshot <fixture.json|checkpoint.npz> for offline runs")
+        return None, None
+
+
+def _run_single(args, fixture, snapshot, scenario) -> int:
+    from kubernetesclustercapacity_tpu.oracle import (
+        ReferencePanic,
+        fit_arrays_python,
+        reference_run,
+    )
+    from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+    from kubernetesclustercapacity_tpu.report import (
+        json_report,
+        reference_report,
+        table_report,
+    )
+
+    if args.backend == "cpu":
+        try:
+            if fixture is not None and args.semantics == "reference":
+                fits = np.array(
+                    reference_run(fixture, scenario).fits, dtype=np.int64
+                )
+            else:
+                fits = np.array(
+                    fit_arrays_python(
+                        snapshot.alloc_cpu_milli,
+                        snapshot.alloc_mem_bytes,
+                        snapshot.alloc_pods,
+                        snapshot.used_cpu_req_milli,
+                        snapshot.used_mem_req_bytes,
+                        snapshot.pods_count,
+                        scenario.cpu_request_milli,
+                        scenario.mem_request_bytes,
+                        mode=args.semantics,
+                        healthy=snapshot.healthy,
+                    ),
+                    dtype=np.int64,
+                )
+        except ReferencePanic as e:
+            print(f"panic: {e}")
+            return 2
+    else:
+        fits = np.asarray(
+            fit_per_node(
+                snapshot.alloc_cpu_milli,
+                snapshot.alloc_mem_bytes,
+                snapshot.alloc_pods,
+                snapshot.used_cpu_req_milli,
+                snapshot.used_mem_req_bytes,
+                snapshot.pods_count,
+                snapshot.healthy,
+                scenario.cpu_request_milli,
+                scenario.mem_request_bytes,
+                mode=args.semantics,
+            )
+        )
+
+    if args.output == "json":
+        print(json_report(snapshot, fits, scenario))
+    elif args.output == "table":
+        print(table_report(snapshot, fits, scenario))
+    else:
+        print(reference_report(snapshot, fits, scenario), end="")
+    return 0
+
+
+def _run_grid(args, snapshot) -> int:
+    from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+    from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
+
+    grid = random_scenario_grid(args.grid, seed=args.seed)
+    totals, sched = sweep_snapshot(snapshot, grid, mode=args.semantics)
+    summary = {
+        "scenarios": args.grid,
+        "seed": args.seed,
+        "semantics": args.semantics,
+        "totals": totals.tolist(),
+        "schedulable": sched.tolist(),
+        "totals_p50": float(np.percentile(totals, 50)),
+        "schedulable_fraction": float(np.mean(sched)),
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+"""Scheduling-constraint masks (BASELINE config 5).
+
+The reference ignores taints, selectors, and affinity entirely — a pod "fits"
+anywhere resources allow.  Real scheduling gates placement on them, and the
+TPU-native encoding is simple: every constraint family reduces to a boolean
+node mask ``[N]`` (or per-scenario ``[S, N]``) built host-side from snapshot
+metadata, ANDed together, and applied inside the fit kernel — a free
+elementwise op on device.
+
+Implemented families (the hard predicates kube-scheduler enforces):
+
+* taints × tolerations (``NoSchedule``/``NoExecute``; ``PreferNoSchedule`` is
+  a soft preference and is ignored, as the scheduler's filter phase does);
+* ``nodeSelector`` (exact label subset match);
+* node affinity ``requiredDuringSchedulingIgnoredDuringExecution`` match
+  expressions (``In``/``NotIn``/``Exists``/``DoesNotExist``/``Gt``/``Lt``);
+* pod anti-affinity against *existing* pods by label selector over the
+  hostname topology, plus self-anti-affinity (replicas of the scenario pod
+  repel each other → at most one replica per node, a per-node fit clamp
+  rather than a mask).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+
+__all__ = [
+    "tolerations_mask",
+    "node_selector_mask",
+    "node_affinity_mask",
+    "anti_affinity_existing_mask",
+    "combine_masks",
+]
+
+_HARD_EFFECTS = ("NoSchedule", "NoExecute")
+
+
+def _toleration_matches(tol: dict, taint: dict) -> bool:
+    """Kubernetes toleration-matches-taint predicate.
+
+    ``operator: Exists`` with an empty key tolerates every taint; otherwise
+    keys must match, ``Equal`` (the default operator) also requires value
+    equality, and an empty toleration effect matches all effects.
+    """
+    t_effect = tol.get("effect", "")
+    if t_effect and t_effect != taint.get("effect", ""):
+        return False
+    op = tol.get("operator", "Equal")
+    key = tol.get("key", "")
+    if op == "Exists":
+        return key == "" or key == taint.get("key", "")
+    return key == taint.get("key", "") and tol.get("value", "") == taint.get(
+        "value", ""
+    )
+
+
+def tolerations_mask(
+    snapshot: ClusterSnapshot, tolerations: list[dict] | None
+) -> np.ndarray:
+    """``mask[n]`` — every hard taint on node ``n`` is tolerated."""
+    tolerations = tolerations or []
+    mask = np.ones(snapshot.n_nodes, dtype=np.bool_)
+    for i, taints in enumerate(snapshot.taints):
+        for taint in taints or []:
+            if taint.get("effect") not in _HARD_EFFECTS:
+                continue
+            if not any(_toleration_matches(t, taint) for t in tolerations):
+                mask[i] = False
+                break
+    return mask
+
+
+def node_selector_mask(
+    snapshot: ClusterSnapshot, node_selector: dict | None
+) -> np.ndarray:
+    """``mask[n]`` — node labels contain every (key, value) of the selector."""
+    if not node_selector:
+        return np.ones(snapshot.n_nodes, dtype=np.bool_)
+    mask = np.empty(snapshot.n_nodes, dtype=np.bool_)
+    for i, labels in enumerate(snapshot.labels):
+        labels = labels or {}
+        mask[i] = all(labels.get(k) == v for k, v in node_selector.items())
+    return mask
+
+
+def _expr_matches(labels: dict, expr: dict) -> bool:
+    key = expr.get("key", "")
+    op = expr.get("operator", "In")
+    values = expr.get("values", [])
+    present = key in labels
+    if op == "In":
+        return present and labels[key] in values
+    if op == "NotIn":
+        return not present or labels[key] not in values
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return not present
+    if op in ("Gt", "Lt"):
+        if not present or not values:
+            return False
+        try:
+            label_val = int(labels[key])
+            bound = int(values[0])
+        except ValueError:
+            return False
+        return label_val > bound if op == "Gt" else label_val < bound
+    raise ValueError(f"unknown match-expression operator {op!r}")
+
+
+def node_affinity_mask(
+    snapshot: ClusterSnapshot, node_selector_terms: list[dict] | None
+) -> np.ndarray:
+    """Required node-affinity: terms OR-ed, expressions within a term AND-ed."""
+    if not node_selector_terms:
+        return np.ones(snapshot.n_nodes, dtype=np.bool_)
+    mask = np.zeros(snapshot.n_nodes, dtype=np.bool_)
+    for i, labels in enumerate(snapshot.labels):
+        labels = labels or {}
+        mask[i] = any(
+            all(
+                _expr_matches(labels, e)
+                for e in term.get("matchExpressions", [])
+            )
+            for term in node_selector_terms
+        )
+    return mask
+
+
+def anti_affinity_existing_mask(
+    snapshot: ClusterSnapshot,
+    fixture: dict,
+    label_selector: dict,
+) -> np.ndarray:
+    """Anti-affinity vs existing pods: exclude nodes hosting a matching pod.
+
+    Hostname topology (the overwhelmingly common case): a node is infeasible
+    if any non-terminated pod already on it carries all the selector labels.
+    Label data comes from the fixture's pods (``labels`` key, optional).
+    """
+    node_index = {name: i for i, name in enumerate(snapshot.names)}
+    mask = np.ones(snapshot.n_nodes, dtype=np.bool_)
+    for pod in fixture.get("pods", []):
+        if pod.get("phase") in ("Succeeded", "Failed"):
+            continue
+        i = node_index.get(pod.get("nodeName", ""))
+        if i is None:
+            continue
+        pod_labels = pod.get("labels", {}) or {}
+        if all(pod_labels.get(k) == v for k, v in label_selector.items()):
+            mask[i] = False
+    return mask
+
+
+def combine_masks(*masks: np.ndarray | None) -> np.ndarray | None:
+    """AND together any number of optional ``[N]`` masks (None = all-true)."""
+    out = None
+    for m in masks:
+        if m is None:
+            continue
+        out = m.copy() if out is None else (out & m)
+    return out

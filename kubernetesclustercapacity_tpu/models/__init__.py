@@ -1,0 +1,7 @@
+"""Capacity models: user-facing facades composing snapshot, kernel and masks."""
+
+from kubernetesclustercapacity_tpu.models.capacity import (  # noqa: F401
+    CapacityModel,
+    CapacityResult,
+    PodSpec,
+)

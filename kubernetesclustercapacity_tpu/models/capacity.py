@@ -1,0 +1,263 @@
+"""The flagship capacity model: one object answering "will it schedule?".
+
+:class:`CapacityModel` is the framework's user-facing composition of the
+layers below it — snapshot arrays, constraint masks, and the jitted fit
+kernels.  A :class:`PodSpec` describes the what-if pod (resources AND
+scheduling constraints — everything the reference's six flags could not
+express); ``evaluate`` answers one spec, ``sweep`` answers a grid.
+
+The reference equivalent is the whole of ``main`` (``ClusterCapacity.go:
+48-150``) minus flag parsing and printing; the constraint families have no
+reference equivalent (it schedules anywhere resources allow).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubernetesclustercapacity_tpu import masks as _masks
+from kubernetesclustercapacity_tpu.ops.fit import (
+    fit_per_node,
+    fit_per_node_multi,
+    sweep_grid,
+)
+from kubernetesclustercapacity_tpu.scenario import Scenario, ScenarioGrid
+from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+
+__all__ = ["PodSpec", "CapacityModel", "CapacityResult"]
+
+
+@dataclass(frozen=True)
+class PodSpec:
+    """A what-if pod: resources plus the scheduling constraints it carries.
+
+    ``extended_requests`` maps extra resource names (must exist in the
+    snapshot's ``extended`` columns) to per-replica requests.  Constraint
+    fields mirror the pod-spec fields kube-scheduler filters on; all are
+    optional and default to unconstrained.  ``spread`` caps replicas per node
+    (self-anti-affinity over the hostname topology; 1 = classic one-per-node
+    spread, 0/None = unlimited).
+    """
+
+    cpu_request_milli: int
+    mem_request_bytes: int
+    replicas: int = 1
+    cpu_limit_milli: int = 0
+    mem_limit_bytes: int = 0
+    extended_requests: dict[str, int] = field(default_factory=dict)
+    tolerations: tuple = ()
+    node_selector: dict = field(default_factory=dict)
+    affinity_terms: tuple = ()
+    anti_affinity_labels: dict = field(default_factory=dict)
+    spread: int | None = None
+
+    @classmethod
+    def from_scenario(cls, s: Scenario) -> "PodSpec":
+        return cls(
+            cpu_request_milli=s.cpu_request_milli,
+            mem_request_bytes=s.mem_request_bytes,
+            replicas=s.replicas,
+            cpu_limit_milli=s.cpu_limit_milli,
+            mem_limit_bytes=s.mem_limit_bytes,
+        )
+
+    @property
+    def constrained(self) -> bool:
+        return bool(
+            self.tolerations
+            or self.node_selector
+            or self.affinity_terms
+            or self.anti_affinity_labels
+            or self.spread is not None
+        )
+
+
+@dataclass
+class CapacityResult:
+    """Outcome of one evaluation: per-node fits, total, and the verdict."""
+
+    fits: np.ndarray
+    total: int
+    replicas_requested: int
+    mode: str
+
+    @property
+    def schedulable(self) -> bool:
+        return self.total >= self.replicas_requested  # :144 inclusive >=
+
+
+class CapacityModel:
+    """Evaluate pod specs against one snapshot, with optional constraints.
+
+    ``mode="reference"`` restricts to the bit-exact 2-resource kernel (and
+    rejects constraints the reference cannot express unless
+    ``allow_extensions``); ``mode="strict"`` uses corrected semantics and the
+    full constraint/multi-resource surface.  ``fixture`` is only needed for
+    anti-affinity against existing pods (pod labels aren't in the arrays).
+    """
+
+    def __init__(
+        self,
+        snapshot: ClusterSnapshot,
+        *,
+        mode: str = "strict",
+        fixture: dict | None = None,
+        allow_extensions: bool = True,
+    ) -> None:
+        self.snapshot = snapshot
+        self.mode = mode
+        self.fixture = fixture
+        self.allow_extensions = allow_extensions
+
+    # -- mask assembly -----------------------------------------------------
+    def _masks_for(self, spec: PodSpec) -> np.ndarray | None:
+        """Mask policy, by mode.
+
+        * ``strict``: the taint mask ALWAYS applies (a real scheduler never
+          places an untolerating pod on a hard-tainted node); the other
+          families apply when the spec carries them.
+        * ``reference``: the reference ignores constraints entirely, so no
+          mask is implicit; explicitly-carried constraints are an extension
+          and require ``allow_extensions`` (else :meth:`evaluate` raised
+          already).
+        """
+        snap = self.snapshot
+        has_taints = bool(snap.taints) and any(snap.taints)
+        parts = []
+        if has_taints and (self.mode == "strict" or spec.tolerations):
+            parts.append(_masks.tolerations_mask(snap, list(spec.tolerations)))
+        if spec.node_selector:
+            parts.append(_masks.node_selector_mask(snap, spec.node_selector))
+        if spec.affinity_terms:
+            parts.append(
+                _masks.node_affinity_mask(snap, list(spec.affinity_terms))
+            )
+        if spec.anti_affinity_labels:
+            if self.fixture is None:
+                raise ValueError(
+                    "anti-affinity vs existing pods needs the source fixture "
+                    "(pod labels are not part of the dense snapshot)"
+                )
+            parts.append(
+                _masks.anti_affinity_existing_mask(
+                    snap, self.fixture, spec.anti_affinity_labels
+                )
+            )
+        return _masks.combine_masks(*parts)
+
+    def _check_extensions(self, constrained: bool) -> None:
+        if (
+            constrained
+            and self.mode == "reference"
+            and not self.allow_extensions
+        ):
+            raise ValueError(
+                "constraints/extended resources are extensions beyond "
+                "reference semantics; pass allow_extensions=True"
+            )
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self, spec: PodSpec) -> CapacityResult:
+        """One spec → per-node fits + verdict.
+
+        Resource arithmetic always runs on the appropriate kernel: the
+        bit-exact 2-resource kernel unless the spec requests extended
+        resources (which need the R-dim generalization).  Constraint masks
+        and the spread clamp compose around either kernel.
+        """
+        snap = self.snapshot
+        self._check_extensions(spec.constrained or bool(spec.extended_requests))
+        mask = self._masks_for(spec)
+
+        if not spec.extended_requests:
+            fits = np.asarray(
+                fit_per_node(
+                    snap.alloc_cpu_milli,
+                    snap.alloc_mem_bytes,
+                    snap.alloc_pods,
+                    snap.used_cpu_req_milli,
+                    snap.used_mem_req_bytes,
+                    snap.pods_count,
+                    snap.healthy,
+                    spec.cpu_request_milli,
+                    spec.mem_request_bytes,
+                    mode=self.mode,
+                    node_mask=mask,
+                )
+            )
+            if spec.spread is not None:
+                fits = np.minimum(fits, spec.spread)
+                if mask is not None:  # keep masked nodes at 0 after the clamp
+                    fits = np.where(mask, fits, 0)
+        else:
+            resources = ("cpu", "memory", *sorted(spec.extended_requests))
+            alloc_rn, used_rn = snap.resource_matrix(resources)
+            reqs = np.array(
+                [
+                    spec.cpu_request_milli,
+                    spec.mem_request_bytes,
+                    *(spec.extended_requests[r] for r in resources[2:]),
+                ],
+                dtype=np.int64,
+            )
+            fits = np.asarray(
+                fit_per_node_multi(
+                    alloc_rn,
+                    used_rn,
+                    snap.alloc_pods,
+                    snap.pods_count,
+                    snap.healthy,
+                    reqs,
+                    mode=self.mode,
+                    node_mask=mask,
+                    max_per_node=spec.spread,
+                )
+            )
+        return CapacityResult(
+            fits=fits,
+            total=int(fits.sum()),
+            replicas_requested=spec.replicas,
+            mode=self.mode,
+        )
+
+    def sweep(
+        self,
+        grid: ScenarioGrid,
+        *,
+        tolerations: tuple = (),
+        node_selector: dict | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Grid sweep with optional shared constraints.
+
+        Always runs on the bit-exact 2-resource kernel; the shared mask (same
+        for every scenario) is applied inside the jitted sweep.  Per-scenario
+        constraint grids go through :func:`..ops.fit.sweep_grid_multi`
+        directly.
+        """
+        grid.validate()
+        snap = self.snapshot
+        shared_spec = PodSpec(
+            cpu_request_milli=1,
+            mem_request_bytes=1,
+            tolerations=tolerations,
+            node_selector=node_selector or {},
+        )
+        self._check_extensions(shared_spec.constrained)
+        mask = self._masks_for(shared_spec)
+        totals, sched = sweep_grid(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.used_cpu_req_milli,
+            snap.used_mem_req_bytes,
+            snap.pods_count,
+            snap.healthy,
+            grid.cpu_request_milli,
+            grid.mem_request_bytes,
+            grid.replicas,
+            mode=self.mode,
+            node_mask=mask,
+        )
+        return np.asarray(totals), np.asarray(sched)

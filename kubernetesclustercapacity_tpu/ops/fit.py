@@ -44,7 +44,11 @@ __all__ = [
     "sweep_grid",
     "sweep_snapshot",
     "snapshot_device_arrays",
+    "fit_per_node_multi",
+    "sweep_grid_multi",
 ]
+
+_INT64_MAX = np.iinfo(np.int64).max
 
 MODES = ("reference", "strict")
 
@@ -76,12 +80,17 @@ def fit_per_node(
     mem_req,
     *,
     mode: str = "reference",
+    node_mask: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Per-node replica fit for ONE scenario — ``[N]`` int64.
 
     Inputs are the snapshot's int64 node arrays and scalar int64 requests.
     ``cpu_req``/``mem_req`` must be nonzero (validated upstream — the
     reference would panic, SURVEY.md §2.4 Q8); the kernel itself is total.
+    ``node_mask`` (``[N]`` bool, optional) zeroes constraint-infeasible nodes
+    after the mode epilogue — an extension (the reference has no constraint
+    concept), applied on the uint64-faithful kernel so resource arithmetic
+    parity is preserved for the unmasked nodes.
     """
     alloc_cpu = jnp.asarray(alloc_cpu, jnp.int64)
     alloc_mem = jnp.asarray(alloc_mem, jnp.int64)
@@ -111,18 +120,23 @@ def fit_per_node(
     )
 
     fit = jnp.minimum(cpu_fit, mem_fit)  # findMin (:159-164)
+    fit = _apply_mode(fit, alloc_pods, pods_count, healthy, mode)
+    if node_mask is not None:
+        fit = jnp.where(jnp.asarray(node_mask, jnp.bool_), fit, 0)
+    return fit
 
+
+def _apply_mode(fit, alloc_pods, pods_count, healthy, mode: str):
+    """The pod-count epilogue, shared by the 2-resource and R-dim kernels."""
     if mode == "reference":
         # Q1: conditional overwrite — only when fit >= allocatablePods, and
         # the replacement ignores that cpu/mem may bind tighter (:134-136).
-        fit = jnp.where(fit >= alloc_pods, alloc_pods - pods_count, fit)
-    elif mode == "strict":
+        return jnp.where(fit >= alloc_pods, alloc_pods - pods_count, fit)
+    if mode == "strict":
         slots = jnp.maximum(alloc_pods - pods_count, 0)
         fit = jnp.maximum(jnp.minimum(fit, slots), 0)
-        fit = jnp.where(jnp.asarray(healthy, jnp.bool_), fit, 0)
-    else:
-        raise ValueError(f"unknown mode {mode!r}")
-    return fit
+        return jnp.where(jnp.asarray(healthy, jnp.bool_), fit, 0)
+    raise ValueError(f"unknown mode {mode!r}")
 
 
 @partial(jax.jit, static_argnames=("mode",))
@@ -170,6 +184,7 @@ def sweep_grid(
     replicas,
     *,
     mode: str = "reference",
+    node_mask=None,
     return_per_node: bool = False,
 ):
     """Evaluate S scenarios against N nodes in one compiled program.
@@ -177,7 +192,8 @@ def sweep_grid(
     ``vmap`` over the scenario axis of ``(cpu_reqs[S], mem_reqs[S])``;
     returns ``(totals[S], schedulable[S])`` — and ``fits[S, N]`` too when
     ``return_per_node`` (kept optional so the 10k×1k sweep reduces in-register
-    instead of materializing a 10M-cell intermediate in HBM).
+    instead of materializing a 10M-cell intermediate in HBM).  ``node_mask``
+    is an optional shared ``[N]`` constraint mask.
     """
     per_scenario = jax.vmap(
         lambda c, m: fit_per_node(
@@ -191,9 +207,123 @@ def sweep_grid(
             c,
             m,
             mode=mode,
+            node_mask=node_mask,
         )
     )
     fits = per_scenario(jnp.asarray(cpu_reqs, jnp.int64), jnp.asarray(mem_reqs, jnp.int64))
+    totals = jnp.sum(fits, axis=1)
+    schedulable = totals >= jnp.asarray(replicas, jnp.int64)
+    if return_per_node:
+        return totals, schedulable, fits
+    return totals, schedulable
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def fit_per_node_multi(
+    alloc_rn: jnp.ndarray,
+    used_rn: jnp.ndarray,
+    alloc_pods: jnp.ndarray,
+    pods_count: jnp.ndarray,
+    healthy: jnp.ndarray,
+    reqs_r: jnp.ndarray,
+    *,
+    mode: str = "strict",
+    node_mask: jnp.ndarray | None = None,
+    max_per_node: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """R-dimensional fit (BASELINE config 4): ``min`` over resource rows.
+
+    ``alloc_rn``/``used_rn`` are ``[R, N]`` int64 (rows in the caller's
+    resource order — e.g. cpu milli, memory bytes, ephemeral-storage bytes,
+    GPU count); ``reqs_r`` is the scenario's ``[R]`` request vector.  A zero
+    request means "does not consume this resource": that row is excluded
+    from the min (``+inf`` fit) rather than dividing by zero — the natural
+    generalization, since the reference's 2-resource kernel treats a zero
+    request as fatal (SURVEY.md §2.4 Q8).
+
+    All rows use int64 semantics (the generalized kernel is an extension —
+    the bit-exactness contract vs. the Go path applies to the 2-resource
+    :func:`fit_per_node`, which carries Go's uint64-CPU quirk).
+
+    ``node_mask`` (``[N]`` bool) zeroes constraint-infeasible nodes;
+    ``max_per_node`` (scalar) clamps per-node replicas (self-anti-affinity:
+    spread pods repel each other → at most k per topology domain).
+    """
+    alloc_rn = jnp.asarray(alloc_rn, jnp.int64)
+    used_rn = jnp.asarray(used_rn, jnp.int64)
+    reqs = jnp.asarray(reqs_r, jnp.int64)[:, None]  # [R, 1]
+    alloc_pods = jnp.asarray(alloc_pods, jnp.int64)
+    pods_count = jnp.asarray(pods_count, jnp.int64)
+
+    head = alloc_rn - used_rn
+    per_resource = jnp.where(
+        reqs == 0,
+        jnp.int64(_INT64_MAX),
+        jnp.where(
+            alloc_rn <= used_rn,
+            jnp.int64(0),
+            # Zero-only divisor guard (the zero row is excluded above);
+            # negative requests divide as-is, matching fit_per_node.
+            _trunc_div(head, jnp.where(reqs == 0, jnp.int64(1), reqs)),
+        ),
+    )  # [R, N]
+    fit = jnp.min(per_resource, axis=0)
+    fit = _apply_mode(fit, alloc_pods, pods_count, healthy, mode)
+
+    if max_per_node is not None:
+        fit = jnp.minimum(fit, jnp.asarray(max_per_node, jnp.int64))
+    if node_mask is not None:
+        fit = jnp.where(jnp.asarray(node_mask, jnp.bool_), fit, 0)
+    return fit
+
+
+@partial(jax.jit, static_argnames=("mode", "return_per_node"))
+def sweep_grid_multi(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_sr,
+    replicas,
+    *,
+    mode: str = "strict",
+    node_masks=None,
+    max_per_node=None,
+    return_per_node: bool = False,
+):
+    """S scenarios × R resources sweep: ``reqs_sr`` is ``[S, R]``.
+
+    ``node_masks`` may be ``None``, a shared ``[N]`` mask, or per-scenario
+    ``[S, N]``; ``max_per_node`` may be ``None``, a scalar, or ``[S]``.
+    """
+    reqs_sr = jnp.asarray(reqs_sr, jnp.int64)
+
+    def one(req_r, mask, cap):
+        return fit_per_node_multi(
+            alloc_rn,
+            used_rn,
+            alloc_pods,
+            pods_count,
+            healthy,
+            req_r,
+            mode=mode,
+            node_mask=mask,
+            max_per_node=cap,
+        )
+
+    mask_axis = None
+    if node_masks is not None:
+        node_masks = jnp.asarray(node_masks, jnp.bool_)
+        mask_axis = 0 if node_masks.ndim == 2 else None
+    cap_axis = None
+    if max_per_node is not None:
+        max_per_node = jnp.asarray(max_per_node, jnp.int64)
+        cap_axis = 0 if max_per_node.ndim == 1 else None
+
+    fits = jax.vmap(one, in_axes=(0, mask_axis, cap_axis))(
+        reqs_sr, node_masks, max_per_node
+    )  # [S, N]
     totals = jnp.sum(fits, axis=1)
     schedulable = totals >= jnp.asarray(replicas, jnp.int64)
     if return_per_node:

@@ -302,15 +302,22 @@ def fit_arrays_python(
     pods_count,
     cpu_req: int,
     mem_req: int,
+    *,
+    mode: str = "reference",
+    healthy=None,
 ) -> list[int]:
-    """Go-semantics fit over raw int64 arrays — the array-level ground truth.
+    """Sequential fit over raw int64 arrays — the array-level ground truth.
 
-    Same arithmetic as :func:`reference_run`'s per-node loop, but taking the
-    snapshot's packed int64 arrays directly (bit patterns: CPU values are
-    uint64 reinterpreted).  Lets parity tests feed the JAX kernel and this
-    scalar loop identical adversarial arrays — including wrapped negatives —
-    without constructing fixtures.
+    ``mode="reference"`` is the same arithmetic as :func:`reference_run`'s
+    per-node loop (bit patterns: CPU values are uint64 reinterpreted, zero
+    requests panic at division exactly where Go would); ``mode="strict"``
+    mirrors the kernel's corrected semantics (3-way min with remaining pod
+    slots, clamped at 0, unhealthy nodes contribute nothing — ``healthy``
+    defaults to all-healthy).  Lets parity tests and the CPU CLI backend feed
+    this scalar loop and the JAX kernel identical arrays in either mode.
     """
+    if mode not in ("reference", "strict"):
+        raise ValueError(f"unknown mode {mode!r}")
     fits = []
     cr = int(cpu_req) % _UINT64_MOD
     mr = int(mem_req)
@@ -336,8 +343,14 @@ def fit_arrays_python(
             mem_fit = _go_div(_to_go_int(am - um), mr)
         fit = cpu_fit if cpu_fit <= mem_fit else mem_fit
         ap = int(alloc_pods[i])
-        if fit >= ap:
-            fit = ap - int(pods_count[i])
+        if mode == "reference":
+            if fit >= ap:
+                fit = ap - int(pods_count[i])
+        else:
+            slots = max(ap - int(pods_count[i]), 0)
+            fit = max(min(fit, slots), 0)
+            if healthy is not None and not bool(healthy[i]):
+                fit = 0
         fits.append(fit)
     return fits
 

@@ -1,0 +1,219 @@
+"""Verdict reporting (L0): reference-parity text and structured output.
+
+The reference's entire observability story is ``fmt.Printf`` to stdout
+(SURVEY.md §5).  This module reproduces that text byte-for-byte — including
+the typos ("allocatbale", "scehdule") and Go's float rendering of NaN/±Inf —
+so transcript-level parity can be asserted, and adds what the reference
+lacks: structured JSON and a compact table for humans.
+
+All formatting is host-side numpy/python; percentages are display-only in the
+reference too (``ClusterCapacity.go:113-117`` — they never influence the
+fit).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+
+from kubernetesclustercapacity_tpu.scenario import Scenario
+from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+
+__all__ = [
+    "reference_report",
+    "json_report",
+    "table_report",
+]
+
+_RULE = "=" * 110  # the reference prints 110 '=' (ClusterCapacity.go:142,149)
+
+
+def _go_float(x: float) -> str:
+    """Render a float the way Go ``%.2f`` does (NaN, ±Inf spellings)."""
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "+Inf" if x > 0 else "-Inf"
+    return f"{x:.2f}"
+
+
+def _go_percent(num: int, den: int) -> float:
+    """Go float64 division semantics: x/0 → ±Inf, 0/0 → NaN."""
+    if den == 0:
+        if num == 0:
+            return math.nan
+        return math.inf if num > 0 else -math.inf
+    return float(num) * 100 / float(den)
+
+
+def reference_report(
+    snapshot: ClusterSnapshot,
+    fits: np.ndarray,
+    scenario: Scenario,
+    *,
+    include_preamble: bool = True,
+) -> str:
+    """The reference's stdout transcript, reconstructed from arrays.
+
+    Mirrors ``main``'s prints in order: the parsed-input line (``:85``), the
+    node count (``:174``), per-node blocks (``:107-137``), and the final
+    verdict (``:142-149``).  The per-node struct print matches Go's ``%v`` of
+    the ``node`` struct: ``{name cpu mem pods}``.
+    """
+    out = []
+    if include_preamble:
+        out.append(
+            "\nCPU limits, requests, Memory limits, requests and replicas "
+            f"parsed from input : {scenario.cpu_limit_milli} "
+            f"{scenario.cpu_request_milli} {scenario.mem_limit_bytes} "
+            f"{scenario.mem_request_bytes} {scenario.replicas}\n"
+        )
+        out.append(
+            f"\nThere are total {snapshot.n_nodes} nodes in the cluster\n\n"
+        )
+
+    total = 0
+    for i in range(snapshot.n_nodes):
+        name = snapshot.names[i]
+        alloc_cpu = int(snapshot.alloc_cpu_milli[i])
+        alloc_mem = int(snapshot.alloc_mem_bytes[i])
+        cpu_lim = int(snapshot.used_cpu_lim_milli[i])
+        cpu_req = int(snapshot.used_cpu_req_milli[i])
+        mem_lim = int(snapshot.used_mem_lim_bytes[i])
+        mem_req = int(snapshot.used_mem_req_bytes[i])
+        out.append(
+            f"\n{{{name} {alloc_cpu} {alloc_mem} "
+            f"{int(snapshot.alloc_pods[i])}}} - "
+            f"Current non-terminated pods : {int(snapshot.pods_count[i])}"
+        )
+        out.append(
+            "\nSum of CPU Limits, Requests and Memory Limits, Requests for "
+            f"all pods : {cpu_lim} {cpu_req} {mem_lim} {mem_req}"
+        )
+        out.append(
+            f"\nTotal allocatbale CPU and Memory : {alloc_cpu}, {alloc_mem}"
+        )
+        out.append(
+            "\nCPU Limits, Requests and Memory Limits, Requests used "
+            "percentage till now : "
+            f"{_go_float(_go_percent(cpu_lim, alloc_cpu))} "
+            f"{_go_float(_go_percent(cpu_req, alloc_cpu))} "
+            f"{_go_float(_go_percent(mem_lim, alloc_mem))} "
+            f"{_go_float(_go_percent(mem_req, alloc_mem))}"
+        )
+        out.append(f"\nMax replicas : {int(fits[i])}\n")
+        total += int(fits[i])
+
+    out.append(_RULE + "\n")
+    out.append(
+        "\n\t Total possible replicas for the pod with required input specs "
+        f": {total}"
+    )
+    if total >= scenario.replicas:
+        out.append(
+            f"\n\t So you can go ahead with deployment of {scenario.replicas} "
+            "pod replicas in the Kubernetes cluster!!\n\n"
+        )
+    else:
+        out.append(
+            f"\n\t Unfortunately Kubernetes cluster can't scehdule "
+            f"{scenario.replicas} replicas. Please try again by reducing the "
+            "number of replicas or/and cpu/memory resource requests. "
+            "Exiting!!\n\n"
+        )
+    out.append(_RULE + "\n")
+    return "".join(out)
+
+
+def json_report(
+    snapshot: ClusterSnapshot, fits: np.ndarray, scenario: Scenario
+) -> str:
+    """Structured output: the same quantities the reference prints, as JSON."""
+    total = int(np.sum(fits))
+    nodes = []
+    for i in range(snapshot.n_nodes):
+        alloc_cpu = int(snapshot.alloc_cpu_milli[i])
+        alloc_mem = int(snapshot.alloc_mem_bytes[i])
+        cpu_req = int(snapshot.used_cpu_req_milli[i])
+        mem_req = int(snapshot.used_mem_req_bytes[i])
+        nodes.append(
+            {
+                "name": snapshot.names[i],
+                "healthy": bool(snapshot.healthy[i]),
+                "allocatable": {
+                    "cpu_milli": alloc_cpu,
+                    "memory_bytes": alloc_mem,
+                    "pods": int(snapshot.alloc_pods[i]),
+                },
+                "used_requests": {
+                    "cpu_milli": cpu_req,
+                    "memory_bytes": mem_req,
+                },
+                "used_limits": {
+                    "cpu_milli": int(snapshot.used_cpu_lim_milli[i]),
+                    "memory_bytes": int(snapshot.used_mem_lim_bytes[i]),
+                },
+                "pods_count": int(snapshot.pods_count[i]),
+                "utilization_pct": {
+                    "cpu_requests": _nan_to_none(
+                        _go_percent(cpu_req, alloc_cpu)
+                    ),
+                    "memory_requests": _nan_to_none(
+                        _go_percent(mem_req, alloc_mem)
+                    ),
+                },
+                "max_replicas": int(fits[i]),
+            }
+        )
+    return json.dumps(
+        {
+            "scenario": {
+                "cpu_request_milli": scenario.cpu_request_milli,
+                "cpu_limit_milli": scenario.cpu_limit_milli,
+                "mem_request_bytes": scenario.mem_request_bytes,
+                "mem_limit_bytes": scenario.mem_limit_bytes,
+                "replicas": scenario.replicas,
+            },
+            "nodes": nodes,
+            "total_possible_replicas": total,
+            "schedulable": total >= scenario.replicas,
+        },
+        indent=2,
+    )
+
+
+def _nan_to_none(x: float):
+    if math.isnan(x) or math.isinf(x):
+        return None
+    return round(x, 2)
+
+
+def table_report(
+    snapshot: ClusterSnapshot, fits: np.ndarray, scenario: Scenario
+) -> str:
+    """Compact human-readable table (a view the reference never had)."""
+    header = (
+        f"{'NODE':<24} {'HEALTHY':<8} {'CPU USED/ALLOC (m)':<22} "
+        f"{'MEM USED/ALLOC (MiB)':<24} {'PODS':<9} {'FIT':>6}"
+    )
+    lines = [header, "-" * len(header)]
+    mib = 1024 * 1024
+    for i in range(snapshot.n_nodes):
+        lines.append(
+            f"{snapshot.names[i] or '<phantom>':<24} "
+            f"{'yes' if snapshot.healthy[i] else 'NO':<8} "
+            f"{f'{int(snapshot.used_cpu_req_milli[i])}/{int(snapshot.alloc_cpu_milli[i])}':<22} "
+            f"{f'{int(snapshot.used_mem_req_bytes[i]) // mib}/{int(snapshot.alloc_mem_bytes[i]) // mib}':<24} "
+            f"{f'{int(snapshot.pods_count[i])}/{int(snapshot.alloc_pods[i])}':<9} "
+            f"{int(fits[i]):>6}"
+        )
+    total = int(np.sum(fits))
+    verdict = "SCHEDULABLE" if total >= scenario.replicas else "NOT SCHEDULABLE"
+    lines.append("-" * len(header))
+    lines.append(
+        f"total possible replicas: {total}   requested: {scenario.replicas}   "
+        f"verdict: {verdict}"
+    )
+    return "\n".join(lines)

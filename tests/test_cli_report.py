@@ -1,0 +1,165 @@
+"""CLI and report tests: flag parity, verdict text, output formats."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.cli import main
+from kubernetesclustercapacity_tpu.fixtures import load_fixture, synthetic_fixture
+from kubernetesclustercapacity_tpu.report import (
+    json_report,
+    reference_report,
+    table_report,
+)
+from kubernetesclustercapacity_tpu.scenario import scenario_from_flags
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+KIND = "tests/fixtures/kind-3node.json"
+
+
+class TestReferenceReport:
+    def test_transcript_content(self):
+        fx = load_fixture(KIND)
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        s = scenario_from_flags(cpuRequests="200m", cpuLimits="400m",
+                                memRequests="250mb", memLimits="500mb",
+                                replicas="10")
+        fits = np.array([36, 36, 37])
+        text = reference_report(snap, fits, s)
+        # Parsed-input line (:85) — cpuLim cpuReq memLim memReq replicas.
+        assert ("CPU limits, requests, Memory limits, requests and replicas "
+                "parsed from input : 400 200 524288000 262144000 10") in text
+        assert "There are total 3 nodes in the cluster" in text
+        # Node struct %v print and the reference's typo'd lines.
+        assert "{kind-control-plane 8000 16761683968 110} - " in text
+        assert "Current non-terminated pods : 4" in text
+        assert "Total allocatbale CPU and Memory : 8000, 16761683968" in text
+        assert "Max replicas : 36" in text
+        assert ("Total possible replicas for the pod with required input "
+                "specs : 109") in text
+        assert ("So you can go ahead with deployment of 10 pod replicas in "
+                "the Kubernetes cluster!!") in text
+        assert "=" * 110 in text
+
+    def test_unschedulable_verdict_typo_parity(self):
+        fx = load_fixture(KIND)
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        s = scenario_from_flags(cpuRequests="200m", memRequests="250mb",
+                                replicas="500")
+        text = reference_report(snap, np.array([36, 36, 37]), s)
+        assert ("Unfortunately Kubernetes cluster can't scehdule 500 "
+                "replicas.") in text
+
+    def test_phantom_node_percentages_render_go_style(self):
+        fx = synthetic_fixture(3, seed=7, unhealthy_frac=1.0,
+                               unscheduled_running_pods=1)
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        s = scenario_from_flags()
+        text = reference_report(snap, np.array([-1, -1, -1]), s)
+        # 0-alloc phantom with orphan usage: +Inf; zero-usage: NaN.
+        assert "+Inf" in text or "NaN" in text
+
+    def test_cpu_backend_cross_check(self):
+        """The transcript derived from kernel fits == oracle-run transcript."""
+        from kubernetesclustercapacity_tpu.oracle import reference_run
+        from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+
+        fx = synthetic_fixture(25, seed=3, unhealthy_frac=0.2)
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        s = scenario_from_flags(cpuRequests="150m", memRequests="200mb")
+        kernel_fits = np.asarray(fit_per_node(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, snap.healthy,
+            s.cpu_request_milli, s.mem_request_bytes))
+        oracle_fits = np.array(reference_run(fx, s).fits)
+        assert reference_report(snap, kernel_fits, s) == reference_report(
+            snap, oracle_fits, s)
+
+
+class TestOtherFormats:
+    def test_json_report(self):
+        fx = load_fixture(KIND)
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        s = scenario_from_flags(replicas="10")
+        doc = json.loads(json_report(snap, np.array([36, 36, 37]), s))
+        assert doc["total_possible_replicas"] == 109
+        assert doc["schedulable"] is True
+        assert len(doc["nodes"]) == 3
+        assert doc["nodes"][0]["allocatable"]["cpu_milli"] == 8000
+
+    def test_table_report(self):
+        fx = load_fixture(KIND)
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        s = scenario_from_flags(replicas="200")
+        t = table_report(snap, np.array([36, 36, 37]), s)
+        assert "kind-worker2" in t
+        assert "NOT SCHEDULABLE" in t
+
+
+class TestCli:
+    def test_sample_run(self, capsys):
+        rc = main(["-snapshot", KIND, "-cpuRequests=200m", "-cpuLimits=400m",
+                   "-memRequests=250mb", "-memLimits=500mb", "-replicas=10"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Total possible replicas for the pod with required input specs : 109" in out
+        assert "go ahead with deployment of 10 pod replicas" in out
+
+    def test_backend_cpu_matches_tpu(self, capsys):
+        rc1 = main(["-snapshot", KIND, "-backend", "tpu"])
+        out1 = capsys.readouterr().out
+        rc2 = main(["-snapshot", KIND, "-backend", "cpu"])
+        out2 = capsys.readouterr().out
+        assert rc1 == rc2 == 0
+        assert out1 == out2
+
+    def test_bad_mem_flag_exits_1(self, capsys):
+        rc = main(["-snapshot", KIND, "-memRequests=garbage"])
+        assert rc == 1
+        assert "ERROR :" in capsys.readouterr().out
+
+    def test_bad_replicas_exits_1(self, capsys):
+        rc = main(["-snapshot", KIND, "-replicas=ten"])
+        assert rc == 1
+
+    def test_zero_cpu_request_validated(self, capsys):
+        rc = main(["-snapshot", KIND, "-cpuRequests=half"])
+        assert rc == 1
+        assert "cpuRequests" in capsys.readouterr().out
+
+    def test_json_output(self, capsys):
+        rc = main(["-snapshot", KIND, "-output", "json", "-replicas=10",
+                   "-cpuRequests=200m", "-memRequests=250mb"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["total_possible_replicas"] == 109
+
+    def test_grid_sweep(self, capsys):
+        rc = main(["-snapshot", KIND, "-grid", "16", "-output", "json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["totals"]) == 16
+        assert 0 <= doc["schedulable_fraction"] <= 1
+
+    def test_npz_roundtrip_through_cli(self, tmp_path, capsys):
+        p = str(tmp_path / "snap.npz")
+        rc = main(["-snapshot", KIND, "-save-snapshot", p, "-replicas=10"])
+        out1 = capsys.readouterr().out
+        assert rc == 0
+        rc = main(["-snapshot", p, "-replicas=10"])
+        out2 = capsys.readouterr().out
+        assert rc == 0
+        assert out1 == out2
+
+    def test_missing_snapshot_file(self, capsys):
+        rc = main(["-snapshot", "/does/not/exist.json"])
+        assert rc == 1
+        assert "not found" in capsys.readouterr().out
+
+    def test_strict_semantics_flag(self, capsys):
+        rc = main(["-snapshot", KIND, "-semantics", "strict",
+                   "-output", "table"])
+        assert rc == 0
+        assert "SCHEDULABLE" in capsys.readouterr().out

@@ -1,0 +1,314 @@
+"""Constraint masks (config 5) and multi-resource fit (config 4) tests."""
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import load_fixture, synthetic_fixture
+from kubernetesclustercapacity_tpu.masks import (
+    anti_affinity_existing_mask,
+    combine_masks,
+    node_affinity_mask,
+    node_selector_mask,
+    tolerations_mask,
+)
+from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
+from kubernetesclustercapacity_tpu.ops.fit import (
+    fit_per_node_multi,
+    sweep_grid_multi,
+)
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+MIB = 1024 * 1024
+GIB = 1024 * MIB
+
+
+@pytest.fixture(scope="module")
+def kind_snap():
+    fx = load_fixture("tests/fixtures/kind-3node.json")
+    return snapshot_from_fixture(fx, semantics="strict")
+
+
+class TestTolerations:
+    def test_untolerated_control_plane_taint(self, kind_snap):
+        mask = tolerations_mask(kind_snap, [])
+        np.testing.assert_array_equal(mask, [False, True, True])
+
+    def test_exists_toleration(self, kind_snap):
+        tols = [{"key": "node-role.kubernetes.io/control-plane",
+                 "operator": "Exists", "effect": "NoSchedule"}]
+        assert tolerations_mask(kind_snap, tols).all()
+
+    def test_equal_toleration_requires_value(self, kind_snap):
+        tols = [{"key": "node-role.kubernetes.io/control-plane",
+                 "operator": "Equal", "value": "wrong", "effect": "NoSchedule"}]
+        np.testing.assert_array_equal(
+            tolerations_mask(kind_snap, tols), [False, True, True]
+        )
+        tols[0]["value"] = ""  # taint value is ""
+        assert tolerations_mask(kind_snap, tols).all()
+
+    def test_tolerate_everything(self, kind_snap):
+        assert tolerations_mask(kind_snap, [{"operator": "Exists"}]).all()
+
+    def test_prefer_no_schedule_is_soft(self):
+        fx = {"nodes": [{"name": "n", "allocatable": {"cpu": "4"},
+                         "conditions": [{"type": "Ready", "status": "True"}],
+                         "taints": [{"key": "k", "value": "v",
+                                     "effect": "PreferNoSchedule"}]}],
+              "pods": []}
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        assert tolerations_mask(snap, []).all()
+
+
+class TestSelectorsAffinity:
+    def test_node_selector(self, kind_snap):
+        mask = node_selector_mask(kind_snap, {"zone": "zone-0"})
+        np.testing.assert_array_equal(mask, [False, True, False])
+        assert node_selector_mask(kind_snap, None).all()
+
+    def test_affinity_expressions(self, kind_snap):
+        terms = [{"matchExpressions": [
+            {"key": "zone", "operator": "In", "values": ["zone-0", "zone-1"]}]}]
+        np.testing.assert_array_equal(
+            node_affinity_mask(kind_snap, terms), [False, True, True]
+        )
+        terms = [{"matchExpressions": [
+            {"key": "node-role.kubernetes.io/control-plane",
+             "operator": "DoesNotExist"}]}]
+        np.testing.assert_array_equal(
+            node_affinity_mask(kind_snap, terms), [False, True, True]
+        )
+
+    def test_affinity_terms_are_ored(self, kind_snap):
+        terms = [
+            {"matchExpressions": [{"key": "zone", "operator": "In",
+                                   "values": ["zone-0"]}]},
+            {"matchExpressions": [{"key": "zone", "operator": "In",
+                                   "values": ["zone-1"]}]},
+        ]
+        np.testing.assert_array_equal(
+            node_affinity_mask(kind_snap, terms), [False, True, True]
+        )
+
+    def test_gt_lt(self):
+        fx = {"nodes": [
+            {"name": "a", "allocatable": {"cpu": "4"}, "labels": {"gen": "3"},
+             "conditions": [{"type": "Ready", "status": "True"}]},
+            {"name": "b", "allocatable": {"cpu": "4"}, "labels": {"gen": "7"},
+             "conditions": [{"type": "Ready", "status": "True"}]}],
+            "pods": []}
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        terms = [{"matchExpressions": [
+            {"key": "gen", "operator": "Gt", "values": ["5"]}]}]
+        np.testing.assert_array_equal(
+            node_affinity_mask(snap, terms), [False, True]
+        )
+
+
+class TestAntiAffinity:
+    def test_existing_pods_repel(self, kind_snap):
+        fx = load_fixture("tests/fixtures/kind-3node.json")
+        fx["pods"][8]["labels"] = {"app": "web"}  # web pod on kind-worker
+        mask = anti_affinity_existing_mask(kind_snap, fx, {"app": "web"})
+        np.testing.assert_array_equal(mask, [True, False, True])
+
+    def test_combine(self, kind_snap):
+        a = np.array([True, True, False])
+        b = np.array([True, False, True])
+        np.testing.assert_array_equal(combine_masks(a, b), [True, False, False])
+        np.testing.assert_array_equal(combine_masks(None, a, None), a)
+        assert combine_masks(None, None) is None
+
+
+class TestMultiResourceKernel:
+    def _gpu_fixture(self):
+        return {"nodes": [
+            {"name": "gpu-a", "allocatable": {
+                "cpu": "16", "memory": "64Gi", "pods": "110",
+                "nvidia.com/gpu": "8", "ephemeral-storage": "200Gi"},
+             "conditions": [{"type": "Ready", "status": "True"}]},
+            {"name": "cpu-b", "allocatable": {
+                "cpu": "64", "memory": "256Gi", "pods": "110",
+                "ephemeral-storage": "500Gi"},
+             "conditions": [{"type": "Ready", "status": "True"}]}],
+            "pods": []}
+
+    def test_gpu_binds(self):
+        snap = snapshot_from_fixture(
+            self._gpu_fixture(), semantics="strict",
+            extended_resources=("ephemeral-storage", "nvidia.com/gpu"))
+        alloc, used = snap.resource_matrix(
+            ("cpu", "memory", "nvidia.com/gpu"))
+        reqs = np.array([1000, GIB, 2], dtype=np.int64)
+        fits = np.asarray(fit_per_node_multi(
+            alloc, used, snap.alloc_pods, snap.pods_count, snap.healthy,
+            reqs, mode="strict"))
+        # gpu-a: min(16, 64, 4) = 4; cpu-b: no GPUs -> alloc 0 <= used 0 -> 0.
+        np.testing.assert_array_equal(fits, [4, 0])
+
+    def test_zero_request_excludes_resource(self):
+        snap = snapshot_from_fixture(
+            self._gpu_fixture(), semantics="strict",
+            extended_resources=("nvidia.com/gpu",))
+        alloc, used = snap.resource_matrix(("cpu", "memory", "nvidia.com/gpu"))
+        reqs = np.array([1000, GIB, 0], dtype=np.int64)  # GPU-less pod
+        fits = np.asarray(fit_per_node_multi(
+            alloc, used, snap.alloc_pods, snap.pods_count, snap.healthy,
+            reqs, mode="strict"))
+        np.testing.assert_array_equal(fits, [16, 64])
+
+    def test_multi_matches_two_resource_kernel(self):
+        from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+        fx = synthetic_fixture(50, seed=13)
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        alloc, used = snap.resource_matrix(("cpu", "memory"))
+        reqs = np.array([150, 200 * MIB], dtype=np.int64)
+        multi = np.asarray(fit_per_node_multi(
+            alloc, used, snap.alloc_pods, snap.pods_count, snap.healthy,
+            reqs, mode="strict"))
+        two = np.asarray(fit_per_node(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, snap.healthy, 150, 200 * MIB, mode="strict"))
+        np.testing.assert_array_equal(multi, two)
+
+    def test_sweep_with_per_scenario_masks(self):
+        fx = synthetic_fixture(30, seed=14)
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        alloc, used = snap.resource_matrix(("cpu", "memory"))
+        reqs = np.tile(np.array([[100, MIB]], dtype=np.int64), (4, 1))
+        masks = np.ones((4, 30), dtype=bool)
+        masks[1, :] = False          # scenario 1: nothing feasible
+        masks[2, ::2] = False        # scenario 2: half the nodes
+        totals, sched = sweep_grid_multi(
+            alloc, used, snap.alloc_pods, snap.pods_count, snap.healthy,
+            reqs, np.ones(4, dtype=np.int64), mode="strict",
+            node_masks=masks)
+        totals = np.asarray(totals)
+        assert totals[1] == 0
+        assert totals[0] == totals[3]
+        assert totals[2] < totals[0]
+        assert not np.asarray(sched)[1]
+
+
+class TestCapacityModel:
+    def test_spread_one_per_node(self, kind_snap):
+        model = CapacityModel(kind_snap, mode="strict")
+        spec = PodSpec(cpu_request_milli=100, mem_request_bytes=MIB,
+                       replicas=2, spread=1)
+        r = model.evaluate(spec)
+        # Control-plane taint is untolerated (the mask applies whenever the
+        # snapshot has taints), workers clamp to 1 replica each.
+        np.testing.assert_array_equal(r.fits, [0, 1, 1])
+        assert r.schedulable
+
+    def test_spread_with_toleration_covers_all_nodes(self, kind_snap):
+        model = CapacityModel(kind_snap, mode="strict")
+        spec = PodSpec(cpu_request_milli=100, mem_request_bytes=MIB,
+                       replicas=3, spread=1,
+                       tolerations=({"operator": "Exists"},))
+        r = model.evaluate(spec)
+        np.testing.assert_array_equal(r.fits, [1, 1, 1])
+        assert r.schedulable
+
+    def test_constraints_compose(self, kind_snap):
+        fx = load_fixture("tests/fixtures/kind-3node.json")
+        fx["pods"][8]["labels"] = {"app": "web"}
+        model = CapacityModel(kind_snap, mode="strict", fixture=fx)
+        spec = PodSpec(
+            cpu_request_milli=100, mem_request_bytes=MIB, replicas=2,
+            anti_affinity_labels={"app": "web"},  # excludes kind-worker
+        )
+        r = model.evaluate(spec)
+        assert r.fits[0] == 0  # control-plane taint untolerated
+        assert r.fits[1] == 0  # anti-affinity
+        assert r.fits[2] > 0
+
+    def test_gpu_spec(self):
+        fx = {"nodes": [
+            {"name": "g", "allocatable": {
+                "cpu": "16", "memory": "64Gi", "pods": "110",
+                "nvidia.com/gpu": "8"},
+             "conditions": [{"type": "Ready", "status": "True"}]}],
+            "pods": []}
+        snap = snapshot_from_fixture(fx, semantics="strict",
+                                     extended_resources=("nvidia.com/gpu",))
+        model = CapacityModel(snap, mode="strict")
+        r = model.evaluate(PodSpec(
+            cpu_request_milli=1000, mem_request_bytes=GIB, replicas=4,
+            extended_requests={"nvidia.com/gpu": 2}))
+        assert r.total == 4
+        assert r.schedulable
+
+    def test_reference_mode_unconstrained_stays_bit_exact(self):
+        """reference-mode model paths must agree with the uint64 oracle even
+        on wrapped CPU bit patterns (the multi kernel would diverge)."""
+        from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+        from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+        from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+
+        n = 4
+        snap = ClusterSnapshot(
+            names=[f"n{i}" for i in range(n)],
+            alloc_cpu_milli=np.array([5000, 8000, 100, 700]),
+            alloc_mem_bytes=np.full(n, 64 * GIB),
+            alloc_pods=np.full(n, 110),
+            used_cpu_req_milli=np.array([-1, 650, 0, 0]),  # -1 = uint64 max
+            used_cpu_lim_milli=np.zeros(n),
+            used_mem_req_bytes=np.zeros(n),
+            used_mem_lim_bytes=np.zeros(n),
+            pods_count=np.zeros(n),
+            healthy=np.ones(n, dtype=bool),
+        )
+        model = CapacityModel(snap, mode="reference")
+        spec = PodSpec(cpu_request_milli=100, mem_request_bytes=MIB, replicas=1)
+        expected = fit_arrays_python(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, 100, MIB)
+        np.testing.assert_array_equal(model.evaluate(spec).fits, expected)
+        # node 0: alloc 5000 <= used (uint64 max) -> 0, NOT a huge int64 fit.
+        assert model.evaluate(spec).fits[0] == 0
+        grid = ScenarioGrid(np.array([100]), np.array([MIB]), np.array([1]))
+        totals, _ = model.sweep(grid)
+        assert totals[0] == sum(expected)
+
+    def test_reference_mode_constraints_need_allow_extensions(self, kind_snap):
+        model = CapacityModel(kind_snap, mode="reference",
+                              allow_extensions=False)
+        spec = PodSpec(cpu_request_milli=100, mem_request_bytes=MIB,
+                       node_selector={"zone": "zone-0"})
+        with pytest.raises(ValueError, match="extensions"):
+            model.evaluate(spec)
+        from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+        grid = ScenarioGrid(np.array([100]), np.array([MIB]), np.array([1]))
+        with pytest.raises(ValueError, match="extensions"):
+            model.sweep(grid, node_selector={"zone": "zone-0"})
+        # Unconstrained reference sweep does NOT mask tainted nodes.
+        totals, _ = model.sweep(grid)
+        assert totals[0] > 0
+
+    def test_cpu_strict_backend_matches_kernel(self):
+        from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+        from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+
+        fx = synthetic_fixture(40, seed=17, unhealthy_frac=0.3)
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        py = fit_arrays_python(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, 150, MIB, mode="strict", healthy=snap.healthy)
+        jx = np.asarray(fit_per_node(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, snap.healthy, 150, MIB, mode="strict"))
+        np.testing.assert_array_equal(py, jx)
+
+    def test_model_sweep_with_tolerations(self, kind_snap):
+        from kubernetesclustercapacity_tpu.scenario import ScenarioGrid, Scenario
+        model = CapacityModel(kind_snap, mode="strict")
+        grid = ScenarioGrid.from_scenarios(
+            [Scenario(100, MIB, 1), Scenario(200, 2 * MIB, 1)])
+        untol, _ = model.sweep(grid)
+        tol, _ = model.sweep(grid, tolerations=({"operator": "Exists"},))
+        assert (tol > untol).all()  # control-plane becomes available
