@@ -55,13 +55,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Memory limits either in GB(2) or megabytes(500mb)")
     p.add_argument("-replicas", default="1", help="No of pod replicas")
     # New surface.
-    p.add_argument("-backend", choices=("tpu", "cpu"), default="tpu",
-                   help="vectorized JAX kernel (tpu) or sequential walk (cpu)")
+    p.add_argument("-backend", choices=("tpu", "cpu", "native"), default="tpu",
+                   help="vectorized JAX kernel (tpu), pure-Python sequential "
+                        "walk (cpu), or the compiled C++ loop (native)")
     p.add_argument("-snapshot", default="",
                    help="offline source: fixture .json or checkpoint .npz")
     p.add_argument("-semantics", choices=("reference", "strict"),
-                   default="reference",
-                   help="bug-compatible reference semantics or corrected mode")
+                   default=None,
+                   help="bug-compatible reference semantics or corrected mode "
+                        "(default: reference; for .npz snapshots, the "
+                        "semantics they were packed with)")
     p.add_argument("-output", choices=("reference", "json", "table"),
                    default="reference", help="report format")
     p.add_argument("-grid", type=int, default=0, metavar="N",
@@ -141,9 +144,25 @@ def _load_source(args):
             print(f"ERROR : snapshot file not found: {args.snapshot}")
             return None, None
         if args.snapshot.endswith(".npz"):
-            return None, load_snapshot(args.snapshot)
+            snap = load_snapshot(args.snapshot)
+            # An .npz stores the semantics its arrays were packed with; the
+            # kernel mode must match or the run silently mixes packings.
+            if args.semantics is None:
+                args.semantics = snap.semantics
+            elif args.semantics != snap.semantics:
+                print(
+                    f"ERROR : snapshot {args.snapshot} was packed with "
+                    f"-semantics {snap.semantics}; re-pack from a fixture to "
+                    f"run {args.semantics}"
+                )
+                return None, None
+            return None, snap
+        if args.semantics is None:
+            args.semantics = "reference"
         fixture = load_fixture(args.snapshot)
         return fixture, snapshot_from_fixture(fixture, semantics=args.semantics)
+    if args.semantics is None:
+        args.semantics = "reference"
     try:
         return None, snapshot_from_live_cluster(
             args.kubeconfig or None, semantics=args.semantics
@@ -167,7 +186,29 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
         table_report,
     )
 
-    if args.backend == "cpu":
+    if args.backend == "native":
+        from kubernetesclustercapacity_tpu import native
+
+        try:
+            fits = native.fit_arrays(
+                snapshot.alloc_cpu_milli,
+                snapshot.alloc_mem_bytes,
+                snapshot.alloc_pods,
+                snapshot.used_cpu_req_milli,
+                snapshot.used_mem_req_bytes,
+                snapshot.pods_count,
+                scenario.cpu_request_milli,
+                scenario.mem_request_bytes,
+                mode=args.semantics,
+                healthy=snapshot.healthy,
+            )
+        except native.NativeUnavailable as e:
+            print(f"ERROR : native backend unavailable: {e}")
+            return 1
+        except native.NativePanic as e:
+            print(f"panic: {e}")
+            return 2
+    elif args.backend == "cpu":
         try:
             if fixture is not None and args.semantics == "reference":
                 fits = np.array(

@@ -113,14 +113,20 @@ def _expr_matches(labels: dict, expr: dict) -> bool:
 def node_affinity_mask(
     snapshot: ClusterSnapshot, node_selector_terms: list[dict] | None
 ) -> np.ndarray:
-    """Required node-affinity: terms OR-ed, expressions within a term AND-ed."""
+    """Required node-affinity: terms OR-ed, expressions within a term AND-ed.
+
+    An empty/expressionless term matches NO nodes (kube-scheduler's
+    nodeaffinity helper treats a nil term as selecting nothing — it is not a
+    match-everything wildcard).
+    """
     if not node_selector_terms:
         return np.ones(snapshot.n_nodes, dtype=np.bool_)
     mask = np.zeros(snapshot.n_nodes, dtype=np.bool_)
     for i, labels in enumerate(snapshot.labels):
         labels = labels or {}
         mask[i] = any(
-            all(
+            bool(term.get("matchExpressions"))
+            and all(
                 _expr_matches(labels, e)
                 for e in term.get("matchExpressions", [])
             )

@@ -38,7 +38,7 @@ class PodSpec:
     fields mirror the pod-spec fields kube-scheduler filters on; all are
     optional and default to unconstrained.  ``spread`` caps replicas per node
     (self-anti-affinity over the hostname topology; 1 = classic one-per-node
-    spread, 0/None = unlimited).
+    spread, ``None`` = unlimited; must be >= 1 when set).
     """
 
     cpu_request_milli: int
@@ -52,6 +52,10 @@ class PodSpec:
     affinity_terms: tuple = ()
     anti_affinity_labels: dict = field(default_factory=dict)
     spread: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.spread is not None and self.spread < 1:
+            raise ValueError("spread must be >= 1 (or None for unlimited)")
 
     @classmethod
     def from_scenario(cls, s: Scenario) -> "PodSpec":

@@ -1,0 +1,208 @@
+"""Native CPU backend: ctypes bindings over the C++ capacity library.
+
+Builds ``capacity.cc`` on demand with the system toolchain (``g++`` — no
+pybind11 dependency; plain C ABI + ctypes) into a cached shared object next
+to the source, keyed by source mtime.  The native path is the framework's
+compiled sequential reference — the role the reference's Go binary plays —
+used by the CLI's ``-backend=cpu`` cross-check and by benchmarks comparing
+the TPU kernel against a real compiled CPU loop rather than interpreted
+Python.
+
+All entry points raise :class:`NativeUnavailable` if no C++ toolchain exists;
+callers fall back to the pure-Python oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+__all__ = [
+    "NativeUnavailable",
+    "NativePanic",
+    "available",
+    "cpu_to_milli",
+    "to_bytes",
+    "fit_arrays",
+    "sweep",
+]
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "capacity.cc")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_BUILD_ERROR: str | None = None
+
+_I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+
+
+class NativeUnavailable(RuntimeError):
+    """No toolchain / build failed — use the pure-Python oracle instead."""
+
+
+class NativePanic(RuntimeError):
+    """The native kernel hit the reference's divide-by-zero panic point."""
+
+
+def _build_dir() -> str:
+    d = os.path.join(os.path.dirname(_SRC), "_build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load() -> ctypes.CDLL:
+    global _LIB, _BUILD_ERROR
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _BUILD_ERROR is not None:
+            raise NativeUnavailable(_BUILD_ERROR)
+        so_path = os.path.join(_build_dir(), "libkcccapacity.so")
+        if (
+            not os.path.exists(so_path)
+            or os.path.getmtime(so_path) < os.path.getmtime(_SRC)
+        ):
+            # Build into a temp file then atomically rename, so concurrent
+            # processes never dlopen a half-written object.
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_build_dir())
+            os.close(fd)
+            cmd = [
+                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                "-o", tmp, _SRC, "-lpthread",
+            ]
+            try:
+                subprocess.run(
+                    cmd, check=True, capture_output=True, text=True
+                )
+                os.replace(tmp, so_path)
+            except (OSError, subprocess.CalledProcessError) as e:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                detail = getattr(e, "stderr", "") or str(e)
+                _BUILD_ERROR = f"native build failed: {detail}"
+                raise NativeUnavailable(_BUILD_ERROR) from e
+
+        lib = ctypes.CDLL(so_path)
+        lib.kcc_cpu_to_milli.argtypes = [ctypes.c_char_p]
+        lib.kcc_cpu_to_milli.restype = ctypes.c_uint64
+        lib.kcc_to_bytes.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)
+        ]
+        lib.kcc_to_bytes.restype = ctypes.c_int
+        lib.kcc_fit_arrays.argtypes = [
+            ctypes.c_int64, _I64P, _I64P, _I64P, _I64P, _I64P, _I64P, _U8P,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int, _I64P,
+        ]
+        lib.kcc_fit_arrays.restype = ctypes.c_int
+        lib.kcc_sweep.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, _I64P, _I64P, _I64P, _I64P,
+            _I64P, _I64P, _U8P, _I64P, _I64P, ctypes.c_int, ctypes.c_int,
+            _I64P,
+        ]
+        lib.kcc_sweep.restype = ctypes.c_int
+        _LIB = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def cpu_to_milli(s: str) -> int:
+    """Native ``convertCPUToMilis`` — returns the uint64 value."""
+    return int(_load().kcc_cpu_to_milli(s.encode()))
+
+
+def to_bytes(s: str) -> int:
+    """Native ``bytefmt.ToBytes``; raises ValueError on the reference error."""
+    out = ctypes.c_int64()
+    if _load().kcc_to_bytes(s.encode(), ctypes.byref(out)) != 0:
+        raise ValueError(
+            "byte quantity must be a positive integer with a unit of "
+            "measurement like M, MB, MiB, G, GiB, or GB"
+        )
+    return out.value
+
+
+_MODES = {"reference": 0, "strict": 1}
+
+
+def _prep(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, dtype=np.int64))
+
+
+def fit_arrays(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    cpu_req: int,
+    mem_req: int,
+    *,
+    mode: str = "reference",
+    healthy=None,
+) -> np.ndarray:
+    """Native per-node fits — same signature family as the Python oracle."""
+    lib = _load()
+    alloc_cpu = _prep(alloc_cpu)
+    n = alloc_cpu.shape[0]
+    h = (
+        np.ascontiguousarray(np.asarray(healthy, dtype=np.uint8))
+        if healthy is not None
+        else np.ones(n, dtype=np.uint8)
+    )
+    fits = np.empty(n, dtype=np.int64)
+    rc = lib.kcc_fit_arrays(
+        n, alloc_cpu, _prep(alloc_mem), _prep(alloc_pods), _prep(used_cpu),
+        _prep(used_mem), _prep(pods_count), h,
+        int(cpu_req), int(mem_req), _MODES[mode], fits,
+    )
+    if rc != 0:
+        raise NativePanic("integer divide by zero (ClusterCapacity.go:123/129)")
+    return fits
+
+
+def sweep(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    cpu_reqs,
+    mem_reqs,
+    *,
+    mode: str = "reference",
+    healthy=None,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Native multi-threaded scenario sweep — ``totals[S]``."""
+    lib = _load()
+    alloc_cpu = _prep(alloc_cpu)
+    cpu_reqs = _prep(cpu_reqs)
+    n, s = alloc_cpu.shape[0], cpu_reqs.shape[0]
+    h = (
+        np.ascontiguousarray(np.asarray(healthy, dtype=np.uint8))
+        if healthy is not None
+        else np.ones(n, dtype=np.uint8)
+    )
+    totals = np.empty(s, dtype=np.int64)
+    rc = lib.kcc_sweep(
+        n, s, alloc_cpu, _prep(alloc_mem), _prep(alloc_pods),
+        _prep(used_cpu), _prep(used_mem), _prep(pods_count), h,
+        cpu_reqs, _prep(mem_reqs), _MODES[mode], int(n_threads), totals,
+    )
+    if rc != 0:
+        raise NativePanic("integer divide by zero (ClusterCapacity.go:123/129)")
+    return totals

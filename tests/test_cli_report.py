@@ -107,13 +107,30 @@ class TestCli:
         assert "Total possible replicas for the pod with required input specs : 109" in out
         assert "go ahead with deployment of 10 pod replicas" in out
 
-    def test_backend_cpu_matches_tpu(self, capsys):
-        rc1 = main(["-snapshot", KIND, "-backend", "tpu"])
-        out1 = capsys.readouterr().out
-        rc2 = main(["-snapshot", KIND, "-backend", "cpu"])
-        out2 = capsys.readouterr().out
-        assert rc1 == rc2 == 0
-        assert out1 == out2
+    def test_all_backends_agree(self, capsys):
+        from kubernetesclustercapacity_tpu import native
+
+        backends = ["tpu", "cpu"] + (["native"] if native.available() else [])
+        outs = {}
+        for backend in backends:
+            rc = main(["-snapshot", KIND, "-backend", backend])
+            outs[backend] = capsys.readouterr().out
+            assert rc == 0
+        assert len(set(outs.values())) == 1
+
+    def test_npz_semantics_mismatch_rejected(self, tmp_path, capsys):
+        p = str(tmp_path / "strict.npz")
+        rc = main(["-snapshot", KIND, "-semantics", "strict",
+                   "-save-snapshot", p])
+        capsys.readouterr()
+        assert rc == 0
+        # Stored semantics adopted by default...
+        assert main(["-snapshot", p]) == 0
+        capsys.readouterr()
+        # ...and an explicit conflicting -semantics is an error.
+        rc = main(["-snapshot", p, "-semantics", "reference"])
+        assert rc == 1
+        assert "packed with" in capsys.readouterr().out
 
     def test_bad_mem_flag_exits_1(self, capsys):
         rc = main(["-snapshot", KIND, "-memRequests=garbage"])

@@ -90,6 +90,13 @@ class TestSelectorsAffinity:
             node_affinity_mask(kind_snap, terms), [False, True, True]
         )
 
+    def test_empty_term_matches_nothing(self, kind_snap):
+        # kube-scheduler: a nil/empty nodeSelectorTerm selects NO nodes.
+        assert not node_affinity_mask(kind_snap, [{}]).any()
+        assert not node_affinity_mask(
+            kind_snap, [{"matchExpressions": []}]
+        ).any()
+
     def test_gt_lt(self):
         fx = {"nodes": [
             {"name": "a", "allocatable": {"cpu": "4"}, "labels": {"gen": "3"},
@@ -201,6 +208,10 @@ class TestCapacityModel:
         # snapshot has taints), workers clamp to 1 replica each.
         np.testing.assert_array_equal(r.fits, [0, 1, 1])
         assert r.schedulable
+
+    def test_spread_zero_rejected(self):
+        with pytest.raises(ValueError, match="spread"):
+            PodSpec(cpu_request_milli=100, mem_request_bytes=MIB, spread=0)
 
     def test_spread_with_toleration_covers_all_nodes(self, kind_snap):
         model = CapacityModel(kind_snap, mode="strict")

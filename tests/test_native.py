@@ -1,0 +1,133 @@
+"""Native C++ backend parity tests (skipped if no toolchain)."""
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu import native
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+from kubernetesclustercapacity_tpu.utils.quantity import (
+    QuantityParseError,
+    cpu_to_milli_reference,
+    to_bytes_reference,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain"
+)
+
+MIB = 1024 * 1024
+
+
+class TestNativeCodecs:
+    @pytest.mark.parametrize(
+        "s",
+        ["100m", "250m", "2", "4", "0", "+3", "-5", "-5m", "0.5", "", "m",
+         "5mm", "100Mi", "1e2", str(2**63), str(2**63 - 1), "9" * 30],
+    )
+    def test_cpu_codec_parity(self, s):
+        assert native.cpu_to_milli(s) == cpu_to_milli_reference(s)
+
+    @pytest.mark.parametrize(
+        "s",
+        ["100mb", "100MB", "100Mi", "1k", "3500Ki", "2g", "1T", "5B",
+         "  250mb  ", "0.5M", "1.5K", "9400000T"],
+    )
+    def test_byte_codec_parity_valid(self, s):
+        assert native.to_bytes(s) == to_bytes_reference(s)
+
+    @pytest.mark.parametrize(
+        "s",
+        ["16Gi", "1Ti", "1073741824", "0Ki", "-5M", "", "MB", "1XB",
+         "2 GB", "9" * 400 + "M"],
+    )
+    def test_byte_codec_parity_invalid(self, s):
+        with pytest.raises(ValueError):
+            native.to_bytes(s)
+        with pytest.raises(QuantityParseError):
+            to_bytes_reference(s)
+
+
+class TestNativeKernelParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    def test_fuzz_vs_python_oracle(self, seed, mode):
+        rng = np.random.default_rng(seed)
+        n = 311
+
+        def mixed(lo, hi):
+            vals = rng.integers(lo, hi, size=n, dtype=np.int64)
+            hostile = rng.random(n) < 0.1
+            return np.where(
+                hostile,
+                rng.integers(-(2**62), 2**62, size=n, dtype=np.int64),
+                vals,
+            )
+
+        alloc_cpu = mixed(0, 10**6)
+        used_cpu = mixed(0, 10**6)
+        alloc_mem = mixed(0, 2**45)
+        used_mem = mixed(0, 2**45)
+        alloc_pods = rng.integers(0, 200, size=n, dtype=np.int64)
+        pods_count = rng.integers(0, 300, size=n, dtype=np.int64)
+        healthy = rng.random(n) > 0.2
+
+        for cpu_req, mem_req in [(100, MIB), (1, 1), (123457, 987654321)]:
+            expected = fit_arrays_python(
+                alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+                pods_count, cpu_req, mem_req, mode=mode, healthy=healthy,
+            )
+            got = native.fit_arrays(
+                alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+                pods_count, cpu_req, mem_req, mode=mode, healthy=healthy,
+            )
+            np.testing.assert_array_equal(got, expected)
+
+    def test_int64_min_headroom(self):
+        got = native.fit_arrays(
+            np.array([10_000]), np.array([0]), np.array([10**12]),
+            np.array([0]), np.array([-(2**63)]), np.array([0]), 100, 3,
+        )
+        expected = fit_arrays_python(
+            [10_000], [0], [10**12], [0], [-(2**63)], [0], 100, 3)
+        np.testing.assert_array_equal(got, expected)
+
+    def test_zero_divisor_panics(self):
+        with pytest.raises(native.NativePanic):
+            native.fit_arrays(
+                np.array([8000]), np.array([2**30]), np.array([110]),
+                np.array([0]), np.array([0]), np.array([0]), 0, MIB,
+            )
+
+    def test_sweep_matches_fit_arrays(self):
+        from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+        snap = synthetic_snapshot(200, seed=31)
+        cpu_reqs = np.array([100, 250, 1000, 137], dtype=np.int64)
+        mem_reqs = np.array([MIB, 250 * MIB, 7 * MIB + 13, MIB], dtype=np.int64)
+        totals = native.sweep(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, cpu_reqs, mem_reqs, n_threads=3,
+        )
+        for j in range(4):
+            fits = native.fit_arrays(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+                snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+                snap.pods_count, int(cpu_reqs[j]), int(mem_reqs[j]),
+            )
+            assert totals[j] == fits.sum()
+
+    def test_sweep_matches_jax_kernel(self):
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+        from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
+        from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+        snap = synthetic_snapshot(500, seed=33)
+        grid = random_scenario_grid(64, seed=34)
+        jax_totals, _ = sweep_snapshot(snap, grid)
+        native_totals = native.sweep(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, grid.cpu_request_milli, grid.mem_request_bytes,
+        )
+        np.testing.assert_array_equal(native_totals, jax_totals)
