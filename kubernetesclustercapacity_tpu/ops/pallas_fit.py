@@ -1,0 +1,262 @@
+"""Pallas TPU fast path: fused int32 fit + reduction for eligible sweeps.
+
+Why this exists: the exact kernel (:mod:`.fit`) is int64 because memory is
+tracked in bytes (node memory ≈ 2^34), and TPUs emulate int64 with 32-bit
+pairs — every subtract/compare/divide costs multiple VPU ops.  But kubelets
+report memory in ``Ki`` and realistic pod requests are MiB-granular, so on
+real snapshots every memory quantity is a multiple of 1024.  Under that
+precondition (checked, never assumed) the whole fit is exact in int32:
+
+    (alloc − used) // req  ==  ((alloc/1024) − (used/1024)) // (req/1024)
+
+when all three are multiples of 1024 — the rescale is a bijection on the
+eligible domain, so the fast path is bit-exact, not approximate.
+
+The Pallas kernel fuses the whole sweep: each grid step loads a
+``(node-tile)`` slab of the six snapshot arrays into VMEM, evaluates a
+``(scenario-tile × node-tile)`` block of fits on the VPU, reduces over the
+node axis in-register, and accumulates ``(scenario-tile, 128)`` partial sums
+— the ``[S, N]`` fit matrix never exists in HBM.  Layout: node arrays are
+reshaped to ``(N/128, 128)`` lanes; scenario requests ride as ``(S, 1)``
+columns; the final 128-lane reduction happens outside the kernel (an ``[S,
+128] → [S]`` sum, negligible).
+
+Eligibility (:func:`fast_sweep_eligible`) requires every value non-negative,
+int32-range after rescale, and KiB-quantized memory.  Ineligible inputs fall
+back to the exact int64 path; :func:`sweep_auto` picks automatically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
+
+__all__ = [
+    "fast_sweep_eligible",
+    "sweep_pallas",
+    "sweep_auto",
+]
+
+LANES = 128
+# Node tile: 16 sublanes x 128 lanes = 2048 nodes per step; scenario tile 256.
+NODE_TILE_ROWS = 16
+SCENARIO_TILE = 256
+
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def fast_sweep_eligible(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    cpu_reqs,
+    mem_reqs,
+) -> bool:
+    """True iff the int32 KiB-rescaled kernel is bit-exact for these inputs.
+
+    Three conditions, all checked — never assumed:
+
+    1. every value non-negative and int32-range (memory after /1024), with
+       memory KiB-quantized (the rescale bijection);
+    2. every request strictly positive (the fast kernel divides without the
+       exact kernel's divisor clamp; zero requests are invalid upstream but
+       must not become undefined behavior here);
+    3. the worst-case per-scenario TOTAL fits in int32: per node the fit is
+       bounded by ``max(alloc_cpu // min_cpu_req, alloc_pods, pods_count)``
+       (resource bound, the Q1 cap value, and its negative magnitude), and
+       the kernel accumulates totals in int32 lanes — so the sum of those
+       bounds must stay under 2^31.
+    """
+    for a in (alloc_cpu, used_cpu, cpu_reqs, alloc_pods, pods_count):
+        a = np.asarray(a)
+        if a.size and (a.min() < 0 or a.max() > _I32_MAX):
+            return False
+    for a in (alloc_mem, used_mem, mem_reqs):
+        a = np.asarray(a)
+        if a.size == 0:
+            continue
+        if a.min() < 0 or (a % 1024).any() or (a // 1024).max() > _I32_MAX:
+            return False
+    cpu_reqs = np.asarray(cpu_reqs)
+    mem_reqs = np.asarray(mem_reqs)
+    if cpu_reqs.size == 0 or mem_reqs.size == 0:
+        return True
+    if cpu_reqs.min() < 1 or mem_reqs.min() < 1024:
+        return False
+    per_node_bound = np.maximum(
+        np.asarray(alloc_cpu, dtype=np.int64) // int(cpu_reqs.min()),
+        np.maximum(
+            np.asarray(alloc_pods, dtype=np.int64),
+            np.asarray(pods_count, dtype=np.int64),
+        ),
+    )
+    return int(per_node_bound.sum()) <= _I32_MAX
+
+
+def _fit_block(ac, am, ap, uc, um, pc, cr, mr):
+    """Reference-semantics fit on an int32 tile.
+
+    ``ac..pc`` are ``(ROWS, LANES)`` node tiles, ``cr``/``mr`` are
+    ``(BS, 1, 1)`` scenario requests; returns ``(BS, ROWS, LANES)`` fits.
+    In the eligible domain (non-negative int32) Go's uint64/int64 semantics
+    and int32 semantics coincide, including the conditional pod-cap
+    overwrite (which may go negative — int32 handles that fine).
+    """
+    cpu_fit = jnp.where(ac <= uc, 0, (ac - uc)[None] // cr)
+    mem_fit = jnp.where(am <= um, 0, (am - um)[None] // mr)
+    fit = jnp.minimum(cpu_fit, mem_fit)
+    return jnp.where(fit >= ap, (ap - pc)[None] + jnp.zeros_like(fit), fit)
+
+
+def _sweep_kernel(ac, am, ap, uc, um, pc, cr, mr, out):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out[...] = jnp.zeros_like(out)
+
+    fits = _fit_block(
+        ac[...], am[...], ap[...], uc[...], um[...], pc[...],
+        cr[...][:, :, None], mr[...][:, :, None],
+    )  # (BS, ROWS, LANES) int32
+    out[...] += jnp.sum(fits, axis=1)  # accumulate (BS, LANES)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _sweep_pallas_padded(ac, am, ap, uc, um, pc, cr, mr, *, interpret=False):
+    """Inner jitted pallas sweep on padded arrays.
+
+    ``ac..pc``: ``(N/128, 128)`` int32 node arrays; ``cr``/``mr``: ``(S, 1)``
+    int32 requests; returns int64 ``totals[S]``.
+    """
+    n_rows = ac.shape[0]
+    s = cr.shape[0]
+    grid = (s // SCENARIO_TILE, n_rows // NODE_TILE_ROWS)
+
+    node_spec = pl.BlockSpec(
+        (NODE_TILE_ROWS, LANES),
+        lambda i, j: (j, 0),
+        memory_space=pltpu.VMEM,
+    )
+    scen_spec = pl.BlockSpec(
+        (SCENARIO_TILE, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+    )
+    out_spec = pl.BlockSpec(
+        (SCENARIO_TILE, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+    )
+
+    partial_sums = pl.pallas_call(
+        _sweep_kernel,
+        out_shape=jax.ShapeDtypeStruct((s, LANES), jnp.int32),
+        grid=grid,
+        in_specs=[node_spec] * 6 + [scen_spec] * 2,
+        out_specs=out_spec,
+        interpret=interpret,
+    )(ac, am, ap, uc, um, pc, cr, mr)
+    return jnp.sum(partial_sums.astype(jnp.int64), axis=1)
+
+
+def _pad_to(x: np.ndarray, size: int, fill=0) -> np.ndarray:
+    pad = size - x.shape[0]
+    return np.pad(x, (0, pad), constant_values=fill) if pad else x
+
+
+def sweep_pallas(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    interpret: bool = False,
+):
+    """Fused Pallas sweep (reference semantics). Caller must check eligibility.
+
+    Padding: nodes pad with zero rows (fit 0 — ``0 >= alloc_pods 0`` rewrites
+    to ``0 − 0``); scenarios pad with ``(1, 1)`` probes whose outputs are
+    dropped.  Returns ``(totals[S], schedulable[S])`` numpy arrays.
+    """
+    n = np.asarray(alloc_cpu).shape[0]
+    s = np.asarray(cpu_reqs).shape[0]
+    node_block = NODE_TILE_ROWS * LANES
+    n_pad = -(-max(n, 1) // node_block) * node_block
+    s_pad = -(-max(s, 1) // SCENARIO_TILE) * SCENARIO_TILE
+
+    def node32(a, kib=False):
+        a = np.asarray(a, dtype=np.int64)
+        if kib:
+            a = a // 1024
+        return (
+            _pad_to(a.astype(np.int32), n_pad).reshape(n_pad // LANES, LANES)
+        )
+
+    def scen32(a, kib=False):
+        a = np.asarray(a, dtype=np.int64)
+        if kib:
+            a = a // 1024
+        return _pad_to(a.astype(np.int32), s_pad, fill=1).reshape(s_pad, 1)
+
+    totals = _sweep_pallas_padded(
+        node32(alloc_cpu),
+        node32(alloc_mem, kib=True),
+        node32(alloc_pods),
+        node32(used_cpu),
+        node32(used_mem, kib=True),
+        node32(pods_count),
+        scen32(cpu_reqs),
+        scen32(mem_reqs, kib=True),
+        interpret=interpret,
+    )
+    totals = np.asarray(totals)[:s]
+    schedulable = totals >= np.asarray(replicas, dtype=np.int64)
+    return totals, schedulable
+
+
+def sweep_auto(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    interpret: bool = False,
+):
+    """Fast path when eligible, exact int64 path otherwise — always bit-exact.
+
+    Reference semantics only (the fast path exists for the headline sweep;
+    strict mode goes through the exact kernel).  Returns numpy
+    ``(totals[S], schedulable[S], used_fast_path)``.
+    """
+    if fast_sweep_eligible(
+        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
+        cpu_reqs, mem_reqs,
+    ):
+        totals, sched = sweep_pallas(
+            alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
+            cpu_reqs, mem_reqs, replicas, interpret=interpret,
+        )
+        return totals, sched, True
+    totals, sched = sweep_grid(
+        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
+        healthy, cpu_reqs, mem_reqs, replicas, mode="reference",
+    )
+    return np.asarray(totals), np.asarray(sched), False

@@ -1,0 +1,151 @@
+"""Pallas fast-path tests (interpret mode on CPU; real TPU covered by bench)."""
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+    fast_sweep_eligible,
+    sweep_auto,
+    sweep_pallas,
+)
+from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+MIB = 1024 * 1024
+
+
+def _args(snap):
+    return (
+        snap.alloc_cpu_milli,
+        snap.alloc_mem_bytes,
+        snap.alloc_pods,
+        snap.used_cpu_req_milli,
+        snap.used_mem_req_bytes,
+        snap.pods_count,
+    )
+
+
+class TestEligibility:
+    def test_kib_quantized_snapshot_eligible(self):
+        snap = synthetic_snapshot(100, seed=1)
+        grid = random_scenario_grid(10, seed=2)
+        assert fast_sweep_eligible(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes
+        )
+
+    def test_unquantized_memory_ineligible(self):
+        snap = synthetic_snapshot(100, seed=1, kib_quantized=False)
+        grid = random_scenario_grid(10, seed=2)
+        assert not fast_sweep_eligible(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes
+        )
+
+    def test_negative_values_ineligible(self):
+        snap = synthetic_snapshot(10, seed=1)
+        args = list(_args(snap))
+        args[3] = args[3].copy()
+        args[3][0] = -1  # wrapped uint64 bit pattern
+        grid = random_scenario_grid(4, seed=2)
+        assert not fast_sweep_eligible(
+            *args, grid.cpu_request_milli, grid.mem_request_bytes
+        )
+
+    def test_zero_request_ineligible(self):
+        snap = synthetic_snapshot(10, seed=1)
+        cpu = np.array([100, 0], dtype=np.int64)
+        mem = np.array([MIB, MIB], dtype=np.int64)
+        assert not fast_sweep_eligible(*_args(snap), cpu, mem)
+        # mem_req of 0 passes the KiB-quantization check but not positivity.
+        assert not fast_sweep_eligible(
+            *_args(snap), np.array([100]), np.array([0])
+        )
+
+    def test_total_overflow_ineligible(self):
+        # Individual values fit int32, but the worst-case per-scenario total
+        # (sum over nodes of alloc_cpu // min_req) would wrap the int32
+        # accumulator lanes.
+        snap = synthetic_snapshot(4, seed=1)
+        args = list(_args(snap))
+        args[0] = np.full(4, 2_000_000_000, dtype=np.int64)  # 2e9 milli each
+        cpu = np.array([1], dtype=np.int64)
+        mem = np.array([MIB], dtype=np.int64)
+        assert not fast_sweep_eligible(*args, cpu, mem)
+        # The auto dispatcher then takes the exact path and stays correct.
+        from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+        snap_big = synthetic_snapshot(4, seed=1)
+        snap_big.alloc_cpu_milli[:] = 2_000_000_000
+        totals, _, fast = sweep_auto(
+            snap_big.alloc_cpu_milli, snap_big.alloc_mem_bytes,
+            snap_big.alloc_pods, snap_big.used_cpu_req_milli,
+            snap_big.used_mem_req_bytes, snap_big.pods_count,
+            snap_big.healthy, cpu, mem, np.array([1]), interpret=True,
+        )
+        assert not fast
+        exact, _ = sweep_snapshot(snap_big, __import__(
+            "kubernetesclustercapacity_tpu.scenario", fromlist=["ScenarioGrid"]
+        ).ScenarioGrid(cpu, mem, np.array([1])))
+        np.testing.assert_array_equal(totals, exact)
+
+    def test_out_of_i32_range_ineligible(self):
+        snap = synthetic_snapshot(10, seed=1)
+        args = list(_args(snap))
+        args[1] = args[1].copy()
+        args[1][0] = (2**32) * 1024  # 4 TiB: KiB value overflows int32
+        grid = random_scenario_grid(4, seed=2)
+        assert not fast_sweep_eligible(
+            *args, grid.cpu_request_milli, grid.mem_request_bytes
+        )
+
+
+class TestPallasParity:
+    @pytest.mark.parametrize("n,s", [(100, 10), (2048, 256), (2049, 257),
+                                     (5000, 33)])
+    def test_matches_exact_kernel(self, n, s):
+        snap = synthetic_snapshot(n, seed=n, mean_utilization=0.5)
+        grid = random_scenario_grid(s, seed=s)
+        exact_totals, exact_sched = sweep_snapshot(snap, grid)
+        totals, sched = sweep_pallas(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, interpret=True,
+        )
+        np.testing.assert_array_equal(totals, exact_totals)
+        np.testing.assert_array_equal(sched, exact_sched)
+
+    def test_pod_cap_negative_fits_preserved(self):
+        # Nodes whose pod budget is exhausted produce negative fits via the
+        # Q1 overwrite; the fast path must reproduce them.
+        snap = synthetic_snapshot(200, seed=5, alloc_pods=3)
+        snap.pods_count[:] = 7  # 3 - 7 = -4 whenever the cap triggers
+        grid = random_scenario_grid(8, seed=6)
+        exact_totals, _ = sweep_snapshot(snap, grid)
+        totals, _ = sweep_pallas(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, interpret=True,
+        )
+        assert (totals < 0).any()
+        np.testing.assert_array_equal(totals, exact_totals)
+
+
+class TestAuto:
+    def test_auto_uses_fast_when_eligible(self):
+        snap = synthetic_snapshot(300, seed=9)
+        grid = random_scenario_grid(16, seed=10)
+        totals, sched, fast = sweep_auto(
+            *_args(snap), snap.healthy, grid.cpu_request_milli,
+            grid.mem_request_bytes, grid.replicas, interpret=True,
+        )
+        assert fast
+        exact_totals, _ = sweep_snapshot(snap, grid)
+        np.testing.assert_array_equal(totals, exact_totals)
+
+    def test_auto_falls_back_when_ineligible(self):
+        snap = synthetic_snapshot(300, seed=9, kib_quantized=False)
+        grid = random_scenario_grid(16, seed=10)
+        totals, sched, fast = sweep_auto(
+            *_args(snap), snap.healthy, grid.cpu_request_milli,
+            grid.mem_request_bytes, grid.replicas, interpret=True,
+        )
+        assert not fast
+        exact_totals, _ = sweep_snapshot(snap, grid)
+        np.testing.assert_array_equal(totals, exact_totals)
