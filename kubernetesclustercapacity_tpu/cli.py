@@ -132,35 +132,23 @@ def main(argv: list[str] | None = None) -> int:
 
 def _load_source(args):
     """Resolve the cluster source: fixture JSON, npz checkpoint, or live."""
-    from kubernetesclustercapacity_tpu.fixtures import load_fixture
-    from kubernetesclustercapacity_tpu.snapshot import (
-        load_snapshot,
-        snapshot_from_fixture,
-        snapshot_from_live_cluster,
-    )
+    from kubernetesclustercapacity_tpu.snapshot import snapshot_from_live_cluster
 
     if args.snapshot:
-        if not os.path.exists(args.snapshot):
-            print(f"ERROR : snapshot file not found: {args.snapshot}")
+        from kubernetesclustercapacity_tpu.sources import (
+            SourceError,
+            resolve_source,
+        )
+
+        try:
+            fixture, snap, semantics = resolve_source(
+                args.snapshot, args.semantics
+            )
+        except SourceError as e:
+            print(f"ERROR : {e}")
             return None, None
-        if args.snapshot.endswith(".npz"):
-            snap = load_snapshot(args.snapshot)
-            # An .npz stores the semantics its arrays were packed with; the
-            # kernel mode must match or the run silently mixes packings.
-            if args.semantics is None:
-                args.semantics = snap.semantics
-            elif args.semantics != snap.semantics:
-                print(
-                    f"ERROR : snapshot {args.snapshot} was packed with "
-                    f"-semantics {snap.semantics}; re-pack from a fixture to "
-                    f"run {args.semantics}"
-                )
-                return None, None
-            return None, snap
-        if args.semantics is None:
-            args.semantics = "reference"
-        fixture = load_fixture(args.snapshot)
-        return fixture, snapshot_from_fixture(fixture, semantics=args.semantics)
+        args.semantics = semantics
+        return fixture, snap
     if args.semantics is None:
         args.semantics = "reference"
     try:

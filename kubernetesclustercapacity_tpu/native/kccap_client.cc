@@ -1,0 +1,250 @@
+// kccap-client: compiled front-end CLI for the capacity service.
+//
+// The north-star boundary is "thin compiled front-end -> RPC -> Python/JAX
+// service".  This is that front-end: it mirrors the reference CLI's six
+// flags (same names, same defaults — src/KubeAPI/ClusterCapacity.go:50-62),
+// frames a `fit` request in the service's length-prefixed JSON protocol,
+// and prints the server-rendered report verbatim (all semantics, parsing
+// included, live server-side so the two front-ends can never drift).
+//
+// Build:  g++ -O2 -std=c++17 -o kccap-client kccap_client.cc
+// Usage:  kccap-client -server 127.0.0.1:7077 -cpuRequests=200m \
+//         -memRequests=250mb -replicas=10 [-output reference|json|table]
+//
+// Protocol frame: 4-byte big-endian length + UTF-8 JSON
+// (see service/protocol.py).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+static std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+// Extract and unescape a top-level string field from a JSON object.  The
+// server controls the wire format (json.dumps), so a targeted scan is safe:
+// find `"<key>": "` then unescape until the closing unescaped quote.
+static bool json_get_string(const std::string& doc, const std::string& key,
+                            std::string* out) {
+  std::string needle = "\"" + key + "\": \"";
+  size_t p = doc.find(needle);
+  if (p == std::string::npos) {
+    needle = "\"" + key + "\":\"";
+    p = doc.find(needle);
+    if (p == std::string::npos) return false;
+  }
+  p += needle.size();
+  std::string result;
+  while (p < doc.size()) {
+    char c = doc[p];
+    if (c == '"') {
+      *out = result;
+      return true;
+    }
+    if (c == '\\' && p + 1 < doc.size()) {
+      char e = doc[++p];
+      switch (e) {
+        case 'n': result += '\n'; break;
+        case 't': result += '\t'; break;
+        case 'r': result += '\r'; break;
+        case '"': result += '"'; break;
+        case '\\': result += '\\'; break;
+        case '/': result += '/'; break;
+        case 'u': {
+          if (p + 4 >= doc.size()) return false;  // truncated escape
+          unsigned code = 0;
+          if (sscanf(doc.c_str() + p + 1, "%4x", &code) != 1) return false;
+          p += 4;
+          // Combine UTF-16 surrogate pairs (json.dumps emits them for
+          // non-BMP characters under ensure_ascii).
+          if (code >= 0xD800 && code <= 0xDBFF) {
+            if (p + 6 >= doc.size() || doc[p + 1] != '\\' || doc[p + 2] != 'u')
+              return false;
+            unsigned low = 0;
+            if (sscanf(doc.c_str() + p + 3, "%4x", &low) != 1) return false;
+            if (low < 0xDC00 || low > 0xDFFF) return false;
+            p += 6;
+            code = 0x10000 + ((code - 0xD800) << 10) + (low - 0xDC00);
+          }
+          if (code < 0x80) {
+            result += (char)code;
+          } else if (code < 0x800) {  // 2-byte UTF-8
+            result += (char)(0xC0 | (code >> 6));
+            result += (char)(0x80 | (code & 0x3F));
+          } else if (code < 0x10000) {  // 3-byte UTF-8
+            result += (char)(0xE0 | (code >> 12));
+            result += (char)(0x80 | ((code >> 6) & 0x3F));
+            result += (char)(0x80 | (code & 0x3F));
+          } else {  // 4-byte UTF-8
+            result += (char)(0xF0 | (code >> 18));
+            result += (char)(0x80 | ((code >> 12) & 0x3F));
+            result += (char)(0x80 | ((code >> 6) & 0x3F));
+            result += (char)(0x80 | (code & 0x3F));
+          }
+          break;
+        }
+        default: result += e;
+      }
+    } else {
+      result += c;
+    }
+    p++;
+  }
+  return false;
+}
+
+static bool send_all(int fd, const char* buf, size_t n) {
+  while (n) {
+    ssize_t w = write(fd, buf, n);
+    if (w <= 0) return false;
+    buf += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+static bool recv_all(int fd, char* buf, size_t n) {
+  while (n) {
+    ssize_t r = read(fd, buf, n);
+    if (r <= 0) return false;
+    buf += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+int main(int argc, char** argv) {
+  std::string server = "127.0.0.1:7077";
+  // Reference flag defaults (ClusterCapacity.go:57-61).
+  std::string cpuRequests = "100m", cpuLimits = "200m";
+  std::string memRequests = "100mb", memLimits = "200mb";
+  std::string replicas = "1", output = "reference";
+
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto value = [&](const std::string& flag, std::string* dst) -> bool {
+      if (a.rfind(flag + "=", 0) == 0) {
+        *dst = a.substr(flag.size() + 1);
+        return true;
+      }
+      if (a == flag && i + 1 < argc) {
+        *dst = argv[++i];
+        return true;
+      }
+      return false;
+    };
+    if (value("-server", &server) || value("-cpuRequests", &cpuRequests) ||
+        value("-cpuLimits", &cpuLimits) || value("-memRequests", &memRequests) ||
+        value("-memLimits", &memLimits) || value("-replicas", &replicas) ||
+        value("-output", &output))
+      continue;
+    if (a == "-h" || a == "-help" || a == "--help") {
+      fprintf(stderr,
+              "usage: kccap-client [-server host:port] [-cpuRequests v] "
+              "[-cpuLimits v] [-memRequests v] [-memLimits v] [-replicas n] "
+              "[-output reference|json|table]\n");
+      return 0;
+    }
+    fprintf(stderr, "unknown flag: %s\n", a.c_str());
+    return 1;
+  }
+
+  size_t colon = server.rfind(':');
+  if (colon == std::string::npos) {
+    fprintf(stderr, "ERROR : -server must be host:port\n");
+    return 1;
+  }
+  std::string host = server.substr(0, colon);
+  std::string port = server.substr(colon + 1);
+
+  struct addrinfo hints = {}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res) {
+    fprintf(stderr, "ERROR : cannot resolve %s\n", server.c_str());
+    return 1;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    fprintf(stderr, "ERROR : cannot connect to capacity service at %s\n",
+            server.c_str());
+    freeaddrinfo(res);
+    return 1;
+  }
+  freeaddrinfo(res);
+
+  std::string body = std::string("{\"op\":\"fit\"") +
+      ",\"cpuRequests\":\"" + json_escape(cpuRequests) + "\"" +
+      ",\"cpuLimits\":\"" + json_escape(cpuLimits) + "\"" +
+      ",\"memRequests\":\"" + json_escape(memRequests) + "\"" +
+      ",\"memLimits\":\"" + json_escape(memLimits) + "\"" +
+      ",\"replicas\":\"" + json_escape(replicas) + "\"" +
+      ",\"output\":\"" + json_escape(output) + "\"}";
+  uint32_t len = htonl((uint32_t)body.size());
+  if (!send_all(fd, (const char*)&len, 4) ||
+      !send_all(fd, body.data(), body.size())) {
+    fprintf(stderr, "ERROR : send failed\n");
+    return 1;
+  }
+
+  uint32_t resp_len_be = 0;
+  if (!recv_all(fd, (char*)&resp_len_be, 4)) {
+    fprintf(stderr, "ERROR : no response\n");
+    return 1;
+  }
+  uint32_t resp_len = ntohl(resp_len_be);
+  if (resp_len > (64u << 20)) {
+    fprintf(stderr, "ERROR : oversized response\n");
+    return 1;
+  }
+  std::string resp(resp_len, '\0');
+  if (!recv_all(fd, resp.data(), resp_len)) {
+    fprintf(stderr, "ERROR : truncated response\n");
+    return 1;
+  }
+  close(fd);
+
+  if (resp.find("\"ok\": true") == std::string::npos &&
+      resp.find("\"ok\":true") == std::string::npos) {
+    std::string err;
+    if (json_get_string(resp, "error", &err))
+      fprintf(stderr, "ERROR : %s\n", err.c_str());
+    else
+      fprintf(stderr, "ERROR : %s\n", resp.c_str());
+    return 1;
+  }
+
+  std::string report;
+  if (json_get_string(resp, "report", &report)) {
+    fputs(report.c_str(), stdout);
+  } else {
+    fputs(resp.c_str(), stdout);  // json/table outputs arrive pre-rendered too
+    fputc('\n', stdout);
+  }
+  return 0;
+}

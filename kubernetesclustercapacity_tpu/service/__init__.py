@@ -1,0 +1,19 @@
+"""Service boundary: front-end ↔ accelerator-resident capacity service.
+
+The north-star architecture (BASELINE.json) is a thin compiled front-end CLI
+talking to a long-lived Python/JAX service that holds the snapshot
+device-resident — so interactive queries never pay process startup, JAX
+import, or compile time.  This package implements that boundary as a
+length-prefixed-JSON protocol over TCP:
+
+* :mod:`.protocol` — framing + request/response schema;
+* :mod:`.server`   — threaded TCP server dispatching to the kernels;
+* :mod:`.client`   — Python client;
+* ``native/kccap_client.cc`` — the compiled front-end CLI (C++; the
+  environment has no Go toolchain or grpcio, so the "Go → gRPC" leg of the
+  north-star is realized as "C++ → framed JSON" with identical shape: flag
+  parsing in the native front-end, all semantics server-side).
+"""
+
+from kubernetesclustercapacity_tpu.service.client import CapacityClient  # noqa: F401
+from kubernetesclustercapacity_tpu.service.server import CapacityServer  # noqa: F401

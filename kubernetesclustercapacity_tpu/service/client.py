@@ -1,0 +1,50 @@
+"""Python client for the capacity service (same protocol as the C++ CLI)."""
+
+from __future__ import annotations
+
+import socket
+
+from kubernetesclustercapacity_tpu.service import protocol
+
+__all__ = ["CapacityClient"]
+
+
+class CapacityClient:
+    """Connect once, issue many requests (context-manager friendly)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7077) -> None:
+        self._sock = socket.create_connection((host, port))
+
+    def __enter__(self) -> "CapacityClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def call(self, op: str, **params):
+        protocol.send_msg(self._sock, {"op": op, **params})
+        resp = protocol.recv_msg(self._sock)
+        if resp is None:
+            raise protocol.ProtocolError("server closed connection")
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", "unknown server error"))
+        return resp["result"]
+
+    # Convenience wrappers -------------------------------------------------
+    def ping(self) -> str:
+        return self.call("ping")
+
+    def info(self) -> dict:
+        return self.call("info")
+
+    def fit(self, **flags) -> dict:
+        return self.call("fit", **flags)
+
+    def sweep(self, **params) -> dict:
+        return self.call("sweep", **params)
+
+    def reload(self, path: str, **params) -> dict:
+        return self.call("reload", path=path, **params)

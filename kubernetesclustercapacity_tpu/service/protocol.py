@@ -1,0 +1,76 @@
+"""Wire protocol: 4-byte big-endian length prefix + UTF-8 JSON body.
+
+Requests are JSON objects with an ``"op"`` field:
+
+=========  ==========================================================
+op         params
+=========  ==========================================================
+ping       —
+info       —
+fit        ``cpuRequests``/``cpuLimits``/``memRequests``/``memLimits``/
+           ``replicas`` (flag STRINGS, parsed server-side with exact
+           reference semantics), optional ``output`` (``reference`` |
+           ``json`` | ``table``)
+sweep      ``cpu_request_milli``/``mem_request_bytes``/``replicas``
+           (numeric arrays) OR ``random: {n, seed}``
+reload     ``path`` — swap the served snapshot (fixture .json or .npz)
+=========  ==========================================================
+
+Responses: ``{"ok": true, "result": ...}`` or ``{"ok": false, "error": "..."}``.
+Maximum frame size 64 MiB (a 10k-node JSON report is ~3 MB).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+__all__ = ["send_msg", "recv_msg", "MAX_FRAME", "ProtocolError"]
+
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class ProtocolError(RuntimeError):
+    pass
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    body = json.dumps(obj).encode()
+    if len(body) > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {len(body)}")
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ProtocolError("connection closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> dict | None:
+    """Read one frame; None on clean EOF at a frame boundary."""
+    try:
+        header = sock.recv(4)
+    except ConnectionResetError:
+        return None
+    if not header:
+        return None
+    while len(header) < 4:
+        more = sock.recv(4 - len(header))
+        if not more:
+            raise ProtocolError("connection closed mid-header")
+        header += more
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {length}")
+    body = _recv_exact(sock, length)
+    try:
+        return json.loads(body)
+    except ValueError as e:  # malformed/empty body is a protocol error
+        raise ProtocolError(f"invalid JSON frame: {e}") from e

@@ -1,0 +1,257 @@
+"""The capacity service: a long-lived server holding the snapshot on device.
+
+One server serves one cluster snapshot (reloadable).  Query cost is a single
+jitted kernel dispatch — the snapshot arrays stay device-resident between
+requests, which is the whole point of the service boundary: the reference
+re-walks the apiserver on every invocation (SURVEY.md §3.4); here a
+front-end query is ~1 ms of kernel time.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+
+import numpy as np
+
+from kubernetesclustercapacity_tpu.oracle import reference_run
+from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+from kubernetesclustercapacity_tpu.report import (
+    json_report,
+    reference_report,
+    table_report,
+)
+from kubernetesclustercapacity_tpu.scenario import (
+    ScenarioError,
+    ScenarioGrid,
+    random_scenario_grid,
+    scenario_from_flags,
+)
+from kubernetesclustercapacity_tpu.service import protocol
+from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+from kubernetesclustercapacity_tpu.sources import resolve_source
+
+__all__ = ["CapacityServer"]
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:  # one connection, many frames
+        server: "CapacityServer" = self.server.capacity_server  # type: ignore[attr-defined]
+        while True:
+            try:
+                msg = protocol.recv_msg(self.request)
+            except protocol.ProtocolError:
+                return
+            if msg is None:
+                return
+            try:
+                result = server.dispatch(msg)
+                protocol.send_msg(self.request, {"ok": True, "result": result})
+            except Exception as e:  # noqa: BLE001 - service boundary
+                protocol.send_msg(
+                    self.request, {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                )
+
+
+class _ThreadingServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class CapacityServer:
+    """Serve capacity queries for one snapshot over the framed-JSON protocol."""
+
+    def __init__(
+        self,
+        snapshot: ClusterSnapshot,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        fixture: dict | None = None,
+    ) -> None:
+        self.snapshot = snapshot
+        self.fixture = fixture
+        self._lock = threading.Lock()
+        self._tcp = _ThreadingServer((host, port), _Handler)
+        self._tcp.capacity_server = self  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._tcp.server_address  # type: ignore[return-value]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._tcp.serve_forever()
+
+    def shutdown(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # -- dispatch ----------------------------------------------------------
+    def dispatch(self, msg: dict) -> dict | str:
+        op = msg.get("op")
+        if op == "ping":
+            return "pong"
+        # Snapshot the (snapshot, fixture) pair once under the lock so a
+        # concurrent reload can never produce a torn read (fits computed on
+        # the new snapshot, report rendered against the old one).
+        with self._lock:
+            snap, fixture = self.snapshot, self.fixture
+        if op == "info":
+            return {
+                "nodes": snap.n_nodes,
+                "semantics": snap.semantics,
+                "healthy_nodes": int(np.sum(snap.healthy)),
+                "extended_resources": sorted(snap.extended),
+            }
+        if op == "fit":
+            return self._op_fit(msg, snap, fixture)
+        if op == "sweep":
+            return self._op_sweep(msg, snap)
+        if op == "reload":
+            return self._op_reload(msg)
+        raise ValueError(f"unknown op {op!r}")
+
+    def _op_fit(self, msg: dict, snap: ClusterSnapshot, fixture: dict | None) -> dict:
+        try:
+            scenario = scenario_from_flags(
+                cpuRequests=msg.get("cpuRequests", "100m"),
+                cpuLimits=msg.get("cpuLimits", "200m"),
+                memRequests=msg.get("memRequests", "100mb"),
+                memLimits=msg.get("memLimits", "200mb"),
+                replicas=msg.get("replicas", "1"),
+            )
+            scenario.validate()
+        except ScenarioError as e:
+            raise ValueError(str(e)) from e
+
+        backend = msg.get("backend", "tpu")
+        if backend == "cpu" and fixture is not None and snap.semantics == "reference":
+            fits = np.array(
+                reference_run(fixture, scenario).fits, dtype=np.int64
+            )
+        elif backend == "cpu":
+            # No fixture (.npz source) or strict packing: sequential walk
+            # over the packed arrays — same fallback the CLI uses, so the
+            # cpu/tpu cross-check is never vacuous.
+            from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+
+            fits = np.array(
+                fit_arrays_python(
+                    snap.alloc_cpu_milli,
+                    snap.alloc_mem_bytes,
+                    snap.alloc_pods,
+                    snap.used_cpu_req_milli,
+                    snap.used_mem_req_bytes,
+                    snap.pods_count,
+                    scenario.cpu_request_milli,
+                    scenario.mem_request_bytes,
+                    mode=snap.semantics,
+                    healthy=snap.healthy,
+                ),
+                dtype=np.int64,
+            )
+        else:
+            fits = np.asarray(
+                fit_per_node(
+                    snap.alloc_cpu_milli,
+                    snap.alloc_mem_bytes,
+                    snap.alloc_pods,
+                    snap.used_cpu_req_milli,
+                    snap.used_mem_req_bytes,
+                    snap.pods_count,
+                    snap.healthy,
+                    scenario.cpu_request_milli,
+                    scenario.mem_request_bytes,
+                    mode=snap.semantics,
+                )
+            )
+
+        output = msg.get("output", "reference")
+        if output == "json":
+            report = json_report(snap, fits, scenario)
+        elif output == "table":
+            report = table_report(snap, fits, scenario)
+        else:
+            report = reference_report(snap, fits, scenario)
+        total = int(fits.sum())
+        return {
+            "total": total,
+            "schedulable": total >= scenario.replicas,
+            "fits": fits.tolist(),
+            "report": report,
+        }
+
+    def _op_sweep(self, msg: dict, snap: ClusterSnapshot) -> dict:
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+
+        if "random" in msg:
+            grid = random_scenario_grid(
+                int(msg["random"]["n"]), seed=int(msg["random"].get("seed", 0))
+            )
+        else:
+            grid = ScenarioGrid(
+                cpu_request_milli=np.asarray(msg["cpu_request_milli"]),
+                mem_request_bytes=np.asarray(msg["mem_request_bytes"]),
+                replicas=np.asarray(msg.get("replicas", [1])),
+            )
+        totals, sched = sweep_snapshot(snap, grid, mode=snap.semantics)
+        return {
+            "totals": totals.tolist(),
+            "schedulable": sched.tolist(),
+            "scenarios": grid.size,
+        }
+
+    def _op_reload(self, msg: dict) -> dict:
+        new_fixture, new_snap, _ = resolve_source(
+            msg["path"], msg.get("semantics")
+        )
+        with self._lock:
+            self.snapshot = new_snap
+            self.fixture = new_fixture
+        return {"nodes": new_snap.n_nodes, "semantics": new_snap.semantics}
+
+
+def main(argv=None) -> int:
+    """``python -m kubernetesclustercapacity_tpu.service.server -snapshot ... -port N``"""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(prog="kccap-server")
+    p.add_argument("-snapshot", required=True)
+    p.add_argument("-port", type=int, default=7077)
+    p.add_argument("-host", default="127.0.0.1")
+    p.add_argument("-semantics", choices=("reference", "strict"),
+                   default=None)
+    args = p.parse_args(argv)
+
+    try:
+        fixture, snap, _ = resolve_source(args.snapshot, args.semantics)
+    except Exception as e:
+        print(f"ERROR : {e}", file=sys.stderr)
+        return 1
+    server = CapacityServer(
+        snap, host=args.host, port=args.port, fixture=fixture
+    )
+    print(
+        f"serving {snap.n_nodes} nodes ({snap.semantics}) on "
+        f"{server.address[0]}:{server.address[1]}",
+        file=sys.stderr,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,188 @@
+"""Service boundary tests: protocol, server ops, Python client, C++ client."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import load_fixture
+from kubernetesclustercapacity_tpu.oracle import reference_run
+from kubernetesclustercapacity_tpu.scenario import scenario_from_flags
+from kubernetesclustercapacity_tpu.service import CapacityClient, CapacityServer
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+KIND = "tests/fixtures/kind-3node.json"
+
+
+@pytest.fixture(scope="module")
+def server():
+    fixture = load_fixture(KIND)
+    snap = snapshot_from_fixture(fixture, semantics="reference")
+    srv = CapacityServer(snap, port=0, fixture=fixture)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture()
+def client(server):
+    c = CapacityClient(*server.address)
+    yield c
+    c.close()
+
+
+class TestOps:
+    def test_ping_info(self, client):
+        assert client.ping() == "pong"
+        info = client.info()
+        assert info["nodes"] == 3
+        assert info["semantics"] == "reference"
+        assert info["healthy_nodes"] == 3
+
+    def test_fit_matches_oracle(self, client):
+        r = client.fit(cpuRequests="200m", cpuLimits="400m",
+                       memRequests="250mb", memLimits="500mb", replicas="10")
+        oracle = reference_run(
+            load_fixture(KIND),
+            scenario_from_flags(cpuRequests="200m", memRequests="250mb",
+                                replicas="10"),
+        )
+        assert r["total"] == oracle.total_possible_replicas == 109
+        assert r["fits"] == oracle.fits
+        assert r["schedulable"] is True
+        assert "go ahead with deployment of 10 pod replicas" in r["report"]
+
+    def test_fit_backends_agree(self, client):
+        a = client.fit(backend="tpu")
+        b = client.fit(backend="cpu")
+        assert a["fits"] == b["fits"]
+
+    def test_bad_flags_are_service_errors(self, client):
+        with pytest.raises(RuntimeError, match="memRequests"):
+            client.fit(memRequests="garbage")
+        with pytest.raises(RuntimeError):
+            client.call("nope")
+
+    def test_sweep_random(self, client):
+        r = client.sweep(random={"n": 8, "seed": 3})
+        assert len(r["totals"]) == 8
+        assert len(r["schedulable"]) == 8
+
+    def test_sweep_explicit(self, client):
+        r = client.sweep(
+            cpu_request_milli=[200], mem_request_bytes=[250 * 1024 * 1024],
+            replicas=[10],
+        )
+        assert r["totals"] == [109]
+
+    def test_many_requests_one_connection(self, client):
+        for _ in range(20):
+            assert client.ping() == "pong"
+
+    def test_cpu_backend_works_from_npz_source(self, server, tmp_path):
+        # Reload from an .npz (no fixture): backend=cpu must fall back to
+        # the sequential array walk, not silently run the TPU kernel.
+        p = str(tmp_path / "snap.npz")
+        snapshot_from_fixture(load_fixture(KIND), semantics="reference").save(p)
+        c = CapacityClient(*server.address)
+        try:
+            c.reload(p)
+            a = c.fit(backend="cpu", cpuRequests="200m", memRequests="250mb")
+            b = c.fit(backend="tpu", cpuRequests="200m", memRequests="250mb")
+            assert a["fits"] == b["fits"]
+            assert a["total"] == 109
+        finally:
+            c.reload(KIND)
+            c.close()
+
+    def test_reload_npz_semantics_conflict_rejected(self, server, tmp_path):
+        p = str(tmp_path / "strict.npz")
+        snapshot_from_fixture(load_fixture(KIND), semantics="strict").save(p)
+        c = CapacityClient(*server.address)
+        try:
+            with pytest.raises(RuntimeError, match="packed with"):
+                c.reload(p, semantics="reference")
+        finally:
+            c.close()
+
+    def test_malformed_frame_closes_cleanly(self, server):
+        import socket
+        import struct
+
+        s = socket.create_connection(server.address)
+        s.sendall(struct.pack(">I", 7) + b"not-js{")
+        # Server treats it as a protocol error and closes; no hang.
+        s.settimeout(5)
+        assert s.recv(4) == b""
+        s.close()
+
+    def test_reload(self, server):
+        c = CapacityClient(*server.address)
+        try:
+            r = c.reload(KIND, semantics="strict")
+            assert r["semantics"] == "strict"
+            assert c.info()["semantics"] == "strict"
+        finally:
+            c.reload(KIND, semantics="reference")
+            c.close()
+
+
+class TestNativeClient:
+    @pytest.fixture(scope="class")
+    def client_bin(self, tmp_path_factory):
+        src = os.path.join(
+            "kubernetesclustercapacity_tpu", "native", "kccap_client.cc"
+        )
+        out = str(tmp_path_factory.mktemp("bin") / "kccap-client")
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-o", out, src],
+                check=True, capture_output=True,
+            )
+        except (OSError, subprocess.CalledProcessError):
+            pytest.skip("no C++ toolchain")
+        return out
+
+    def test_end_to_end_transcript(self, server, client_bin):
+        host, port = server.address
+        proc = subprocess.run(
+            [client_bin, "-server", f"{host}:{port}",
+             "-cpuRequests=200m", "-cpuLimits=400m",
+             "-memRequests=250mb", "-memLimits=500mb", "-replicas=10"],
+            capture_output=True, text=True, timeout=30,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert ("Total possible replicas for the pod with required input "
+                "specs : 109") in proc.stdout
+        assert "go ahead with deployment of 10 pod replicas" in proc.stdout
+
+    def test_native_client_matches_python_cli(self, server, client_bin, capsys):
+        from kubernetesclustercapacity_tpu.cli import main
+
+        host, port = server.address
+        proc = subprocess.run(
+            [client_bin, "-server", f"{host}:{port}", "-replicas=5"],
+            capture_output=True, text=True, timeout=30,
+        )
+        rc = main(["-snapshot", KIND, "-replicas=5"])
+        assert rc == 0
+        local_out = capsys.readouterr().out
+        assert proc.stdout == local_out
+
+    def test_error_path(self, server, client_bin):
+        host, port = server.address
+        proc = subprocess.run(
+            [client_bin, "-server", f"{host}:{port}", "-memRequests=bogus"],
+            capture_output=True, text=True, timeout=30,
+        )
+        assert proc.returncode == 1
+        assert "ERROR" in proc.stderr
+
+    def test_connection_refused(self, client_bin):
+        proc = subprocess.run(
+            [client_bin, "-server", "127.0.0.1:1"],
+            capture_output=True, text=True, timeout=30,
+        )
+        assert proc.returncode == 1
+        assert "cannot connect" in proc.stderr
