@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 import numpy as np
 
@@ -71,21 +70,14 @@ def main() -> None:
     mem_d = jax.device_put(grid.mem_request_bytes)
     rep_d = jax.device_put(grid.replicas)
 
+    from kubernetesclustercapacity_tpu.utils.timing import measure_latency
+
     def run_exact():
         totals, sched = sweep_grid(*arrays, cpu_d, mem_d, rep_d, mode="reference")
         jax.block_until_ready(totals)
         return np.asarray(totals)
 
-    def time_fn(fn, reps=30):
-        fn()  # compile / warm
-        lat = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            fn()
-            lat.append((time.perf_counter() - t0) * 1e3)
-        return lat
-
-    exact_lat = time_fn(run_exact)
+    exact_stats = measure_latency(run_exact, reps=30)
     exact_totals = run_exact()
 
     # Pallas int32 fast path (eligibility-checked; exactness cross-checked
@@ -156,11 +148,11 @@ def main() -> None:
                     _sweep_pallas_padded(*dev_args, interpret=interpret)
                 )
 
-            fast_lat = time_fn(run_fast)
+            fast_lat = measure_latency(run_fast, reps=30)
 
-    lat_ms = fast_lat if fast_lat is not None else exact_lat
-    p50 = float(np.percentile(lat_ms, 50))
-    scenarios_per_sec = n_scenarios / (p50 / 1e3)
+    stats = fast_lat if fast_lat is not None else exact_stats
+    p50 = stats.p50
+    scenarios_per_sec = stats.throughput(n_scenarios)
 
     print(
         json.dumps(
@@ -173,9 +165,9 @@ def main() -> None:
                 "node_scenario_cells_per_sec": round(
                     n_nodes * scenarios_per_sec
                 ),
-                "p10_ms": round(float(np.percentile(lat_ms, 10)), 3),
-                "p90_ms": round(float(np.percentile(lat_ms, 90)), 3),
-                "exact_int64_p50_ms": round(float(np.percentile(exact_lat, 50)), 3),
+                "p10_ms": round(stats.p10, 3),
+                "p90_ms": round(stats.p90, 3),
+                "exact_int64_p50_ms": round(exact_stats.p50, 3),
                 "kernel": "pallas_i32_fused" if fast_lat is not None else "xla_int64",
                 "device": str(jax.devices()[0]),
                 "correctness_gate": "oracle-exact",

@@ -1,0 +1,85 @@
+"""Runtime value guards via ``jax.experimental.checkify`` (SURVEY.md §5).
+
+The reference's failure story is panic/exit (its race-detection and
+sanitizer rows are empty — single goroutine, nothing shared).  The JAX-side
+analog of sanitizers is functional purity plus *checkified* kernels:
+:func:`checked_fit_totals` runs the fit with in-graph assertions that
+surface as Python errors instead of silently wrong totals — used in tests
+and debugging sessions, never on the bench hot path (checkify adds ops).
+
+Checks:
+
+* nonzero requests (the reference integer-divide-by-zero panic sites,
+  ``ClusterCapacity.go:123,129``);
+* no negative snapshot values (wrapped uint64 bit patterns reaching a mode
+  that assumes non-negativity);
+* total within int64 headroom of the node count (sum cannot have wrapped).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+
+__all__ = ["checked_fit_totals"]
+
+
+def _checked_impl(
+    alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
+    healthy, cpu_req, mem_req,
+):
+    checkify.check(cpu_req != 0, "cpuRequests is zero: the reference panics "
+                   "with integer divide by zero (ClusterCapacity.go:123)")
+    checkify.check(mem_req != 0, "memRequests is zero: the reference panics "
+                   "with integer divide by zero (ClusterCapacity.go:129)")
+    checkify.check(
+        jnp.all(alloc_cpu >= 0) & jnp.all(used_cpu >= 0),
+        "negative CPU values in snapshot (wrapped uint64 bit pattern)",
+    )
+    checkify.check(
+        jnp.all(alloc_mem >= 0) & jnp.all(used_mem >= 0),
+        "negative memory values in snapshot (wrapped int64 sum)",
+    )
+    checkify.check(
+        jnp.all(alloc_pods >= 0) & jnp.all(pods_count >= 0),
+        "negative pod counts in snapshot",
+    )
+    fits = fit_per_node(
+        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
+        healthy, cpu_req, mem_req, mode="reference",
+    )
+    total = jnp.sum(fits)
+    n = fits.shape[0]
+    # Each |fit| < 2^31 on sane inputs, so |total| < n * 2^31; anything
+    # larger means the int64 sum wrapped.
+    checkify.check(
+        jnp.abs(total) <= jnp.int64(n) * jnp.int64(2**31),
+        "total replica count out of range: int64 sum may have wrapped",
+    )
+    return total
+
+
+_checked = jax.jit(checkify.checkify(_checked_impl))
+
+
+def checked_fit_totals(
+    alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
+    healthy, cpu_req, mem_req,
+) -> int:
+    """Fit total with in-graph validity checks; raises on violation."""
+    err, total = _checked(
+        jnp.asarray(alloc_cpu, jnp.int64),
+        jnp.asarray(alloc_mem, jnp.int64),
+        jnp.asarray(alloc_pods, jnp.int64),
+        jnp.asarray(used_cpu, jnp.int64),
+        jnp.asarray(used_mem, jnp.int64),
+        jnp.asarray(pods_count, jnp.int64),
+        jnp.asarray(healthy, jnp.bool_),
+        jnp.asarray(cpu_req, jnp.int64),
+        jnp.asarray(mem_req, jnp.int64),
+    )
+    err.throw()
+    return int(total)

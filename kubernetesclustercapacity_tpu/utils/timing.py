@@ -1,0 +1,146 @@
+"""Timing / profiling harness (SURVEY.md §5 "tracing / profiling").
+
+The reference's only observability is ad-hoc ``fmt.Printf`` progress lines;
+it publishes no timings at all.  This module is the framework's built-in
+instrumentation: phase-scoped wall-clock timers (snapshot → pack → kernel →
+report), latency statistics for the BASELINE metrics (scenarios/sec, p50
+sweep latency), and an optional ``jax.profiler`` trace hook for XLA-level
+inspection.
+
+Device-timing note: JAX dispatch is async — a phase that launches a kernel
+returns before the kernel finishes.  :func:`timed` takes a ``block`` result
+(anything acceptable to ``jax.block_until_ready``) so kernel phases measure
+completion, not dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["PhaseTimer", "LatencyStats", "measure_latency", "trace"]
+
+
+class _PhaseHandle:
+    """Yielded by :meth:`PhaseTimer.phase`; lets the body register device
+    results the phase must wait for (JAX dispatch is async)."""
+
+    def __init__(self) -> None:
+        self._blockers: list = []
+
+    def block(self, result):
+        """Register a result to ``jax.block_until_ready`` before the phase
+        closes; returns it unchanged so it can be used inline."""
+        self._blockers.append(result)
+        return result
+
+
+@dataclass
+class PhaseTimer:
+    """Accumulates named phase durations; renders a report or JSON.
+
+    >>> t = PhaseTimer()
+    >>> with t.phase("pack"):
+    ...     snapshot = snapshot_from_fixture(fx)
+    >>> with t.phase("kernel") as ph:
+    ...     totals = ph.block(sweep(...))  # phase waits for the device
+    >>> print(t.report())
+    """
+
+    phases: dict[str, float] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        handle = _PhaseHandle()
+        t0 = time.perf_counter()
+        try:
+            yield handle
+        finally:
+            if handle._blockers:
+                import jax
+
+                jax.block_until_ready(handle._blockers)
+            self.phases[name] = self.phases.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def report(self) -> str:
+        total = sum(self.phases.values())
+        lines = [f"{'PHASE':<24} {'SECONDS':>10} {'SHARE':>8}"]
+        for name, secs in self.phases.items():
+            share = (secs / total * 100) if total else 0.0
+            lines.append(f"{name:<24} {secs:>10.4f} {share:>7.1f}%")
+        lines.append(f"{'total':<24} {total:>10.4f}")
+        return "\n".join(lines)
+
+    def json(self) -> str:
+        return json.dumps(
+            {k: round(v, 6) for k, v in self.phases.items()}
+        )
+
+
+@dataclass(frozen=True)
+class LatencyStats:
+    """Latency distribution of repeated runs, in milliseconds."""
+
+    samples_ms: tuple
+
+    @property
+    def p50(self) -> float:
+        return float(np.percentile(self.samples_ms, 50))
+
+    @property
+    def p10(self) -> float:
+        return float(np.percentile(self.samples_ms, 10))
+
+    @property
+    def p90(self) -> float:
+        return float(np.percentile(self.samples_ms, 90))
+
+    def throughput(self, items_per_run: int) -> float:
+        """items/sec at p50 — e.g. scenarios/sec for a sweep."""
+        return items_per_run / (self.p50 / 1e3)
+
+    def json(self) -> str:
+        return json.dumps(
+            {
+                "p10_ms": round(self.p10, 3),
+                "p50_ms": round(self.p50, 3),
+                "p90_ms": round(self.p90, 3),
+                "runs": len(self.samples_ms),
+            }
+        )
+
+
+def measure_latency(fn, *, reps: int = 30, warmup: int = 1) -> LatencyStats:
+    """Time ``fn()`` (which must block on its own result) ``reps`` times."""
+    for _ in range(warmup):
+        fn()
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return LatencyStats(samples_ms=tuple(samples))
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """``jax.profiler`` trace scope — view with TensorBoard/XProf.
+
+    Wrap a sweep to capture XLA execution timelines::
+
+        with trace("/tmp/kcc-trace"):
+            sweep_snapshot(snap, grid)
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,110 @@
+"""Aux subsystem tests: timing harness and checkified guards."""
+
+import json
+import time
+
+import pytest
+
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+from kubernetesclustercapacity_tpu.utils.guards import checked_fit_totals
+from kubernetesclustercapacity_tpu.utils.timing import (
+    LatencyStats,
+    PhaseTimer,
+    measure_latency,
+)
+
+MIB = 1024 * 1024
+
+
+class TestPhaseTimer:
+    def test_phases_accumulate(self):
+        t = PhaseTimer()
+        with t.phase("a"):
+            time.sleep(0.01)
+        with t.phase("a"):
+            time.sleep(0.01)
+        with t.phase("b"):
+            pass
+        assert t.phases["a"] >= 0.02
+        assert "a" in t.report() and "SHARE" in t.report()
+        assert set(json.loads(t.json())) == {"a", "b"}
+
+    def test_phase_blocks_on_registered_results(self, monkeypatch):
+        import jax
+
+        waited = []
+        real = jax.block_until_ready
+        monkeypatch.setattr(
+            jax, "block_until_ready", lambda x: waited.append(x) or real(x)
+        )
+        t = PhaseTimer()
+        with t.phase("kernel") as ph:
+            out = ph.block(jax.numpy.arange(10).sum())
+        assert waited and int(out) == 45
+        # A phase with no registered results must not call it.
+        with t.phase("host"):
+            pass
+        assert len(waited) == 1
+
+
+class TestLatency:
+    def test_measure(self):
+        stats = measure_latency(lambda: time.sleep(0.001), reps=5)
+        assert stats.p50 >= 1.0
+        assert stats.p10 <= stats.p50 <= stats.p90
+        assert stats.throughput(100) > 0
+        assert isinstance(stats, LatencyStats)
+        assert json.loads(stats.json())["runs"] == 5
+
+
+class TestGuards:
+    def test_valid_inputs_pass(self):
+        snap = synthetic_snapshot(50, seed=1)
+        total = checked_fit_totals(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, snap.healthy, 100, MIB,
+        )
+        assert total > 0
+
+    def test_zero_request_raises(self):
+        snap = synthetic_snapshot(10, seed=1)
+        with pytest.raises(Exception, match="divide by zero"):
+            checked_fit_totals(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+                snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+                snap.pods_count, snap.healthy, 0, MIB,
+            )
+
+    def test_negative_snapshot_raises(self):
+        snap = synthetic_snapshot(10, seed=1)
+        bad = snap.used_cpu_req_milli.copy()
+        bad[0] = -5
+        with pytest.raises(Exception, match="negative CPU"):
+            checked_fit_totals(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+                bad, snap.used_mem_req_bytes,
+                snap.pods_count, snap.healthy, 100, MIB,
+            )
+
+    def test_negative_memory_raises(self):
+        snap = synthetic_snapshot(10, seed=1)
+        bad = snap.used_mem_req_bytes.copy()
+        bad[0] = -(2**40)
+        with pytest.raises(Exception, match="negative memory"):
+            checked_fit_totals(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+                snap.used_cpu_req_milli, bad,
+                snap.pods_count, snap.healthy, 100, MIB,
+            )
+
+    def test_negative_pods_raises(self):
+        snap = synthetic_snapshot(10, seed=1)
+        bad = snap.pods_count.copy()
+        bad[0] = -1
+        with pytest.raises(Exception, match="negative pod"):
+            checked_fit_totals(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+                snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+                bad, snap.healthy, 100, MIB,
+            )
