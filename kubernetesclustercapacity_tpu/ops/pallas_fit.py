@@ -103,19 +103,29 @@ def fast_sweep_eligible(
     return int(per_node_bound.sum()) <= _I32_MAX
 
 
-def _fit_block(ac, am, ap, uc, um, pc, cr, mr):
-    """Reference-semantics fit on an int32 tile.
+def _fit_row(ac, am, ap, uc, um, pc, cr, mr):
+    """Reference-semantics fit of one node sublane row against all scenarios.
 
-    ``ac..pc`` are ``(ROWS, LANES)`` node tiles, ``cr``/``mr`` are
-    ``(BS, 1, 1)`` scenario requests; returns ``(BS, ROWS, LANES)`` fits.
-    In the eligible domain (non-negative int32) Go's uint64/int64 semantics
-    and int32 semantics coincide, including the conditional pod-cap
-    overwrite (which may go negative — int32 handles that fine).
+    ``ac..pc`` are ``(1, LANES)`` node rows, ``cr``/``mr`` are ``(BS, 1)``
+    scenario requests; returns ``(BS, LANES)`` fits.  In the eligible domain
+    (non-negative int32) Go's uint64/int64 semantics and int32 semantics
+    coincide, including the conditional pod-cap overwrite (which may go
+    negative — int32 handles that fine).
+
+    Everything here is a 2-D ``(scenario, lane)`` op with standard
+    rank-2×rank-2 broadcasting — Mosaic's native vector layout.  (The first
+    formulation materialized a 3-D ``(BS, ROWS, LANES)`` block; composing
+    broadcast `//` and 2-D-condition `where` on that shape failed Mosaic
+    legalization on real TPU, and the 3-D intermediate is layout-hostile
+    anyway.)  Literal zeros are explicit int32: under jax_enable_x64 a bare
+    ``0`` is a weak i64 scalar, and Mosaic's i64→i32 conversion lowering
+    does not terminate (observed as RecursionError at compile time).
     """
-    cpu_fit = jnp.where(ac <= uc, 0, (ac - uc)[None] // cr)
-    mem_fit = jnp.where(am <= um, 0, (am - um)[None] // mr)
+    zero = jnp.int32(0)
+    cpu_fit = jnp.where(ac <= uc, zero, (ac - uc) // cr)
+    mem_fit = jnp.where(am <= um, zero, (am - um) // mr)
     fit = jnp.minimum(cpu_fit, mem_fit)
-    return jnp.where(fit >= ap, (ap - pc)[None] + jnp.zeros_like(fit), fit)
+    return jnp.where(fit >= ap, (ap - pc) + jnp.zeros_like(fit), fit)
 
 
 def _sweep_kernel(ac, am, ap, uc, um, pc, cr, mr, out):
@@ -125,11 +135,18 @@ def _sweep_kernel(ac, am, ap, uc, um, pc, cr, mr, out):
     def _():
         out[...] = jnp.zeros_like(out)
 
-    fits = _fit_block(
-        ac[...], am[...], ap[...], uc[...], um[...], pc[...],
-        cr[...][:, :, None], mr[...][:, :, None],
-    )  # (BS, ROWS, LANES) int32
-    out[...] += jnp.sum(fits, axis=1)  # accumulate (BS, LANES)
+    cr = cr[...]  # (BS, 1)
+    mr = mr[...]
+    # Unrolled loop over the tile's sublane rows: each step is a fused
+    # (BS, LANES) 2-D block of VPU ops — no 3-D intermediate ever exists.
+    # dtype stays i32 throughout (x64 promotion would break Mosaic).
+    acc = jnp.zeros_like(out)
+    for r in range(NODE_TILE_ROWS):
+        row = slice(r, r + 1)
+        acc += _fit_row(
+            ac[row], am[row], ap[row], uc[row], um[row], pc[row], cr, mr
+        )
+    out[...] += acc
 
 
 @partial(jax.jit, static_argnames=("interpret",))
@@ -155,14 +172,20 @@ def _sweep_pallas_padded(ac, am, ap, uc, um, pc, cr, mr, *, interpret=False):
         (SCENARIO_TILE, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
     )
 
-    partial_sums = pl.pallas_call(
-        _sweep_kernel,
-        out_shape=jax.ShapeDtypeStruct((s, LANES), jnp.int32),
-        grid=grid,
-        in_specs=[node_spec] * 6 + [scen_spec] * 2,
-        out_specs=out_spec,
-        interpret=interpret,
-    )(ac, am, ap, uc, um, pc, cr, mr)
+    # The kernel must trace with x64 OFF: the framework enables x64 globally
+    # (exact int64 path), but under x64 pallas ref-slice/program_id index
+    # arithmetic traces as i64, which Mosaic cannot legalize on real TPU
+    # (interpret mode on CPU masks this).  All kernel values are i32 either
+    # way; only the trace-time index/promotion semantics change.
+    with jax.enable_x64(False):
+        partial_sums = pl.pallas_call(
+            _sweep_kernel,
+            out_shape=jax.ShapeDtypeStruct((s, LANES), jnp.int32),
+            grid=grid,
+            in_specs=[node_spec] * 6 + [scen_spec] * 2,
+            out_specs=out_spec,
+            interpret=interpret,
+        )(ac, am, ap, uc, um, pc, cr, mr)
     return jnp.sum(partial_sums.astype(jnp.int64), axis=1)
 
 
