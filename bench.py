@@ -11,6 +11,21 @@ The BASELINE target for this framework is the whole 10k × 1k sweep in < 1 s
 on TPU, so ``vs_baseline`` reports how many times faster than that 1-second
 target budget the measured p50 sweep latency is (> 1.0 = beating the target).
 
+Methodology — slope-based, dispatch-independent. On this environment the
+TPU sits behind a tunnel whose per-dispatch round trip is ~60-70 ms
+(reported as ``dispatch_floor_ms``; a trivial ``x+1`` jit call costs the
+same), and per-dispatch timing through it proved unreliable (pipelining can
+make ``block_until_ready`` return early).  So each kernel path is timed as
+one jit call that runs K *distinct* scenario grids back-to-back on device
+via ``lax.scan`` (fresh random grids per rep, so nothing can be hoisted,
+deduped, or served from any cache), with the full ``[K, S]`` totals fetched
+to host as the synchronization point.  Run at two scan lengths, the
+marginal cost ``(t(K_big) − t(K_small)) / (K_big − K_small)`` is the true
+per-sweep time — fixed tunnel/dispatch overhead cancels, while per-sweep
+work (kernel + its share of result transfer) stays in.  The one-dispatch
+end-to-end latency of the exact kernel is also reported
+(``exact_single_dispatch_p50_ms``).
+
 Prints exactly one JSON line:
 ``{"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ...}``
 plus auxiliary fields (scenarios/sec, device, correctness gate).
@@ -20,10 +35,14 @@ from __future__ import annotations
 
 import json
 import os
+import time
 
 import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+K_SMALL, K_BIG = 8, 64  # scan lengths for the slope measurement
+REPS = 5  # timed repetitions per scan length, each with fresh grids
 
 
 def main() -> None:
@@ -31,7 +50,10 @@ def main() -> None:
 
     import kubernetesclustercapacity_tpu as kcc
     from kubernetesclustercapacity_tpu.fixtures import load_fixture
-    from kubernetesclustercapacity_tpu.ops.fit import snapshot_device_arrays, sweep_grid
+    from kubernetesclustercapacity_tpu.ops.fit import (
+        snapshot_device_arrays,
+        sweep_grid,
+    )
     from kubernetesclustercapacity_tpu.oracle import reference_run
 
     # --- correctness gate: never bench a wrong kernel.  kind fixture +
@@ -46,8 +68,7 @@ def main() -> None:
     oracle = reference_run(fixture, scenario)
     grid_small = kcc.ScenarioGrid.from_scenarios([scenario])
     totals_small, _ = kcc.sweep_snapshot(snap_small, grid_small)
-    gate_ok = int(totals_small[0]) == oracle.total_possible_replicas
-    if not gate_ok:
+    if int(totals_small[0]) != oracle.total_possible_replicas:
         print(
             json.dumps(
                 {
@@ -61,98 +82,234 @@ def main() -> None:
         )
         return
 
+    # --- dispatch floor: what one tunnel round trip costs, kernel aside.
+    trivial = jax.jit(lambda a: a + 1)
+    probe = jax.device_put(np.arange(1024, dtype=np.int32))
+    np.asarray(trivial(probe))
+    floor_ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(trivial(probe))
+        floor_ts.append((time.perf_counter() - t0) * 1e3)
+    dispatch_floor_ms = float(np.percentile(floor_ts, 50))
+
     # --- the north-star workload.
     n_nodes, n_scenarios = 10_000, 1_000
     snap = kcc.synthetic_snapshot(n_nodes, seed=1)
-    grid = kcc.random_scenario_grid(n_scenarios, seed=2)
     arrays = snapshot_device_arrays(snap)  # device-resident once, like a real sweep service
-    cpu_d = jax.device_put(grid.cpu_request_milli)
-    mem_d = jax.device_put(grid.mem_request_bytes)
-    rep_d = jax.device_put(grid.replicas)
 
-    from kubernetesclustercapacity_tpu.utils.timing import measure_latency
+    _grid_cache = {}
 
-    def run_exact():
-        totals, sched = sweep_grid(*arrays, cpu_d, mem_d, rep_d, mode="reference")
-        jax.block_until_ready(totals)
-        return np.asarray(totals)
+    def fresh_grids(n_grids, seed):
+        """n distinct stacked grids: (crs, mrs, rps) each [n, S] int64.
 
-    exact_stats = measure_latency(run_exact, reps=30)
-    exact_totals = run_exact()
+        Cached per (n_grids, seed): eligibility validation, exact timing and
+        fast timing all walk the same deterministic batches.
+        """
+        key = (n_grids, seed)
+        if key not in _grid_cache:
+            grids = [
+                kcc.random_scenario_grid(n_scenarios, seed=seed * 1000 + k)
+                for k in range(n_grids)
+            ]
+            crs = np.stack([g.cpu_request_milli for g in grids])
+            mrs = np.stack([g.mem_request_bytes for g in grids])
+            rps = np.stack([g.replicas for g in grids])
+            _grid_cache[key] = (grids, crs, mrs, rps)
+        return _grid_cache[key]
 
-    # Pallas int32 fast path (eligibility-checked; exactness cross-checked
-    # against the int64 kernel on the full workload before timing counts).
+    # Every (K, seed) batch both paths will time, plus the warm-up batches:
+    # used to validate fast-path eligibility on ALL timed inputs and to
+    # cross-check fast totals against exact totals batch by batch.
+    timed_keys = [
+        (K, seed)
+        for K in (K_SMALL, K_BIG)
+        for seed in ([99] + [7 * K + rep for rep in range(REPS)])
+    ]
+
+    def measure_slope(make_run, make_args):
+        """True per-sweep ms: marginal cost between two scan lengths.
+
+        ``make_run(K)`` builds the jitted K-sweep runner; ``make_args(K,
+        seed)`` stages fresh device inputs.  Full result fetch (np.asarray)
+        is the sync point; min-of-reps at each K, then the slope.  Returns
+        ``(per_sweep_ms, mins, outputs)`` with ``outputs[(K, seed)]`` the
+        ``[K, S]`` totals of every timed batch.
+        """
+        mins = {}
+        outputs = {}
+        for K in (K_SMALL, K_BIG):
+            run = make_run(K)
+            np.asarray(run(*make_args(K, seed=99)))  # warm the compile
+            ts = []
+            for rep in range(REPS):
+                seed = 7 * K + rep
+                args = make_args(K, seed=seed)
+                t0 = time.perf_counter()
+                out = np.asarray(run(*args))
+                ts.append((time.perf_counter() - t0) * 1e3)
+                outputs[(K, seed)] = out
+            mins[K] = min(ts)
+        per_sweep = (mins[K_BIG] - mins[K_SMALL]) / (K_BIG - K_SMALL)
+        return per_sweep, mins, outputs
+
+    # --- exact int64 path.
+    def make_run_exact(K):
+        @jax.jit
+        def run_many(crs, mrs, rps):
+            def body(carry, xs):
+                cr, mr, rp = xs
+                totals, _ = sweep_grid(*arrays, cr, mr, rp, mode="reference")
+                return carry, totals
+
+            _, totals = jax.lax.scan(body, 0, (crs, mrs, rps))
+            return totals
+
+        return run_many
+
+    def make_exact_args(K, seed):
+        _, crs, mrs, rps = fresh_grids(K, seed)
+        return tuple(jax.device_put(x) for x in (crs, mrs, rps))
+
+    exact_per_sweep, exact_mins, exact_outputs = measure_slope(
+        make_run_exact, make_exact_args
+    )
+
+    # --- single-dispatch end-to-end (includes one tunnel round trip).
+    g0 = kcc.random_scenario_grid(n_scenarios, seed=424242)
+    cr0 = jax.device_put(g0.cpu_request_milli)
+    mr0 = jax.device_put(g0.mem_request_bytes)
+    rp0 = jax.device_put(g0.replicas)
+    np.asarray(sweep_grid(*arrays, cr0, mr0, rp0, mode="reference")[0])
+    single_ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(sweep_grid(*arrays, cr0, mr0, rp0, mode="reference")[0])
+        single_ts.append((time.perf_counter() - t0) * 1e3)
+    single_dispatch_p50 = float(np.percentile(single_ts, 50))
+
+    # --- Pallas int32 fast path (eligibility-checked; exactness
+    # cross-checked against the int64 kernel on the full workload).
     from kubernetesclustercapacity_tpu.ops.pallas_fit import (
-        _sweep_pallas_padded,  # inner jitted padded form: device-resident timing
+        _sweep_pallas_padded,
+        _sweep_pallas_padded_rcp,
         fast_sweep_eligible,
-        sweep_pallas,
+        pad_node_array,
+        pad_scenario_array,
+        padded_node_shape,
+        padded_scenario_shape,
+        rcp_division_eligible,
     )
 
-    # Compiled Pallas needs a TPU; on CPU (smoke runs) use interpret mode.
     interpret = jax.default_backend() == "cpu"
-    fast_used = fast_sweep_eligible(
-        snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
-        snap.used_cpu_req_milli, snap.used_mem_req_bytes, snap.pods_count,
-        grid.cpu_request_milli, grid.mem_request_bytes,
-    )
-    fast_lat = None
-    if fast_used:
-        fast_totals, _ = sweep_pallas(
+    # Validate EVERY batch the fast path will time — eligibility is cheap
+    # host-side numpy; sampling would leave timed batches unvalidated.
+    all_timed_grids = [
+        g for K, seed in timed_keys for g in fresh_grids(K, seed)[0]
+    ]
+    fast_used = all(
+        fast_sweep_eligible(
             snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
-            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
-            snap.pods_count, grid.cpu_request_milli, grid.mem_request_bytes,
-            grid.replicas, interpret=interpret,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes, snap.pods_count,
+            g.cpu_request_milli, g.mem_request_bytes,
         )
-        if not np.array_equal(fast_totals, exact_totals):
-            fast_used = False  # never report a wrong fast path
-        else:
-            from kubernetesclustercapacity_tpu.ops.pallas_fit import (
-                LANES, NODE_TILE_ROWS, SCENARIO_TILE,
-            )
-            node_block = NODE_TILE_ROWS * LANES
-            n_pad = -(-n_nodes // node_block) * node_block
-            s_pad = -(-n_scenarios // SCENARIO_TILE) * SCENARIO_TILE
+        for g in all_timed_grids
+    )
+    use_rcp = fast_used and all(
+        rcp_division_eligible(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            g.cpu_request_milli, g.mem_request_bytes,
+        )
+        for g in all_timed_grids
+    )
+    fast_per_sweep = None
+    if fast_used:
+        n_pad = padded_node_shape(n_nodes)
+        s_pad = padded_scenario_shape(n_scenarios)
 
-            def pad32(a, kib=False):
-                a = np.asarray(a, dtype=np.int64)
-                if kib:
-                    a = a // 1024
-                out = np.zeros(n_pad, dtype=np.int32)
-                out[: a.shape[0]] = a.astype(np.int32)
-                return out.reshape(n_pad // LANES, LANES)
-
-            def pads(a, kib=False):
-                a = np.asarray(a, dtype=np.int64)
-                if kib:
-                    a = a // 1024
-                out = np.ones(s_pad, dtype=np.int32)
-                out[: a.shape[0]] = a.astype(np.int32)
-                return out.reshape(s_pad, 1)
-
-            dev_args = tuple(
-                jax.device_put(x)
-                for x in (
-                    pad32(snap.alloc_cpu_milli),
-                    pad32(snap.alloc_mem_bytes, kib=True),
-                    pad32(snap.alloc_pods),
-                    pad32(snap.used_cpu_req_milli),
-                    pad32(snap.used_mem_req_bytes, kib=True),
-                    pad32(snap.pods_count),
-                    pads(grid.cpu_request_milli),
-                    pads(grid.mem_request_bytes, kib=True),
-                )
+        def pad_scen_stack(stack, kib=False):
+            """[K, S] int64 -> [K, s_pad, 1] int32 (kernel's own padding)."""
+            return np.stack(
+                [pad_scenario_array(row, s_pad, kib=kib) for row in stack]
             )
 
-            def run_fast():
-                jax.block_until_ready(
-                    _sweep_pallas_padded(*dev_args, interpret=interpret)
-                )
+        node_args = tuple(
+            jax.device_put(x)
+            for x in (
+                pad_node_array(snap.alloc_cpu_milli, n_pad),
+                pad_node_array(snap.alloc_mem_bytes, n_pad, kib=True),
+                pad_node_array(snap.alloc_pods, n_pad),
+                pad_node_array(snap.used_cpu_req_milli, n_pad),
+                pad_node_array(snap.used_mem_req_bytes, n_pad, kib=True),
+                pad_node_array(snap.pods_count, n_pad),
+            )
+        )
 
-            fast_lat = measure_latency(run_fast, reps=30)
+        def make_run_fast(K):
+            @jax.jit
+            def run_many(*stacks):
+                def body(carry, xs):
+                    if use_rcp:
+                        cr, mr, crr, mrr = xs
+                        totals = _sweep_pallas_padded_rcp(
+                            *node_args, cr, mr, crr, mrr, interpret=interpret
+                        )
+                    else:
+                        cr, mr = xs
+                        totals = _sweep_pallas_padded(
+                            *node_args, cr, mr, interpret=interpret
+                        )
+                    return carry, totals
 
-    stats = fast_lat if fast_lat is not None else exact_stats
-    p50 = stats.p50
-    scenarios_per_sec = stats.throughput(n_scenarios)
+                _, totals = jax.lax.scan(body, 0, stacks)
+                return totals
+
+            return run_many
+
+        def make_fast_args(K, seed):
+            _, crs, mrs, _ = fresh_grids(K, seed)
+            crs_p = pad_scen_stack(crs)
+            mrs_p = pad_scen_stack(mrs, kib=True)
+            stacks = [crs_p, mrs_p]
+            if use_rcp:
+                stacks += [
+                    (1.0 / crs_p.astype(np.float64)).astype(np.float32),
+                    (1.0 / mrs_p.astype(np.float64)).astype(np.float32),
+                ]
+            return tuple(jax.device_put(x) for x in stacks)
+
+        fast_per_sweep, fast_mins, fast_outputs = measure_slope(
+            make_run_fast, make_fast_args
+        )
+        # exactness cross-check: EVERY timed fast batch against the exact
+        # path's totals for the same (K, seed) grids.
+        for key, exact_totals_k in exact_outputs.items():
+            fast_totals_k = np.asarray(fast_outputs[key])[:, :n_scenarios]
+            if not np.array_equal(fast_totals_k, np.asarray(exact_totals_k)):
+                fast_used = False  # never report a wrong fast path
+                fast_per_sweep = None
+                break
+
+    p50 = fast_per_sweep if fast_per_sweep is not None else exact_per_sweep
+    if p50 <= 0:
+        # Tunnel jitter swamped the slope (mins[K_BIG] <= mins[K_SMALL]):
+        # never publish a nonsense non-positive latency.
+        print(
+            json.dumps(
+                {
+                    "metric": "sweep_10k_nodes_x_1k_scenarios_p50",
+                    "value": None,
+                    "unit": "ms",
+                    "vs_baseline": 0.0,
+                    "error": "non-positive timing slope (dispatch jitter)",
+                    "exact_int64_per_sweep_ms": round(exact_per_sweep, 3),
+                    "dispatch_floor_ms": round(dispatch_floor_ms, 3),
+                }
+            )
+        )
+        return
+    scenarios_per_sec = n_scenarios / (p50 / 1e3)
 
     print(
         json.dumps(
@@ -165,10 +322,15 @@ def main() -> None:
                 "node_scenario_cells_per_sec": round(
                     n_nodes * scenarios_per_sec
                 ),
-                "p10_ms": round(stats.p10, 3),
-                "p90_ms": round(stats.p90, 3),
-                "exact_int64_p50_ms": round(exact_stats.p50, 3),
-                "kernel": "pallas_i32_fused" if fast_lat is not None else "xla_int64",
+                "exact_int64_per_sweep_ms": round(exact_per_sweep, 3),
+                "exact_single_dispatch_p50_ms": round(single_dispatch_p50, 3),
+                "dispatch_floor_ms": round(dispatch_floor_ms, 3),
+                "slope_scan_lengths": [K_SMALL, K_BIG],
+                "kernel": (
+                    ("pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused")
+                    if fast_per_sweep is not None
+                    else "xla_int64"
+                ),
                 "device": str(jax.devices()[0]),
                 "correctness_gate": "oracle-exact",
             }

@@ -40,6 +40,7 @@ from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
 
 __all__ = [
     "fast_sweep_eligible",
+    "rcp_division_eligible",
     "sweep_pallas",
     "sweep_auto",
 ]
@@ -103,6 +104,65 @@ def fast_sweep_eligible(
     return int(per_node_bound.sum()) <= _I32_MAX
 
 
+def rcp_division_eligible(
+    alloc_cpu,
+    alloc_mem,
+    used_cpu,
+    used_mem,
+    cpu_reqs,
+    mem_reqs,
+) -> bool:
+    """True iff f32-reciprocal division is provably exact for these inputs.
+
+    The rcp kernel replaces each emulated int32 ``//`` (~6x slower on the
+    VPU) with ``floor(float32(a) * float32(1/d))`` plus two integer fixup
+    rounds.  That is bit-exact when the initial estimate lands within ±1 of
+    the true quotient, which holds under (callers must already have passed
+    :func:`fast_sweep_eligible`, so values are non-negative int32 and
+    memory is KiB-quantized; KiB units are used below):
+
+    1. quotient bound: ``max(dividend)/min(divisor) <= 2**20``.  Relative
+       f32 error stacks to at most ``5*2^-24 < 2^-21.6`` (one conversion
+       each for a and d, one IEEE divide for 1/d, one multiply), so the
+       absolute error is ``<= 2^20 * 2^-21.6 < 0.5`` — after ``floor`` the
+       estimate is within ±1, and one fixup round converges (the second is
+       then a proven no-op, kept as margin for a <=1ulp-sloppy divide).
+    2. divisor bound ``<= 2**29``: keeps every fixup intermediate
+       ``a - q*d`` in ``(-d, 2d)`` ⊂ int32 range.
+
+    Dividends are ``alloc - used`` clamped at 0 (negative headrooms are
+    where'd out of the result), so ``max(alloc)`` bounds them.
+    """
+    qmax = np.int64(1) << 20
+    dmax = np.int64(1) << 29
+    for alloc, reqs, scale in (
+        (alloc_cpu, cpu_reqs, 1),
+        (alloc_mem, mem_reqs, 1024),
+    ):
+        alloc = np.asarray(alloc, dtype=np.int64) // scale
+        reqs = np.asarray(reqs, dtype=np.int64) // scale
+        if alloc.size == 0 or reqs.size == 0:
+            continue
+        if reqs.min() < 1 or reqs.max() > dmax:
+            return False
+        if alloc.max() // reqs.min() > qmax:
+            return False
+    return True
+
+
+def _rcp_div(a, d, r):
+    """Exact ``a // d`` for the :func:`rcp_division_eligible` domain.
+
+    ``a`` int32 ``>= 0``, ``d`` int32 ``> 0``, ``r`` = f32 ``1/d`` computed
+    by an IEEE divide.  Two fixup rounds; see the eligibility proof.
+    """
+    q = jnp.floor(a.astype(jnp.float32) * r).astype(jnp.int32)
+    for _ in range(2):
+        rem = a - q * d
+        q = q + (rem >= d).astype(jnp.int32) - (rem < 0).astype(jnp.int32)
+    return q
+
+
 def _fit_row(ac, am, ap, uc, um, pc, cr, mr):
     """Reference-semantics fit of one node sublane row against all scenarios.
 
@@ -128,34 +188,89 @@ def _fit_row(ac, am, ap, uc, um, pc, cr, mr):
     return jnp.where(fit >= ap, (ap - pc) + jnp.zeros_like(fit), fit)
 
 
-def _sweep_kernel(ac, am, ap, uc, um, pc, cr, mr, out):
-    j = pl.program_id(1)
+def _fit_row_rcp(ac, am, ap, uc, um, pc, cr, mr, crr, mrr):
+    """:func:`_fit_row` with reciprocal division (rcp-eligible domain only).
 
-    @pl.when(j == 0)
-    def _():
-        out[...] = jnp.zeros_like(out)
+    Dividends clamp at 0 before the divide: negative headrooms are where'd
+    out anyway, and the clamp keeps them inside the exactness proof's
+    ``[0, max(alloc)]`` dividend domain.
+    """
+    zero = jnp.int32(0)
+    cpu_fit = jnp.where(
+        ac <= uc, zero, _rcp_div(jnp.maximum(ac - uc, zero), cr, crr)
+    )
+    mem_fit = jnp.where(
+        am <= um, zero, _rcp_div(jnp.maximum(am - um, zero), mr, mrr)
+    )
+    fit = jnp.minimum(cpu_fit, mem_fit)
+    return jnp.where(fit >= ap, (ap - pc) + jnp.zeros_like(fit), fit)
 
-    cr = cr[...]  # (BS, 1)
-    mr = mr[...]
-    # Unrolled loop over the tile's sublane rows: each step is a fused
-    # (BS, LANES) 2-D block of VPU ops — no 3-D intermediate ever exists.
-    # dtype stays i32 throughout (x64 promotion would break Mosaic).
-    acc = jnp.zeros_like(out)
-    for r in range(NODE_TILE_ROWS):
-        row = slice(r, r + 1)
-        acc += _fit_row(
-            ac[row], am[row], ap[row], uc[row], um[row], pc[row], cr, mr
-        )
-    out[...] += acc
+
+def _make_sweep_kernel(use_rcp: bool):
+    def kernel(ac, am, ap, uc, um, pc, cr, mr, *rest):
+        (crr, mrr, out) = rest if use_rcp else (None, None, rest[0])
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            out[...] = jnp.zeros_like(out)
+
+        cr = cr[...]  # (BS, 1)
+        mr = mr[...]
+        if use_rcp:
+            crr = crr[...]
+            mrr = mrr[...]
+        # Unrolled loop over the tile's sublane rows: each step is a fused
+        # (BS, LANES) 2-D block of VPU ops — no 3-D intermediate ever exists.
+        # dtype stays i32 throughout (x64 promotion would break Mosaic).
+        acc = jnp.zeros_like(out)
+        for r in range(NODE_TILE_ROWS):
+            row = slice(r, r + 1)
+            if use_rcp:
+                acc += _fit_row_rcp(
+                    ac[row], am[row], ap[row], uc[row], um[row], pc[row],
+                    cr, mr, crr, mrr,
+                )
+            else:
+                acc += _fit_row(
+                    ac[row], am[row], ap[row], uc[row], um[row], pc[row],
+                    cr, mr,
+                )
+        out[...] += acc
+
+    return kernel
 
 
 @partial(jax.jit, static_argnames=("interpret",))
 def _sweep_pallas_padded(ac, am, ap, uc, um, pc, cr, mr, *, interpret=False):
-    """Inner jitted pallas sweep on padded arrays.
+    """Inner jitted pallas sweep on padded arrays (int32 ``//`` kernel).
 
     ``ac..pc``: ``(N/128, 128)`` int32 node arrays; ``cr``/``mr``: ``(S, 1)``
     int32 requests; returns int64 ``totals[S]``.
     """
+    return _pallas_dispatch(
+        ac, am, ap, uc, um, pc, cr, mr, None, None,
+        use_rcp=False, interpret=interpret,
+    )
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _sweep_pallas_padded_rcp(
+    ac, am, ap, uc, um, pc, cr, mr, crr, mrr, *, interpret=False
+):
+    """Reciprocal-division variant: ``crr``/``mrr`` are f32 ``(S, 1)``
+    reciprocals of ``cr``/``mr`` produced by an IEEE divide (numpy f64
+    halved to f32, or an XLA f32 divide — both within the proof's 1-ulp
+    budget).  Only valid on :func:`rcp_division_eligible` inputs."""
+    return _pallas_dispatch(
+        ac, am, ap, uc, um, pc, cr, mr, crr, mrr,
+        use_rcp=True, interpret=interpret,
+    )
+
+
+def _pallas_dispatch(
+    ac, am, ap, uc, um, pc, cr, mr, crr, mrr, *, use_rcp, interpret
+):
     n_rows = ac.shape[0]
     s = cr.shape[0]
     grid = (s // SCENARIO_TILE, n_rows // NODE_TILE_ROWS)
@@ -172,6 +287,12 @@ def _sweep_pallas_padded(ac, am, ap, uc, um, pc, cr, mr, *, interpret=False):
         (SCENARIO_TILE, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
     )
 
+    operands = (ac, am, ap, uc, um, pc, cr, mr)
+    in_specs = [node_spec] * 6 + [scen_spec] * 2
+    if use_rcp:
+        operands += (crr, mrr)
+        in_specs += [scen_spec] * 2
+
     # The kernel must trace with x64 OFF: the framework enables x64 globally
     # (exact int64 path), but under x64 pallas ref-slice/program_id index
     # arithmetic traces as i64, which Mosaic cannot legalize on real TPU
@@ -179,19 +300,52 @@ def _sweep_pallas_padded(ac, am, ap, uc, um, pc, cr, mr, *, interpret=False):
     # way; only the trace-time index/promotion semantics change.
     with jax.enable_x64(False):
         partial_sums = pl.pallas_call(
-            _sweep_kernel,
+            _make_sweep_kernel(use_rcp),
             out_shape=jax.ShapeDtypeStruct((s, LANES), jnp.int32),
             grid=grid,
-            in_specs=[node_spec] * 6 + [scen_spec] * 2,
+            in_specs=in_specs,
             out_specs=out_spec,
             interpret=interpret,
-        )(ac, am, ap, uc, um, pc, cr, mr)
+        )(*operands)
     return jnp.sum(partial_sums.astype(jnp.int64), axis=1)
 
 
 def _pad_to(x: np.ndarray, size: int, fill=0) -> np.ndarray:
     pad = size - x.shape[0]
     return np.pad(x, (0, pad), constant_values=fill) if pad else x
+
+
+def padded_node_shape(n: int) -> int:
+    """Nodes padded up to a whole number of (NODE_TILE_ROWS × LANES) tiles."""
+    node_block = NODE_TILE_ROWS * LANES
+    return -(-max(n, 1) // node_block) * node_block
+
+
+def padded_scenario_shape(s: int) -> int:
+    """Scenarios padded up to a whole number of SCENARIO_TILE blocks."""
+    return -(-max(s, 1) // SCENARIO_TILE) * SCENARIO_TILE
+
+
+def pad_node_array(a, n_pad: int, *, kib: bool = False) -> np.ndarray:
+    """``[N]`` int64 → ``(n_pad/LANES, LANES)`` int32 kernel layout.
+
+    Zero rows are fit-neutral: ``0 >= alloc_pods 0`` rewrites to ``0 − 0``.
+    """
+    a = np.asarray(a, dtype=np.int64)
+    if kib:
+        a = a // 1024
+    return _pad_to(a.astype(np.int32), n_pad).reshape(n_pad // LANES, LANES)
+
+
+def pad_scenario_array(a, s_pad: int, *, kib: bool = False) -> np.ndarray:
+    """``[S]`` int64 → ``(s_pad, 1)`` int32 request column.
+
+    Pads with ``1``-probes (valid divisors) whose outputs are dropped.
+    """
+    a = np.asarray(a, dtype=np.int64)
+    if kib:
+        a = a // 1024
+    return _pad_to(a.astype(np.int32), s_pad, fill=1).reshape(s_pad, 1)
 
 
 def sweep_pallas(
@@ -206,44 +360,47 @@ def sweep_pallas(
     replicas,
     *,
     interpret: bool = False,
+    use_rcp: bool | None = None,
 ):
     """Fused Pallas sweep (reference semantics). Caller must check eligibility.
 
     Padding: nodes pad with zero rows (fit 0 — ``0 >= alloc_pods 0`` rewrites
     to ``0 − 0``); scenarios pad with ``(1, 1)`` probes whose outputs are
-    dropped.  Returns ``(totals[S], schedulable[S])`` numpy arrays.
+    dropped.  ``use_rcp`` selects the reciprocal-division kernel (~6x faster
+    divides); ``None`` auto-enables it when :func:`rcp_division_eligible`
+    proves it exact.  Returns ``(totals[S], schedulable[S])`` numpy arrays.
     """
+    if use_rcp is None:
+        use_rcp = rcp_division_eligible(
+            alloc_cpu, alloc_mem, used_cpu, used_mem, cpu_reqs, mem_reqs
+        )
     n = np.asarray(alloc_cpu).shape[0]
     s = np.asarray(cpu_reqs).shape[0]
-    node_block = NODE_TILE_ROWS * LANES
-    n_pad = -(-max(n, 1) // node_block) * node_block
-    s_pad = -(-max(s, 1) // SCENARIO_TILE) * SCENARIO_TILE
+    n_pad = padded_node_shape(n)
+    s_pad = padded_scenario_shape(s)
 
-    def node32(a, kib=False):
-        a = np.asarray(a, dtype=np.int64)
-        if kib:
-            a = a // 1024
-        return (
-            _pad_to(a.astype(np.int32), n_pad).reshape(n_pad // LANES, LANES)
-        )
-
-    def scen32(a, kib=False):
-        a = np.asarray(a, dtype=np.int64)
-        if kib:
-            a = a // 1024
-        return _pad_to(a.astype(np.int32), s_pad, fill=1).reshape(s_pad, 1)
-
-    totals = _sweep_pallas_padded(
-        node32(alloc_cpu),
-        node32(alloc_mem, kib=True),
-        node32(alloc_pods),
-        node32(used_cpu),
-        node32(used_mem, kib=True),
-        node32(pods_count),
-        scen32(cpu_reqs),
-        scen32(mem_reqs, kib=True),
-        interpret=interpret,
+    args = (
+        pad_node_array(alloc_cpu, n_pad),
+        pad_node_array(alloc_mem, n_pad, kib=True),
+        pad_node_array(alloc_pods, n_pad),
+        pad_node_array(used_cpu, n_pad),
+        pad_node_array(used_mem, n_pad, kib=True),
+        pad_node_array(pods_count, n_pad),
+        pad_scenario_array(cpu_reqs, s_pad),
+        pad_scenario_array(mem_reqs, s_pad, kib=True),
     )
+    if use_rcp:
+        # f64 reciprocal halved to f32 is correctly rounded (<= 1/2 ulp),
+        # inside the exactness proof's divide budget.
+        recips = tuple(
+            (1.0 / args[i].astype(np.float64)).astype(np.float32)
+            for i in (6, 7)
+        )
+        totals = _sweep_pallas_padded_rcp(
+            *args, *recips, interpret=interpret
+        )
+    else:
+        totals = _sweep_pallas_padded(*args, interpret=interpret)
     totals = np.asarray(totals)[:s]
     schedulable = totals >= np.asarray(replicas, dtype=np.int64)
     return totals, schedulable

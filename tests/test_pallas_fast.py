@@ -6,6 +6,7 @@ import pytest
 from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
 from kubernetesclustercapacity_tpu.ops.pallas_fit import (
     fast_sweep_eligible,
+    rcp_division_eligible,
     sweep_auto,
     sweep_pallas,
 )
@@ -125,6 +126,137 @@ class TestPallasParity:
         )
         assert (totals < 0).any()
         np.testing.assert_array_equal(totals, exact_totals)
+
+
+class TestRcpDivision:
+    """The f32-reciprocal division tier: eligibility bounds + exactness."""
+
+    def test_realistic_snapshot_is_rcp_eligible(self):
+        snap = synthetic_snapshot(500, seed=3)
+        grid = random_scenario_grid(32, seed=4)
+        assert rcp_division_eligible(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            grid.cpu_request_milli, grid.mem_request_bytes,
+        )
+
+    def test_quotient_bound_enforced(self):
+        def elig(alloc_cpu_val, cpu_req_val):
+            return rcp_division_eligible(
+                np.array([alloc_cpu_val], dtype=np.int64), np.array([MIB]),
+                np.array([0]), np.array([0]),
+                np.array([cpu_req_val], dtype=np.int64), np.array([MIB]),
+            )
+
+        assert elig((1 << 20) * 3, 3)  # quotient exactly 2^20: eligible
+        assert not elig((1 << 20) * 3 + 3, 3)  # 2^20 + 1: out
+        assert not elig((1 << 21), 1)  # way out with divisor 1
+
+    def test_divisor_bound_enforced(self):
+        # mem request beyond 2^29 KiB (512 GiB) -> ineligible.
+        big_req = ((1 << 29) + 1024) * 1024
+        assert not rcp_division_eligible(
+            np.array([1000]), np.array([(1 << 30) * 1024], dtype=np.int64),
+            np.array([0]), np.array([0]),
+            np.array([100]), np.array([big_req], dtype=np.int64),
+        )
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_forced_rcp_matches_forced_divide(self, seed):
+        snap = synthetic_snapshot(777, seed=seed, mean_utilization=0.6)
+        grid = random_scenario_grid(64, seed=seed + 100)
+        t_div, s_div = sweep_pallas(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, interpret=True, use_rcp=False,
+        )
+        t_rcp, s_rcp = sweep_pallas(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, interpret=True, use_rcp=True,
+        )
+        np.testing.assert_array_equal(t_rcp, t_div)
+        np.testing.assert_array_equal(s_rcp, s_div)
+
+    def test_adversarial_boundary_quotients(self):
+        # Dividends landing exactly on and one-off multiples of the divisor,
+        # at the largest eligible quotient (2^20) where f32 error peaks.
+        q = 1 << 20
+        d_cpu = 997  # prime, not a power of two
+        n = 64
+        alloc_cpu = np.array(
+            [q * d_cpu, q * d_cpu - 1, q * d_cpu + 1, (q - 1) * d_cpu]
+            * (n // 4),
+            dtype=np.int64,
+        )
+        # Mem divides in KiB units, so the floor boundary is ±1 KiB around a
+        # multiple of the KiB divisor (then *1024 back to bytes).
+        d_mem_kib = 1031
+        alloc_mem = np.array(
+            [q * d_mem_kib, q * d_mem_kib - 1,
+             q * d_mem_kib + 1, (q - 1) * d_mem_kib]
+            * (n // 4),
+            dtype=np.int64,
+        ) * 1024
+        snap = synthetic_snapshot(n, seed=1)
+        snap.alloc_cpu_milli[:] = alloc_cpu
+        snap.alloc_mem_bytes[:] = alloc_mem
+        snap.used_cpu_req_milli[:] = 0
+        snap.used_mem_req_bytes[:] = 0
+        snap.pods_count[:] = 0
+        snap.alloc_pods[:] = 1 << 30  # keep the pod cap out of the way
+        cpu_reqs = np.array([d_cpu], dtype=np.int64)
+        mem_reqs = np.array([d_mem_kib * 1024], dtype=np.int64)
+        reps = np.array([1], dtype=np.int64)
+        assert rcp_division_eligible(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            cpu_reqs, mem_reqs,
+        )
+        t_div, _ = sweep_pallas(
+            *_args(snap), cpu_reqs, mem_reqs, reps,
+            interpret=True, use_rcp=False,
+        )
+        t_rcp, _ = sweep_pallas(
+            *_args(snap), cpu_reqs, mem_reqs, reps,
+            interpret=True, use_rcp=True,
+        )
+        np.testing.assert_array_equal(t_rcp, t_div)
+        # and against the pure-numpy truth
+        expect = (alloc_cpu // d_cpu).clip(max=alloc_mem // (d_mem_kib * 1024))
+        assert int(t_div[0]) == int(expect.sum())
+
+    def test_randomized_rcp_exactness_property(self):
+        # Hammer the divide itself across the eligible domain: random
+        # divisors, dividends biased to land near multiples of the divisor.
+        rng = np.random.default_rng(12345)
+        n, s = 512, 64
+        d_cpu = rng.integers(1, 1 << 14, size=s)
+        snap = synthetic_snapshot(n, seed=2)
+        q = rng.integers(0, 1 << 20, size=n)
+        jitter = rng.integers(-1, 2, size=n)
+        base_d = int(d_cpu.min())
+        snap.alloc_cpu_milli[:] = np.clip(q * base_d + jitter, 1, None)
+        snap.used_cpu_req_milli[:] = 0
+        snap.used_mem_req_bytes[:] = 0
+        snap.pods_count[:] = 0
+        snap.alloc_pods[:] = 1 << 30
+        mem_reqs = np.full(s, 64 * MIB, dtype=np.int64)
+        cpu_reqs = d_cpu.astype(np.int64)
+        reps = np.ones(s, dtype=np.int64)
+        if not rcp_division_eligible(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            cpu_reqs, mem_reqs,
+        ):
+            pytest.skip("random draw fell outside the eligible domain")
+        t_div, _ = sweep_pallas(
+            *_args(snap), cpu_reqs, mem_reqs, reps,
+            interpret=True, use_rcp=False,
+        )
+        t_rcp, _ = sweep_pallas(
+            *_args(snap), cpu_reqs, mem_reqs, reps,
+            interpret=True, use_rcp=True,
+        )
+        np.testing.assert_array_equal(t_rcp, t_div)
 
 
 class TestAuto:
