@@ -1,0 +1,399 @@
+"""Stdlib-only Kubernetes API client — live-cluster snapshot ingestion.
+
+The reference bootstraps ``k8s.io/client-go`` from a kubeconfig
+(``ClusterCapacity.go:88-97``, ``$HOME`` fallback at ``:152-157``) and then
+issues ``1 + 2N + ΣP`` sequential requests (SURVEY.md §3.4).  This module is
+the new framework's C2 equivalent with two deliberate differences:
+
+* **no Kubernetes client dependency** — TLS, auth, transport, and
+  pagination are pure stdlib (``ssl``/``http.client``); the only import
+  beyond the stdlib is PyYAML for the kubeconfig file itself (the optional
+  ``kubernetes`` package, when present, is used instead purely for its
+  broader auth-provider support);
+* **exactly TWO paginated List calls** — ``GET /api/v1/nodes`` and
+  ``GET /api/v1/pods`` — then all packing is local, fixing the reference's
+  N+1 query pattern.
+
+Auth support: bearer token (inline or ``tokenFile``), client certificates
+(inline base64 ``*-data`` or file paths), HTTP basic auth, and ``exec``
+credential plugins (the EKS/GKE pattern).  TLS verifies against the
+cluster's ``certificate-authority(-data)`` unless
+``insecure-skip-tls-verify`` is set.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import os
+import ssl
+import subprocess
+import tempfile
+import urllib.parse
+
+__all__ = [
+    "KubeConfigError",
+    "KubeAPIError",
+    "KubeConfig",
+    "KubeClient",
+    "default_kubeconfig_path",
+    "live_fixture",
+]
+
+
+class KubeConfigError(ValueError):
+    """Unusable kubeconfig (missing file/context/credentials)."""
+
+
+class KubeAPIError(RuntimeError):
+    """Non-2xx apiserver response or transport failure."""
+
+
+def default_kubeconfig_path() -> str:
+    """``$KUBECONFIG`` if set (first path entry, client-go semantics), else
+    ``$HOME/.kube/config`` with the reference's HOME/USERPROFILE fallback
+    (``ClusterCapacity.go:152-157``)."""
+    env = os.environ.get("KUBECONFIG")
+    if env:
+        return env.split(os.pathsep)[0]
+    home = os.environ.get("HOME") or os.environ.get("USERPROFILE") or ""
+    return os.path.join(home, ".kube", "config") if home else ""
+
+
+def _b64_or_file(data_b64: str | None, path: str | None, what: str) -> bytes | None:
+    if data_b64:
+        try:
+            return base64.b64decode(data_b64)
+        except Exception as e:
+            raise KubeConfigError(f"invalid base64 in {what}-data: {e}") from e
+    if path:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError as e:
+            raise KubeConfigError(f"cannot read {what} file {path}: {e}") from e
+    return None
+
+
+class KubeConfig:
+    """The subset of a kubeconfig one context needs: server + TLS + creds."""
+
+    def __init__(
+        self,
+        server: str,
+        *,
+        ca_pem: bytes | None = None,
+        insecure: bool = False,
+        client_cert_pem: bytes | None = None,
+        client_key_pem: bytes | None = None,
+        token: str | None = None,
+        username: str | None = None,
+        password: str | None = None,
+    ):
+        self.server = server.rstrip("/")
+        self.ca_pem = ca_pem
+        self.insecure = insecure
+        self.client_cert_pem = client_cert_pem
+        self.client_key_pem = client_key_pem
+        self.token = token
+        self.username = username
+        self.password = password
+
+    @classmethod
+    def load(cls, path: str | None = None, context: str | None = None) -> "KubeConfig":
+        """Parse a kubeconfig file and resolve one context to credentials."""
+        try:
+            import yaml
+        except ImportError as e:  # pragma: no cover - yaml is baked in here
+            raise KubeConfigError(
+                "live-cluster ingestion needs PyYAML to read the kubeconfig "
+                "(pip install pyyaml), or use snapshot_from_fixture()/"
+                "load_snapshot() for offline operation"
+            ) from e
+
+        path = path or default_kubeconfig_path()
+        if not path or not os.path.exists(path):
+            raise KubeConfigError(f"kubeconfig not found: {path!r}")
+        with open(path) as f:
+            try:
+                doc = yaml.safe_load(f) or {}
+            except yaml.YAMLError as e:
+                raise KubeConfigError(f"cannot parse kubeconfig {path}: {e}") from e
+
+        def by_name(section: str, name: str) -> dict:
+            for entry in doc.get(section) or []:
+                if entry.get("name") == name:
+                    return entry.get(section.rstrip("s"), {}) or {}
+            raise KubeConfigError(f"kubeconfig has no {section[:-1]} named {name!r}")
+
+        ctx_name = context or doc.get("current-context")
+        if not ctx_name:
+            raise KubeConfigError("kubeconfig has no current-context")
+        ctx = by_name("contexts", ctx_name)
+        cluster = by_name("clusters", ctx.get("cluster", ""))
+        user = by_name("users", ctx.get("user", "")) if ctx.get("user") else {}
+
+        server = cluster.get("server")
+        if not server:
+            raise KubeConfigError(f"context {ctx_name!r}: cluster has no server")
+
+        token = user.get("token")
+        if not token and user.get("tokenFile"):
+            token = _b64_or_file(None, user["tokenFile"], "token")
+            token = token.decode().strip() if token else None
+        if not token and user.get("exec"):
+            token = _exec_credential_token(user["exec"])
+
+        return cls(
+            server,
+            ca_pem=_b64_or_file(
+                cluster.get("certificate-authority-data"),
+                cluster.get("certificate-authority"),
+                "certificate-authority",
+            ),
+            insecure=bool(cluster.get("insecure-skip-tls-verify")),
+            client_cert_pem=_b64_or_file(
+                user.get("client-certificate-data"),
+                user.get("client-certificate"),
+                "client-certificate",
+            ),
+            client_key_pem=_b64_or_file(
+                user.get("client-key-data"), user.get("client-key"), "client-key"
+            ),
+            token=token,
+            username=user.get("username"),
+            password=user.get("password"),
+        )
+
+    def ssl_context(self) -> ssl.SSLContext:
+        ctx = ssl.create_default_context()
+        if self.insecure:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif self.ca_pem:
+            ctx.load_verify_locations(cadata=self.ca_pem.decode())
+        if self.client_cert_pem and self.client_key_pem:
+            # load_cert_chain only takes paths; stage the PEMs in a private
+            # temp dir for the duration of the load.
+            with tempfile.TemporaryDirectory() as d:
+                cert_p = os.path.join(d, "client.crt")
+                key_p = os.path.join(d, "client.key")
+                with open(cert_p, "wb") as f:
+                    f.write(self.client_cert_pem)
+                with open(key_p, "wb") as f:
+                    f.write(self.client_key_pem)
+                os.chmod(key_p, 0o600)
+                ctx.load_cert_chain(cert_p, key_p)
+        return ctx
+
+    def auth_headers(self) -> dict:
+        if self.token:
+            return {"Authorization": f"Bearer {self.token}"}
+        if self.username is not None and self.password is not None:
+            basic = base64.b64encode(
+                f"{self.username}:{self.password}".encode()
+            ).decode()
+            return {"Authorization": f"Basic {basic}"}
+        return {}
+
+
+def _exec_credential_token(spec: dict) -> str:
+    """Run a client-go ``exec`` credential plugin and return its token."""
+    cmd = [spec.get("command", "")] + list(spec.get("args") or [])
+    env = dict(os.environ)
+    for pair in spec.get("env") or []:
+        env[pair.get("name", "")] = pair.get("value", "")
+    env.setdefault(
+        "KUBERNETES_EXEC_INFO",
+        json.dumps(
+            {
+                "apiVersion": spec.get(
+                    "apiVersion", "client.authentication.k8s.io/v1"
+                ),
+                "kind": "ExecCredential",
+                "spec": {"interactive": False},
+            }
+        ),
+    )
+    try:
+        out = subprocess.run(
+            cmd, env=env, capture_output=True, timeout=60, check=True
+        ).stdout
+        cred = json.loads(out)
+        token = cred.get("status", {}).get("token")
+    except (OSError, subprocess.SubprocessError, ValueError) as e:
+        raise KubeConfigError(f"exec credential plugin failed: {e}") from e
+    if not token:
+        raise KubeConfigError("exec credential plugin returned no status.token")
+    return str(token)
+
+
+class KubeClient:
+    """Minimal apiserver GET client with pagination over a kubeconfig."""
+
+    def __init__(self, config: KubeConfig, *, timeout: float = 30.0):
+        self.config = config
+        self.timeout = timeout
+        u = urllib.parse.urlsplit(config.server)
+        if u.scheme not in ("http", "https"):
+            raise KubeConfigError(f"unsupported server scheme: {config.server!r}")
+        self._scheme = u.scheme
+        self._host = u.hostname or ""
+        self._port = u.port or (443 if u.scheme == "https" else 80)
+        self._prefix = u.path.rstrip("/")
+        self._ssl = config.ssl_context() if u.scheme == "https" else None
+        self._conn: http.client.HTTPConnection | None = None
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if self._scheme == "https":
+            return http.client.HTTPSConnection(
+                self._host, self._port, timeout=self.timeout, context=self._ssl
+            )
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self.timeout
+        )
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def _get_once(self, url: str) -> tuple[int, str, bytes]:
+        if self._conn is None:
+            self._conn = self._connect()
+        conn = self._conn
+        try:
+            conn.request(
+                "GET",
+                url,
+                headers={"Accept": "application/json", **self.config.auth_headers()},
+            )
+            resp = conn.getresponse()
+            return resp.status, resp.reason or "", resp.read()
+        except (OSError, http.client.HTTPException):
+            self.close()
+            raise
+
+    def get_json(self, path: str, params: dict | None = None) -> dict:
+        """GET over a persistent keep-alive connection (one TLS handshake
+        per client, not per page); a stale connection is retried once."""
+        query = urllib.parse.urlencode(
+            {k: v for k, v in (params or {}).items() if v}
+        )
+        url = self._prefix + path + (f"?{query}" if query else "")
+        try:
+            fresh = self._conn is None
+            try:
+                status, reason, body = self._get_once(url)
+            except (OSError, http.client.HTTPException):
+                if fresh:
+                    raise
+                # Keep-alive connection idled out since the last page —
+                # reconnect once; a failure on a fresh socket is real.
+                status, reason, body = self._get_once(url)
+        except (OSError, http.client.HTTPException) as e:
+            raise KubeAPIError(f"GET {path} failed: {e}") from e
+        if status // 100 != 2:
+            raise KubeAPIError(
+                f"GET {path} -> {status} {reason}: "
+                f"{body[:200].decode(errors='replace')}"
+            )
+        try:
+            return json.loads(body)
+        except ValueError as e:
+            raise KubeAPIError(f"GET {path}: invalid JSON response: {e}") from e
+
+    def list_all(
+        self, path: str, *, limit: int = 500, field_selector: str | None = None
+    ):
+        """Paginated List: follow ``metadata.continue`` until exhausted."""
+        token: str | None = None
+        while True:
+            page = self.get_json(
+                path,
+                {"limit": limit, "continue": token, "fieldSelector": field_selector},
+            )
+            yield from page.get("items") or []
+            token = (page.get("metadata") or {}).get("continue")
+            if not token:
+                return
+
+
+def _containers_fixture(containers: list | None) -> list:
+    out = []
+    for c in containers or []:
+        res = c.get("resources") or {}
+        out.append(
+            {
+                "resources": {
+                    "requests": dict(res.get("requests") or {}),
+                    "limits": dict(res.get("limits") or {}),
+                }
+            }
+        )
+    return out
+
+
+def live_fixture(
+    kubeconfig: str | None = None,
+    *,
+    context: str | None = None,
+    client: KubeClient | None = None,
+    page_limit: int = 500,
+) -> dict:
+    """Snapshot a live cluster into the framework's fixture schema.
+
+    Two paginated Lists total (vs. the reference's ``1 + 2N + ΣP`` pattern,
+    ``ClusterCapacity.go:168,183,238,264``).  Pods are fetched across all
+    namespaces with **no** phase field-selector: phases travel in the fixture
+    so reference/strict filtering stays a local, testable decision
+    (PARITY.md Q7).
+    """
+    own_client = client is None
+    if client is None:
+        client = KubeClient(KubeConfig.load(kubeconfig, context=context))
+
+    fixture: dict = {"nodes": [], "pods": []}
+    for n in client.list_all("/api/v1/nodes", limit=page_limit):
+        status = n.get("status") or {}
+        spec = n.get("spec") or {}
+        meta = n.get("metadata") or {}
+        fixture["nodes"].append(
+            {
+                "name": meta.get("name", ""),
+                "allocatable": {
+                    k: str(v) for k, v in (status.get("allocatable") or {}).items()
+                },
+                "conditions": [
+                    {"type": c.get("type", ""), "status": c.get("status", "")}
+                    for c in (status.get("conditions") or [])
+                ],
+                "labels": dict(meta.get("labels") or {}),
+                "taints": [
+                    {
+                        "key": t.get("key", ""),
+                        "value": t.get("value", "") or "",
+                        "effect": t.get("effect", ""),
+                    }
+                    for t in (spec.get("taints") or [])
+                ],
+            }
+        )
+    for p in client.list_all("/api/v1/pods", limit=page_limit):
+        meta = p.get("metadata") or {}
+        spec = p.get("spec") or {}
+        status = p.get("status") or {}
+        fixture["pods"].append(
+            {
+                "name": meta.get("name", ""),
+                "namespace": meta.get("namespace", ""),
+                "nodeName": spec.get("nodeName") or "",
+                "phase": status.get("phase", ""),
+                "containers": _containers_fixture(spec.get("containers")),
+                "initContainers": _containers_fixture(spec.get("initContainers")),
+            }
+        )
+    if own_client:
+        client.close()
+    return fixture

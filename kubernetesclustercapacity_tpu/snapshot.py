@@ -419,16 +419,19 @@ def snapshot_from_live_cluster(
 
     Fixes the reference's N+1 query pattern (``1 + 2N + ΣP`` requests,
     SURVEY.md §3.4): exactly TWO paginated List calls — nodes and pods —
-    then pure local packing.  Requires the optional ``kubernetes`` package;
-    everything else in the framework works offline from fixtures/snapshots.
+    then pure local packing.  Uses the optional ``kubernetes`` package when
+    present (for its wider auth-provider support); otherwise falls back to
+    the framework's own client (:mod:`..kubeapi`) — stdlib transport/auth
+    plus PyYAML for the kubeconfig file, no Kubernetes client library.
     """
     try:
         from kubernetes import client, config  # type: ignore[import-not-found]
-    except ImportError as e:  # pragma: no cover - optional dependency
-        raise RuntimeError(
-            "live-cluster ingestion needs the 'kubernetes' package; use "
-            "snapshot_from_fixture()/load_snapshot() for offline operation"
-        ) from e
+    except ImportError:
+        from kubernetesclustercapacity_tpu.kubeapi import live_fixture
+
+        return snapshot_from_fixture(
+            live_fixture(kubeconfig), semantics=semantics
+        )
 
     config.load_kube_config(config_file=kubeconfig)  # pragma: no cover
     v1 = client.CoreV1Api()  # pragma: no cover

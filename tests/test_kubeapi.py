@@ -1,0 +1,337 @@
+"""Stdlib live-cluster client tests against an in-process mock apiserver.
+
+The reference can only be exercised against a real kube-apiserver
+(SURVEY.md §4); here a ``http.server`` stand-in serves paginated
+``/api/v1/nodes`` and ``/api/v1/pods`` JSON so the whole C2 path —
+kubeconfig parsing → auth headers → pagination → fixture conversion →
+packed snapshot — runs hermetically.
+"""
+
+import base64
+import http.server
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+import yaml
+
+from kubernetesclustercapacity_tpu import kubeapi
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.kubeapi import (
+    KubeAPIError,
+    KubeClient,
+    KubeConfig,
+    KubeConfigError,
+    live_fixture,
+)
+from kubernetesclustercapacity_tpu.snapshot import (
+    snapshot_from_fixture,
+    snapshot_from_live_cluster,
+)
+
+
+def _k8s_node(n: dict) -> dict:
+    """Fixture-schema node → K8s REST Node object."""
+    return {
+        "metadata": {"name": n["name"], "labels": n.get("labels") or {}},
+        "spec": {"taints": list(n.get("taints") or [])},
+        "status": {
+            "allocatable": n["allocatable"],
+            "conditions": n["conditions"],
+        },
+    }
+
+
+def _k8s_pod(p: dict) -> dict:
+    return {
+        "metadata": {"name": p["name"], "namespace": p["namespace"]},
+        "spec": {
+            "nodeName": p["nodeName"] or None,
+            "containers": list(p.get("containers") or []),
+            "initContainers": list(p.get("initContainers") or []),
+        },
+        "status": {"phase": p["phase"]},
+    }
+
+
+class MockApiserver:
+    """Paginated read-only apiserver over the fixture schema."""
+
+    def __init__(self, fixture: dict, *, require_token: str | None = None):
+        self.items = {
+            "/api/v1/nodes": [_k8s_node(n) for n in fixture["nodes"]],
+            "/api/v1/pods": [_k8s_pod(p) for p in fixture["pods"]],
+        }
+        self.requests: list[str] = []
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # keep-alive, like a real apiserver
+
+            def log_message(self, *a):  # silence
+                pass
+
+            def do_GET(self):
+                outer.requests.append(self.path)
+                from urllib.parse import parse_qs, urlsplit
+
+                u = urlsplit(self.path)
+                def fail(code, body=b""):
+                    self.send_response(code)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                if require_token is not None:
+                    if self.headers.get("Authorization") != f"Bearer {require_token}":
+                        return fail(401, b"Unauthorized")
+                items = outer.items.get(u.path)
+                if items is None:
+                    return fail(404)
+                q = parse_qs(u.query)
+                limit = int(q.get("limit", ["500"])[0])
+                start = int(q.get("continue", ["0"])[0] or 0)
+                page = items[start : start + limit]
+                nxt = start + limit
+                meta = {"continue": str(nxt)} if nxt < len(items) else {}
+                body = json.dumps({"items": page, "metadata": meta}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def cluster():
+    fixture = synthetic_fixture(
+        23, seed=7, unhealthy_frac=0.1, unscheduled_running_pods=2
+    )
+    srv = MockApiserver(fixture, require_token="sekrit")
+    yield fixture, srv
+    srv.close()
+
+
+def _write_kubeconfig(tmp_path, server: str, user: dict) -> str:
+    doc = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": "mock",
+        "contexts": [{"name": "mock", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": server}}],
+        "users": [{"name": "u", "user": user}],
+    }
+    p = tmp_path / "kubeconfig"
+    p.write_text(yaml.safe_dump(doc))
+    return str(p)
+
+
+class TestKubeConfig:
+    def test_load_token_user(self, tmp_path):
+        path = _write_kubeconfig(tmp_path, "http://1.2.3.4:8080/", {"token": "abc"})
+        cfg = KubeConfig.load(path)
+        assert cfg.server == "http://1.2.3.4:8080"
+        assert cfg.auth_headers() == {"Authorization": "Bearer abc"}
+
+    def test_token_file_and_basic_auth(self, tmp_path):
+        tok = tmp_path / "tok"
+        tok.write_text("filetoken\n")
+        path = _write_kubeconfig(
+            tmp_path, "https://x", {"tokenFile": str(tok)}
+        )
+        assert KubeConfig.load(path).token == "filetoken"
+        path = _write_kubeconfig(
+            tmp_path, "https://x", {"username": "u", "password": "p"}
+        )
+        hdr = KubeConfig.load(path).auth_headers()["Authorization"]
+        assert base64.b64decode(hdr.split()[1]).decode() == "u:p"
+
+    def test_exec_credential_plugin(self, tmp_path):
+        path = _write_kubeconfig(
+            tmp_path,
+            "https://x",
+            {
+                "exec": {
+                    "apiVersion": "client.authentication.k8s.io/v1",
+                    "command": sys.executable,
+                    "args": [
+                        "-c",
+                        "import json;print(json.dumps({'kind':'ExecCredential',"
+                        "'status':{'token':'exectok'}}))",
+                    ],
+                }
+            },
+        )
+        assert KubeConfig.load(path).token == "exectok"
+
+    def test_missing_file_and_context_errors(self, tmp_path):
+        with pytest.raises(KubeConfigError, match="not found"):
+            KubeConfig.load(str(tmp_path / "nope"))
+        path = _write_kubeconfig(tmp_path, "http://x", {})
+        with pytest.raises(KubeConfigError, match="no context named"):
+            KubeConfig.load(path, context="other")
+
+    def test_ca_data_roundtrip(self, tmp_path):
+        pem = b"-----BEGIN CERTIFICATE-----\nZm9v\n-----END CERTIFICATE-----\n"
+        doc = {
+            "current-context": "m",
+            "contexts": [{"name": "m", "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [
+                {
+                    "name": "c",
+                    "cluster": {
+                        "server": "https://x",
+                        "certificate-authority-data": base64.b64encode(pem).decode(),
+                    },
+                }
+            ],
+            "users": [{"name": "u", "user": {"token": "t"}}],
+        }
+        p = tmp_path / "kc"
+        p.write_text(yaml.safe_dump(doc))
+        assert KubeConfig.load(str(p)).ca_pem == pem
+
+    @pytest.mark.skipif(shutil.which("openssl") is None, reason="needs openssl")
+    def test_ssl_context_loads_real_ca_and_client_cert(self, tmp_path):
+        key = tmp_path / "k.pem"
+        crt = tmp_path / "c.pem"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", str(key), "-out", str(crt), "-days", "1",
+                "-subj", "/CN=kccap-test",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        cfg = KubeConfig(
+            "https://x",
+            ca_pem=crt.read_bytes(),
+            client_cert_pem=crt.read_bytes(),
+            client_key_pem=key.read_bytes(),
+        )
+        ctx = cfg.ssl_context()  # raises if any PEM is rejected
+        assert ctx.verify_mode.name == "CERT_REQUIRED"
+
+    def test_insecure_skip_verify(self):
+        ctx = KubeConfig("https://x", insecure=True).ssl_context()
+        assert ctx.verify_mode.name == "CERT_NONE"
+
+
+class TestLiveFixture:
+    def test_two_paginated_lists_reconstruct_fixture(self, tmp_path, cluster):
+        fixture, srv = cluster
+        path = _write_kubeconfig(
+            tmp_path, f"http://127.0.0.1:{srv.port}", {"token": "sekrit"}
+        )
+        got = live_fixture(path, page_limit=7)
+        # Exact reconstruction: same nodes (incl. taints/labels/conditions)
+        # and pods (incl. initContainers, empty nodeName orphans).
+        assert got["nodes"] == [
+            {
+                "name": n["name"],
+                "allocatable": n["allocatable"],
+                "conditions": n["conditions"],
+                "labels": n["labels"],
+                "taints": n["taints"],
+            }
+            for n in fixture["nodes"]
+        ]
+        assert [p["name"] for p in got["pods"]] == [
+            p["name"] for p in fixture["pods"]
+        ]
+        for mine, orig in zip(got["pods"], fixture["pods"]):
+            assert mine["nodeName"] == orig["nodeName"]
+            assert mine["phase"] == orig["phase"]
+        # Pagination actually happened: >1 request per resource, and only
+        # the two resources were ever queried (no N+1 pattern).
+        paths = {r.split("?")[0] for r in srv.requests}
+        assert paths == {"/api/v1/nodes", "/api/v1/pods"}
+        assert len(srv.requests) > 2
+
+    def test_snapshot_from_live_cluster_stdlib_fallback(self, tmp_path, cluster):
+        """snapshot_from_live_cluster → stdlib client → identical packing."""
+        fixture, srv = cluster
+        path = _write_kubeconfig(
+            tmp_path, f"http://127.0.0.1:{srv.port}", {"token": "sekrit"}
+        )
+        assert "kubernetes" not in sys.modules  # the fallback path is live
+        snap = snapshot_from_live_cluster(path, semantics="reference")
+        ref = snapshot_from_fixture(fixture, semantics="reference")
+        np.testing.assert_array_equal(snap.alloc_cpu_milli, ref.alloc_cpu_milli)
+        np.testing.assert_array_equal(snap.alloc_mem_bytes, ref.alloc_mem_bytes)
+        np.testing.assert_array_equal(
+            snap.used_cpu_req_milli, ref.used_cpu_req_milli
+        )
+        np.testing.assert_array_equal(snap.pods_count, ref.pods_count)
+        np.testing.assert_array_equal(snap.healthy, ref.healthy)
+
+    def test_auth_failure_is_kubeapi_error(self, tmp_path, cluster):
+        _, srv = cluster
+        path = _write_kubeconfig(
+            tmp_path, f"http://127.0.0.1:{srv.port}", {"token": "WRONG"}
+        )
+        with pytest.raises(KubeAPIError, match="401"):
+            live_fixture(path)
+
+    def test_connection_refused_is_kubeapi_error(self, tmp_path):
+        path = _write_kubeconfig(tmp_path, "http://127.0.0.1:1", {"token": "t"})
+        with pytest.raises(KubeAPIError, match="failed"):
+            live_fixture(path)
+
+    def test_default_kubeconfig_path_home_fallback(self, monkeypatch):
+        monkeypatch.delenv("KUBECONFIG", raising=False)
+        monkeypatch.setenv("HOME", "/h")
+        assert kubeapi.default_kubeconfig_path() == os.path.join(
+            "/h", ".kube", "config"
+        )
+        monkeypatch.delenv("HOME")
+        monkeypatch.setenv("USERPROFILE", "/u")
+        assert kubeapi.default_kubeconfig_path() == os.path.join(
+            "/u", ".kube", "config"
+        )
+
+    def test_kubeconfig_env_var_wins(self, monkeypatch, tmp_path, cluster):
+        """$KUBECONFIG is honored (first path entry), like client-go."""
+        _, srv = cluster
+        path = _write_kubeconfig(
+            tmp_path, f"http://127.0.0.1:{srv.port}", {"token": "sekrit"}
+        )
+        monkeypatch.setenv("KUBECONFIG", path + os.pathsep + "/nonexistent")
+        got = live_fixture(None)  # no explicit path: env must resolve it
+        assert len(got["nodes"]) == 23
+
+    def test_connection_reuse_across_pages(self, tmp_path, cluster):
+        """Paginated listing rides ONE keep-alive connection, and a client
+        survives the server dropping the idle connection between calls."""
+        fixture, srv = cluster
+        path = _write_kubeconfig(
+            tmp_path, f"http://127.0.0.1:{srv.port}", {"token": "sekrit"}
+        )
+        client = KubeClient(KubeConfig.load(path))
+        nodes = list(client.list_all("/api/v1/nodes", limit=5))
+        assert len(nodes) == len(fixture["nodes"])
+        conn = client._conn
+        assert conn is not None  # persistent, not per-request
+        # Simulate the keep-alive going stale server-side:
+        conn.sock.close()
+        nodes2 = list(client.list_all("/api/v1/nodes", limit=5))
+        assert [n["metadata"]["name"] for n in nodes2] == [
+            n["metadata"]["name"] for n in nodes
+        ]
+        client.close()
+        assert client._conn is None
