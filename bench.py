@@ -55,6 +55,7 @@ def main() -> None:
         sweep_grid,
     )
     from kubernetesclustercapacity_tpu.oracle import reference_run
+    from kubernetesclustercapacity_tpu.utils.timing import measure_latency
 
     # --- correctness gate: never bench a wrong kernel.  kind fixture +
     # sample scenario must match the oracle exactly.
@@ -85,13 +86,9 @@ def main() -> None:
     # --- dispatch floor: what one tunnel round trip costs, kernel aside.
     trivial = jax.jit(lambda a: a + 1)
     probe = jax.device_put(np.arange(1024, dtype=np.int32))
-    np.asarray(trivial(probe))
-    floor_ts = []
-    for _ in range(10):
-        t0 = time.perf_counter()
-        np.asarray(trivial(probe))
-        floor_ts.append((time.perf_counter() - t0) * 1e3)
-    dispatch_floor_ms = float(np.percentile(floor_ts, 50))
+    dispatch_floor_ms = measure_latency(
+        lambda: np.asarray(trivial(probe)), reps=10
+    ).p50
 
     # --- the north-star workload.
     n_nodes, n_scenarios = 10_000, 1_000
@@ -180,13 +177,12 @@ def main() -> None:
     cr0 = jax.device_put(g0.cpu_request_milli)
     mr0 = jax.device_put(g0.mem_request_bytes)
     rp0 = jax.device_put(g0.replicas)
-    np.asarray(sweep_grid(*arrays, cr0, mr0, rp0, mode="reference")[0])
-    single_ts = []
-    for _ in range(10):
-        t0 = time.perf_counter()
-        np.asarray(sweep_grid(*arrays, cr0, mr0, rp0, mode="reference")[0])
-        single_ts.append((time.perf_counter() - t0) * 1e3)
-    single_dispatch_p50 = float(np.percentile(single_ts, 50))
+    single_dispatch_p50 = measure_latency(
+        lambda: np.asarray(
+            sweep_grid(*arrays, cr0, mr0, rp0, mode="reference")[0]
+        ),
+        reps=10,
+    ).p50
 
     # --- Pallas int32 fast path (eligibility-checked; exactness
     # cross-checked against the int64 kernel on the full workload).
@@ -199,6 +195,7 @@ def main() -> None:
         padded_node_shape,
         padded_scenario_shape,
         rcp_division_eligible,
+        scenario_reciprocals,
     )
 
     interpret = jax.default_backend() == "cpu"
@@ -274,8 +271,8 @@ def main() -> None:
             stacks = [crs_p, mrs_p]
             if use_rcp:
                 stacks += [
-                    (1.0 / crs_p.astype(np.float64)).astype(np.float32),
-                    (1.0 / mrs_p.astype(np.float64)).astype(np.float32),
+                    scenario_reciprocals(crs_p),
+                    scenario_reciprocals(mrs_p),
                 ]
             return tuple(jax.device_put(x) for x in stacks)
 

@@ -348,6 +348,18 @@ def pad_scenario_array(a, s_pad: int, *, kib: bool = False) -> np.ndarray:
     return _pad_to(a.astype(np.int32), s_pad, fill=1).reshape(s_pad, 1)
 
 
+def scenario_reciprocals(padded_requests: np.ndarray) -> np.ndarray:
+    """The rcp kernel's proof-bearing reciprocal: f64 divide halved to f32.
+
+    This exact computation (correctly rounded, <= 1/2 ulp) is what the
+    reciprocal-division exactness proof assumes; every caller of the rcp
+    kernel must stage divisor reciprocals through here.
+    """
+    return (1.0 / np.asarray(padded_requests).astype(np.float64)).astype(
+        np.float32
+    )
+
+
 def sweep_pallas(
     alloc_cpu,
     alloc_mem,
@@ -390,12 +402,7 @@ def sweep_pallas(
         pad_scenario_array(mem_reqs, s_pad, kib=True),
     )
     if use_rcp:
-        # f64 reciprocal halved to f32 is correctly rounded (<= 1/2 ulp),
-        # inside the exactness proof's divide budget.
-        recips = tuple(
-            (1.0 / args[i].astype(np.float64)).astype(np.float32)
-            for i in (6, 7)
-        )
+        recips = tuple(scenario_reciprocals(args[i]) for i in (6, 7))
         totals = _sweep_pallas_padded_rcp(
             *args, *recips, interpret=interpret
         )
