@@ -61,3 +61,4 @@ from kubernetesclustercapacity_tpu.ops.fit import (  # noqa: E402,F401
     sweep_grid,
     sweep_snapshot,
 )
+from kubernetesclustercapacity_tpu.store import ClusterStore  # noqa: E402,F401

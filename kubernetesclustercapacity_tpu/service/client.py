@@ -48,3 +48,7 @@ class CapacityClient:
 
     def reload(self, path: str, **params) -> dict:
         return self.call("reload", path=path, **params)
+
+    def update(self, events: list[dict]) -> dict:
+        """Apply watch-style node/pod events to the served snapshot."""
+        return self.call("update", events=events)

@@ -71,6 +71,8 @@ class CapacityServer:
     ) -> None:
         self.snapshot = snapshot
         self.fixture = fixture
+        self._store = None  # lazy ClusterStore, built on first update op
+        self._fixture_dirty = False  # fixture lags the store until needed
         self._lock = threading.Lock()
         self._tcp = _ThreadingServer((host, port), _Handler)
         self._tcp.capacity_server = self  # type: ignore[attr-defined]
@@ -99,9 +101,19 @@ class CapacityServer:
         if op == "ping":
             return "pong"
         # Snapshot the (snapshot, fixture) pair once under the lock so a
-        # concurrent reload can never produce a torn read (fits computed on
-        # the new snapshot, report rendered against the old one).
+        # concurrent reload/update can never produce a torn read (fits
+        # computed on the new snapshot, report rendered against the old
+        # one).  The raw fixture is rebuilt from the store lazily — only
+        # when an op actually consumes it (cpu-backend fit), not on every
+        # watch-event batch.
         with self._lock:
+            if (
+                self._fixture_dirty
+                and op == "fit"
+                and msg.get("backend") == "cpu"
+            ):
+                self.fixture = self._store.fixture_view()
+                self._fixture_dirty = False
             snap, fixture = self.snapshot, self.fixture
         if op == "info":
             return {
@@ -116,6 +128,8 @@ class CapacityServer:
             return self._op_sweep(msg, snap)
         if op == "reload":
             return self._op_reload(msg)
+        if op == "update":
+            return self._op_update(msg)
         raise ValueError(f"unknown op {op!r}")
 
     def _op_fit(self, msg: dict, snap: ClusterSnapshot, fixture: dict | None) -> dict:
@@ -215,7 +229,46 @@ class CapacityServer:
         with self._lock:
             self.snapshot = new_snap
             self.fixture = new_fixture
+            self._store = None  # stale after a wholesale replace
+            self._fixture_dirty = False
         return {"nodes": new_snap.n_nodes, "semantics": new_snap.semantics}
+
+    def _op_update(self, msg: dict) -> dict:
+        """Apply watch-style node/pod events to the served snapshot.
+
+        Incremental (per-row recompute via :class:`ClusterStore`) — the
+        informer analog of the reference's full re-walk.  Events apply in
+        order; on a bad event the ops before it stay applied and the served
+        snapshot/fixture are re-synced to the store before the error
+        surfaces.
+        """
+        from kubernetesclustercapacity_tpu.store import ClusterStore
+
+        events = msg.get("events")
+        if not isinstance(events, list):
+            raise ValueError("update needs an 'events' list")
+        with self._lock:
+            if self._store is None:
+                if self.fixture is None:
+                    raise ValueError(
+                        "update needs a fixture-backed source (.json); "
+                        ".npz checkpoints carry no raw objects to update"
+                    )
+                self._store = ClusterStore(
+                    self.fixture,
+                    semantics=self.snapshot.semantics,
+                    extended_resources=tuple(sorted(self.snapshot.extended)),
+                )
+            try:
+                self._store.apply(events)
+            finally:
+                snap = self.snapshot = self._store.snapshot()
+                self._fixture_dirty = True  # rebuilt on demand (cpu fit)
+        return {
+            "nodes": snap.n_nodes,
+            "healthy_nodes": int(np.sum(snap.healthy)),
+            "applied": len(events),
+        }
 
 
 def main(argv=None) -> int:

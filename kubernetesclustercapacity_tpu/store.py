@@ -1,0 +1,327 @@
+"""Incremental cluster store — the framework's informer analog.
+
+The reference re-walks the entire apiserver on every invocation
+(``1 + 2N + ΣP`` requests, SURVEY.md §3.4); real Kubernetes controllers
+instead keep a *watch*-fed cache and apply object deltas.  This module is
+that layer for the packed snapshot: a :class:`ClusterStore` holds the raw
+node/pod state plus the dense arrays, and applies watch-style events —
+
+    {"type": "ADDED"|"MODIFIED"|"DELETED",
+     "kind": "Pod"|"Node",
+     "object": <fixture-schema dict>}
+
+— by recomputing only the affected node *rows* (O(pods-on-node) per pod
+event, O(N) array reshape only when nodes join/leave), never the whole
+cluster.  The invariant, enforced by tests on randomized event streams:
+after any sequence of events the store's snapshot is element-identical to a
+full :func:`~.snapshot.snapshot_from_fixture` repack of its state — under
+either semantics, including the reference quirks (phantom rows re-homing
+orphan pods, mod-2^64 usage wrap, parse-fail→0).
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from kubernetesclustercapacity_tpu.oracle import reference as _oracle
+from kubernetesclustercapacity_tpu.snapshot import (
+    ClusterSnapshot,
+    _effective_pod_resources,
+    _clamp_i64,
+    _strict_healthy,
+    _strict_parse,
+    _STRICT_TERMINATED,
+)
+
+__all__ = ["StoreError", "ClusterStore"]
+
+_INT_COLS = (
+    "alloc_cpu_milli",
+    "alloc_mem_bytes",
+    "alloc_pods",
+    "used_cpu_req_milli",
+    "used_cpu_lim_milli",
+    "used_mem_req_bytes",
+    "used_mem_lim_bytes",
+    "pods_count",
+)
+
+
+class StoreError(ValueError):
+    """Malformed or inapplicable watch event."""
+
+
+def _pod_key(pod: dict) -> tuple[str, str]:
+    return (pod.get("namespace", ""), pod.get("name", ""))
+
+
+class ClusterStore:
+    """Watch-fed packed snapshot with per-row incremental updates."""
+
+    def __init__(
+        self,
+        fixture: dict,
+        *,
+        semantics: str = "reference",
+        extended_resources: tuple[str, ...] = (),
+    ):
+        if semantics not in ("reference", "strict"):
+            raise ValueError(f"unknown semantics {semantics!r}")
+        self.semantics = semantics
+        self.extended_resources = tuple(extended_resources)
+        # Raw state, deep-copied: events must never alias caller objects.
+        self._nodes: list[dict] = [copy.deepcopy(n) for n in fixture.get("nodes", [])]
+        self._pods: dict[tuple[str, str], dict] = {}
+        self._pods_by_node: dict[str, dict[tuple[str, str], dict]] = {}
+        for p in fixture.get("pods", []):
+            p = copy.deepcopy(p)
+            key = _pod_key(p)
+            if key in self._pods:
+                raise StoreError(f"duplicate pod {key} in fixture")
+            self._pods[key] = p
+            self._pods_by_node.setdefault(p.get("nodeName", ""), {})[key] = p
+
+        n = len(self._nodes)
+        self._cols = {c: np.zeros(n, dtype=np.int64) for c in _INT_COLS}
+        self._healthy = np.zeros(n, dtype=np.bool_)
+        self._ext = {
+            r: (np.zeros(n, dtype=np.int64), np.zeros(n, dtype=np.int64))
+            for r in self.extended_resources
+        }
+        # The name a row *matches pods by*: the raw name in strict mode, the
+        # NodeView name in reference mode ("" for phantom rows, Q4).
+        self._view_names: list[str] = [""] * n
+        for i in range(n):
+            self._recompute_row(i)
+
+    # -- public ------------------------------------------------------------
+    @property
+    def n_nodes(self) -> int:
+        return len(self._nodes)
+
+    def fixture_view(self) -> dict:
+        """Current raw state in fixture schema (deep copy)."""
+        return copy.deepcopy(
+            {"nodes": self._nodes, "pods": list(self._pods.values())}
+        )
+
+    def snapshot(self) -> ClusterSnapshot:
+        """An immutable-by-copy packed snapshot of the current state."""
+        # Reference mode reports the NodeView name — "" for phantom rows,
+        # exactly what the Go slice holds (Q4); strict reports raw names.
+        return ClusterSnapshot(
+            names=list(self._view_names),
+            semantics=self.semantics,
+            extended={
+                r: (a.copy(), u.copy()) for r, (a, u) in self._ext.items()
+            },
+            labels=[n.get("labels", {}) for n in self._nodes],
+            taints=[n.get("taints", []) for n in self._nodes],
+            healthy=self._healthy.copy(),
+            **{c: self._cols[c].copy() for c in _INT_COLS},
+        )
+
+    def apply(self, events: list[dict]) -> ClusterSnapshot:
+        """Apply watch events in order; returns the updated snapshot.
+
+        Events are validated before any mutation of the failing event is
+        applied — a bad event raises :class:`StoreError` and leaves the
+        store at the state after the last good event.
+        """
+        for ev in events:
+            self.apply_event(ev)
+        return self.snapshot()
+
+    def apply_event(self, event: dict) -> None:
+        etype = event.get("type")
+        kind = event.get("kind")
+        obj = event.get("object")
+        if etype not in ("ADDED", "MODIFIED", "DELETED"):
+            raise StoreError(f"unknown event type {etype!r}")
+        if not isinstance(obj, dict):
+            raise StoreError("event has no object")
+        obj = copy.deepcopy(obj)
+        if kind == "Pod":
+            self._apply_pod(etype, obj)
+        elif kind == "Node":
+            self._apply_node(etype, obj)
+        else:
+            raise StoreError(f"unknown event kind {kind!r}")
+
+    # -- validation (before ANY mutation: a malformed object must never
+    # enter raw state, or it would poison every later recompute AND the
+    # full-repack invariant) ----------------------------------------------
+    def _validate_pod(self, pod: dict) -> tuple[str, str]:
+        try:
+            key = _pod_key(pod)
+            hash(key)
+            hash(pod.get("nodeName", ""))  # it indexes _pods_by_node
+            if self.semantics == "reference":
+                _oracle.pod_requests_limits([pod])
+            else:
+                _effective_pod_resources(pod, self.extended_resources)
+        except Exception as e:
+            raise StoreError(f"malformed pod object: {e}") from e
+        return key
+
+    def _validate_node(self, node: dict) -> None:
+        try:
+            if self.semantics == "reference":
+                # Runs the reference health check too: its <4-conditions
+                # ReferencePanic (Q3) surfaces as-is, pre-mutation, where
+                # the reference process would simply have died.
+                _oracle.healthy_nodes({"nodes": [node]})
+            else:
+                allocatable = node.get("allocatable", {})
+                for k in ("cpu", "memory", "pods", *self.extended_resources):
+                    _strict_parse(allocatable.get(k), milli=(k == "cpu"))
+                _strict_healthy(node.get("conditions", []))
+        except _oracle.ReferencePanic:
+            raise
+        except Exception as e:
+            raise StoreError(f"malformed node object: {e}") from e
+
+    # -- pods --------------------------------------------------------------
+    def _apply_pod(self, etype: str, pod: dict) -> None:
+        key = self._validate_pod(pod)
+        old = self._pods.get(key)
+        if etype == "ADDED" and old is not None:
+            raise StoreError(f"pod {key} already exists")
+        if etype in ("MODIFIED", "DELETED") and old is None:
+            raise StoreError(f"pod {key} not found")
+
+        touched = set()
+        if old is not None:
+            old_node = old.get("nodeName", "")
+            del self._pods_by_node[old_node][key]
+            touched.add(old_node)
+        if etype == "DELETED":
+            del self._pods[key]
+        else:
+            new_node = pod.get("nodeName", "")
+            self._pods[key] = pod
+            self._pods_by_node.setdefault(new_node, {})[key] = pod
+            touched.add(new_node)
+        for node_name in touched:
+            for i in self._rows_matching(node_name):
+                self._recompute_row(i)
+
+    def _rows_matching(self, node_name: str) -> list[int]:
+        """Rows whose pod-match name equals ``node_name``.
+
+        In reference mode every phantom row matches ``""`` — an orphan-pod
+        event touches all of them (the degenerate field selector, Q4).
+        """
+        return [i for i, v in enumerate(self._view_names) if v == node_name]
+
+    # -- nodes -------------------------------------------------------------
+    def _apply_node(self, etype: str, node: dict) -> None:
+        name = node.get("name", "")
+        if etype in ("ADDED", "MODIFIED"):
+            self._validate_node(node)
+        idx = [i for i, n in enumerate(self._nodes) if n.get("name", "") == name]
+        if etype == "ADDED":
+            if idx:
+                raise StoreError(f"node {name!r} already exists")
+            self._append_row()
+            self._nodes.append(node)
+            self._recompute_row(len(self._nodes) - 1)
+        elif etype == "MODIFIED":
+            if not idx:
+                raise StoreError(f"node {name!r} not found")
+            for i in idx:
+                self._nodes[i] = node
+                self._recompute_row(i)
+        else:  # DELETED
+            if not idx:
+                raise StoreError(f"node {name!r} not found")
+            keep = np.ones(len(self._nodes), dtype=bool)
+            keep[idx] = False
+            for c in _INT_COLS:
+                self._cols[c] = self._cols[c][keep]
+            self._healthy = self._healthy[keep]
+            self._ext = {
+                r: (a[keep], u[keep]) for r, (a, u) in self._ext.items()
+            }
+            self._nodes = [n for i, n in enumerate(self._nodes) if keep[i]]
+            self._view_names = [
+                v for i, v in enumerate(self._view_names) if keep[i]
+            ]
+
+    def _append_row(self) -> None:
+        for c in _INT_COLS:
+            self._cols[c] = np.append(self._cols[c], np.int64(0))
+        self._healthy = np.append(self._healthy, False)
+        self._ext = {
+            r: (np.append(a, np.int64(0)), np.append(u, np.int64(0)))
+            for r, (a, u) in self._ext.items()
+        }
+        self._view_names.append("")
+
+    # -- row packing (the single source of per-row truth) ------------------
+    def _node_pods(self, match_name: str) -> list[dict]:
+        return list(self._pods_by_node.get(match_name, {}).values())
+
+    def _recompute_row(self, i: int) -> None:
+        raw = self._nodes[i]
+        if self.semantics == "reference":
+            self._recompute_row_reference(i, raw)
+        else:
+            self._recompute_row_strict(i, raw)
+
+    def _recompute_row_reference(self, i: int, raw: dict) -> None:
+        # Single-node oracle walk: health check (incl. the <4-conditions
+        # panic), reference codecs, phantom zeroing — identical to
+        # _pack_reference's per-node step by construction.
+        view = _oracle.healthy_nodes({"nodes": [raw]})[0]
+        pods = [
+            p
+            for p in self._node_pods(view.name)
+            if _oracle._survives_field_selector(p)
+        ]
+        cpu_lim, cpu_req, mem_lim, mem_req = _oracle.pod_requests_limits(pods)
+        c = self._cols
+        c["alloc_cpu_milli"][i] = _clamp_i64(view.allocatable_cpu)
+        c["alloc_mem_bytes"][i] = _clamp_i64(view.allocatable_memory)
+        c["alloc_pods"][i] = view.allocatable_pods
+        c["used_cpu_req_milli"][i] = _clamp_i64(cpu_req)
+        c["used_cpu_lim_milli"][i] = _clamp_i64(cpu_lim)
+        c["used_mem_req_bytes"][i] = mem_req
+        c["used_mem_lim_bytes"][i] = mem_lim
+        c["pods_count"][i] = len(pods)
+        self._healthy[i] = bool(view.name)
+        self._view_names[i] = view.name
+
+    def _recompute_row_strict(self, i: int, raw: dict) -> None:
+        name = raw.get("name", "")
+        allocatable = raw.get("allocatable", {})
+        c = self._cols
+        c["alloc_cpu_milli"][i] = _strict_parse(allocatable.get("cpu"), milli=True)
+        c["alloc_mem_bytes"][i] = _strict_parse(allocatable.get("memory"))
+        c["alloc_pods"][i] = _strict_parse(allocatable.get("pods"))
+        self._healthy[i] = _strict_healthy(raw.get("conditions", []))
+        self._view_names[i] = name
+
+        totals = dict.fromkeys(
+            ("cpu_req", "cpu_lim", "mem_req", "mem_lim", "count"), 0
+        )
+        ext_used = dict.fromkeys(self.extended_resources, 0)
+        for p in self._node_pods(name):
+            if p.get("phase") in _STRICT_TERMINATED:
+                continue
+            totals["count"] += 1
+            eff = _effective_pod_resources(p, self.extended_resources)
+            for k in ("cpu_req", "cpu_lim", "mem_req", "mem_lim"):
+                totals[k] += eff[k]
+            for r in self.extended_resources:
+                ext_used[r] += eff["ext"][r]
+        c["used_cpu_req_milli"][i] = totals["cpu_req"]
+        c["used_cpu_lim_milli"][i] = totals["cpu_lim"]
+        c["used_mem_req_bytes"][i] = totals["mem_req"]
+        c["used_mem_lim_bytes"][i] = totals["mem_lim"]
+        c["pods_count"][i] = totals["count"]
+        for r in self.extended_resources:
+            self._ext[r][0][i] = _strict_parse(allocatable.get(r))
+            self._ext[r][1][i] = ext_used[r]

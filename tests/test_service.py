@@ -128,6 +128,104 @@ class TestOps:
             c.close()
 
 
+class TestUpdateOp:
+    @pytest.fixture()
+    def fresh(self):
+        """Per-test server: update mutates served state."""
+        fixture = load_fixture(KIND)
+        snap = snapshot_from_fixture(fixture, semantics="reference")
+        srv = CapacityServer(snap, port=0, fixture=fixture)
+        srv.start()
+        c = CapacityClient(*srv.address)
+        yield c
+        c.close()
+        srv.shutdown()
+
+    @staticmethod
+    def _node(name):
+        return {
+            "name": name,
+            "allocatable": {"cpu": "16", "memory": "33554432Ki", "pods": "110"},
+            "conditions": [
+                {"type": t, "status": "False"}
+                for t in ("OutOfDisk", "MemoryPressure", "DiskPressure",
+                          "PIDPressure")
+            ] + [{"type": "Ready", "status": "True"}],
+        }
+
+    def test_node_join_changes_capacity(self, fresh):
+        before = fresh.fit(cpuRequests="200m", memRequests="250mb")["total"]
+        r = fresh.update(
+            [{"type": "ADDED", "kind": "Node", "object": self._node("big")}]
+        )
+        assert r["nodes"] == 4 and r["applied"] == 1
+        after = fresh.fit(cpuRequests="200m", memRequests="250mb")["total"]
+        # 16 cores / 200m = 80 more replicas, pod-cap quirk aside.
+        assert after > before
+
+    def test_pod_events_shift_usage(self, fresh):
+        base = fresh.fit(cpuRequests="1", memRequests="1gb")["total"]
+        pod = {
+            "name": "hog", "namespace": "default",
+            "nodeName": "kind-worker", "phase": "Running",
+            "containers": [{"resources": {"requests":
+                {"cpu": "2", "memory": "4Gi"}}}],
+        }
+        fresh.update([{"type": "ADDED", "kind": "Pod", "object": pod}])
+        squeezed = fresh.fit(cpuRequests="1", memRequests="1gb")["total"]
+        assert squeezed < base
+        fresh.update([{"type": "DELETED", "kind": "Pod", "object": pod}])
+        assert fresh.fit(cpuRequests="1", memRequests="1gb")["total"] == base
+
+    def test_update_matches_full_repack_fit(self, fresh):
+        """Served fits after updates == oracle on the updated fixture."""
+        pod = {
+            "name": "extra", "namespace": "web",
+            "nodeName": "kind-worker2", "phase": "Running",
+            "containers": [{"resources": {"requests":
+                {"cpu": "500m", "memory": "1Gi"}}}],
+        }
+        fresh.update([
+            {"type": "ADDED", "kind": "Node", "object": self._node("n4")},
+            {"type": "ADDED", "kind": "Pod", "object": pod},
+        ])
+        fixture = load_fixture(KIND)
+        fixture["nodes"].append(self._node("n4"))
+        fixture["pods"].append(pod)
+        scen = scenario_from_flags(cpuRequests="300m", memRequests="500mb",
+                                   replicas="10")
+        oracle = reference_run(fixture, scen)
+        got = fresh.fit(cpuRequests="300m", memRequests="500mb", replicas="10")
+        assert got["fits"] == oracle.fits
+        assert got["total"] == oracle.total_possible_replicas
+
+    def test_cpu_backend_sees_updates(self, fresh):
+        """backend=cpu re-derives the fixture lazily from the store."""
+        fresh.update(
+            [{"type": "ADDED", "kind": "Node", "object": self._node("n4")}]
+        )
+        a = fresh.fit(backend="cpu", cpuRequests="200m", memRequests="250mb")
+        b = fresh.fit(backend="tpu", cpuRequests="200m", memRequests="250mb")
+        assert a["fits"] == b["fits"] and len(a["fits"]) == 4
+
+    def test_bad_event_is_error_but_prior_events_stick(self, fresh):
+        with pytest.raises(RuntimeError, match="not found"):
+            fresh.update([
+                {"type": "ADDED", "kind": "Node", "object": self._node("ok")},
+                {"type": "DELETED", "kind": "Node", "object": {"name": "ghost"}},
+            ])
+        assert fresh.info()["nodes"] == 4  # "ok" applied before the failure
+
+    def test_update_after_npz_reload_is_rejected(self, fresh, tmp_path):
+        p = str(tmp_path / "s.npz")
+        snapshot_from_fixture(load_fixture(KIND), semantics="reference").save(p)
+        fresh.reload(p)
+        with pytest.raises(RuntimeError, match="fixture-backed"):
+            fresh.update(
+                [{"type": "ADDED", "kind": "Node", "object": self._node("x")}]
+            )
+
+
 class TestNativeClient:
     @pytest.fixture(scope="class")
     def client_bin(self, tmp_path_factory):

@@ -1,0 +1,275 @@
+"""Incremental store (informer analog) tests.
+
+The load-bearing property: after ANY event stream, the store's snapshot is
+element-identical to a full ``snapshot_from_fixture`` repack of its raw
+state — under both semantics, including the reference quirks (phantom rows
+re-homing orphan pods, mod-2^64 wrap, parse-fail→0).  Randomized event
+streams drive that invariant; directed tests pin the interesting
+transitions (health flips, node joins/leaves, orphan pods).
+"""
+
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.oracle import ReferencePanic
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+from kubernetesclustercapacity_tpu.store import ClusterStore, StoreError
+
+_COLS = (
+    "alloc_cpu_milli",
+    "alloc_mem_bytes",
+    "alloc_pods",
+    "used_cpu_req_milli",
+    "used_cpu_lim_milli",
+    "used_mem_req_bytes",
+    "used_mem_lim_bytes",
+    "pods_count",
+    "healthy",
+)
+
+
+def assert_matches_repack(store: ClusterStore):
+    snap = store.snapshot()
+    repack = snapshot_from_fixture(
+        store.fixture_view(),
+        semantics=store.semantics,
+        extended_resources=store.extended_resources,
+    )
+    assert snap.names == repack.names
+    for col in _COLS:
+        np.testing.assert_array_equal(
+            getattr(snap, col), getattr(repack, col), err_msg=col
+        )
+    assert sorted(snap.extended) == sorted(repack.extended)
+    for r in snap.extended:
+        np.testing.assert_array_equal(snap.extended[r][0], repack.extended[r][0])
+        np.testing.assert_array_equal(snap.extended[r][1], repack.extended[r][1])
+
+
+def _mk_pod(name, node, phase="Running", cpu="250m", mem="512Mi"):
+    return {
+        "name": name,
+        "namespace": "default",
+        "nodeName": node,
+        "phase": phase,
+        "containers": [
+            {"resources": {"requests": {"cpu": cpu, "memory": mem},
+                           "limits": {"cpu": cpu, "memory": mem}}}
+        ],
+    }
+
+
+def _mk_node(name, cpu="8", mem="16777216Ki", healthy=True):
+    conds = [
+        {"type": t, "status": "False"}
+        for t in ("OutOfDisk", "MemoryPressure", "DiskPressure", "PIDPressure")
+    ] + [{"type": "Ready", "status": "True"}]
+    if not healthy:
+        conds[1]["status"] = "True"
+    return {
+        "name": name,
+        "allocatable": {"cpu": cpu, "memory": mem, "pods": "110"},
+        "conditions": conds,
+        "labels": {"kubernetes.io/hostname": name},
+        "taints": [],
+    }
+
+
+class TestDirected:
+    @pytest.mark.parametrize("semantics", ["reference", "strict"])
+    def test_pod_lifecycle(self, semantics):
+        fx = synthetic_fixture(6, seed=3)
+        store = ClusterStore(fx, semantics=semantics)
+        node = fx["nodes"][0]["name"]
+        pod = _mk_pod("newpod", node)
+        store.apply_event({"type": "ADDED", "kind": "Pod", "object": pod})
+        assert_matches_repack(store)
+        moved = dict(pod, nodeName=fx["nodes"][1]["name"])
+        store.apply_event({"type": "MODIFIED", "kind": "Pod", "object": moved})
+        assert_matches_repack(store)
+        store.apply_event({"type": "MODIFIED", "kind": "Pod",
+                           "object": dict(moved, phase="Succeeded")})
+        assert_matches_repack(store)
+        store.apply_event({"type": "DELETED", "kind": "Pod", "object": moved})
+        assert_matches_repack(store)
+
+    @pytest.mark.parametrize("semantics", ["reference", "strict"])
+    def test_node_join_leave_and_health_flip(self, semantics):
+        fx = synthetic_fixture(5, seed=4, unhealthy_frac=0.0)
+        store = ClusterStore(fx, semantics=semantics)
+        store.apply_event(
+            {"type": "ADDED", "kind": "Node", "object": _mk_node("joiner")}
+        )
+        assert_matches_repack(store)
+        # A pod lands on the new node, then the node goes unhealthy: in
+        # reference mode the row becomes a phantom and re-homes to the
+        # orphan-pod set; in strict mode only the mask flips.
+        store.apply_event(
+            {"type": "ADDED", "kind": "Pod", "object": _mk_pod("p1", "joiner")}
+        )
+        assert_matches_repack(store)
+        store.apply_event(
+            {"type": "MODIFIED", "kind": "Node",
+             "object": _mk_node("joiner", healthy=False)}
+        )
+        assert_matches_repack(store)
+        store.apply_event(
+            {"type": "DELETED", "kind": "Node", "object": {"name": "joiner"}}
+        )
+        assert_matches_repack(store)
+        assert "joiner" not in [n["name"] for n in store.fixture_view()["nodes"]]
+
+    def test_orphan_pod_touches_all_phantom_rows_reference(self):
+        fx = synthetic_fixture(8, seed=5, unhealthy_frac=0.4)
+        store = ClusterStore(fx, semantics="reference")
+        n_phantom = int(np.sum(~store.snapshot().healthy))
+        assert n_phantom >= 2  # seed chosen to yield several phantoms
+        before = store.snapshot().pods_count.copy()
+        store.apply_event(
+            {"type": "ADDED", "kind": "Pod", "object": _mk_pod("orphan", "")}
+        )
+        after = store.snapshot().pods_count
+        # Every phantom row counted the orphan; healthy rows untouched.
+        assert int(np.sum(after - before)) == n_phantom
+        assert_matches_repack(store)
+
+    def test_strict_extended_resources_update(self):
+        fx = synthetic_fixture(4, seed=6)
+        fx["nodes"][0]["allocatable"]["nvidia.com/gpu"] = "8"
+        store = ClusterStore(
+            fx, semantics="strict", extended_resources=("nvidia.com/gpu",)
+        )
+        pod = _mk_pod("gpu-pod", fx["nodes"][0]["name"])
+        pod["containers"][0]["resources"]["requests"]["nvidia.com/gpu"] = "3"
+        store.apply_event({"type": "ADDED", "kind": "Pod", "object": pod})
+        alloc, used = store.snapshot().extended["nvidia.com/gpu"]
+        assert alloc[0] == 8 and used[0] == 3
+        assert_matches_repack(store)
+
+    def test_bad_events_raise_and_leave_state_intact(self):
+        fx = synthetic_fixture(3, seed=7)
+        store = ClusterStore(fx, semantics="reference")
+        before = store.snapshot()
+        node0 = fx["nodes"][0]["name"]
+        existing = store.fixture_view()["pods"][0]
+        for ev in (
+            {"type": "BOGUS", "kind": "Pod", "object": _mk_pod("x", node0)},
+            {"type": "ADDED", "kind": "Gizmo", "object": {}},
+            {"type": "ADDED", "kind": "Pod", "object": existing},
+            {"type": "DELETED", "kind": "Pod", "object": _mk_pod("ghost", node0)},
+            {"type": "MODIFIED", "kind": "Node", "object": _mk_node("ghost")},
+            {"type": "ADDED", "kind": "Node", "object": fx["nodes"][0]},
+        ):
+            with pytest.raises(StoreError):
+                store.apply_event(ev)
+        after = store.snapshot()
+        for col in _COLS:
+            np.testing.assert_array_equal(
+                getattr(before, col), getattr(after, col)
+            )
+
+    @pytest.mark.parametrize("semantics", ["reference", "strict"])
+    def test_malformed_objects_rejected_without_poisoning(self, semantics):
+        """A malformed object must never enter raw state: later events on
+        the same node and the full-repack invariant must keep working."""
+        fx = synthetic_fixture(4, seed=11)
+        store = ClusterStore(fx, semantics=semantics)
+        node0 = fx["nodes"][0]["name"]
+        bad_objects = [
+            {"name": "bad", "namespace": "d", "nodeName": node0,
+             "phase": "Running", "containers": "oops"},
+            {"name": "bad", "namespace": "d", "nodeName": node0,
+             "phase": "Running",
+             "containers": [{"resources": {"requests": 7}}]},
+            {"name": ["unhashable"], "namespace": "d", "nodeName": node0,
+             "phase": "Running", "containers": []},
+            {"name": "bad", "namespace": "d", "nodeName": {},
+             "phase": "Running", "containers": []},
+        ]
+        for obj in bad_objects:
+            with pytest.raises(StoreError, match="malformed pod"):
+                store.apply_event({"type": "ADDED", "kind": "Pod", "object": obj})
+        with pytest.raises(StoreError, match="malformed node"):
+            store.apply_event({"type": "ADDED", "kind": "Node",
+                               "object": {"name": "badnode",
+                                          "allocatable": "oops",
+                                          "conditions": []}})
+        # The store still works: a good event on the same node applies and
+        # the state is still repackable.
+        store.apply_event(
+            {"type": "ADDED", "kind": "Pod", "object": _mk_pod("good", node0)}
+        )
+        assert_matches_repack(store)
+        assert "bad" not in [p["name"] for p in store.fixture_view()["pods"]]
+
+    def test_reference_panic_node_is_rejected_without_mutation(self):
+        store = ClusterStore(synthetic_fixture(3, seed=8), semantics="reference")
+        bad = _mk_node("short-conds")
+        bad["conditions"] = bad["conditions"][:2]  # <4 → reference panic (Q3)
+        with pytest.raises(ReferencePanic):
+            store.apply_event({"type": "ADDED", "kind": "Node", "object": bad})
+        assert store.n_nodes == 3
+        assert_matches_repack(store)
+
+    def test_events_do_not_alias_caller_objects(self):
+        fx = synthetic_fixture(3, seed=9)
+        store = ClusterStore(fx, semantics="strict")
+        pod = _mk_pod("aliased", fx["nodes"][0]["name"])
+        store.apply_event({"type": "ADDED", "kind": "Pod", "object": pod})
+        pod["containers"][0]["resources"]["requests"]["cpu"] = "4000"
+        assert_matches_repack(store)  # mutation above must not leak in
+        fx["nodes"][0]["allocatable"]["cpu"] = "999"
+        assert_matches_repack(store)
+
+
+class TestRandomizedInvariant:
+    @pytest.mark.parametrize("semantics", ["reference", "strict"])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_event_stream_matches_repack(self, semantics, seed):
+        rng = random.Random(seed)
+        fx = synthetic_fixture(
+            10, seed=seed, unhealthy_frac=0.2, unscheduled_running_pods=2
+        )
+        store = ClusterStore(fx, semantics=semantics)
+        pod_serial = 0
+        for step in range(60):
+            live = store.fixture_view()
+            node_names = [n["name"] for n in live["nodes"]]
+            roll = rng.random()
+            if roll < 0.35 or not live["pods"]:
+                pod_serial += 1
+                target = rng.choice(node_names + ["", "nowhere"])
+                phase = rng.choice(["Running", "Pending", "Succeeded"])
+                ev = {"type": "ADDED", "kind": "Pod",
+                      "object": _mk_pod(f"r{seed}-{pod_serial}", target,
+                                        phase=phase,
+                                        cpu=rng.choice(["100m", "1", "2"]),
+                                        mem=rng.choice(["128Mi", "1Gi"]))}
+            elif roll < 0.55:
+                victim = copy.deepcopy(rng.choice(live["pods"]))
+                ev = {"type": "DELETED", "kind": "Pod", "object": victim}
+            elif roll < 0.75:
+                victim = copy.deepcopy(rng.choice(live["pods"]))
+                victim["nodeName"] = rng.choice(node_names + [""])
+                victim["phase"] = rng.choice(["Running", "Failed", "Unknown"])
+                ev = {"type": "MODIFIED", "kind": "Pod", "object": victim}
+            elif roll < 0.85:
+                ev = {"type": "ADDED", "kind": "Node",
+                      "object": _mk_node(f"join-{seed}-{step}",
+                                         healthy=rng.random() > 0.3)}
+            elif roll < 0.95 and node_names:
+                name = rng.choice(node_names)
+                ev = {"type": "MODIFIED", "kind": "Node",
+                      "object": _mk_node(name, cpu=rng.choice(["4", "16"]),
+                                         healthy=rng.random() > 0.3)}
+            else:
+                ev = {"type": "DELETED", "kind": "Node",
+                      "object": {"name": rng.choice(node_names)}}
+            store.apply_event(ev)
+            if step % 10 == 9:
+                assert_matches_repack(store)
+        assert_matches_repack(store)
