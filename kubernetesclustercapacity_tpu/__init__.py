@@ -62,3 +62,4 @@ from kubernetesclustercapacity_tpu.ops.fit import (  # noqa: E402,F401
     sweep_snapshot,
 )
 from kubernetesclustercapacity_tpu.store import ClusterStore  # noqa: E402,F401
+from kubernetesclustercapacity_tpu.follower import ClusterFollower  # noqa: E402,F401

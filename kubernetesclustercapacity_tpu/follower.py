@@ -1,0 +1,233 @@
+"""Live cluster follower — the full list+watch informer loop.
+
+The reference re-walks the whole apiserver (``1 + 2N + ΣP`` requests,
+SURVEY.md §3.4) every time it runs.  This module is the end state of the
+TPU-native redesign's ingestion side: list once (two paginated Lists,
+:mod:`.kubeapi`), pack once (:class:`~.store.ClusterStore`), then stay
+synced through the Kubernetes *watch* protocol — each cluster change costs
+one streamed event and one per-row array update, and every
+:meth:`ClusterFollower.snapshot` call is a consistent packed snapshot ready
+for the fit kernels.
+
+Watch-protocol handling follows the standard informer contract:
+
+* resume each re-watch from the last seen ``metadata.resourceVersion``;
+* ``BOOKMARK`` events only advance the resume version;
+* ``ERROR`` events (e.g. 410 Gone — version expired) and any transport
+  failure trigger a full relist+repack;
+* ``ADDED``/``MODIFIED`` are applied as upserts (a relist race can replay
+  either for an object the store already has), ``DELETED`` of an unknown
+  object is ignored.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from kubernetesclustercapacity_tpu.kubeapi import (
+    KubeAPIError,
+    KubeClient,
+    KubeConfig,
+    node_to_fixture,
+    pod_to_fixture,
+)
+from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+from kubernetesclustercapacity_tpu.store import ClusterStore, StoreError
+
+__all__ = ["ClusterFollower"]
+
+_RESOURCES = {
+    "/api/v1/nodes": ("Node", node_to_fixture),
+    "/api/v1/pods": ("Pod", pod_to_fixture),
+}
+
+
+class ClusterFollower:
+    """Keep a packed :class:`ClusterStore` synced to a live cluster."""
+
+    def __init__(
+        self,
+        kubeconfig: str | None = None,
+        *,
+        semantics: str = "reference",
+        context: str | None = None,
+        client_factory=None,
+        on_event=None,
+        stop_on_idle_window: bool = False,
+        idle_rewatch_backoff: float = 1.0,
+    ) -> None:
+        """``client_factory() -> KubeClient`` builds one client per stream
+        (each watch occupies a connection); defaults to clients over the
+        given kubeconfig.  ``on_event(kind, type, obj)`` is an optional
+        observer called after each applied event.
+
+        A real apiserver regularly ends watch windows with no events and no
+        version progress; the follower re-watches after
+        ``idle_rewatch_backoff`` seconds.  ``stop_on_idle_window=True``
+        instead ends that resource's watch loop — ONLY for tests driving
+        finite mock streams; in production it would silently stop syncing.
+        """
+        if client_factory is None:
+            config = KubeConfig.load(kubeconfig, context=context)
+
+            def client_factory() -> KubeClient:  # noqa: F811 - default
+                return KubeClient(config)
+
+        self._factory = client_factory
+        self._semantics = semantics
+        self.on_event = on_event
+        self._stop_on_idle_window = stop_on_idle_window
+        self._idle_backoff = idle_rewatch_backoff
+        self._lock = threading.Lock()
+        self._store: ClusterStore | None = None
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._versions: dict[str, str] = {}
+        self._errors: collections.deque = collections.deque(maxlen=100)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, *, watch: bool = True) -> "ClusterFollower":
+        """List+pack, then follow both watch streams in daemon threads.
+
+        ``watch=False`` stops after the initial list+pack (synchronous);
+        call :meth:`start_watches` to begin streaming — useful to install
+        :attr:`on_event` consumers race-free between the two phases.
+        """
+        self._relist()
+        if watch:
+            self.start_watches()
+        return self
+
+    def start_watches(self) -> None:
+        for path in _RESOURCES:
+            t = threading.Thread(
+                target=self._watch_loop, args=(path,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float | None = None) -> None:
+        """Wait for the watch streams to end (tests: finite mock streams)."""
+        for t in self._threads:
+            t.join(timeout)
+
+    def wait_synced(self, timeout: float | None = None) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- state -------------------------------------------------------------
+    def snapshot(self) -> ClusterSnapshot:
+        """A consistent packed snapshot of the followed cluster."""
+        with self._lock:
+            if self._store is None:
+                raise RuntimeError("follower not started")
+            return self._store.snapshot()
+
+    def fixture_view(self) -> dict:
+        with self._lock:
+            if self._store is None:
+                raise RuntimeError("follower not started")
+            return self._store.fixture_view()
+
+    @property
+    def errors(self) -> list[str]:
+        """Recent transport/apply errors (each followed by a relist;
+        bounded to the last 100)."""
+        return list(self._errors)
+
+    # -- internals ---------------------------------------------------------
+    def _relist(self) -> None:
+        """Full list of both resources → fresh store, under one lock hold."""
+        client = self._factory()
+        try:
+            fixture: dict = {"nodes": [], "pods": []}
+            versions = {}
+            for path, (kind, convert) in _RESOURCES.items():
+                items, version = client.list_with_version(path)
+                key = "nodes" if kind == "Node" else "pods"
+                fixture[key] = [convert(o) for o in items]
+                versions[path] = version
+            store = ClusterStore(fixture, semantics=self._semantics)
+        finally:
+            client.close()
+        with self._lock:
+            self._store = store
+            self._versions = versions
+        self._synced.set()
+
+    def _watch_loop(self, path: str) -> None:
+        kind, convert = _RESOURCES[path]
+        while not self._stop.is_set():
+            version = self._versions.get(path)
+            try:
+                stream_ended = self._consume_stream(path, kind, convert, version)
+            except (KubeAPIError, StoreError) as e:
+                self._errors.append(f"{path}: {e}")
+                if self._stop.is_set():
+                    return
+                try:
+                    self._relist()  # 410 Gone / transport loss / bad apply
+                except KubeAPIError as e2:
+                    self._errors.append(f"relist {path}: {e2}")
+                    return  # cluster unreachable; keep last good snapshot
+                continue
+            if stream_ended:
+                if version == self._versions.get(path):
+                    # Window ended with no progress (idle cluster, or a
+                    # finite mock stream under test).
+                    if self._stop_on_idle_window:
+                        return
+                    # Back off before re-watching so a server that closes
+                    # instantly cannot drive a hot loop; interruptible.
+                    self._stop.wait(self._idle_backoff)
+                continue  # re-watch from the latest seen version
+
+    def _consume_stream(self, path, kind, convert, version) -> bool:
+        client = self._factory()
+        try:
+            for event in client.watch_events(
+                path, resource_version=version or None
+            ):
+                if self._stop.is_set():
+                    return False
+                etype = event.get("type", "")
+                obj = event.get("object") or {}
+                if etype == "BOOKMARK":
+                    rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    if rv:
+                        self._versions[path] = rv
+                    continue
+                if etype == "ERROR":
+                    raise KubeAPIError(
+                        f"watch error event: {obj.get('message', obj)}"
+                    )
+                rv = (obj.get("metadata") or {}).get("resourceVersion")
+                self._apply(kind, etype, convert(obj))
+                if rv:
+                    self._versions[path] = rv
+            return True
+        finally:
+            client.close()
+
+    def _apply(self, kind: str, etype: str, obj: dict) -> None:
+        with self._lock:
+            store = self._store
+            if kind == "Node":
+                exists = store.has_node(obj.get("name", ""))
+            else:
+                exists = store.has_pod(
+                    obj.get("namespace", ""), obj.get("name", "")
+                )
+            # Upsert translation: relist races can replay ADDED for known
+            # objects or DELETED for unknown ones; both are benign.
+            if etype in ("ADDED", "MODIFIED"):
+                etype = "MODIFIED" if exists else "ADDED"
+            elif etype == "DELETED" and not exists:
+                return
+            store.apply_event({"type": etype, "kind": kind, "object": obj})
+        if self.on_event is not None:
+            self.on_event(kind, etype, obj)

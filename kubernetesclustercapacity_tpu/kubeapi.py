@@ -39,6 +39,8 @@ __all__ = [
     "KubeClient",
     "default_kubeconfig_path",
     "live_fixture",
+    "node_to_fixture",
+    "pod_to_fixture",
 ]
 
 
@@ -245,13 +247,17 @@ class KubeClient:
         self._ssl = config.ssl_context() if u.scheme == "https" else None
         self._conn: http.client.HTTPConnection | None = None
 
-    def _connect(self) -> http.client.HTTPConnection:
+    def _connect(
+        self, *, timeout: float | None = -1.0
+    ) -> http.client.HTTPConnection:
+        if timeout == -1.0:
+            timeout = self.timeout
         if self._scheme == "https":
             return http.client.HTTPSConnection(
-                self._host, self._port, timeout=self.timeout, context=self._ssl
+                self._host, self._port, timeout=timeout, context=self._ssl
             )
         return http.client.HTTPConnection(
-            self._host, self._port, timeout=self.timeout
+            self._host, self._port, timeout=timeout
         )
 
     def close(self) -> None:
@@ -279,7 +285,7 @@ class KubeClient:
         """GET over a persistent keep-alive connection (one TLS handshake
         per client, not per page); a stale connection is retried once."""
         query = urllib.parse.urlencode(
-            {k: v for k, v in (params or {}).items() if v}
+            {k: v for k, v in (params or {}).items() if v is not None}
         )
         url = self._prefix + path + (f"?{query}" if query else "")
         try:
@@ -308,16 +314,103 @@ class KubeClient:
         self, path: str, *, limit: int = 500, field_selector: str | None = None
     ):
         """Paginated List: follow ``metadata.continue`` until exhausted."""
+        items, _ = self.list_with_version(
+            path, limit=limit, field_selector=field_selector
+        )
+        yield from items
+
+    def list_with_version(
+        self, path: str, *, limit: int = 500, field_selector: str | None = None
+    ) -> tuple[list, str]:
+        """Paginated List returning ``(items, resourceVersion)``.
+
+        The resourceVersion of the final page is the point a subsequent
+        watch resumes from (the standard list+watch contract).
+        """
+        items: list = []
         token: str | None = None
+        version = ""
         while True:
             page = self.get_json(
                 path,
                 {"limit": limit, "continue": token, "fieldSelector": field_selector},
             )
-            yield from page.get("items") or []
-            token = (page.get("metadata") or {}).get("continue")
+            items.extend(page.get("items") or [])
+            meta = page.get("metadata") or {}
+            version = meta.get("resourceVersion") or version
+            token = meta.get("continue")
             if not token:
-                return
+                return items, version
+
+    def watch_events(
+        self,
+        path: str,
+        *,
+        resource_version: str | None = None,
+        field_selector: str | None = None,
+        timeout_seconds: int | None = 300,
+        read_timeout: float | None = None,
+    ):
+        """Stream watch events for one resource until the server ends it.
+
+        Yields the decoded ``{"type": ..., "object": ...}`` dicts of the
+        Kubernetes watch protocol (newline-delimited JSON over a chunked
+        response).  The generator exits when the server closes the stream;
+        callers re-watch from the last seen
+        ``object.metadata.resourceVersion``.  A dedicated client should own
+        a watch — the connection is occupied for the stream's lifetime.
+
+        Idle-cluster handling: the window is bounded *server-side* via
+        ``timeoutSeconds`` (which ends the stream cleanly) while the client
+        socket has NO read timeout by default — an idle watch must block,
+        not raise ``socket.timeout`` and masquerade as a transport failure.
+        """
+        query = urllib.parse.urlencode(
+            {
+                k: v
+                for k, v in {
+                    "watch": "1",
+                    "resourceVersion": resource_version,
+                    "fieldSelector": field_selector,
+                    "allowWatchBookmarks": "true",
+                    "timeoutSeconds": timeout_seconds,
+                }.items()
+                if v is not None
+            }
+        )
+        url = f"{self._prefix}{path}?{query}"
+        self.close()  # a watch always runs on its own fresh connection
+        conn = self._connect(timeout=read_timeout)
+        try:
+            conn.request(
+                "GET",
+                url,
+                headers={"Accept": "application/json", **self.config.auth_headers()},
+            )
+            resp = conn.getresponse()
+            if resp.status // 100 != 2:
+                body = resp.read()
+                raise KubeAPIError(
+                    f"WATCH {path} -> {resp.status} {resp.reason}: "
+                    f"{body[:200].decode(errors='replace')}"
+                )
+            while True:
+                line = resp.readline()
+                if not line:
+                    return  # server closed the watch window
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except ValueError as e:
+                    raise KubeAPIError(
+                        f"WATCH {path}: invalid event frame: {e}"
+                    ) from e
+        except (OSError, http.client.HTTPException) as e:
+            raise KubeAPIError(f"WATCH {path} failed: {e}") from e
+        finally:
+            conn.close()
 
 
 def _containers_fixture(containers: list | None) -> list:
@@ -333,6 +426,47 @@ def _containers_fixture(containers: list | None) -> list:
             }
         )
     return out
+
+
+def node_to_fixture(n: dict) -> dict:
+    """K8s REST Node object → the framework's fixture-schema node."""
+    status = n.get("status") or {}
+    spec = n.get("spec") or {}
+    meta = n.get("metadata") or {}
+    return {
+        "name": meta.get("name", ""),
+        "allocatable": {
+            k: str(v) for k, v in (status.get("allocatable") or {}).items()
+        },
+        "conditions": [
+            {"type": c.get("type", ""), "status": c.get("status", "")}
+            for c in (status.get("conditions") or [])
+        ],
+        "labels": dict(meta.get("labels") or {}),
+        "taints": [
+            {
+                "key": t.get("key", ""),
+                "value": t.get("value", "") or "",
+                "effect": t.get("effect", ""),
+            }
+            for t in (spec.get("taints") or [])
+        ],
+    }
+
+
+def pod_to_fixture(p: dict) -> dict:
+    """K8s REST Pod object → the framework's fixture-schema pod."""
+    meta = p.get("metadata") or {}
+    spec = p.get("spec") or {}
+    status = p.get("status") or {}
+    return {
+        "name": meta.get("name", ""),
+        "namespace": meta.get("namespace", ""),
+        "nodeName": spec.get("nodeName") or "",
+        "phase": status.get("phase", ""),
+        "containers": _containers_fixture(spec.get("containers")),
+        "initContainers": _containers_fixture(spec.get("initContainers")),
+    }
 
 
 def live_fixture(
@@ -356,44 +490,9 @@ def live_fixture(
 
     fixture: dict = {"nodes": [], "pods": []}
     for n in client.list_all("/api/v1/nodes", limit=page_limit):
-        status = n.get("status") or {}
-        spec = n.get("spec") or {}
-        meta = n.get("metadata") or {}
-        fixture["nodes"].append(
-            {
-                "name": meta.get("name", ""),
-                "allocatable": {
-                    k: str(v) for k, v in (status.get("allocatable") or {}).items()
-                },
-                "conditions": [
-                    {"type": c.get("type", ""), "status": c.get("status", "")}
-                    for c in (status.get("conditions") or [])
-                ],
-                "labels": dict(meta.get("labels") or {}),
-                "taints": [
-                    {
-                        "key": t.get("key", ""),
-                        "value": t.get("value", "") or "",
-                        "effect": t.get("effect", ""),
-                    }
-                    for t in (spec.get("taints") or [])
-                ],
-            }
-        )
+        fixture["nodes"].append(node_to_fixture(n))
     for p in client.list_all("/api/v1/pods", limit=page_limit):
-        meta = p.get("metadata") or {}
-        spec = p.get("spec") or {}
-        status = p.get("status") or {}
-        fixture["pods"].append(
-            {
-                "name": meta.get("name", ""),
-                "namespace": meta.get("namespace", ""),
-                "nodeName": spec.get("nodeName") or "",
-                "phase": status.get("phase", ""),
-                "containers": _containers_fixture(spec.get("containers")),
-                "initContainers": _containers_fixture(spec.get("initContainers")),
-            }
-        )
+        fixture["pods"].append(pod_to_fixture(p))
     if own_client:
         client.close()
     return fixture

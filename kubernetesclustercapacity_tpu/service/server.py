@@ -107,14 +107,21 @@ class CapacityServer:
         # when an op actually consumes it (cpu-backend fit), not on every
         # watch-event batch.
         with self._lock:
+            snap = self.snapshot
             if (
                 self._fixture_dirty
                 and op == "fit"
                 and msg.get("backend") == "cpu"
+                and snap.semantics == "reference"
             ):
+                # The one path that reads the raw fixture (_op_fit's
+                # reference cpu cross-check) rebuilds it here, under the
+                # same lock hold that captured the snapshot.
                 self.fixture = self._store.fixture_view()
                 self._fixture_dirty = False
-            snap, fixture = self.snapshot, self.fixture
+            # A dirty fixture is NEVER served: consumers see None (and
+            # fall back to packed-array walks) rather than stale objects.
+            fixture = None if self._fixture_dirty else self.fixture
         if op == "info":
             return {
                 "nodes": snap.n_nodes,
@@ -222,15 +229,21 @@ class CapacityServer:
             "scenarios": grid.size,
         }
 
+    def replace_snapshot(
+        self, snapshot: ClusterSnapshot, fixture: dict | None = None
+    ) -> None:
+        """Atomically swap the served snapshot (e.g. from a live follower)."""
+        with self._lock:
+            self.snapshot = snapshot
+            self.fixture = fixture
+            self._store = None  # stale after a wholesale replace
+            self._fixture_dirty = False
+
     def _op_reload(self, msg: dict) -> dict:
         new_fixture, new_snap, _ = resolve_source(
             msg["path"], msg.get("semantics")
         )
-        with self._lock:
-            self.snapshot = new_snap
-            self.fixture = new_fixture
-            self._store = None  # stale after a wholesale replace
-            self._fixture_dirty = False
+        self.replace_snapshot(new_snap, new_fixture)
         return {"nodes": new_snap.n_nodes, "semantics": new_snap.semantics}
 
     def _op_update(self, msg: dict) -> dict:
@@ -277,21 +290,47 @@ def main(argv=None) -> int:
     import sys
 
     p = argparse.ArgumentParser(prog="kccap-server")
-    p.add_argument("-snapshot", required=True)
+    p.add_argument("-snapshot", default=None,
+                   help="fixture .json / checkpoint .npz to serve")
+    p.add_argument("-follow", action="store_true",
+                   help="serve a live cluster and stay synced (list+watch)")
+    p.add_argument("-kubeconfig", default=None,
+                   help="kubeconfig for -follow (default: $KUBECONFIG or "
+                        "$HOME/.kube/config)")
     p.add_argument("-port", type=int, default=7077)
     p.add_argument("-host", default="127.0.0.1")
     p.add_argument("-semantics", choices=("reference", "strict"),
                    default=None)
     args = p.parse_args(argv)
 
+    follower = None
     try:
-        fixture, snap, _ = resolve_source(args.snapshot, args.semantics)
+        if args.follow:
+            from kubernetesclustercapacity_tpu.follower import ClusterFollower
+
+            follower = ClusterFollower(
+                args.kubeconfig, semantics=args.semantics or "reference"
+            ).start(watch=False)
+            snap, fixture = follower.snapshot(), follower.fixture_view()
+        elif args.snapshot:
+            fixture, snap, _ = resolve_source(args.snapshot, args.semantics)
+        else:
+            raise ValueError("one of -snapshot or -follow is required")
     except Exception as e:
         print(f"ERROR : {e}", file=sys.stderr)
         return 1
     server = CapacityServer(
         snap, host=args.host, port=args.port, fixture=fixture
     )
+    if follower is not None:
+        # Every applied watch event pushes a fresh snapshot (O(N) array
+        # copies, no raw-object deepcopy) into the server; queries between
+        # events serve the last consistent state.  The raw fixture is left
+        # unset — the cpu cross-check backend walks the packed arrays.
+        follower.on_event = lambda kind, etype, obj: server.replace_snapshot(
+            follower.snapshot()
+        )
+        follower.start_watches()  # after wiring: no event can be missed
     print(
         f"serving {snap.n_nodes} nodes ({snap.semantics}) on "
         f"{server.address[0]}:{server.address[1]}",

@@ -101,6 +101,12 @@ class ClusterStore:
     def n_nodes(self) -> int:
         return len(self._nodes)
 
+    def has_node(self, name: str) -> bool:
+        return any(n.get("name", "") == name for n in self._nodes)
+
+    def has_pod(self, namespace: str, name: str) -> bool:
+        return (namespace, name) in self._pods
+
     def fixture_view(self) -> dict:
         """Current raw state in fixture schema (deep copy)."""
         return copy.deepcopy(

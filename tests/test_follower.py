@@ -1,0 +1,202 @@
+"""List+watch follower tests against the mock apiserver.
+
+The invariant carried over from the store tests: at every point the
+follower's snapshot is element-identical to a full repack of its raw
+state — and after a finite watch stream, that state is exactly the initial
+List plus the events.
+"""
+
+import json
+
+import pytest
+
+from kubernetesclustercapacity_tpu.follower import ClusterFollower
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.kubeapi import KubeClient, KubeConfig
+
+from test_kubeapi import MockApiserver, _k8s_node, _k8s_pod
+from test_store import _mk_node, _mk_pod, assert_matches_repack
+
+NODES, PODS = "/api/v1/nodes", "/api/v1/pods"
+
+
+def _with_rv(obj: dict, rv: int) -> dict:
+    obj = json.loads(json.dumps(obj))
+    obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
+    return obj
+
+
+@pytest.fixture()
+def srv():
+    fixture = synthetic_fixture(6, seed=21, unhealthy_frac=0.0)
+    server = MockApiserver(fixture, require_token="tok")
+    yield fixture, server
+    server.close()
+
+
+def _follower(server, **kw) -> ClusterFollower:
+    cfg = KubeConfig(f"http://127.0.0.1:{server.port}", token="tok")
+    kw.setdefault("stop_on_idle_window", True)  # finite mock streams
+    return ClusterFollower(client_factory=lambda: KubeClient(cfg), **kw)
+
+
+class TestFollower:
+    def test_list_then_watch_applies_events(self, srv):
+        fixture, server = srv
+        node0 = fixture["nodes"][0]["name"]
+        joiner = _mk_node("late-joiner")
+        newpod = _mk_pod("streamed", "late-joiner")
+        victim = fixture["pods"][0]
+        moved = dict(fixture["pods"][1], phase="Succeeded")
+        server.watch_streams = {
+            NODES: [[{"type": "ADDED", "object": _with_rv(_k8s_node(joiner), 501)}]],
+            PODS: [[
+                {"type": "ADDED", "object": _with_rv(_k8s_pod(newpod), 601)},
+                {"type": "DELETED", "object": _with_rv(_k8s_pod(victim), 602)},
+                {"type": "MODIFIED", "object": _with_rv(_k8s_pod(moved), 603)},
+            ]],
+        }
+        f = _follower(server, semantics="reference").start()
+        assert f.wait_synced(5)
+        f.join(10)
+
+        view = f.fixture_view()
+        names = [n["name"] for n in view["nodes"]]
+        assert "late-joiner" in names and node0 in names
+        pod_names = [p["name"] for p in view["pods"]]
+        assert "streamed" in pod_names
+        assert victim["name"] not in pod_names
+        changed = [p for p in view["pods"] if p["name"] == moved["name"]][0]
+        assert changed["phase"] == "Succeeded"
+        # Store invariant still holds through the streamed mutations.
+        with f._lock:
+            assert_matches_repack(f._store)
+        assert f.errors == []
+
+    def test_initial_snapshot_matches_live_fixture(self, srv):
+        fixture, server = srv
+        f = _follower(server, semantics="strict").start()
+        assert f.wait_synced(5)
+        snap = f.snapshot()
+        assert snap.n_nodes == len(fixture["nodes"])
+        assert snap.semantics == "strict"
+        f.stop()
+
+    def test_upsert_and_unknown_delete_are_benign(self, srv):
+        fixture, server = srv
+        existing = fixture["nodes"][0]
+        ghost = _mk_pod("never-existed", existing["name"])
+        replayed = dict(existing)
+        replayed["allocatable"] = dict(
+            existing["allocatable"], cpu="64"
+        )  # replayed ADDED with changed content must apply as MODIFIED
+        server.watch_streams = {
+            NODES: [[{"type": "ADDED",
+                      "object": _with_rv(_k8s_node(replayed), 511)}]],
+            PODS: [[{"type": "DELETED",
+                     "object": _with_rv(_k8s_pod(ghost), 611)}]],
+        }
+        f = _follower(server).start()
+        assert f.wait_synced(5)
+        f.join(10)
+        assert f.errors == []
+        view = f.fixture_view()
+        got = [n for n in view["nodes"] if n["name"] == existing["name"]][0]
+        assert got["allocatable"]["cpu"] == "64"
+        assert len(view["nodes"]) == len(fixture["nodes"])  # no duplicate
+
+    def test_error_event_triggers_relist(self, srv):
+        fixture, server = srv
+        # The pods watch dies with 410 Gone; by then the "cluster" has a new
+        # node that only a relist can discover.
+        server.watch_streams = {
+            PODS: [[{"type": "ERROR",
+                     "object": {"code": 410, "message": "too old"}}]],
+        }
+        late = _mk_node("relist-only")
+        server.items[NODES] = server.items[NODES] + [_k8s_node(late)]
+        f = _follower(server).start()
+        assert f.wait_synced(5)
+        f.join(10)
+        assert any("watch error" in e for e in f.errors)
+        assert "relist-only" in [n["name"] for n in f.fixture_view()["nodes"]]
+
+    def test_bookmark_advances_version_only(self, srv):
+        fixture, server = srv
+        server.watch_streams = {
+            NODES: [[{"type": "BOOKMARK",
+                      "object": {"metadata": {"resourceVersion": "999"}}}]],
+        }
+        f = _follower(server).start()
+        assert f.wait_synced(5)
+        n_before = f.snapshot().n_nodes
+        f.join(10)
+        assert f.snapshot().n_nodes == n_before
+        assert f._versions[NODES] == "999"
+
+    def test_follow_mode_feeds_capacity_server(self, srv):
+        """The -follow wiring: watch events reach clients of the service."""
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        fixture, server = srv
+        server.watch_streams = {
+            NODES: [[{"type": "ADDED",
+                      "object": _with_rv(_k8s_node(_mk_node("fed")), 888)}]],
+        }
+        f = _follower(server, semantics="reference").start(watch=False)
+        assert f.wait_synced(5)
+        cap = CapacityServer(f.snapshot(), port=0)
+        cap.start()
+        f.on_event = lambda k, t, o: cap.replace_snapshot(f.snapshot())
+        f.start_watches()
+        try:
+            f.join(10)
+            with CapacityClient(*cap.address) as c:
+                info = c.info()
+                assert info["nodes"] == len(fixture["nodes"]) + 1
+                # Both backends agree on the followed snapshot (no raw
+                # fixture server-side: cpu walks the packed arrays).
+                a = c.fit(backend="cpu", cpuRequests="250m",
+                          memRequests="250mb")
+                b = c.fit(backend="tpu", cpuRequests="250m",
+                          memRequests="250mb")
+                assert a["fits"] == b["fits"]
+        finally:
+            cap.shutdown()
+            f.stop()
+
+    def test_idle_window_rewatches_by_default(self, srv):
+        """Production default: an idle watch window ends → back off and
+        re-watch (never silently stop following a resource)."""
+        import time
+
+        _, server = srv
+        f = _follower(
+            server, stop_on_idle_window=False, idle_rewatch_backoff=0.05
+        ).start()
+        assert f.wait_synced(5)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            watch_calls = [r for r in server.requests if "watch=1" in r]
+            if len(watch_calls) >= 6:  # several re-watches across resources
+                break
+            time.sleep(0.05)
+        assert len([r for r in server.requests if "watch=1" in r]) >= 6
+        # Server-side window bound + no client read timeout on the stream.
+        assert all("timeoutSeconds=300" in r for r in watch_calls)
+        f.stop()
+
+    def test_on_event_observer(self, srv):
+        _, server = srv
+        seen = []
+        server.watch_streams = {
+            NODES: [[{"type": "ADDED",
+                      "object": _with_rv(_k8s_node(_mk_node("obs")), 777)}]],
+        }
+        f = _follower(server, on_event=lambda k, t, o: seen.append((k, t, o["name"])))
+        f.start()
+        f.join(10)
+        assert ("Node", "ADDED", "obs") in seen

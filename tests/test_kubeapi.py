@@ -60,7 +60,13 @@ def _k8s_pod(p: dict) -> dict:
 
 
 class MockApiserver:
-    """Paginated read-only apiserver over the fixture schema."""
+    """Paginated + watchable apiserver over the fixture schema.
+
+    ``watch_streams[path]`` is a queue of streams; each watch request pops
+    one (or gets an instantly-ended empty stream) and receives its events
+    as newline-delimited JSON.  Every List response carries a fresh
+    ``resourceVersion`` so the list+watch resume contract is exercised.
+    """
 
     def __init__(self, fixture: dict, *, require_token: str | None = None):
         self.items = {
@@ -68,6 +74,8 @@ class MockApiserver:
             "/api/v1/pods": [_k8s_pod(p) for p in fixture["pods"]],
         }
         self.requests: list[str] = []
+        self.watch_streams: dict[str, list[list]] = {}
+        self._rv = 100
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -94,11 +102,25 @@ class MockApiserver:
                 if items is None:
                     return fail(404)
                 q = parse_qs(u.query)
+                if q.get("watch"):
+                    streams = outer.watch_streams.get(u.path) or []
+                    events = streams.pop(0) if streams else []
+                    body = b"".join(
+                        json.dumps(e).encode() + b"\n" for e in events
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 limit = int(q.get("limit", ["500"])[0])
                 start = int(q.get("continue", ["0"])[0] or 0)
                 page = items[start : start + limit]
                 nxt = start + limit
                 meta = {"continue": str(nxt)} if nxt < len(items) else {}
+                outer._rv += 1
+                meta["resourceVersion"] = str(outer._rv)
                 body = json.dumps({"items": page, "metadata": meta}).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
