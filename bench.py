@@ -42,11 +42,21 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 K_SMALL, K_BIG = 8, 64  # scan lengths for the slope measurement
-REPS = 5  # timed repetitions per scan length, each with fresh grids
+REPS = 9  # timed repetitions per scan length (same staged batch; jit does
+# not memoize results, so re-running identical inputs re-executes the
+# kernel — staging once keeps slow tunnel transfers off the rep loop)
 
 
 def main() -> None:
     import jax
+
+    # A TPU-plugin sitecustomize may re-pin jax_platforms at interpreter
+    # startup; an explicit JAX_PLATFORMS (e.g. cpu smoke runs) must win.
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass
 
     import kubernetesclustercapacity_tpu as kcc
     from kubernetesclustercapacity_tpu.fixtures import load_fixture
@@ -90,8 +100,11 @@ def main() -> None:
         lambda: np.asarray(trivial(probe)), reps=10
     ).p50
 
-    # --- the north-star workload.
-    n_nodes, n_scenarios = 10_000, 1_000
+    # --- the north-star workload.  Size overrides exist for smoke-testing
+    # the bench pipeline itself on small shapes/CPU; the recorded metric is
+    # only meaningful at the default 10k x 1k.
+    n_nodes = int(os.environ.get("KCC_BENCH_NODES", 10_000))
+    n_scenarios = int(os.environ.get("KCC_BENCH_SCENARIOS", 1_000))
     snap = kcc.synthetic_snapshot(n_nodes, seed=1)
     arrays = snapshot_device_arrays(snap)  # device-resident once, like a real sweep service
 
@@ -119,12 +132,10 @@ def main() -> None:
     # used to validate fast-path eligibility on ALL timed inputs and to
     # cross-check fast totals against exact totals batch by batch.
     timed_keys = [
-        (K, seed)
-        for K in (K_SMALL, K_BIG)
-        for seed in ([99] + [7 * K + rep for rep in range(REPS)])
+        (K, seed) for K in (K_SMALL, K_BIG) for seed in (99, 7 * K)
     ]
 
-    def measure_slope(make_run, make_args):
+    def measure_slope(make_run, make_args, *, ks=(K_SMALL, K_BIG), reps=REPS):
         """True per-sweep ms: marginal cost between two scan lengths.
 
         ``make_run(K)`` builds the jitted K-sweep runner; ``make_args(K,
@@ -133,21 +144,22 @@ def main() -> None:
         ``(per_sweep_ms, mins, outputs)`` with ``outputs[(K, seed)]`` the
         ``[K, S]`` totals of every timed batch.
         """
+        k_small, k_big = ks
         mins = {}
         outputs = {}
-        for K in (K_SMALL, K_BIG):
+        for K in ks:
             run = make_run(K)
             np.asarray(run(*make_args(K, seed=99)))  # warm the compile
+            seed = 7 * K
+            args = make_args(K, seed=seed)  # staged once per K
             ts = []
-            for rep in range(REPS):
-                seed = 7 * K + rep
-                args = make_args(K, seed=seed)
+            for _ in range(reps):
                 t0 = time.perf_counter()
                 out = np.asarray(run(*args))
                 ts.append((time.perf_counter() - t0) * 1e3)
-                outputs[(K, seed)] = out
+            outputs[(K, seed)] = out
             mins[K] = min(ts)
-        per_sweep = (mins[K_BIG] - mins[K_SMALL]) / (K_BIG - K_SMALL)
+        per_sweep = (mins[k_big] - mins[k_small]) / (k_big - k_small)
         return per_sweep, mins, outputs
 
     # --- exact int64 path.
@@ -288,6 +300,125 @@ def main() -> None:
                 fast_per_sweep = None
                 break
 
+    # --- BASELINE evaluation-ladder aux metrics (configs 2, 4, 5): the
+    # headline metric stays config 3; these report breadth on the same
+    # slope methodology with lighter scan lengths.  Never allowed to break
+    # the headline line.
+    ladder: dict = {}
+    try:
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_grid_multi
+
+        aux = dict(ks=(4, 16), reps=3)
+        rng = np.random.default_rng(7)
+
+        def scan_runner(step):
+            """jit runner scanning ``step`` over stacked per-sweep inputs."""
+
+            @jax.jit
+            def run_many(*stacks):
+                def body(carry, xs):
+                    return carry, step(*xs)
+
+                _, totals = jax.lax.scan(body, 0, stacks)
+                return totals
+
+            return run_many
+
+        # config 2: 1k-node × 1k-scenario exact sweep.
+        snap_1k = kcc.synthetic_snapshot(1_000, seed=2)
+        arrays_1k = snapshot_device_arrays(snap_1k)
+
+        def grids_stack(K, seed):
+            _, crs, mrs, rps = fresh_grids(K, seed)
+            return tuple(jax.device_put(x) for x in (crs, mrs, rps))
+
+        # The 1k-node sweep is ~10x cheaper than the headline; it needs the
+        # full scan span or the slope drowns in tunnel jitter.
+        ladder["config2_1k_nodes_exact_per_sweep_ms"] = measure_slope(
+            lambda K: scan_runner(
+                lambda cr, mr, rp: sweep_grid(
+                    *arrays_1k, cr, mr, rp, mode="reference"
+                )[0]
+            ),
+            grids_stack,
+            ks=(K_SMALL, K_BIG),
+            reps=3,
+        )[0]
+
+        # config 4: 10k-node × 1k-scenario × 4-resource fit
+        # (cpu, memory, ephemeral-storage, GPU).
+        alloc_rn = np.stack(
+            [
+                snap.alloc_cpu_milli,
+                snap.alloc_mem_bytes,
+                rng.integers(50, 500, n_nodes) * (1 << 30),
+                rng.integers(0, 9, n_nodes),
+            ]
+        )
+        used_rn = np.stack(
+            [
+                snap.used_cpu_req_milli,
+                snap.used_mem_req_bytes,
+                rng.integers(0, 50, n_nodes) * (1 << 30),
+                np.zeros(n_nodes, dtype=np.int64),
+            ]
+        )
+        dev_multi = tuple(
+            jax.device_put(x)
+            for x in (
+                alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+                snap.healthy,
+            )
+        )
+
+        def multi_stack(K, seed):
+            grids, _, _, rps = fresh_grids(K, seed)
+            g = np.random.default_rng(seed)
+            reqs = np.stack(
+                [
+                    np.stack(
+                        [
+                            gr.cpu_request_milli,
+                            gr.mem_request_bytes,
+                            g.integers(1, 20, n_scenarios) * (1 << 30),
+                            g.integers(0, 3, n_scenarios),
+                        ],
+                        axis=1,
+                    )
+                    for gr in grids
+                ]
+            )  # [K, S, 4]
+            return (jax.device_put(reqs), jax.device_put(rps))
+
+        ladder["config4_multi4_per_sweep_ms"] = measure_slope(
+            lambda K: scan_runner(
+                lambda reqs, rp: sweep_grid_multi(
+                    *dev_multi, reqs, rp, mode="strict"
+                )[0]
+            ),
+            multi_stack,
+            **aux,
+        )[0]
+
+        # config 5: 10k-node masked sweep (taint/affinity-style node mask).
+        mask = jax.device_put(rng.random(n_nodes) < 0.7)
+        ladder["config5_masked_per_sweep_ms"] = measure_slope(
+            lambda K: scan_runner(
+                lambda cr, mr, rp: sweep_grid(
+                    *arrays, cr, mr, rp, mode="reference", node_mask=mask
+                )[0]
+            ),
+            grids_stack,
+            **aux,
+        )[0]
+        # Jitter can still produce a nonsense non-positive slope on the
+        # cheapest configs: report null rather than a negative latency.
+        ladder = {
+            k: (round(v, 3) if v > 0 else None) for k, v in ladder.items()
+        }
+    except Exception as e:  # noqa: BLE001 - aux must never kill the bench
+        ladder = {"ladder_error": f"{type(e).__name__}: {e}"}
+
     p50 = fast_per_sweep if fast_per_sweep is not None else exact_per_sweep
     if p50 <= 0:
         # Tunnel jitter swamped the slope (mins[K_BIG] <= mins[K_SMALL]):
@@ -323,6 +454,7 @@ def main() -> None:
                 "exact_single_dispatch_p50_ms": round(single_dispatch_p50, 3),
                 "dispatch_floor_ms": round(dispatch_floor_ms, 3),
                 "slope_scan_lengths": [K_SMALL, K_BIG],
+                **ladder,
                 "kernel": (
                     ("pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused")
                     if fast_per_sweep is not None
@@ -330,6 +462,11 @@ def main() -> None:
                 ),
                 "device": str(jax.devices()[0]),
                 "correctness_gate": "oracle-exact",
+                **(
+                    {"smoke_sizes": [n_nodes, n_scenarios]}
+                    if (n_nodes, n_scenarios) != (10_000, 1_000)
+                    else {}
+                ),
             }
         )
     )

@@ -33,12 +33,23 @@ Pallas fast path (:mod:`.ops.pallas_fit`) avoids that via exactness-checked
 KiB rescaling to int32.
 """
 
+import os as _os
+
 import jax as _jax
 
 # Must happen before any jnp array is created anywhere in the framework:
 # without x64, jnp silently downcasts int64 -> int32 and memory-bytes
 # arithmetic (node memory ~2^34) overflows, breaking bit-exactness.
 _jax.config.update("jax_enable_x64", True)
+
+# Restore standard JAX env semantics: an explicit JAX_PLATFORMS (e.g. cpu
+# for hosts without an accelerator) must win even where a TPU-plugin
+# sitecustomize re-pins jax_platforms at interpreter startup.
+if _os.environ.get("JAX_PLATFORMS"):
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except RuntimeError:  # pragma: no cover - backends already initialized
+        pass
 
 __version__ = "0.1.0"
 

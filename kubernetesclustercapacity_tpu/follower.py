@@ -167,13 +167,20 @@ class ClusterFollower:
                 stream_ended = self._consume_stream(path, kind, convert, version)
             except (KubeAPIError, StoreError) as e:
                 self._errors.append(f"{path}: {e}")
-                if self._stop.is_set():
-                    return
-                try:
-                    self._relist()  # 410 Gone / transport loss / bad apply
-                except KubeAPIError as e2:
-                    self._errors.append(f"relist {path}: {e2}")
-                    return  # cluster unreachable; keep last good snapshot
+                # Back off, then relist (410 Gone / transport loss / bad
+                # apply).  A failing relist retries forever with backoff —
+                # a transient outage must never permanently stop the sync
+                # loop — and a persistently rejected watch (e.g. RBAC
+                # grants list but not watch) cannot hot-loop full LISTs.
+                while not self._stop.is_set():
+                    self._stop.wait(self._idle_backoff)
+                    if self._stop.is_set():
+                        return
+                    try:
+                        self._relist()
+                        break
+                    except KubeAPIError as e2:
+                        self._errors.append(f"relist {path}: {e2}")
                 continue
             if stream_ended:
                 if version == self._versions.get(path):

@@ -310,14 +310,27 @@ class KubeClient:
         except ValueError as e:
             raise KubeAPIError(f"GET {path}: invalid JSON response: {e}") from e
 
+    def _pages(self, path: str, limit: int, field_selector: str | None):
+        """Yield ``(items, metadata)`` per page, following ``continue``."""
+        token: str | None = None
+        while True:
+            page = self.get_json(
+                path,
+                {"limit": limit, "continue": token, "fieldSelector": field_selector},
+            )
+            meta = page.get("metadata") or {}
+            yield page.get("items") or [], meta
+            token = meta.get("continue")
+            if not token:
+                return
+
     def list_all(
         self, path: str, *, limit: int = 500, field_selector: str | None = None
     ):
-        """Paginated List: follow ``metadata.continue`` until exhausted."""
-        items, _ = self.list_with_version(
-            path, limit=limit, field_selector=field_selector
-        )
-        yield from items
+        """Paginated List, streamed: one page of raw items in memory at a
+        time (a 100k-pod cluster must not be materialized twice)."""
+        for items, _ in self._pages(path, limit, field_selector):
+            yield from items
 
     def list_with_version(
         self, path: str, *, limit: int = 500, field_selector: str | None = None
@@ -328,19 +341,11 @@ class KubeClient:
         watch resumes from (the standard list+watch contract).
         """
         items: list = []
-        token: str | None = None
         version = ""
-        while True:
-            page = self.get_json(
-                path,
-                {"limit": limit, "continue": token, "fieldSelector": field_selector},
-            )
-            items.extend(page.get("items") or [])
-            meta = page.get("metadata") or {}
+        for page_items, meta in self._pages(path, limit, field_selector):
+            items.extend(page_items)
             version = meta.get("resourceVersion") or version
-            token = meta.get("continue")
-            if not token:
-                return items, version
+        return items, version
 
     def watch_events(
         self,
@@ -464,6 +469,8 @@ def pod_to_fixture(p: dict) -> dict:
         "namespace": meta.get("namespace", ""),
         "nodeName": spec.get("nodeName") or "",
         "phase": status.get("phase", ""),
+        # Pod labels feed the anti-affinity-vs-existing-pods mask.
+        "labels": dict(meta.get("labels") or {}),
         "containers": _containers_fixture(spec.get("containers")),
         "initContainers": _containers_fixture(spec.get("initContainers")),
     }

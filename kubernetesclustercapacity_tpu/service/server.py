@@ -34,6 +34,18 @@ from kubernetesclustercapacity_tpu.sources import resolve_source
 __all__ = ["CapacityServer"]
 
 
+def _implicit_taint_mask(snap: ClusterSnapshot):
+    """Strict semantics honors hard taints even on plain-flag fits (an
+    untolerating pod never lands on a NoSchedule node).  Depends only on
+    the snapshot, so it is computed once per snapshot swap — not per
+    request (the pure-Python taint walk is O(N))."""
+    if snap.semantics != "strict" or not any(snap.taints or []):
+        return None
+    from kubernetesclustercapacity_tpu.masks import tolerations_mask
+
+    return tolerations_mask(snap, [])
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:  # one connection, many frames
         server: "CapacityServer" = self.server.capacity_server  # type: ignore[attr-defined]
@@ -73,6 +85,7 @@ class CapacityServer:
         self.fixture = fixture
         self._store = None  # lazy ClusterStore, built on first update op
         self._fixture_dirty = False  # fixture lags the store until needed
+        self._implicit_mask = _implicit_taint_mask(snapshot)
         self._lock = threading.Lock()
         self._tcp = _ThreadingServer((host, port), _Handler)
         self._tcp.capacity_server = self  # type: ignore[attr-defined]
@@ -111,8 +124,7 @@ class CapacityServer:
             if (
                 self._fixture_dirty
                 and op == "fit"
-                and msg.get("backend") == "cpu"
-                and snap.semantics == "reference"
+                and self._fit_consumes_fixture(msg, snap.semantics)
             ):
                 # The one path that reads the raw fixture (_op_fit's
                 # reference cpu cross-check) rebuilds it here, under the
@@ -122,6 +134,7 @@ class CapacityServer:
             # A dirty fixture is NEVER served: consumers see None (and
             # fall back to packed-array walks) rather than stale objects.
             fixture = None if self._fixture_dirty else self.fixture
+            implicit_mask = self._implicit_mask
         if op == "info":
             return {
                 "nodes": snap.n_nodes,
@@ -130,7 +143,7 @@ class CapacityServer:
                 "extended_resources": sorted(snap.extended),
             }
         if op == "fit":
-            return self._op_fit(msg, snap, fixture)
+            return self._op_fit(msg, snap, fixture, implicit_mask)
         if op == "sweep":
             return self._op_sweep(msg, snap)
         if op == "reload":
@@ -139,7 +152,34 @@ class CapacityServer:
             return self._op_update(msg)
         raise ValueError(f"unknown op {op!r}")
 
-    def _op_fit(self, msg: dict, snap: ClusterSnapshot, fixture: dict | None) -> dict:
+    # PodSpec extension fields a fit message may carry beyond the
+    # reference's six flags (kube-scheduler constraint families).
+    _SPEC_FIELDS = (
+        "tolerations",
+        "node_selector",
+        "affinity_terms",
+        "anti_affinity_labels",
+        "spread",
+        "extended_requests",
+    )
+
+    @staticmethod
+    def _fit_consumes_fixture(msg: dict, semantics: str) -> bool:
+        """The fit paths that read raw objects, not just packed arrays:
+        the reference cpu cross-check walk, and anti-affinity masks (pod
+        labels are not in the arrays).  dispatch() uses this to decide
+        whether a store-dirty fixture must be rematerialized."""
+        return (
+            msg.get("backend") == "cpu" and semantics == "reference"
+        ) or "anti_affinity_labels" in msg
+
+    def _op_fit(
+        self,
+        msg: dict,
+        snap: ClusterSnapshot,
+        fixture: dict | None,
+        implicit_mask=None,
+    ) -> dict:
         try:
             scenario = scenario_from_flags(
                 cpuRequests=msg.get("cpuRequests", "100m"),
@@ -151,6 +191,14 @@ class CapacityServer:
             scenario.validate()
         except ScenarioError as e:
             raise ValueError(str(e)) from e
+
+        if any(k in msg for k in self._SPEC_FIELDS):
+            return self._op_fit_spec(msg, snap, fixture, scenario)
+
+        # The implicit strict-mode taint mask (precomputed per snapshot
+        # swap) — the same mask CapacityModel applies, so the plain-flags
+        # and PodSpec surfaces agree.
+        node_mask = implicit_mask
 
         backend = msg.get("backend", "tpu")
         if backend == "cpu" and fixture is not None and snap.semantics == "reference":
@@ -174,7 +222,11 @@ class CapacityServer:
                     scenario.cpu_request_milli,
                     scenario.mem_request_bytes,
                     mode=snap.semantics,
-                    healthy=snap.healthy,
+                    healthy=(
+                        snap.healthy
+                        if node_mask is None
+                        else snap.healthy & node_mask
+                    ),
                 ),
                 dtype=np.int64,
             )
@@ -191,22 +243,77 @@ class CapacityServer:
                     scenario.cpu_request_milli,
                     scenario.mem_request_bytes,
                     mode=snap.semantics,
+                    node_mask=node_mask,
                 )
             )
 
-        output = msg.get("output", "reference")
-        if output == "json":
-            report = json_report(snap, fits, scenario)
-        elif output == "table":
-            report = table_report(snap, fits, scenario)
-        else:
-            report = reference_report(snap, fits, scenario)
+        report = self._render_report(msg, snap, fits, scenario)
         total = int(fits.sum())
         return {
             "total": total,
             "schedulable": total >= scenario.replicas,
             "fits": fits.tolist(),
             "report": report,
+        }
+
+    @staticmethod
+    def _render_report(msg: dict, snap: ClusterSnapshot, fits, scenario):
+        """One place maps the wire ``output`` flag to a report renderer —
+        every fit path honors the same formats."""
+        output = msg.get("output", "reference")
+        if output == "json":
+            return json_report(snap, fits, scenario)
+        if output == "table":
+            return table_report(snap, fits, scenario)
+        return reference_report(snap, fits, scenario)
+
+    def _op_fit_spec(
+        self,
+        msg: dict,
+        snap: ClusterSnapshot,
+        fixture: dict | None,
+        scenario,
+    ) -> dict:
+        """Constrained/multi-resource fit through the CapacityModel facade.
+
+        Exposes the full :class:`~..models.capacity.PodSpec` surface over
+        the wire: taint tolerations, nodeSelector, node (anti-)affinity,
+        spread, and extended resources — everything the reference's six
+        flags could not express (SURVEY.md §5 "failure detection" masks,
+        BASELINE configs 4-5).
+        """
+        from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
+
+        try:
+            spec = PodSpec(
+                cpu_request_milli=scenario.cpu_request_milli,
+                mem_request_bytes=scenario.mem_request_bytes,
+                replicas=scenario.replicas,
+                cpu_limit_milli=scenario.cpu_limit_milli,
+                mem_limit_bytes=scenario.mem_limit_bytes,
+                tolerations=tuple(msg.get("tolerations") or ()),
+                node_selector=dict(msg.get("node_selector") or {}),
+                affinity_terms=tuple(msg.get("affinity_terms") or ()),
+                anti_affinity_labels=dict(
+                    msg.get("anti_affinity_labels") or {}
+                ),
+                spread=msg.get("spread"),
+                extended_requests={
+                    k: int(v)
+                    for k, v in (msg.get("extended_requests") or {}).items()
+                },
+            )
+            model = CapacityModel(
+                snap, mode=snap.semantics, fixture=fixture
+            )
+            result = model.evaluate(spec)
+        except (TypeError, KeyError, ValueError) as e:
+            raise ValueError(f"bad pod spec: {e}") from e
+        return {
+            "total": result.total,
+            "schedulable": result.schedulable,
+            "fits": result.fits.tolist(),
+            "report": self._render_report(msg, snap, result.fits, scenario),
         }
 
     def _op_sweep(self, msg: dict, snap: ClusterSnapshot) -> dict:
@@ -233,11 +340,13 @@ class CapacityServer:
         self, snapshot: ClusterSnapshot, fixture: dict | None = None
     ) -> None:
         """Atomically swap the served snapshot (e.g. from a live follower)."""
+        mask = _implicit_taint_mask(snapshot)
         with self._lock:
             self.snapshot = snapshot
             self.fixture = fixture
             self._store = None  # stale after a wholesale replace
             self._fixture_dirty = False
+            self._implicit_mask = mask
 
     def _op_reload(self, msg: dict) -> dict:
         new_fixture, new_snap, _ = resolve_source(
@@ -277,6 +386,7 @@ class CapacityServer:
             finally:
                 snap = self.snapshot = self._store.snapshot()
                 self._fixture_dirty = True  # rebuilt on demand (cpu fit)
+                self._implicit_mask = _implicit_taint_mask(snap)
         return {
             "nodes": snap.n_nodes,
             "healthy_nodes": int(np.sum(snap.healthy)),

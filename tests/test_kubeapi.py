@@ -49,7 +49,8 @@ def _k8s_node(n: dict) -> dict:
 
 def _k8s_pod(p: dict) -> dict:
     return {
-        "metadata": {"name": p["name"], "namespace": p["namespace"]},
+        "metadata": {"name": p["name"], "namespace": p["namespace"],
+                     "labels": p.get("labels") or {}},
         "spec": {
             "nodeName": p["nodeName"] or None,
             "containers": list(p.get("containers") or []),
@@ -301,6 +302,33 @@ class TestLiveFixture:
         )
         np.testing.assert_array_equal(snap.pods_count, ref.pods_count)
         np.testing.assert_array_equal(snap.healthy, ref.healthy)
+
+    def test_pod_labels_survive_conversion(self, tmp_path, cluster):
+        """Pod labels must reach the fixture: the anti-affinity mask vs
+        existing pods reads them."""
+        fixture, srv = cluster
+        fixture["pods"][0]["labels"] = {"app": "db"}
+        srv.items["/api/v1/pods"][0]["metadata"]["labels"] = {"app": "db"}
+        path = _write_kubeconfig(
+            tmp_path, f"http://127.0.0.1:{srv.port}", {"token": "sekrit"}
+        )
+        got = live_fixture(path)
+        assert got["pods"][0]["labels"] == {"app": "db"}
+
+    def test_list_all_streams_pages(self, tmp_path, cluster):
+        """list_all yields items before later pages are fetched."""
+        _, srv = cluster
+        path = _write_kubeconfig(
+            tmp_path, f"http://127.0.0.1:{srv.port}", {"token": "sekrit"}
+        )
+        client = KubeClient(KubeConfig.load(path))
+        gen = client.list_all("/api/v1/nodes", limit=5)
+        first = next(gen)
+        pages_so_far = len([r for r in srv.requests if "nodes" in r])
+        assert first["metadata"]["name"]
+        assert pages_so_far == 1  # only one page fetched for the first item
+        list(gen)
+        client.close()
 
     def test_auth_failure_is_kubeapi_error(self, tmp_path, cluster):
         _, srv = cluster

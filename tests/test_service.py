@@ -226,6 +226,101 @@ class TestUpdateOp:
             )
 
 
+class TestSpecFit:
+    """The PodSpec surface over the wire (constraints, spread, extended)."""
+
+    @pytest.fixture(scope="class")
+    def strict_server(self):
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+
+        fixture = synthetic_fixture(12, seed=31, taint_frac=0.4)
+        fixture["nodes"][0]["allocatable"]["nvidia.com/gpu"] = "8"
+        fixture["nodes"][0]["taints"] = []  # the GPU node must be reachable
+        fixture["nodes"][0]["allocatable"]["cpu"] = "32"  # not CPU-bound
+        snap = snapshot_from_fixture(
+            fixture, semantics="strict",
+            extended_resources=("nvidia.com/gpu",),
+        )
+        srv = CapacityServer(snap, port=0, fixture=fixture)
+        srv.start()
+        yield fixture, srv
+        srv.shutdown()
+
+    @pytest.fixture()
+    def sclient(self, strict_server):
+        _, srv = strict_server
+        with CapacityClient(*srv.address) as c:
+            yield c
+
+    def test_spread_caps_per_node(self, sclient):
+        r = sclient.fit(cpuRequests="100m", memRequests="64mb", spread=1)
+        assert max(r["fits"]) <= 1
+
+    def test_node_selector_restricts(self, sclient, strict_server):
+        fixture, _ = strict_server
+        r = sclient.fit(cpuRequests="100m", memRequests="64mb",
+                        node_selector={"zone": "zone-0"})
+        zone0 = [n["labels"].get("zone") == "zone-0" for n in fixture["nodes"]]
+        for fits_i, in_zone in zip(r["fits"], zone0):
+            if not in_zone:
+                assert fits_i == 0
+
+    def test_tolerations_open_tainted_nodes(self, sclient, strict_server):
+        fixture, _ = strict_server
+        untol = sclient.fit(cpuRequests="100m", memRequests="64mb")
+        tol = sclient.fit(cpuRequests="100m", memRequests="64mb",
+                          tolerations=[{"operator": "Exists"}])
+        tainted = [bool(n["taints"]) for n in fixture["nodes"]]
+        assert any(tainted)
+        for u, t, is_tainted in zip(untol["fits"], tol["fits"], tainted):
+            if is_tainted:
+                assert u == 0 and t >= 0
+            else:
+                assert u == t
+
+    def test_extended_resources_gate_fit(self, sclient, strict_server):
+        fixture, _ = strict_server
+        r = sclient.fit(cpuRequests="100m", memRequests="64mb",
+                        extended_requests={"nvidia.com/gpu": 2})
+        # Only node 0 advertises GPUs (8 of them): 8 // 2 = 4 replicas max.
+        assert sum(1 for f in r["fits"] if f > 0) == 1
+        assert r["fits"][0] == 4
+
+    def test_matches_library_model(self, sclient, strict_server):
+        from kubernetesclustercapacity_tpu.models import (
+            CapacityModel,
+            PodSpec,
+        )
+
+        fixture, _ = strict_server
+        snap = snapshot_from_fixture(
+            fixture, semantics="strict",
+            extended_resources=("nvidia.com/gpu",),
+        )
+        spec = PodSpec(cpu_request_milli=250, mem_request_bytes=256 << 20,
+                       replicas=3, tolerations=({"operator": "Exists"},),
+                       spread=2)
+        want = CapacityModel(snap, mode="strict", fixture=fixture).evaluate(spec)
+        got = sclient.fit(cpuRequests="250m", memRequests="256Mi",
+                          replicas="3",
+                          tolerations=[{"operator": "Exists"}], spread=2)
+        assert got["fits"] == want.fits.tolist()
+        assert got["total"] == want.total
+        assert got["schedulable"] == want.schedulable
+
+    def test_bad_spec_is_service_error(self, sclient):
+        with pytest.raises(RuntimeError, match="spread"):
+            sclient.fit(spread=0)
+
+    def test_spec_fit_honors_output_format(self, sclient):
+        table = sclient.fit(cpuRequests="100m", memRequests="64mb",
+                            spread=2, output="table")["report"]
+        assert "NODE" in table  # table renderer, not the json default
+        js = sclient.fit(cpuRequests="100m", memRequests="64mb",
+                         spread=2, output="json")["report"]
+        assert js.strip().startswith("{")
+
+
 class TestNativeClient:
     @pytest.fixture(scope="class")
     def client_bin(self, tmp_path_factory):
