@@ -3,5 +3,6 @@
 from kubernetesclustercapacity_tpu.models.capacity import (  # noqa: F401
     CapacityModel,
     CapacityResult,
+    PlacementResult,
     PodSpec,
 )

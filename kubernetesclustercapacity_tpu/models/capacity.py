@@ -26,7 +26,7 @@ from kubernetesclustercapacity_tpu.ops.fit import (
 from kubernetesclustercapacity_tpu.scenario import Scenario, ScenarioGrid
 from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
 
-__all__ = ["PodSpec", "CapacityModel", "CapacityResult"]
+__all__ = ["PodSpec", "CapacityModel", "CapacityResult", "PlacementResult"]
 
 
 @dataclass(frozen=True)
@@ -76,6 +76,32 @@ class PodSpec:
             or self.anti_affinity_labels
             or self.spread is not None
         )
+
+
+@dataclass
+class PlacementResult:
+    """Outcome of a placement simulation: node assignment per replica."""
+
+    assignments: np.ndarray  # [R] node index, -1 = unplaceable
+    per_node: np.ndarray  # [N] replicas landed on each node
+    node_names: list[str]
+    policy: str
+
+    @property
+    def placed(self) -> int:
+        return int(np.sum(self.assignments >= 0))
+
+    @property
+    def all_placed(self) -> bool:
+        return bool(np.all(self.assignments >= 0))
+
+    def by_node(self) -> dict[str, int]:
+        """Non-zero placements keyed by node name."""
+        return {
+            self.node_names[i]: int(c)
+            for i, c in enumerate(self.per_node)
+            if c
+        }
 
 
 @dataclass
@@ -224,6 +250,48 @@ class CapacityModel:
             total=int(fits.sum()),
             replicas_requested=spec.replicas,
             mode=self.mode,
+        )
+
+    def place(self, spec: PodSpec, *, policy: str = "first-fit") -> PlacementResult:
+        """Simulate WHERE each replica lands (sequential greedy scheduler).
+
+        The fit kernels answer "how many"; this answers "which node gets
+        replica k" under a bin-packing policy, each placement shrinking
+        the headroom the next one sees (:mod:`..ops.placement`).  Strict
+        feasibility semantics; constraint masks compose like
+        :meth:`evaluate`.  Extended resources are not simulated (fit-check
+        them via :meth:`evaluate`).
+        """
+        from kubernetesclustercapacity_tpu.ops.placement import place_replicas
+
+        if spec.extended_requests:
+            raise ValueError(
+                "placement simulates cpu/memory/pod-slots; evaluate() "
+                "handles extended-resource feasibility"
+            )
+        self._check_extensions(spec.constrained)
+        snap = self.snapshot
+        mask = self._masks_for(spec)
+        assignments, per_node = place_replicas(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.used_cpu_req_milli,
+            snap.used_mem_req_bytes,
+            snap.pods_count,
+            snap.healthy,
+            spec.cpu_request_milli,
+            spec.mem_request_bytes,
+            n_replicas=spec.replicas,
+            policy=policy,
+            node_mask=mask,
+            max_per_node=spec.spread,
+        )
+        return PlacementResult(
+            assignments=np.asarray(assignments),
+            per_node=np.asarray(per_node),
+            node_names=list(snap.names),
+            policy=policy,
         )
 
     def sweep(

@@ -1,0 +1,194 @@
+"""Replica placement simulator — a sequential scheduler as a ``lax.scan``.
+
+The reference (and this framework's fit kernels) answer *how many* replicas
+fit by treating nodes independently (``ClusterCapacity.go:105-140``); a real
+scheduler answers *where each replica lands*, and every placement changes
+the feasibility of the next.  That sequential dependence is exactly what
+``lax.scan`` expresses on TPU: the loop body is a branchless
+score→argmin→subtract step over dense ``[N]`` arrays, compiled once per
+(policy, replica-count) pair — no data-dependent Python control flow.
+
+Policies (the classic bin-packing family):
+
+* ``first-fit``  — lowest-index feasible node (kube-scheduler's default
+  behavior is closer to scored spreading, but first-fit is the canonical
+  baseline);
+* ``best-fit``   — the feasible node left with the LEAST normalized
+  headroom after placement (packs tightly, frees whole nodes);
+* ``spread``     — the feasible node left with the MOST normalized
+  headroom (worst-fit; balances load like the scheduler's
+  ``LeastAllocated`` scoring).
+
+Invariant (tested): for identical replicas every work-conserving greedy
+policy places exactly ``min(R, sum(strict per-node fits))`` — placement
+*order* differs, capacity does not.  This pins the simulator to the
+bit-exactness chain anchored at the fit kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["place_replicas", "place_replicas_python", "POLICIES"]
+
+POLICIES = ("first-fit", "best-fit", "spread")
+
+
+def _normalized_headroom(hc, hm, alloc_cpu, alloc_mem):
+    """Score in [0, 2]: how empty a node would remain (f64 for ordering
+    only — never feeds back into the integer feasibility state)."""
+    safe = lambda num, den: jnp.where(  # noqa: E731 - local two-liner
+        den > 0, num.astype(jnp.float64) / den.astype(jnp.float64), 0.0
+    )
+    return safe(hc, alloc_cpu) + safe(hm, alloc_mem)
+
+
+@partial(jax.jit, static_argnames=("n_replicas", "policy", "max_per_node"))
+def place_replicas(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_req,
+    mem_req,
+    *,
+    n_replicas: int,
+    policy: str = "first-fit",
+    node_mask=None,
+    max_per_node: int | None = None,
+):
+    """Greedily place ``n_replicas`` identical pods, one per scan step.
+
+    Feasibility mirrors the strict fit kernel exactly: integer headroom
+    ``alloc - used >= request`` per resource, one free pod slot, healthy,
+    and (optionally) an external constraint ``node_mask``.  Returns
+    ``(assignments[n_replicas], per_node_counts[N])`` where an assignment
+    of ``-1`` means that replica found no feasible node (all later
+    replicas of a full cluster are ``-1`` too — the state stops changing).
+
+    ``max_per_node`` caps how many of THESE replicas one node may take
+    (self-anti-affinity / topology spread).
+
+    ``n_replicas``, ``policy`` and ``max_per_node`` are static: one
+    compile per combination, then every (snapshot, request) reuses it.
+    """
+    if policy not in POLICIES:
+        raise ValueError(f"unknown policy {policy!r} (want one of {POLICIES})")
+    alloc_cpu = jnp.asarray(alloc_cpu, jnp.int64)
+    alloc_mem = jnp.asarray(alloc_mem, jnp.int64)
+    c = jnp.asarray(cpu_req, jnp.int64)
+    m = jnp.asarray(mem_req, jnp.int64)
+    eligible = jnp.asarray(healthy, jnp.bool_)
+    if node_mask is not None:
+        eligible = eligible & jnp.asarray(node_mask, jnp.bool_)
+
+    hc0 = alloc_cpu - jnp.asarray(used_cpu, jnp.int64)
+    hm0 = alloc_mem - jnp.asarray(used_mem, jnp.int64)
+    slots0 = jnp.maximum(
+        jnp.asarray(alloc_pods, jnp.int64) - jnp.asarray(pods_count, jnp.int64),
+        0,
+    )
+    n = hc0.shape[0]
+    idx_arange = jnp.arange(n)
+
+    def body(state, _):
+        hc, hm, slots, mine = state
+        feasible = (hc >= c) & (hm >= m) & (slots >= 1) & eligible
+        if max_per_node is not None:
+            feasible = feasible & (mine < max_per_node)
+        if policy == "first-fit":
+            score = idx_arange.astype(jnp.float64)
+        else:
+            after = _normalized_headroom(hc - c, hm - m, alloc_cpu, alloc_mem)
+            score = after if policy == "best-fit" else -after
+        score = jnp.where(feasible, score, jnp.inf)
+        idx = jnp.argmin(score)
+        ok = feasible[idx]
+        one_hot = (idx_arange == idx) & ok
+        hc = hc - jnp.where(one_hot, c, 0)
+        hm = hm - jnp.where(one_hot, m, 0)
+        one = jnp.where(one_hot, jnp.int64(1), jnp.int64(0))
+        slots = slots - one
+        mine = mine + one
+        assignment = jnp.where(ok, idx.astype(jnp.int64), jnp.int64(-1))
+        return (hc, hm, slots, mine), assignment
+
+    mine0 = jnp.zeros(n, dtype=jnp.int64)
+    _, assignments = jax.lax.scan(
+        body, (hc0, hm0, slots0, mine0), None, length=n_replicas
+    )
+    counts = jnp.sum(
+        (assignments[:, None] == idx_arange[None, :]), axis=0, dtype=jnp.int64
+    )
+    return assignments, counts
+
+
+def place_replicas_python(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_req: int,
+    mem_req: int,
+    *,
+    n_replicas: int,
+    policy: str = "first-fit",
+    node_mask=None,
+    max_per_node: int | None = None,
+) -> tuple[list[int], list[int]]:
+    """Sequential ground truth for :func:`place_replicas` (same tie rules:
+    numpy argmin picks the lowest index among equal scores, as the kernel's
+    ``jnp.argmin`` does)."""
+    if policy not in POLICIES:
+        raise ValueError(f"unknown policy {policy!r}")
+    n = len(alloc_cpu)
+    hc = [int(a) - int(u) for a, u in zip(alloc_cpu, used_cpu)]
+    hm = [int(a) - int(u) for a, u in zip(alloc_mem, used_mem)]
+    slots = [max(int(a) - int(p), 0) for a, p in zip(alloc_pods, pods_count)]
+    eligible = [
+        bool(healthy[i]) and (node_mask is None or bool(node_mask[i]))
+        for i in range(n)
+    ]
+    assignments: list[int] = []
+    counts = [0] * n
+    for _ in range(n_replicas):
+        best, best_score = -1, None
+        for i in range(n):
+            if not (
+                eligible[i]
+                and hc[i] >= cpu_req
+                and hm[i] >= mem_req
+                and slots[i] >= 1
+                and (max_per_node is None or counts[i] < max_per_node)
+            ):
+                continue
+            if policy == "first-fit":
+                score = float(i)
+            else:
+                after = 0.0
+                if alloc_cpu[i] > 0:
+                    after += (hc[i] - cpu_req) / float(alloc_cpu[i])
+                if alloc_mem[i] > 0:
+                    after += (hm[i] - mem_req) / float(alloc_mem[i])
+                score = after if policy == "best-fit" else -after
+            if best_score is None or score < best_score:
+                best, best_score = i, score
+        if best < 0:
+            assignments.append(-1)
+            continue
+        hc[best] -= cpu_req
+        hm[best] -= mem_req
+        slots[best] -= 1
+        counts[best] += 1
+        assignments.append(best)
+    return assignments, counts

@@ -52,3 +52,7 @@ class CapacityClient:
     def update(self, events: list[dict]) -> dict:
         """Apply watch-style node/pod events to the served snapshot."""
         return self.call("update", events=events)
+
+    def place(self, **flags) -> dict:
+        """Simulate where each replica lands (greedy scheduler)."""
+        return self.call("place", **flags)

@@ -123,7 +123,7 @@ class CapacityServer:
             snap = self.snapshot
             if (
                 self._fixture_dirty
-                and op == "fit"
+                and op in ("fit", "place")
                 and self._fit_consumes_fixture(msg, snap.semantics)
             ):
                 # The one path that reads the raw fixture (_op_fit's
@@ -146,6 +146,8 @@ class CapacityServer:
             return self._op_fit(msg, snap, fixture, implicit_mask)
         if op == "sweep":
             return self._op_sweep(msg, snap)
+        if op == "place":
+            return self._op_place(msg, snap, fixture)
         if op == "reload":
             return self._op_reload(msg)
         if op == "update":
@@ -164,6 +166,52 @@ class CapacityServer:
     )
 
     @staticmethod
+    def _scenario_from_msg(msg: dict):
+        """The six reference flags (shared defaults for every op)."""
+        try:
+            scenario = scenario_from_flags(
+                cpuRequests=msg.get("cpuRequests", "100m"),
+                cpuLimits=msg.get("cpuLimits", "200m"),
+                memRequests=msg.get("memRequests", "100mb"),
+                memLimits=msg.get("memLimits", "200mb"),
+                replicas=msg.get("replicas", "1"),
+            )
+            scenario.validate()
+        except ScenarioError as e:
+            raise ValueError(str(e)) from e
+        return scenario
+
+    @staticmethod
+    def _spec_from_msg(msg: dict, scenario):
+        """msg → PodSpec: ONE copy of the spec-field wiring for fit and
+        place.  ``spread`` follows the protocol's string-flag convention
+        (``spread="2"`` and ``spread=2`` both work)."""
+        from kubernetesclustercapacity_tpu.models import PodSpec
+
+        spread = msg.get("spread")
+        try:
+            return PodSpec(
+                cpu_request_milli=scenario.cpu_request_milli,
+                mem_request_bytes=scenario.mem_request_bytes,
+                replicas=scenario.replicas,
+                cpu_limit_milli=scenario.cpu_limit_milli,
+                mem_limit_bytes=scenario.mem_limit_bytes,
+                tolerations=tuple(msg.get("tolerations") or ()),
+                node_selector=dict(msg.get("node_selector") or {}),
+                affinity_terms=tuple(msg.get("affinity_terms") or ()),
+                anti_affinity_labels=dict(
+                    msg.get("anti_affinity_labels") or {}
+                ),
+                spread=int(spread) if spread is not None else None,
+                extended_requests={
+                    k: int(v)
+                    for k, v in (msg.get("extended_requests") or {}).items()
+                },
+            )
+        except (TypeError, KeyError, ValueError) as e:
+            raise ValueError(f"bad pod spec: {e}") from e
+
+    @staticmethod
     def _fit_consumes_fixture(msg: dict, semantics: str) -> bool:
         """The fit paths that read raw objects, not just packed arrays:
         the reference cpu cross-check walk, and anti-affinity masks (pod
@@ -180,18 +228,7 @@ class CapacityServer:
         fixture: dict | None,
         implicit_mask=None,
     ) -> dict:
-        try:
-            scenario = scenario_from_flags(
-                cpuRequests=msg.get("cpuRequests", "100m"),
-                cpuLimits=msg.get("cpuLimits", "200m"),
-                memRequests=msg.get("memRequests", "100mb"),
-                memLimits=msg.get("memLimits", "200mb"),
-                replicas=msg.get("replicas", "1"),
-            )
-            scenario.validate()
-        except ScenarioError as e:
-            raise ValueError(str(e)) from e
-
+        scenario = self._scenario_from_msg(msg)
         if any(k in msg for k in self._SPEC_FIELDS):
             return self._op_fit_spec(msg, snap, fixture, scenario)
 
@@ -282,27 +319,10 @@ class CapacityServer:
         flags could not express (SURVEY.md §5 "failure detection" masks,
         BASELINE configs 4-5).
         """
-        from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
+        from kubernetesclustercapacity_tpu.models import CapacityModel
 
+        spec = self._spec_from_msg(msg, scenario)
         try:
-            spec = PodSpec(
-                cpu_request_milli=scenario.cpu_request_milli,
-                mem_request_bytes=scenario.mem_request_bytes,
-                replicas=scenario.replicas,
-                cpu_limit_milli=scenario.cpu_limit_milli,
-                mem_limit_bytes=scenario.mem_limit_bytes,
-                tolerations=tuple(msg.get("tolerations") or ()),
-                node_selector=dict(msg.get("node_selector") or {}),
-                affinity_terms=tuple(msg.get("affinity_terms") or ()),
-                anti_affinity_labels=dict(
-                    msg.get("anti_affinity_labels") or {}
-                ),
-                spread=msg.get("spread"),
-                extended_requests={
-                    k: int(v)
-                    for k, v in (msg.get("extended_requests") or {}).items()
-                },
-            )
             model = CapacityModel(
                 snap, mode=snap.semantics, fixture=fixture
             )
@@ -314,6 +334,34 @@ class CapacityServer:
             "schedulable": result.schedulable,
             "fits": result.fits.tolist(),
             "report": self._render_report(msg, snap, result.fits, scenario),
+        }
+
+    def _op_place(
+        self, msg: dict, snap: ClusterSnapshot, fixture: dict | None
+    ) -> dict:
+        """Placement simulation over the wire: which node gets replica k.
+
+        Accepts the same spec fields as fit (one shared msg→PodSpec
+        parser), so (anti-)affinity constraints bind placements too.
+        """
+        from kubernetesclustercapacity_tpu.models import CapacityModel
+
+        scenario = self._scenario_from_msg(msg)
+        spec = self._spec_from_msg(msg, scenario)
+        try:
+            model = CapacityModel(snap, mode=snap.semantics, fixture=fixture)
+            result = model.place(spec, policy=msg.get("policy", "first-fit"))
+        except (TypeError, ValueError) as e:
+            raise ValueError(str(e)) from e
+        return {
+            "assignments": [
+                snap.names[i] if i >= 0 else None
+                for i in result.assignments.tolist()
+            ],
+            "by_node": result.by_node(),
+            "placed": result.placed,
+            "all_placed": result.all_placed,
+            "policy": result.policy,
         }
 
     def _op_sweep(self, msg: dict, snap: ClusterSnapshot) -> dict:

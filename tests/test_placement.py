@@ -1,0 +1,180 @@
+"""Placement simulator tests: kernel vs Python oracle, capacity invariant,
+policy behavior, model/service surfaces."""
+
+import numpy as np
+import pytest
+
+import kubernetesclustercapacity_tpu as kcc
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
+from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+from kubernetesclustercapacity_tpu.ops.placement import (
+    POLICIES,
+    place_replicas,
+    place_replicas_python,
+)
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+
+def _snap_arrays(snap):
+    return (
+        snap.alloc_cpu_milli,
+        snap.alloc_mem_bytes,
+        snap.alloc_pods,
+        snap.used_cpu_req_milli,
+        snap.used_mem_req_bytes,
+        snap.pods_count,
+        snap.healthy,
+    )
+
+
+@pytest.fixture(scope="module")
+def snap():
+    fx = synthetic_fixture(17, seed=51, unhealthy_frac=0.1)
+    return snapshot_from_fixture(fx, semantics="strict")
+
+
+class TestKernelVsOracle:
+    @pytest.mark.parametrize("policy", POLICIES)
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_assignments_match_python(self, snap, policy, seed):
+        rng = np.random.default_rng(seed)
+        cpu = int(rng.integers(50, 2000))
+        mem = int(rng.integers(1, 4)) * (256 << 20)
+        a_jax, c_jax = place_replicas(
+            *_snap_arrays(snap), cpu, mem, n_replicas=40, policy=policy
+        )
+        a_py, c_py = place_replicas_python(
+            *_snap_arrays(snap), cpu, mem, n_replicas=40, policy=policy
+        )
+        np.testing.assert_array_equal(np.asarray(a_jax), a_py)
+        np.testing.assert_array_equal(np.asarray(c_jax), c_py)
+
+    @pytest.mark.parametrize("policy", POLICIES)
+    def test_with_mask_and_cap(self, snap, policy):
+        mask = np.arange(snap.n_nodes) % 2 == 0
+        kw = dict(
+            n_replicas=25, policy=policy, node_mask=mask, max_per_node=2
+        )
+        a_jax, c_jax = place_replicas(*_snap_arrays(snap), 100, 128 << 20, **kw)
+        a_py, c_py = place_replicas_python(
+            *_snap_arrays(snap), 100, 128 << 20, **kw
+        )
+        np.testing.assert_array_equal(np.asarray(a_jax), a_py)
+        assert max(c_py) <= 2
+        for i, count in enumerate(c_py):
+            if not mask[i]:
+                assert count == 0
+
+
+class TestCapacityInvariant:
+    @pytest.mark.parametrize("policy", POLICIES)
+    def test_placed_equals_min_replicas_strict_total(self, snap, policy):
+        """Any work-conserving greedy places min(R, sum strict fits)."""
+        cpu, mem = 500, 512 << 20
+        fits = np.asarray(
+            fit_per_node(*_snap_arrays(snap), cpu, mem, mode="strict")
+        )
+        capacity = int(fits.sum())
+        for r in (1, capacity, capacity + 7):
+            a, _ = place_replicas(
+                *_snap_arrays(snap), cpu, mem, n_replicas=r, policy=policy
+            )
+            assert int(np.sum(np.asarray(a) >= 0)) == min(r, capacity)
+
+    def test_full_cluster_emits_minus_one_forever(self, snap):
+        huge = int(snap.alloc_cpu_milli.max())  # at most 1 fits anywhere
+        a, _ = place_replicas(
+            *_snap_arrays(snap), huge * 2, 1, n_replicas=5, policy="first-fit"
+        )
+        assert np.all(np.asarray(a) == -1)
+
+
+class TestPolicies:
+    def test_first_fit_prefers_low_indices(self, snap):
+        a, _ = place_replicas(
+            *_snap_arrays(snap), 100, 64 << 20, n_replicas=3,
+            policy="first-fit",
+        )
+        a = np.asarray(a)
+        feasible = (
+            (snap.alloc_cpu_milli - snap.used_cpu_req_milli >= 100)
+            & (snap.alloc_mem_bytes - snap.used_mem_req_bytes >= 64 << 20)
+            & (np.maximum(snap.alloc_pods - snap.pods_count, 0) >= 1)
+            & snap.healthy
+        )
+        assert a[0] == int(np.argmax(feasible))  # lowest-index feasible
+
+    def test_spread_uses_more_nodes_than_best_fit(self, snap):
+        kw = dict(n_replicas=12)
+        _, c_best = place_replicas(
+            *_snap_arrays(snap), 100, 64 << 20, policy="best-fit", **kw
+        )
+        _, c_spread = place_replicas(
+            *_snap_arrays(snap), 100, 64 << 20, policy="spread", **kw
+        )
+        used_best = int(np.sum(np.asarray(c_best) > 0))
+        used_spread = int(np.sum(np.asarray(c_spread) > 0))
+        assert used_spread >= used_best
+
+    def test_unknown_policy_raises(self, snap):
+        with pytest.raises(ValueError, match="unknown policy"):
+            place_replicas(
+                *_snap_arrays(snap), 100, 1, n_replicas=1, policy="magic"
+            )
+
+
+class TestModelAndService:
+    def test_model_place(self, snap):
+        model = CapacityModel(snap, mode="strict")
+        res = model.place(
+            PodSpec(cpu_request_milli=250, mem_request_bytes=256 << 20,
+                    replicas=9, spread=1),
+            policy="spread",
+        )
+        assert res.placed <= 9
+        assert max(res.per_node) <= 1  # spread=1 honored in simulation
+        assert sum(res.by_node().values()) == res.placed
+        assert res.policy == "spread"
+
+    def test_model_place_rejects_extended(self, snap):
+        model = CapacityModel(snap, mode="strict")
+        with pytest.raises(ValueError, match="extended"):
+            model.place(
+                PodSpec(cpu_request_milli=1, mem_request_bytes=1,
+                        extended_requests={"nvidia.com/gpu": 1})
+            )
+
+    def test_service_place_op(self):
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        fx = synthetic_fixture(6, seed=52, unhealthy_frac=0.0)
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        srv = CapacityServer(snap, port=0, fixture=fx)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                r = c.place(cpuRequests="250m", memRequests="128mb",
+                            replicas="5", policy="spread")
+                assert r["placed"] == 5 and r["all_placed"] is True
+                assert len(r["assignments"]) == 5
+                assert all(a in snap.names for a in r["assignments"])
+                assert sum(r["by_node"].values()) == 5
+                with pytest.raises(RuntimeError, match="policy"):
+                    c.place(policy="magic")
+                # String spread follows the protocol's flag convention.
+                s = c.place(cpuRequests="250m", memRequests="128mb",
+                            replicas="5", spread="1")
+                assert max(s["by_node"].values()) <= 1
+                # Constraint fields bind placements like they bind fits.
+                sel = c.place(cpuRequests="250m", memRequests="128mb",
+                              replicas="5",
+                              node_selector={"zone": "zone-0"})
+                zone0 = {n["name"] for n in fx["nodes"]
+                         if n["labels"].get("zone") == "zone-0"}
+                assert set(sel["by_node"]) <= zone0
+        finally:
+            srv.shutdown()
