@@ -18,3 +18,4 @@ from kubernetesclustercapacity_tpu.parallel.sweep import (  # noqa: F401
     sweep_gspmd,
     sweep_shard_map,
 )
+from kubernetesclustercapacity_tpu.parallel import multihost  # noqa: F401

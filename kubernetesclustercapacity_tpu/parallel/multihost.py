@@ -1,0 +1,149 @@
+"""Multi-host sweeps: the DCN story (SURVEY.md §5 "distributed backend").
+
+The reference has no distributed anything (§2.3); this framework's scaling
+axes are *scenarios* and *nodes*, and they map onto TPU pod networks the
+standard way:
+
+* **scenario axis over DCN** — embarrassingly parallel: each host owns a
+  contiguous block of the what-if grid and computes complete results for
+  it.  Zero cross-host collectives in the compute; one optional
+  ``process_allgather`` at the end if every host wants the full result.
+* **node axis over ICI** — within a host's chips, the ``psum`` replica
+  reduction of :func:`..parallel.sweep.sweep_shard_map` rides the
+  intra-slice interconnect.
+
+Launch recipe (one process per host, standard JAX multi-process SPMD)::
+
+    # on every host, same program:
+    from kubernetesclustercapacity_tpu.parallel import multihost
+    multihost.initialize(coordinator_address="host0:8476",
+                         num_processes=H, process_id=h)   # no-op when H==1
+    totals, sched = multihost.sweep_multihost(snapshot_arrays, grid)
+
+Everything here degrades to single-process semantics when
+``jax.process_count() == 1``, so the same program runs on a laptop, one
+TPU host, or a pod — and the test suite exercises the single-process path
+on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
+from kubernetesclustercapacity_tpu.parallel.mesh import SCENARIO_AXIS
+
+__all__ = ["initialize", "sweep_multihost", "scenario_block"]
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> bool:
+    """Join the multi-process JAX runtime; no-op for single-process runs.
+
+    Returns True when distributed mode was initialized.  Call once per
+    process before any other JAX use, exactly like
+    ``jax.distributed.initialize`` (which this wraps).
+    """
+    if not num_processes or num_processes == 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    return True
+
+
+def scenario_block(total: int, process_id: int, process_count: int) -> tuple[int, int]:
+    """The [start, stop) scenario rows process ``process_id`` owns.
+
+    Blocks are contiguous and cover ``total`` exactly; the last block may
+    be short.  Every process must compute the SAME split (it is pure
+    arithmetic on the global size).
+    """
+    per = -(-total // process_count)  # ceil
+    start = min(process_id * per, total)
+    return start, min(start + per, total)
+
+
+def sweep_multihost(
+    snapshot_arrays: tuple,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+    gather: bool = True,
+):
+    """Sweep a globally-partitioned scenario grid across all hosts.
+
+    Every process passes the FULL grid (it is tiny — three int64 vectors);
+    each host computes only its :func:`scenario_block` on its local
+    devices, scenario-sharded.  The snapshot arrays are replicated per
+    host (node-axis sharding across hosts would put the ``psum`` on DCN —
+    the wrong network for it; shard nodes only within a host via
+    :func:`..parallel.sweep.sweep_shard_map`).
+
+    With ``gather`` (default) the per-host partial results are
+    all-gathered so every process returns the full ``(totals[S],
+    schedulable[S])``; with ``gather=False`` each process returns only its
+    own block (stitch externally, e.g. when only host 0 reports).
+    """
+    cpu_reqs = np.asarray(cpu_reqs, dtype=np.int64)
+    mem_reqs = np.asarray(mem_reqs, dtype=np.int64)
+    replicas = np.asarray(replicas, dtype=np.int64)
+    s = cpu_reqs.shape[0]
+    pid, pcount = jax.process_index(), jax.process_count()
+    start, stop = scenario_block(s, pid, pcount)
+
+    # Local slice, padded to the local device count and scenario-sharded
+    # over the host's chips (no cross-host sharding anywhere).
+    local_devices = jax.local_devices()
+    k = max(len(local_devices), 1)
+    width = stop - start
+    s_pad = -(-max(width, 1) // k) * k
+    pad = s_pad - width
+
+    def stage(a, fill):
+        block = a[start:stop]
+        if pad:
+            block = np.pad(block, (0, pad), constant_values=fill)
+        mesh = Mesh(np.array(local_devices), (SCENARIO_AXIS,))
+        return jax.device_put(block, NamedSharding(mesh, P(SCENARIO_AXIS)))
+
+    cpu_d = stage(cpu_reqs, 1)  # pad with harmless 1-milli probes
+    mem_d = stage(mem_reqs, 1)
+    rep_d = stage(replicas, 0)
+    arrays_d = tuple(jax.device_put(np.asarray(a)) for a in snapshot_arrays)
+
+    totals_p, sched_p = sweep_grid(*arrays_d, cpu_d, mem_d, rep_d, mode=mode)
+    totals_local = np.asarray(totals_p)[:width]
+    sched_local = np.asarray(sched_p)[:width]
+    if not gather:
+        return totals_local, sched_local
+
+    if pcount == 1:
+        return totals_local, sched_local
+    from jax.experimental import multihost_utils  # pragma: no cover
+
+    # Fixed-width blocks so the gather is a dense [pcount, per] array;
+    # short tails are padded then sliced off after concatenation.
+    per = -(-s // pcount)
+    t_pad = np.pad(totals_local, (0, per - width))
+    s_pad_arr = np.pad(sched_local, (0, per - width))
+    gathered_t = multihost_utils.process_allgather(t_pad)  # pragma: no cover
+    gathered_s = multihost_utils.process_allgather(s_pad_arr)  # pragma: no cover
+    totals = np.concatenate(
+        [gathered_t[p][: scenario_block(s, p, pcount)[1] - scenario_block(s, p, pcount)[0]] for p in range(pcount)]
+    )  # pragma: no cover
+    sched = np.concatenate(
+        [gathered_s[p][: scenario_block(s, p, pcount)[1] - scenario_block(s, p, pcount)[0]] for p in range(pcount)]
+    )  # pragma: no cover
+    return totals, sched  # pragma: no cover

@@ -75,3 +75,62 @@ def test_mesh_padding_math():
     assert plan.pad_scenarios(97) == 100
     assert plan.pad_nodes(503) == 504
     assert plan.pad_nodes(504) == 504
+
+
+class TestMultihost:
+    """Single-process path of the DCN layer (same program runs on a pod)."""
+
+    def test_initialize_is_noop_single_process(self):
+        from kubernetesclustercapacity_tpu.parallel import multihost
+
+        assert multihost.initialize() is False
+        assert multihost.initialize(num_processes=1) is False
+
+    def test_scenario_block_partition(self):
+        from kubernetesclustercapacity_tpu.parallel.multihost import (
+            scenario_block,
+        )
+
+        for total, pcount in [(97, 4), (8, 8), (5, 8), (1000, 3)]:
+            blocks = [scenario_block(total, p, pcount) for p in range(pcount)]
+            covered = []
+            for start, stop in blocks:
+                assert 0 <= start <= stop <= total
+                covered.extend(range(start, stop))
+            assert covered == list(range(total))  # exact disjoint cover
+
+    def test_sweep_multihost_matches_unsharded(self, snap, grid, baseline):
+        from kubernetesclustercapacity_tpu.parallel.multihost import (
+            sweep_multihost,
+        )
+
+        totals, sched = sweep_multihost(
+            _arrays(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas,
+        )
+        np.testing.assert_array_equal(totals, baseline[0])
+        np.testing.assert_array_equal(sched, baseline[1])
+
+    def test_gather_false_returns_local_block(self, snap, grid, baseline):
+        from kubernetesclustercapacity_tpu.parallel.multihost import (
+            sweep_multihost,
+        )
+
+        totals, _ = sweep_multihost(
+            _arrays(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, gather=False,
+        )
+        # Single process owns the whole grid.
+        np.testing.assert_array_equal(totals, baseline[0])
+
+    def test_strict_mode(self, snap, grid):
+        from kubernetesclustercapacity_tpu.parallel.multihost import (
+            sweep_multihost,
+        )
+
+        ref_totals, _ = sweep_snapshot(snap, grid, mode="strict")
+        totals, _ = sweep_multihost(
+            _arrays(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, mode="strict",
+        )
+        np.testing.assert_array_equal(totals, ref_totals)
