@@ -35,7 +35,10 @@ from __future__ import annotations
 
 import json
 import os
+import sys
+import threading
 import time
+import traceback
 
 import numpy as np
 
@@ -46,8 +49,109 @@ REPS = 9  # timed repetitions per scan length (same staged batch; jit does
 # not memoize results, so re-running identical inputs re-executes the
 # kernel — staging once keeps slow tunnel transfers off the rep loop)
 
+_METRIC = "sweep_10k_nodes_x_1k_scenarios_p50"
+
+# Backend acquisition bounds.  The TPU here sits behind a tunnel that can
+# be transiently UNAVAILABLE (that exact failure cost round 1 its number),
+# so init gets a bounded retry loop; a *hung* init (C++ blocking inside
+# jax.devices()) gets a watchdog timeout instead — it holds the backend
+# lock, so further in-process retries would deadlock.
+def _env_num(name: str, default: float, cast) -> float:
+    """Env override that can never break the one-JSON-line contract."""
+    try:
+        return cast(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+_INIT_ATTEMPTS = max(1, _env_num("KCC_BENCH_INIT_ATTEMPTS", 5, int))
+_INIT_TIMEOUT_S = max(1.0, _env_num("KCC_BENCH_INIT_TIMEOUT_S", 300, float))
+
+
+def _emit(payload: dict) -> None:
+    """The bench's single contractual output: one JSON line on stdout."""
+    print(json.dumps(payload), flush=True)
+
+
+def _fail(error: str, **aux) -> None:
+    """Structured failure line — same metric key, value null, error field."""
+    _emit(
+        {
+            "metric": _METRIC,
+            "value": None,
+            "unit": "ms",
+            "vs_baseline": 0.0,
+            "error": error,
+            **aux,
+        }
+    )
+
+
+def _acquire_backend():
+    """jax.devices() with bounded retry/backoff and a hang watchdog.
+
+    Returns ``(devices, None)`` on success or ``(None, error_str)`` after
+    exhausting attempts.  Each attempt runs in a daemon thread so a hung
+    PJRT init cannot wedge the bench past the watchdog; on timeout no
+    retry is made (the stuck thread still holds jax's backend lock).
+    """
+    import jax
+
+    last_err = "unknown"
+    for attempt in range(_INIT_ATTEMPTS):
+        box: dict = {}
+
+        def probe() -> None:
+            try:
+                box["devices"] = jax.devices()
+            except Exception as e:  # noqa: BLE001 - reported, retried
+                box["error"] = f"{type(e).__name__}: {e}"
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(_INIT_TIMEOUT_S)
+        if t.is_alive():
+            return None, (
+                f"backend init hung > {_INIT_TIMEOUT_S:.0f}s "
+                f"(attempt {attempt + 1}/{_INIT_ATTEMPTS})"
+            )
+        if "devices" in box:
+            return box["devices"], None
+        last_err = box.get("error", "unknown")
+        if attempt + 1 < _INIT_ATTEMPTS:
+            # Reset jax's cached backend failure so the next attempt
+            # actually re-dials the plugin instead of replaying the error.
+            try:
+                import jax._src.xla_bridge as xb
+
+                xb._clear_backends()
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+            time.sleep(min(2.0 ** attempt, 30.0))
+    return None, f"{last_err} (after {_INIT_ATTEMPTS} attempts)"
+
 
 def main() -> None:
+    try:
+        _run()
+    except Exception as e:  # noqa: BLE001 - bench must emit JSON, not die
+        tb = traceback.format_exc()
+        print(tb, file=sys.stderr)  # full trace for interactive diagnosis
+        lines = tb.strip().splitlines()
+        # Keep the frames that identify WHERE in the bench it died (deep
+        # library stacks would otherwise crowd out the bench-side frame).
+        bench_frames = [
+            ln.strip() for ln in lines if "bench.py" in ln and "File" in ln
+        ]
+        _fail(
+            f"unhandled {type(e).__name__}: {e}",
+            bench_frames=bench_frames[-3:],
+            traceback_tail=lines[-2:],
+        )
+        sys.exit(0)
+
+
+def _run() -> None:
     import jax
 
     # A TPU-plugin sitecustomize may re-pin jax_platforms at interpreter
@@ -57,6 +161,15 @@ def main() -> None:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         except RuntimeError:
             pass
+
+    devices, init_err = _acquire_backend()
+    if init_err is not None:
+        _fail(
+            f"backend init failed: {init_err}",
+            init_attempts=_INIT_ATTEMPTS,
+            init_timeout_s=_INIT_TIMEOUT_S,
+        )
+        return
 
     import kubernetesclustercapacity_tpu as kcc
     from kubernetesclustercapacity_tpu.fixtures import load_fixture
@@ -80,17 +193,7 @@ def main() -> None:
     grid_small = kcc.ScenarioGrid.from_scenarios([scenario])
     totals_small, _ = kcc.sweep_snapshot(snap_small, grid_small)
     if int(totals_small[0]) != oracle.total_possible_replicas:
-        print(
-            json.dumps(
-                {
-                    "metric": "sweep_10k_nodes_x_1k_scenarios_p50",
-                    "value": None,
-                    "unit": "ms",
-                    "vs_baseline": 0.0,
-                    "error": "correctness gate failed",
-                }
-            )
-        )
+        _fail("correctness gate failed")
         return
 
     # --- dispatch floor: what one tunnel round trip costs, kernel aside.
@@ -423,26 +526,18 @@ def main() -> None:
     if p50 <= 0:
         # Tunnel jitter swamped the slope (mins[K_BIG] <= mins[K_SMALL]):
         # never publish a nonsense non-positive latency.
-        print(
-            json.dumps(
-                {
-                    "metric": "sweep_10k_nodes_x_1k_scenarios_p50",
-                    "value": None,
-                    "unit": "ms",
-                    "vs_baseline": 0.0,
-                    "error": "non-positive timing slope (dispatch jitter)",
-                    "exact_int64_per_sweep_ms": round(exact_per_sweep, 3),
-                    "dispatch_floor_ms": round(dispatch_floor_ms, 3),
-                }
-            )
+        _fail(
+            "non-positive timing slope (dispatch jitter)",
+            exact_int64_per_sweep_ms=round(exact_per_sweep, 3),
+            dispatch_floor_ms=round(dispatch_floor_ms, 3),
         )
         return
     scenarios_per_sec = n_scenarios / (p50 / 1e3)
 
-    print(
-        json.dumps(
+    _emit(
+        (
             {
-                "metric": "sweep_10k_nodes_x_1k_scenarios_p50",
+                "metric": _METRIC,
                 "value": round(p50, 3),
                 "unit": "ms",
                 "vs_baseline": round(1000.0 / p50, 2),
@@ -460,7 +555,7 @@ def main() -> None:
                     if fast_per_sweep is not None
                     else "xla_int64"
                 ),
-                "device": str(jax.devices()[0]),
+                "device": str(devices[0]),
                 "correctness_gate": "oracle-exact",
                 **(
                     {"smoke_sizes": [n_nodes, n_scenarios]}
