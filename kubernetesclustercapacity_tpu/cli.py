@@ -70,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-grid", type=int, default=0, metavar="N",
                    help="evaluate a random N-scenario sweep instead of one spec")
     p.add_argument("-seed", type=int, default=0, help="sweep RNG seed")
+    p.add_argument("-kernel", choices=("auto", "exact"), default="auto",
+                   help="sweep kernel: auto (Pallas fast path when provably "
+                        "bit-exact) or exact (force the int64 XLA kernel)")
     p.add_argument("-save-snapshot", default="", metavar="PATH",
                    help="checkpoint the packed snapshot to PATH (.npz)")
     return p
@@ -247,15 +250,18 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
 
 
 def _run_grid(args, snapshot) -> int:
-    from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+    from kubernetesclustercapacity_tpu.ops.pallas_fit import sweep_snapshot_auto
     from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
 
     grid = random_scenario_grid(args.grid, seed=args.seed)
-    totals, sched = sweep_snapshot(snapshot, grid, mode=args.semantics)
+    totals, sched, kernel = sweep_snapshot_auto(
+        snapshot, grid, mode=args.semantics, kernel=args.kernel
+    )
     summary = {
         "scenarios": args.grid,
         "seed": args.seed,
         "semantics": args.semantics,
+        "kernel": kernel,
         "totals": totals.tolist(),
         "schedulable": sched.tolist(),
         "totals_p50": float(np.percentile(totals, 50)),

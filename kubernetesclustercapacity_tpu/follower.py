@@ -84,7 +84,11 @@ class ClusterFollower:
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # _versions, _epoch and _store share _lock: every read or write of
+        # any of them happens under it (two watch threads + callers race).
         self._versions: dict[str, str] = {}
+        self._epoch = 0  # bumped by every relist; stale streams stop applying
+        self._fatal: str | None = None
         self._errors: collections.deque = collections.deque(maxlen=100)
 
     # -- lifecycle ---------------------------------------------------------
@@ -110,6 +114,13 @@ class ClusterFollower:
 
     def stop(self) -> None:
         self._stop.set()
+
+    def wait_stopped(self, timeout: float | None = None) -> bool:
+        """Block until :meth:`stop` is called (by a user or by a fatal
+        watch-thread death — check :attr:`fatal` afterwards).  Supervisors
+        serving this follower's snapshots wait on this: a stopped follower
+        means the served state will only grow staler."""
+        return self._stop.wait(timeout)
 
     def join(self, timeout: float | None = None) -> None:
         """Wait for the watch streams to end (tests: finite mock streams)."""
@@ -139,6 +150,18 @@ class ClusterFollower:
         bounded to the last 100)."""
         return list(self._errors)
 
+    @property
+    def fatal(self) -> str | None:
+        """Non-``None`` when a watch thread died on an unexpected error.
+
+        Transport and apply failures relist-and-continue; anything else
+        (notably :class:`~.oracle.ReferencePanic`, which reference mode
+        deliberately re-raises where the Go process would have died) stops
+        the follower and is recorded here — a dead sync loop must be
+        *visible*, never a silently stale snapshot."""
+        with self._lock:
+            return self._fatal
+
     # -- internals ---------------------------------------------------------
     def _relist(self) -> None:
         """Full list of both resources → fresh store, under one lock hold."""
@@ -157,14 +180,33 @@ class ClusterFollower:
         with self._lock:
             self._store = store
             self._versions = versions
+            self._epoch += 1
         self._synced.set()
 
     def _watch_loop(self, path: str) -> None:
+        try:
+            self._watch_loop_inner(path)
+        except Exception as e:  # noqa: BLE001 - a dead watch must be visible
+            # Unexpected failure — notably ReferencePanic, which reference
+            # mode re-raises where the Go process would have died, or a bug
+            # in convert/apply.  Record it, mark the follower fatal, and
+            # stop BOTH streams: serving ever-staler snapshots behind a
+            # silently dead thread is the one unacceptable outcome.
+            self._errors.append(f"{path}: fatal {type(e).__name__}: {e}")
+            with self._lock:
+                self._fatal = f"{path}: {type(e).__name__}: {e}"
+            self.stop()
+
+    def _watch_loop_inner(self, path: str) -> None:
         kind, convert = _RESOURCES[path]
         while not self._stop.is_set():
-            version = self._versions.get(path)
+            with self._lock:
+                version = self._versions.get(path)
+                epoch = self._epoch
             try:
-                stream_ended = self._consume_stream(path, kind, convert, version)
+                stream_ended = self._consume_stream(
+                    path, kind, convert, version, epoch
+                )
             except (KubeAPIError, StoreError) as e:
                 self._errors.append(f"{path}: {e}")
                 # Back off, then relist (410 Gone / transport loss / bad
@@ -183,7 +225,9 @@ class ClusterFollower:
                         self._errors.append(f"relist {path}: {e2}")
                 continue
             if stream_ended:
-                if version == self._versions.get(path):
+                with self._lock:
+                    unchanged = version == self._versions.get(path)
+                if unchanged:
                     # Window ended with no progress (idle cluster, or a
                     # finite mock stream under test).
                     if self._stop_on_idle_window:
@@ -193,7 +237,13 @@ class ClusterFollower:
                     self._stop.wait(self._idle_backoff)
                 continue  # re-watch from the latest seen version
 
-    def _consume_stream(self, path, kind, convert, version) -> bool:
+    def _consume_stream(self, path, kind, convert, version, epoch) -> bool:
+        """Stream one watch window.  ``epoch`` is the relist generation this
+        stream was started against: if a peer thread relists mid-flight
+        (swapping in a store listed at a NEWER resourceVersion), this
+        stream's remaining events are older than the store and must not be
+        applied — the epoch check drops them and ends the stream, and the
+        loop re-watches from the post-relist version."""
         client = self._factory()
         try:
             for event in client.watch_events(
@@ -205,23 +255,35 @@ class ClusterFollower:
                 obj = event.get("object") or {}
                 if etype == "BOOKMARK":
                     rv = (obj.get("metadata") or {}).get("resourceVersion")
-                    if rv:
-                        self._versions[path] = rv
+                    if rv and not self._set_version(path, rv, epoch):
+                        return False  # stale epoch: abandon this stream
                     continue
                 if etype == "ERROR":
                     raise KubeAPIError(
                         f"watch error event: {obj.get('message', obj)}"
                     )
                 rv = (obj.get("metadata") or {}).get("resourceVersion")
-                self._apply(kind, etype, convert(obj))
-                if rv:
-                    self._versions[path] = rv
+                if not self._apply(kind, etype, convert(obj), epoch):
+                    return False  # stale epoch: abandon this stream
+                if rv and not self._set_version(path, rv, epoch):
+                    return False
             return True
         finally:
             client.close()
 
-    def _apply(self, kind: str, etype: str, obj: dict) -> None:
+    def _set_version(self, path: str, rv: str, epoch: int) -> bool:
+        """Advance the resume version — only if this stream is current."""
         with self._lock:
+            if epoch != self._epoch:
+                return False
+            self._versions[path] = rv
+        return True
+
+    def _apply(self, kind: str, etype: str, obj: dict, epoch: int) -> bool:
+        """Apply one event; False (no-op) if the stream's epoch is stale."""
+        with self._lock:
+            if epoch != self._epoch:
+                return False
             store = self._store
             if kind == "Node":
                 exists = store.has_node(obj.get("name", ""))
@@ -234,7 +296,8 @@ class ClusterFollower:
             if etype in ("ADDED", "MODIFIED"):
                 etype = "MODIFIED" if exists else "ADDED"
             elif etype == "DELETED" and not exists:
-                return
+                return True
             store.apply_event({"type": etype, "kind": kind, "object": obj})
         if self.on_event is not None:
             self.on_event(kind, etype, obj)
+        return True

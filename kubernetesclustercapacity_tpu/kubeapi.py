@@ -44,6 +44,12 @@ __all__ = [
 ]
 
 
+# Watch liveness watchdog: client read timeout = timeoutSeconds + this.
+# The server must end the window within timeoutSeconds; the grace covers
+# scheduling/transit slack before a silent dead peer is declared.
+_WATCH_GRACE_SECONDS = 30.0
+
+
 class KubeConfigError(ValueError):
     """Unusable kubeconfig (missing file/context/credentials)."""
 
@@ -366,10 +372,19 @@ class KubeClient:
         a watch — the connection is occupied for the stream's lifetime.
 
         Idle-cluster handling: the window is bounded *server-side* via
-        ``timeoutSeconds`` (which ends the stream cleanly) while the client
-        socket has NO read timeout by default — an idle watch must block,
-        not raise ``socket.timeout`` and masquerade as a transport failure.
+        ``timeoutSeconds`` (which ends the stream cleanly), and the client
+        socket carries a read timeout of ``timeoutSeconds`` plus a grace
+        period as a liveness watchdog — if the apiserver or an LB dies
+        without sending FIN, the server-side bound can never fire, and
+        without the watchdog a reader would block on the dead socket
+        forever.  A watchdog trip *while streaming* is treated as a clean
+        end-of-window (the caller re-watches, exactly as after a normal
+        window close), not a transport failure; pass ``read_timeout``
+        explicitly to override, or ``timeout_seconds=None`` for an
+        unbounded watch with no watchdog.
         """
+        if read_timeout is None and timeout_seconds is not None:
+            read_timeout = timeout_seconds + _WATCH_GRACE_SECONDS
         query = urllib.parse.urlencode(
             {
                 k: v
@@ -400,7 +415,14 @@ class KubeClient:
                     f"{body[:200].decode(errors='replace')}"
                 )
             while True:
-                line = resp.readline()
+                try:
+                    line = resp.readline()
+                except TimeoutError:
+                    # Liveness watchdog: the stream outlived timeoutSeconds
+                    # + grace, so the server-side window bound is never
+                    # coming (dead peer, no FIN).  Clean end-of-window —
+                    # the caller re-watches on a fresh connection.
+                    return
                 if not line:
                     return  # server closed the watch window
                 line = line.strip()

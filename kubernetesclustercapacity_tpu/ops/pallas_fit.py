@@ -43,6 +43,7 @@ __all__ = [
     "rcp_division_eligible",
     "sweep_pallas",
     "sweep_auto",
+    "sweep_snapshot_auto",
 ]
 
 LANES = 128
@@ -426,24 +427,86 @@ def sweep_auto(
     replicas,
     *,
     interpret: bool = False,
+    force_exact: bool = False,
 ):
     """Fast path when eligible, exact int64 path otherwise — always bit-exact.
 
     Reference semantics only (the fast path exists for the headline sweep;
-    strict mode goes through the exact kernel).  Returns numpy
-    ``(totals[S], schedulable[S], used_fast_path)``.
+    strict mode goes through the exact kernel).  The ONE dispatcher: every
+    auto-kernel surface (:func:`sweep_snapshot_auto`, and through it the
+    CLI and service) funnels here, so eligibility/padding fixes land
+    everywhere at once.  Returns numpy ``(totals[S], schedulable[S],
+    kernel_name)`` with ``kernel_name`` one of ``pallas_i32_rcp_fused``,
+    ``pallas_i32_fused``, ``xla_int64``.
     """
-    if fast_sweep_eligible(
+    if not force_exact and fast_sweep_eligible(
         alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
         cpu_reqs, mem_reqs,
     ):
+        use_rcp = rcp_division_eligible(
+            alloc_cpu, alloc_mem, used_cpu, used_mem, cpu_reqs, mem_reqs
+        )
         totals, sched = sweep_pallas(
             alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
             cpu_reqs, mem_reqs, replicas, interpret=interpret,
+            use_rcp=use_rcp,
         )
-        return totals, sched, True
+        name = "pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused"
+        return totals, sched, name
     totals, sched = sweep_grid(
         alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
         healthy, cpu_reqs, mem_reqs, replicas, mode="reference",
     )
-    return np.asarray(totals), np.asarray(sched), False
+    return np.asarray(totals), np.asarray(sched), "xla_int64"
+
+
+def sweep_snapshot_auto(
+    snapshot,
+    grid,
+    *,
+    mode: str = "reference",
+    kernel: str = "auto",
+    interpret: bool | None = None,
+):
+    """Production sweep entry: fastest kernel that is provably bit-exact.
+
+    The dispatch the CLI ``-grid`` path and the service ``sweep`` op use
+    (the reference evaluates its one scenario with the sequential loop at
+    ``ClusterCapacity.go:105-140``; a sweep is that loop over S what-if
+    specs).  Eligible reference-mode sweeps take the fused Pallas int32
+    path — the same kernel the headline bench times — everything else
+    takes the exact int64 XLA kernel.  Strict mode always goes exact: its
+    healthy/slot clamping lives only in the int64 kernel.
+
+    ``kernel="exact"`` forces the int64 path (operator escape hatch);
+    ``interpret=None`` auto-selects Pallas interpret mode off-TPU.
+    Returns ``(totals[S], schedulable[S], kernel_name)`` with numpy arrays
+    and the kernel actually used.
+    """
+    from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+
+    if kernel not in ("auto", "exact"):
+        raise ValueError(f"unknown kernel {kernel!r}")
+    if mode != "reference":
+        totals, sched = sweep_snapshot(snapshot, grid, mode=mode)
+        return totals, sched, "xla_int64"
+    grid.validate()
+    if interpret is None:
+        # The real chip may register under a plugin platform name (here
+        # "axon"), so detect the one backend that NEEDS interpret mode
+        # rather than allowlisting TPU.
+        interpret = jax.default_backend() == "cpu"
+    return sweep_auto(
+        snapshot.alloc_cpu_milli,
+        snapshot.alloc_mem_bytes,
+        snapshot.alloc_pods,
+        snapshot.used_cpu_req_milli,
+        snapshot.used_mem_req_bytes,
+        snapshot.pods_count,
+        snapshot.healthy,
+        grid.cpu_request_milli,
+        grid.mem_request_bytes,
+        grid.replicas,
+        interpret=interpret,
+        force_exact=(kernel == "exact"),
+    )

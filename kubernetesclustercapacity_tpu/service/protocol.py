@@ -10,10 +10,21 @@ info       —
 fit        ``cpuRequests``/``cpuLimits``/``memRequests``/``memLimits``/
            ``replicas`` (flag STRINGS, parsed server-side with exact
            reference semantics), optional ``output`` (``reference`` |
-           ``json`` | ``table``)
+           ``json`` | ``table``), optional ``backend`` (``tpu`` |
+           ``cpu``), optional PodSpec constraint fields
+           (``tolerations``/``node_selector``/``affinity_terms``/
+           ``anti_affinity_labels``/``spread``/``extended_requests``)
 sweep      ``cpu_request_milli``/``mem_request_bytes``/``replicas``
-           (numeric arrays) OR ``random: {n, seed}``
-reload     ``path`` — swap the served snapshot (fixture .json or .npz)
+           (numeric arrays) OR ``random: {n, seed}``; optional
+           ``kernel`` (``auto`` — Pallas fast path when provably
+           bit-exact — | ``exact``); result carries the kernel used
+place      the fit flag/spec fields plus optional ``policy``
+           (``first-fit`` | ``best-fit`` | ``spread``) — placement
+           simulation; result maps each replica to a node
+reload     ``path`` — swap the served snapshot (fixture .json or .npz);
+           optional ``semantics``
+update     ``events`` — watch-style node/pod event list applied
+           incrementally to the served snapshot (fixture-backed only)
 =========  ==========================================================
 
 Responses: ``{"ok": true, "result": ...}`` or ``{"ok": false, "error": "..."}``.

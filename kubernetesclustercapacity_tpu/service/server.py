@@ -365,7 +365,9 @@ class CapacityServer:
         }
 
     def _op_sweep(self, msg: dict, snap: ClusterSnapshot) -> dict:
-        from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            sweep_snapshot_auto,
+        )
 
         if "random" in msg:
             grid = random_scenario_grid(
@@ -377,11 +379,14 @@ class CapacityServer:
                 mem_request_bytes=np.asarray(msg["mem_request_bytes"]),
                 replicas=np.asarray(msg.get("replicas", [1])),
             )
-        totals, sched = sweep_snapshot(snap, grid, mode=snap.semantics)
+        totals, sched, kernel = sweep_snapshot_auto(
+            snap, grid, mode=snap.semantics, kernel=msg.get("kernel", "auto")
+        )
         return {
             "totals": totals.tolist(),
             "schedulable": sched.tolist(),
             "scenarios": grid.size,
+            "kernel": kernel,
         }
 
     def replace_snapshot(
@@ -495,8 +500,27 @@ def main(argv=None) -> int:
         file=sys.stderr,
     )
     try:
-        server.serve_forever()
+        if follower is None:
+            server.serve_forever()
+        else:
+            # Supervised serve: if the follower dies (fatal watch-thread
+            # failure, e.g. ReferencePanic), the service must die WITH it —
+            # silently answering every query from a snapshot frozen at the
+            # failure instant is the one unacceptable outcome.
+            server.start()
+            while not follower.wait_stopped(1.0):
+                pass
+            if follower.fatal is not None:
+                print(
+                    f"ERROR : follower died: {follower.fatal}",
+                    file=sys.stderr,
+                )
+                return 2
     except KeyboardInterrupt:
+        pass
+    finally:
+        if follower is not None:
+            follower.stop()
         server.shutdown()
     return 0
 

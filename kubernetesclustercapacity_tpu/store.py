@@ -21,6 +21,7 @@ orphan pods, mod-2^64 usage wrap, parse-fail→0).
 
 from __future__ import annotations
 
+import collections
 import copy
 
 import numpy as np
@@ -73,6 +74,20 @@ class ClusterStore:
         self.extended_resources = tuple(extended_resources)
         # Raw state, deep-copied: events must never alias caller objects.
         self._nodes: list[dict] = [copy.deepcopy(n) for n in fixture.get("nodes", [])]
+        if semantics == "strict":
+            # Strict mode matches pods to rows BY NAME, so duplicate or
+            # empty names would diverge from _pack_strict (whose name index
+            # is last-wins and whose "" row never matches): reject them,
+            # preserving the element-identical-to-full-repack invariant.
+            # (Reference mode keeps them: phantom-row semantics, Q4.)
+            names = collections.Counter(
+                n.get("name", "") for n in self._nodes
+            )
+            if names[""]:
+                raise StoreError("strict mode requires non-empty node names")
+            dups = sorted(x for x, c in names.items() if c > 1)
+            if dups:
+                raise StoreError(f"duplicate node names in fixture: {dups}")
         self._pods: dict[tuple[str, str], dict] = {}
         self._pods_by_node: dict[str, dict[tuple[str, str], dict]] = {}
         for p in fixture.get("pods", []):
@@ -227,6 +242,8 @@ class ClusterStore:
         name = node.get("name", "")
         if etype in ("ADDED", "MODIFIED"):
             self._validate_node(node)
+            if self.semantics == "strict" and not name:
+                raise StoreError("strict mode requires non-empty node names")
         idx = [i for i, n in enumerate(self._nodes) if n.get("name", "") == name]
         if etype == "ADDED":
             if idx:

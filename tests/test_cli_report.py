@@ -159,6 +159,21 @@ class TestCli:
         doc = json.loads(capsys.readouterr().out)
         assert len(doc["totals"]) == 16
         assert 0 <= doc["schedulable_fraction"] <= 1
+        assert doc["kernel"] in (
+            "pallas_i32_rcp_fused", "pallas_i32_fused", "xla_int64",
+        )
+
+    def test_grid_sweep_kernel_flag_forces_exact(self, capsys):
+        rc = main(["-snapshot", KIND, "-grid", "8", "-kernel", "exact"])
+        assert rc == 0
+        exact = json.loads(capsys.readouterr().out)
+        assert exact["kernel"] == "xla_int64"
+        rc = main(["-snapshot", KIND, "-grid", "8"])
+        assert rc == 0
+        auto = json.loads(capsys.readouterr().out)
+        # whichever kernel auto picked, the results are bit-identical
+        assert auto["totals"] == exact["totals"]
+        assert auto["schedulable"] == exact["schedulable"]
 
     def test_npz_roundtrip_through_cli(self, tmp_path, capsys):
         p = str(tmp_path / "snap.npz")

@@ -385,3 +385,70 @@ class TestLiveFixture:
         ]
         client.close()
         assert client._conn is None
+
+
+class TestWatchLivenessWatchdog:
+    """ADVICE round 1: a silently dead apiserver (no FIN) must end the
+    watch via the client-side read timeout, not block readline() forever."""
+
+    def test_default_read_timeout_derived_from_window(self, monkeypatch):
+        captured = {}
+
+        def fake_connect(self, timeout=None):
+            captured["timeout"] = timeout
+            raise OSError("probe stop")
+
+        monkeypatch.setattr(KubeClient, "_connect", fake_connect)
+        client = KubeClient(KubeConfig("http://127.0.0.1:1"))
+        with pytest.raises(OSError):
+            list(client.watch_events("/api/v1/nodes", timeout_seconds=120))
+        assert captured["timeout"] == 150.0  # timeoutSeconds + 30s grace
+        with pytest.raises(OSError):
+            list(client.watch_events("/api/v1/nodes", timeout_seconds=None))
+        assert captured["timeout"] is None  # unbounded watch: no watchdog
+        with pytest.raises(OSError):
+            list(client.watch_events("/api/v1/nodes", read_timeout=7.0))
+        assert captured["timeout"] == 7.0  # explicit override wins
+
+    def test_silent_dead_stream_ends_cleanly(self):
+        import socket
+        import threading
+        import time
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        release = threading.Event()
+
+        def serve():
+            conn, _ = srv.accept()
+            conn.recv(65536)
+            conn.sendall(
+                b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n\r\n"
+            )
+            # One event, then silence with the socket held open: no FIN,
+            # no server-side window end — only the watchdog can end this.
+            conn.sendall(
+                json.dumps(
+                    {"type": "BOOKMARK",
+                     "object": {"metadata": {"resourceVersion": "5"}}}
+                ).encode() + b"\n"
+            )
+            release.wait(10)
+            conn.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        client = KubeClient(KubeConfig(f"http://127.0.0.1:{port}"))
+        t0 = time.monotonic()
+        events = list(
+            client.watch_events("/api/v1/nodes", read_timeout=0.5)
+        )
+        elapsed = time.monotonic() - t0
+        release.set()
+        srv.close()
+        # The pre-hang event arrived, then a clean end-of-window — no
+        # KubeAPIError, and well before any server action.
+        assert [e["type"] for e in events] == ["BOOKMARK"]
+        assert elapsed < 5

@@ -9,6 +9,7 @@ from kubernetesclustercapacity_tpu.ops.pallas_fit import (
     rcp_division_eligible,
     sweep_auto,
     sweep_pallas,
+    sweep_snapshot_auto,
 )
 from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
 from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
@@ -76,13 +77,13 @@ class TestEligibility:
         from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
         snap_big = synthetic_snapshot(4, seed=1)
         snap_big.alloc_cpu_milli[:] = 2_000_000_000
-        totals, _, fast = sweep_auto(
+        totals, _, kernel = sweep_auto(
             snap_big.alloc_cpu_milli, snap_big.alloc_mem_bytes,
             snap_big.alloc_pods, snap_big.used_cpu_req_milli,
             snap_big.used_mem_req_bytes, snap_big.pods_count,
             snap_big.healthy, cpu, mem, np.array([1]), interpret=True,
         )
-        assert not fast
+        assert kernel == "xla_int64"
         exact, _ = sweep_snapshot(snap_big, __import__(
             "kubernetesclustercapacity_tpu.scenario", fromlist=["ScenarioGrid"]
         ).ScenarioGrid(cpu, mem, np.array([1])))
@@ -263,21 +264,72 @@ class TestAuto:
     def test_auto_uses_fast_when_eligible(self):
         snap = synthetic_snapshot(300, seed=9)
         grid = random_scenario_grid(16, seed=10)
-        totals, sched, fast = sweep_auto(
+        totals, sched, kernel = sweep_auto(
             *_args(snap), snap.healthy, grid.cpu_request_milli,
             grid.mem_request_bytes, grid.replicas, interpret=True,
         )
-        assert fast
+        assert kernel.startswith("pallas_")
         exact_totals, _ = sweep_snapshot(snap, grid)
         np.testing.assert_array_equal(totals, exact_totals)
 
     def test_auto_falls_back_when_ineligible(self):
         snap = synthetic_snapshot(300, seed=9, kib_quantized=False)
         grid = random_scenario_grid(16, seed=10)
-        totals, sched, fast = sweep_auto(
+        totals, sched, kernel = sweep_auto(
             *_args(snap), snap.healthy, grid.cpu_request_milli,
             grid.mem_request_bytes, grid.replicas, interpret=True,
         )
-        assert not fast
+        assert kernel == "xla_int64"
         exact_totals, _ = sweep_snapshot(snap, grid)
         np.testing.assert_array_equal(totals, exact_totals)
+
+    def test_force_exact(self):
+        snap = synthetic_snapshot(50, seed=9)
+        grid = random_scenario_grid(4, seed=10)
+        _, _, kernel = sweep_auto(
+            *_args(snap), snap.healthy, grid.cpu_request_milli,
+            grid.mem_request_bytes, grid.replicas, interpret=True,
+            force_exact=True,
+        )
+        assert kernel == "xla_int64"
+
+
+class TestSnapshotAuto:
+    """The production dispatch (CLI -grid / service sweep go through this)."""
+
+    def test_eligible_takes_pallas_and_matches_exact(self):
+        snap = synthetic_snapshot(500, seed=11)
+        grid = random_scenario_grid(24, seed=12)
+        totals, sched, kernel = sweep_snapshot_auto(snap, grid)
+        assert kernel in ("pallas_i32_rcp_fused", "pallas_i32_fused")
+        exact_totals, exact_sched = sweep_snapshot(snap, grid)
+        np.testing.assert_array_equal(totals, exact_totals)
+        np.testing.assert_array_equal(sched, exact_sched)
+
+    def test_force_exact_kernel(self):
+        snap = synthetic_snapshot(100, seed=11)
+        grid = random_scenario_grid(8, seed=12)
+        _, _, kernel = sweep_snapshot_auto(snap, grid, kernel="exact")
+        assert kernel == "xla_int64"
+
+    def test_strict_mode_goes_exact(self):
+        snap = synthetic_snapshot(100, seed=11)
+        grid = random_scenario_grid(8, seed=12)
+        totals, _, kernel = sweep_snapshot_auto(snap, grid, mode="strict")
+        assert kernel == "xla_int64"
+        exact_totals, _ = sweep_snapshot(snap, grid, mode="strict")
+        np.testing.assert_array_equal(totals, exact_totals)
+
+    def test_ineligible_falls_back(self):
+        snap = synthetic_snapshot(100, seed=11, kib_quantized=False)
+        grid = random_scenario_grid(8, seed=12)
+        totals, _, kernel = sweep_snapshot_auto(snap, grid)
+        assert kernel == "xla_int64"
+        exact_totals, _ = sweep_snapshot(snap, grid)
+        np.testing.assert_array_equal(totals, exact_totals)
+
+    def test_unknown_kernel_rejected(self):
+        snap = synthetic_snapshot(10, seed=11)
+        grid = random_scenario_grid(4, seed=12)
+        with pytest.raises(ValueError, match="kernel"):
+            sweep_snapshot_auto(snap, grid, kernel="warp")

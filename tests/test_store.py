@@ -273,3 +273,36 @@ class TestRandomizedInvariant:
             if step % 10 == 9:
                 assert_matches_repack(store)
         assert_matches_repack(store)
+
+
+class TestStrictNameValidation:
+    """Strict mode matches pods to rows by name: duplicate/empty names would
+    diverge from _pack_strict's last-wins index, so they are rejected
+    up front (reference mode keeps its phantom-row quirks)."""
+
+    def test_duplicate_node_names_rejected_in_strict(self):
+        fixture = {"nodes": [_mk_node("twin"), _mk_node("twin")], "pods": []}
+        with pytest.raises(StoreError, match="duplicate node names"):
+            ClusterStore(fixture, semantics="strict")
+
+    def test_empty_node_name_rejected_in_strict(self):
+        fixture = {"nodes": [{**_mk_node("x"), "name": ""}], "pods": []}
+        with pytest.raises(StoreError, match="non-empty"):
+            ClusterStore(fixture, semantics="strict")
+
+    def test_strict_added_event_empty_name_rejected(self):
+        store = ClusterStore(
+            {"nodes": [_mk_node("a")], "pods": []}, semantics="strict"
+        )
+        anon = {**_mk_node("y"), "name": ""}
+        with pytest.raises(StoreError, match="non-empty"):
+            store.apply_event(
+                {"type": "ADDED", "kind": "Node", "object": anon}
+            )
+        assert_matches_repack(store)  # rejected pre-mutation
+
+    def test_reference_mode_still_accepts_duplicates(self):
+        fixture = {"nodes": [_mk_node("twin"), _mk_node("twin")], "pods": []}
+        store = ClusterStore(fixture, semantics="reference")
+        assert store.n_nodes == 2
+        assert_matches_repack(store)
