@@ -514,10 +514,75 @@ def _run() -> None:
             grids_stack,
             **aux,
         )[0]
+        # --- native compiled-CPU comparator: the multi-threaded C++ sweep
+        # (the role the Go binary plays in the survey's inventory) on the
+        # same workloads, for a true compiled-CPU vs TPU ratio.
+        from kubernetesclustercapacity_tpu import native as _native
+
+        if _native.available():
+            g2 = fresh_grids(1, 99)[0][0]
+
+            def native_ms(s_snap, reps=5):
+                args_nat = (
+                    s_snap.alloc_cpu_milli, s_snap.alloc_mem_bytes,
+                    s_snap.alloc_pods, s_snap.used_cpu_req_milli,
+                    s_snap.used_mem_req_bytes, s_snap.pods_count,
+                    g2.cpu_request_milli, g2.mem_request_bytes,
+                )
+                totals_n = _native.sweep(*args_nat, healthy=s_snap.healthy)
+                ts = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    totals_n = _native.sweep(*args_nat, healthy=s_snap.healthy)
+                    ts.append((time.perf_counter() - t0) * 1e3)
+                return min(ts), totals_n
+
+            nat_1k_ms, nat_1k_totals = native_ms(snap_1k)
+            exact_1k = np.asarray(
+                sweep_grid(
+                    *arrays_1k, g2.cpu_request_milli, g2.mem_request_bytes,
+                    g2.replicas, mode="reference",
+                )[0]
+            )
+            if np.array_equal(nat_1k_totals, exact_1k):
+                ladder["config2_native_cpu_per_sweep_ms"] = nat_1k_ms
+            else:  # never report a wrong comparator's time
+                ladder["native_cpu_mismatch"] = True
+            nat_10k_ms, nat_10k_totals = native_ms(snap)
+            exact_10k = np.asarray(
+                sweep_grid(
+                    *arrays, g2.cpu_request_milli, g2.mem_request_bytes,
+                    g2.replicas, mode="reference",
+                )[0]
+            )
+            if np.array_equal(nat_10k_totals, exact_10k):
+                ladder["native_cpu_10k_per_sweep_ms"] = nat_10k_ms
+            else:
+                ladder["native_cpu_10k_mismatch"] = True
+
+        # --- ingestion (SURVEY §7 "snapshot ingestion at 10k nodes"): the
+        # fixture-object walk is the production path (a live 2-List +
+        # convert yields the same fixture schema); pack is timed per
+        # semantics over a 10k-node / ~115k-pod synthetic fixture.
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+        from kubernetesclustercapacity_tpu.utils.timing import PhaseTimer
+
+        timer = PhaseTimer()
+        with timer.phase("fixture_build"):
+            fx10k = synthetic_fixture(10_000, seed=11)
+        with timer.phase("pack_reference"):
+            kcc.snapshot_from_fixture(fx10k, semantics="reference")
+        with timer.phase("pack_strict"):
+            kcc.snapshot_from_fixture(fx10k, semantics="strict")
+        ladder["fixture_10k_build_ms"] = timer.phases["fixture_build"] * 1e3
+        ladder["pack_10k_nodes_ms"] = timer.phases["pack_reference"] * 1e3
+        ladder["pack_10k_nodes_strict_ms"] = timer.phases["pack_strict"] * 1e3
+
         # Jitter can still produce a nonsense non-positive slope on the
         # cheapest configs: report null rather than a negative latency.
         ladder = {
-            k: (round(v, 3) if v > 0 else None) for k, v in ladder.items()
+            k: ((round(v, 3) if v > 0 else None) if isinstance(v, float) else v)
+            for k, v in ladder.items()
         }
     except Exception as e:  # noqa: BLE001 - aux must never kill the bench
         ladder = {"ladder_error": f"{type(e).__name__}: {e}"}

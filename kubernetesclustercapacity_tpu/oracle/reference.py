@@ -46,6 +46,7 @@ Fixture schema (all quantity values are strings, as the API serves them)::
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field
 
@@ -279,11 +280,14 @@ def pod_requests_limits(pods: list[dict]) -> tuple[int, int, int, int]:
     return cpu_lim_total, cpu_req_total, mem_lim_total, mem_req_total
 
 
+@functools.lru_cache(maxsize=1 << 16)
 def _mem_value(s: str | None) -> int:
     """``Quantity.Value()`` of a container memory string; absent/invalid → 0.
 
     (An invalid quantity cannot exist in a real API object — the apiserver
     validates — so zero matches what the zero Quantity would report.)
+    Memoized: pod memory strings repeat across a cluster (see
+    ``utils.quantity``'s cache note).
     """
     if s is None:
         return 0

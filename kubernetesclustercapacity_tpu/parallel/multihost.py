@@ -131,19 +131,25 @@ def sweep_multihost(
 
     if pcount == 1:
         return totals_local, sched_local
-    from jax.experimental import multihost_utils  # pragma: no cover
+    from jax.experimental import multihost_utils
 
     # Fixed-width blocks so the gather is a dense [pcount, per] array;
     # short tails are padded then sliced off after concatenation.
     per = -(-s // pcount)
-    t_pad = np.pad(totals_local, (0, per - width))
-    s_pad_arr = np.pad(sched_local, (0, per - width))
-    gathered_t = multihost_utils.process_allgather(t_pad)  # pragma: no cover
-    gathered_s = multihost_utils.process_allgather(s_pad_arr)  # pragma: no cover
-    totals = np.concatenate(
-        [gathered_t[p][: scenario_block(s, p, pcount)[1] - scenario_block(s, p, pcount)[0]] for p in range(pcount)]
-    )  # pragma: no cover
-    sched = np.concatenate(
-        [gathered_s[p][: scenario_block(s, p, pcount)[1] - scenario_block(s, p, pcount)[0]] for p in range(pcount)]
-    )  # pragma: no cover
-    return totals, sched  # pragma: no cover
+    gathered_t = multihost_utils.process_allgather(
+        np.pad(totals_local, (0, per - width))
+    )
+    gathered_s = multihost_utils.process_allgather(
+        np.pad(sched_local, (0, per - width))
+    )
+    return _stitch(gathered_t, s, pcount), _stitch(gathered_s, s, pcount)
+
+
+def _stitch(gathered: np.ndarray, s: int, pcount: int) -> np.ndarray:
+    """``[pcount, per]`` gathered blocks → the ``[s]`` global result
+    (drops each block's tail padding)."""
+    blocks = []
+    for p in range(pcount):
+        b0, b1 = scenario_block(s, p, pcount)
+        blocks.append(np.asarray(gathered[p])[: b1 - b0])
+    return np.concatenate(blocks)

@@ -30,6 +30,7 @@ offline and reproducibly (SURVEY.md §5 "checkpoint/resume").
 
 from __future__ import annotations
 
+import functools
 import json
 from dataclasses import dataclass, field, fields
 
@@ -202,24 +203,48 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
     pods_by_node = _oracle.pods_by_node_index(fixture)
 
     n = len(nodes)
-    snap = _empty_arrays(n)
+    # Row tuples first, one bulk np.array at the end: per-element numpy
+    # writes would cost ~1µs × 8 columns × N on the 10k-node path.
+    rows = []
     names, labels, taints = [], [], []
     raw_nodes = fixture.get("nodes", [])
     for i, node in enumerate(nodes):
         pods = pods_by_node.get(node.name, [])
         cpu_lim, cpu_req, mem_lim, mem_req = _oracle.pod_requests_limits(pods)
         names.append(node.name)
-        snap["alloc_cpu_milli"][i] = _clamp_i64(node.allocatable_cpu)
-        snap["alloc_mem_bytes"][i] = _clamp_i64(node.allocatable_memory)
-        snap["alloc_pods"][i] = node.allocatable_pods
-        snap["used_cpu_req_milli"][i] = _clamp_i64(cpu_req)
-        snap["used_cpu_lim_milli"][i] = _clamp_i64(cpu_lim)
-        snap["used_mem_req_bytes"][i] = mem_req
-        snap["used_mem_lim_bytes"][i] = mem_lim
-        snap["pods_count"][i] = len(pods)
-        snap["healthy"][i] = bool(node.name)  # phantom = zero node = ""
+        rows.append(
+            (
+                _clamp_i64(node.allocatable_cpu),
+                _clamp_i64(node.allocatable_memory),
+                node.allocatable_pods,
+                _clamp_i64(cpu_req),
+                _clamp_i64(cpu_lim),
+                mem_req,
+                mem_lim,
+                len(pods),
+            )
+        )
         labels.append(raw_nodes[i].get("labels", {}))
         taints.append(raw_nodes[i].get("taints", []))
+
+    mat = np.array(rows, dtype=np.int64).reshape(n, 8)
+    snap = dict(
+        zip(
+            (
+                "alloc_cpu_milli",
+                "alloc_mem_bytes",
+                "alloc_pods",
+                "used_cpu_req_milli",
+                "used_cpu_lim_milli",
+                "used_mem_req_bytes",
+                "used_mem_lim_bytes",
+                "pods_count",
+            ),
+            mat.T.copy(),
+        )
+    )
+    # Phantom rows (unhealthy → zero-valued node) carry the empty name (Q4).
+    snap["healthy"] = np.array([bool(nm) for nm in names], dtype=np.bool_)
 
     return ClusterSnapshot(
         names=names, semantics="reference", labels=labels, taints=taints, **snap
@@ -253,21 +278,38 @@ def _pack_strict(
         for r in extended_resources:
             ext[r][0][i] = _strict_parse(allocatable.get(r))
 
+    # Per-pod effective resources are gathered into flat lists, then
+    # scatter-added once per column (np.add.at): per-element numpy ``+=``
+    # costs ~1µs each and dominates 100k-pod ingestion otherwise.
+    rows: list[tuple] = []
     for pod in fixture.get("pods", []):
         node_name = pod.get("nodeName", "")
         if not node_name or node_name not in index:
             continue
         if pod.get("phase") in _STRICT_TERMINATED:
             continue
-        i = index[node_name]
-        snap["pods_count"][i] += 1
-        eff = _effective_pod_resources(pod, extended_resources)
-        snap["used_cpu_req_milli"][i] += eff["cpu_req"]
-        snap["used_cpu_lim_milli"][i] += eff["cpu_lim"]
-        snap["used_mem_req_bytes"][i] += eff["mem_req"]
-        snap["used_mem_lim_bytes"][i] += eff["mem_lim"]
-        for r in extended_resources:
-            ext[r][1][i] += eff["ext"][r]
+        rows.append(
+            (index[node_name], _effective_pod_resources(pod, extended_resources))
+        )
+    if rows:
+        p = len(rows)
+        idx = np.fromiter((r[0] for r in rows), dtype=np.int64, count=p)
+        np.add.at(snap["pods_count"], idx, 1)
+        for col, key in (
+            ("used_cpu_req_milli", "cpu_req"),
+            ("used_cpu_lim_milli", "cpu_lim"),
+            ("used_mem_req_bytes", "mem_req"),
+            ("used_mem_lim_bytes", "mem_lim"),
+        ):
+            vals = np.fromiter(
+                (r[1][key] for r in rows), dtype=np.int64, count=p
+            )
+            np.add.at(snap[col], idx, vals)
+        for r_name in extended_resources:
+            vals = np.fromiter(
+                (r[1]["ext"][r_name] for r in rows), dtype=np.int64, count=p
+            )
+            np.add.at(ext[r_name][1], idx, vals)
 
     return ClusterSnapshot(
         names=names,
@@ -288,37 +330,35 @@ def _effective_pod_resources(
     reserves the max of the init-container peak and the steady-state sum.
     """
 
-    def container_vals(c: dict) -> dict:
+    # Flat accumulation in local ints (no per-container dicts): this runs
+    # once per pod on the 100k-pod ingestion path.
+    cpu_req = cpu_lim = mem_req = mem_lim = 0
+    ext = dict.fromkeys(extended_resources, 0)
+    for c in pod.get("containers", []):
         res = c.get("resources", {})
         req, lim = res.get("requests", {}), res.get("limits", {})
-        return {
-            "cpu_req": _strict_parse(req.get("cpu"), milli=True),
-            "cpu_lim": _strict_parse(lim.get("cpu"), milli=True),
-            "mem_req": _strict_parse(req.get("memory")),
-            "mem_lim": _strict_parse(lim.get("memory")),
-            "ext": {r: _strict_parse(req.get(r)) for r in extended_resources},
-        }
-
-    totals = {
-        "cpu_req": 0,
-        "cpu_lim": 0,
-        "mem_req": 0,
-        "mem_lim": 0,
-        "ext": dict.fromkeys(extended_resources, 0),
-    }
-    for c in pod.get("containers", []):
-        v = container_vals(c)
-        for k in ("cpu_req", "cpu_lim", "mem_req", "mem_lim"):
-            totals[k] += v[k]
+        cpu_req += _strict_parse(req.get("cpu"), milli=True)
+        cpu_lim += _strict_parse(lim.get("cpu"), milli=True)
+        mem_req += _strict_parse(req.get("memory"))
+        mem_lim += _strict_parse(lim.get("memory"))
         for r in extended_resources:
-            totals["ext"][r] += v["ext"][r]
+            ext[r] += _strict_parse(req.get(r))
     for c in pod.get("initContainers", []):
-        v = container_vals(c)
-        for k in ("cpu_req", "cpu_lim", "mem_req", "mem_lim"):
-            totals[k] = max(totals[k], v[k])
+        res = c.get("resources", {})
+        req, lim = res.get("requests", {}), res.get("limits", {})
+        cpu_req = max(cpu_req, _strict_parse(req.get("cpu"), milli=True))
+        cpu_lim = max(cpu_lim, _strict_parse(lim.get("cpu"), milli=True))
+        mem_req = max(mem_req, _strict_parse(req.get("memory")))
+        mem_lim = max(mem_lim, _strict_parse(lim.get("memory")))
         for r in extended_resources:
-            totals["ext"][r] = max(totals["ext"][r], v["ext"][r])
-    return totals
+            ext[r] = max(ext[r], _strict_parse(req.get(r)))
+    return {
+        "cpu_req": cpu_req,
+        "cpu_lim": cpu_lim,
+        "mem_req": mem_req,
+        "mem_lim": mem_lim,
+        "ext": ext,
+    }
 
 
 def _strict_healthy(conditions: list[dict]) -> bool:
@@ -333,7 +373,10 @@ def _strict_healthy(conditions: list[dict]) -> bool:
     return ready
 
 
+@functools.lru_cache(maxsize=1 << 16)
 def _strict_parse(s: str | None, *, milli: bool = False) -> int:
+    """Strict-grammar parse with absent/invalid → 0; memoized (quantity
+    strings repeat across a cluster — see ``utils.quantity``'s cache note)."""
     if s is None:
         return 0
     try:

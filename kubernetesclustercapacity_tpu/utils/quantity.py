@@ -32,8 +32,18 @@ ingestion, never inside the TPU hot loop.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from fractions import Fraction
+
+# Quantity strings repeat massively across a cluster (every node of a
+# machine type advertises the same "8" / "16Gi" / "110"; most pods share a
+# handful of request shapes), so the pure string→value codecs are memoized.
+# 10k-node ingestion is dominated by exact-Fraction parsing without this
+# (SURVEY.md §7 "snapshot ingestion at 10k nodes").  Bounded so hostile
+# streams of distinct strings cannot grow memory; failures raise and are
+# deliberately NOT cached (lru_cache does not cache exceptions).
+_PARSE_CACHE_SIZE = 1 << 16
 
 __all__ = [
     "QuantityParseError",
@@ -85,6 +95,7 @@ def go_atoi(s: str) -> int | None:
     return value
 
 
+@functools.lru_cache(maxsize=_PARSE_CACHE_SIZE)
 def cpu_to_milli_reference(cpu: str) -> int:
     """CPU quantity string → millicores, reference semantics.
 
@@ -134,6 +145,7 @@ def _go_parse_float(s: str) -> float | None:
     return value
 
 
+@functools.lru_cache(maxsize=_PARSE_CACHE_SIZE)
 def to_bytes_reference(s: str) -> int:
     """Byte quantity string → bytes, reference ``bytefmt.ToBytes`` semantics.
 
@@ -275,6 +287,7 @@ def _ceil_fraction(f: Fraction) -> int:
     return -((-f.numerator) // f.denominator)
 
 
+@functools.lru_cache(maxsize=_PARSE_CACHE_SIZE)
 def parse_quantity(s: str) -> Quantity:
     """Parse a Kubernetes ``resource.Quantity`` string exactly.
 

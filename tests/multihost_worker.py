@@ -1,0 +1,73 @@
+"""Worker for the 2-process DCN test (launched by test_parallel.py).
+
+Each process joins a jax.distributed CPU runtime (localhost coordinator),
+runs the globally-partitioned scenario sweep with ``gather=True``, and
+asserts the stitched global result is bit-identical to the single-host
+exact sweep — the multi-process execution of
+``multihost.sweep_multihost``'s allgather path (SURVEY.md §5 "DCN").
+
+Usage: ``multihost_worker.py <coordinator_port> <process_id> <num_processes>``
+(env must set JAX_PLATFORMS=cpu and a per-process
+``xla_force_host_platform_device_count``).
+"""
+
+import sys
+
+import numpy as np
+
+from kubernetesclustercapacity_tpu.parallel import multihost
+
+
+def main() -> None:
+    port, pid, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n,
+        process_id=pid,
+    )
+    import jax
+
+    from kubernetesclustercapacity_tpu.ops.fit import (
+        snapshot_device_arrays,
+        sweep_grid,
+    )
+    from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
+    from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+    assert jax.process_count() == n, jax.process_count()
+
+    snap = synthetic_snapshot(97, seed=4)
+    # 23 scenarios over 2 processes: per-block 12, so process 1 takes the
+    # short 11-row tail — the padding/stitch path is exercised.
+    grid = random_scenario_grid(23, seed=5)
+    arrays = snapshot_device_arrays(snap)
+
+    totals, sched = multihost.sweep_multihost(
+        arrays,
+        grid.cpu_request_milli,
+        grid.mem_request_bytes,
+        grid.replicas,
+        gather=True,
+    )
+    exp_t, exp_s = sweep_grid(
+        *arrays, grid.cpu_request_milli, grid.mem_request_bytes, grid.replicas
+    )
+    assert np.array_equal(totals, np.asarray(exp_t)), (totals, exp_t)
+    assert np.array_equal(sched, np.asarray(exp_s))
+
+    # gather=False: each process returns exactly its own block.
+    bt, bs = multihost.sweep_multihost(
+        arrays,
+        grid.cpu_request_milli,
+        grid.mem_request_bytes,
+        grid.replicas,
+        gather=False,
+    )
+    b0, b1 = multihost.scenario_block(grid.size, pid, n)
+    assert np.array_equal(bt, np.asarray(exp_t)[b0:b1])
+    assert np.array_equal(bs, np.asarray(exp_s)[b0:b1])
+    print(f"OK {pid}")
+
+
+if __name__ == "__main__":
+    main()
