@@ -109,14 +109,18 @@ def main(argv: list[str] | None = None) -> int:
             replicas=args.replicas,
         )
     except ScenarioError as e:
-        # The reference prints an ERROR line and exits 1 (:68-83).
-        print(f"ERROR : {e} ...exiting")
+        # The reference prints an ERROR line and exits 1 (:68-83) —
+        # reproduced byte-for-byte when the error maps to one of its
+        # fatal flag paths.
+        print(e.reference_line or f"ERROR : {e} ...exiting")
         return 1
 
     if args.grid <= 0:
         try:
             scenario.validate()
         except ScenarioError as e:
+            # No reference line exists here: the reference would NOT exit —
+            # it would panic later at the division (Q8 divergence).
             print(f"ERROR : {e} ...exiting")
             return 1
 

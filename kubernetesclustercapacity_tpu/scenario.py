@@ -17,6 +17,7 @@ from kubernetesclustercapacity_tpu.utils.quantity import (
     QuantityParseError,
     cpu_to_milli_reference,
     go_atoi,
+    go_atoi_error,
     to_bytes_reference,
 )
 
@@ -37,7 +38,16 @@ DEFAULT_REPLICAS = "1"
 
 
 class ScenarioError(ValueError):
-    """Invalid scenario flags — the analog of the reference's ``os.Exit(1)``."""
+    """Invalid scenario flags — the analog of the reference's ``os.Exit(1)``.
+
+    ``reference_line``, when set, is the BYTE-EXACT fatal line the reference
+    would have printed before exiting (``ClusterCapacity.go:69,75,81``); the
+    CLI prints it verbatim for error-path transcript parity.
+    """
+
+    def __init__(self, msg: str, *, reference_line: str | None = None):
+        super().__init__(msg)
+        self.reference_line = reference_line
 
 
 @dataclass(frozen=True)
@@ -95,17 +105,32 @@ def scenario_from_flags(
     """
     cpu_req = cpu_to_milli_reference(cpuRequests)
     cpu_lim = cpu_to_milli_reference(cpuLimits)
+    # Fatal-flag errors carry the reference's exact Println output: the
+    # zeroed value ToBytes/Atoi returned alongside its error, space-joined
+    # (ClusterCapacity.go:69,75,81).
     try:
         mem_req = to_bytes_reference(memRequests)
     except QuantityParseError as e:
-        raise ScenarioError(f"Invalid input memRequests: {e}") from e
+        raise ScenarioError(
+            f"Invalid input memRequests: {e}",
+            reference_line=f"ERROR : Invalid input memRequests = 0 {e} ...exiting",
+        ) from e
     try:
         mem_lim = to_bytes_reference(memLimits)
     except QuantityParseError as e:
-        raise ScenarioError(f"Invalid input memLimits: {e}") from e
+        raise ScenarioError(
+            f"Invalid input memLimits: {e}",
+            reference_line=f"ERROR : Invalid input memLimits = 0 {e} ...exiting",
+        ) from e
     n_replicas = go_atoi(replicas)  # Go strconv.Atoi acceptance rules (:79)
     if n_replicas is None:
-        raise ScenarioError(f"Invalid input replicas: {replicas!r}")
+        raise ScenarioError(
+            f"Invalid input replicas: {replicas!r}",
+            reference_line=(
+                f"ERROR : Invalid input replicas = 0 "
+                f"{go_atoi_error(replicas)} ...exiting"
+            ),
+        )
     return Scenario(
         cpu_request_milli=cpu_req,
         mem_request_bytes=mem_req,

@@ -95,6 +95,21 @@ def go_atoi(s: str) -> int | None:
     return value
 
 
+def go_atoi_error(s: str) -> str:
+    """The ``strconv.Atoi`` error text Go prints for a failed parse.
+
+    Byte-parity helper for the reference's fatal replicas line
+    (``ClusterCapacity.go:81``): syntactically-valid digits that overflow
+    int64 are a range error, anything else is a syntax error.  (Go quotes
+    the input with ``%q``; plain double quotes here — control characters in
+    flag values are out of scope.)
+    """
+    body = s[1:] if s[:1] in "+-" else s
+    if body and body.isascii() and body.isdigit():
+        return f'strconv.Atoi: parsing "{s}": value out of range'
+    return f'strconv.Atoi: parsing "{s}": invalid syntax'
+
+
 @functools.lru_cache(maxsize=_PARSE_CACHE_SIZE)
 def cpu_to_milli_reference(cpu: str) -> int:
     """CPU quantity string → millicores, reference semantics.

@@ -132,14 +132,48 @@ class TestCli:
         assert rc == 1
         assert "packed with" in capsys.readouterr().out
 
+    # The reference's bytefmt error text, verbatim (bytes.go:23).
+    _BYTEFMT_ERR = (
+        "byte quantity must be a positive integer with a unit of "
+        "measurement like M, MB, MiB, G, GiB, or GB"
+    )
+
     def test_bad_mem_flag_exits_1(self, capsys):
+        """Byte parity with the reference's fatal memRequests line
+        (ClusterCapacity.go:69): Println of the zeroed value + error."""
         rc = main(["-snapshot", KIND, "-memRequests=garbage"])
         assert rc == 1
-        assert "ERROR :" in capsys.readouterr().out
+        assert capsys.readouterr().out == (
+            f"ERROR : Invalid input memRequests = 0 {self._BYTEFMT_ERR} "
+            "...exiting\n"
+        )
+
+    def test_bad_mem_limits_line_parity(self, capsys):
+        rc = main(["-snapshot", KIND, "-memLimits=12"])  # no unit -> error
+        assert rc == 1
+        assert capsys.readouterr().out == (
+            f"ERROR : Invalid input memLimits = 0 {self._BYTEFMT_ERR} "
+            "...exiting\n"
+        )
 
     def test_bad_replicas_exits_1(self, capsys):
+        """Byte parity with the fatal replicas line (ClusterCapacity.go:81),
+        including Go's strconv.Atoi error rendering."""
         rc = main(["-snapshot", KIND, "-replicas=ten"])
         assert rc == 1
+        assert capsys.readouterr().out == (
+            'ERROR : Invalid input replicas = 0 strconv.Atoi: '
+            'parsing "ten": invalid syntax ...exiting\n'
+        )
+
+    def test_replicas_range_error_line_parity(self, capsys):
+        huge = "99999999999999999999"  # valid digits, overflows int64
+        rc = main(["-snapshot", KIND, f"-replicas={huge}"])
+        assert rc == 1
+        assert capsys.readouterr().out == (
+            f'ERROR : Invalid input replicas = 0 strconv.Atoi: '
+            f'parsing "{huge}": value out of range ...exiting\n'
+        )
 
     def test_zero_cpu_request_validated(self, capsys):
         rc = main(["-snapshot", KIND, "-cpuRequests=half"])
