@@ -35,8 +35,9 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
-import threading
 import time
 import traceback
 
@@ -51,11 +52,15 @@ REPS = 9  # timed repetitions per scan length (same staged batch; jit does
 
 _METRIC = "sweep_10k_nodes_x_1k_scenarios_p50"
 
-# Backend acquisition bounds.  The TPU here sits behind a tunnel that can
-# be transiently UNAVAILABLE (that exact failure cost round 1 its number),
-# so init gets a bounded retry loop; a *hung* init (C++ blocking inside
-# jax.devices()) gets a watchdog timeout instead — it holds the backend
-# lock, so further in-process retries would deadlock.
+# Backend acquisition: PROCESS-ISOLATED.  The TPU here sits behind a
+# tunnel that can be transiently UNAVAILABLE (cost round 1 its number) or
+# hang outright inside PJRT init (cost round 2 its number: a stuck
+# ``jax.devices()`` thread holds jax's in-process backend lock forever, so
+# no in-process retry is possible).  The fix is structural: the default
+# invocation is a thin PARENT that never imports jax; each attempt spawns
+# the measurement as a fresh CHILD process in its own process group.  A
+# child that hangs — during init (no ready-marker in time) or mid-measure
+# (tunnel death) — is killed wholesale and re-dialed from a clean slate.
 def _env_num(name: str, default: float, cast) -> float:
     """Env override that can never break the one-JSON-line contract."""
     try:
@@ -65,7 +70,12 @@ def _env_num(name: str, default: float, cast) -> float:
 
 
 _INIT_ATTEMPTS = max(1, _env_num("KCC_BENCH_INIT_ATTEMPTS", 5, int))
-_INIT_TIMEOUT_S = max(1.0, _env_num("KCC_BENCH_INIT_TIMEOUT_S", 300, float))
+_INIT_TIMEOUT_S = max(1.0, _env_num("KCC_BENCH_INIT_TIMEOUT_S", 150, float))
+_MEASURE_TIMEOUT_S = max(
+    10.0, _env_num("KCC_BENCH_MEASURE_TIMEOUT_S", 2400, float)
+)
+_CHILD_ENV = "KCC_BENCH_CHILD"
+_READY_MARK = "@@KCC_BENCH_BACKEND_READY@@"
 
 
 def _emit(payload: dict) -> None:
@@ -87,51 +97,170 @@ def _fail(error: str, **aux) -> None:
     )
 
 
-def _acquire_backend():
-    """jax.devices() with bounded retry/backoff and a hang watchdog.
+def _kill_group(proc: subprocess.Popen) -> None:
+    """SIGKILL the child's whole process group (PJRT spawns threads that
+    ignore SIGTERM while blocked in C++)."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+    try:
+        proc.wait(timeout=10)
+    except Exception:  # noqa: BLE001 - best effort reap
+        pass
 
-    Returns ``(devices, None)`` on success or ``(None, error_str)`` after
-    exhausting attempts.  Each attempt runs in a daemon thread so a hung
-    PJRT init cannot wedge the bench past the watchdog; on timeout no
-    retry is made (the stuck thread still holds jax's backend lock).
+
+def _run_child_attempt() -> tuple[dict | None, str, bool]:
+    """One measurement attempt in a fresh subprocess.
+
+    Returns ``(payload, phase, ready)``: the child's JSON line (or ``None``
+    on a hang/crash), which phase the attempt reached (``"init"`` /
+    ``"measure"`` / ``"done"``), and whether backend init succeeded (the
+    ready-marker was seen) — the parent only re-dials failures that
+    happened *before* ready; post-init failures are deterministic and are
+    not worth re-running the whole measurement for.  The child prints the
+    ready-marker line the moment ``jax.devices()`` returns, then its one
+    JSON line; stderr passes straight through for interactive diagnosis.
     """
-    import jax
+    env = dict(os.environ, **{_CHILD_ENV: "1"})
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE,
+        text=True,
+        start_new_session=True,  # own process group → killable wholesale
+        env=env,
+        cwd=_REPO_ROOT,
+    )
 
-    last_err = "unknown"
+    import queue
+    import threading
+
+    lines: queue.Queue = queue.Queue()
+
+    def pump() -> None:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.put(line)
+        lines.put(None)  # EOF sentinel
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    phase = "init"
+    ready = False
+    deadline = time.monotonic() + _INIT_TIMEOUT_S
+    payload = None
+
+    def handle(raw: str) -> None:
+        nonlocal phase, ready, deadline, payload
+        raw = raw.strip()
+        if not raw:
+            return
+        if raw.startswith(_READY_MARK):
+            phase, ready = "measure", True
+            deadline = time.monotonic() + _MEASURE_TIMEOUT_S
+            return
+        try:
+            candidate = json.loads(raw)
+        except ValueError:
+            return  # stray child chatter; never relay non-JSON
+        if isinstance(candidate, dict) and candidate.get("metric") == _METRIC:
+            payload = candidate
+            phase = "done"
+            # Result in hand: give teardown a short grace, not the full
+            # measure budget — a wedged PJRT exit must not void a capture.
+            deadline = time.monotonic() + 15.0
+
+    eof = False
+    while not eof:
+        # Deadline is checked unconditionally: a hung child that still
+        # chatters on stdout must not dodge the watchdog via queue traffic.
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        try:
+            line = lines.get(timeout=min(remaining, 1.0))
+        except queue.Empty:
+            continue
+        if line is None:
+            eof = True
+        else:
+            handle(line)
+    # Final non-blocking drain: a JSON line enqueued just before the
+    # deadline (or before EOF) must not be thrown away as a "hang".
+    while True:
+        try:
+            line = lines.get_nowait()
+        except queue.Empty:
+            break
+        if line is not None:
+            handle(line)
+    if eof and payload is None:
+        # Crash before any JSON — label it as such, not as a hang.  The
+        # wait is bounded: stdout EOF with a wedged process exit must not
+        # stall the parent past the watchdog.
+        try:
+            rc: object = proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            rc = "wedged"
+        phase = f"{phase} (child exited rc={rc} without JSON)"
+    _kill_group(proc)
+    return payload, phase, ready
+
+
+def _parent_main() -> None:
+    """Orchestrate child attempts; relay the first successful JSON line.
+
+    Never imports jax: a hung PJRT init can only be recovered by killing
+    the process that attempted it, so the process that owns the output
+    contract must stay clean.
+    """
+    failures: list[str] = []
+    last_payload = None
     for attempt in range(_INIT_ATTEMPTS):
-        box: dict = {}
-
-        def probe() -> None:
-            try:
-                box["devices"] = jax.devices()
-            except Exception as e:  # noqa: BLE001 - reported, retried
-                box["error"] = f"{type(e).__name__}: {e}"
-
-        t = threading.Thread(target=probe, daemon=True)
-        t.start()
-        t.join(_INIT_TIMEOUT_S)
-        if t.is_alive():
-            return None, (
-                f"backend init hung > {_INIT_TIMEOUT_S:.0f}s "
-                f"(attempt {attempt + 1}/{_INIT_ATTEMPTS})"
+        payload, phase, ready = _run_child_attempt()
+        if payload is not None and payload.get("value") is not None:
+            if attempt or failures:
+                payload.setdefault("init_retries", attempt)
+                payload.setdefault("init_failures", failures[-3:])
+            _emit(payload)
+            return
+        if payload is not None:  # structured in-child failure
+            last_payload = payload
+            failures.append(str(payload.get("error", "unknown")))
+            if ready:
+                # Post-init failure (correctness gate, kernel bug, ...) is
+                # deterministic: re-running the whole measurement would
+                # just replay it N times.  Emit once, now.
+                break
+        elif "exited" in phase:  # crash before any JSON — not a hang
+            failures.append(f"child {phase}")
+        else:
+            timeout_s = (
+                _INIT_TIMEOUT_S if phase == "init" else _MEASURE_TIMEOUT_S
             )
-        if "devices" in box:
-            return box["devices"], None
-        last_err = box.get("error", "unknown")
+            failures.append(
+                f"child hung in {phase} > {timeout_s:.0f}s (killed)"
+            )
         if attempt + 1 < _INIT_ATTEMPTS:
-            # Reset jax's cached backend failure so the next attempt
-            # actually re-dials the plugin instead of replaying the error.
-            try:
-                import jax._src.xla_bridge as xb
-
-                xb._clear_backends()
-            except Exception:  # noqa: BLE001 - best effort
-                pass
             time.sleep(min(2.0 ** attempt, 30.0))
-    return None, f"{last_err} (after {_INIT_ATTEMPTS} attempts)"
+    # Exhausted: relay the most informative failure we have.
+    if last_payload is not None:
+        last_payload["init_attempts"] = _INIT_ATTEMPTS
+        last_payload["init_failures"] = failures[-3:]
+        _emit(last_payload)
+    else:
+        _fail(
+            f"all {_INIT_ATTEMPTS} subprocess attempts failed",
+            init_attempts=_INIT_ATTEMPTS,
+            init_timeout_s=_INIT_TIMEOUT_S,
+            init_failures=failures[-3:],
+        )
 
 
 def main() -> None:
+    if os.environ.get(_CHILD_ENV) != "1":
+        _parent_main()
+        return
     try:
         _run()
     except Exception as e:  # noqa: BLE001 - bench must emit JSON, not die
@@ -162,14 +291,15 @@ def _run() -> None:
         except RuntimeError:
             pass
 
-    devices, init_err = _acquire_backend()
-    if init_err is not None:
-        _fail(
-            f"backend init failed: {init_err}",
-            init_attempts=_INIT_ATTEMPTS,
-            init_timeout_s=_INIT_TIMEOUT_S,
-        )
+    # Child-side init is a plain blocking call: the parent's watchdog owns
+    # hang handling (kills this whole process group), and an error here is
+    # reported as structured JSON for the parent to relay/retry fresh.
+    try:
+        devices = jax.devices()
+    except Exception as e:  # noqa: BLE001 - structured, parent re-dials
+        _fail(f"backend init failed: {type(e).__name__}: {e}")
         return
+    print(f"{_READY_MARK} {devices[0]}", flush=True)
 
     import kubernetesclustercapacity_tpu as kcc
     from kubernetesclustercapacity_tpu.fixtures import load_fixture
