@@ -690,6 +690,38 @@ def _run() -> None:
             else:
                 ladder["native_cpu_10k_mismatch"] = True
 
+        # --- placement (the round-1 scalability gap: R replicas = R
+        # dependent scan steps): closed-form bulk engine vs the lax.scan
+        # scheduler, 1k replicas on the 10k-node snapshot, counts
+        # cross-checked so a wrong engine's time is never reported.
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_replicas,
+            place_replicas_bulk,
+        )
+
+        place_args = (
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, snap.healthy, 500, 512 << 20,
+        )
+        place_kw = dict(n_replicas=1_000, policy="best-fit")
+        counts_scan = np.asarray(
+            place_replicas(*place_args, **place_kw)[1]
+        )  # warms the compile too
+        ts_scan, ts_bulk = [], []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = np.asarray(place_replicas(*place_args, **place_kw)[1])
+            ts_scan.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            counts_bulk, _ = place_replicas_bulk(*place_args, **place_kw)
+            ts_bulk.append((time.perf_counter() - t0) * 1e3)
+        if np.array_equal(counts_bulk, counts_scan):
+            ladder["placement_scan_1k_ms"] = min(ts_scan)
+            ladder["placement_bulk_ms"] = min(ts_bulk)
+        else:
+            ladder["placement_engine_mismatch"] = True
+
         # --- ingestion (SURVEY §7 "snapshot ingestion at 10k nodes"): the
         # fixture-object walk is the production path (a live 2-List +
         # convert yields the same fixture schema); pack is timed per

@@ -164,28 +164,31 @@ def place_replicas_bulk(
     * ``first-fit`` fills nodes to capacity in index order (placing on a
       node never makes it preferable to skip);
     * ``best-fit`` picks the feasible node with minimum after-placement
-      headroom; placing there only shrinks its headroom further, so the
-      node stays the minimum until exhausted → fill-to-capacity in
-      ascending initial-score order (ties: lowest index, like the scan's
-      ``argmin``);
+      headroom; placing there only LOWERS its score, so the filling
+      node's trajectory stays strictly below every other node's untouched
+      initial score and can never cross one — it stays the argmin until
+      exhausted → fill-to-capacity in ascending initial-score order
+      (ties: lowest index, like the scan's ``argmin``).  This holds in
+      f64 too: each score is ``fl(fl(a) + fl(b))`` of monotone terms, and
+      ``fl`` is monotone, so rounding can flatten a step into a plateau
+      but never invert the order; a plateau tied with an equal-initial-
+      score node still resolves to the lowest index on both sides.
+      Counts therefore match the scan in ALL cases;
     * ``spread`` picks the maximum; placing there lowers the node's score,
-      so the greedy walk is a k-way head merge of per-node strictly
-      decreasing score sequences — i.e. the global top-R elements of the
-      multiset ``{score_i(j) : j < cap_i}`` (water-filling).  The R-th
-      value is found by bisection on the float64 bit lattice with exact
-      score evaluation (bit-identical to the scan's per-step scores), and
-      boundary ties are broken by node index exactly as ``argmin`` does —
-      intermediate head ties never change counts (both elements are in
-      the top-R either way), so spread counts match the scan in ALL
-      cases.
+      so the greedy walk is a k-way head merge of per-node monotone
+      non-increasing score sequences — i.e. the global top-R elements of
+      the multiset ``{score_i(j) : j < cap_i}`` (water-filling).  The
+      R-th value is found by bisection on the float64 bit lattice with
+      EXACT per-node binary-search counting (the same f64 scores the scan
+      compares — see ``count_ge``), and boundary ties at the waterline
+      are distributed in the scan's order (lowest index first, each
+      node's plateau exhausted before the next), so spread counts match
+      the scan in ALL cases.
 
-    Best-fit exactness caveat: if a node's MID-sequence score lands with
-    exact f64 equality on a lower-indexed node's initial score (requires
-    the integer headroom gaps of both resources to align simultaneously),
-    the scan briefly switches nodes there; counts then differ from the
-    sorted fill only when R runs out inside that tied window.  Real
-    snapshots don't produce such double coincidences; the parity tests
-    pin representative grids.
+    Exactness is pinned by ``tests/test_placement.py::TestBulkParity`` —
+    randomized snapshots plus adversarial tie grids (equal allocatables
+    and aligned integer headrooms force exact f64 score collisions), all
+    policies, R swept through every boundary.
 
     The per-replica assignment ORDER (which the scan also returns) is
     policy-defined given the counts: index order for first-fit, score
@@ -268,28 +271,28 @@ def place_replicas_bulk(
         return np.zeros_like(caps), 0
 
     def count_ge(theta: float) -> tuple[np.ndarray, int]:
-        """Per-node count of sequence elements with score >= theta.
+        """Per-node count of sequence elements with score >= theta — EXACT.
 
-        Scores are strictly decreasing in j on feasible nodes, so the
-        count is the first j with score < theta.  A float-algebra estimate
-        is corrected by exact evaluation over a +/-2 window — the counts
-        are decided by the same f64 values the scan compares.
+        Each node's score sequence is monotone non-increasing in ``j``
+        (exact-math strictly decreasing; f64 rounding can only flatten
+        steps into plateaus, never invert them, because ``fl`` and the
+        two-term sum are monotone), so the count is the first ``j`` with
+        ``score < theta``.  Found by a vectorized per-node binary search
+        that evaluates the SAME f64 scores the scan compares — no
+        float-algebra estimate, no correction window, no error bound to
+        argue about.  O(N log max_cap).
         """
-        s0 = score_after(0)
-        d = np.where(
-            feas,
-            np.where(ac > 0, c / ac.astype(np.float64), 0.0)
-            + np.where(am > 0, m / am.astype(np.float64), 0.0),
-            1.0,
-        )
-        est = np.floor((s0 - theta) / d).astype(np.int64) + 1
-        lo = np.clip(est - 2, 0, caps)
-        cnt = lo.copy()
-        for step in range(5):  # exact fixup around the estimate
-            j = np.clip(lo + step, 0, caps)
-            ok = (j < caps) & (score_after(j) >= theta) & (j == cnt)
-            cnt = np.where(ok, j + 1, cnt)
-        cnt = np.where(feas, np.clip(cnt, 0, caps), 0)
+        lo = np.zeros_like(caps)
+        hi = caps.copy()  # count lives in [0, caps]
+        while True:
+            active = lo < hi
+            if not active.any():
+                break
+            mid = (lo + hi) // 2
+            ge = score_after(mid) >= theta
+            lo = np.where(active & ge, mid + 1, lo)
+            hi = np.where(active & ~ge, mid, hi)
+        cnt = np.where(feas, lo, 0)
         return cnt, int(cnt.sum())
 
     # Bisect theta on the ordered-int64 view of f64 (monotone encoding):
@@ -316,16 +319,18 @@ def place_replicas_bulk(
     theta = i2f(lo_i)
     base, n_ge = count_ge(theta)
     strict, n_gt = count_ge(i2f(lo_i + 1))
-    # Elements strictly above theta all place; the r - n_gt remaining go
-    # to the nodes whose next element EQUALS theta, lowest index first —
-    # the scan's argmin tie rule.
-    counts = strict
-    remaining = r - n_gt
-    if remaining > 0:
-        at_theta = np.flatnonzero(base > strict)
-        counts = counts.copy()
-        counts[at_theta[:remaining]] += 1
-    return counts, r
+    # Elements strictly above theta all place.  The ``r - n_gt`` remaining
+    # go to elements EQUAL to theta in the scan's order: argmin breaks the
+    # cross-node tie by lowest index, and after a node takes one
+    # theta-element its next element is <= theta — if it EQUALS theta
+    # (an f64 plateau) argmin stays on that same lowest index.  So the
+    # scan exhausts each node's theta-plateau fully before moving to the
+    # next node, in index order — exactly a cumsum fill over the per-node
+    # plateau lengths ``base - strict``.
+    at = base - strict  # elements == theta per node (plateaus can be > 1)
+    before = np.concatenate(([0], np.cumsum(at)[:-1]))
+    take = np.clip(r - n_gt - before, 0, at)
+    return strict + take, r
 
 
 def place_replicas_python(

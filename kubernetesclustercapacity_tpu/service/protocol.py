@@ -19,8 +19,13 @@ sweep      ``cpu_request_milli``/``mem_request_bytes``/``replicas``
            ``kernel`` (``auto`` — Pallas fast path when provably
            bit-exact — | ``exact``); result carries the kernel used
 place      the fit flag/spec fields plus optional ``policy``
-           (``first-fit`` | ``best-fit`` | ``spread``) — placement
-           simulation; result maps each replica to a node
+           (``first-fit`` | ``best-fit`` | ``spread``) and optional
+           ``assignments`` (bool, default true) — placement
+           simulation.  Default: the scan, result maps each replica
+           to a node.  ``assignments: false`` opts into the
+           closed-form bulk engine (O(N) instead of R scan steps):
+           result ``assignments`` is null, ``by_node``/``placed``
+           identical to the scan's; result ``engine`` says which ran
 reload     ``path`` — swap the served snapshot (fixture .json or .npz);
            optional ``semantics``
 update     ``events`` — watch-style node/pod event list applied

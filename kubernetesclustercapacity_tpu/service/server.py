@@ -348,20 +348,39 @@ class CapacityServer:
 
         scenario = self._scenario_from_msg(msg)
         spec = self._spec_from_msg(msg, scenario)
+        # Wire flag ``assignments``: false = counts-only (bulk engine,
+        # O(N) instead of R scan steps).  Absent/true = the scan WITH the
+        # per-replica order — the wire default stays the scan at every R
+        # so pre-flag clients keep the response shape they were built
+        # against; only an explicit opt-out changes it.
+        want_order = msg.get("assignments", True)
+        if not isinstance(want_order, bool):
+            raise ValueError(
+                f"assignments must be a JSON bool, got {want_order!r}"
+            )
         try:
             model = CapacityModel(snap, mode=snap.semantics, fixture=fixture)
-            result = model.place(spec, policy=msg.get("policy", "first-fit"))
+            result = model.place(
+                spec,
+                policy=msg.get("policy", "first-fit"),
+                assignments=want_order,
+            )
         except (TypeError, ValueError) as e:
             raise ValueError(str(e)) from e
         return {
-            "assignments": [
-                snap.names[i] if i >= 0 else None
-                for i in result.assignments.tolist()
-            ],
+            "assignments": (
+                None
+                if result.assignments is None
+                else [
+                    snap.names[i] if i >= 0 else None
+                    for i in result.assignments.tolist()
+                ]
+            ),
             "by_node": result.by_node(),
             "placed": result.placed,
             "all_placed": result.all_placed,
             "policy": result.policy,
+            "engine": result.engine,
         }
 
     def _op_sweep(self, msg: dict, snap: ClusterSnapshot) -> dict:

@@ -11,6 +11,7 @@ from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
 from kubernetesclustercapacity_tpu.ops.placement import (
     POLICIES,
     place_replicas,
+    place_replicas_bulk,
     place_replicas_python,
 )
 from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
@@ -124,6 +125,122 @@ class TestPolicies:
             )
 
 
+def _random_cluster(trial: int):
+    """Random small cluster; even trials are TIE-PRONE (equal allocatables
+    + request-aligned headrooms force exact f64 score collisions — the
+    regime where a wrong tie rule in the closed form would show)."""
+    rng = np.random.default_rng(trial)
+    n = int(rng.integers(2, 12))
+    if trial % 2 == 0:
+        ac = np.full(n, int(rng.integers(2, 6)) * 1000, dtype=np.int64)
+        am = np.full(n, int(rng.integers(1, 4)) * 1024, dtype=np.int64)
+        uc = (rng.integers(0, 4, n) * 500).astype(np.int64)
+        um = (rng.integers(0, 4, n) * 256).astype(np.int64)
+        c, m = 500, 256
+    else:
+        ac = rng.integers(100, 8000, n).astype(np.int64)
+        am = rng.integers(100, 1 << 34, n).astype(np.int64)
+        uc = (ac * rng.random(n) * 0.9).astype(np.int64)
+        um = (am * rng.random(n) * 0.9).astype(np.int64)
+        c = int(rng.integers(1, 900))
+        m = int(rng.integers(1, 1 << 28))
+    ap = rng.integers(1, 8, n).astype(np.int64)
+    pc = rng.integers(0, 8, n).astype(np.int64)
+    healthy = rng.random(n) < 0.85
+    mask = rng.random(n) < 0.8 if trial % 3 == 0 else None
+    mpn = int(rng.integers(1, 4)) if trial % 5 == 0 else None
+    return (ac, am, ap, uc, um, pc, healthy, c, m), mask, mpn
+
+
+class TestBulkParity:
+    """The closed-form engine must produce the scan's counts in ALL cases
+    (the exactness claim of ``place_replicas_bulk``'s docstring)."""
+
+    @pytest.mark.parametrize("policy", POLICIES)
+    @pytest.mark.parametrize("trial", range(24))
+    def test_counts_match_oracle_through_every_boundary(self, policy, trial):
+        args, mask, mpn = _random_cluster(trial)
+        kw = dict(policy=policy, node_mask=mask, max_per_node=mpn)
+        _, c_full = place_replicas_python(*args, n_replicas=200, **kw)
+        total = sum(c_full)
+        # R swept through 0, 1, mid, the capacity boundary, and beyond.
+        for r in sorted({0, 1, total // 2, max(total - 1, 0), total,
+                         total + 3}):
+            _, c_py = place_replicas_python(*args, n_replicas=r, **kw)
+            c_bulk, placed = place_replicas_bulk(*args, n_replicas=r, **kw)
+            np.testing.assert_array_equal(
+                c_bulk, np.asarray(c_py),
+                err_msg=f"{policy} trial={trial} r={r}")
+            assert placed == min(r, total)
+
+    @pytest.mark.parametrize("policy", ("best-fit", "spread"))
+    def test_adversarial_exact_f64_ties(self, policy):
+        """Hand-built grid where every node shares the same score lattice:
+        identical allocatables, identical headrooms → every step of every
+        node's sequence collides exactly in f64.  Counts must still match
+        the scan's index-ordered tie walk for every R."""
+        n = 6
+        ac = np.full(n, 4000, dtype=np.int64)
+        am = np.full(n, 4096, dtype=np.int64)
+        uc = np.zeros(n, dtype=np.int64)
+        um = np.zeros(n, dtype=np.int64)
+        ap = np.full(n, 5, dtype=np.int64)  # slots bind at 5 < cpu fit 8
+        pc = np.zeros(n, dtype=np.int64)
+        healthy = np.ones(n, dtype=bool)
+        args = (ac, am, ap, uc, um, pc, healthy, 500, 512)
+        for r in range(0, n * 5 + 2):
+            _, c_py = place_replicas_python(*args, n_replicas=r,
+                                            policy=policy)
+            c_bulk, _ = place_replicas_bulk(*args, n_replicas=r,
+                                            policy=policy)
+            np.testing.assert_array_equal(
+                c_bulk, np.asarray(c_py), err_msg=f"r={r}")
+
+    def test_spread_waterline_plateau_partial_fill(self):
+        """Staggered used-resources: nodes hit the waterline mid-sequence
+        with multi-element plateaus; the cumsum tie fill must hand the
+        scan's lowest-index node its whole plateau before the next."""
+        n = 4
+        ac = np.full(n, 2000, dtype=np.int64)
+        am = np.full(n, 2048, dtype=np.int64)
+        uc = np.array([0, 500, 0, 500], dtype=np.int64)
+        um = np.array([0, 512, 0, 512], dtype=np.int64)
+        ap = np.full(n, 99, dtype=np.int64)
+        pc = np.zeros(n, dtype=np.int64)
+        args = (ac, am, ap, uc, um, pc, np.ones(n, bool), 500, 512)
+        for r in range(0, 14):
+            _, c_py = place_replicas_python(*args, n_replicas=r,
+                                            policy="spread")
+            c_bulk, _ = place_replicas_bulk(*args, n_replicas=r,
+                                            policy="spread")
+            np.testing.assert_array_equal(
+                c_bulk, np.asarray(c_py), err_msg=f"r={r}")
+
+    def test_bulk_matches_jax_scan_large_r(self, snap):
+        """Directly against the lax.scan kernel (not just the python
+        oracle) at an R big enough to cross many node boundaries."""
+        for policy in POLICIES:
+            _, c_scan = place_replicas(
+                *_snap_arrays(snap), 300, 256 << 20,
+                n_replicas=120, policy=policy,
+            )
+            c_bulk, _ = place_replicas_bulk(
+                *_snap_arrays(snap), 300, 256 << 20,
+                n_replicas=120, policy=policy,
+            )
+            np.testing.assert_array_equal(c_bulk, np.asarray(c_scan))
+
+    def test_bulk_validates_inputs(self, snap):
+        with pytest.raises(ValueError, match="unknown policy"):
+            place_replicas_bulk(
+                *_snap_arrays(snap), 100, 1, n_replicas=1, policy="magic"
+            )
+        with pytest.raises(ValueError, match="must be > 0"):
+            place_replicas_bulk(
+                *_snap_arrays(snap), 0, 1, n_replicas=1
+            )
+
+
 class TestModelAndService:
     def test_model_place(self, snap):
         model = CapacityModel(snap, mode="strict")
@@ -136,6 +253,30 @@ class TestModelAndService:
         assert max(res.per_node) <= 1  # spread=1 honored in simulation
         assert sum(res.by_node().values()) == res.placed
         assert res.policy == "spread"
+
+    def test_model_place_engine_routing(self, snap):
+        """auto = scan (with order) small R, bulk (counts-only) big R;
+        both engines agree on counts for the identical spec."""
+        model = CapacityModel(snap, mode="strict")
+        spec = PodSpec(cpu_request_milli=100, mem_request_bytes=64 << 20,
+                       replicas=20)
+        scan = model.place(spec, policy="best-fit", assignments=True)
+        assert scan.engine == "scan" and scan.assignments is not None
+        bulk = model.place(spec, policy="best-fit", assignments=False)
+        assert bulk.engine == "bulk" and bulk.assignments is None
+        np.testing.assert_array_equal(bulk.per_node, scan.per_node)
+        assert bulk.placed == scan.placed
+        assert bulk.all_placed == scan.all_placed
+        # auto: small R keeps the scan...
+        assert model.place(spec).engine == "scan"
+        # ...and R above the threshold switches to bulk.
+        model.PLACE_SCAN_MAX = 10
+        auto = model.place(spec, policy="spread")
+        assert auto.engine == "bulk"
+        np.testing.assert_array_equal(
+            auto.per_node,
+            model.place(spec, policy="spread", assignments=True).per_node,
+        )
 
     def test_model_place_rejects_extended(self, snap):
         model = CapacityModel(snap, mode="strict")
@@ -176,5 +317,14 @@ class TestModelAndService:
                 zone0 = {n["name"] for n in fx["nodes"]
                          if n["labels"].get("zone") == "zone-0"}
                 assert set(sel["by_node"]) <= zone0
+                # assignments:false routes the counts-only bulk engine;
+                # per-node counts must equal the scan's for the same spec.
+                b = c.place(cpuRequests="250m", memRequests="128mb",
+                            replicas="5", policy="spread",
+                            assignments=False)
+                assert b["engine"] == "bulk"
+                assert b["assignments"] is None
+                assert b["by_node"] == r["by_node"]
+                assert b["placed"] == 5 and b["all_placed"] is True
         finally:
             srv.shutdown()
