@@ -169,6 +169,7 @@ def _load_source(args):
 
 
 def _run_single(args, fixture, snapshot, scenario) -> int:
+    from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
     from kubernetesclustercapacity_tpu.oracle import (
         ReferencePanic,
         fit_arrays_python,
@@ -244,6 +245,14 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
             )
         )
 
+    # Strict semantics honors hard taints on every surface (service fit,
+    # service sweep, -grid, and this single-spec path) — same mask, same
+    # zeroing the fit kernel's node_mask performs, for all three backends.
+    # None (so a no-op, preserving byte parity) under reference semantics.
+    mask = implicit_taint_mask(snapshot)
+    if mask is not None:
+        fits = np.where(mask, fits, 0)
+
     if args.output == "json":
         print(json_report(snapshot, fits, scenario))
     elif args.output == "table":
@@ -254,12 +263,19 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
 
 
 def _run_grid(args, snapshot) -> int:
+    from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
     from kubernetesclustercapacity_tpu.ops.pallas_fit import sweep_snapshot_auto
     from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
 
     grid = random_scenario_grid(args.grid, seed=args.seed)
+    # Strict grids honor hard taints exactly like single-spec strict fits
+    # (and the service's fit/sweep ops) — one spec, one answer, any surface.
     totals, sched, kernel = sweep_snapshot_auto(
-        snapshot, grid, mode=args.semantics, kernel=args.kernel
+        snapshot,
+        grid,
+        mode=args.semantics,
+        kernel=args.kernel,
+        node_mask=implicit_taint_mask(snapshot),
     )
     summary = {
         "scenarios": args.grid,

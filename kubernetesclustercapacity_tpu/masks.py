@@ -32,6 +32,7 @@ __all__ = [
     "node_affinity_mask",
     "anti_affinity_existing_mask",
     "combine_masks",
+    "implicit_taint_mask",
 ]
 
 _HARD_EFFECTS = ("NoSchedule", "NoExecute")
@@ -168,3 +169,21 @@ def combine_masks(*masks: np.ndarray | None) -> np.ndarray | None:
             continue
         out = m.copy() if out is None else (out & m)
     return out
+
+
+def implicit_taint_mask(snap: ClusterSnapshot) -> np.ndarray | None:
+    """Strict semantics honors hard taints even on plain-flag queries (an
+    untolerating pod never lands on a NoSchedule node — the eligibility
+    role of the reference's health filter, ``ClusterCapacity.go:212-219``,
+    extended to taints).  ``None`` when nothing is tainted or semantics is
+    reference (the reference ignores taints entirely).
+
+    Every strict surface that evaluates a plain flag/grid spec — service
+    ``fit`` AND ``sweep``, the CLI ``-grid`` path — must apply this same
+    mask, or identical specs would report different totals depending on
+    which surface answered.  Depends only on the snapshot: compute once
+    per snapshot swap, not per request (the taint walk is O(N) Python).
+    """
+    if snap.semantics != "strict" or not any(snap.taints or []):
+        return None
+    return tolerations_mask(snap, [])

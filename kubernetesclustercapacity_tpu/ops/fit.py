@@ -353,11 +353,14 @@ def sweep_snapshot(
     *,
     mode: str = "reference",
     return_per_node: bool = False,
+    node_mask=None,
 ):
     """Convenience wrapper: ``ClusterSnapshot`` × ``ScenarioGrid`` → results.
 
     Validates the grid the way the reference's flag layer would (nonzero
-    requests), then dispatches the jitted sweep.  Returns numpy arrays.
+    requests), then dispatches the jitted sweep.  ``node_mask`` ([N] bool,
+    optional) zeroes constraint-infeasible nodes for every scenario.
+    Returns numpy arrays.
     """
     grid.validate()
     arrays = snapshot_device_arrays(snapshot)
@@ -368,5 +371,6 @@ def sweep_snapshot(
         grid.replicas,
         mode=mode,
         return_per_node=return_per_node,
+        node_mask=node_mask,
     )
     return tuple(np.asarray(o) for o in out)

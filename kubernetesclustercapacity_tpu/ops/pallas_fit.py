@@ -467,6 +467,7 @@ def sweep_snapshot_auto(
     mode: str = "reference",
     kernel: str = "auto",
     interpret: bool | None = None,
+    node_mask=None,
 ):
     """Production sweep entry: fastest kernel that is provably bit-exact.
 
@@ -478,6 +479,11 @@ def sweep_snapshot_auto(
     takes the exact int64 XLA kernel.  Strict mode always goes exact: its
     healthy/slot clamping lives only in the int64 kernel.
 
+    ``node_mask`` (``[N]`` bool, optional) zeroes constraint-infeasible
+    nodes — e.g. the implicit hard-taint mask every strict surface shares
+    (:func:`..masks.implicit_taint_mask`); masked sweeps always take the
+    exact kernel (the Pallas path has no mask input).
+
     ``kernel="exact"`` forces the int64 path (operator escape hatch);
     ``interpret=None`` auto-selects Pallas interpret mode off-TPU.
     Returns ``(totals[S], schedulable[S], kernel_name)`` with numpy arrays
@@ -487,8 +493,10 @@ def sweep_snapshot_auto(
 
     if kernel not in ("auto", "exact"):
         raise ValueError(f"unknown kernel {kernel!r}")
-    if mode != "reference":
-        totals, sched = sweep_snapshot(snapshot, grid, mode=mode)
+    if mode != "reference" or node_mask is not None:
+        totals, sched = sweep_snapshot(
+            snapshot, grid, mode=mode, node_mask=node_mask
+        )
         return totals, sched, "xla_int64"
     grid.validate()
     if interpret is None:

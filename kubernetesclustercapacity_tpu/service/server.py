@@ -34,16 +34,9 @@ from kubernetesclustercapacity_tpu.sources import resolve_source
 __all__ = ["CapacityServer"]
 
 
-def _implicit_taint_mask(snap: ClusterSnapshot):
-    """Strict semantics honors hard taints even on plain-flag fits (an
-    untolerating pod never lands on a NoSchedule node).  Depends only on
-    the snapshot, so it is computed once per snapshot swap — not per
-    request (the pure-Python taint walk is O(N))."""
-    if snap.semantics != "strict" or not any(snap.taints or []):
-        return None
-    from kubernetesclustercapacity_tpu.masks import tolerations_mask
-
-    return tolerations_mask(snap, [])
+from kubernetesclustercapacity_tpu.masks import (
+    implicit_taint_mask as _implicit_taint_mask,
+)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -145,7 +138,7 @@ class CapacityServer:
         if op == "fit":
             return self._op_fit(msg, snap, fixture, implicit_mask)
         if op == "sweep":
-            return self._op_sweep(msg, snap)
+            return self._op_sweep(msg, snap, implicit_mask)
         if op == "place":
             return self._op_place(msg, snap, fixture)
         if op == "reload":
@@ -383,7 +376,9 @@ class CapacityServer:
             "engine": result.engine,
         }
 
-    def _op_sweep(self, msg: dict, snap: ClusterSnapshot) -> dict:
+    def _op_sweep(
+        self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
+    ) -> dict:
         from kubernetesclustercapacity_tpu.ops.pallas_fit import (
             sweep_snapshot_auto,
         )
@@ -398,8 +393,15 @@ class CapacityServer:
                 mem_request_bytes=np.asarray(msg["mem_request_bytes"]),
                 replicas=np.asarray(msg.get("replicas", [1])),
             )
+        # The same implicit taint mask the fit op applies: a strict sweep
+        # over a tainted snapshot must not report higher totals than fit
+        # does for the identical spec.
         totals, sched, kernel = sweep_snapshot_auto(
-            snap, grid, mode=snap.semantics, kernel=msg.get("kernel", "auto")
+            snap,
+            grid,
+            mode=snap.semantics,
+            kernel=msg.get("kernel", "auto"),
+            node_mask=implicit_mask,
         )
         return {
             "totals": totals.tolist(),

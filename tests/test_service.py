@@ -1,5 +1,6 @@
 """Service boundary tests: protocol, server ops, Python client, C++ client."""
 
+import json
 import os
 import subprocess
 
@@ -315,6 +316,86 @@ class TestSpecFit:
                 assert u == 0 and t >= 0
             else:
                 assert u == t
+
+    def test_strict_fit_and_sweep_agree_on_tainted_cluster(self, sclient):
+        """The service's two query surfaces must not contradict each other:
+        a strict sweep applies the same implicit hard-taint mask as fit,
+        so the identical spec yields the identical total either way."""
+        fit = sclient.fit(cpuRequests="100m", memRequests="64mb")
+        sweep = sclient.sweep(
+            cpu_request_milli=[100],
+            mem_request_bytes=[64 * 1024 * 1024],
+            replicas=[1],
+        )
+        assert sweep["totals"][0] == fit["total"]
+        assert sweep["kernel"] == "xla_int64"  # masked → exact path
+
+    def test_strict_sweep_masks_only_tainted_capacity(self):
+        """Non-degenerate agreement: clean nodes keep real capacity, so
+        the shared mask must show up as 0 < masked == fit < unmasked."""
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+
+        fx = synthetic_fixture(8, seed=7, taint_frac=0.0,
+                               unhealthy_frac=0.0)
+        for n in fx["nodes"][:4]:  # taint exactly half the cluster
+            n["taints"] = [{"key": "dedicated", "value": "x",
+                            "effect": "NoSchedule"}]
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        srv = CapacityServer(snap, port=0, fixture=fx)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                fit = c.fit(cpuRequests="100m", memRequests="64mb")
+                sweep = c.sweep(cpu_request_milli=[100],
+                                mem_request_bytes=[64 << 20],
+                                replicas=[1])
+                tol = c.fit(cpuRequests="100m", memRequests="64mb",
+                            tolerations=[{"operator": "Exists"}])
+                assert 0 < sweep["totals"][0] == fit["total"] < tol["total"]
+        finally:
+            srv.shutdown()
+
+    def test_cli_strict_surfaces_match_service_on_tainted_cluster(
+        self, tmp_path, sclient, strict_server
+    ):
+        """Same invariant across process surfaces: the CLI -grid AND the
+        CLI single-spec strict paths mask hard taints exactly like the
+        service's sweep and fit ops — one spec, one answer, any surface."""
+        import subprocess
+        import sys
+
+        fixture, _ = strict_server
+        path = tmp_path / "tainted.json"
+        path.write_text(json.dumps(fixture))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        base = [sys.executable, "-m", "kubernetesclustercapacity_tpu.cli",
+                "-snapshot", str(path), "-semantics", "strict"]
+        out = subprocess.run(
+            base + ["-grid", "4", "-seed", "5"],
+            capture_output=True, text=True, check=True, env=env,
+        )
+        summary = json.loads(out.stdout)
+        from kubernetesclustercapacity_tpu.scenario import (
+            random_scenario_grid,
+        )
+
+        grid = random_scenario_grid(4, seed=5)
+        wire = sclient.sweep(
+            cpu_request_milli=grid.cpu_request_milli.tolist(),
+            mem_request_bytes=grid.mem_request_bytes.tolist(),
+            replicas=grid.replicas.tolist(),
+        )
+        assert summary["totals"] == wire["totals"]
+        # Single-spec, all three CLI backends vs the service fit op.
+        fit = sclient.fit(cpuRequests="100m", memRequests="64mb")
+        for backend in ("tpu", "cpu", "native"):
+            single = subprocess.run(
+                base + ["-cpuRequests", "100m", "-memRequests", "64mb",
+                        "-output", "json", "-backend", backend],
+                capture_output=True, text=True, check=True, env=env,
+            )
+            doc = json.loads(single.stdout)
+            assert doc["total_possible_replicas"] == fit["total"], backend
 
     def test_extended_resources_gate_fit(self, sclient, strict_server):
         fixture, _ = strict_server
