@@ -16,4 +16,5 @@ length-prefixed-JSON protocol over TCP:
 """
 
 from kubernetesclustercapacity_tpu.service.client import CapacityClient  # noqa: F401
+from kubernetesclustercapacity_tpu.service.coalesce import SnapshotCoalescer  # noqa: F401
 from kubernetesclustercapacity_tpu.service.server import CapacityServer  # noqa: F401

@@ -485,6 +485,9 @@ def main(argv=None) -> int:
     p.add_argument("-host", default="127.0.0.1")
     p.add_argument("-semantics", choices=("reference", "strict"),
                    default=None)
+    p.add_argument("-coalesce-ms", type=int, default=100, dest="coalesce_ms",
+                   help="min interval between snapshot repacks under "
+                        "-follow churn (0 = repack on every event)")
     args = p.parse_args(argv)
 
     follower = None
@@ -506,14 +509,34 @@ def main(argv=None) -> int:
     server = CapacityServer(
         snap, host=args.host, port=args.port, fixture=fixture
     )
+    coalescer = None
     if follower is not None:
-        # Every applied watch event pushes a fresh snapshot (O(N) array
-        # copies, no raw-object deepcopy) into the server; queries between
-        # events serve the last consistent state.  The raw fixture is left
+        # Watch events are applied to the store per-row (O(1)); snapshot
+        # PUBLICATION (an O(N) repack+swap into the server) is coalesced:
+        # first event flushes at once, bursts collapse to one trailing
+        # repack per -coalesce-ms window.  Queries between pushes serve
+        # the last published consistent state.  The raw fixture is left
         # unset — the cpu cross-check backend walks the packed arrays.
-        follower.on_event = lambda kind, etype, obj: server.replace_snapshot(
-            follower.snapshot()
+        from kubernetesclustercapacity_tpu.service.coalesce import (
+            SnapshotCoalescer,
         )
+
+        # A failing publish is fatal to the supervised serve — identical
+        # policy to the pre-coalescing wiring, where the exception killed
+        # the watch thread: answering queries from a silently frozen
+        # snapshot is the one unacceptable outcome.
+        publish_fatal: list[str] = []
+
+        def _publish_failed(err: str) -> None:
+            publish_fatal.append(err)
+            follower.stop()
+
+        coalescer = SnapshotCoalescer(
+            lambda: server.replace_snapshot(follower.snapshot()),
+            min_interval_s=max(args.coalesce_ms, 0) / 1e3,
+            on_error=_publish_failed,
+        )
+        follower.on_event = coalescer.notify
         follower.start_watches()  # after wiring: no event can be missed
     print(
         f"serving {snap.n_nodes} nodes ({snap.semantics}) on "
@@ -537,11 +560,19 @@ def main(argv=None) -> int:
                     file=sys.stderr,
                 )
                 return 2
+            if publish_fatal:
+                print(
+                    f"ERROR : snapshot publish failed: {publish_fatal[0]}",
+                    file=sys.stderr,
+                )
+                return 2
     except KeyboardInterrupt:
         pass
     finally:
         if follower is not None:
             follower.stop()
+        if coalescer is not None:
+            coalescer.stop()
         server.shutdown()
     return 0
 
