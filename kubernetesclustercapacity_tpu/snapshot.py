@@ -278,38 +278,111 @@ def _pack_strict(
         for r in extended_resources:
             ext[r][0][i] = _strict_parse(allocatable.get(r))
 
-    # Per-pod effective resources are gathered into flat lists, then
-    # scatter-added once per column (np.add.at): per-element numpy ``+=``
-    # costs ~1µs each and dominates 100k-pod ingestion otherwise.
-    rows: list[tuple] = []
+    # Columnar pod ingestion — the 100k-pod hot path.  One Python walk
+    # collects quantity-string INTERN CODES into flat per-container
+    # columns; each distinct string is parsed exactly once into a lookup
+    # table; every piece of arithmetic after that (per-pod container
+    # sums, init-container peaks, the scheduler's ``max(sum, init_peak)``
+    # rule, per-node totals) is a numpy gather/scatter.  Replaces a
+    # per-pod ``_effective_pod_resources`` walk (which remains the
+    # single-pod path for watch-event updates, ``store.py``) that spent
+    # ~5µs/pod on dict building and memoized-parse call overhead;
+    # semantics are pinned equal by
+    # ``tests/test_snapshot.py::TestStrictColumnarParity``.
+    intern: dict = {None: 0}
+    strings: list = [None]
+
+    def code(s) -> int:
+        try:
+            return intern[s]
+        except KeyError:
+            intern[s] = c = len(strings)
+            strings.append(s)
+            return c
+
+    pod_nodes: list[int] = []
+    c_pod: list[int] = []  # container -> pod ordinal
+    c_cols: tuple[list[int], ...] = ([], [], [], [])  # cr, cl, mr, ml codes
+    i_pod: list[int] = []
+    i_cols: tuple[list[int], ...] = ([], [], [], [])
+    c_ext = {r: [] for r in extended_resources}
+    i_ext = {r: [] for r in extended_resources}
     for pod in fixture.get("pods", []):
         node_name = pod.get("nodeName", "")
         if not node_name or node_name not in index:
             continue
         if pod.get("phase") in _STRICT_TERMINATED:
             continue
-        rows.append(
-            (index[node_name], _effective_pod_resources(pod, extended_resources))
-        )
-    if rows:
-        p = len(rows)
-        idx = np.fromiter((r[0] for r in rows), dtype=np.int64, count=p)
-        np.add.at(snap["pods_count"], idx, 1)
-        for col, key in (
-            ("used_cpu_req_milli", "cpu_req"),
-            ("used_cpu_lim_milli", "cpu_lim"),
-            ("used_mem_req_bytes", "mem_req"),
-            ("used_mem_lim_bytes", "mem_lim"),
+        pid = len(pod_nodes)
+        pod_nodes.append(index[node_name])
+        for kind_pod, kind_cols, kind_ext, key in (
+            (c_pod, c_cols, c_ext, "containers"),
+            (i_pod, i_cols, i_ext, "initContainers"),
         ):
-            vals = np.fromiter(
-                (r[1][key] for r in rows), dtype=np.int64, count=p
+            for c in pod.get(key, []):
+                res = c.get("resources", {})
+                req, lim = res.get("requests", {}), res.get("limits", {})
+                kind_pod.append(pid)
+                kind_cols[0].append(code(req.get("cpu")))
+                kind_cols[1].append(code(lim.get("cpu")))
+                kind_cols[2].append(code(req.get("memory")))
+                kind_cols[3].append(code(lim.get("memory")))
+                for r in extended_resources:
+                    kind_ext[r].append(code(req.get(r)))
+
+    p = len(pod_nodes)
+    if p:
+        lut_milli = np.fromiter(
+            (_strict_parse(s, milli=True) for s in strings),
+            dtype=np.int64, count=len(strings),
+        )
+        lut_plain = np.fromiter(
+            (_strict_parse(s) for s in strings),
+            dtype=np.int64, count=len(strings),
+        )
+        idx = np.asarray(pod_nodes, dtype=np.int64)
+        np.add.at(snap["pods_count"], idx, 1)
+        cp = np.asarray(c_pod, dtype=np.int64)
+        ip = np.asarray(i_pod, dtype=np.int64)
+        i64min = np.iinfo(np.int64).min
+        luts = (lut_milli, lut_milli, lut_plain, lut_plain)
+
+        def effective(col: int, lut) -> np.ndarray:
+            """Per-pod ``max(sum(containers), max(initContainers))``."""
+            acc = np.zeros(p, dtype=np.int64)
+            np.add.at(acc, cp, lut[np.asarray(c_cols[col], dtype=np.int64)])
+            if ip.size:
+                # Peak starts at int64 min so untouched pods keep their
+                # plain sum even for (degenerate) negative quantities —
+                # exactly the per-pod running-max rule.
+                peak = np.full(p, i64min, dtype=np.int64)
+                np.maximum.at(
+                    peak, ip, lut[np.asarray(i_cols[col], dtype=np.int64)]
+                )
+                acc = np.where(peak != i64min, np.maximum(acc, peak), acc)
+            return acc
+
+        for col, (name, lut) in enumerate(
+            zip(
+                ("used_cpu_req_milli", "used_cpu_lim_milli",
+                 "used_mem_req_bytes", "used_mem_lim_bytes"),
+                luts,
             )
-            np.add.at(snap[col], idx, vals)
+        ):
+            np.add.at(snap[name], idx, effective(col, lut))
         for r_name in extended_resources:
-            vals = np.fromiter(
-                (r[1]["ext"][r_name] for r in rows), dtype=np.int64, count=p
+            acc = np.zeros(p, dtype=np.int64)
+            np.add.at(
+                acc, cp, lut_plain[np.asarray(c_ext[r_name], dtype=np.int64)]
             )
-            np.add.at(ext[r_name][1], idx, vals)
+            if ip.size:
+                peak = np.full(p, i64min, dtype=np.int64)
+                np.maximum.at(
+                    peak, ip,
+                    lut_plain[np.asarray(i_ext[r_name], dtype=np.int64)],
+                )
+                acc = np.where(peak != i64min, np.maximum(acc, peak), acc)
+            np.add.at(ext[r_name][1], idx, acc)
 
     return ClusterSnapshot(
         names=names,

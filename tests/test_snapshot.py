@@ -191,3 +191,76 @@ class TestSynthetic:
         assert (s.used_cpu_req_milli <= s.alloc_cpu_milli).all()
         assert (s.used_mem_req_bytes <= s.alloc_mem_bytes).all()
         assert isinstance(s, ClusterSnapshot)
+
+
+class TestStrictColumnarParity:
+    """The columnar strict pack must equal a per-pod walk with
+    ``_effective_pod_resources`` — the single-pod path watch events use
+    (store.py), so any drift would desync live updates from full packs."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_columnar_equals_per_pod_oracle(self, seed):
+        from kubernetesclustercapacity_tpu.snapshot import (
+            _STRICT_TERMINATED,
+            _effective_pod_resources,
+        )
+
+        rng = np.random.default_rng(seed)
+        fx = synthetic_fixture(30, seed=seed, unhealthy_frac=0.1)
+        ext = ("nvidia.com/gpu",)
+        # Adversarial decoration: init containers (peaks above and below
+        # the steady-state sum), extended requests, duplicate and invalid
+        # quantity strings, containers with missing request/limit dicts.
+        for pod in fx["pods"]:
+            roll = int(rng.integers(0, 5))
+            if roll == 0:
+                pod["initContainers"] = [
+                    {"resources": {"requests": {"cpu": "9", "memory": "9Gi",
+                                                "nvidia.com/gpu": "3"},
+                                   "limits": {"cpu": "10"}}},
+                    {"resources": {"requests": {"cpu": "1m"}, "limits": {}}},
+                ]
+            elif roll == 1:
+                pod["initContainers"] = [{"resources": {"requests": {},
+                                                        "limits": {}}}]
+            elif roll == 2:
+                pod["containers"].append(
+                    {"resources": {"requests": {"cpu": "not-a-qty",
+                                                "nvidia.com/gpu": "2"},
+                                   "limits": {"memory": "bad"}}}
+                )
+            elif roll == 3:
+                pod["containers"] = [{"resources": {}}]
+        snap = snapshot_from_fixture(
+            fx, semantics="strict", extended_resources=ext
+        )
+        index = {n["name"]: i for i, n in enumerate(fx["nodes"])}
+        n = len(index)
+        want = {k: np.zeros(n, dtype=np.int64)
+                for k in ("cpu_req", "cpu_lim", "mem_req", "mem_lim", "gpu",
+                          "count")}
+        for pod in fx["pods"]:
+            nn = pod.get("nodeName", "")
+            if not nn or nn not in index:
+                continue
+            if pod.get("phase") in _STRICT_TERMINATED:
+                continue
+            e = _effective_pod_resources(pod, ext)
+            i = index[nn]
+            want["count"][i] += 1
+            want["cpu_req"][i] += e["cpu_req"]
+            want["cpu_lim"][i] += e["cpu_lim"]
+            want["mem_req"][i] += e["mem_req"]
+            want["mem_lim"][i] += e["mem_lim"]
+            want["gpu"][i] += e["ext"]["nvidia.com/gpu"]
+        np.testing.assert_array_equal(snap.used_cpu_req_milli,
+                                      want["cpu_req"])
+        np.testing.assert_array_equal(snap.used_cpu_lim_milli,
+                                      want["cpu_lim"])
+        np.testing.assert_array_equal(snap.used_mem_req_bytes,
+                                      want["mem_req"])
+        np.testing.assert_array_equal(snap.used_mem_lim_bytes,
+                                      want["mem_lim"])
+        np.testing.assert_array_equal(snap.pods_count, want["count"])
+        np.testing.assert_array_equal(snap.extended["nvidia.com/gpu"][1],
+                                      want["gpu"])
