@@ -488,26 +488,37 @@ def _run() -> None:
             )
         )
 
-        def make_run_fast(K):
-            @jax.jit
-            def run_many(*stacks):
-                def body(carry, xs):
-                    if use_rcp:
-                        cr, mr, crr, mrr = xs
-                        totals = _sweep_pallas_padded_rcp(
-                            *node_args, cr, mr, crr, mrr, interpret=interpret
-                        )
-                    else:
-                        cr, mr = xs
-                        totals = _sweep_pallas_padded(
-                            *node_args, cr, mr, interpret=interpret
-                        )
-                    return carry, totals
+        def make_run_fast_var(strict, mk):
+            """Factory for fused scan runners: one body for the headline
+            (strict=False, mk=None) and the ladder's strict/masked
+            variants, so all fused timings dispatch identical code."""
 
-                _, totals = jax.lax.scan(body, 0, stacks)
-                return totals
+            def make(K):
+                @jax.jit
+                def run_many(*stacks):
+                    def body(carry, xs):
+                        if use_rcp:
+                            cr, mr, crr, mrr = xs
+                            totals = _sweep_pallas_padded_rcp(
+                                *node_args, cr, mr, crr, mrr, mk,
+                                strict=strict, interpret=interpret,
+                            )
+                        else:
+                            cr, mr = xs
+                            totals = _sweep_pallas_padded(
+                                *node_args, cr, mr, mk,
+                                strict=strict, interpret=interpret,
+                            )
+                        return carry, totals
 
-            return run_many
+                    _, totals = jax.lax.scan(body, 0, stacks)
+                    return totals
+
+                return run_many
+
+            return make
+
+        make_run_fast = make_run_fast_var(False, None)
 
         def make_fast_args(K, seed):
             _, crs, mrs, _ = fresh_grids(K, seed)
@@ -633,17 +644,75 @@ def _run() -> None:
             **aux,
         )[0]
 
-        # config 5: 10k-node masked sweep (taint/affinity-style node mask).
-        mask = jax.device_put(rng.random(n_nodes) < 0.7)
-        ladder["config5_masked_per_sweep_ms"] = measure_slope(
-            lambda K: scan_runner(
-                lambda cr, mr, rp: sweep_grid(
-                    *arrays, cr, mr, rp, mode="reference", node_mask=mask
-                )[0]
-            ),
-            grids_stack,
-            **aux,
-        )[0]
+        # config 5 + strict: the fused kernel now carries the mode epilogue
+        # and a lane mask, so the production default (strict, implicitly
+        # taint-masked) and masked reference sweeps ride the same fast path
+        # as the headline.  Timed fused when eligible (cross-checked batch
+        # by batch against the exact kernel — a wrong fast variant's time
+        # is never reported), exact otherwise.
+        mask_np = rng.random(n_nodes) < 0.7
+        mask = jax.device_put(mask_np)
+        if fast_used:
+            mk_masked = jax.device_put(
+                pad_node_array(mask_np.astype(np.int64), n_pad)
+            )
+            healthy_np = np.asarray(snap.healthy, dtype=bool)
+            mk_strict = jax.device_put(
+                pad_node_array(healthy_np.astype(np.int64), n_pad)
+            )
+
+            def exact_batch(K, seed, **kw):
+                """Exact-kernel totals for the (K, seed) grid batch."""
+                return np.asarray(
+                    scan_runner(
+                        lambda cr, mr, rp: sweep_grid(
+                            *arrays, cr, mr, rp, **kw
+                        )[0]
+                    )(*grids_stack(K, seed))
+                )
+
+            for name, strict_flag, mk_dev, exact_kw in (
+                ("strict_per_sweep_ms", True, mk_strict,
+                 dict(mode="strict")),
+                ("config5_masked_per_sweep_ms", False, mk_masked,
+                 dict(mode="reference", node_mask=mask)),
+            ):
+                ms, _, outs = measure_slope(
+                    make_run_fast_var(strict_flag, mk_dev),
+                    make_fast_args, **aux,
+                )
+                ok = all(
+                    np.array_equal(
+                        np.asarray(outs[key])[:, :n_scenarios],
+                        exact_batch(*key, **exact_kw),
+                    )
+                    for key in outs
+                )
+                if ok:
+                    ladder[name] = ms
+                else:
+                    ladder[f"{name}_mismatch"] = True
+        else:
+            # Ineligible snapshot: both ladder entries still report, timed
+            # on the exact kernel (which IS the production path then).
+            ladder["strict_per_sweep_ms"] = measure_slope(
+                lambda K: scan_runner(
+                    lambda cr, mr, rp: sweep_grid(
+                        *arrays, cr, mr, rp, mode="strict"
+                    )[0]
+                ),
+                grids_stack,
+                **aux,
+            )[0]
+            ladder["config5_masked_per_sweep_ms"] = measure_slope(
+                lambda K: scan_runner(
+                    lambda cr, mr, rp: sweep_grid(
+                        *arrays, cr, mr, rp, mode="reference", node_mask=mask
+                    )[0]
+                ),
+                grids_stack,
+                **aux,
+            )[0]
         # --- native compiled-CPU comparator: the multi-threaded C++ sweep
         # (the role the Go binary plays in the survey's inventory) on the
         # same workloads, for a true compiled-CPU vs TPU ratio.

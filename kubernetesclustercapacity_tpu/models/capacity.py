@@ -21,7 +21,6 @@ from kubernetesclustercapacity_tpu import masks as _masks
 from kubernetesclustercapacity_tpu.ops.fit import (
     fit_per_node,
     fit_per_node_multi,
-    sweep_grid,
 )
 from kubernetesclustercapacity_tpu.scenario import Scenario, ScenarioGrid
 from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
@@ -362,11 +361,16 @@ class CapacityModel:
     ) -> tuple[np.ndarray, np.ndarray]:
         """Grid sweep with optional shared constraints.
 
-        Always runs on the bit-exact 2-resource kernel; the shared mask (same
-        for every scenario) is applied inside the jitted sweep.  Per-scenario
-        constraint grids go through :func:`..ops.fit.sweep_grid_multi`
-        directly.
+        Dispatches through the auto kernel chooser
+        (:func:`..ops.pallas_fit.sweep_auto`): eligible sweeps — either
+        mode, masked or not — run the fused Pallas int32 kernel, the rest
+        the exact int64 XLA kernel; both are bit-exact.  The shared mask
+        (same for every scenario) is applied inside the kernel.
+        Per-scenario constraint grids go through
+        :func:`..ops.fit.sweep_grid_multi` directly.
         """
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import sweep_auto
+
         grid.validate()
         snap = self.snapshot
         shared_spec = PodSpec(
@@ -377,7 +381,7 @@ class CapacityModel:
         )
         self._check_extensions(shared_spec.constrained)
         mask = self._masks_for(shared_spec)
-        totals, sched = sweep_grid(
+        totals, sched, _ = sweep_auto(
             snap.alloc_cpu_milli,
             snap.alloc_mem_bytes,
             snap.alloc_pods,

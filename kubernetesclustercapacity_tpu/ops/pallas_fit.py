@@ -164,14 +164,36 @@ def _rcp_div(a, d, r):
     return q
 
 
-def _fit_row(ac, am, ap, uc, um, pc, cr, mr):
-    """Reference-semantics fit of one node sublane row against all scenarios.
+def _epilogue(fit, ap, pc, mk, strict: bool):
+    """The mode epilogue + constraint mask, on ``(BS, LANES)`` fit blocks.
 
-    ``ac..pc`` are ``(1, LANES)`` node rows, ``cr``/``mr`` are ``(BS, 1)``
-    scenario requests; returns ``(BS, LANES)`` fits.  In the eligible domain
-    (non-negative int32) Go's uint64/int64 semantics and int32 semantics
-    coincide, including the conditional pod-cap overwrite (which may go
-    negative — int32 handles that fine).
+    Reference mode is the Q1 conditional overwrite (``ClusterCapacity.go:
+    134-136`` — may go negative; int32 handles that fine).  Strict mode is
+    the corrected 3-way min: clamp to remaining pod slots and to zero (the
+    healthy filter rides in ``mk`` — in the eligible domain zeroing a lane
+    via the mask is exactly the exact kernel's ``where(healthy, fit, 0)``).
+    ``mk`` is a ``(1, LANES)`` int32 0/1 row or ``None``; multiplying is
+    cheaper than a select on the VPU and exact for 0/1 masks.
+    """
+    if strict:
+        zero = jnp.int32(0)
+        slots = jnp.maximum(ap - pc, zero)
+        fit = jnp.maximum(jnp.minimum(fit, slots), zero)
+    else:
+        fit = jnp.where(fit >= ap, (ap - pc) + jnp.zeros_like(fit), fit)
+    if mk is not None:
+        fit = fit * mk
+    return fit
+
+
+def _fit_row(ac, am, ap, uc, um, pc, mk, cr, mr, strict):
+    """Fit of one node sublane row against all scenarios.
+
+    ``ac..pc`` (and ``mk`` when present) are ``(1, LANES)`` node rows,
+    ``cr``/``mr`` are ``(BS, 1)`` scenario requests; returns ``(BS, LANES)``
+    fits.  In the eligible domain (non-negative int32) Go's uint64/int64
+    semantics and int32 semantics coincide, including the conditional
+    pod-cap overwrite.
 
     Everything here is a 2-D ``(scenario, lane)`` op with standard
     rank-2×rank-2 broadcasting — Mosaic's native vector layout.  (The first
@@ -186,10 +208,10 @@ def _fit_row(ac, am, ap, uc, um, pc, cr, mr):
     cpu_fit = jnp.where(ac <= uc, zero, (ac - uc) // cr)
     mem_fit = jnp.where(am <= um, zero, (am - um) // mr)
     fit = jnp.minimum(cpu_fit, mem_fit)
-    return jnp.where(fit >= ap, (ap - pc) + jnp.zeros_like(fit), fit)
+    return _epilogue(fit, ap, pc, mk, strict)
 
 
-def _fit_row_rcp(ac, am, ap, uc, um, pc, cr, mr, crr, mrr):
+def _fit_row_rcp(ac, am, ap, uc, um, pc, mk, cr, mr, crr, mrr, strict):
     """:func:`_fit_row` with reciprocal division (rcp-eligible domain only).
 
     Dividends clamp at 0 before the divide: negative headrooms are where'd
@@ -204,12 +226,23 @@ def _fit_row_rcp(ac, am, ap, uc, um, pc, cr, mr, crr, mrr):
         am <= um, zero, _rcp_div(jnp.maximum(am - um, zero), mr, mrr)
     )
     fit = jnp.minimum(cpu_fit, mem_fit)
-    return jnp.where(fit >= ap, (ap - pc) + jnp.zeros_like(fit), fit)
+    return _epilogue(fit, ap, pc, mk, strict)
 
 
-def _make_sweep_kernel(use_rcp: bool):
-    def kernel(ac, am, ap, uc, um, pc, cr, mr, *rest):
-        (crr, mrr, out) = rest if use_rcp else (None, None, rest[0])
+def _make_sweep_kernel(use_rcp: bool, strict: bool, use_mask: bool):
+    def kernel(*refs):
+        ac, am, ap, uc, um, pc = refs[:6]
+        i = 6
+        mk = None
+        if use_mask:
+            mk = refs[i]
+            i += 1
+        cr, mr = refs[i], refs[i + 1]
+        i += 2
+        if use_rcp:
+            crr, mrr = refs[i], refs[i + 1]
+            i += 2
+        out = refs[i]
         j = pl.program_id(1)
 
         @pl.when(j == 0)
@@ -227,50 +260,57 @@ def _make_sweep_kernel(use_rcp: bool):
         acc = jnp.zeros_like(out)
         for r in range(NODE_TILE_ROWS):
             row = slice(r, r + 1)
+            mk_row = mk[row] if use_mask else None
             if use_rcp:
                 acc += _fit_row_rcp(
                     ac[row], am[row], ap[row], uc[row], um[row], pc[row],
-                    cr, mr, crr, mrr,
+                    mk_row, cr, mr, crr, mrr, strict,
                 )
             else:
                 acc += _fit_row(
                     ac[row], am[row], ap[row], uc[row], um[row], pc[row],
-                    cr, mr,
+                    mk_row, cr, mr, strict,
                 )
         out[...] += acc
 
     return kernel
 
 
-@partial(jax.jit, static_argnames=("interpret",))
-def _sweep_pallas_padded(ac, am, ap, uc, um, pc, cr, mr, *, interpret=False):
+@partial(jax.jit, static_argnames=("strict", "interpret"))
+def _sweep_pallas_padded(
+    ac, am, ap, uc, um, pc, cr, mr, mk=None, *, strict=False, interpret=False
+):
     """Inner jitted pallas sweep on padded arrays (int32 ``//`` kernel).
 
     ``ac..pc``: ``(N/128, 128)`` int32 node arrays; ``cr``/``mr``: ``(S, 1)``
-    int32 requests; returns int64 ``totals[S]``.
+    int32 requests; ``mk``: optional ``(N/128, 128)`` int32 0/1 constraint
+    mask (for strict mode this carries healthy∧constraints); returns int64
+    ``totals[S]``.
     """
     return _pallas_dispatch(
-        ac, am, ap, uc, um, pc, cr, mr, None, None,
-        use_rcp=False, interpret=interpret,
+        ac, am, ap, uc, um, pc, mk, cr, mr, None, None,
+        use_rcp=False, strict=strict, interpret=interpret,
     )
 
 
-@partial(jax.jit, static_argnames=("interpret",))
+@partial(jax.jit, static_argnames=("strict", "interpret"))
 def _sweep_pallas_padded_rcp(
-    ac, am, ap, uc, um, pc, cr, mr, crr, mrr, *, interpret=False
+    ac, am, ap, uc, um, pc, cr, mr, crr, mrr, mk=None,
+    *, strict=False, interpret=False,
 ):
     """Reciprocal-division variant: ``crr``/``mrr`` are f32 ``(S, 1)``
     reciprocals of ``cr``/``mr`` produced by an IEEE divide (numpy f64
     halved to f32, or an XLA f32 divide — both within the proof's 1-ulp
     budget).  Only valid on :func:`rcp_division_eligible` inputs."""
     return _pallas_dispatch(
-        ac, am, ap, uc, um, pc, cr, mr, crr, mrr,
-        use_rcp=True, interpret=interpret,
+        ac, am, ap, uc, um, pc, mk, cr, mr, crr, mrr,
+        use_rcp=True, strict=strict, interpret=interpret,
     )
 
 
 def _pallas_dispatch(
-    ac, am, ap, uc, um, pc, cr, mr, crr, mrr, *, use_rcp, interpret
+    ac, am, ap, uc, um, pc, mk, cr, mr, crr, mrr,
+    *, use_rcp, strict, interpret,
 ):
     n_rows = ac.shape[0]
     s = cr.shape[0]
@@ -288,8 +328,14 @@ def _pallas_dispatch(
         (SCENARIO_TILE, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
     )
 
-    operands = (ac, am, ap, uc, um, pc, cr, mr)
-    in_specs = [node_spec] * 6 + [scen_spec] * 2
+    use_mask = mk is not None
+    operands = (ac, am, ap, uc, um, pc)
+    in_specs = [node_spec] * 6
+    if use_mask:
+        operands += (mk,)
+        in_specs += [node_spec]
+    operands += (cr, mr)
+    in_specs += [scen_spec] * 2
     if use_rcp:
         operands += (crr, mrr)
         in_specs += [scen_spec] * 2
@@ -301,7 +347,7 @@ def _pallas_dispatch(
     # way; only the trace-time index/promotion semantics change.
     with jax.enable_x64(False):
         partial_sums = pl.pallas_call(
-            _make_sweep_kernel(use_rcp),
+            _make_sweep_kernel(use_rcp, strict, use_mask),
             out_shape=jax.ShapeDtypeStruct((s, LANES), jnp.int32),
             grid=grid,
             in_specs=in_specs,
@@ -372,17 +418,30 @@ def sweep_pallas(
     mem_reqs,
     replicas,
     *,
+    mode: str = "reference",
+    node_mask=None,
     interpret: bool = False,
     use_rcp: bool | None = None,
 ):
-    """Fused Pallas sweep (reference semantics). Caller must check eligibility.
+    """Fused Pallas sweep. Caller must check eligibility.
 
-    Padding: nodes pad with zero rows (fit 0 — ``0 >= alloc_pods 0`` rewrites
-    to ``0 − 0``); scenarios pad with ``(1, 1)`` probes whose outputs are
-    dropped.  ``use_rcp`` selects the reciprocal-division kernel (~6x faster
-    divides); ``None`` auto-enables it when :func:`rcp_division_eligible`
-    proves it exact.  Returns ``(totals[S], schedulable[S])`` numpy arrays.
+    ``mode`` selects the epilogue: ``"reference"`` is the Q1 conditional
+    pod-cap overwrite; ``"strict"`` the corrected 3-way min (callers fold
+    ``healthy`` into ``node_mask`` — the exact kernel's
+    ``where(healthy, fit, 0)`` is the same lane-zeroing).  ``node_mask``
+    (``[N]`` bool/int 0-1, optional) zeroes constraint-infeasible nodes
+    after the epilogue, matching :func:`..fit.fit_per_node`'s ordering.
+
+    Padding: nodes pad with zero rows (fit 0 in both modes — reference
+    rewrites ``0 >= alloc_pods 0`` to ``0 − 0``, strict clamps to zero
+    slots); a present mask pads with 0 (masked out).  Scenarios pad with
+    ``(1, 1)`` probes whose outputs are dropped.  ``use_rcp`` selects the
+    reciprocal-division kernel (~6x faster divides); ``None`` auto-enables
+    it when :func:`rcp_division_eligible` proves it exact.  Returns
+    ``(totals[S], schedulable[S])`` numpy arrays.
     """
+    if mode not in ("reference", "strict"):
+        raise ValueError(f"unknown mode {mode!r}")
     if use_rcp is None:
         use_rcp = rcp_division_eligible(
             alloc_cpu, alloc_mem, used_cpu, used_mem, cpu_reqs, mem_reqs
@@ -402,13 +461,21 @@ def sweep_pallas(
         pad_scenario_array(cpu_reqs, s_pad),
         pad_scenario_array(mem_reqs, s_pad, kib=True),
     )
+    mk = None
+    if node_mask is not None:
+        mk = pad_node_array(
+            np.asarray(node_mask).astype(np.int64), n_pad
+        )
+    strict = mode == "strict"
     if use_rcp:
         recips = tuple(scenario_reciprocals(args[i]) for i in (6, 7))
         totals = _sweep_pallas_padded_rcp(
-            *args, *recips, interpret=interpret
+            *args, *recips, mk, strict=strict, interpret=interpret
         )
     else:
-        totals = _sweep_pallas_padded(*args, interpret=interpret)
+        totals = _sweep_pallas_padded(
+            *args, mk, strict=strict, interpret=interpret
+        )
     totals = np.asarray(totals)[:s]
     schedulable = totals >= np.asarray(replicas, dtype=np.int64)
     return totals, schedulable
@@ -426,19 +493,41 @@ def sweep_auto(
     mem_reqs,
     replicas,
     *,
-    interpret: bool = False,
+    mode: str = "reference",
+    node_mask=None,
+    interpret: bool | None = None,
     force_exact: bool = False,
 ):
     """Fast path when eligible, exact int64 path otherwise — always bit-exact.
 
-    Reference semantics only (the fast path exists for the headline sweep;
-    strict mode goes through the exact kernel).  The ONE dispatcher: every
-    auto-kernel surface (:func:`sweep_snapshot_auto`, and through it the
-    CLI and service) funnels here, so eligibility/padding fixes land
-    everywhere at once.  Returns numpy ``(totals[S], schedulable[S],
-    kernel_name)`` with ``kernel_name`` one of ``pallas_i32_rcp_fused``,
-    ``pallas_i32_fused``, ``xla_int64``.
+    Both modes take the fused path when eligible: reference with the Q1
+    epilogue, strict (the :class:`..models.capacity.CapacityModel` default,
+    where every surface also carries the implicit taint mask) with the
+    clamped epilogue and ``healthy`` folded into the kernel's lane mask.
+    The ONE dispatcher: every auto-kernel surface
+    (:func:`sweep_snapshot_auto`, and through it the CLI and service)
+    funnels here, so eligibility/padding fixes land everywhere at once.
+    Returns numpy ``(totals[S], schedulable[S], kernel_name)`` with
+    ``kernel_name`` one of ``pallas_i32_rcp_fused``, ``pallas_i32_fused``,
+    ``xla_int64``.  ``interpret=None`` auto-selects Pallas interpret mode
+    off-TPU (the real chip may register under a plugin platform name, so
+    detect the one backend that NEEDS interpret mode).
     """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if mode == "strict":
+        # Strict zeroes unhealthy nodes inside the exact kernel; the fused
+        # kernel expresses that as the same lane mask the constraint mask
+        # uses, so fold them (reference mode ignores healthy: its phantom
+        # nodes are handled at packing).
+        healthy_arr = np.asarray(healthy, dtype=bool)
+        kernel_mask = (
+            healthy_arr
+            if node_mask is None
+            else healthy_arr & np.asarray(node_mask, dtype=bool)
+        )
+    else:
+        kernel_mask = node_mask
     if not force_exact and fast_sweep_eligible(
         alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
         cpu_reqs, mem_reqs,
@@ -448,14 +537,15 @@ def sweep_auto(
         )
         totals, sched = sweep_pallas(
             alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
-            cpu_reqs, mem_reqs, replicas, interpret=interpret,
-            use_rcp=use_rcp,
+            cpu_reqs, mem_reqs, replicas, mode=mode, node_mask=kernel_mask,
+            interpret=interpret, use_rcp=use_rcp,
         )
         name = "pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused"
         return totals, sched, name
     totals, sched = sweep_grid(
         alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
-        healthy, cpu_reqs, mem_reqs, replicas, mode="reference",
+        healthy, cpu_reqs, mem_reqs, replicas, mode=mode,
+        node_mask=node_mask,
     )
     return np.asarray(totals), np.asarray(sched), "xla_int64"
 
@@ -474,36 +564,26 @@ def sweep_snapshot_auto(
     The dispatch the CLI ``-grid`` path and the service ``sweep`` op use
     (the reference evaluates its one scenario with the sequential loop at
     ``ClusterCapacity.go:105-140``; a sweep is that loop over S what-if
-    specs).  Eligible reference-mode sweeps take the fused Pallas int32
-    path — the same kernel the headline bench times — everything else
-    takes the exact int64 XLA kernel.  Strict mode always goes exact: its
-    healthy/slot clamping lives only in the int64 kernel.
+    specs).  Eligible sweeps take the fused Pallas int32 path — the same
+    kernel the headline bench times — in BOTH modes, masked or not: strict
+    (the production default, always implicitly masked by hard taints) runs
+    the fused clamped epilogue with healthy∧mask as the kernel's lane
+    mask.  Everything else falls back to the exact int64 XLA kernel.
 
     ``node_mask`` (``[N]`` bool, optional) zeroes constraint-infeasible
     nodes — e.g. the implicit hard-taint mask every strict surface shares
-    (:func:`..masks.implicit_taint_mask`); masked sweeps always take the
-    exact kernel (the Pallas path has no mask input).
+    (:func:`..masks.implicit_taint_mask`).
 
     ``kernel="exact"`` forces the int64 path (operator escape hatch);
     ``interpret=None`` auto-selects Pallas interpret mode off-TPU.
     Returns ``(totals[S], schedulable[S], kernel_name)`` with numpy arrays
     and the kernel actually used.
     """
-    from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
-
     if kernel not in ("auto", "exact"):
         raise ValueError(f"unknown kernel {kernel!r}")
-    if mode != "reference" or node_mask is not None:
-        totals, sched = sweep_snapshot(
-            snapshot, grid, mode=mode, node_mask=node_mask
-        )
-        return totals, sched, "xla_int64"
+    if mode not in ("reference", "strict"):
+        raise ValueError(f"unknown mode {mode!r}")
     grid.validate()
-    if interpret is None:
-        # The real chip may register under a plugin platform name (here
-        # "axon"), so detect the one backend that NEEDS interpret mode
-        # rather than allowlisting TPU.
-        interpret = jax.default_backend() == "cpu"
     return sweep_auto(
         snapshot.alloc_cpu_milli,
         snapshot.alloc_mem_bytes,
@@ -515,6 +595,8 @@ def sweep_snapshot_auto(
         grid.cpu_request_milli,
         grid.mem_request_bytes,
         grid.replicas,
+        mode=mode,
+        node_mask=node_mask,
         interpret=interpret,
         force_exact=(kernel == "exact"),
     )

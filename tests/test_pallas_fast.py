@@ -114,6 +114,50 @@ class TestPallasParity:
         np.testing.assert_array_equal(totals, exact_totals)
         np.testing.assert_array_equal(sched, exact_sched)
 
+    @pytest.mark.parametrize("n,s", [(100, 10), (2049, 257)])
+    def test_strict_matches_exact_kernel(self, n, s):
+        snap = synthetic_snapshot(n, seed=n + 1, mean_utilization=0.6)
+        snap.healthy[::3] = False
+        grid = random_scenario_grid(s, seed=s + 1)
+        exact_totals, exact_sched = sweep_snapshot(snap, grid, mode="strict")
+        totals, sched = sweep_pallas(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, mode="strict", node_mask=snap.healthy,
+            interpret=True,
+        )
+        np.testing.assert_array_equal(totals, exact_totals)
+        np.testing.assert_array_equal(sched, exact_sched)
+
+    def test_strict_slot_clamp_zero(self):
+        # pods_count > alloc_pods: strict slots clamp at 0, never negative.
+        snap = synthetic_snapshot(150, seed=21, alloc_pods=3)
+        snap.pods_count[:] = 9
+        grid = random_scenario_grid(8, seed=22)
+        exact_totals, _ = sweep_snapshot(snap, grid, mode="strict")
+        totals, _ = sweep_pallas(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, mode="strict", node_mask=snap.healthy,
+            interpret=True,
+        )
+        assert (totals == 0).all()
+        np.testing.assert_array_equal(totals, exact_totals)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_strict_forced_rcp_matches_forced_divide(self, seed):
+        snap = synthetic_snapshot(777, seed=seed, mean_utilization=0.6)
+        snap.healthy[::4] = False
+        grid = random_scenario_grid(64, seed=seed + 50)
+        kw = dict(mode="strict", node_mask=snap.healthy, interpret=True)
+        t_div, _ = sweep_pallas(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, use_rcp=False, **kw,
+        )
+        t_rcp, _ = sweep_pallas(
+            *_args(snap), grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, use_rcp=True, **kw,
+        )
+        np.testing.assert_array_equal(t_rcp, t_div)
+
     def test_pod_cap_negative_fits_preserved(self):
         # Nodes whose pod budget is exhausted produce negative fits via the
         # Q1 overwrite; the fast path must reproduce them.
@@ -312,13 +356,56 @@ class TestSnapshotAuto:
         _, _, kernel = sweep_snapshot_auto(snap, grid, kernel="exact")
         assert kernel == "xla_int64"
 
-    def test_strict_mode_goes_exact(self):
+    def test_strict_mode_takes_pallas_and_matches_exact(self):
         snap = synthetic_snapshot(100, seed=11)
+        snap.healthy[::7] = False  # exercise the fused healthy lane mask
         grid = random_scenario_grid(8, seed=12)
+        totals, _, kernel = sweep_snapshot_auto(snap, grid, mode="strict")
+        assert kernel.startswith("pallas_")
+        exact_totals, _ = sweep_snapshot(snap, grid, mode="strict")
+        np.testing.assert_array_equal(totals, exact_totals)
+
+    def test_strict_masked_takes_pallas_and_matches_exact(self):
+        snap = synthetic_snapshot(300, seed=13)
+        snap.healthy[::5] = False
+        rng = np.random.default_rng(14)
+        mask = rng.random(300) < 0.7
+        grid = random_scenario_grid(16, seed=15)
+        totals, _, kernel = sweep_snapshot_auto(
+            snap, grid, mode="strict", node_mask=mask
+        )
+        assert kernel.startswith("pallas_")
+        exact_totals, _ = sweep_snapshot(
+            snap, grid, mode="strict", node_mask=mask
+        )
+        np.testing.assert_array_equal(totals, exact_totals)
+
+    def test_reference_masked_takes_pallas_and_matches_exact(self):
+        # Reference mode with a mask: the Q1 overwrite's negative fits must
+        # zero out on masked lanes exactly like the exact kernel's where.
+        snap = synthetic_snapshot(200, seed=16, alloc_pods=3)
+        snap.pods_count[:] = 7  # cap triggers -> negative fits
+        rng = np.random.default_rng(17)
+        mask = rng.random(200) < 0.5
+        grid = random_scenario_grid(8, seed=18)
+        totals, _, kernel = sweep_snapshot_auto(snap, grid, node_mask=mask)
+        assert kernel.startswith("pallas_")
+        exact_totals, _ = sweep_snapshot(snap, grid, node_mask=mask)
+        np.testing.assert_array_equal(totals, exact_totals)
+
+    def test_strict_ineligible_falls_back_exact(self):
+        snap = synthetic_snapshot(100, seed=19, kib_quantized=False)
+        grid = random_scenario_grid(8, seed=20)
         totals, _, kernel = sweep_snapshot_auto(snap, grid, mode="strict")
         assert kernel == "xla_int64"
         exact_totals, _ = sweep_snapshot(snap, grid, mode="strict")
         np.testing.assert_array_equal(totals, exact_totals)
+
+    def test_unknown_mode_rejected(self):
+        snap = synthetic_snapshot(10, seed=11)
+        grid = random_scenario_grid(4, seed=12)
+        with pytest.raises(ValueError, match="mode"):
+            sweep_snapshot_auto(snap, grid, mode="lenient")
 
     def test_ineligible_falls_back(self):
         snap = synthetic_snapshot(100, seed=11, kib_quantized=False)
