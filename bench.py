@@ -615,26 +615,39 @@ def _run() -> None:
             )
         )
 
-        def multi_stack(K, seed):
-            grids, _, _, rps = fresh_grids(K, seed)
-            g = np.random.default_rng(seed)
-            reqs = np.stack(
-                [
-                    np.stack(
-                        [
-                            gr.cpu_request_milli,
-                            gr.mem_request_bytes,
-                            g.integers(1, 20, n_scenarios) * (1 << 30),
-                            g.integers(0, 3, n_scenarios),
-                        ],
-                        axis=1,
-                    )
-                    for gr in grids
-                ]
-            )  # [K, S, 4]
-            return (jax.device_put(reqs), jax.device_put(rps))
+        _multi_req_cache: dict = {}
 
-        ladder["config4_multi4_per_sweep_ms"] = measure_slope(
+        def multi_reqs(K, seed):
+            """[K, S, 4] request batches, cached so the exact and fused
+            timings (and their cross-check) walk identical inputs."""
+            key = (K, seed)
+            if key not in _multi_req_cache:
+                grids, _, _, _ = fresh_grids(K, seed)
+                g = np.random.default_rng(seed)
+                _multi_req_cache[key] = np.stack(
+                    [
+                        np.stack(
+                            [
+                                gr.cpu_request_milli,
+                                gr.mem_request_bytes,
+                                g.integers(1, 20, n_scenarios) * (1 << 30),
+                                g.integers(0, 3, n_scenarios),
+                            ],
+                            axis=1,
+                        )
+                        for gr in grids
+                    ]
+                )
+            return _multi_req_cache[key]
+
+        def multi_stack(K, seed):
+            _, _, _, rps = fresh_grids(K, seed)
+            return (
+                jax.device_put(multi_reqs(K, seed)),
+                jax.device_put(rps),
+            )
+
+        exact4_ms, _, exact4_out = measure_slope(
             lambda K: scan_runner(
                 lambda reqs, rp: sweep_grid_multi(
                     *dev_multi, reqs, rp, mode="strict"
@@ -642,7 +655,120 @@ def _run() -> None:
             ),
             multi_stack,
             **aux,
-        )[0]
+        )
+
+        # Fused R-dim kernel (ops/pallas_multi): eligibility + row scales
+        # proven over the UNION of every batch the fast path will time, so
+        # one compiled kernel serves them all; totals cross-checked against
+        # the exact path batch by batch.
+        from kubernetesclustercapacity_tpu.ops.pallas_multi import (
+            _sweep_pallas_multi_padded,
+            fast_multi_eligible,
+            pad_multi_operands,
+            rcp_multi_eligible,
+        )
+
+        aux_keys = [(K, 7 * K) for K in aux["ks"]] + [
+            (K, 99) for K in aux["ks"]
+        ]
+        reqs_union = np.concatenate(
+            [multi_reqs(K, seed).reshape(-1, 4) for K, seed in aux_keys]
+        )
+        alloc_rn_np = np.asarray(alloc_rn)
+        used_rn_np = np.asarray(used_rn)
+        m_scales, m_ok = fast_multi_eligible(
+            alloc_rn_np, used_rn_np, snap.alloc_pods, snap.pods_count,
+            reqs_union,
+        )
+        if m_ok:
+            m_rcp = rcp_multi_eligible(
+                alloc_rn_np, used_rn_np, reqs_union, m_scales
+            )
+            node_ops4, ap4, pc4, req0, mk4 = pad_multi_operands(
+                alloc_rn_np, used_rn_np, snap.alloc_pods, snap.pods_count,
+                reqs_union[: n_scenarios], m_scales,
+                node_mask=np.asarray(snap.healthy, dtype=bool),
+            )
+            node_ops4 = tuple(jax.device_put(x) for x in node_ops4)
+            ap4, pc4, mk4 = (
+                jax.device_put(ap4), jax.device_put(pc4), jax.device_put(mk4)
+            )
+
+            def make_run_multi_fast(K):
+                @jax.jit
+                def run_many(req_stacks, rcp_stacks):
+                    def body(carry, xs):
+                        reqs_k, rcps_k = xs
+                        totals = _sweep_pallas_multi_padded(
+                            node_ops4, ap4, pc4, reqs_k, rcps_k, mk4,
+                            use_rcp=m_rcp, strict=True,
+                            interpret=interpret,
+                        )
+                        return carry, totals
+
+                    _, totals = jax.lax.scan(
+                        body, 0, (req_stacks, rcp_stacks)
+                    )
+                    return totals
+
+                return run_many
+
+            s_pad4 = padded_scenario_shape(n_scenarios)
+
+            def make_multi_fast_args(K, seed):
+                reqs = multi_reqs(K, seed)  # [K, S, 4]
+                req_stacks = tuple(
+                    np.stack(
+                        [
+                            pad_scenario_array(
+                                reqs[k, :, r] // m_scales[r], s_pad4
+                            )
+                            for k in range(K)
+                        ]
+                    )
+                    for r in range(4)
+                )
+                rcp_stacks = (
+                    tuple(
+                        np.stack(
+                            [
+                                scenario_reciprocals(
+                                    np.maximum(st[k], 1)
+                                )
+                                for k in range(K)
+                            ]
+                        )
+                        for st in req_stacks
+                    )
+                    if m_rcp
+                    else tuple(
+                        np.zeros_like(st, dtype=np.float32)
+                        for st in req_stacks
+                    )
+                )
+                return (
+                    tuple(jax.device_put(x) for x in req_stacks),
+                    tuple(jax.device_put(x) for x in rcp_stacks),
+                )
+
+            fused4_ms, _, fused4_out = measure_slope(
+                make_run_multi_fast, make_multi_fast_args, **aux
+            )
+            ok4 = all(
+                np.array_equal(
+                    np.asarray(fused4_out[key])[:, :n_scenarios],
+                    np.asarray(exact4_out[key]),
+                )
+                for key in fused4_out
+            )
+            if ok4:
+                ladder["config4_multi4_per_sweep_ms"] = fused4_ms
+                ladder["config4_multi4_exact_per_sweep_ms"] = exact4_ms
+            else:
+                ladder["config4_multi4_mismatch"] = True
+                ladder["config4_multi4_per_sweep_ms"] = exact4_ms
+        else:
+            ladder["config4_multi4_per_sweep_ms"] = exact4_ms
 
         # config 5 + strict: the fused kernel now carries the mode epilogue
         # and a lane mask, so the production default (strict, implicitly
@@ -652,7 +778,46 @@ def _run() -> None:
         # is never reported), exact otherwise.
         mask_np = rng.random(n_nodes) < 0.7
         mask = jax.device_put(mask_np)
-        if fast_used:
+
+        def exact_ladder_ms(**kw):
+            """Exact-kernel slope timing on the aux scan lengths."""
+            return measure_slope(
+                lambda K: scan_runner(
+                    lambda cr, mr, rp: sweep_grid(
+                        *arrays, cr, mr, rp, **kw
+                    )[0]
+                ),
+                grids_stack,
+                **aux,
+            )[0]
+
+        # The aux timings use their own (K, seed) batches — the headline's
+        # eligibility proof does not cover them, and the file invariant is
+        # to validate EVERY batch a fast kernel times.
+        aux_grids = [
+            g
+            for K in aux["ks"]
+            for seed in (99, 7 * K)
+            for g in fresh_grids(K, seed)[0]
+        ]
+        aux_fast_ok = fast_used and all(
+            fast_sweep_eligible(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+                snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+                snap.pods_count, g.cpu_request_milli, g.mem_request_bytes,
+            )
+            for g in aux_grids
+        )
+        if aux_fast_ok and use_rcp:
+            aux_fast_ok = all(
+                rcp_division_eligible(
+                    snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+                    snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+                    g.cpu_request_milli, g.mem_request_bytes,
+                )
+                for g in aux_grids
+            )
+        if aux_fast_ok:
             mk_masked = jax.device_put(
                 pad_node_array(mask_np.astype(np.int64), n_pad)
             )
@@ -690,29 +855,17 @@ def _run() -> None:
                 )
                 if ok:
                     ladder[name] = ms
-                else:
+                else:  # never report a wrong fast variant's time — but the
+                    # metric itself must not vanish: report exact + flag.
                     ladder[f"{name}_mismatch"] = True
+                    ladder[name] = exact_ladder_ms(**exact_kw)
         else:
-            # Ineligible snapshot: both ladder entries still report, timed
-            # on the exact kernel (which IS the production path then).
-            ladder["strict_per_sweep_ms"] = measure_slope(
-                lambda K: scan_runner(
-                    lambda cr, mr, rp: sweep_grid(
-                        *arrays, cr, mr, rp, mode="strict"
-                    )[0]
-                ),
-                grids_stack,
-                **aux,
-            )[0]
-            ladder["config5_masked_per_sweep_ms"] = measure_slope(
-                lambda K: scan_runner(
-                    lambda cr, mr, rp: sweep_grid(
-                        *arrays, cr, mr, rp, mode="reference", node_mask=mask
-                    )[0]
-                ),
-                grids_stack,
-                **aux,
-            )[0]
+            # Ineligible: both ladder entries still report, timed on the
+            # exact kernel (which IS the production path then).
+            ladder["strict_per_sweep_ms"] = exact_ladder_ms(mode="strict")
+            ladder["config5_masked_per_sweep_ms"] = exact_ladder_ms(
+                mode="reference", node_mask=mask
+            )
         # --- native compiled-CPU comparator: the multi-threaded C++ sweep
         # (the role the Go binary plays in the survey's inventory) on the
         # same workloads, for a true compiled-CPU vs TPU ratio.

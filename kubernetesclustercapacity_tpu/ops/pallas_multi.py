@@ -1,0 +1,414 @@
+"""Pallas TPU fast path for multi-resource sweeps (BASELINE config 4).
+
+Generalizes the fused 2-resource kernel (:mod:`.pallas_fit`) to R resource
+rows — the reference's 2-way min at ``ClusterCapacity.go:133`` extended to
+``min`` over R rows the way :func:`.fit.fit_per_node_multi` defines it.
+Same architecture: each grid step loads a node tile of every per-resource
+alloc/used slab into VMEM, evaluates a ``(scenario-tile × node-tile)``
+block per resource on the VPU, R-way-min's in-register, applies the mode
+epilogue + lane mask, and accumulates partial sums — the ``[S, N]`` fit
+matrix never exists in HBM (the int64 XLA path materializes ``[R, N]``
+per scenario, which is exactly what made config 4 40× off the headline).
+
+Eligibility generalizes the KiB-rescale proof per row: each resource row
+gets the smallest power-of-1024 scale that keeps alloc/used/requests
+int32-range while dividing all of them exactly — the rescale is then a
+bijection on the row's domain, so the int32 quotient equals the int64
+one.  Divisibility is monotone down the scale ladder (failing 1024 means
+failing 1024²), so the search is a short ascending walk.  Zero requests
+mean "does not consume this resource" (row excluded from the min via an
+int32-max fit, matching the exact kernel's int64-max sentinel — both are
+``>=`` every real fit, and the epilogue bounds the all-inactive case).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubernetesclustercapacity_tpu.ops.fit import sweep_grid_multi
+from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+    LANES,
+    NODE_TILE_ROWS,
+    SCENARIO_TILE,
+    _epilogue,
+    _rcp_div,
+    pad_node_array,
+    pad_scenario_array,
+    padded_node_shape,
+    padded_scenario_shape,
+    scenario_reciprocals,
+)
+
+__all__ = [
+    "multi_row_scales",
+    "fast_multi_eligible",
+    "rcp_multi_eligible",
+    "sweep_pallas_multi",
+    "sweep_multi_auto",
+]
+
+_I32_MAX = np.iinfo(np.int32).max
+_SCALES = (1, 1024, 1024**2, 1024**3)
+
+
+def _positive_reqs(reqs_col: np.ndarray) -> np.ndarray:
+    reqs_col = np.asarray(reqs_col)
+    return reqs_col[reqs_col > 0]
+
+
+def multi_row_scales(alloc_rn, used_rn, reqs_sr) -> list[int] | None:
+    """Per-row rescale factors proving int32 exactness, or None.
+
+    For each resource row r: the smallest ``s ∈ {1, 1024, 1024², 1024³}``
+    such that ``alloc[r]``, ``used[r]`` and every POSITIVE request in
+    ``reqs_sr[:, r]`` are all non-negative multiples of ``s`` with
+    quotients in int32 range.  Divisibility by a larger power of 1024
+    implies divisibility by the smaller ones, so the first divisibility
+    failure ends the row's search.
+    """
+    alloc_rn = np.asarray(alloc_rn, dtype=np.int64)
+    used_rn = np.asarray(used_rn, dtype=np.int64)
+    reqs_sr = np.asarray(reqs_sr, dtype=np.int64)
+    if reqs_sr.ndim != 2 or alloc_rn.shape[0] != reqs_sr.shape[1]:
+        return None
+    if reqs_sr.size and reqs_sr.min() < 0:
+        # The exact kernel divides negative requests as-is; the fused
+        # kernel's "active = req > 0" test would silently exclude them.
+        return None
+    scales: list[int] = []
+    for r in range(alloc_rn.shape[0]):
+        row_arrays = (alloc_rn[r], used_rn[r], _positive_reqs(reqs_sr[:, r]))
+        if any(a.size and a.min() < 0 for a in row_arrays):
+            return None
+        chosen = None
+        for s in _SCALES:
+            if s > 1 and any(
+                a.size and (a % s).any() for a in row_arrays
+            ):
+                break  # no larger scale can divide either
+            if all(
+                (not a.size) or (a // s).max() <= _I32_MAX
+                for a in row_arrays
+            ):
+                chosen = s
+                break
+        if chosen is None:
+            return None
+        scales.append(chosen)
+    return scales
+
+
+def fast_multi_eligible(
+    alloc_rn, used_rn, alloc_pods, pods_count, reqs_sr
+) -> tuple[list[int] | None, bool]:
+    """``(row_scales, ok)`` — ok iff the fused int32 R-dim kernel is exact.
+
+    Beyond the per-row rescale (:func:`multi_row_scales`): pod columns in
+    int32 range, and the int32 accumulator sum bound.  The per-node fit
+    after the epilogue is ``<=`` the fit of ANY active row, and which rows
+    a scenario activates is per-scenario — so the conservative per-node
+    bound takes the MAX over rows of ``alloc[r] // min_positive_req[r]``
+    (rows with no positive request anywhere in the grid can never bind and
+    are skipped), joined with the pod-cap values ``alloc_pods`` /
+    ``pods_count`` that the epilogue can emit.
+    """
+    scales = multi_row_scales(alloc_rn, used_rn, reqs_sr)
+    if scales is None:
+        return None, False
+    alloc_pods = np.asarray(alloc_pods, dtype=np.int64)
+    pods_count = np.asarray(pods_count, dtype=np.int64)
+    for a in (alloc_pods, pods_count):
+        if a.size and (a.min() < 0 or a.max() > _I32_MAX):
+            return scales, False
+    alloc_rn = np.asarray(alloc_rn, dtype=np.int64)
+    reqs_sr = np.asarray(reqs_sr, dtype=np.int64)
+    bound = np.maximum(alloc_pods, pods_count)
+    for r in range(alloc_rn.shape[0]):
+        pos = _positive_reqs(reqs_sr[:, r])
+        if pos.size:
+            bound = np.maximum(bound, alloc_rn[r] // int(pos.min()))
+    return scales, int(bound.sum()) <= _I32_MAX
+
+
+def rcp_multi_eligible(alloc_rn, used_rn, reqs_sr, scales) -> bool:
+    """Per-row reciprocal-division exactness, on the SCALED values.
+
+    Same two bounds as the 2-resource proof
+    (:func:`.pallas_fit.rcp_division_eligible`): quotient ``<= 2^20`` and
+    divisor ``<= 2^29``, per row, with dividends clamped to
+    ``[0, max(alloc)]``.  Zero requests never divide (the kernel
+    substitutes divisor 1 and wheres the row out), so only positive
+    requests bound the row.
+    """
+    qmax = np.int64(1) << 20
+    dmax = np.int64(1) << 29
+    alloc_rn = np.asarray(alloc_rn, dtype=np.int64)
+    reqs_sr = np.asarray(reqs_sr, dtype=np.int64)
+    for r, s in enumerate(scales):
+        alloc = alloc_rn[r] // s
+        pos = _positive_reqs(reqs_sr[:, r]) // s
+        if not pos.size:
+            continue
+        if pos.max() > dmax:
+            return False
+        if alloc.size and alloc.max() // pos.min() > qmax:
+            return False
+    return True
+
+
+def _make_multi_kernel(n_res: int, use_rcp: bool, strict: bool,
+                       use_mask: bool):
+    def kernel(*refs):
+        node = refs[: 2 * n_res]  # alloc_0, used_0, alloc_1, used_1, ...
+        i = 2 * n_res
+        ap, pc = refs[i], refs[i + 1]
+        i += 2
+        mk = None
+        if use_mask:
+            mk = refs[i]
+            i += 1
+        reqs = refs[i : i + n_res]
+        i += n_res
+        rcps = None
+        if use_rcp:
+            rcps = refs[i : i + n_res]
+            i += n_res
+        out = refs[i]
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            out[...] = jnp.zeros_like(out)
+
+        # (BS, 1) per-resource request columns; divisor-safe + active mask
+        # computed once per tile (scenario-only values).
+        zero = jnp.int32(0)
+        one = jnp.int32(1)
+        big = jnp.int32(_I32_MAX)
+        req_cols = [rq[...] for rq in reqs]
+        act_cols = [rq > zero for rq in req_cols]
+        safe_cols = [jnp.maximum(rq, one) for rq in req_cols]
+        rcp_cols = [rc[...] for rc in rcps] if use_rcp else None
+
+        acc = jnp.zeros_like(out)
+        for r in range(NODE_TILE_ROWS):
+            row = slice(r, r + 1)
+            fit = None
+            for k in range(n_res):
+                a = node[2 * k][row]
+                u = node[2 * k + 1][row]
+                if use_rcp:
+                    q = _rcp_div(
+                        jnp.maximum(a - u, zero), safe_cols[k], rcp_cols[k]
+                    )
+                else:
+                    q = (a - u) // safe_cols[k]
+                fit_k = jnp.where(
+                    act_cols[k], jnp.where(a <= u, zero, q), big
+                )
+                fit = fit_k if fit is None else jnp.minimum(fit, fit_k)
+            mk_row = mk[row] if use_mask else None
+            acc += _epilogue(fit, ap[row], pc[row], mk_row, strict)
+        out[...] += acc
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("use_rcp", "strict", "interpret"))
+def _sweep_pallas_multi_padded(
+    node_ops, ap, pc, req_ops, rcp_ops, mk=None,
+    *, use_rcp=False, strict=True, interpret=False,
+):
+    """Inner jitted R-dim pallas sweep on padded int32 arrays.
+
+    ``node_ops``: tuple of 2R ``(N/128, 128)`` arrays (alloc/used pairs in
+    resource order, each pre-scaled by its row scale); ``req_ops`` /
+    ``rcp_ops``: tuples of R ``(S, 1)`` request / reciprocal columns
+    (``rcp_ops=()`` without rcp); returns int64 ``totals[S]``.
+    """
+    n_res = len(node_ops) // 2
+    n_rows = ap.shape[0]
+    s = req_ops[0].shape[0]
+    grid = (s // SCENARIO_TILE, n_rows // NODE_TILE_ROWS)
+
+    node_spec = pl.BlockSpec(
+        (NODE_TILE_ROWS, LANES), lambda i, j: (j, 0),
+        memory_space=pltpu.VMEM,
+    )
+    scen_spec = pl.BlockSpec(
+        (SCENARIO_TILE, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+    )
+    out_spec = pl.BlockSpec(
+        (SCENARIO_TILE, LANES), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+    )
+
+    use_mask = mk is not None
+    operands = (*node_ops, ap, pc)
+    in_specs = [node_spec] * (len(node_ops) + 2)
+    if use_mask:
+        operands += (mk,)
+        in_specs += [node_spec]
+    operands += tuple(req_ops)
+    in_specs += [scen_spec] * len(req_ops)
+    if use_rcp:
+        operands += tuple(rcp_ops)
+        in_specs += [scen_spec] * len(rcp_ops)
+
+    with jax.enable_x64(False):  # same Mosaic x64 constraint as pallas_fit
+        partial_sums = pl.pallas_call(
+            _make_multi_kernel(n_res, use_rcp, strict, use_mask),
+            out_shape=jax.ShapeDtypeStruct((s, LANES), jnp.int32),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_spec,
+            interpret=interpret,
+        )(*operands)
+    return jnp.sum(partial_sums.astype(jnp.int64), axis=1)
+
+
+def pad_multi_operands(
+    alloc_rn, used_rn, alloc_pods, pods_count, reqs_sr, scales,
+    node_mask=None,
+):
+    """Host-side packing: scaled int32 kernel layout for the R-dim sweep.
+
+    Returns ``(node_ops, ap, pc, req_ops, mk)`` — see
+    :func:`_sweep_pallas_multi_padded`.  Row scales divide exactly (the
+    eligibility contract), so ``//`` here is the bijective rescale.
+    """
+    alloc_rn = np.asarray(alloc_rn, dtype=np.int64)
+    used_rn = np.asarray(used_rn, dtype=np.int64)
+    reqs_sr = np.asarray(reqs_sr, dtype=np.int64)
+    n = alloc_rn.shape[1]
+    s = reqs_sr.shape[0]
+    n_pad = padded_node_shape(n)
+    s_pad = padded_scenario_shape(s)
+    node_ops = []
+    req_ops = []
+    for r, scale in enumerate(scales):
+        node_ops.append(pad_node_array(alloc_rn[r] // scale, n_pad))
+        node_ops.append(pad_node_array(used_rn[r] // scale, n_pad))
+        req_ops.append(pad_scenario_array(reqs_sr[:, r] // scale, s_pad))
+    ap = pad_node_array(alloc_pods, n_pad)
+    pc = pad_node_array(pods_count, n_pad)
+    mk = None
+    if node_mask is not None:
+        mk = pad_node_array(np.asarray(node_mask).astype(np.int64), n_pad)
+    return tuple(node_ops), ap, pc, tuple(req_ops), mk
+
+
+def sweep_pallas_multi(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    reqs_sr,
+    replicas,
+    scales,
+    *,
+    mode: str = "strict",
+    node_mask=None,
+    use_rcp: bool | None = None,
+    interpret: bool = False,
+):
+    """Fused R-dim Pallas sweep.  Caller must have checked eligibility.
+
+    ``scales`` is :func:`multi_row_scales`' output for these inputs; for
+    strict mode callers fold ``healthy`` into ``node_mask``.  Returns
+    ``(totals[S], schedulable[S])`` numpy arrays.
+    """
+    if mode not in ("reference", "strict"):
+        raise ValueError(f"unknown mode {mode!r}")
+    if use_rcp is None:
+        use_rcp = rcp_multi_eligible(alloc_rn, used_rn, reqs_sr, scales)
+    s = np.asarray(reqs_sr).shape[0]
+    node_ops, ap, pc, req_ops, mk = pad_multi_operands(
+        alloc_rn, used_rn, alloc_pods, pods_count, reqs_sr, scales,
+        node_mask=node_mask,
+    )
+    rcp_ops = (
+        tuple(scenario_reciprocals(np.maximum(rq, 1)) for rq in req_ops)
+        if use_rcp
+        else ()
+    )
+    totals = _sweep_pallas_multi_padded(
+        node_ops, ap, pc, req_ops, rcp_ops, mk,
+        use_rcp=use_rcp, strict=(mode == "strict"), interpret=interpret,
+    )
+    totals = np.asarray(totals)[:s]
+    schedulable = totals >= np.asarray(replicas, dtype=np.int64)
+    return totals, schedulable
+
+
+def sweep_multi_auto(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_sr,
+    replicas,
+    *,
+    mode: str = "strict",
+    node_masks=None,
+    max_per_node=None,
+    interpret: bool | None = None,
+    force_exact: bool = False,
+):
+    """R-dim sweep on the fastest provably-exact kernel.
+
+    Mirrors :func:`.pallas_fit.sweep_auto` for the multi-resource surface:
+    eligible sweeps with a shared (or absent) node mask and no per-node
+    cap take the fused kernel; per-scenario ``[S, N]`` masks,
+    ``max_per_node``, or eligibility failure fall back to
+    :func:`.fit.sweep_grid_multi`.  Returns ``(totals, schedulable,
+    kernel_name)``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    shared_mask = None
+    fused_ok = max_per_node is None and not force_exact
+    if node_masks is not None:
+        nm = np.asarray(node_masks)
+        if nm.ndim == 1:
+            shared_mask = nm.astype(bool)
+        else:
+            fused_ok = False
+    if fused_ok:
+        scales, ok = fast_multi_eligible(
+            alloc_rn, used_rn, alloc_pods, pods_count, reqs_sr
+        )
+        if ok:
+            if mode == "strict":
+                healthy_arr = np.asarray(healthy, dtype=bool)
+                kernel_mask = (
+                    healthy_arr
+                    if shared_mask is None
+                    else healthy_arr & shared_mask
+                )
+            else:
+                kernel_mask = shared_mask
+            use_rcp = rcp_multi_eligible(alloc_rn, used_rn, reqs_sr, scales)
+            totals, sched = sweep_pallas_multi(
+                alloc_rn, used_rn, alloc_pods, pods_count, reqs_sr,
+                replicas, scales, mode=mode, node_mask=kernel_mask,
+                use_rcp=use_rcp, interpret=interpret,
+            )
+            name = (
+                "pallas_multi_i32_rcp_fused"
+                if use_rcp
+                else "pallas_multi_i32_fused"
+            )
+            return totals, sched, name
+    totals, sched = sweep_grid_multi(
+        alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs_sr,
+        replicas, mode=mode, node_masks=node_masks,
+        max_per_node=max_per_node,
+    )
+    return np.asarray(totals), np.asarray(sched), "xla_int64_multi"

@@ -1,0 +1,249 @@
+"""R-dim Pallas fast-path tests (interpret mode on CPU; TPU via bench)."""
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.ops.fit import sweep_grid_multi
+from kubernetesclustercapacity_tpu.ops.pallas_multi import (
+    fast_multi_eligible,
+    multi_row_scales,
+    rcp_multi_eligible,
+    sweep_multi_auto,
+    sweep_pallas_multi,
+)
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+GIB = 1 << 30
+
+
+def _workload(n, s, seed, *, gpu_zeros=True):
+    """Config-4-shaped inputs: cpu, memory, ephemeral-storage, GPU rows."""
+    rng = np.random.default_rng(seed)
+    snap = synthetic_snapshot(n, seed=seed)
+    alloc_rn = np.stack(
+        [
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            rng.integers(50, 500, n) * GIB,
+            rng.integers(0, 9, n),
+        ]
+    )
+    used_rn = np.stack(
+        [
+            snap.used_cpu_req_milli,
+            snap.used_mem_req_bytes,
+            rng.integers(0, 50, n) * GIB,
+            np.zeros(n, dtype=np.int64),
+        ]
+    )
+    reqs_sr = np.stack(
+        [
+            rng.integers(1, 10, s) * 100,
+            rng.integers(1, 16, s) * (64 << 20),
+            rng.integers(1, 20, s) * GIB,
+            rng.integers(0, 3, s) if gpu_zeros else rng.integers(1, 3, s),
+        ],
+        axis=1,
+    ).astype(np.int64)
+    reps = rng.integers(1, 500, s).astype(np.int64)
+    return snap, alloc_rn, used_rn, reqs_sr, reps
+
+
+class TestEligibility:
+    def test_config4_workload_eligible_and_rcp(self):
+        snap, alloc_rn, used_rn, reqs_sr, _ = _workload(500, 32, seed=1)
+        scales, ok = fast_multi_eligible(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count, reqs_sr
+        )
+        assert ok
+        # cpu milli scale 1; memory + ephemeral rows pick a power of 1024.
+        assert scales[0] == 1 and scales[1] >= 1024 and scales[2] >= 1024
+        assert scales[3] == 1
+        assert rcp_multi_eligible(alloc_rn, used_rn, reqs_sr, scales)
+
+    def test_unquantized_row_ineligible(self):
+        snap, alloc_rn, used_rn, reqs_sr, _ = _workload(50, 8, seed=2)
+        alloc_rn[1, 0] += 1  # de-quantize one memory cell, i32-overflow row
+        assert multi_row_scales(alloc_rn, used_rn, reqs_sr) is None
+
+    def test_negative_request_ineligible(self):
+        snap, alloc_rn, used_rn, reqs_sr, _ = _workload(50, 8, seed=3)
+        reqs_sr[0, 3] = -1
+        assert multi_row_scales(alloc_rn, used_rn, reqs_sr) is None
+
+    def test_sum_overflow_ineligible(self):
+        snap, alloc_rn, used_rn, reqs_sr, _ = _workload(50, 8, seed=4)
+        alloc_rn[0, :] = 2_000_000_000
+        reqs_sr[:, 0] = 1
+        scales, ok = fast_multi_eligible(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count, reqs_sr
+        )
+        assert scales is not None and not ok
+
+
+class TestParity:
+    @pytest.mark.parametrize("n,s", [(100, 10), (2048, 256), (2049, 257)])
+    @pytest.mark.parametrize("mode", ["strict", "reference"])
+    def test_matches_exact_kernel(self, n, s, mode):
+        snap, alloc_rn, used_rn, reqs_sr, reps = _workload(n, s, seed=n + s)
+        snap.healthy[::5] = False
+        exact = sweep_grid_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode=mode,
+        )
+        mask = snap.healthy if mode == "strict" else None
+        scales = multi_row_scales(alloc_rn, used_rn, reqs_sr)
+        totals, sched = sweep_pallas_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            reqs_sr, reps, scales, mode=mode, node_mask=mask,
+            interpret=True,
+        )
+        np.testing.assert_array_equal(totals, np.asarray(exact[0]))
+        np.testing.assert_array_equal(sched, np.asarray(exact[1]))
+
+    def test_all_zero_request_scenario(self):
+        # A scenario consuming nothing: every row inactive -> the epilogue
+        # bounds the int-max sentinel identically on both paths.
+        snap, alloc_rn, used_rn, reqs_sr, reps = _workload(150, 8, seed=7)
+        reqs_sr[3, :] = 0
+        for mode in ("strict", "reference"):
+            exact = sweep_grid_multi(
+                alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+                snap.healthy, reqs_sr, reps, mode=mode,
+            )
+            scales = multi_row_scales(alloc_rn, used_rn, reqs_sr)
+            mask = snap.healthy if mode == "strict" else None
+            totals, _ = sweep_pallas_multi(
+                alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+                reqs_sr, reps, scales, mode=mode, node_mask=mask,
+                interpret=True,
+            )
+            np.testing.assert_array_equal(totals, np.asarray(exact[0]))
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_forced_rcp_matches_forced_divide(self, seed):
+        snap, alloc_rn, used_rn, reqs_sr, reps = _workload(777, 64, seed=seed)
+        scales = multi_row_scales(alloc_rn, used_rn, reqs_sr)
+        assert rcp_multi_eligible(alloc_rn, used_rn, reqs_sr, scales)
+        args = (
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            reqs_sr, reps, scales,
+        )
+        t_div, _ = sweep_pallas_multi(
+            *args, mode="strict", node_mask=snap.healthy,
+            use_rcp=False, interpret=True,
+        )
+        t_rcp, _ = sweep_pallas_multi(
+            *args, mode="strict", node_mask=snap.healthy,
+            use_rcp=True, interpret=True,
+        )
+        np.testing.assert_array_equal(t_rcp, t_div)
+
+    def test_two_resource_agrees_with_2d_kernel_surface(self):
+        # R=2 multi fast path must agree with the exact 2-resource sweep
+        # in strict mode (same semantics there; reference differs by the
+        # uint64-CPU quirk, which multi does not carry).
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
+
+        snap = synthetic_snapshot(300, seed=9)
+        rng = np.random.default_rng(10)
+        s = 16
+        cpu = rng.integers(1, 10, s) * 100
+        mem = rng.integers(1, 16, s) * (64 << 20)
+        reps = np.ones(s, dtype=np.int64)
+        reqs_sr = np.stack([cpu, mem], axis=1).astype(np.int64)
+        alloc_rn = np.stack([snap.alloc_cpu_milli, snap.alloc_mem_bytes])
+        used_rn = np.stack(
+            [snap.used_cpu_req_milli, snap.used_mem_req_bytes]
+        )
+        exact2, _ = sweep_grid(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, snap.healthy, cpu, mem, reps, mode="strict",
+        )
+        scales = multi_row_scales(alloc_rn, used_rn, reqs_sr)
+        totals, _ = sweep_pallas_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            reqs_sr, reps, scales, mode="strict", node_mask=snap.healthy,
+            interpret=True,
+        )
+        np.testing.assert_array_equal(totals, np.asarray(exact2))
+
+
+class TestAuto:
+    def test_auto_fused_when_eligible(self):
+        snap, alloc_rn, used_rn, reqs_sr, reps = _workload(400, 24, seed=11)
+        totals, sched, kernel = sweep_multi_auto(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict", interpret=True,
+        )
+        assert kernel.startswith("pallas_multi_")
+        exact = sweep_grid_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict",
+        )
+        np.testing.assert_array_equal(totals, np.asarray(exact[0]))
+
+    def test_auto_shared_mask_fused(self):
+        snap, alloc_rn, used_rn, reqs_sr, reps = _workload(400, 24, seed=12)
+        rng = np.random.default_rng(13)
+        mask = rng.random(400) < 0.6
+        totals, _, kernel = sweep_multi_auto(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict", node_masks=mask,
+            interpret=True,
+        )
+        assert kernel.startswith("pallas_multi_")
+        exact = sweep_grid_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict", node_masks=mask,
+        )
+        np.testing.assert_array_equal(totals, np.asarray(exact[0]))
+
+    def test_auto_per_scenario_masks_fall_back(self):
+        snap, alloc_rn, used_rn, reqs_sr, reps = _workload(100, 8, seed=14)
+        rng = np.random.default_rng(15)
+        masks = rng.random((8, 100)) < 0.6
+        totals, _, kernel = sweep_multi_auto(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict", node_masks=masks,
+            interpret=True,
+        )
+        assert kernel == "xla_int64_multi"
+        exact = sweep_grid_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict", node_masks=masks,
+        )
+        np.testing.assert_array_equal(totals, np.asarray(exact[0]))
+
+    def test_auto_max_per_node_falls_back(self):
+        snap, alloc_rn, used_rn, reqs_sr, reps = _workload(100, 8, seed=16)
+        _, _, kernel = sweep_multi_auto(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict", max_per_node=2,
+            interpret=True,
+        )
+        assert kernel == "xla_int64_multi"
+
+    def test_auto_ineligible_falls_back(self):
+        snap, alloc_rn, used_rn, reqs_sr, reps = _workload(100, 8, seed=17)
+        alloc_rn[1, 0] += 1  # de-quantize -> row can't fit int32
+        totals, _, kernel = sweep_multi_auto(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict", interpret=True,
+        )
+        assert kernel == "xla_int64_multi"
+        exact = sweep_grid_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict",
+        )
+        np.testing.assert_array_equal(totals, np.asarray(exact[0]))
+
+    def test_force_exact(self):
+        snap, alloc_rn, used_rn, reqs_sr, reps = _workload(100, 8, seed=18)
+        _, _, kernel = sweep_multi_auto(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, reps, mode="strict", force_exact=True,
+            interpret=True,
+        )
+        assert kernel == "xla_int64_multi"
