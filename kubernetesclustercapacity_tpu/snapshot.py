@@ -193,18 +193,129 @@ def snapshot_from_fixture(
 
 
 def _pack_reference(fixture: dict) -> ClusterSnapshot:
-    """Reference-semantics packing, built on the oracle's own primitives.
+    """Reference-semantics packing — columnar, bit-exact vs. the oracle.
 
     Phantom nodes (unhealthy → zero-valued, ``ClusterCapacity.go:221-226``)
     keep their zero allocatables AND accumulate usage from pods with an empty
     ``nodeName`` — exactly what the degenerate field selector matches (Q4).
+
+    Same intern-code/scatter-add technique as :func:`_pack_strict`, with the
+    reference codecs in the lookup tables: one pod walk collects per
+    container the interned cpu/mem strings plus a node-NAME group code; each
+    distinct string parses once (uint64 cpu codec / ``Quantity.Value()``
+    memory, both stored as int64 bit patterns); per-name usage totals are
+    ``np.add.at`` scatter-adds whose int64 wraparound IS Go's mod-2^64
+    uint64/int64 running-sum wrap (modular addition commutes, so numpy's
+    accumulation order matching the oracle's is not required for equality);
+    rows then gather their name's totals — rows sharing a name (phantom
+    ``""`` rows, duplicate node names) get identical sums exactly as the
+    oracle's per-row walk produces.  Pinned equal to the row-wise walk by
+    ``tests/test_snapshot.py::TestReferenceColumnarParity``.
     """
+    nodes = _oracle.healthy_nodes(fixture)
+    raw_nodes = fixture.get("nodes", [])
+    n = len(nodes)
+    names = [v.name for v in nodes]
+    labels = [raw.get("labels", {}) for raw in raw_nodes]
+    taints = [raw.get("taints", []) for raw in raw_nodes]
+
+    snap = _empty_arrays(n)
+    if n:
+        snap["alloc_cpu_milli"] = np.fromiter(
+            (_clamp_i64(v.allocatable_cpu) for v in nodes), np.int64, n
+        )
+        snap["alloc_mem_bytes"] = np.fromiter(
+            (_clamp_i64(v.allocatable_memory) for v in nodes), np.int64, n
+        )
+        snap["alloc_pods"] = np.fromiter(
+            (v.allocatable_pods for v in nodes), np.int64, n
+        )
+        # Phantom rows (unhealthy → zero-valued node) carry the empty name.
+        snap["healthy"] = np.fromiter(
+            (bool(nm) for nm in names), np.bool_, n
+        )
+
+    # -- columnar pod walk (the ΣP hot path) --
+    # Two intern tables: cpu strings are fetched with the rowwise walk's
+    # own `.get("cpu", "0")` default, so an explicit-null cpu reaches the
+    # codec and raises exactly as the per-row oracle does; memory seeds the
+    # None→0 slot (absent or null memory is Value() 0 on both paths).
+    cpu_strings, cpu_code = _interner(seed_none=False)
+    mem_strings, mem_code = _interner()
+
+    name_gid: dict[str, int] = {}
+    pod_gids: list[int] = []  # per surviving pod: its name group
+    c_gids: list[int] = []  # per container: its pod's name group
+    c_cols: tuple[list[int], ...] = ([], [], [], [])  # creq, clim, mreq, mlim
+    for pod in fixture.get("pods", []):
+        if not _oracle._survives_field_selector(pod):
+            continue
+        gid = name_gid.setdefault(pod.get("nodeName", ""), len(name_gid))
+        pod_gids.append(gid)
+        for c in pod.get("containers", []):
+            res = c.get("resources", {})
+            req, lim = res.get("requests", {}), res.get("limits", {})
+            c_gids.append(gid)
+            c_cols[0].append(cpu_code(req.get("cpu", "0")))
+            c_cols[1].append(cpu_code(lim.get("cpu", "0")))
+            c_cols[2].append(mem_code(req.get("memory")))
+            c_cols[3].append(mem_code(lim.get("memory")))
+
+    if name_gid and n:
+        lut_cpu = np.fromiter(
+            (
+                _clamp_i64(_q.cpu_to_milli_reference(s))
+                for s in cpu_strings
+            ),
+            np.int64, len(cpu_strings),
+        )
+        lut_mem = np.fromiter(
+            (_clamp_i64(_oracle._mem_value(s)) for s in mem_strings),
+            np.int64, len(mem_strings),
+        )
+        g = len(name_gid)
+        by_name = {
+            k: np.zeros(g, dtype=np.int64)
+            for k in ("creq", "clim", "mreq", "mlim", "count")
+        }
+        np.add.at(by_name["count"], np.asarray(pod_gids, np.int64), 1)
+        cg = np.asarray(c_gids, np.int64)
+        for key, col, lut in (
+            ("creq", 0, lut_cpu),
+            ("clim", 1, lut_cpu),
+            ("mreq", 2, lut_mem),
+            ("mlim", 3, lut_mem),
+        ):
+            np.add.at(
+                by_name[key], cg, lut[np.asarray(c_cols[col], np.int64)]
+            )
+        row_gid = np.fromiter(
+            (name_gid.get(nm, -1) for nm in names), np.int64, n
+        )
+        hit = row_gid >= 0
+        safe = np.where(hit, row_gid, 0)
+        for field_name, key in (
+            ("used_cpu_req_milli", "creq"),
+            ("used_cpu_lim_milli", "clim"),
+            ("used_mem_req_bytes", "mreq"),
+            ("used_mem_lim_bytes", "mlim"),
+            ("pods_count", "count"),
+        ):
+            snap[field_name] = np.where(hit, by_name[key][safe], 0)
+
+    return ClusterSnapshot(
+        names=names, semantics="reference", labels=labels, taints=taints, **snap
+    )
+
+
+def _pack_reference_rowwise(fixture: dict) -> ClusterSnapshot:
+    """The original per-row oracle walk — kept as the parity comparator for
+    the columnar packer (and as executable documentation of the per-node
+    semantics the store's incremental updates follow, ``store.py``)."""
     nodes = _oracle.healthy_nodes(fixture)
     pods_by_node = _oracle.pods_by_node_index(fixture)
 
     n = len(nodes)
-    # Row tuples first, one bulk np.array at the end: per-element numpy
-    # writes would cost ~1µs × 8 columns × N on the 10k-node path.
     rows = []
     names, labels, taints = [], [], []
     raw_nodes = fixture.get("nodes", [])
@@ -243,7 +354,6 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
             mat.T.copy(),
         )
     )
-    # Phantom rows (unhealthy → zero-valued node) carry the empty name (Q4).
     snap["healthy"] = np.array([bool(nm) for nm in names], dtype=np.bool_)
 
     return ClusterSnapshot(
@@ -289,16 +399,7 @@ def _pack_strict(
     # ~5µs/pod on dict building and memoized-parse call overhead;
     # semantics are pinned equal by
     # ``tests/test_snapshot.py::TestStrictColumnarParity``.
-    intern: dict = {None: 0}
-    strings: list = [None]
-
-    def code(s) -> int:
-        try:
-            return intern[s]
-        except KeyError:
-            intern[s] = c = len(strings)
-            strings.append(s)
-            return c
+    strings, code = _interner()
 
     pod_nodes: list[int] = []
     c_pod: list[int] = []  # container -> pod ordinal
@@ -457,6 +558,30 @@ def _strict_parse(s: str | None, *, milli: bool = False) -> int:
     except _q.QuantityParseError:
         return 0
     return q.milli_value() if milli else q.value()
+
+
+def _interner(seed_none: bool = True):
+    """String intern table for columnar packing: ``(strings, code)``.
+
+    ``code(s)`` returns a stable small integer per distinct value;
+    ``strings[code]`` recovers it for one-parse-per-distinct-string lookup
+    tables.  ``seed_none`` reserves slot 0 for ``None`` (absent value).
+    """
+    intern: dict = {}
+    strings: list = []
+    if seed_none:
+        intern[None] = 0
+        strings.append(None)
+
+    def code(s) -> int:
+        try:
+            return intern[s]
+        except KeyError:
+            intern[s] = c = len(strings)
+            strings.append(s)
+            return c
+
+    return strings, code
 
 
 def _clamp_i64(u: int) -> int:

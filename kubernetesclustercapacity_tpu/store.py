@@ -99,6 +99,8 @@ class ClusterStore:
             self._pods_by_node.setdefault(p.get("nodeName", ""), {})[key] = p
 
         n = len(self._nodes)
+        # Columns may carry spare capacity beyond the live row count (rows
+        # ADD by amortized doubling); every read slices to n_nodes.
         self._cols = {c: np.zeros(n, dtype=np.int64) for c in _INT_COLS}
         self._healthy = np.zeros(n, dtype=np.bool_)
         self._ext = {
@@ -106,8 +108,15 @@ class ClusterStore:
             for r in self.extended_resources
         }
         # The name a row *matches pods by*: the raw name in strict mode, the
-        # NodeView name in reference mode ("" for phantom rows, Q4).
+        # NodeView name in reference mode ("" for phantom rows, Q4) — plus
+        # inverted indices so a pod event touches its rows in O(1), not via
+        # an O(N) name scan (the round-3 churn bottleneck), and node events
+        # locate rows by raw name the same way.
         self._view_names: list[str] = [""] * n
+        self._rows_by_view: dict[str, set[int]] = {"": set(range(n))}
+        self._rows_by_raw: dict[str, set[int]] = {}
+        for i, node in enumerate(self._nodes):
+            self._rows_by_raw.setdefault(node.get("name", ""), set()).add(i)
         for i in range(n):
             self._recompute_row(i)
 
@@ -117,7 +126,7 @@ class ClusterStore:
         return len(self._nodes)
 
     def has_node(self, name: str) -> bool:
-        return any(n.get("name", "") == name for n in self._nodes)
+        return bool(self._rows_by_raw.get(name))
 
     def has_pod(self, namespace: str, name: str) -> bool:
         return (namespace, name) in self._pods
@@ -132,16 +141,18 @@ class ClusterStore:
         """An immutable-by-copy packed snapshot of the current state."""
         # Reference mode reports the NodeView name — "" for phantom rows,
         # exactly what the Go slice holds (Q4); strict reports raw names.
+        n = len(self._nodes)
         return ClusterSnapshot(
             names=list(self._view_names),
             semantics=self.semantics,
             extended={
-                r: (a.copy(), u.copy()) for r, (a, u) in self._ext.items()
+                r: (a[:n].copy(), u[:n].copy())
+                for r, (a, u) in self._ext.items()
             },
-            labels=[n.get("labels", {}) for n in self._nodes],
-            taints=[n.get("taints", []) for n in self._nodes],
-            healthy=self._healthy.copy(),
-            **{c: self._cols[c].copy() for c in _INT_COLS},
+            labels=[node.get("labels", {}) for node in self._nodes],
+            taints=[node.get("taints", []) for node in self._nodes],
+            healthy=self._healthy[:n].copy(),
+            **{c: self._cols[c][:n].copy() for c in _INT_COLS},
         )
 
     def apply(self, events: list[dict]) -> ClusterSnapshot:
@@ -230,12 +241,31 @@ class ClusterStore:
                 self._recompute_row(i)
 
     def _rows_matching(self, node_name: str) -> list[int]:
-        """Rows whose pod-match name equals ``node_name``.
+        """Rows whose pod-match name equals ``node_name`` (indexed, O(1)).
 
         In reference mode every phantom row matches ``""`` — an orphan-pod
         event touches all of them (the degenerate field selector, Q4).
         """
-        return [i for i, v in enumerate(self._view_names) if v == node_name]
+        return list(self._rows_by_view.get(node_name, ()))
+
+    def _set_view_name(self, i: int, name: str) -> None:
+        """Row view-name write-through that keeps the inverted index true."""
+        old = self._view_names[i]
+        if old == name:
+            return
+        rows = self._rows_by_view.get(old)
+        if rows is not None:
+            rows.discard(i)
+        self._rows_by_view.setdefault(name, set()).add(i)
+        self._view_names[i] = name
+
+    def _rebuild_indices(self) -> None:
+        """Full index rebuild — row indices shifted (node DELETE compaction)."""
+        self._rows_by_view = {}
+        self._rows_by_raw = {}
+        for i, (node, view) in enumerate(zip(self._nodes, self._view_names)):
+            self._rows_by_raw.setdefault(node.get("name", ""), set()).add(i)
+            self._rows_by_view.setdefault(view, set()).add(i)
 
     # -- nodes -------------------------------------------------------------
     def _apply_node(self, etype: str, node: dict) -> None:
@@ -244,13 +274,15 @@ class ClusterStore:
             self._validate_node(node)
             if self.semantics == "strict" and not name:
                 raise StoreError("strict mode requires non-empty node names")
-        idx = [i for i, n in enumerate(self._nodes) if n.get("name", "") == name]
+        idx = sorted(self._rows_by_raw.get(name, ()))
         if etype == "ADDED":
             if idx:
                 raise StoreError(f"node {name!r} already exists")
             self._append_row()
             self._nodes.append(node)
-            self._recompute_row(len(self._nodes) - 1)
+            i = len(self._nodes) - 1
+            self._rows_by_raw.setdefault(name, set()).add(i)
+            self._recompute_row(i)
         elif etype == "MODIFIED":
             if not idx:
                 raise StoreError(f"node {name!r} not found")
@@ -260,28 +292,46 @@ class ClusterStore:
         else:  # DELETED
             if not idx:
                 raise StoreError(f"node {name!r} not found")
-            keep = np.ones(len(self._nodes), dtype=bool)
+            n = len(self._nodes)
+            keep = np.ones(n, dtype=bool)
             keep[idx] = False
             for c in _INT_COLS:
-                self._cols[c] = self._cols[c][keep]
-            self._healthy = self._healthy[keep]
+                self._cols[c] = self._cols[c][:n][keep]
+            self._healthy = self._healthy[:n][keep]
             self._ext = {
-                r: (a[keep], u[keep]) for r, (a, u) in self._ext.items()
+                r: (a[:n][keep], u[:n][keep])
+                for r, (a, u) in self._ext.items()
             }
-            self._nodes = [n for i, n in enumerate(self._nodes) if keep[i]]
+            self._nodes = [nd for i, nd in enumerate(self._nodes) if keep[i]]
             self._view_names = [
                 v for i, v in enumerate(self._view_names) if keep[i]
             ]
+            self._rebuild_indices()
 
     def _append_row(self) -> None:
-        for c in _INT_COLS:
-            self._cols[c] = np.append(self._cols[c], np.int64(0))
-        self._healthy = np.append(self._healthy, False)
-        self._ext = {
-            r: (np.append(a, np.int64(0)), np.append(u, np.int64(0)))
-            for r, (a, u) in self._ext.items()
-        }
+        """Grow columns by amortized doubling (per-ADD ``np.append`` was
+        O(N) — quadratic on relist-scale joins); the new row starts zeroed
+        with view name ``""`` and is recomputed by the caller."""
+        n = len(self._nodes)
+        cap = self._healthy.shape[0]
+        if n >= cap:
+            pad = max(16, cap)
+            self._cols = {
+                c: np.concatenate([a, np.zeros(pad, a.dtype)])
+                for c, a in self._cols.items()
+            }
+            self._healthy = np.concatenate(
+                [self._healthy, np.zeros(pad, np.bool_)]
+            )
+            self._ext = {
+                r: (
+                    np.concatenate([a, np.zeros(pad, np.int64)]),
+                    np.concatenate([u, np.zeros(pad, np.int64)]),
+                )
+                for r, (a, u) in self._ext.items()
+            }
         self._view_names.append("")
+        self._rows_by_view.setdefault("", set()).add(n)
 
     # -- row packing (the single source of per-row truth) ------------------
     def _node_pods(self, match_name: str) -> list[dict]:
@@ -315,7 +365,7 @@ class ClusterStore:
         c["used_mem_lim_bytes"][i] = mem_lim
         c["pods_count"][i] = len(pods)
         self._healthy[i] = bool(view.name)
-        self._view_names[i] = view.name
+        self._set_view_name(i, view.name)
 
     def _recompute_row_strict(self, i: int, raw: dict) -> None:
         name = raw.get("name", "")
@@ -325,7 +375,7 @@ class ClusterStore:
         c["alloc_mem_bytes"][i] = _strict_parse(allocatable.get("memory"))
         c["alloc_pods"][i] = _strict_parse(allocatable.get("pods"))
         self._healthy[i] = _strict_healthy(raw.get("conditions", []))
-        self._view_names[i] = name
+        self._set_view_name(i, name)
 
         totals = dict.fromkeys(
             ("cpu_req", "cpu_lim", "mem_req", "mem_lim", "count"), 0

@@ -264,3 +264,127 @@ class TestStrictColumnarParity:
         np.testing.assert_array_equal(snap.pods_count, want["count"])
         np.testing.assert_array_equal(snap.extended["nvidia.com/gpu"][1],
                                       want["gpu"])
+
+
+class TestReferenceColumnarParity:
+    """The columnar reference pack must equal the per-row oracle walk
+    (kept as ``_pack_reference_rowwise``) on adversarial fixtures — wrap
+    arithmetic, phantom rows, duplicate names, orphan pods, parse-fail→0."""
+
+    def _assert_equal(self, fx):
+        from kubernetesclustercapacity_tpu.snapshot import (
+            _pack_reference,
+            _pack_reference_rowwise,
+        )
+
+        got = _pack_reference(fx)
+        want = _pack_reference_rowwise(fx)
+        assert got.names == want.names
+        for f in ("alloc_cpu_milli", "alloc_mem_bytes", "alloc_pods",
+                  "used_cpu_req_milli", "used_cpu_lim_milli",
+                  "used_mem_req_bytes", "used_mem_lim_bytes",
+                  "pods_count", "healthy"):
+            np.testing.assert_array_equal(
+                getattr(got, f), getattr(want, f), err_msg=f
+            )
+        assert got.labels == want.labels and got.taints == want.taints
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_randomized_fixture(self, seed):
+        fx = synthetic_fixture(
+            40, seed=seed, unhealthy_frac=0.2, unscheduled_running_pods=3
+        )
+        self._assert_equal(fx)
+
+    def test_adversarial_wrap_dups_and_orphans(self):
+        # Duplicate node names, phantom rows, uint64-wrapping cpu sums,
+        # int64-wrapping memory sums, parse-fail strings, missing dicts.
+        node = {
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "110"},
+            "conditions": [{"type": "c", "status": "False"}] * 4,
+        }
+        bad_node = {
+            "name": "sick",
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "110"},
+            "conditions": [{"type": "c", "status": "True"}] * 4,
+        }
+        fx = {
+            "nodes": [
+                dict(node, name="twin"),
+                dict(node, name="twin"),
+                bad_node,
+                dict(node, name="solo",
+                     labels={"a": "b"}, taints=[{"key": "k"}]),
+            ],
+            "pods": [
+                # uint64 wrap: a negative cpu string wraps through the codec
+                {"name": "w", "namespace": "d", "nodeName": "twin",
+                 "phase": "Running",
+                 "containers": [
+                     {"resources": {"requests": {"cpu": "-5"},
+                                    "limits": {"memory": "1Ei"}}},
+                     {"resources": {}},
+                 ]},
+                # orphan pod: matches every phantom row (sick -> "")
+                {"name": "o", "namespace": "d", "nodeName": "",
+                 "phase": "Weird",
+                 "containers": [
+                     {"resources": {"requests": {"cpu": "bogus",
+                                                 "memory": "64Mi"},
+                                    "limits": {}}}]},
+                # pod on a nonexistent node: counted nowhere
+                {"name": "x", "namespace": "d", "nodeName": "ghost",
+                 "phase": "Running",
+                 "containers": [
+                     {"resources": {"requests": {"cpu": "1"}, "limits": {}}}]},
+                # terminated: excluded by the field selector
+                {"name": "t", "namespace": "d", "nodeName": "solo",
+                 "phase": "Succeeded",
+                 "containers": [
+                     {"resources": {"requests": {"cpu": "2"}, "limits": {}}}]},
+            ],
+        }
+        self._assert_equal(fx)
+        got = snapshot_from_fixture(fx, semantics="reference")
+        # duplicate rows carry identical sums; the orphan landed on phantom
+        assert got.used_cpu_req_milli[0] == got.used_cpu_req_milli[1]
+        assert got.pods_count[2] == 1 and not got.healthy[2]
+
+    def test_empty_fixture(self):
+        self._assert_equal({"nodes": [], "pods": []})
+
+    def test_explicit_null_cpu_raises_like_rowwise(self):
+        # An explicit JSON null cpu reaches the reference codec on both
+        # paths (the rowwise walk's `.get("cpu", "0")` default only covers
+        # ABSENT keys); null memory is Value() 0 on both.
+        from kubernetesclustercapacity_tpu.snapshot import (
+            _pack_reference,
+            _pack_reference_rowwise,
+        )
+
+        node = {
+            "name": "n0",
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "10"},
+            "conditions": [{"type": "c", "status": "False"}] * 4,
+        }
+        fx_null_cpu = {
+            "nodes": [node],
+            "pods": [{"name": "p", "namespace": "d", "nodeName": "n0",
+                      "phase": "Running",
+                      "containers": [{"resources":
+                                      {"requests": {"cpu": None},
+                                       "limits": {}}}]}],
+        }
+        with pytest.raises(AttributeError):
+            _pack_reference_rowwise(fx_null_cpu)
+        with pytest.raises(AttributeError):
+            _pack_reference(fx_null_cpu)
+        fx_null_mem = {
+            "nodes": [node],
+            "pods": [{"name": "p", "namespace": "d", "nodeName": "n0",
+                      "phase": "Running",
+                      "containers": [{"resources":
+                                      {"requests": {"memory": None},
+                                       "limits": {}}}]}],
+        }
+        self._assert_equal(fx_null_mem)

@@ -306,3 +306,57 @@ class TestStrictNameValidation:
         store = ClusterStore(fixture, semantics="reference")
         assert store.n_nodes == 2
         assert_matches_repack(store)
+
+
+class TestScaleAndIndices:
+    """The O(1)-index + amortized-growth paths (round-4 churn fix)."""
+
+    @pytest.mark.parametrize("semantics", ["reference", "strict"])
+    def test_many_adds_then_deletes_match_repack(self, semantics):
+        # Growth crosses several capacity doublings; deletes compact and
+        # rebuild the inverted indices; the repack invariant must hold
+        # throughout.
+        fx = synthetic_fixture(3, seed=5)
+        store = ClusterStore(fx, semantics=semantics)
+        for k in range(70):
+            store.apply_event(
+                {"type": "ADDED", "kind": "Node",
+                 "object": _mk_node(f"grow-{k}", healthy=(k % 3 != 0))}
+            )
+            if k % 10 == 9:
+                assert_matches_repack(store)
+        for k in range(0, 70, 2):
+            store.apply_event(
+                {"type": "DELETED", "kind": "Node",
+                 "object": {"name": f"grow-{k}"}}
+            )
+        assert_matches_repack(store)
+        # Post-compaction, pod events must land on the re-indexed rows.
+        store.apply_event(
+            {"type": "ADDED", "kind": "Pod",
+             "object": _mk_pod("late", "grow-1")}
+        )
+        assert_matches_repack(store)
+        assert store.has_node("grow-1") and not store.has_node("grow-0")
+
+    def test_health_flip_moves_view_index_reference(self):
+        # A reference-mode health flip changes the row's view name ("" for
+        # phantom): pod matching must follow the flip through the index.
+        fx = {"nodes": [_mk_node("flip")], "pods": []}
+        store = ClusterStore(fx, semantics="reference")
+        store.apply_event(
+            {"type": "MODIFIED", "kind": "Node",
+             "object": _mk_node("flip", healthy=False)}
+        )
+        # Now phantom: an orphan pod (nodeName "") must touch the row.
+        store.apply_event(
+            {"type": "ADDED", "kind": "Pod", "object": _mk_pod("orphan", "")}
+        )
+        assert_matches_repack(store)
+        assert store.snapshot().pods_count[0] == 1
+        store.apply_event(
+            {"type": "MODIFIED", "kind": "Node",
+             "object": _mk_node("flip", healthy=True)}
+        )
+        assert_matches_repack(store)
+        assert store.snapshot().pods_count[0] == 0
