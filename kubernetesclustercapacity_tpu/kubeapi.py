@@ -19,6 +19,12 @@ Auth support: bearer token (inline or ``tokenFile``), client certificates
 credential plugins (the EKS/GKE pattern).  TLS verifies against the
 cluster's ``certificate-authority(-data)`` unless
 ``insecure-skip-tls-verify`` is set.
+
+Known limits vs client-go's stack (recorded in PARITY.md "Architecture
+divergences"): no OIDC token *refresh* (a static OIDC id-token in
+``token`` works), no legacy azure/gcp auth-provider stanzas (deprecated
+upstream since client-go v1.26), no ``HTTP(S)_PROXY`` tunneling.  Install
+the optional ``kubernetes`` package to regain those paths.
 """
 
 from __future__ import annotations
