@@ -12,8 +12,15 @@ __all__ = ["CapacityClient"]
 class CapacityClient:
     """Connect once, issue many requests (context-manager friendly)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 7077) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 7077,
+        *,
+        token: str | None = None,
+    ) -> None:
         self._sock = socket.create_connection((host, port))
+        self._token = token
 
     def __enter__(self) -> "CapacityClient":
         return self
@@ -25,6 +32,8 @@ class CapacityClient:
         self._sock.close()
 
     def call(self, op: str, **params):
+        if self._token is not None:
+            params.setdefault("token", self._token)
         protocol.send_msg(self._sock, {"op": op, **params})
         resp = protocol.recv_msg(self._sock)
         if resp is None:

@@ -64,7 +64,21 @@ class _ThreadingServer(socketserver.ThreadingTCPServer):
 
 
 class CapacityServer:
-    """Serve capacity queries for one snapshot over the framed-JSON protocol."""
+    """Serve capacity queries for one snapshot over the framed-JSON protocol.
+
+    Guardrails (all opt-in, preserving the localhost-bench default):
+
+    * ``auth_token`` — when set, every op except ``ping`` must carry a
+      matching ``token`` field (compared constant-time); required before
+      exposing the port beyond localhost, since ``reload``/``update``
+      mutate served state.
+    * ``max_inflight`` — cap on concurrently-executing compute ops
+      (fit/sweep/place); excess requests wait up to ``inflight_wait_s``
+      then fail with "server busy" instead of queuing unboundedly.
+    * ``reload_roots`` — when non-empty, ``reload`` paths must resolve
+      (symlinks followed) under one of these directories; otherwise any
+      server-readable path can be probed through reload errors.
+    """
 
     def __init__(
         self,
@@ -73,12 +87,25 @@ class CapacityServer:
         host: str = "127.0.0.1",
         port: int = 0,
         fixture: dict | None = None,
+        auth_token: str | None = None,
+        max_inflight: int = 8,
+        inflight_wait_s: float = 30.0,
+        reload_roots: tuple[str, ...] = (),
     ) -> None:
+        import os
+
         self.snapshot = snapshot
         self.fixture = fixture
         self._store = None  # lazy ClusterStore, built on first update op
         self._fixture_dirty = False  # fixture lags the store until needed
         self._implicit_mask = _implicit_taint_mask(snapshot)
+        self._auth_token = auth_token
+        self._max_inflight = max(1, int(max_inflight))
+        self._inflight = threading.Semaphore(self._max_inflight)
+        self._inflight_wait_s = float(inflight_wait_s)
+        self._reload_roots = tuple(
+            os.path.realpath(r) for r in reload_roots
+        )
         self._lock = threading.Lock()
         self._tcp = _ThreadingServer((host, port), _Handler)
         self._tcp.capacity_server = self  # type: ignore[attr-defined]
@@ -106,6 +133,32 @@ class CapacityServer:
         op = msg.get("op")
         if op == "ping":
             return "pong"
+        if self._auth_token is not None:
+            import hmac
+
+            token = msg.get("token")
+            # Compare as bytes: compare_digest on str raises TypeError for
+            # non-ASCII, which would lock out a correct non-ASCII token.
+            if not isinstance(token, str) or not hmac.compare_digest(
+                token.encode(), self._auth_token.encode()
+            ):
+                raise PermissionError("missing or invalid auth token")
+        if op in ("fit", "sweep", "place"):
+            # Bounded concurrency for the compute ops: each holds device
+            # dispatch + host packing; unbounded fan-in from one noisy
+            # client must not starve the box.
+            if not self._inflight.acquire(timeout=self._inflight_wait_s):
+                raise RuntimeError(
+                    f"server busy: {self._max_inflight} compute requests "
+                    "already in flight"
+                )
+            try:
+                return self._dispatch_inner(op, msg)
+            finally:
+                self._inflight.release()
+        return self._dispatch_inner(op, msg)
+
+    def _dispatch_inner(self, op: str, msg: dict) -> dict | str:
         # Snapshot the (snapshot, fixture) pair once under the lock so a
         # concurrent reload/update can never produce a torn read (fits
         # computed on the new snapshot, report rendered against the old
@@ -423,9 +476,25 @@ class CapacityServer:
             self._implicit_mask = mask
 
     def _op_reload(self, msg: dict) -> dict:
-        new_fixture, new_snap, _ = resolve_source(
-            msg["path"], msg.get("semantics")
-        )
+        path = msg["path"]
+        if self._reload_roots:
+            import os
+
+            real = os.path.realpath(path)
+            inside = False
+            for root in self._reload_roots:
+                try:
+                    inside = os.path.commonpath([real, root]) == root
+                except ValueError:  # mixed absolute/relative or drives
+                    inside = False
+                if inside:
+                    break
+            if not inside:
+                raise PermissionError(
+                    f"reload path {path!r} outside the allowed roots"
+                )
+            path = real
+        new_fixture, new_snap, _ = resolve_source(path, msg.get("semantics"))
         self.replace_snapshot(new_snap, new_fixture)
         return {"nodes": new_snap.n_nodes, "semantics": new_snap.semantics}
 
@@ -488,7 +557,34 @@ def main(argv=None) -> int:
     p.add_argument("-coalesce-ms", type=int, default=100, dest="coalesce_ms",
                    help="min interval between snapshot repacks under "
                         "-follow churn (0 = repack on every event)")
+    p.add_argument("-auth-token-file", default=None, dest="auth_token_file",
+                   help="file holding the shared bearer token; when set (or "
+                        "$KCCAP_AUTH_TOKEN is), every op except ping must "
+                        "carry it")
+    p.add_argument("-max-inflight", type=int, default=8, dest="max_inflight",
+                   help="max concurrently-executing fit/sweep/place requests")
+    p.add_argument("-reload-root", action="append", default=[],
+                   dest="reload_roots", metavar="DIR",
+                   help="restrict reload paths to this directory "
+                        "(repeatable; default: unrestricted)")
     args = p.parse_args(argv)
+
+    import os as _os
+
+    # `or None`: an empty-but-set env var must not enable auth with an
+    # empty token (which would lock out every client).
+    auth_token = _os.environ.get("KCCAP_AUTH_TOKEN") or None
+    if args.auth_token_file:
+        try:
+            with open(args.auth_token_file, encoding="utf-8") as fh:
+                auth_token = fh.read().strip()
+        except OSError as e:
+            print(f"ERROR : cannot read auth token file: {e}",
+                  file=sys.stderr)
+            return 1
+        if not auth_token:
+            print("ERROR : auth token file is empty", file=sys.stderr)
+            return 1
 
     follower = None
     try:
@@ -507,7 +603,9 @@ def main(argv=None) -> int:
         print(f"ERROR : {e}", file=sys.stderr)
         return 1
     server = CapacityServer(
-        snap, host=args.host, port=args.port, fixture=fixture
+        snap, host=args.host, port=args.port, fixture=fixture,
+        auth_token=auth_token, max_inflight=args.max_inflight,
+        reload_roots=tuple(args.reload_roots),
     )
     coalescer = None
     if follower is not None:
