@@ -9,7 +9,11 @@ Axis semantics:
   partial per-scenario replica sums over its node shard; one int64 ``psum``
   per sweep reduces them over ICI.  Use when a single cluster snapshot is too
   big for one device's HBM (≥ millions of nodes) or to cut per-device work
-  for latency.
+  for latency.  Proven at that scale: ``tests/test_parallel.py::
+  TestMillionNodeScale`` pins both sharded paths bit-exact on a 1M-node
+  snapshot — shard_map over a pure node-axis (1×8) mesh, GSPMD over a
+  mixed (2×4) mesh — and ``bench.py`` records the single-chip 1M-node
+  sweep (``nodes_1m_per_sweep_ms``).
 
 For the 10k-node × 1k-scenario north-star on a v4-8, scenario-only sharding
 is optimal (zero collectives); the node axis exists for the scale beyond.

@@ -187,3 +187,41 @@ class TestMultihostDCN:
         for i, (p, (out, err)) in enumerate(zip(procs, results)):
             assert p.returncode == 0, f"process {i} failed:\n{err}"
             assert f"OK {i}" in out
+
+
+class TestMillionNodeScale:
+    """The node axis exists for "≥ millions of nodes" (parallel/mesh.py):
+    prove the sharded paths stay bit-exact at that scale against the
+    single-device kernel — shard_map over a pure node-axis (1x8) mesh,
+    GSPMD over a mixed (2x4) mesh.  (The single-chip 1M perf number lives
+    in bench.py as nodes_1m_per_sweep_ms.)"""
+
+    @pytest.fixture(scope="class")
+    def snap1m(self):
+        return synthetic_snapshot(1_000_003, seed=31)  # prime: pads node axis
+
+    @pytest.fixture(scope="class")
+    def grid1m(self):
+        return random_scenario_grid(8, seed=32)
+
+    @pytest.fixture(scope="class")
+    def baseline1m(self, snap1m, grid1m):
+        return sweep_snapshot(snap1m, grid1m)
+
+    def test_shard_map_node_axis_1m(self, snap1m, grid1m, baseline1m):
+        plan = make_mesh(1, 8)
+        totals, sched = sweep_shard_map(
+            plan, _arrays(snap1m), grid1m.cpu_request_milli,
+            grid1m.mem_request_bytes, grid1m.replicas,
+        )
+        np.testing.assert_array_equal(totals, baseline1m[0])
+        np.testing.assert_array_equal(sched, baseline1m[1])
+
+    def test_gspmd_node_axis_1m(self, snap1m, grid1m, baseline1m):
+        plan = make_mesh(2, 4)
+        totals, sched = sweep_gspmd(
+            plan, _arrays(snap1m), grid1m.cpu_request_milli,
+            grid1m.mem_request_bytes, grid1m.replicas,
+        )
+        np.testing.assert_array_equal(totals, baseline1m[0])
+        np.testing.assert_array_equal(sched, baseline1m[1])
