@@ -61,6 +61,7 @@ from kubernetesclustercapacity_tpu.snapshot import (  # noqa: E402,F401
     synthetic_snapshot,
 )
 from kubernetesclustercapacity_tpu.scenario import (  # noqa: E402,F401
+    MultiResourceGrid,
     Scenario,
     ScenarioGrid,
     random_scenario_grid,

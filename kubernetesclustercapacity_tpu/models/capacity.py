@@ -22,7 +22,11 @@ from kubernetesclustercapacity_tpu.ops.fit import (
     fit_per_node,
     fit_per_node_multi,
 )
-from kubernetesclustercapacity_tpu.scenario import Scenario, ScenarioGrid
+from kubernetesclustercapacity_tpu.scenario import (
+    MultiResourceGrid,
+    Scenario,
+    ScenarioGrid,
+)
 from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
 
 __all__ = ["PodSpec", "CapacityModel", "CapacityResult", "PlacementResult"]
@@ -394,5 +398,57 @@ class CapacityModel:
             grid.replicas,
             mode=self.mode,
             node_mask=mask,
+        )
+        return np.asarray(totals), np.asarray(sched)
+
+    def sweep_multi(
+        self,
+        grid: MultiResourceGrid,
+        *,
+        tolerations: tuple = (),
+        node_selector: dict | None = None,
+        spread: int | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """R-resource grid sweep (BASELINE config 4) with shared constraints.
+
+        ``grid.resources`` selects snapshot columns (``cpu``/``memory`` plus
+        any :attr:`ClusterSnapshot.extended` names); dispatch goes through
+        :func:`..ops.pallas_multi.sweep_multi_auto` — the fused R-dim
+        Pallas kernel when eligibility is proven, the exact int64 kernel
+        otherwise, bit-exact either way.  The shared mask composes exactly
+        like :meth:`sweep`; ``spread`` caps per-node replicas (forces the
+        exact kernel).
+        """
+        from kubernetesclustercapacity_tpu.ops.pallas_multi import (
+            sweep_multi_auto,
+        )
+
+        grid.validate()
+        snap = self.snapshot
+        shared_spec = PodSpec(
+            cpu_request_milli=1,
+            mem_request_bytes=1,
+            tolerations=tolerations,
+            node_selector=node_selector or {},
+            extended_requests=dict.fromkeys(
+                (r for r in grid.resources if r not in ("cpu", "memory")), 1
+            ),
+        )
+        self._check_extensions(
+            shared_spec.constrained or bool(shared_spec.extended_requests)
+        )
+        mask = self._masks_for(shared_spec)
+        alloc_rn, used_rn = snap.resource_matrix(grid.resources)
+        totals, sched, _ = sweep_multi_auto(
+            alloc_rn,
+            used_rn,
+            snap.alloc_pods,
+            snap.pods_count,
+            snap.healthy,
+            grid.requests,
+            grid.replicas,
+            mode=self.mode,
+            node_masks=mask,
+            max_per_node=spread,
         )
         return np.asarray(totals), np.asarray(sched)

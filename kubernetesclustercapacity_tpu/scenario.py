@@ -24,6 +24,7 @@ from kubernetesclustercapacity_tpu.utils.quantity import (
 __all__ = [
     "Scenario",
     "ScenarioGrid",
+    "MultiResourceGrid",
     "ScenarioError",
     "scenario_from_flags",
     "random_scenario_grid",
@@ -193,6 +194,79 @@ class ScenarioGrid:
             mem_request_bytes=int(self.mem_request_bytes[i]),
             replicas=int(self.replicas[i]),
         )
+
+
+@dataclass(frozen=True)
+class MultiResourceGrid:
+    """An R-resource what-if grid (BASELINE config 4's scenario axis).
+
+    ``resources`` names the request rows in order (``"cpu"`` in millicores,
+    ``"memory"`` in bytes, anything else an extended-resource column of the
+    snapshot, in its native unit); ``requests`` is ``[S, R]`` int64;
+    ``replicas`` is ``[S]``.  The reference can express only the 2-resource
+    case one scenario at a time (``ClusterCapacity.go:57-61``); this is the
+    generalized axis the R-dim kernels sweep.
+    """
+
+    resources: tuple[str, ...]
+    requests: np.ndarray
+    replicas: np.ndarray
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "resources", tuple(self.resources))
+        if len(set(self.resources)) != len(self.resources):
+            # Duplicates would silently alias the same snapshot column
+            # twice (resource_matrix maps by name) — a typo'd grid must
+            # fail loudly, not sweep min-over-duplicate-rows.
+            raise ScenarioError(
+                f"duplicate resource names in {self.resources!r}"
+            )
+        req = np.asarray(self.requests, dtype=np.int64)
+        rep = np.asarray(self.replicas, dtype=np.int64)
+        if req.ndim != 2 or req.shape[1] != len(self.resources):
+            raise ScenarioError(
+                f"requests must be [S, {len(self.resources)}], got {req.shape}"
+            )
+        if rep.shape != (req.shape[0],):
+            raise ScenarioError("replicas must be [S]")
+        object.__setattr__(self, "requests", req)
+        object.__setattr__(self, "replicas", rep)
+
+    @property
+    def size(self) -> int:
+        return int(self.requests.shape[0])
+
+    @classmethod
+    def from_grid(
+        cls, grid: "ScenarioGrid", extended: dict | None = None
+    ) -> "MultiResourceGrid":
+        """Lift a 2-resource grid, optionally adding extended columns
+        (``{resource_name: [S] per-replica requests}``)."""
+        extended = dict(extended or {})
+        names = ("cpu", "memory", *sorted(extended))
+        cols = [grid.cpu_request_milli, grid.mem_request_bytes]
+        for r in names[2:]:
+            col = np.asarray(extended[r], dtype=np.int64)
+            if col.shape != (grid.size,):
+                raise ScenarioError(f"extended column {r!r} must be [S]")
+            cols.append(col)
+        return cls(
+            resources=names,
+            requests=np.stack(cols, axis=1),
+            replicas=grid.replicas,
+        )
+
+    def validate(self) -> None:
+        """cpu/memory must be positive (the reference's zero-request panic,
+        SURVEY §2.4 Q8); extended requests may be 0 = "does not consume";
+        negative anything is rejected."""
+        if (self.requests < 0).any():
+            raise ScenarioError("requests must be >= 0")
+        for i, r in enumerate(self.resources):
+            if r in ("cpu", "memory") and (self.requests[:, i] == 0).any():
+                raise ScenarioError(f"all {r} requests must be > 0")
+        if (self.replicas < 0).any():
+            raise ScenarioError("all replicas must be >= 0")
 
 
 def random_scenario_grid(

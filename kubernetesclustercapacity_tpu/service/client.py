@@ -55,6 +55,16 @@ class CapacityClient:
     def sweep(self, **params) -> dict:
         return self.call("sweep", **params)
 
+    def sweep_multi(self, resources, requests, **params) -> dict:
+        """R-resource grid sweep: ``resources`` row names, ``requests``
+        an ``[S][R]`` matrix in each resource's native unit."""
+        return self.call(
+            "sweep_multi",
+            resources=list(resources),
+            requests=[list(map(int, row)) for row in requests],
+            **params,
+        )
+
     def reload(self, path: str, **params) -> dict:
         return self.call("reload", path=path, **params)
 

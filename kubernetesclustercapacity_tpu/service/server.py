@@ -143,7 +143,7 @@ class CapacityServer:
                 token.encode(), self._auth_token.encode()
             ):
                 raise PermissionError("missing or invalid auth token")
-        if op in ("fit", "sweep", "place"):
+        if op in ("fit", "sweep", "sweep_multi", "place"):
             # Bounded concurrency for the compute ops: each holds device
             # dispatch + host packing; unbounded fan-in from one noisy
             # client must not starve the box.
@@ -192,6 +192,8 @@ class CapacityServer:
             return self._op_fit(msg, snap, fixture, implicit_mask)
         if op == "sweep":
             return self._op_sweep(msg, snap, implicit_mask)
+        if op == "sweep_multi":
+            return self._op_sweep_multi(msg, snap, implicit_mask)
         if op == "place":
             return self._op_place(msg, snap, fixture)
         if op == "reload":
@@ -460,6 +462,50 @@ class CapacityServer:
             "totals": totals.tolist(),
             "schedulable": sched.tolist(),
             "scenarios": grid.size,
+            "kernel": kernel,
+        }
+
+    def _op_sweep_multi(
+        self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
+    ) -> dict:
+        """R-resource grid sweep (config 4): ``resources`` names the rows
+        (cpu milli / memory bytes / extended columns), ``requests`` is the
+        ``[S][R]`` request matrix, ``replicas`` the ``[S]`` targets.  Same
+        implicit-taint-mask policy as the 2-resource sweep."""
+        from kubernetesclustercapacity_tpu.ops.pallas_multi import (
+            sweep_multi_auto,
+        )
+        from kubernetesclustercapacity_tpu.scenario import MultiResourceGrid
+
+        try:
+            grid = MultiResourceGrid(
+                resources=tuple(msg["resources"]),
+                requests=np.asarray(msg["requests"]),
+                replicas=np.asarray(
+                    msg.get("replicas", [1] * len(msg["requests"]))
+                ),
+            )
+            grid.validate()
+            alloc_rn, used_rn = snap.resource_matrix(grid.resources)
+        except (ScenarioError, KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"bad multi-resource grid: {e}") from e
+        totals, sched, kernel = sweep_multi_auto(
+            alloc_rn,
+            used_rn,
+            snap.alloc_pods,
+            snap.pods_count,
+            snap.healthy,
+            grid.requests,
+            grid.replicas,
+            mode=snap.semantics,
+            node_masks=implicit_mask,
+            force_exact=(msg.get("kernel", "auto") == "exact"),
+        )
+        return {
+            "totals": totals.tolist(),
+            "schedulable": sched.tolist(),
+            "scenarios": grid.size,
+            "resources": list(grid.resources),
             "kernel": kernel,
         }
 

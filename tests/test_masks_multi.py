@@ -323,3 +323,83 @@ class TestCapacityModel:
         untol, _ = model.sweep(grid)
         tol, _ = model.sweep(grid, tolerations=({"operator": "Exists"},))
         assert (tol > untol).all()  # control-plane becomes available
+
+
+class TestSweepMulti:
+    """CapacityModel.sweep_multi: the R-resource production sweep surface
+    (config 4) over MultiResourceGrid, auto-dispatching the fused kernel."""
+
+    def _snap(self, n=600, seed=41):
+        fx = synthetic_fixture(n, seed=seed)
+        rng = np.random.default_rng(seed)
+        for node in fx["nodes"]:
+            node["allocatable"]["nvidia.com/gpu"] = str(
+                int(rng.integers(0, 9))
+            )
+        return snapshot_from_fixture(
+            fx, semantics="strict", extended_resources=("nvidia.com/gpu",)
+        )
+
+    def _grid(self, s=24, seed=42):
+        from kubernetesclustercapacity_tpu.scenario import (
+            MultiResourceGrid,
+            random_scenario_grid,
+        )
+
+        rng = np.random.default_rng(seed)
+        base = random_scenario_grid(s, seed=seed)
+        return MultiResourceGrid.from_grid(
+            base, {"nvidia.com/gpu": rng.integers(0, 3, s)}
+        )
+
+    def test_matches_exact_kernel(self):
+        snap = self._snap()
+        grid = self._grid()
+        model = CapacityModel(snap, mode="strict")
+        totals, sched = model.sweep_multi(grid)
+        alloc_rn, used_rn = snap.resource_matrix(grid.resources)
+        exact = sweep_grid_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, grid.requests, grid.replicas, mode="strict",
+        )
+        np.testing.assert_array_equal(totals, np.asarray(exact[0]))
+        np.testing.assert_array_equal(sched, np.asarray(exact[1]))
+
+    def test_constraints_and_spread_compose(self, kind_snap):
+        from kubernetesclustercapacity_tpu.scenario import (
+            MultiResourceGrid,
+        )
+
+        grid = MultiResourceGrid(
+            resources=("cpu", "memory"),
+            requests=np.array([[100, 64 * MIB]], dtype=np.int64),
+            replicas=np.array([1], dtype=np.int64),
+        )
+        model = CapacityModel(kind_snap, mode="strict")
+        unconstrained, _ = model.sweep_multi(grid)
+        selected, _ = model.sweep_multi(
+            grid, node_selector={"kubernetes.io/hostname": "kind-worker"}
+        )
+        assert selected[0] < unconstrained[0]
+        spread1, _ = model.sweep_multi(grid, spread=1)
+        # kind has 3 nodes; control-plane is hard-tainted in strict mode.
+        assert spread1[0] == 2
+
+    def test_grid_validation(self):
+        from kubernetesclustercapacity_tpu.scenario import (
+            MultiResourceGrid,
+            ScenarioError,
+        )
+
+        with pytest.raises(ScenarioError, match="cpu"):
+            MultiResourceGrid(
+                resources=("cpu", "memory"),
+                requests=np.array([[0, MIB]]),
+                replicas=np.array([1]),
+            ).validate()
+        with pytest.raises(ScenarioError, match="requests"):
+            MultiResourceGrid(
+                resources=("cpu", "memory"),
+                requests=np.array([[1, 2, 3]]),
+                replicas=np.array([1]),
+            )

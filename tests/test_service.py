@@ -77,6 +77,39 @@ class TestOps:
         )
         assert r["totals"] == [109]
 
+    def test_sweep_multi(self, client):
+        # 2-resource multi sweep must agree with the plain sweep on the
+        # same specs (reference semantics: multi runs int64 semantics on
+        # both rows, which coincide for non-wrapping values).
+        plain = client.sweep(
+            cpu_request_milli=[200, 500],
+            mem_request_bytes=[250 * 1024 * 1024, 1 << 30],
+            replicas=[10, 1],
+        )
+        multi = client.sweep_multi(
+            resources=("cpu", "memory"),
+            requests=[[200, 250 * 1024 * 1024], [500, 1 << 30]],
+            replicas=[10, 1],
+        )
+        assert multi["totals"] == plain["totals"]
+        assert multi["schedulable"] == plain["schedulable"]
+        assert multi["resources"] == ["cpu", "memory"]
+
+    def test_sweep_multi_bad_grid_is_service_error(self, client):
+        with pytest.raises(RuntimeError, match="multi-resource grid"):
+            client.sweep_multi(resources=("cpu", "memory"),
+                               requests=[[0, 1]], replicas=[1])
+        with pytest.raises(RuntimeError, match="multi-resource grid"):
+            client.sweep_multi(resources=("cpu", "memory", "no-such"),
+                               requests=[[1, 1, 1]], replicas=[1])
+        with pytest.raises(RuntimeError, match="multi-resource grid"):
+            client.sweep_multi(resources=("cpu", "memory"),
+                               requests=[[100, 1048576], [200]],
+                               replicas=[1, 1])  # ragged matrix
+        with pytest.raises(RuntimeError, match="multi-resource grid"):
+            client.sweep_multi(resources=("cpu", "memory", "cpu"),
+                               requests=[[1, 1, 1]], replicas=[1])
+
     def test_many_requests_one_connection(self, client):
         for _ in range(20):
             assert client.ping() == "pong"
