@@ -15,16 +15,21 @@ the new framework's C2 equivalent with two deliberate differences:
   N+1 query pattern.
 
 Auth support: bearer token (inline or ``tokenFile``), client certificates
-(inline base64 ``*-data`` or file paths), HTTP basic auth, and ``exec``
-credential plugins (the EKS/GKE pattern).  TLS verifies against the
-cluster's ``certificate-authority(-data)`` unless
-``insecure-skip-tls-verify`` is set.
+(inline base64 ``*-data`` or file paths), HTTP basic auth, ``exec``
+credential plugins (the EKS/GKE pattern), and the ``oidc`` auth-provider
+stanza including token *refresh* (a fresh id-token is fetched through the
+issuer's discovery + token endpoints when the cached one is expired).
+TLS verifies against the cluster's ``certificate-authority(-data)``
+unless ``insecure-skip-tls-verify`` is set.  ``HTTPS_PROXY`` /
+``NO_PROXY`` are honored for the apiserver connection (CONNECT
+tunneling; the OIDC refresh request goes through ``urllib`` which obeys
+them natively).
 
 Known limits vs client-go's stack (recorded in PARITY.md "Architecture
-divergences"): no OIDC token *refresh* (a static OIDC id-token in
-``token`` works), no legacy azure/gcp auth-provider stanzas (deprecated
-upstream since client-go v1.26), no ``HTTP(S)_PROXY`` tunneling.  Install
-the optional ``kubernetes`` package to regain those paths.
+divergences"): the legacy ``azure``/``gcp`` auth-provider stanzas are
+rejected with a pointer to exec plugins (client-go removed them in
+v1.26), and plain-``http`` apiservers do not proxy (real apiservers are
+https).
 """
 
 from __future__ import annotations
@@ -36,7 +41,9 @@ import os
 import ssl
 import subprocess
 import tempfile
+import time
 import urllib.parse
+import urllib.request
 
 __all__ = [
     "KubeConfigError",
@@ -158,6 +165,54 @@ class KubeConfig:
             token = token.decode().strip() if token else None
         if not token and user.get("exec"):
             token = _exec_credential_token(user["exec"])
+        # The auth-provider stanza is consulted only when no other working
+        # credential exists: a leftover legacy stanza next to client certs
+        # or basic auth (common in old GKE kubeconfigs) must not block a
+        # cluster that is otherwise reachable.
+        has_cert = bool(
+            user.get("client-certificate-data")
+            or user.get("client-certificate")
+        )
+        has_basic = (
+            user.get("username") is not None
+            and user.get("password") is not None
+        )
+        if (
+            not token
+            and not has_cert
+            and not has_basic
+            and user.get("auth-provider")
+        ):
+            provider = user["auth-provider"] or {}
+            name = provider.get("name")
+            if name == "oidc":
+
+                def _persist(new_id: str, new_refresh: str | None) -> None:
+                    # client-go's oidc plugin persists rotated tokens back
+                    # into the kubeconfig; IdPs with refresh-token rotation
+                    # invalidate the old one on first use, so dropping the
+                    # rotation would brick every later run.  `provider` is
+                    # a live reference into `doc`.  A read-only kubeconfig
+                    # still gets this run's fresh token (write skipped).
+                    block = provider.setdefault("config", {})
+                    block["id-token"] = new_id
+                    if new_refresh:
+                        block["refresh-token"] = new_refresh
+                    try:
+                        with open(path, "w") as f:
+                            yaml.safe_dump(doc, f)
+                    except OSError:
+                        pass
+
+                token = _oidc_id_token(
+                    provider.get("config") or {}, persist=_persist
+                )
+            else:
+                raise KubeConfigError(
+                    f"unsupported auth-provider {name!r} (the legacy "
+                    "azure/gcp providers were removed from client-go in "
+                    "v1.26 — migrate the kubeconfig to an exec plugin)"
+                )
 
         return cls(
             server,
@@ -243,6 +298,123 @@ def _exec_credential_token(spec: dict) -> str:
     return str(token)
 
 
+def _jwt_expired(token: str, *, skew_s: float = 30.0) -> bool:
+    """True iff the JWT's ``exp`` claim is within ``skew_s`` of now.
+
+    Claims are decoded WITHOUT signature verification — expiry here only
+    decides whether to spend a refresh round-trip (client-go's oidc plugin
+    does the same); the apiserver is the party that verifies the token.
+    A token that does not parse as a JWT is treated as expired (refresh).
+    """
+    try:
+        payload_b64 = token.split(".")[1]
+        payload_b64 += "=" * (-len(payload_b64) % 4)
+        claims = json.loads(base64.urlsafe_b64decode(payload_b64))
+        exp = float(claims["exp"])
+    except (IndexError, KeyError, ValueError, TypeError):
+        return True
+    return exp - skew_s <= time.time()
+
+
+def _oidc_ssl_context(cfg: dict) -> ssl.SSLContext:
+    ctx = ssl.create_default_context()
+    ca = _b64_or_file(
+        cfg.get("idp-certificate-authority-data"),
+        cfg.get("idp-certificate-authority"),
+        "idp-certificate-authority",
+    )
+    if ca:
+        ctx.load_verify_locations(cadata=ca.decode())
+    return ctx
+
+
+def _oidc_http_json(
+    url: str, ctx: ssl.SSLContext, data: bytes | None = None
+) -> dict:
+    """GET/POST JSON from the identity provider (urllib honors
+    HTTP(S)_PROXY/NO_PROXY natively, matching the transport the refreshed
+    token will ultimately ride)."""
+    req = urllib.request.Request(
+        url,
+        data=data,
+        headers=(
+            {"Content-Type": "application/x-www-form-urlencoded"}
+            if data is not None
+            else {}
+        ),
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30, context=ctx) as resp:
+            return json.loads(resp.read())
+    except (OSError, ValueError) as e:
+        raise KubeConfigError(f"OIDC request to {url} failed: {e}") from e
+
+
+def _oidc_id_token(cfg: dict, persist=None) -> str:
+    """client-go's ``oidc`` auth-provider: cached id-token, refreshed when
+    expired via OIDC discovery + the token endpoint.
+
+    ``persist(new_id_token, new_refresh_token_or_None)`` is invoked after a
+    successful refresh so the caller can write rotated tokens back to the
+    kubeconfig (rotation-enabled IdPs invalidate the consumed refresh
+    token; without write-back every later run would fail invalid_grant).
+    """
+    id_token = cfg.get("id-token")
+    if id_token and not _jwt_expired(str(id_token)):
+        return str(id_token)
+    issuer = (cfg.get("idp-issuer-url") or "").rstrip("/")
+    refresh = cfg.get("refresh-token")
+    if not issuer or not refresh:
+        raise KubeConfigError(
+            "oidc auth-provider: id-token expired or absent and no "
+            "idp-issuer-url + refresh-token to refresh with"
+        )
+    ctx = _oidc_ssl_context(cfg)
+    discovery = _oidc_http_json(
+        issuer + "/.well-known/openid-configuration", ctx
+    )
+    endpoint = discovery.get("token_endpoint")
+    if not endpoint:
+        raise KubeConfigError(
+            "oidc auth-provider: issuer discovery has no token_endpoint"
+        )
+    # Empty client_id/client_secret are OMITTED, not sent blank: strict
+    # IdPs treat a present client_secret as secret-based client auth and
+    # reject public clients (x/oauth2, which client-go uses, omits too).
+    fields = {
+        "grant_type": "refresh_token",
+        "refresh_token": refresh,
+        "client_id": cfg.get("client-id"),
+        "client_secret": cfg.get("client-secret"),
+    }
+    form = urllib.parse.urlencode(
+        {k: v for k, v in fields.items() if v}
+    ).encode()
+    tokens = _oidc_http_json(endpoint, ctx, data=form)
+    fresh = tokens.get("id_token")
+    if not fresh:
+        raise KubeConfigError(
+            "oidc auth-provider: token endpoint returned no id_token"
+        )
+    if persist is not None:
+        persist(str(fresh), tokens.get("refresh_token"))
+    return str(fresh)
+
+
+def _proxy_for(scheme: str, host: str, port: int) -> str | None:
+    """The proxy URL to tunnel through, or None (honors NO_PROXY).
+
+    The bypass probe carries the port: urllib only matches a ported
+    NO_PROXY entry (``api.example:6443``) when the probe string does too.
+    """
+    try:
+        if urllib.request.proxy_bypass(f"{host}:{port}"):
+            return None
+    except OSError:  # pragma: no cover - platform lookup failure
+        pass
+    return urllib.request.getproxies().get(scheme)
+
+
 class KubeClient:
     """Minimal apiserver GET client with pagination over a kubeconfig."""
 
@@ -265,6 +437,39 @@ class KubeClient:
         if timeout == -1.0:
             timeout = self.timeout
         if self._scheme == "https":
+            proxy = _proxy_for("https", self._host, self._port)
+            if proxy:
+                # CONNECT tunnel: TCP (+ optional basic auth) to the proxy,
+                # then TLS end-to-end to the apiserver through it — the
+                # proxy never sees plaintext.
+                pu = urllib.parse.urlsplit(proxy)
+                if not pu.hostname:  # "host:port" with no scheme
+                    pu = urllib.parse.urlsplit("http://" + proxy)
+                if pu.scheme == "https":
+                    # set_tunnel sends the CONNECT in plaintext before any
+                    # TLS wrap; a TLS-terminating proxy would hang/reset
+                    # opaquely — fail with a diagnosis instead.
+                    raise KubeConfigError(
+                        f"HTTPS_PROXY {proxy!r}: TLS-to-proxy is not "
+                        "supported; use an http:// CONNECT proxy"
+                    )
+                headers = {}
+                if pu.username:
+                    cred = (
+                        f"{urllib.parse.unquote(pu.username)}:"
+                        f"{urllib.parse.unquote(pu.password or '')}"
+                    )
+                    headers["Proxy-Authorization"] = (
+                        "Basic " + base64.b64encode(cred.encode()).decode()
+                    )
+                conn = http.client.HTTPSConnection(
+                    pu.hostname or "",
+                    pu.port or 3128,
+                    timeout=timeout,
+                    context=self._ssl,
+                )
+                conn.set_tunnel(self._host, self._port, headers=headers)
+                return conn
             return http.client.HTTPSConnection(
                 self._host, self._port, timeout=timeout, context=self._ssl
             )

@@ -452,3 +452,249 @@ class TestWatchLivenessWatchdog:
         # KubeAPIError, and well before any server action.
         assert [e["type"] for e in events] == ["BOOKMARK"]
         assert elapsed < 5
+
+
+def _make_jwt(exp: float) -> str:
+    """Unsigned JWT with one claim — expiry checks don't verify signatures."""
+    def seg(obj):
+        raw = base64.urlsafe_b64encode(json.dumps(obj).encode()).decode()
+        return raw.rstrip("=")
+
+    return f"{seg({'alg': 'none'})}.{seg({'exp': exp})}.sig"
+
+
+class MockIdP:
+    """A plain-HTTP OIDC issuer: discovery + token endpoints."""
+
+    def __init__(self):
+        idp = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                assert self.path == "/.well-known/openid-configuration"
+                self._json({"token_endpoint": idp.url + "/token"})
+
+            def do_POST(self):
+                assert self.path == "/token"
+                n = int(self.headers.get("Content-Length", 0))
+                import urllib.parse
+
+                form = dict(
+                    urllib.parse.parse_qsl(self.rfile.read(n).decode())
+                )
+                idp.refresh_calls.append(form)
+                resp = {"id_token": idp.next_id_token}
+                if idp.next_refresh_token:
+                    resp["refresh_token"] = idp.next_refresh_token
+                self._json(resp)
+
+        self.refresh_calls: list = []
+        self.next_id_token = "REFRESHED"
+        self.next_refresh_token = None
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        host, port = self.server.server_address
+        self.url = f"http://{host}:{port}"
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class TestOIDCProvider:
+    @pytest.fixture()
+    def idp(self):
+        m = MockIdP()
+        yield m
+        m.close()
+
+    def test_fresh_id_token_used_without_refresh(self, tmp_path, idp):
+        import time as _t
+
+        token = _make_jwt(_t.time() + 3600)
+        path = _write_kubeconfig(
+            tmp_path, "https://x",
+            {"auth-provider": {"name": "oidc", "config": {
+                "idp-issuer-url": idp.url, "id-token": token,
+                "refresh-token": "r1"}}},
+        )
+        assert KubeConfig.load(path).token == token
+        assert idp.refresh_calls == []
+
+    def test_expired_id_token_refreshes(self, tmp_path, idp):
+        import time as _t
+
+        idp.next_id_token = _make_jwt(_t.time() + 3600)
+        path = _write_kubeconfig(
+            tmp_path, "https://x",
+            {"auth-provider": {"name": "oidc", "config": {
+                "idp-issuer-url": idp.url,
+                "id-token": _make_jwt(_t.time() - 10),
+                "refresh-token": "r2", "client-id": "cid",
+                "client-secret": "sec"}}},
+        )
+        assert KubeConfig.load(path).token == idp.next_id_token
+        [form] = idp.refresh_calls
+        assert form["grant_type"] == "refresh_token"
+        assert form["refresh_token"] == "r2"
+        assert form["client_id"] == "cid" and form["client_secret"] == "sec"
+
+    def test_public_client_omits_empty_secret(self, tmp_path, idp):
+        import time as _t
+
+        idp.next_id_token = _make_jwt(_t.time() + 3600)
+        path = _write_kubeconfig(
+            tmp_path, "https://x",
+            {"auth-provider": {"name": "oidc", "config": {
+                "idp-issuer-url": idp.url,
+                "id-token": _make_jwt(_t.time() - 10),
+                "refresh-token": "r3", "client-id": "pub"}}},
+        )
+        KubeConfig.load(path)
+        [form] = idp.refresh_calls
+        assert "client_secret" not in form  # public client: omit, not blank
+
+    def test_rotated_tokens_persist_to_kubeconfig(self, tmp_path, idp):
+        import time as _t
+
+        fresh = _make_jwt(_t.time() + 3600)
+        idp.next_id_token = fresh
+        idp.next_refresh_token = "ROTATED"
+        path = _write_kubeconfig(
+            tmp_path, "https://x",
+            {"auth-provider": {"name": "oidc", "config": {
+                "idp-issuer-url": idp.url,
+                "id-token": _make_jwt(_t.time() - 10),
+                "refresh-token": "consumed"}}},
+        )
+        assert KubeConfig.load(path).token == fresh
+        saved = yaml.safe_load(open(path))
+        cfg = saved["users"][0]["user"]["auth-provider"]["config"]
+        assert cfg["id-token"] == fresh
+        assert cfg["refresh-token"] == "ROTATED"
+        # Second load: fresh id-token used from the file, no new refresh.
+        assert KubeConfig.load(path).token == fresh
+        assert len(idp.refresh_calls) == 1
+
+    def test_legacy_stanza_ignored_when_certs_present(self, tmp_path):
+        # A leftover gcp stanza next to working client certs (old GKE
+        # configs) must not block the load.
+        path = _write_kubeconfig(
+            tmp_path, "https://x",
+            {"auth-provider": {"name": "gcp", "config": {}},
+             "client-certificate-data": base64.b64encode(b"PEM").decode(),
+             "client-key-data": base64.b64encode(b"KEY").decode()},
+        )
+        cfg = KubeConfig.load(path)
+        assert cfg.client_cert_pem == b"PEM" and cfg.token is None
+
+    def test_missing_refresh_material_errors(self, tmp_path):
+        path = _write_kubeconfig(
+            tmp_path, "https://x",
+            {"auth-provider": {"name": "oidc", "config": {}}},
+        )
+        with pytest.raises(KubeConfigError, match="refresh-token"):
+            KubeConfig.load(path)
+
+    def test_token_endpoint_without_id_token_errors(self, tmp_path, idp):
+        idp.next_id_token = None
+        path = _write_kubeconfig(
+            tmp_path, "https://x",
+            {"auth-provider": {"name": "oidc", "config": {
+                "idp-issuer-url": idp.url, "refresh-token": "r"}}},
+        )
+        with pytest.raises(KubeConfigError, match="no id_token"):
+            KubeConfig.load(path)
+
+    def test_legacy_providers_rejected_with_guidance(self, tmp_path):
+        path = _write_kubeconfig(
+            tmp_path, "https://x",
+            {"auth-provider": {"name": "gcp", "config": {}}},
+        )
+        with pytest.raises(KubeConfigError, match="exec plugin"):
+            KubeConfig.load(path)
+
+    def test_oidc_refresh_drives_live_fixture_end_to_end(
+        self, tmp_path, idp
+    ):
+        # Full C2 path: expired cached id-token -> discovery + refresh at
+        # the IdP -> Bearer <fresh> on the paginated Lists -> fixture.
+        import time as _t
+
+        fresh = _make_jwt(_t.time() + 3600)
+        idp.next_id_token = fresh
+        fixture = synthetic_fixture(5, seed=3)
+        api = MockApiserver(fixture, require_token=fresh)
+        try:
+            path = _write_kubeconfig(
+                tmp_path, f"http://127.0.0.1:{api.port}",
+                {"auth-provider": {"name": "oidc", "config": {
+                    "idp-issuer-url": idp.url,
+                    "id-token": _make_jwt(_t.time() - 5),
+                    "refresh-token": "rt"}}},
+            )
+            got = live_fixture(path)
+        finally:
+            api.close()
+        assert [n["name"] for n in got["nodes"]] == [
+            n["name"] for n in fixture["nodes"]
+        ]
+        assert len(idp.refresh_calls) == 1
+
+    def test_malformed_jwt_treated_as_expired(self):
+        assert kubeapi._jwt_expired("not-a-jwt")
+        assert kubeapi._jwt_expired("a.b.c")
+
+
+class TestProxySupport:
+    def _client(self):
+        return KubeClient(KubeConfig(server="https://api.example:6443",
+                                     insecure=True))
+
+    def test_https_proxy_builds_connect_tunnel(self, monkeypatch):
+        monkeypatch.setenv("HTTPS_PROXY", "http://user:pw@proxy.corp:3129")
+        monkeypatch.delenv("NO_PROXY", raising=False)
+        conn = self._client()._connect()
+        assert conn.host == "proxy.corp" and conn.port == 3129
+        # the tunnel targets the apiserver; proxy auth header attached
+        assert conn._tunnel_host == "api.example"
+        assert conn._tunnel_port == 6443
+        auth = conn._tunnel_headers["Proxy-Authorization"]
+        assert base64.b64decode(auth.split()[1]).decode() == "user:pw"
+
+    def test_no_proxy_bypasses(self, monkeypatch):
+        monkeypatch.setenv("HTTPS_PROXY", "http://proxy.corp:3129")
+        monkeypatch.setenv("NO_PROXY", "api.example")
+        conn = self._client()._connect()
+        assert conn.host == "api.example" and conn._tunnel_host is None
+
+    def test_no_proxy_with_port_bypasses(self, monkeypatch):
+        monkeypatch.setenv("HTTPS_PROXY", "http://proxy.corp:3129")
+        monkeypatch.setenv("NO_PROXY", "api.example:6443")
+        conn = self._client()._connect()
+        assert conn.host == "api.example" and conn._tunnel_host is None
+
+    def test_https_scheme_proxy_rejected(self, monkeypatch):
+        from kubernetesclustercapacity_tpu.kubeapi import KubeConfigError
+
+        monkeypatch.setenv("HTTPS_PROXY", "https://tlsproxy.corp:443")
+        monkeypatch.delenv("NO_PROXY", raising=False)
+        with pytest.raises(KubeConfigError, match="TLS-to-proxy"):
+            self._client()._connect()
+
+    def test_without_proxy_env_direct(self, monkeypatch):
+        monkeypatch.delenv("HTTPS_PROXY", raising=False)
+        monkeypatch.delenv("https_proxy", raising=False)
+        conn = self._client()._connect()
+        assert conn.host == "api.example" and conn._tunnel_host is None
