@@ -1,0 +1,59 @@
+"""The reference's sample run, offline — where a switching user starts.
+
+The reference CLI answers one question per invocation against a live
+cluster (``README.md:38-47`` shows its sample run).  Here the same
+question runs against a saved fixture, bit-exact to the Go semantics,
+with no cluster and no network.
+
+Run:  python examples/01_reference_run.py
+"""
+
+import os
+
+import numpy as np
+
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))  # noqa: E402 - run-by-path support
+
+import kubernetesclustercapacity_tpu as kcc
+from kubernetesclustercapacity_tpu.fixtures import load_fixture
+from kubernetesclustercapacity_tpu.oracle import reference_run
+from kubernetesclustercapacity_tpu.report import reference_report
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "kind-3node.json"
+)
+
+
+def main() -> None:
+    fixture = load_fixture(FIXTURE)
+    scenario = kcc.scenario_from_flags(
+        cpuRequests="200m", cpuLimits="400m",
+        memRequests="250mb", memLimits="500mb", replicas="10",
+    )
+
+    # The TPU path: pack once, evaluate per-node fits on the jitted kernel.
+    snap = kcc.snapshot_from_fixture(fixture, semantics="reference")
+    fits = np.asarray(
+        kcc.fit_per_node(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, snap.healthy,
+            scenario.cpu_request_milli, scenario.mem_request_bytes,
+            mode="reference",
+        )
+    )
+
+    # The sequential oracle (the stand-in for the Go binary) agrees bit
+    # for bit — that equality is the framework's core contract.
+    oracle = reference_run(fixture, scenario)
+    assert fits.tolist() == oracle.fits
+    assert int(fits.sum()) == oracle.total_possible_replicas
+
+    # The byte-parity transcript the reference would have printed:
+    print(reference_report(snap, fits, scenario))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""What-if sweeps — the workload the reference cannot express.
+
+The reference evaluates ONE (cpuRequests, memRequests, replicas) triple
+per multi-minute apiserver walk.  The TPU-shaped question is a *grid*:
+thousands of what-if pod shapes against one snapshot, answered in
+milliseconds by the fused kernel, plus the R-resource generalization
+(GPUs, ephemeral-storage).
+
+Run:  python examples/02_what_if_sweep.py
+"""
+
+import numpy as np
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))  # noqa: E402 - run-by-path support
+
+import kubernetesclustercapacity_tpu as kcc
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.models import CapacityModel
+from kubernetesclustercapacity_tpu.ops.pallas_fit import sweep_snapshot_auto
+
+
+def main() -> None:
+    snap = kcc.synthetic_snapshot(10_000, seed=7)
+
+    # 1k random pod shapes, evaluated in one kernel dispatch.
+    grid = kcc.random_scenario_grid(1_000, seed=8)
+    totals, schedulable, kernel = sweep_snapshot_auto(snap, grid)
+    print(f"kernel: {kernel}")
+    print(f"p50 cluster headroom over 1k scenarios: "
+          f"{int(np.median(totals))} replicas")
+    print(f"schedulable fraction: {schedulable.mean():.1%}")
+
+    # The R-resource axis: the same sweep with a GPU request column.
+    rng = np.random.default_rng(9)
+    fx = synthetic_fixture(2_000, seed=9)
+    for node in fx["nodes"]:
+        node["allocatable"]["nvidia.com/gpu"] = str(int(rng.integers(0, 9)))
+    gsnap = kcc.snapshot_from_fixture(
+        fx, semantics="strict", extended_resources=("nvidia.com/gpu",)
+    )
+    base = kcc.random_scenario_grid(256, seed=10)
+    mgrid = kcc.MultiResourceGrid.from_grid(
+        base, {"nvidia.com/gpu": rng.integers(0, 3, 256)}
+    )
+    model = CapacityModel(gsnap, mode="strict")
+    mtotals, msched = model.sweep_multi(mgrid)
+    gpu_rows = mgrid.requests[:, list(mgrid.resources).index("nvidia.com/gpu")]
+    print(f"\nGPU-requesting scenarios: {(gpu_rows > 0).sum()} / 256")
+    print(f"p50 headroom with GPU constraint: {int(np.median(mtotals))}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Constraints + placement: WHERE replicas land, not just how many.
+
+The reference schedules anywhere resources allow.  Real scheduling
+carries taints/tolerations, selectors, affinity, and spread — and a
+capacity answer is more useful with a concrete placement plan.
+
+Run:  python examples/03_constraints_and_placement.py
+"""
+
+import os
+
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))  # noqa: E402 - run-by-path support
+
+import kubernetesclustercapacity_tpu as kcc
+from kubernetesclustercapacity_tpu.fixtures import load_fixture
+from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "kind-3node.json"
+)
+
+
+def main() -> None:
+    fixture = load_fixture(FIXTURE)
+    snap = kcc.snapshot_from_fixture(fixture, semantics="strict")
+    model = CapacityModel(snap, mode="strict", fixture=fixture)
+
+    spec = PodSpec(
+        cpu_request_milli=250,
+        mem_request_bytes=512 << 20,
+        replicas=6,
+    )
+    result = model.evaluate(spec)
+    print(f"fits per node: {result.fits.tolist()}  "
+          f"(total {result.total}, schedulable={result.schedulable})")
+    # Strict mode auto-applies the control-plane hard taint: untolerating
+    # pods never count capacity there.  Tolerate it and capacity grows:
+    tolerant = model.evaluate(
+        PodSpec(cpu_request_milli=250, mem_request_bytes=512 << 20,
+                replicas=6, tolerations=({"operator": "Exists"},))
+    )
+    print(f"with a tolerate-everything pod: total {tolerant.total}")
+
+    placement = model.place(spec, policy="spread")
+    print(f"\nspread placement of {spec.replicas} replicas "
+          f"(engine={placement.engine}):")
+    for node, count in sorted(placement.by_node().items()):
+        print(f"  {node:<24} {count}")
+    assert placement.all_placed
+
+
+if __name__ == "__main__":
+    main()

@@ -192,17 +192,42 @@ class KubeConfig:
                     # into the kubeconfig; IdPs with refresh-token rotation
                     # invalidate the old one on first use, so dropping the
                     # rotation would brick every later run.  `provider` is
-                    # a live reference into `doc`.  A read-only kubeconfig
-                    # still gets this run's fresh token (write skipped).
+                    # a live reference into `doc`.  Write atomically
+                    # (temp file + rename in the same directory): an
+                    # in-place truncating write that dies mid-dump would
+                    # destroy the kubeconfig — which holds credentials for
+                    # every cluster — with the old refresh token already
+                    # consumed server-side.
                     block = provider.setdefault("config", {})
                     block["id-token"] = new_id
                     if new_refresh:
                         block["refresh-token"] = new_refresh
                     try:
-                        with open(path, "w") as f:
-                            yaml.safe_dump(doc, f)
-                    except OSError:
-                        pass
+                        d = os.path.dirname(os.path.abspath(path))
+                        fd, tmp = tempfile.mkstemp(
+                            dir=d, prefix=".kubeconfig-"
+                        )
+                        try:
+                            with os.fdopen(fd, "w") as f:
+                                yaml.safe_dump(doc, f)
+                            os.replace(tmp, path)
+                        except BaseException:
+                            os.unlink(tmp)
+                            raise
+                    except OSError as e:
+                        # Read-only kubeconfig: this run still gets the
+                        # fresh token, but a rotated refresh token is now
+                        # LOST — say so, or the next run's invalid_grant
+                        # is undiagnosable.
+                        import sys
+
+                        print(
+                            "warning: could not persist refreshed OIDC "
+                            f"tokens to {path}: {e} (if your IdP rotates "
+                            "refresh tokens, the next run will need to "
+                            "re-authenticate)",
+                            file=sys.stderr,
+                        )
 
                 token = _oidc_id_token(
                     provider.get("config") or {}, persist=_persist
@@ -464,7 +489,9 @@ class KubeClient:
                     )
                 conn = http.client.HTTPSConnection(
                     pu.hostname or "",
-                    pu.port or 3128,
+                    # Portless proxy URLs default to 80 like urllib/curl/
+                    # client-go (and this module's own OIDC refresh path).
+                    pu.port or 80,
                     timeout=timeout,
                     context=self._ssl,
                 )

@@ -22,9 +22,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import checkify
 
-from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+from kubernetesclustercapacity_tpu.ops.fit import (
+    fit_per_node,
+    fit_per_node_multi,
+)
 
-__all__ = ["checked_fit_totals"]
+__all__ = ["checked_fit_totals", "checked_fit_totals_multi"]
 
 
 def _checked_impl(
@@ -80,6 +83,54 @@ def checked_fit_totals(
         jnp.asarray(healthy, jnp.bool_),
         jnp.asarray(cpu_req, jnp.int64),
         jnp.asarray(mem_req, jnp.int64),
+    )
+    err.throw()
+    return int(total)
+
+
+def _checked_multi_impl(
+    alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs_r
+):
+    checkify.check(
+        jnp.all(reqs_r >= 0),
+        "negative resource request in the R-dim grid (zero means "
+        "does-not-consume; negative has no defined semantics)",
+    )
+    checkify.check(
+        jnp.all(alloc_rn >= 0) & jnp.all(used_rn >= 0),
+        "negative values in the [R, N] resource matrix",
+    )
+    checkify.check(
+        jnp.all(alloc_pods >= 0) & jnp.all(pods_count >= 0),
+        "negative pod counts in snapshot",
+    )
+    fits = fit_per_node_multi(
+        alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs_r,
+        mode="strict",
+    )
+    total = jnp.sum(fits)
+    n = fits.shape[0]
+    checkify.check(
+        jnp.abs(total) <= jnp.int64(n) * jnp.int64(2**31),
+        "total replica count out of range: int64 sum may have wrapped",
+    )
+    return total
+
+
+_checked_multi = jax.jit(checkify.checkify(_checked_multi_impl))
+
+
+def checked_fit_totals_multi(
+    alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs_r
+) -> int:
+    """R-dim (strict) fit total with in-graph validity checks."""
+    err, total = _checked_multi(
+        jnp.asarray(alloc_rn, jnp.int64),
+        jnp.asarray(used_rn, jnp.int64),
+        jnp.asarray(alloc_pods, jnp.int64),
+        jnp.asarray(pods_count, jnp.int64),
+        jnp.asarray(healthy, jnp.bool_),
+        jnp.asarray(reqs_r, jnp.int64),
     )
     err.throw()
     return int(total)

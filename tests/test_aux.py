@@ -3,6 +3,7 @@
 import json
 import time
 
+import numpy as np
 import pytest
 
 from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
@@ -107,4 +108,52 @@ class TestGuards:
                 snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
                 snap.used_cpu_req_milli, snap.used_mem_req_bytes,
                 bad, snap.healthy, 100, MIB,
+            )
+
+
+class TestGuardsMulti:
+    def _args(self, n=40, seed=2):
+        snap = synthetic_snapshot(n, seed=seed)
+        alloc_rn = np.stack([snap.alloc_cpu_milli, snap.alloc_mem_bytes])
+        used_rn = np.stack(
+            [snap.used_cpu_req_milli, snap.used_mem_req_bytes]
+        )
+        return snap, alloc_rn, used_rn
+
+    def test_valid_inputs_pass(self):
+        from kubernetesclustercapacity_tpu.utils.guards import (
+            checked_fit_totals_multi,
+        )
+
+        snap, alloc_rn, used_rn = self._args()
+        total = checked_fit_totals_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, np.array([100, MIB], dtype=np.int64),
+        )
+        assert total > 0
+
+    def test_negative_request_raises(self):
+        from kubernetesclustercapacity_tpu.utils.guards import (
+            checked_fit_totals_multi,
+        )
+
+        snap, alloc_rn, used_rn = self._args()
+        with pytest.raises(Exception, match="negative resource request"):
+            checked_fit_totals_multi(
+                alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+                snap.healthy, np.array([-1, MIB], dtype=np.int64),
+            )
+
+    def test_negative_matrix_raises(self):
+        from kubernetesclustercapacity_tpu.utils.guards import (
+            checked_fit_totals_multi,
+        )
+
+        snap, alloc_rn, used_rn = self._args()
+        used_rn = used_rn.copy()
+        used_rn[1, 0] = -7
+        with pytest.raises(Exception, match="resource matrix"):
+            checked_fit_totals_multi(
+                alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+                snap.healthy, np.array([100, MIB], dtype=np.int64),
             )

@@ -1,0 +1,33 @@
+"""The examples/ scripts must actually run (docs that rot are worse than
+no docs) — each executes in-process with its asserts live."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES = sorted(
+    f
+    for f in os.listdir(
+        os.path.join(os.path.dirname(__file__), "..", "examples")
+    )
+    if f.endswith(".py")
+)
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name, capsys):
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "examples", name
+    )
+    spec = importlib.util.spec_from_file_location(f"example_{name[:-3]}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        mod.main()
+    finally:
+        sys.modules.pop(spec.name, None)
+    out = capsys.readouterr().out
+    assert out.strip()  # every example prints its result

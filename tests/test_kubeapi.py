@@ -652,6 +652,36 @@ class TestOIDCProvider:
         ]
         assert len(idp.refresh_calls) == 1
 
+    def test_persist_failure_warns_but_loads(
+        self, tmp_path, idp, capsys, monkeypatch
+    ):
+        # A kubeconfig that cannot be rewritten (read-only mount, other
+        # owner): the load still returns the fresh token, warns about the
+        # lost rotation, and the original file is never truncated.
+        # (chmod cannot provoke this under root, so fail the atomic
+        # rename itself.)
+        import time as _t
+
+        fresh = _make_jwt(_t.time() + 3600)
+        idp.next_id_token = fresh
+        path = _write_kubeconfig(
+            tmp_path, "https://x",
+            {"auth-provider": {"name": "oidc", "config": {
+                "idp-issuer-url": idp.url,
+                "id-token": _make_jwt(_t.time() - 10),
+                "refresh-token": "rt"}}},
+        )
+
+        def boom(src, dst):
+            raise OSError("read-only file system")
+
+        monkeypatch.setattr("os.replace", boom)
+        assert KubeConfig.load(path).token == fresh
+        err = capsys.readouterr().err
+        assert "could not persist refreshed OIDC tokens" in err
+        # the original file is intact (not truncated)
+        assert yaml.safe_load(open(path))["users"]
+
     def test_malformed_jwt_treated_as_expired(self):
         assert kubeapi._jwt_expired("not-a-jwt")
         assert kubeapi._jwt_expired("a.b.c")
