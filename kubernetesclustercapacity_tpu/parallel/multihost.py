@@ -32,10 +32,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
+from kubernetesclustercapacity_tpu.ops.fit import sweep_grid, sweep_grid_multi
 from kubernetesclustercapacity_tpu.parallel.mesh import SCENARIO_AXIS
 
-__all__ = ["initialize", "sweep_multihost", "scenario_block"]
+__all__ = [
+    "initialize",
+    "sweep_multihost",
+    "sweep_multihost_multi",
+    "scenario_block",
+]
 
 
 def initialize(
@@ -100,36 +105,85 @@ def sweep_multihost(
     mem_reqs = np.asarray(mem_reqs, dtype=np.int64)
     replicas = np.asarray(replicas, dtype=np.int64)
     s = cpu_reqs.shape[0]
-    pid, pcount = jax.process_index(), jax.process_count()
-    start, stop = scenario_block(s, pid, pcount)
-
-    # Local slice, padded to the local device count and scenario-sharded
-    # over the host's chips (no cross-host sharding anywhere).
-    local_devices = jax.local_devices()
-    k = max(len(local_devices), 1)
-    width = stop - start
-    s_pad = -(-max(width, 1) // k) * k
-    pad = s_pad - width
-
-    def stage(a, fill):
-        block = a[start:stop]
-        if pad:
-            block = np.pad(block, (0, pad), constant_values=fill)
-        mesh = Mesh(np.array(local_devices), (SCENARIO_AXIS,))
-        return jax.device_put(block, NamedSharding(mesh, P(SCENARIO_AXIS)))
-
+    stage, width, pcount = _local_block_stager(s)
     cpu_d = stage(cpu_reqs, 1)  # pad with harmless 1-milli probes
     mem_d = stage(mem_reqs, 1)
     rep_d = stage(replicas, 0)
     arrays_d = tuple(jax.device_put(np.asarray(a)) for a in snapshot_arrays)
 
     totals_p, sched_p = sweep_grid(*arrays_d, cpu_d, mem_d, rep_d, mode=mode)
+    return _finish(totals_p, sched_p, s, width, pcount, gather)
+
+
+def _local_block_stager(s: int):
+    """Shared front half of both multihost sweeps: this process's
+    :func:`scenario_block` of the global grid, padded to the local device
+    count and scenario-sharded over the host's chips (no cross-host
+    sharding anywhere).  Returns ``(stage, width, pcount)`` where
+    ``stage(a, fill)`` slices+pads+shards one grid array (1-D, or 2-D
+    sharded on its scenario axis 0).
+    """
+    pid, pcount = jax.process_index(), jax.process_count()
+    start, stop = scenario_block(s, pid, pcount)
+    local_devices = jax.local_devices()
+    k = max(len(local_devices), 1)
+    width = stop - start
+    pad = -(-max(width, 1) // k) * k - width
+    mesh = Mesh(np.array(local_devices), (SCENARIO_AXIS,))
+    sharding = NamedSharding(mesh, P(SCENARIO_AXIS))
+
+    def stage(a, fill):
+        block = a[start:stop]
+        if pad:
+            widths = ((0, pad),) + ((0, 0),) * (block.ndim - 1)
+            block = np.pad(block, widths, constant_values=fill)
+        return jax.device_put(block, sharding)
+
+    return stage, width, pcount
+
+
+def sweep_multihost_multi(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_sr,
+    replicas,
+    *,
+    mode: str = "strict",
+    gather: bool = True,
+):
+    """R-resource variant of :func:`sweep_multihost` (BASELINE config 4).
+
+    Same partition scheme — every process passes the full ``[S, R]``
+    request grid, owns its contiguous :func:`scenario_block`, shards it
+    over local chips, and optionally all-gathers at the end.  The
+    ``[R, N]`` resource matrix is replicated per host like the 2-resource
+    snapshot arrays (node-axis sharding across hosts would put the
+    reduction on DCN).
+    """
+    reqs_sr = np.asarray(reqs_sr, dtype=np.int64)
+    replicas = np.asarray(replicas, dtype=np.int64)
+    s = reqs_sr.shape[0]
+    stage, width, pcount = _local_block_stager(s)
+    # 1-probes: valid (nonzero) requests whose outputs are sliced off.
+    reqs_d = stage(reqs_sr, 1)
+    rep_d = stage(replicas, 0)
+    node_d = tuple(
+        jax.device_put(np.asarray(a))
+        for a in (alloc_rn, used_rn, alloc_pods, pods_count, healthy)
+    )
+
+    totals_p, sched_p = sweep_grid_multi(*node_d, reqs_d, rep_d, mode=mode)
+    return _finish(totals_p, sched_p, s, width, pcount, gather)
+
+
+def _finish(totals_p, sched_p, s, width, pcount, gather):
+    """Slice off probe padding, then (optionally) all-gather the blocks."""
     totals_local = np.asarray(totals_p)[:width]
     sched_local = np.asarray(sched_p)[:width]
-    if not gather:
-        return totals_local, sched_local
-
-    if pcount == 1:
+    if not gather or pcount == 1:
         return totals_local, sched_local
     from jax.experimental import multihost_utils
 

@@ -111,6 +111,35 @@ class TestMultihost:
         np.testing.assert_array_equal(totals, baseline[0])
         np.testing.assert_array_equal(sched, baseline[1])
 
+    def test_sweep_multihost_multi_matches_unsharded(self, snap, grid):
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_grid_multi
+        from kubernetesclustercapacity_tpu.parallel.multihost import (
+            sweep_multihost_multi,
+        )
+
+        rng = np.random.default_rng(44)
+        n = snap.n_nodes
+        alloc_rn = np.stack([snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+                             rng.integers(0, 9, n)])
+        used_rn = np.stack(
+            [snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+             np.zeros(n, dtype=np.int64)]
+        )
+        reqs_sr = np.stack(
+            [grid.cpu_request_milli, grid.mem_request_bytes,
+             rng.integers(0, 3, grid.size)], axis=1,
+        ).astype(np.int64)
+        totals, sched = sweep_multihost_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, grid.replicas, mode="strict",
+        )
+        exact = sweep_grid_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, reqs_sr, grid.replicas, mode="strict",
+        )
+        np.testing.assert_array_equal(totals, np.asarray(exact[0]))
+        np.testing.assert_array_equal(sched, np.asarray(exact[1]))
+
     def test_gather_false_returns_local_block(self, snap, grid, baseline):
         from kubernetesclustercapacity_tpu.parallel.multihost import (
             sweep_multihost,
