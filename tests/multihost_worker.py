@@ -68,25 +68,21 @@ def main() -> None:
     assert np.array_equal(bs, np.asarray(exp_s)[b0:b1])
 
     # R-resource variant over the same DCN partition scheme.
+    from kubernetesclustercapacity_tpu.fixtures import (
+        synthetic_multi_workload,
+    )
     from kubernetesclustercapacity_tpu.ops.fit import sweep_grid_multi
 
-    rng = np.random.default_rng(6)
-    n_nodes = snap.n_nodes
-    alloc_rn = np.stack([snap.alloc_cpu_milli, snap.alloc_mem_bytes,
-                         rng.integers(0, 9, n_nodes)])
-    used_rn = np.stack([snap.used_cpu_req_milli, snap.used_mem_req_bytes,
-                        np.zeros(n_nodes, dtype=np.int64)])
-    reqs_sr = np.stack(
-        [grid.cpu_request_milli, grid.mem_request_bytes,
-         rng.integers(0, 3, grid.size)], axis=1,
-    ).astype(np.int64)
+    alloc_rn, used_rn, reqs_sr, m_reps = synthetic_multi_workload(
+        snap, grid.size, seed=6
+    )
     mt, ms = multihost.sweep_multihost_multi(
         alloc_rn, used_rn, snap.alloc_pods, snap.pods_count, snap.healthy,
-        reqs_sr, grid.replicas, mode="strict", gather=True,
+        reqs_sr, m_reps, mode="strict", gather=True,
     )
     exp_mt, exp_ms = sweep_grid_multi(
         alloc_rn, used_rn, snap.alloc_pods, snap.pods_count, snap.healthy,
-        reqs_sr, grid.replicas, mode="strict",
+        reqs_sr, m_reps, mode="strict",
     )
     assert np.array_equal(mt, np.asarray(exp_mt)), (mt, exp_mt)
     assert np.array_equal(ms, np.asarray(exp_ms))

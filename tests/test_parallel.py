@@ -117,25 +117,20 @@ class TestMultihost:
             sweep_multihost_multi,
         )
 
-        rng = np.random.default_rng(44)
-        n = snap.n_nodes
-        alloc_rn = np.stack([snap.alloc_cpu_milli, snap.alloc_mem_bytes,
-                             rng.integers(0, 9, n)])
-        used_rn = np.stack(
-            [snap.used_cpu_req_milli, snap.used_mem_req_bytes,
-             np.zeros(n, dtype=np.int64)]
+        from kubernetesclustercapacity_tpu.fixtures import (
+            synthetic_multi_workload,
         )
-        reqs_sr = np.stack(
-            [grid.cpu_request_milli, grid.mem_request_bytes,
-             rng.integers(0, 3, grid.size)], axis=1,
-        ).astype(np.int64)
+
+        alloc_rn, used_rn, reqs_sr, reps = synthetic_multi_workload(
+            snap, grid.size, seed=44
+        )
         totals, sched = sweep_multihost_multi(
             alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
-            snap.healthy, reqs_sr, grid.replicas, mode="strict",
+            snap.healthy, reqs_sr, reps, mode="strict",
         )
         exact = sweep_grid_multi(
             alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
-            snap.healthy, reqs_sr, grid.replicas, mode="strict",
+            snap.healthy, reqs_sr, reps, mode="strict",
         )
         np.testing.assert_array_equal(totals, np.asarray(exact[0]))
         np.testing.assert_array_equal(sched, np.asarray(exact[1]))
