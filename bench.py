@@ -1142,11 +1142,13 @@ def _run() -> None:
     # so kernel work has a roofline target, not only a latency one.  Ops
     # per (scenario × node-lane) cell are STATIC counts of the kernel's
     # vector ALU instructions (compares, selects, adds, converts, the rcp
-    # multiply+2-round fixup vs the ~6x emulated int32 divide); the peak is
+    # multiply+1-round fixup vs the ~6x emulated int32 divide); the peak is
     # an approximate public VPU number (8 sublanes × 128 lanes × ~4 ALU
     # ops/cycle × ~0.94 GHz ≈ 3.9e12 int32 ops/s per v5e core) — an anchor
     # for trend lines, not a datasheet claim.
-    _VPU_OPS_PER_CELL = {"pallas_i32_rcp_fused": 56, "pallas_i32_fused": 150}
+    # rcp: cpu+mem each cost ~16 ops (cmp, sub, clamp, 2 cvt, mul, floor,
+    # cvt, one 9-op fixup round shared across the set) + min/epilogue/acc.
+    _VPU_OPS_PER_CELL = {"pallas_i32_rcp_fused": 38, "pallas_i32_fused": 150}
     _VPU_PEAK_BY_PREFIX = (("TPU v5", 3.9e12),)
 
     p50 = fast_per_sweep if fast_per_sweep is not None else exact_per_sweep

@@ -116,8 +116,8 @@ def rcp_division_eligible(
     """True iff f32-reciprocal division is provably exact for these inputs.
 
     The rcp kernel replaces each emulated int32 ``//`` (~6x slower on the
-    VPU) with ``floor(float32(a) * float32(1/d))`` plus two integer fixup
-    rounds.  That is bit-exact when the initial estimate lands within ±1 of
+    VPU) with ``floor(float32(a) * float32(1/d))`` plus ONE integer fixup
+    round.  That is bit-exact when the initial estimate lands within ±1 of
     the true quotient, which holds under (callers must already have passed
     :func:`fast_sweep_eligible`, so values are non-negative int32 and
     memory is KiB-quantized; KiB units are used below):
@@ -126,9 +126,14 @@ def rcp_division_eligible(
        f32 error stacks to at most ``5*2^-24 < 2^-21.6`` (one conversion
        each for a and d, one IEEE divide for 1/d, one multiply), so the
        absolute error is ``<= 2^20 * 2^-21.6 < 0.5`` — after ``floor`` the
-       estimate is within ±1, and one fixup round converges (the second is
-       then a proven no-op, kept as margin for a <=1ulp-sloppy divide).
-    2. divisor bound ``<= 2**29``: keeps every fixup intermediate
+       estimate is in ``{q-1, q, q+1}``, and one fixup round is EXACT for
+       that whole set: est = q-1 gives ``rem = (a - q*d) + d ∈ [d, 2d)``
+       (the ``>= d`` branch adds 1), est = q+1 gives ``rem ∈ [-d, 0)``
+       (the ``< 0`` branch subtracts 1), est = q gives ``rem ∈ [0, d)``
+       (both branches off).  The single round therefore relies on the
+       reciprocal being correctly rounded — :func:`scenario_reciprocals`
+       is the one sanctioned producer.
+    2. divisor bound ``<= 2**29``: keeps the fixup intermediate
        ``a - q*d`` in ``(-d, 2d)`` ⊂ int32 range.
 
     Dividends are ``alloc - used`` clamped at 0 (negative headrooms are
@@ -155,13 +160,14 @@ def _rcp_div(a, d, r):
     """Exact ``a // d`` for the :func:`rcp_division_eligible` domain.
 
     ``a`` int32 ``>= 0``, ``d`` int32 ``> 0``, ``r`` = f32 ``1/d`` computed
-    by an IEEE divide.  Two fixup rounds; see the eligibility proof.
+    by a correctly-rounded IEEE divide (:func:`scenario_reciprocals`).
+    One fixup round — exact for the proof's ±1 estimate set; see
+    :func:`rcp_division_eligible`.  (A second round was carried through
+    round 3 as margin; it is a proven no-op and cost ~10% of the kernel.)
     """
     q = jnp.floor(a.astype(jnp.float32) * r).astype(jnp.int32)
-    for _ in range(2):
-        rem = a - q * d
-        q = q + (rem >= d).astype(jnp.int32) - (rem < 0).astype(jnp.int32)
-    return q
+    rem = a - q * d
+    return q + (rem >= d).astype(jnp.int32) - (rem < 0).astype(jnp.int32)
 
 
 def _epilogue(fit, ap, pc, mk, strict: bool):
@@ -299,9 +305,10 @@ def _sweep_pallas_padded_rcp(
     *, strict=False, interpret=False,
 ):
     """Reciprocal-division variant: ``crr``/``mrr`` are f32 ``(S, 1)``
-    reciprocals of ``cr``/``mr`` produced by an IEEE divide (numpy f64
-    halved to f32, or an XLA f32 divide — both within the proof's 1-ulp
-    budget).  Only valid on :func:`rcp_division_eligible` inputs."""
+    reciprocals of ``cr``/``mr`` staged through
+    :func:`scenario_reciprocals` — the one sanctioned producer (correctly
+    rounded; the single-fixup proof depends on it).  Only valid on
+    :func:`rcp_division_eligible` inputs."""
     return _pallas_dispatch(
         ac, am, ap, uc, um, pc, mk, cr, mr, crr, mrr,
         use_rcp=True, strict=strict, interpret=interpret,
