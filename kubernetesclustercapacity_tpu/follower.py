@@ -51,6 +51,7 @@ class ClusterFollower:
         kubeconfig: str | None = None,
         *,
         semantics: str = "reference",
+        extended_resources: tuple[str, ...] = (),
         context: str | None = None,
         client_factory=None,
         on_event=None,
@@ -76,6 +77,7 @@ class ClusterFollower:
 
         self._factory = client_factory
         self._semantics = semantics
+        self._extended = tuple(extended_resources)
         self.on_event = on_event
         self._stop_on_idle_window = stop_on_idle_window
         self._idle_backoff = idle_rewatch_backoff
@@ -174,7 +176,11 @@ class ClusterFollower:
                 key = "nodes" if kind == "Node" else "pods"
                 fixture[key] = [convert(o) for o in items]
                 versions[path] = version
-            store = ClusterStore(fixture, semantics=self._semantics)
+            store = ClusterStore(
+                fixture,
+                semantics=self._semantics,
+                extended_resources=self._extended,
+            )
         finally:
             client.close()
         with self._lock:

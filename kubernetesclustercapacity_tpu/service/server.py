@@ -523,6 +523,14 @@ class CapacityServer:
 
     def _op_reload(self, msg: dict) -> dict:
         path = msg["path"]
+        # Default to the columns currently served so a reload cannot
+        # silently drop the extended surface sweep_multi clients rely on;
+        # an explicit list in the message overrides.
+        extended = tuple(
+            msg.get("extended_resources")
+            if msg.get("extended_resources") is not None
+            else sorted(self.snapshot.extended)
+        )
         if self._reload_roots:
             import os
 
@@ -540,7 +548,14 @@ class CapacityServer:
                     f"reload path {path!r} outside the allowed roots"
                 )
             path = real
-        new_fixture, new_snap, _ = resolve_source(path, msg.get("semantics"))
+        # An unspecified semantics keeps the CURRENTLY-SERVED packing (a
+        # plain reload must not flip a strict server to reference and
+        # strand its extended/sweep_multi clients).
+        new_fixture, new_snap, _ = resolve_source(
+            path,
+            msg.get("semantics") or self.snapshot.semantics,
+            extended_resources=extended,
+        )
         self.replace_snapshot(new_snap, new_fixture)
         return {"nodes": new_snap.n_nodes, "semantics": new_snap.semantics}
 
@@ -600,6 +615,11 @@ def main(argv=None) -> int:
     p.add_argument("-host", default="127.0.0.1")
     p.add_argument("-semantics", choices=("reference", "strict"),
                    default=None)
+    p.add_argument("-extended-resources", default="",
+                   dest="extended_resources", metavar="NAMES",
+                   help="comma-separated extra resource columns to pack "
+                        "(strict semantics; e.g. nvidia.com/gpu,"
+                        "ephemeral-storage) — enables sweep_multi over them")
     p.add_argument("-coalesce-ms", type=int, default=100, dest="coalesce_ms",
                    help="min interval between snapshot repacks under "
                         "-follow churn (0 = repack on every event)")
@@ -632,17 +652,31 @@ def main(argv=None) -> int:
             print("ERROR : auth token file is empty", file=sys.stderr)
             return 1
 
+    extended = tuple(
+        r.strip() for r in args.extended_resources.split(",") if r.strip()
+    )
     follower = None
     try:
         if args.follow:
+            # The fixture path's strict-only rule lives in resolve_source;
+            # the follower packs directly, so mirror it here.
+            if extended and (args.semantics or "reference") != "strict":
+                raise ValueError(
+                    "-extended-resources requires -semantics strict "
+                    "(reference semantics has no extended-column concept)"
+                )
             from kubernetesclustercapacity_tpu.follower import ClusterFollower
 
             follower = ClusterFollower(
-                args.kubeconfig, semantics=args.semantics or "reference"
+                args.kubeconfig,
+                semantics=args.semantics or "reference",
+                extended_resources=extended,
             ).start(watch=False)
             snap, fixture = follower.snapshot(), follower.fixture_view()
         elif args.snapshot:
-            fixture, snap, _ = resolve_source(args.snapshot, args.semantics)
+            fixture, snap, _ = resolve_source(
+                args.snapshot, args.semantics, extended_resources=extended
+            )
         else:
             raise ValueError("one of -snapshot or -follow is required")
     except Exception as e:

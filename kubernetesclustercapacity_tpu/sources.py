@@ -26,16 +26,22 @@ class SourceError(ValueError):
 
 
 def resolve_source(
-    path: str, semantics: str | None
+    path: str,
+    semantics: str | None,
+    extended_resources: tuple[str, ...] = (),
 ) -> tuple[dict | None, ClusterSnapshot, str]:
     """Load a fixture/.npz source → ``(fixture|None, snapshot, semantics)``.
 
     ``semantics=None`` means "not explicitly requested": adopt the
     checkpoint's stored packing for ``.npz``, default ``reference``
-    otherwise.
+    otherwise.  ``extended_resources`` names extra columns to pack from a
+    fixture (strict semantics only — reference has no concept of them);
+    a ``.npz`` checkpoint must already CARRY every requested column
+    (columns cannot be re-derived without the raw objects).
     """
     import os
 
+    extended_resources = tuple(extended_resources)
     if not os.path.exists(path):
         raise SourceError(f"snapshot file not found: {path}")
     if path.endswith(".npz"):
@@ -45,7 +51,29 @@ def resolve_source(
                 f"snapshot {path} was packed with -semantics "
                 f"{snap.semantics}; re-pack from a fixture to run {semantics}"
             )
+        missing = sorted(set(extended_resources) - set(snap.extended))
+        if missing:
+            raise SourceError(
+                f"snapshot {path} carries no extended column(s) {missing}; "
+                "re-pack from a fixture with -extended-resources"
+            )
         return None, snap, snap.semantics
     semantics = semantics or "reference"
+    if extended_resources and semantics != "strict":
+        # One place owns this rule (the module contract): silently packing
+        # without the requested columns would strand every front-end's
+        # sweep_multi surface with no error.
+        raise SourceError(
+            "extended resources require strict semantics (reference "
+            "semantics has no extended-column concept)"
+        )
     fixture = load_fixture(path)
-    return fixture, snapshot_from_fixture(fixture, semantics=semantics), semantics
+    return (
+        fixture,
+        snapshot_from_fixture(
+            fixture,
+            semantics=semantics,
+            extended_resources=extended_resources,
+        ),
+        semantics,
+    )

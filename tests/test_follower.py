@@ -304,3 +304,26 @@ class TestFailureVisibility:
         assert {f"churn-{i}" for i in range(30)} <= set(pod_names)
         with f._lock:
             assert_matches_repack(f._store)
+
+
+class TestExtendedResources:
+    def test_follower_packs_extended_columns(self, srv):
+        fixture, server = srv
+        # Decorate the served nodes with a GPU allocatable; re-serve.
+        for n in fixture["nodes"]:
+            n["allocatable"]["nvidia.com/gpu"] = "4"
+        server2 = MockApiserver(fixture, require_token="tok")
+        try:
+            cfg = KubeConfig(f"http://127.0.0.1:{server2.port}", token="tok")
+            f = ClusterFollower(
+                client_factory=lambda: KubeClient(cfg),
+                semantics="strict",
+                extended_resources=("nvidia.com/gpu",),
+                stop_on_idle_window=True,
+            ).start(watch=False)
+            snap = f.snapshot()
+        finally:
+            server2.close()
+        assert "nvidia.com/gpu" in snap.extended
+        alloc, _used = snap.extended["nvidia.com/gpu"]
+        assert (alloc == 4).all()

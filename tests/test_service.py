@@ -744,3 +744,85 @@ class TestGuardrails:
             env=dict(os.environ, KCCAP_AUTH_TOKEN="s3cret"),
         )
         assert proc.returncode == 0, proc.stderr
+
+
+class TestExtendedSources:
+    def test_resolve_source_extended_json_and_npz(self, tmp_path):
+        import numpy as np
+
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+        from kubernetesclustercapacity_tpu.sources import (
+            SourceError,
+            resolve_source,
+        )
+
+        fx = synthetic_fixture(5, seed=9)
+        for n in fx["nodes"]:
+            n["allocatable"]["nvidia.com/gpu"] = "2"
+        p = tmp_path / "gpu.json"
+        p.write_text(json.dumps(fx))
+        _, snap, _ = resolve_source(
+            str(p), "strict", extended_resources=("nvidia.com/gpu",)
+        )
+        assert (snap.extended["nvidia.com/gpu"][0] == 2).all()
+
+        ckpt = tmp_path / "gpu.npz"
+        snap.save(str(ckpt))
+        _, snap2, _ = resolve_source(
+            str(ckpt), None, extended_resources=("nvidia.com/gpu",)
+        )
+        np.testing.assert_array_equal(
+            snap2.extended["nvidia.com/gpu"][0],
+            snap.extended["nvidia.com/gpu"][0],
+        )
+        with pytest.raises(SourceError, match="no extended column"):
+            resolve_source(
+                str(ckpt), None, extended_resources=("amd.com/gpu",)
+            )
+
+    def test_reference_plus_extended_rejected_at_resolution(self, tmp_path):
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+        from kubernetesclustercapacity_tpu.sources import (
+            SourceError,
+            resolve_source,
+        )
+
+        fx = synthetic_fixture(3, seed=1)
+        p = tmp_path / "fx.json"
+        p.write_text(json.dumps(fx))
+        for semantics in (None, "reference"):
+            with pytest.raises(SourceError, match="strict semantics"):
+                resolve_source(
+                    str(p), semantics, extended_resources=("nvidia.com/gpu",)
+                )
+
+    def test_server_sweep_multi_over_extended_columns(self, tmp_path):
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+
+        fx = synthetic_fixture(20, seed=10)
+        for n in fx["nodes"]:
+            n["allocatable"]["nvidia.com/gpu"] = "8"
+        snap = snapshot_from_fixture(
+            fx, semantics="strict", extended_resources=("nvidia.com/gpu",)
+        )
+        srv = CapacityServer(snap, port=0, fixture=fx)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                assert c.info()["extended_resources"] == ["nvidia.com/gpu"]
+                r = c.sweep_multi(
+                    resources=("cpu", "memory", "nvidia.com/gpu"),
+                    requests=[[500, 256 << 20, 2], [500, 256 << 20, 0]],
+                    replicas=[1, 1],
+                )
+                # A GPU-free spec fits at least as many replicas.
+                assert r["totals"][1] >= r["totals"][0]
+                # Reload keeps the extended surface by default.
+                p = tmp_path / "fx.json"
+                p.write_text(json.dumps(fx))
+                c.reload(str(p))  # no semantics: keeps the served packing
+                info = c.info()
+                assert info["semantics"] == "strict"
+                assert info["extended_resources"] == ["nvidia.com/gpu"]
+        finally:
+            srv.shutdown()
