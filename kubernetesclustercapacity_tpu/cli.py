@@ -75,6 +75,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "bit-exact) or exact (force the int64 XLA kernel)")
     p.add_argument("-save-snapshot", default="", metavar="PATH",
                    help="checkpoint the packed snapshot to PATH (.npz)")
+    p.add_argument("-extended-resources", default="",
+                   dest="extended_resources", metavar="NAMES",
+                   help="comma-separated extra resource columns to pack "
+                        "(requires -semantics strict; e.g. nvidia.com/gpu)")
+    p.add_argument("-extended-request", action="append", default=[],
+                   dest="extended_requests", metavar="NAME=QTY",
+                   help="per-replica request for an extended resource "
+                        "(repeatable; strict quantity grammar, e.g. "
+                        "nvidia.com/gpu=2, ephemeral-storage=10Gi)")
     return p
 
 
@@ -149,7 +158,8 @@ def _load_source(args):
 
         try:
             fixture, snap, semantics = resolve_source(
-                args.snapshot, args.semantics
+                args.snapshot, args.semantics,
+                extended_resources=_extended_names(args),
             )
         except SourceError as e:
             print(f"ERROR : {e}")
@@ -158,14 +168,58 @@ def _load_source(args):
         return fixture, snap
     if args.semantics is None:
         args.semantics = "reference"
+    extended = _extended_names(args)
+    if extended and args.semantics != "strict":
+        # Same rule resolve_source owns for file sources: never silently
+        # pack without the requested columns.
+        print("ERROR : extended resources require strict semantics "
+              "(reference semantics has no extended-column concept)")
+        return None, None
     try:
         return None, snapshot_from_live_cluster(
-            args.kubeconfig or None, semantics=args.semantics
+            args.kubeconfig or None, semantics=args.semantics,
+            extended_resources=extended,
         )
     except Exception as e:  # mirrors the reference's panic on bad kubeconfig
         print(f"ERROR : cannot snapshot live cluster: {e}")
         print("hint: use -snapshot <fixture.json|checkpoint.npz> for offline runs")
         return None, None
+
+
+def _extended_names(args) -> tuple[str, ...]:
+    """Columns to pack: the -extended-resources list plus every
+    -extended-request name (a requested resource must have a column)."""
+    names = {
+        r.strip() for r in args.extended_resources.split(",") if r.strip()
+    }
+    for spec in args.extended_requests:
+        name = spec.partition("=")[0].strip()
+        if name:
+            names.add(name)
+    return tuple(sorted(names))
+
+
+def _parse_extended_requests(args) -> dict[str, int] | None:
+    """``-extended-request name=qty`` pairs → {name: int} (strict grammar)."""
+    from kubernetesclustercapacity_tpu.utils.quantity import (
+        QuantityParseError,
+        parse_quantity,
+    )
+
+    out: dict[str, int] = {}
+    for spec in args.extended_requests:
+        name, eq, qty = spec.partition("=")
+        name = name.strip()
+        if not name or not eq:
+            print(f"ERROR : -extended-request wants NAME=QTY, got {spec!r} "
+                  "...exiting")
+            return None
+        try:
+            out[name] = parse_quantity(qty.strip()).value()
+        except QuantityParseError as e:
+            print(f"ERROR : -extended-request {name}: {e} ...exiting")
+            return None
+    return out
 
 
 def _run_single(args, fixture, snapshot, scenario) -> int:
@@ -176,11 +230,39 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
         reference_run,
     )
     from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
-    from kubernetesclustercapacity_tpu.report import (
-        json_report,
-        reference_report,
-        table_report,
-    )
+
+    ext_requests = _parse_extended_requests(args)
+    if ext_requests is None:
+        return 1
+    if ext_requests:
+        # R-dim fit: route through the model facade (R-way min + implicit
+        # strict mask, same dispatch the service's fit op uses).  The
+        # cpu/native backends implement the 2-resource walk only.
+        if args.backend != "tpu":
+            print("ERROR : -extended-request needs -backend tpu ...exiting")
+            return 1
+        from kubernetesclustercapacity_tpu.models import (
+            CapacityModel,
+            PodSpec,
+        )
+
+        try:
+            result = CapacityModel(
+                snapshot, mode=args.semantics, fixture=fixture
+            ).evaluate(
+                PodSpec(
+                    cpu_request_milli=scenario.cpu_request_milli,
+                    mem_request_bytes=scenario.mem_request_bytes,
+                    replicas=scenario.replicas,
+                    cpu_limit_milli=scenario.cpu_limit_milli,
+                    mem_limit_bytes=scenario.mem_limit_bytes,
+                    extended_requests=ext_requests,
+                )
+            )
+        except (KeyError, ValueError) as e:
+            print(f"ERROR : extended-resource fit failed: {e} ...exiting")
+            return 1
+        return _emit_report(args, snapshot, result.fits, scenario)
 
     if args.backend == "native":
         from kubernetesclustercapacity_tpu import native
@@ -249,9 +331,20 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
     # service sweep, -grid, and this single-spec path) — same mask, same
     # zeroing the fit kernel's node_mask performs, for all three backends.
     # None (so a no-op, preserving byte parity) under reference semantics.
+    # (The extended-request path above returned already: CapacityModel
+    # applies the identical implicit mask itself.)
     mask = implicit_taint_mask(snapshot)
     if mask is not None:
         fits = np.where(mask, fits, 0)
+    return _emit_report(args, snapshot, fits, scenario)
+
+
+def _emit_report(args, snapshot, fits, scenario) -> int:
+    from kubernetesclustercapacity_tpu.report import (
+        json_report,
+        reference_report,
+        table_report,
+    )
 
     if args.output == "json":
         print(json_report(snapshot, fits, scenario))
@@ -264,24 +357,68 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
 
 def _run_grid(args, snapshot) -> int:
     from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
-    from kubernetesclustercapacity_tpu.ops.pallas_fit import sweep_snapshot_auto
     from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
 
+    ext_requests = _parse_extended_requests(args)
+    if ext_requests is None:
+        return 1
     grid = random_scenario_grid(args.grid, seed=args.seed)
     # Strict grids honor hard taints exactly like single-spec strict fits
     # (and the service's fit/sweep ops) — one spec, one answer, any surface.
-    totals, sched, kernel = sweep_snapshot_auto(
-        snapshot,
-        grid,
-        mode=args.semantics,
-        kernel=args.kernel,
-        node_mask=implicit_taint_mask(snapshot),
-    )
+    mask = implicit_taint_mask(snapshot)
+    if ext_requests:
+        # Random cpu/mem grid with a CONSTANT extended request per name on
+        # every scenario; dispatched through the R-dim auto kernel with
+        # healthy/taint masking identical to the 2-resource path.
+        from kubernetesclustercapacity_tpu.ops.pallas_multi import (
+            sweep_multi_auto,
+        )
+        from kubernetesclustercapacity_tpu.scenario import MultiResourceGrid
+
+        mgrid = MultiResourceGrid.from_grid(
+            grid,
+            {
+                name: np.full(grid.size, qty, dtype=np.int64)
+                for name, qty in ext_requests.items()
+            },
+        )
+        try:
+            alloc_rn, used_rn = snapshot.resource_matrix(mgrid.resources)
+        except KeyError as e:
+            print(f"ERROR : snapshot has no extended column {e} ...exiting")
+            return 1
+        totals, sched, kernel = sweep_multi_auto(
+            alloc_rn,
+            used_rn,
+            snapshot.alloc_pods,
+            snapshot.pods_count,
+            snapshot.healthy,
+            mgrid.requests,
+            mgrid.replicas,
+            mode=args.semantics,
+            node_masks=mask,
+            force_exact=(args.kernel == "exact"),
+        )
+    else:
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            sweep_snapshot_auto,
+        )
+
+        totals, sched, kernel = sweep_snapshot_auto(
+            snapshot,
+            grid,
+            mode=args.semantics,
+            kernel=args.kernel,
+            node_mask=mask,
+        )
     summary = {
         "scenarios": args.grid,
         "seed": args.seed,
         "semantics": args.semantics,
         "kernel": kernel,
+        **(
+            {"extended_requests": ext_requests} if ext_requests else {}
+        ),
         "totals": totals.tolist(),
         "schedulable": sched.tolist(),
         "totals_p50": float(np.percentile(totals, 50)),

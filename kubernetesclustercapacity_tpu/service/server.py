@@ -197,7 +197,7 @@ class CapacityServer:
         if op == "place":
             return self._op_place(msg, snap, fixture)
         if op == "reload":
-            return self._op_reload(msg)
+            return self._op_reload(msg, snap)
         if op == "update":
             return self._op_update(msg)
         raise ValueError(f"unknown op {op!r}")
@@ -521,16 +521,23 @@ class CapacityServer:
             self._fixture_dirty = False
             self._implicit_mask = mask
 
-    def _op_reload(self, msg: dict) -> dict:
+    def _op_reload(self, msg: dict, snap: ClusterSnapshot) -> dict:
+        """``snap`` is the dispatch's lock-captured snapshot — reading
+        ``self.snapshot`` here could tear against a concurrent reload."""
         path = msg["path"]
-        # Default to the columns currently served so a reload cannot
-        # silently drop the extended surface sweep_multi clients rely on;
-        # an explicit list in the message overrides.
-        extended = tuple(
-            msg.get("extended_resources")
-            if msg.get("extended_resources") is not None
-            else sorted(self.snapshot.extended)
-        )
+        # An unspecified semantics keeps the CURRENTLY-SERVED packing (a
+        # plain reload must not flip a strict server to reference and
+        # strand its extended/sweep_multi clients); the extended columns
+        # default to the served set under the SAME resolved semantics —
+        # an explicit switch to reference deliberately drops them, and an
+        # explicit extended_resources list always wins.
+        semantics = msg.get("semantics") or snap.semantics
+        if msg.get("extended_resources") is not None:
+            extended = tuple(msg["extended_resources"])
+        elif semantics == "strict":
+            extended = tuple(sorted(snap.extended))
+        else:
+            extended = ()
         if self._reload_roots:
             import os
 
@@ -548,13 +555,8 @@ class CapacityServer:
                     f"reload path {path!r} outside the allowed roots"
                 )
             path = real
-        # An unspecified semantics keeps the CURRENTLY-SERVED packing (a
-        # plain reload must not flip a strict server to reference and
-        # strand its extended/sweep_multi clients).
         new_fixture, new_snap, _ = resolve_source(
-            path,
-            msg.get("semantics") or self.snapshot.semantics,
-            extended_resources=extended,
+            path, semantics, extended_resources=extended
         )
         self.replace_snapshot(new_snap, new_fixture)
         return {"nodes": new_snap.n_nodes, "semantics": new_snap.semantics}
@@ -658,13 +660,8 @@ def main(argv=None) -> int:
     follower = None
     try:
         if args.follow:
-            # The fixture path's strict-only rule lives in resolve_source;
-            # the follower packs directly, so mirror it here.
-            if extended and (args.semantics or "reference") != "strict":
-                raise ValueError(
-                    "-extended-resources requires -semantics strict "
-                    "(reference semantics has no extended-column concept)"
-                )
+            # The strict-only extended-columns rule is enforced by the
+            # packers themselves (ClusterStore / snapshot_from_fixture).
             from kubernetesclustercapacity_tpu.follower import ClusterFollower
 
             follower = ClusterFollower(

@@ -184,7 +184,19 @@ def snapshot_from_fixture(
     semantics: str = "reference",
     extended_resources: tuple[str, ...] = (),
 ) -> ClusterSnapshot:
-    """Pack a node/pod fixture into dense arrays under the chosen semantics."""
+    """Pack a node/pod fixture into dense arrays under the chosen semantics.
+
+    ``extended_resources`` is strict-only, enforced HERE (the packer) so
+    no front-end can silently produce a snapshot missing the columns a
+    caller asked for — the reference semantics has no extended-column
+    concept (its resource model is exactly cpu/memory/pods,
+    ``ClusterCapacity.go:41-46``).
+    """
+    if extended_resources and semantics != "strict":
+        raise ValueError(
+            "extended resources require strict semantics (reference "
+            "semantics has no extended-column concept)"
+        )
     if semantics == "reference":
         return _pack_reference(fixture)
     if semantics == "strict":
@@ -654,7 +666,10 @@ def synthetic_snapshot(
 
 
 def snapshot_from_live_cluster(
-    kubeconfig: str | None = None, *, semantics: str = "strict"
+    kubeconfig: str | None = None,
+    *,
+    semantics: str = "strict",
+    extended_resources: tuple[str, ...] = (),
 ) -> ClusterSnapshot:
     """Snapshot a live cluster via the Kubernetes Python client.
 
@@ -664,6 +679,7 @@ def snapshot_from_live_cluster(
     present (for its wider auth-provider support); otherwise falls back to
     the framework's own client (:mod:`..kubeapi`) — stdlib transport/auth
     plus PyYAML for the kubeconfig file, no Kubernetes client library.
+    ``extended_resources`` names extra columns to pack (strict only).
     """
     try:
         from kubernetes import client, config  # type: ignore[import-not-found]
@@ -671,7 +687,9 @@ def snapshot_from_live_cluster(
         from kubernetesclustercapacity_tpu.kubeapi import live_fixture
 
         return snapshot_from_fixture(
-            live_fixture(kubeconfig), semantics=semantics
+            live_fixture(kubeconfig),
+            semantics=semantics,
+            extended_resources=extended_resources,
         )
 
     config.load_kube_config(config_file=kubeconfig)  # pragma: no cover
@@ -728,4 +746,6 @@ def snapshot_from_live_cluster(
                 "initContainers": serialize_containers(p.spec.init_containers),
             }
         )
-    return snapshot_from_fixture(fixture, semantics=semantics)  # pragma: no cover
+    return snapshot_from_fixture(  # pragma: no cover
+        fixture, semantics=semantics, extended_resources=extended_resources
+    )

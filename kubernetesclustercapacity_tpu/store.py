@@ -70,6 +70,12 @@ class ClusterStore:
     ):
         if semantics not in ("reference", "strict"):
             raise ValueError(f"unknown semantics {semantics!r}")
+        if extended_resources and semantics != "strict":
+            # Same packer-level rule as snapshot_from_fixture: reference
+            # rows would silently carry all-zero extended columns.
+            raise StoreError(
+                "extended resources require strict semantics"
+            )
         self.semantics = semantics
         self.extended_resources = tuple(extended_resources)
         # Raw state, deep-copied: events must never alias caller objects.

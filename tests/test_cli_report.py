@@ -229,3 +229,128 @@ class TestCli:
                    "-output", "table"])
         assert rc == 0
         assert "SCHEDULABLE" in capsys.readouterr().out
+
+
+class TestExtendedRequestsCLI:
+    @pytest.fixture()
+    def gpu_fixture_path(self, tmp_path):
+        fx = synthetic_fixture(8, seed=13)
+        for n in fx["nodes"]:
+            n["allocatable"]["nvidia.com/gpu"] = "4"
+        p = tmp_path / "gpu.json"
+        p.write_text(json.dumps(fx))
+        return str(p)
+
+    def test_gpu_request_binds_capacity(self, gpu_fixture_path, capsys):
+        rc = main(["-snapshot", gpu_fixture_path, "-semantics", "strict",
+                   "-extended-request", "nvidia.com/gpu=2",
+                   "-cpuRequests=100m", "-memRequests=64mb",
+                   "-output", "json"])
+        assert rc == 0
+        gpu_limited = json.loads(capsys.readouterr().out)
+        rc = main(["-snapshot", gpu_fixture_path, "-semantics", "strict",
+                   "-cpuRequests=100m", "-memRequests=64mb",
+                   "-output", "json"])
+        assert rc == 0
+        unlimited = json.loads(capsys.readouterr().out)
+        # 4 GPUs / 2 per replica = at most 2 per node; far below cpu/mem fit.
+        assert gpu_limited["total_possible_replicas"] < unlimited[
+            "total_possible_replicas"]
+        per_node = [n["max_replicas"]
+                    for n in gpu_limited["nodes"] if n["healthy"]]
+        assert per_node and all(f <= 2 for f in per_node)
+
+    def test_matches_model_facade(self, gpu_fixture_path, capsys):
+        from kubernetesclustercapacity_tpu.models import (
+            CapacityModel,
+            PodSpec,
+        )
+        from kubernetesclustercapacity_tpu.sources import resolve_source
+
+        rc = main(["-snapshot", gpu_fixture_path, "-semantics", "strict",
+                   "-extended-request", "nvidia.com/gpu=1",
+                   "-output", "json"])
+        assert rc == 0
+        got = json.loads(capsys.readouterr().out)
+        fixture, snap, _ = resolve_source(
+            gpu_fixture_path, "strict",
+            extended_resources=("nvidia.com/gpu",),
+        )
+        from kubernetesclustercapacity_tpu.utils.quantity import (
+            to_bytes_reference,
+        )
+
+        want = CapacityModel(snap, mode="strict", fixture=fixture).evaluate(
+            PodSpec(cpu_request_milli=100,
+                    mem_request_bytes=to_bytes_reference("100mb"),
+                    replicas=1,
+                    extended_requests={"nvidia.com/gpu": 1})
+        )
+        assert got["total_possible_replicas"] == want.total
+
+    def test_quantity_grammar_for_extended(self, gpu_fixture_path, capsys):
+        rc = main(["-snapshot", gpu_fixture_path, "-semantics", "strict",
+                   "-extended-request", "nvidia.com/gpu=not-a-qty"])
+        assert rc == 1
+        assert "ERROR" in capsys.readouterr().out
+
+    def test_requires_tpu_backend(self, gpu_fixture_path, capsys):
+        rc = main(["-snapshot", gpu_fixture_path, "-semantics", "strict",
+                   "-backend", "cpu",
+                   "-extended-request", "nvidia.com/gpu=1"])
+        assert rc == 1
+        assert "-backend tpu" in capsys.readouterr().out
+
+    def test_reference_semantics_rejected(self, gpu_fixture_path, capsys):
+        rc = main(["-snapshot", gpu_fixture_path,
+                   "-extended-request", "nvidia.com/gpu=1"])
+        assert rc == 1
+        assert "strict semantics" in capsys.readouterr().out
+
+    def test_grid_with_extended_requests(self, gpu_fixture_path, capsys):
+        rc = main(["-snapshot", gpu_fixture_path, "-semantics", "strict",
+                   "-extended-request", "nvidia.com/gpu=2",
+                   "-grid", "6", "-output", "json"])
+        assert rc == 0
+        gpu = json.loads(capsys.readouterr().out)
+        assert gpu["extended_requests"] == {"nvidia.com/gpu": 2}
+        rc = main(["-snapshot", gpu_fixture_path, "-semantics", "strict",
+                   "-grid", "6", "-output", "json"])
+        assert rc == 0
+        plain = json.loads(capsys.readouterr().out)
+        # Same random cpu/mem grid; the GPU column can only bind tighter.
+        assert all(g <= p for g, p in zip(gpu["totals"], plain["totals"]))
+        assert any(g < p for g, p in zip(gpu["totals"], plain["totals"]))
+
+    def test_grid_extended_matches_exact_kernel(self, gpu_fixture_path,
+                                                capsys):
+        import numpy as np
+
+        from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_grid_multi
+        from kubernetesclustercapacity_tpu.scenario import (
+            MultiResourceGrid,
+            random_scenario_grid,
+        )
+        from kubernetesclustercapacity_tpu.sources import resolve_source
+
+        rc = main(["-snapshot", gpu_fixture_path, "-semantics", "strict",
+                   "-extended-request", "nvidia.com/gpu=1",
+                   "-grid", "5", "-seed", "3", "-output", "json"])
+        assert rc == 0
+        got = json.loads(capsys.readouterr().out)
+        _, snap, _ = resolve_source(
+            gpu_fixture_path, "strict",
+            extended_resources=("nvidia.com/gpu",),
+        )
+        grid = random_scenario_grid(5, seed=3)
+        mgrid = MultiResourceGrid.from_grid(
+            grid, {"nvidia.com/gpu": np.ones(5, dtype=np.int64)}
+        )
+        alloc_rn, used_rn = snap.resource_matrix(mgrid.resources)
+        exact = sweep_grid_multi(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, mgrid.requests, mgrid.replicas, mode="strict",
+            node_masks=implicit_taint_mask(snap),
+        )
+        assert got["totals"] == np.asarray(exact[0]).tolist()

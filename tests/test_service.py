@@ -826,3 +826,26 @@ class TestExtendedSources:
                 assert info["extended_resources"] == ["nvidia.com/gpu"]
         finally:
             srv.shutdown()
+
+    def test_explicit_reference_reload_drops_extended_cleanly(self, tmp_path):
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+
+        fx = synthetic_fixture(6, seed=12)
+        for n in fx["nodes"]:
+            n["allocatable"]["nvidia.com/gpu"] = "1"
+        snap = snapshot_from_fixture(
+            fx, semantics="strict", extended_resources=("nvidia.com/gpu",)
+        )
+        srv = CapacityServer(snap, port=0, fixture=fx)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                p = tmp_path / "fx.json"
+                p.write_text(json.dumps(fx))
+                # An EXPLICIT switch to reference packing must succeed,
+                # deliberately dropping the extended surface.
+                r = c.reload(str(p), semantics="reference")
+                assert r["semantics"] == "reference"
+                assert c.info()["extended_resources"] == []
+        finally:
+            srv.shutdown()
