@@ -59,6 +59,15 @@ class PodSpec:
     def __post_init__(self) -> None:
         if self.spread is not None and self.spread < 1:
             raise ValueError("spread must be >= 1 (or None for unlimited)")
+        for name, qty in self.extended_requests.items():
+            # Zero means "does not consume"; negative has no coherent
+            # semantics and the kernels disagree on it (the fit kernel
+            # divides as-is, placement would treat it as non-consuming) —
+            # reject at the spec so every surface stays consistent.
+            if int(qty) < 0:
+                raise ValueError(
+                    f"extended request {name!r} must be >= 0, got {qty}"
+                )
 
     @classmethod
     def from_scenario(cls, s: Scenario) -> "PodSpec":
@@ -200,6 +209,23 @@ class CapacityModel:
                 "reference semantics; pass allow_extensions=True"
             )
 
+    def _multi_fit_args(self, spec: PodSpec):
+        """The R-dim kernel operands for a spec with extended requests —
+        ONE definition of the row ordering and request vector, shared by
+        :meth:`evaluate` and :meth:`place` (their agreement is a pinned
+        invariant)."""
+        resources = ("cpu", "memory", *sorted(spec.extended_requests))
+        alloc_rn, used_rn = self.snapshot.resource_matrix(resources)
+        reqs = np.array(
+            [
+                spec.cpu_request_milli,
+                spec.mem_request_bytes,
+                *(spec.extended_requests[r] for r in resources[2:]),
+            ],
+            dtype=np.int64,
+        )
+        return alloc_rn, used_rn, reqs
+
     # -- evaluation --------------------------------------------------------
     def evaluate(self, spec: PodSpec) -> CapacityResult:
         """One spec → per-node fits + verdict.
@@ -234,16 +260,7 @@ class CapacityModel:
                 if mask is not None:  # keep masked nodes at 0 after the clamp
                     fits = np.where(mask, fits, 0)
         else:
-            resources = ("cpu", "memory", *sorted(spec.extended_requests))
-            alloc_rn, used_rn = snap.resource_matrix(resources)
-            reqs = np.array(
-                [
-                    spec.cpu_request_milli,
-                    spec.mem_request_bytes,
-                    *(spec.extended_requests[r] for r in resources[2:]),
-                ],
-                dtype=np.int64,
-            )
+            alloc_rn, used_rn, reqs = self._multi_fit_args(spec)
             fits = np.asarray(
                 fit_per_node_multi(
                     alloc_rn,
@@ -296,56 +313,68 @@ class CapacityModel:
         * ``"auto"`` (default) — scan up to :data:`PLACE_SCAN_MAX`
           replicas, bulk beyond (1k replicas on 10k nodes was 1k
           sequential argmin steps; nobody reads a 1k-row order table).
+
+        A spec with ``extended_requests`` routes to the R-resource engines
+        (:func:`..ops.placement.place_replicas_multi` / ``_bulk_multi``)
+        over the snapshot's extended columns — same policies, same
+        engine-selection rule.
         """
         from kubernetesclustercapacity_tpu.ops.placement import (
             place_replicas,
             place_replicas_bulk,
+            place_replicas_bulk_multi,
+            place_replicas_multi,
         )
 
-        if spec.extended_requests:
-            raise ValueError(
-                "placement simulates cpu/memory/pod-slots; evaluate() "
-                "handles extended-resource feasibility"
-            )
-        self._check_extensions(spec.constrained)
+        self._check_extensions(
+            spec.constrained or bool(spec.extended_requests)
+        )
         snap = self.snapshot
         mask = self._masks_for(spec)
-        args = (
-            snap.alloc_cpu_milli,
-            snap.alloc_mem_bytes,
-            snap.alloc_pods,
-            snap.used_cpu_req_milli,
-            snap.used_mem_req_bytes,
-            snap.pods_count,
-            snap.healthy,
-            spec.cpu_request_milli,
-            spec.mem_request_bytes,
-        )
         kwargs = dict(
             n_replicas=spec.replicas,
             policy=policy,
             node_mask=mask,
             max_per_node=spec.spread,
         )
-        use_bulk = (
-            (
-                assignments is False
-                or (
-                    assignments == "auto"
-                    and spec.replicas > self.PLACE_SCAN_MAX
-                )
+        if spec.extended_requests:
+            alloc_rn, used_rn, reqs = self._multi_fit_args(spec)
+            args = (
+                alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+                snap.healthy, reqs,
             )
+            scan_fn, bulk_fn = place_replicas_multi, place_replicas_bulk_multi
+            # The bulk multi engine needs at least one positive request
+            # row (the 2-resource rule generalized).
+            bulk_ok = (reqs > 0).any() and (reqs >= 0).all()
+        else:
+            args = (
+                snap.alloc_cpu_milli,
+                snap.alloc_mem_bytes,
+                snap.alloc_pods,
+                snap.used_cpu_req_milli,
+                snap.used_mem_req_bytes,
+                snap.pods_count,
+                snap.healthy,
+                spec.cpu_request_milli,
+                spec.mem_request_bytes,
+            )
+            scan_fn, bulk_fn = place_replicas, place_replicas_bulk
             # bulk requires positive requests; the scan tolerates 0 —
             # degenerate zero-request specs always take the scan so both
             # engine selections honor "identical per-node counts".
-            and spec.cpu_request_milli > 0
-            and spec.mem_request_bytes > 0
-        )
+            bulk_ok = (
+                spec.cpu_request_milli > 0 and spec.mem_request_bytes > 0
+            )
+        use_bulk = (
+            assignments is False
+            or (assignments == "auto" and spec.replicas > self.PLACE_SCAN_MAX)
+        ) and bulk_ok
         if use_bulk:
-            per_node, _ = place_replicas_bulk(*args, **kwargs)
+            per_node, _ = bulk_fn(*args, **kwargs)
             order = None
         else:
-            order, per_node = place_replicas(*args, **kwargs)
+            order, per_node = scan_fn(*args, **kwargs)
             order = np.asarray(order)
         return PlacementResult(
             assignments=order,

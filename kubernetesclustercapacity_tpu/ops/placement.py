@@ -37,6 +37,9 @@ __all__ = [
     "place_replicas",
     "place_replicas_bulk",
     "place_replicas_python",
+    "place_replicas_multi",
+    "place_replicas_bulk_multi",
+    "place_replicas_multi_python",
     "POLICIES",
 ]
 
@@ -391,6 +394,310 @@ def place_replicas_python(
             continue
         hc[best] -= cpu_req
         hm[best] -= mem_req
+        slots[best] -= 1
+        counts[best] += 1
+        assignments.append(best)
+    return assignments, counts
+
+
+# --- R-resource generalization (placement with GPUs / ephemeral-storage).
+#
+# Same engines, R resource rows instead of the fixed (cpu, mem) pair.  A
+# zero request row means "does not consume" (excluded from feasibility and
+# headroom updates), matching the R-dim fit kernel's convention.  All three
+# implementations accumulate the normalized-headroom score LEFT-TO-RIGHT
+# over rows in the caller's order, so their f64 values are bit-identical
+# and the bulk closed form's tie arguments carry over unchanged: each
+# per-row term is monotone non-increasing in the per-node placement count,
+# fl() and the left-fold sum are monotone, so plateaus can appear but the
+# order never inverts (the same argument place_replicas_bulk documents for
+# the 2-row case).
+
+
+@partial(jax.jit, static_argnames=("n_replicas", "policy", "max_per_node"))
+def place_replicas_multi(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_r,
+    *,
+    n_replicas: int,
+    policy: str = "first-fit",
+    node_mask=None,
+    max_per_node: int | None = None,
+):
+    """R-resource greedy placement scan — see :func:`place_replicas`.
+
+    ``alloc_rn``/``used_rn`` are ``[R, N]`` int64, ``reqs_r`` the ``[R]``
+    per-replica request vector (zero rows do not consume).
+    """
+    if policy not in POLICIES:
+        raise ValueError(f"unknown policy {policy!r} (want one of {POLICIES})")
+    alloc_rn = jnp.asarray(alloc_rn, jnp.int64)
+    reqs = jnp.asarray(reqs_r, jnp.int64)
+    n = alloc_rn.shape[1]
+    n_res = alloc_rn.shape[0]
+    active = reqs > 0  # [R]
+    eligible = jnp.asarray(healthy, jnp.bool_)
+    if node_mask is not None:
+        eligible = eligible & jnp.asarray(node_mask, jnp.bool_)
+
+    h0 = alloc_rn - jnp.asarray(used_rn, jnp.int64)  # [R, N]
+    slots0 = jnp.maximum(
+        jnp.asarray(alloc_pods, jnp.int64)
+        - jnp.asarray(pods_count, jnp.int64),
+        0,
+    )
+    idx_arange = jnp.arange(n)
+    sub = jnp.where(active, reqs, 0)[:, None]  # [R, 1] headroom delta
+
+    def score_of(h):
+        acc = jnp.zeros(n, dtype=jnp.float64)
+        for r in range(n_res):  # static unroll: row order = caller order
+            term = jnp.where(
+                alloc_rn[r] > 0,
+                (h[r] - sub[r, 0]).astype(jnp.float64)
+                / alloc_rn[r].astype(jnp.float64),
+                0.0,
+            )
+            acc = acc + term
+        return acc
+
+    def body(state, _):
+        h, slots, mine = state
+        feasible = (
+            jnp.all(~active[:, None] | (h >= reqs[:, None]), axis=0)
+            & (slots >= 1)
+            & eligible
+        )
+        if max_per_node is not None:
+            feasible = feasible & (mine < max_per_node)
+        if policy == "first-fit":
+            score = idx_arange.astype(jnp.float64)
+        else:
+            after = score_of(h)
+            score = after if policy == "best-fit" else -after
+        score = jnp.where(feasible, score, jnp.inf)
+        idx = jnp.argmin(score)
+        ok = feasible[idx]
+        one_hot = (idx_arange == idx) & ok
+        h = h - jnp.where(one_hot[None, :], sub, 0)
+        one = jnp.where(one_hot, jnp.int64(1), jnp.int64(0))
+        slots = slots - one
+        mine = mine + one
+        assignment = jnp.where(ok, idx.astype(jnp.int64), jnp.int64(-1))
+        return (h, slots, mine), assignment
+
+    mine0 = jnp.zeros(n, dtype=jnp.int64)
+    _, assignments = jax.lax.scan(
+        body, (h0, slots0, mine0), None, length=n_replicas
+    )
+    counts = jnp.sum(
+        (assignments[:, None] == idx_arange[None, :]), axis=0, dtype=jnp.int64
+    )
+    return assignments, counts
+
+
+def place_replicas_bulk_multi(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_r,
+    *,
+    n_replicas: int,
+    policy: str = "first-fit",
+    node_mask=None,
+    max_per_node: int | None = None,
+) -> tuple[np.ndarray, int]:
+    """Closed-form R-resource plan — see :func:`place_replicas_bulk`.
+
+    The 2-row proofs generalize verbatim: per-node capacity is the min
+    over ACTIVE rows of ``headroom // request`` (then slots/cap/mask), and
+    the score-after-j sequence is a left-fold of R monotone f64 terms —
+    monotone, plateau-capable, never order-inverting — so fill-in-order
+    (best-fit) and waterline-with-plateau-ties (spread) stay exact vs the
+    scan.  At least one request must be positive (an all-zero request
+    consumes only pod slots; use the 2-resource bulk engine's slot path
+    or the scan for that degenerate case).
+    """
+    if policy not in POLICIES:
+        raise ValueError(f"unknown policy {policy!r} (want one of {POLICIES})")
+    alloc_rn = np.asarray(alloc_rn, dtype=np.int64)
+    used_rn = np.asarray(used_rn, dtype=np.int64)
+    reqs = np.asarray(reqs_r, dtype=np.int64)
+    if (reqs < 0).any():
+        raise ValueError("requests must be >= 0")
+    if not (reqs > 0).any():
+        raise ValueError("bulk multi placement needs a positive request")
+    h0 = alloc_rn - used_rn  # [R, N]
+    slots = np.maximum(
+        np.asarray(alloc_pods, dtype=np.int64)
+        - np.asarray(pods_count, dtype=np.int64),
+        0,
+    )
+    eligible = np.asarray(healthy, dtype=bool)
+    if node_mask is not None:
+        eligible = eligible & np.asarray(node_mask, dtype=bool)
+
+    caps = slots.copy()
+    for r in range(alloc_rn.shape[0]):
+        if reqs[r] > 0:
+            row_cap = np.where(h0[r] >= reqs[r], h0[r] // reqs[r], 0)
+            caps = np.minimum(caps, row_cap)
+    if max_per_node is not None:
+        caps = np.minimum(caps, int(max_per_node))
+    caps = np.where(eligible, np.maximum(caps, 0), 0)
+
+    total = int(caps.sum())
+    r_want = int(n_replicas)
+    if r_want <= 0:
+        return np.zeros_like(caps), 0
+    if r_want >= total:
+        return caps.copy(), total
+
+    def fill_in_order(order: np.ndarray) -> np.ndarray:
+        k = caps[order]
+        before = np.concatenate(([0], np.cumsum(k)[:-1]))
+        got = np.clip(r_want - before, 0, k)
+        counts = np.zeros_like(caps)
+        counts[order] = got
+        return counts
+
+    if policy == "first-fit":
+        return fill_in_order(np.arange(caps.shape[0])), r_want
+
+    def score_after(j):
+        j1 = np.asarray(j, dtype=np.int64) + 1
+        acc = np.zeros(alloc_rn.shape[1], dtype=np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            for r in range(alloc_rn.shape[0]):
+                sub = int(reqs[r]) if reqs[r] > 0 else 0
+                term = np.where(
+                    alloc_rn[r] > 0,
+                    (h0[r] - j1 * sub).astype(np.float64)
+                    / alloc_rn[r].astype(np.float64),
+                    0.0,
+                )
+                acc = acc + term
+        return acc
+
+    if policy == "best-fit":
+        s0 = score_after(0)
+        order = np.lexsort((np.arange(caps.shape[0]), s0))
+        order = order[caps[order] > 0]
+        return fill_in_order(order), r_want
+
+    # spread: identical waterline machinery to the 2-row engine, over the
+    # generalized score_after.
+    feas = caps > 0
+    if not feas.any():
+        return np.zeros_like(caps), 0
+
+    def count_ge(theta: float) -> tuple[np.ndarray, int]:
+        lo = np.zeros_like(caps)
+        hi = caps.copy()
+        while True:
+            active_b = lo < hi
+            if not active_b.any():
+                break
+            mid = (lo + hi) // 2
+            ge = score_after(mid) >= theta
+            lo = np.where(active_b & ge, mid + 1, lo)
+            hi = np.where(active_b & ~ge, mid, hi)
+        cnt = np.where(feas, lo, 0)
+        return cnt, int(cnt.sum())
+
+    def f2i(x: float) -> int:
+        bits = np.float64(x).view(np.int64)
+        return int(bits if bits >= 0 else (-(1 << 63)) - bits - 1)
+
+    def i2f(i: int) -> float:
+        bits = i if i >= 0 else (-(1 << 63)) - i - 1
+        return float(np.int64(bits).view(np.float64))
+
+    smax = float(score_after(0)[feas].max())
+    smin = float(score_after(np.maximum(caps - 1, 0))[feas].min())
+    lo_i, hi_i = f2i(smin), f2i(smax) + 1
+    while hi_i - lo_i > 1:
+        mid = (lo_i + hi_i) // 2
+        if count_ge(i2f(mid))[1] >= r_want:
+            lo_i = mid
+        else:
+            hi_i = mid
+    theta = i2f(lo_i)
+    base, _n_ge = count_ge(theta)
+    strict, n_gt = count_ge(i2f(lo_i + 1))
+    at = base - strict
+    before = np.concatenate(([0], np.cumsum(at)[:-1]))
+    take = np.clip(r_want - n_gt - before, 0, at)
+    return strict + take, r_want
+
+
+def place_replicas_multi_python(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_r,
+    *,
+    n_replicas: int,
+    policy: str = "first-fit",
+    node_mask=None,
+    max_per_node: int | None = None,
+) -> tuple[list[int], list[int]]:
+    """Sequential ground truth for :func:`place_replicas_multi`."""
+    if policy not in POLICIES:
+        raise ValueError(f"unknown policy {policy!r}")
+    alloc_rn = [list(map(int, row)) for row in np.asarray(alloc_rn)]
+    used_rn = [list(map(int, row)) for row in np.asarray(used_rn)]
+    reqs = [int(x) for x in np.asarray(reqs_r)]
+    n = len(alloc_rn[0])
+    h = [
+        [alloc_rn[r][i] - used_rn[r][i] for i in range(n)]
+        for r in range(len(reqs))
+    ]
+    slots = [max(int(a) - int(p), 0) for a, p in zip(alloc_pods, pods_count)]
+    eligible = [
+        bool(healthy[i]) and (node_mask is None or bool(node_mask[i]))
+        for i in range(n)
+    ]
+    assignments: list[int] = []
+    counts = [0] * n
+    for _ in range(n_replicas):
+        best, best_score = -1, None
+        for i in range(n):
+            if not (
+                eligible[i]
+                and slots[i] >= 1
+                and all(
+                    reqs[r] == 0 or h[r][i] >= reqs[r]
+                    for r in range(len(reqs))
+                )
+                and (max_per_node is None or counts[i] < max_per_node)
+            ):
+                continue
+            if policy == "first-fit":
+                score = float(i)
+            else:
+                after = 0.0
+                for r in range(len(reqs)):
+                    if alloc_rn[r][i] > 0:
+                        sub = reqs[r] if reqs[r] > 0 else 0
+                        after += (h[r][i] - sub) / float(alloc_rn[r][i])
+                score = after if policy == "best-fit" else -after
+            if best_score is None or score < best_score:
+                best, best_score = i, score
+        if best < 0:
+            assignments.append(-1)
+            continue
+        for r in range(len(reqs)):
+            if reqs[r] > 0:
+                h[r][best] -= reqs[r]
         slots[best] -= 1
         counts[best] += 1
         assignments.append(best)

@@ -413,8 +413,10 @@ class CapacityServer:
                 policy=msg.get("policy", "first-fit"),
                 assignments=want_order,
             )
-        except (TypeError, ValueError) as e:
-            raise ValueError(str(e)) from e
+        except (TypeError, KeyError, ValueError) as e:
+            # KeyError: an extended request naming a column the snapshot
+            # does not carry (same shape _op_fit_spec wraps).
+            raise ValueError(f"bad pod spec: {e}") from e
         return {
             "assignments": (
                 None
@@ -660,8 +662,14 @@ def main(argv=None) -> int:
     follower = None
     try:
         if args.follow:
-            # The strict-only extended-columns rule is enforced by the
-            # packers themselves (ClusterStore / snapshot_from_fixture).
+            # The packers enforce the strict-only extended-columns rule as
+            # the backstop; checking argv here too avoids paying a full
+            # live-cluster LIST before a config error knowable up front.
+            if extended and (args.semantics or "reference") != "strict":
+                raise ValueError(
+                    "-extended-resources requires -semantics strict "
+                    "(reference semantics has no extended-column concept)"
+                )
             from kubernetesclustercapacity_tpu.follower import ClusterFollower
 
             follower = ClusterFollower(

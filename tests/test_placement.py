@@ -278,9 +278,12 @@ class TestModelAndService:
             model.place(spec, policy="spread", assignments=True).per_node,
         )
 
-    def test_model_place_rejects_extended(self, snap):
+    def test_model_place_unknown_extended_column_errors(self, snap):
+        # Placement with extended requests is supported (round 4); a
+        # request for a column the snapshot does not carry still fails
+        # loudly rather than placing without the constraint.
         model = CapacityModel(snap, mode="strict")
-        with pytest.raises(ValueError, match="extended"):
+        with pytest.raises(KeyError, match="nvidia.com/gpu"):
             model.place(
                 PodSpec(cpu_request_milli=1, mem_request_bytes=1,
                         extended_requests={"nvidia.com/gpu": 1})
@@ -328,3 +331,160 @@ class TestModelAndService:
                 assert b["placed"] == 5 and b["all_placed"] is True
         finally:
             srv.shutdown()
+
+
+class TestMultiResourcePlacement:
+    """R-resource engines (config 4 placement): scan vs Python truth vs
+    bulk closed form, including zero-request rows and f64 tie grids."""
+
+    @staticmethod
+    def _random_multi(trial: int):
+        rng = np.random.default_rng(1000 + trial)
+        n = int(rng.integers(4, 15))
+        alloc_rn = np.stack([
+            rng.integers(1000, 16000, n),        # cpu milli
+            rng.integers(1, 64, n) * (1 << 28),  # memory bytes
+            rng.integers(0, 9, n),               # gpus
+        ]).astype(np.int64)
+        used_rn = (alloc_rn * rng.random((3, n)) * 0.6).astype(np.int64)
+        alloc_pods = rng.integers(2, 30, n).astype(np.int64)
+        pods_count = rng.integers(0, 10, n).astype(np.int64)
+        healthy = rng.random(n) > 0.15
+        reqs = np.array(
+            [int(rng.integers(100, 900)),
+             int(rng.integers(1, 8)) * (1 << 27),
+             int(rng.integers(0, 3))],  # gpu row often zero (inactive)
+            dtype=np.int64,
+        )
+        mask = rng.random(n) > 0.2 if trial % 3 == 0 else None
+        mpn = int(rng.integers(1, 5)) if trial % 4 == 0 else None
+        args = (alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs)
+        return args, mask, mpn
+
+    @pytest.mark.parametrize("policy", POLICIES)
+    @pytest.mark.parametrize("trial", range(8))
+    def test_scan_matches_python_truth(self, policy, trial):
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_replicas_multi,
+            place_replicas_multi_python,
+        )
+
+        args, mask, mpn = self._random_multi(trial)
+        kw = dict(policy=policy, node_mask=mask, max_per_node=mpn,
+                  n_replicas=25)
+        a_scan, c_scan = place_replicas_multi(*args, **kw)
+        a_py, c_py = place_replicas_multi_python(*args, **kw)
+        np.testing.assert_array_equal(np.asarray(a_scan), np.asarray(a_py))
+        np.testing.assert_array_equal(np.asarray(c_scan), np.asarray(c_py))
+
+    @pytest.mark.parametrize("policy", POLICIES)
+    @pytest.mark.parametrize("trial", range(12))
+    def test_bulk_matches_truth_through_boundaries(self, policy, trial):
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_replicas_bulk_multi,
+            place_replicas_multi_python,
+        )
+
+        args, mask, mpn = self._random_multi(trial)
+        kw = dict(policy=policy, node_mask=mask, max_per_node=mpn)
+        _, c_full = place_replicas_multi_python(*args, n_replicas=300, **kw)
+        total = sum(c_full)
+        for r in sorted({0, 1, total // 2, max(total - 1, 0), total,
+                         total + 3}):
+            _, c_py = place_replicas_multi_python(*args, n_replicas=r, **kw)
+            c_bulk, placed = place_replicas_bulk_multi(
+                *args, n_replicas=r, **kw
+            )
+            np.testing.assert_array_equal(
+                c_bulk, np.asarray(c_py),
+                err_msg=f"{policy} trial={trial} r={r}")
+            assert placed == min(r, total)
+
+    @pytest.mark.parametrize("policy", ("best-fit", "spread"))
+    def test_adversarial_multi_ties(self, policy):
+        # Identical allocatables and headrooms across nodes: every step of
+        # every node's 3-term score sequence collides exactly in f64.
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_replicas_bulk_multi,
+            place_replicas_multi_python,
+        )
+
+        n = 5
+        alloc_rn = np.stack([
+            np.full(n, 4000), np.full(n, 1 << 32), np.full(n, 4),
+        ]).astype(np.int64)
+        used_rn = np.zeros_like(alloc_rn)
+        alloc_pods = np.full(n, 50, dtype=np.int64)
+        pods_count = np.zeros(n, dtype=np.int64)
+        healthy = np.ones(n, dtype=bool)
+        reqs = np.array([500, 1 << 29, 1], dtype=np.int64)
+        args = (alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs)
+        total = 4 * n  # gpu row binds: 4 per node
+        for r in range(0, total + 2):
+            _, c_py = place_replicas_multi_python(
+                *args, n_replicas=r, policy=policy
+            )
+            c_bulk, _ = place_replicas_bulk_multi(
+                *args, n_replicas=r, policy=policy
+            )
+            np.testing.assert_array_equal(c_bulk, np.asarray(c_py),
+                                          err_msg=f"{policy} r={r}")
+
+    def test_capacity_invariant_matches_fit_kernel(self):
+        from kubernetesclustercapacity_tpu.ops.fit import fit_per_node_multi
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_replicas_multi,
+        )
+
+        args, _, _ = self._random_multi(5)
+        alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs = args
+        fits = np.asarray(fit_per_node_multi(
+            alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs,
+            mode="strict",
+        ))
+        capacity = int(fits.sum())
+        _, counts = place_replicas_multi(
+            *args, n_replicas=capacity + 10, policy="first-fit"
+        )
+        assert int(np.asarray(counts).sum()) == capacity
+
+
+class TestModelExtendedPlacement:
+    def _gpu_model(self):
+        fx = synthetic_fixture(12, seed=77)
+        rng = np.random.default_rng(78)
+        for n in fx["nodes"]:
+            n["allocatable"]["nvidia.com/gpu"] = str(int(rng.integers(0, 5)))
+        snap = snapshot_from_fixture(
+            fx, semantics="strict", extended_resources=("nvidia.com/gpu",)
+        )
+        return CapacityModel(snap, mode="strict"), snap
+
+    def test_place_with_gpu_matches_evaluate_capacity(self):
+        model, snap = self._gpu_model()
+        spec = PodSpec(cpu_request_milli=200, mem_request_bytes=128 << 20,
+                       replicas=10_000,
+                       extended_requests={"nvidia.com/gpu": 1})
+        placement = model.place(spec, policy="first-fit")
+        capacity = model.evaluate(spec).total
+        assert placement.engine == "bulk"  # replicas > PLACE_SCAN_MAX
+        assert placement.placed == capacity
+        # GPU-less nodes took nothing.
+        gpu_alloc = snap.extended["nvidia.com/gpu"][0]
+        assert (placement.per_node[gpu_alloc == 0] == 0).all()
+
+    def test_scan_and_bulk_agree_through_model(self):
+        model, _ = self._gpu_model()
+        spec = PodSpec(cpu_request_milli=200, mem_request_bytes=128 << 20,
+                       replicas=7,
+                       extended_requests={"nvidia.com/gpu": 1})
+        scan = model.place(spec, policy="spread", assignments=True)
+        bulk = model.place(spec, policy="spread", assignments=False)
+        assert scan.engine == "scan" and bulk.engine == "bulk"
+        np.testing.assert_array_equal(scan.per_node, bulk.per_node)
+        assert scan.assignments is not None and bulk.assignments is None
+
+    def test_negative_extended_request_rejected_at_spec(self):
+        with pytest.raises(ValueError, match=">= 0"):
+            PodSpec(cpu_request_milli=100, mem_request_bytes=1 << 20,
+                    extended_requests={"nvidia.com/gpu": -1})
