@@ -50,6 +50,25 @@ def main() -> None:
         print(f"  {node:<24} {count}")
     assert placement.all_placed
 
+    # Placement understands extended resources too: pack GPU columns and
+    # the R-resource engines place only where GPUs exist.
+    for i, node in enumerate(fixture["nodes"]):
+        node["allocatable"]["nvidia.com/gpu"] = str(i)  # 0, 1, 2 GPUs
+    gsnap = kcc.snapshot_from_fixture(
+        fixture, semantics="strict",
+        extended_resources=("nvidia.com/gpu",),
+    )
+    gmodel = CapacityModel(gsnap, mode="strict", fixture=fixture)
+    gplace = gmodel.place(
+        PodSpec(cpu_request_milli=100, mem_request_bytes=128 << 20,
+                replicas=3, extended_requests={"nvidia.com/gpu": 1},
+                tolerations=({"operator": "Exists"},)),
+        policy="first-fit",
+    )
+    print(f"\nGPU placement (1 GPU per replica): {gplace.by_node()}")
+    assert gplace.all_placed
+    assert gplace.by_node().get(fixture["nodes"][0]["name"], 0) == 0
+
 
 if __name__ == "__main__":
     main()

@@ -488,3 +488,32 @@ class TestModelExtendedPlacement:
         with pytest.raises(ValueError, match=">= 0"):
             PodSpec(cpu_request_milli=100, mem_request_bytes=1 << 20,
                     extended_requests={"nvidia.com/gpu": -1})
+
+    def test_service_place_with_extended_requests(self):
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        fx = synthetic_fixture(10, seed=81)
+        for i, n in enumerate(fx["nodes"]):
+            n["allocatable"]["nvidia.com/gpu"] = str(i % 3)  # some zero
+        snap = snapshot_from_fixture(
+            fx, semantics="strict", extended_resources=("nvidia.com/gpu",)
+        )
+        srv = CapacityServer(snap, port=0, fixture=fx)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                r = c.place(cpuRequests="100m", memRequests="64mb",
+                            replicas="5",
+                            extended_requests={"nvidia.com/gpu": 1})
+                assert r["placed"] == 5 and r["all_placed"]
+                gpu_alloc = snap.extended["nvidia.com/gpu"][0]
+                for name, count in r["by_node"].items():
+                    i = snap.names.index(name)
+                    assert gpu_alloc[i] >= count  # only GPU nodes took pods
+                with pytest.raises(RuntimeError, match="bad pod spec"):
+                    c.place(extended_requests={"no-such-column": 1})
+        finally:
+            srv.shutdown()
