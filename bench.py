@@ -46,9 +46,12 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 K_SMALL, K_BIG = 8, 64  # scan lengths for the slope measurement
-REPS = 9  # timed repetitions per scan length (same staged batch; jit does
+REPS = 13  # timed repetitions per scan length (same staged batch; jit does
 # not memoize results, so re-running identical inputs re-executes the
-# kernel — staging once keeps slow tunnel transfers off the rep loop)
+# kernel — staging once keeps slow tunnel transfers off the rep loop).
+# Each rep is ~one tunnel round trip; min-of-13 tightens the slope's two
+# endpoints against the ~65 ms dispatch jitter that dominated run-to-run
+# headline variance (observed 0.51-0.92 ms across identical code).
 
 _METRIC = "sweep_10k_nodes_x_1k_scenarios_p50"
 
@@ -564,7 +567,7 @@ def _run() -> None:
         # Fused kernels sweep in <1 ms, so the (4,16) scan delta (~10-30 ms)
         # drowns in tunnel dispatch jitter (~65 ms floor); fused ladder
         # variants use the headline's scan lengths and more reps instead.
-        aux_fast = dict(ks=(K_SMALL, K_BIG), reps=5)
+        aux_fast = dict(ks=(K_SMALL, K_BIG), reps=7)
         rng = np.random.default_rng(7)
 
         def scan_runner(step):
