@@ -248,17 +248,17 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
         )
 
     # -- columnar pod walk (the ΣP hot path) --
-    # Two intern tables: cpu strings are fetched with the rowwise walk's
-    # own `.get("cpu", "0")` default, so an explicit-null cpu reaches the
-    # codec and raises exactly as the per-row oracle does; memory seeds the
-    # None→0 slot (absent or null memory is Value() 0 on both paths).
-    cpu_strings, cpu_code = _interner(seed_none=False)
-    mem_strings, mem_code = _interner()
-
+    # Each container's four quantity strings intern as ONE tuple key (a
+    # cluster has few distinct request shapes — one dict lookup and one
+    # append per container instead of four of each).  cpu slots carry the
+    # rowwise walk's own `.get("cpu", "0")` default, so an explicit-null
+    # cpu reaches the codec at LUT-build time and raises exactly as the
+    # per-row oracle does; absent/null memory is Value() 0 on both paths.
+    interned: dict = {}  # quad tuple -> code; keys in insertion order
     name_gid: dict[str, int] = {}
     pod_gids: list[int] = []  # per surviving pod: its name group
     c_gids: list[int] = []  # per container: its pod's name group
-    c_cols: tuple[list[int], ...] = ([], [], [], [])  # creq, clim, mreq, mlim
+    c_codes: list[int] = []  # per container: its quad code
     for pod in fixture.get("pods", []):
         if not _oracle._survives_field_selector(pod):
             continue
@@ -267,24 +267,23 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
         for c in pod.get("containers", []):
             res = c.get("resources", {})
             req, lim = res.get("requests", {}), res.get("limits", {})
+            quad = (
+                req.get("cpu", "0"),
+                lim.get("cpu", "0"),
+                req.get("memory"),
+                lim.get("memory"),
+            )
             c_gids.append(gid)
-            c_cols[0].append(cpu_code(req.get("cpu", "0")))
-            c_cols[1].append(cpu_code(lim.get("cpu", "0")))
-            c_cols[2].append(mem_code(req.get("memory")))
-            c_cols[3].append(mem_code(lim.get("memory")))
+            c_codes.append(interned.setdefault(quad, len(interned)))
 
     if name_gid and n:
-        lut_cpu = np.fromiter(
-            (
-                _clamp_i64(_q.cpu_to_milli_reference(s))
-                for s in cpu_strings
-            ),
-            np.int64, len(cpu_strings),
-        )
-        lut_mem = np.fromiter(
-            (_clamp_i64(_oracle._mem_value(s)) for s in mem_strings),
-            np.int64, len(mem_strings),
-        )
+        # Per-column LUTs over the distinct quads: each string parses once.
+        lut = np.empty((4, len(interned)), dtype=np.int64)
+        for qi, quad in enumerate(interned):
+            lut[0, qi] = _clamp_i64(_q.cpu_to_milli_reference(quad[0]))
+            lut[1, qi] = _clamp_i64(_q.cpu_to_milli_reference(quad[1]))
+            lut[2, qi] = _clamp_i64(_oracle._mem_value(quad[2]))
+            lut[3, qi] = _clamp_i64(_oracle._mem_value(quad[3]))
         g = len(name_gid)
         by_name = {
             k: np.zeros(g, dtype=np.int64)
@@ -292,15 +291,11 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
         }
         np.add.at(by_name["count"], np.asarray(pod_gids, np.int64), 1)
         cg = np.asarray(c_gids, np.int64)
-        for key, col, lut in (
-            ("creq", 0, lut_cpu),
-            ("clim", 1, lut_cpu),
-            ("mreq", 2, lut_mem),
-            ("mlim", 3, lut_mem),
+        cc = np.asarray(c_codes, np.int64)
+        for key, row in (
+            ("creq", 0), ("clim", 1), ("mreq", 2), ("mlim", 3),
         ):
-            np.add.at(
-                by_name[key], cg, lut[np.asarray(c_cols[col], np.int64)]
-            )
+            np.add.at(by_name[key], cg, lut[row][cc])
         row_gid = np.fromiter(
             (name_gid.get(nm, -1) for nm in names), np.int64, n
         )
@@ -401,25 +396,23 @@ def _pack_strict(
             ext[r][0][i] = _strict_parse(allocatable.get(r))
 
     # Columnar pod ingestion — the 100k-pod hot path.  One Python walk
-    # collects quantity-string INTERN CODES into flat per-container
-    # columns; each distinct string is parsed exactly once into a lookup
-    # table; every piece of arithmetic after that (per-pod container
-    # sums, init-container peaks, the scheduler's ``max(sum, init_peak)``
-    # rule, per-node totals) is a numpy gather/scatter.  Replaces a
-    # per-pod ``_effective_pod_resources`` walk (which remains the
-    # single-pod path for watch-event updates, ``store.py``) that spent
-    # ~5µs/pod on dict building and memoized-parse call overhead;
-    # semantics are pinned equal by
+    # interns each container's quantity strings (cpu req/lim, mem
+    # req/lim, extended requests) as ONE tuple key — a cluster has few
+    # distinct request shapes, so this is one dict lookup and one append
+    # per container; each distinct tuple then parses once into per-column
+    # lookup tables, and every piece of arithmetic after that (per-pod
+    # container sums, init-container peaks, the scheduler's
+    # ``max(sum, init_peak)`` rule, per-node totals) is a numpy
+    # gather/scatter.  Replaces a per-pod ``_effective_pod_resources``
+    # walk (which remains the single-pod path for watch-event updates,
+    # ``store.py``); semantics are pinned equal by
     # ``tests/test_snapshot.py::TestStrictColumnarParity``.
-    strings, code = _interner()
-
+    interned: dict = {}  # quad tuple -> code; keys in insertion order
     pod_nodes: list[int] = []
     c_pod: list[int] = []  # container -> pod ordinal
-    c_cols: tuple[list[int], ...] = ([], [], [], [])  # cr, cl, mr, ml codes
+    c_codes: list[int] = []  # container -> quad code
     i_pod: list[int] = []
-    i_cols: tuple[list[int], ...] = ([], [], [], [])
-    c_ext = {r: [] for r in extended_resources}
-    i_ext = {r: [] for r in extended_resources}
+    i_codes: list[int] = []
     for pod in fixture.get("pods", []):
         node_name = pod.get("nodeName", "")
         if not node_name or node_name not in index:
@@ -428,74 +421,62 @@ def _pack_strict(
             continue
         pid = len(pod_nodes)
         pod_nodes.append(index[node_name])
-        for kind_pod, kind_cols, kind_ext, key in (
-            (c_pod, c_cols, c_ext, "containers"),
-            (i_pod, i_cols, i_ext, "initContainers"),
+        for kind_pod, kind_codes, key in (
+            (c_pod, c_codes, "containers"),
+            (i_pod, i_codes, "initContainers"),
         ):
             for c in pod.get(key, []):
                 res = c.get("resources", {})
                 req, lim = res.get("requests", {}), res.get("limits", {})
+                quad = (
+                    req.get("cpu"),
+                    lim.get("cpu"),
+                    req.get("memory"),
+                    lim.get("memory"),
+                    *(req.get(r) for r in extended_resources),
+                )
                 kind_pod.append(pid)
-                kind_cols[0].append(code(req.get("cpu")))
-                kind_cols[1].append(code(lim.get("cpu")))
-                kind_cols[2].append(code(req.get("memory")))
-                kind_cols[3].append(code(lim.get("memory")))
-                for r in extended_resources:
-                    kind_ext[r].append(code(req.get(r)))
+                kind_codes.append(
+                    interned.setdefault(quad, len(interned))
+                )
 
     p = len(pod_nodes)
     if p:
-        lut_milli = np.fromiter(
-            (_strict_parse(s, milli=True) for s in strings),
-            dtype=np.int64, count=len(strings),
-        )
-        lut_plain = np.fromiter(
-            (_strict_parse(s) for s in strings),
-            dtype=np.int64, count=len(strings),
-        )
+        n_cols = 4 + len(extended_resources)
+        lut = np.empty((n_cols, len(interned)), dtype=np.int64)
+        for qi, quad in enumerate(interned):
+            lut[0, qi] = _strict_parse(quad[0], milli=True)
+            lut[1, qi] = _strict_parse(quad[1], milli=True)
+            for k in range(2, n_cols):
+                lut[k, qi] = _strict_parse(quad[k])
         idx = np.asarray(pod_nodes, dtype=np.int64)
         np.add.at(snap["pods_count"], idx, 1)
         cp = np.asarray(c_pod, dtype=np.int64)
+        cc = np.asarray(c_codes, dtype=np.int64)
         ip = np.asarray(i_pod, dtype=np.int64)
+        ic = np.asarray(i_codes, dtype=np.int64)
         i64min = np.iinfo(np.int64).min
-        luts = (lut_milli, lut_milli, lut_plain, lut_plain)
 
-        def effective(col: int, lut) -> np.ndarray:
+        def effective(row: int) -> np.ndarray:
             """Per-pod ``max(sum(containers), max(initContainers))``."""
             acc = np.zeros(p, dtype=np.int64)
-            np.add.at(acc, cp, lut[np.asarray(c_cols[col], dtype=np.int64)])
+            np.add.at(acc, cp, lut[row][cc])
             if ip.size:
                 # Peak starts at int64 min so untouched pods keep their
                 # plain sum even for (degenerate) negative quantities —
                 # exactly the per-pod running-max rule.
                 peak = np.full(p, i64min, dtype=np.int64)
-                np.maximum.at(
-                    peak, ip, lut[np.asarray(i_cols[col], dtype=np.int64)]
-                )
+                np.maximum.at(peak, ip, lut[row][ic])
                 acc = np.where(peak != i64min, np.maximum(acc, peak), acc)
             return acc
 
-        for col, (name, lut) in enumerate(
-            zip(
-                ("used_cpu_req_milli", "used_cpu_lim_milli",
-                 "used_mem_req_bytes", "used_mem_lim_bytes"),
-                luts,
-            )
+        for row, name in enumerate(
+            ("used_cpu_req_milli", "used_cpu_lim_milli",
+             "used_mem_req_bytes", "used_mem_lim_bytes")
         ):
-            np.add.at(snap[name], idx, effective(col, lut))
-        for r_name in extended_resources:
-            acc = np.zeros(p, dtype=np.int64)
-            np.add.at(
-                acc, cp, lut_plain[np.asarray(c_ext[r_name], dtype=np.int64)]
-            )
-            if ip.size:
-                peak = np.full(p, i64min, dtype=np.int64)
-                np.maximum.at(
-                    peak, ip,
-                    lut_plain[np.asarray(i_ext[r_name], dtype=np.int64)],
-                )
-                acc = np.where(peak != i64min, np.maximum(acc, peak), acc)
-            np.add.at(ext[r_name][1], idx, acc)
+            np.add.at(snap[name], idx, effective(row))
+        for e, r_name in enumerate(extended_resources):
+            np.add.at(ext[r_name][1], idx, effective(4 + e))
 
     return ClusterSnapshot(
         names=names,
@@ -570,30 +551,6 @@ def _strict_parse(s: str | None, *, milli: bool = False) -> int:
     except _q.QuantityParseError:
         return 0
     return q.milli_value() if milli else q.value()
-
-
-def _interner(seed_none: bool = True):
-    """String intern table for columnar packing: ``(strings, code)``.
-
-    ``code(s)`` returns a stable small integer per distinct value;
-    ``strings[code]`` recovers it for one-parse-per-distinct-string lookup
-    tables.  ``seed_none`` reserves slot 0 for ``None`` (absent value).
-    """
-    intern: dict = {}
-    strings: list = []
-    if seed_none:
-        intern[None] = 0
-        strings.append(None)
-
-    def code(s) -> int:
-        try:
-            return intern[s]
-        except KeyError:
-            intern[s] = c = len(strings)
-            strings.append(s)
-            return c
-
-    return strings, code
 
 
 def _clamp_i64(u: int) -> int:
