@@ -1083,6 +1083,17 @@ def _run() -> None:
         timer = PhaseTimer()
         with timer.phase("fixture_build"):
             fx10k = synthetic_fixture(10_000, seed=11)
+        # De-intern before timing pack: production ingestion (a JSON file
+        # or live Lists) hands the packers all-unique objects, while the
+        # generator shares container dicts per request shape — pack is
+        # timed on the production shape so generator-side sharing (today's
+        # or a future memoization keyed on it) can never flatter it.  The
+        # round trip just allocated a few hundred MB of small objects;
+        # collect now so the timed packs don't pay its deferred GC.
+        import gc
+
+        fx10k = json.loads(json.dumps(fx10k))
+        gc.collect()
         with timer.phase("pack_reference"):
             kcc.snapshot_from_fixture(fx10k, semantics="reference")
         with timer.phase("pack_strict"):

@@ -57,6 +57,16 @@ def synthetic_fixture(
 
     Pod phases are mostly Running with a sprinkle of every excluded phase, so
     the Running-only field-selector semantics (Q7) are exercised.
+
+    .. note:: The returned fixture ALIASES mutable objects: one shared
+       container dict per distinct request shape, one shared initContainers
+       list, and one shared conditions list for all healthy nodes (a few
+       dozen objects serve ~100k containers — this is where the generator's
+       speed comes from).  Treat fixtures as immutable JSON-shaped data, as
+       every framework consumer does; to tweak one pod in place,
+       ``json.loads(json.dumps(fx))`` first (or replace whole
+       containers/conditions values rather than mutating them).  Per-node
+       dicts (``allocatable``, ``labels``, ``taints``) are NOT shared.
     """
     # All randomness is pre-drawn as numpy arrays (one generator call per
     # decision KIND, not per object) — at 10k nodes / ~115k pods the old
@@ -122,17 +132,41 @@ def synthetic_fixture(
 
     pid = cid = 0
 
+    # Container dicts are INTERNED: the distinct (cpu, mem, has_lim) shapes
+    # number a few dozen, so each shape is built once and the same object is
+    # shared by every container with that shape (and likewise the one
+    # no-requests container and the one init-container list).  Fixtures are
+    # read-only JSON-shaped data everywhere downstream (packers, oracle,
+    # store — event updates build NEW dicts; the store deep-copies on
+    # ingestion), so sharing is safe and ``json.dump`` serializes it
+    # identically to the unshared equivalent.  See the docstring note.
+    _container_lut: dict = {}
+
+    def make_container(ci: int) -> dict:
+        if not has_req[ci]:  # some containers set no requests at all
+            key = None
+        else:
+            key = (cpu_reqs[ci], mem_reqs[ci], has_lim[ci])
+        c = _container_lut.get(key)
+        if c is None:
+            resources: dict = {}
+            if key is not None:
+                cpu, mem, lim = key
+                resources["requests"] = {"cpu": cpu, "memory": mem}
+                if lim:
+                    resources["limits"] = {"cpu": cpu, "memory": mem}
+            c = _container_lut[key] = {"resources": resources}
+        return c
+
+    _init_containers = [
+        {"resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+    ]
+
     def make_pod(name: str, node_name: str) -> dict:
         nonlocal pid, cid
         containers = []
         for _ in range(n_containers[pid]):
-            resources: dict = {}
-            if has_req[cid]:  # some containers set no requests at all
-                cpu, mem = cpu_reqs[cid], mem_reqs[cid]
-                resources["requests"] = {"cpu": cpu, "memory": mem}
-                if has_lim[cid]:
-                    resources["limits"] = {"cpu": cpu, "memory": mem}
-            containers.append({"resources": resources})
+            containers.append(make_container(cid))
             cid += 1
         pod = {
             "name": name,
@@ -142,11 +176,16 @@ def synthetic_fixture(
             "containers": containers,
         }
         if has_init[pid]:  # init containers exist but must be ignored (Q7)
-            pod["initContainers"] = [
-                {"resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
-            ]
+            pod["initContainers"] = _init_containers
         pid += 1
         return pod
+
+    # One shared conditions list serves every healthy node (same interning
+    # rationale as containers); unhealthy nodes build their own copy since
+    # one entry differs.
+    _healthy_conditions = [
+        {"type": t, "status": "False"} for t in _CONDITION_TYPES[:4]
+    ] + [{"type": "Ready", "status": "True"}]
 
     for i in range(n_nodes):
         name = f"node-{i:05d}"
@@ -154,11 +193,11 @@ def synthetic_fixture(
         # Kubelet-style: a little less than the round GiB figure, in Ki.
         mem_kib = cores * 4 * 1024 * 1024 - mem_slack[i]
 
-        conditions = [
-            {"type": t, "status": "False"} for t in _CONDITION_TYPES[:4]
-        ] + [{"type": "Ready", "status": "True"}]
         if unhealthy_all[i]:
+            conditions = [dict(c) for c in _healthy_conditions]
             conditions[unhealthy_cond[i]]["status"] = "True"
+        else:
+            conditions = _healthy_conditions
 
         node = {
             "name": name,

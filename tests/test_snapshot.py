@@ -388,3 +388,40 @@ class TestReferenceColumnarParity:
                                        "limits": {}}}]}],
         }
         self._assert_equal(fx_null_mem)
+
+
+class TestSharedObjectFixtures:
+    """The generator's object interning must be invisible to packing: a
+    generator fixture (shared container dicts per request shape) and its
+    JSON round trip (all-unique objects) pack to identical arrays."""
+
+    @pytest.mark.parametrize("semantics", ["reference", "strict"])
+    def test_shared_equals_unique(self, semantics):
+        import json
+
+        fx = synthetic_fixture(
+            60, seed=13, unhealthy_frac=0.2, unscheduled_running_pods=3
+        )
+        # The generator really does share container objects (else this
+        # test exercises nothing).
+        ids = {
+            id(c)
+            for p in fx["pods"]
+            for c in p["containers"]
+        }
+        n_containers = sum(len(p["containers"]) for p in fx["pods"])
+        assert len(ids) < n_containers
+        shared = snapshot_from_fixture(fx, semantics=semantics)
+        unique = snapshot_from_fixture(
+            json.loads(json.dumps(fx)), semantics=semantics
+        )
+        for field_name in (
+            "alloc_cpu_milli", "alloc_mem_bytes", "alloc_pods",
+            "used_cpu_req_milli", "used_cpu_lim_milli",
+            "used_mem_req_bytes", "used_mem_lim_bytes",
+            "pods_count", "healthy",
+        ):
+            np.testing.assert_array_equal(
+                getattr(shared, field_name), getattr(unique, field_name),
+                err_msg=field_name,
+            )
