@@ -1145,6 +1145,11 @@ def _run() -> None:
         ladder["fixture_10k_build_ms"] = timer.phases["fixture_build"] * 1e3
         ladder["pack_10k_nodes_ms"] = timer.phases["pack_reference"] * 1e3
         ladder["pack_10k_nodes_strict_ms"] = timer.phases["pack_strict"] * 1e3
+        from kubernetesclustercapacity_tpu.native import ingest as _ingest
+
+        # Which pod-walk the timed packs ran (the C extension when a
+        # toolchain exists, the pure-Python loop otherwise).
+        ladder["pack_native_walk"] = _ingest.available()
 
         # --- live-serve churn at 10k nodes: watch events applied per-row
         # to the store while a SnapshotCoalescer publishes full repacks at

@@ -16,11 +16,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
 import threading
 
 import numpy as np
+
+from kubernetesclustercapacity_tpu.native import _build_util
 
 __all__ = [
     "NativeUnavailable",
@@ -49,12 +49,6 @@ class NativePanic(RuntimeError):
     """The native kernel hit the reference's divide-by-zero panic point."""
 
 
-def _build_dir() -> str:
-    d = os.path.join(os.path.dirname(_SRC), "_build")
-    os.makedirs(d, exist_ok=True)
-    return d
-
-
 def _load() -> ctypes.CDLL:
     global _LIB, _BUILD_ERROR
     with _LOCK:
@@ -62,30 +56,13 @@ def _load() -> ctypes.CDLL:
             return _LIB
         if _BUILD_ERROR is not None:
             raise NativeUnavailable(_BUILD_ERROR)
-        so_path = os.path.join(_build_dir(), "libkcccapacity.so")
-        if (
-            not os.path.exists(so_path)
-            or os.path.getmtime(so_path) < os.path.getmtime(_SRC)
-        ):
-            # Build into a temp file then atomically rename, so concurrent
-            # processes never dlopen a half-written object.
-            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_build_dir())
-            os.close(fd)
-            cmd = [
-                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                "-o", tmp, _SRC, "-lpthread",
-            ]
-            try:
-                subprocess.run(
-                    cmd, check=True, capture_output=True, text=True
-                )
-                os.replace(tmp, so_path)
-            except (OSError, subprocess.CalledProcessError) as e:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-                detail = getattr(e, "stderr", "") or str(e)
-                _BUILD_ERROR = f"native build failed: {detail}"
-                raise NativeUnavailable(_BUILD_ERROR) from e
+        try:
+            so_path = _build_util.build_so(
+                _SRC, "libkcccapacity.so", link_args=("-lpthread",)
+            )
+        except RuntimeError as e:
+            _BUILD_ERROR = f"native build failed: {e}"
+            raise NativeUnavailable(_BUILD_ERROR) from e
 
         lib = ctypes.CDLL(so_path)
         lib.kcc_cpu_to_milli.argtypes = [ctypes.c_char_p]

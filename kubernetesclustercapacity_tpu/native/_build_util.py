@@ -1,0 +1,52 @@
+"""Shared on-demand ``g++`` build machinery for the native components.
+
+Both native loaders (the ctypes capacity library and the ingest CPython
+extension) build their shared object the same way: into ``_build/`` next
+to the source, keyed on source mtime, via a temp file + atomic rename so
+concurrent processes never dlopen a half-written object.  One
+implementation here so compiler-flag or caching fixes land in both.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+__all__ = ["build_so"]
+
+
+def build_so(
+    src: str,
+    out_name: str,
+    *,
+    compile_args: tuple[str, ...] = (),
+    link_args: tuple[str, ...] = (),
+) -> str:
+    """Build ``src`` into ``_build/<out_name>`` iff missing/stale.
+
+    Returns the shared-object path; raises :class:`RuntimeError` carrying
+    the compiler's stderr on failure.
+    """
+    build_dir = os.path.join(os.path.dirname(os.path.abspath(src)), "_build")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, out_name)
+    if (
+        os.path.exists(so_path)
+        and os.path.getmtime(so_path) >= os.path.getmtime(src)
+    ):
+        return so_path
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=build_dir)
+    os.close(fd)
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        *compile_args, "-o", tmp, src, *link_args,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, so_path)
+    except (OSError, subprocess.CalledProcessError) as e:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise RuntimeError(getattr(e, "stderr", "") or str(e)) from e
+    return so_path

@@ -36,6 +36,7 @@ from dataclasses import dataclass, field, fields
 
 import numpy as np
 
+from kubernetesclustercapacity_tpu.native import ingest as _ingest
 from kubernetesclustercapacity_tpu.oracle import reference as _oracle
 from kubernetesclustercapacity_tpu.utils import quantity as _q
 
@@ -254,27 +255,9 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
     # rowwise walk's own `.get("cpu", "0")` default, so an explicit-null
     # cpu reaches the codec at LUT-build time and raises exactly as the
     # per-row oracle does; absent/null memory is Value() 0 on both paths.
-    interned: dict = {}  # quad tuple -> code; keys in insertion order
-    name_gid: dict[str, int] = {}
-    pod_gids: list[int] = []  # per surviving pod: its name group
-    c_gids: list[int] = []  # per container: its pod's name group
-    c_codes: list[int] = []  # per container: its quad code
-    for pod in fixture.get("pods", []):
-        if not _oracle._survives_field_selector(pod):
-            continue
-        gid = name_gid.setdefault(pod.get("nodeName", ""), len(name_gid))
-        pod_gids.append(gid)
-        for c in pod.get("containers", []):
-            res = c.get("resources", {})
-            req, lim = res.get("requests", {}), res.get("limits", {})
-            quad = (
-                req.get("cpu", "0"),
-                lim.get("cpu", "0"),
-                req.get("memory"),
-                lim.get("memory"),
-            )
-            c_gids.append(gid)
-            c_codes.append(interned.setdefault(quad, len(interned)))
+    interned, name_gid, pod_gids, c_gids, c_codes = _walk_pods_reference(
+        fixture.get("pods", [])
+    )
 
     if name_gid and n:
         # Per-column LUTs over the distinct quads: each string parses once.
@@ -313,6 +296,53 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
     return ClusterSnapshot(
         names=names, semantics="reference", labels=labels, taints=taints, **snap
     )
+
+
+def _walk_pods_reference(pods):
+    """Reference-mode columnar pod walk: the ΣP hot loop of packing.
+
+    Returns ``(interned, name_gid, pod_gids, c_gids, c_codes)`` —
+    insertion-ordered quad→code dict, nodeName→group dict, and the
+    per-pod / per-container index vectors.  Runs the native C walk
+    (:mod:`..native.ingest`) when available — same dict operations at C
+    speed — and the pure-Python loop otherwise or whenever the native
+    walk reports non-JSON-shaped input (``None``), so malformed fixtures
+    raise exactly the pure path's exceptions.  Parity is pinned by
+    ``tests/test_native_ingest.py``.
+    """
+    if _ingest.available():
+        out = _ingest.walk_reference(pods, _oracle._EXCLUDED_PHASES)
+        if out is not None:
+            name_gid, interned, pg, cg, cc = out
+            return (
+                interned,
+                name_gid,
+                np.frombuffer(pg, dtype=np.int64),
+                np.frombuffer(cg, dtype=np.int64),
+                np.frombuffer(cc, dtype=np.int64),
+            )
+    interned: dict = {}  # quad tuple -> code; keys in insertion order
+    name_gid: dict[str, int] = {}
+    pod_gids: list[int] = []  # per surviving pod: its name group
+    c_gids: list[int] = []  # per container: its pod's name group
+    c_codes: list[int] = []  # per container: its quad code
+    for pod in pods:
+        if not _oracle._survives_field_selector(pod):
+            continue
+        gid = name_gid.setdefault(pod.get("nodeName", ""), len(name_gid))
+        pod_gids.append(gid)
+        for c in pod.get("containers", []):
+            res = c.get("resources", {})
+            req, lim = res.get("requests", {}), res.get("limits", {})
+            quad = (
+                req.get("cpu", "0"),
+                lim.get("cpu", "0"),
+                req.get("memory"),
+                lim.get("memory"),
+            )
+            c_gids.append(gid)
+            c_codes.append(interned.setdefault(quad, len(interned)))
+    return interned, name_gid, pod_gids, c_gids, c_codes
 
 
 def _pack_reference_rowwise(fixture: dict) -> ClusterSnapshot:
@@ -407,38 +437,9 @@ def _pack_strict(
     # walk (which remains the single-pod path for watch-event updates,
     # ``store.py``); semantics are pinned equal by
     # ``tests/test_snapshot.py::TestStrictColumnarParity``.
-    interned: dict = {}  # quad tuple -> code; keys in insertion order
-    pod_nodes: list[int] = []
-    c_pod: list[int] = []  # container -> pod ordinal
-    c_codes: list[int] = []  # container -> quad code
-    i_pod: list[int] = []
-    i_codes: list[int] = []
-    for pod in fixture.get("pods", []):
-        node_name = pod.get("nodeName", "")
-        if not node_name or node_name not in index:
-            continue
-        if pod.get("phase") in _STRICT_TERMINATED:
-            continue
-        pid = len(pod_nodes)
-        pod_nodes.append(index[node_name])
-        for kind_pod, kind_codes, key in (
-            (c_pod, c_codes, "containers"),
-            (i_pod, i_codes, "initContainers"),
-        ):
-            for c in pod.get(key, []):
-                res = c.get("resources", {})
-                req, lim = res.get("requests", {}), res.get("limits", {})
-                quad = (
-                    req.get("cpu"),
-                    lim.get("cpu"),
-                    req.get("memory"),
-                    lim.get("memory"),
-                    *(req.get(r) for r in extended_resources),
-                )
-                kind_pod.append(pid)
-                kind_codes.append(
-                    interned.setdefault(quad, len(interned))
-                )
+    interned, pod_nodes, c_pod, c_codes, i_pod, i_codes = _walk_pods_strict(
+        fixture.get("pods", []), index, extended_resources
+    )
 
     p = len(pod_nodes)
     if p:
@@ -486,6 +487,62 @@ def _pack_strict(
         taints=taints,
         **snap,
     )
+
+
+def _walk_pods_strict(pods, index, extended_resources):
+    """Strict-mode columnar pod walk (containers + initContainers).
+
+    Returns ``(interned, pod_nodes, c_pod, c_codes, i_pod, i_codes)``.
+    Native C walk when available, pure-Python loop otherwise or on
+    non-JSON-shaped input — see :func:`_walk_pods_reference`.
+    """
+    if _ingest.available():
+        out = _ingest.walk_strict(
+            pods, index, _STRICT_TERMINATED, tuple(extended_resources)
+        )
+        if out is not None:
+            interned, pn, cp, cc, ip, ic = out
+            return (
+                interned,
+                np.frombuffer(pn, dtype=np.int64),
+                np.frombuffer(cp, dtype=np.int64),
+                np.frombuffer(cc, dtype=np.int64),
+                np.frombuffer(ip, dtype=np.int64),
+                np.frombuffer(ic, dtype=np.int64),
+            )
+    interned: dict = {}  # quad tuple -> code; keys in insertion order
+    pod_nodes: list[int] = []
+    c_pod: list[int] = []  # container -> pod ordinal
+    c_codes: list[int] = []  # container -> quad code
+    i_pod: list[int] = []
+    i_codes: list[int] = []
+    for pod in pods:
+        node_name = pod.get("nodeName", "")
+        if not node_name or node_name not in index:
+            continue
+        if pod.get("phase") in _STRICT_TERMINATED:
+            continue
+        pid = len(pod_nodes)
+        pod_nodes.append(index[node_name])
+        for kind_pod, kind_codes, key in (
+            (c_pod, c_codes, "containers"),
+            (i_pod, i_codes, "initContainers"),
+        ):
+            for c in pod.get(key, []):
+                res = c.get("resources", {})
+                req, lim = res.get("requests", {}), res.get("limits", {})
+                quad = (
+                    req.get("cpu"),
+                    lim.get("cpu"),
+                    req.get("memory"),
+                    lim.get("memory"),
+                    *(req.get(r) for r in extended_resources),
+                )
+                kind_pod.append(pid)
+                kind_codes.append(
+                    interned.setdefault(quad, len(interned))
+                )
+    return interned, pod_nodes, c_pod, c_codes, i_pod, i_codes
 
 
 def _effective_pod_resources(

@@ -1,0 +1,214 @@
+"""Native columnar pod-walk parity: C extension vs pure-Python packers.
+
+The native walk (`native/ingest.cc`) must be invisible: identical arrays
+on well-formed fixtures, identical exceptions on malformed ones (it
+reports non-JSON-shaped input and the packers rerun the pure loop).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.native import ingest
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+pytestmark = pytest.mark.skipif(
+    not ingest.available(), reason="no C++ toolchain for the native walk"
+)
+
+_FIELDS = (
+    "alloc_cpu_milli", "alloc_mem_bytes", "alloc_pods",
+    "used_cpu_req_milli", "used_cpu_lim_milli",
+    "used_mem_req_bytes", "used_mem_lim_bytes",
+    "pods_count", "healthy",
+)
+
+
+def _pack_both(fixture, **kw):
+    """Pack with the native walk and with it disabled; returns the pair."""
+    native = snapshot_from_fixture(fixture, **kw)
+    os.environ["KCC_DISABLE_NATIVE_INGEST"] = "1"
+    try:
+        pure = snapshot_from_fixture(fixture, **kw)
+    finally:
+        del os.environ["KCC_DISABLE_NATIVE_INGEST"]
+    return native, pure
+
+
+def _assert_equal(fixture, **kw):
+    native, pure = _pack_both(fixture, **kw)
+    for f in _FIELDS:
+        np.testing.assert_array_equal(
+            getattr(native, f), getattr(pure, f), err_msg=f
+        )
+    assert set(native.extended) == set(pure.extended)
+    for r in native.extended:
+        np.testing.assert_array_equal(native.extended[r][0], pure.extended[r][0])
+        np.testing.assert_array_equal(native.extended[r][1], pure.extended[r][1])
+
+
+class TestParity:
+    @pytest.mark.parametrize("seed", range(4))
+    @pytest.mark.parametrize("semantics", ["reference", "strict"])
+    def test_randomized(self, seed, semantics):
+        fx = synthetic_fixture(
+            40, seed=seed, unhealthy_frac=0.2, unparseable_mem_frac=0.1,
+            unscheduled_running_pods=3, taint_frac=0.1,
+        )
+        # De-intern so the native walk sees production-unique objects.
+        _assert_equal(json.loads(json.dumps(fx)), semantics=semantics)
+
+    def test_extended_resources(self):
+        fx = synthetic_fixture(20, seed=7)
+        fx["nodes"][0]["allocatable"]["nvidia.com/gpu"] = "8"
+        pod = fx["pods"][0]
+        fx["pods"][0] = dict(
+            pod,
+            containers=[
+                {"resources": {"requests": {"cpu": "1", "nvidia.com/gpu": "2"}}}
+            ],
+        )
+        _assert_equal(
+            fx, semantics="strict",
+            extended_resources=("nvidia.com/gpu", "ephemeral-storage"),
+        )
+
+    def test_explicit_null_and_missing_fields(self):
+        node = {
+            "name": "n0",
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "10"},
+            "conditions": [{"type": "c", "status": "False"}] * 4,
+        }
+        fx = {
+            "nodes": [node],
+            "pods": [
+                # missing resources entirely
+                {"name": "a", "namespace": "d", "nodeName": "n0",
+                 "phase": "Running", "containers": [{}]},
+                # empty resources / requests-only / limits-only
+                {"name": "b", "namespace": "d", "nodeName": "n0",
+                 "phase": "Running",
+                 "containers": [
+                     {"resources": {}},
+                     {"resources": {"requests": {"cpu": "100m"}}},
+                     {"resources": {"limits": {"memory": "64Mi"}}},
+                 ]},
+                # explicit null memory; missing phase (survives selector)
+                {"name": "c", "namespace": "d", "nodeName": "n0",
+                 "containers": [
+                     {"resources": {"requests": {"memory": None}}}
+                 ]},
+                # no containers key at all
+                {"name": "d", "namespace": "d", "nodeName": "n0",
+                 "phase": "Running"},
+            ],
+        }
+        _assert_equal(fx, semantics="reference")
+        _assert_equal(fx, semantics="strict")
+
+    def test_phantom_grouping_and_duplicate_names(self):
+        """Orphan pods group under the phantom '' name; duplicate node
+        names share one usage group — both must survive the native walk."""
+        node = lambda nm, unhealthy: {  # noqa: E731
+            "name": nm,
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "10"},
+            "conditions": (
+                [{"type": "c", "status": "True"}]
+                + [{"type": "c", "status": "False"}] * 3
+                if unhealthy
+                else [{"type": "c", "status": "False"}] * 4
+            ),
+        }
+        mk_pod = lambda nm, node_name: {  # noqa: E731
+            "name": nm, "namespace": "d", "nodeName": node_name,
+            "phase": "Running",
+            "containers": [{"resources": {"requests": {"cpu": "250m"}}}],
+        }
+        fx = {
+            "nodes": [node("dup", False), node("x", True), node("dup", False)],
+            "pods": [
+                mk_pod("p1", "dup"), mk_pod("p2", ""), mk_pod("p3", "dup"),
+            ],
+        }
+        _assert_equal(fx, semantics="reference")
+
+
+class TestFallback:
+    def test_non_list_containers_matches_pure_error(self):
+        node = {
+            "name": "n0",
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "10"},
+            "conditions": [{"type": "c", "status": "False"}] * 4,
+        }
+        # containers as tuple: native reports None, pure loop handles it
+        # (tuples iterate fine) — outputs must still be equal.
+        fx = {
+            "nodes": [node],
+            "pods": [{"name": "a", "namespace": "d", "nodeName": "n0",
+                      "phase": "Running",
+                      "containers": ({"resources":
+                                      {"requests": {"cpu": "1"}}},)}],
+        }
+        assert ingest.walk_reference(fx["pods"], frozenset()) is None
+        _assert_equal(fx, semantics="reference")
+        _assert_equal(fx, semantics="strict")
+
+    def test_null_resources_raises_identically(self):
+        node = {
+            "name": "n0",
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "10"},
+            "conditions": [{"type": "c", "status": "False"}] * 4,
+        }
+        fx = {
+            "nodes": [node],
+            "pods": [{"name": "a", "namespace": "d", "nodeName": "n0",
+                      "phase": "Running",
+                      "containers": [{"resources": None}]}],
+        }
+        with pytest.raises(AttributeError):
+            snapshot_from_fixture(fx, semantics="reference")
+        os.environ["KCC_DISABLE_NATIVE_INGEST"] = "1"
+        try:
+            with pytest.raises(AttributeError):
+                snapshot_from_fixture(fx, semantics="reference")
+        finally:
+            del os.environ["KCC_DISABLE_NATIVE_INGEST"]
+
+    def test_non_string_node_name_skips_like_pure(self):
+        node = {
+            "name": "n0",
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "10"},
+            "conditions": [{"type": "c", "status": "False"}] * 4,
+        }
+        fx = {
+            "nodes": [node],
+            "pods": [{"name": "a", "namespace": "d", "nodeName": 123,
+                      "phase": "Running",
+                      "containers": [{"resources":
+                                      {"requests": {"cpu": "1"}}}]}],
+        }
+        _assert_equal(fx, semantics="strict")
+
+    def test_unhashable_phase_raises_both_ways(self):
+        node = {
+            "name": "n0",
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "10"},
+            "conditions": [{"type": "c", "status": "False"}] * 4,
+        }
+        fx = {
+            "nodes": [node],
+            "pods": [{"name": "a", "namespace": "d", "nodeName": "n0",
+                      "phase": ["not-hashable"], "containers": []}],
+        }
+        for disable in (False, True):
+            if disable:
+                os.environ["KCC_DISABLE_NATIVE_INGEST"] = "1"
+            try:
+                with pytest.raises(TypeError):
+                    snapshot_from_fixture(fx, semantics="reference")
+            finally:
+                if disable:
+                    del os.environ["KCC_DISABLE_NATIVE_INGEST"]
