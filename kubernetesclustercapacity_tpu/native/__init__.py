@@ -60,11 +60,10 @@ def _load() -> ctypes.CDLL:
             so_path = _build_util.build_so(
                 _SRC, "libkcccapacity.so", link_args=("-lpthread",)
             )
-        except RuntimeError as e:
+            lib = ctypes.CDLL(so_path)  # OSError on a bad/unloadable .so
+        except (RuntimeError, OSError) as e:
             _BUILD_ERROR = f"native build failed: {e}"
             raise NativeUnavailable(_BUILD_ERROR) from e
-
-        lib = ctypes.CDLL(so_path)
         lib.kcc_cpu_to_milli.argtypes = [ctypes.c_char_p]
         lib.kcc_cpu_to_milli.restype = ctypes.c_uint64
         lib.kcc_to_bytes.argtypes = [

@@ -28,25 +28,35 @@ def build_so(
     Returns the shared-object path; raises :class:`RuntimeError` carrying
     the compiler's stderr on failure.
     """
-    build_dir = os.path.join(os.path.dirname(os.path.abspath(src)), "_build")
-    os.makedirs(build_dir, exist_ok=True)
-    so_path = os.path.join(build_dir, out_name)
-    if (
-        os.path.exists(so_path)
-        and os.path.getmtime(so_path) >= os.path.getmtime(src)
-    ):
-        return so_path
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=build_dir)
-    os.close(fd)
-    cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        *compile_args, "-o", tmp, src, *link_args,
-    ]
+    # Everything filesystem-touching sits inside the try: a read-only
+    # checkout (PermissionError from makedirs/mkstemp) must surface as
+    # the same RuntimeError the loaders turn into their "unavailable"
+    # signal, not crash callers whose contract is silent fallback.
+    tmp = None
     try:
+        build_dir = os.path.join(
+            os.path.dirname(os.path.abspath(src)), "_build"
+        )
+        os.makedirs(build_dir, exist_ok=True)
+        so_path = os.path.join(build_dir, out_name)
+        if (
+            os.path.exists(so_path)
+            and os.path.getmtime(so_path) >= os.path.getmtime(src)
+        ):
+            return so_path
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=build_dir)
+        os.close(fd)
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            *compile_args, "-o", tmp, src, *link_args,
+        ]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, so_path)
     except (OSError, subprocess.CalledProcessError) as e:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+        if tmp is not None and os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         raise RuntimeError(getattr(e, "stderr", "") or str(e)) from e
     return so_path

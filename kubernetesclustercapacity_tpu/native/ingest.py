@@ -69,8 +69,13 @@ def _load():
                 _MOD = _import_so(so_path)
             except ImportError:
                 # A cached object that no longer loads (corrupt file,
-                # residual mismatch): rebuild once from scratch.
-                os.unlink(so_path)
+                # residual mismatch): rebuild once from scratch.  If the
+                # stale object cannot even be removed (read-only dir),
+                # the retried import fails again and lands below.
+                try:
+                    os.unlink(so_path)
+                except OSError:
+                    pass
                 try:
                     _MOD = _import_so(_build())
                 except ImportError as e:
@@ -80,6 +85,9 @@ def _load():
         except NativeIngestUnavailable as e:
             _BUILD_ERROR = str(e)
             raise
+        except OSError as e:  # any loader-side filesystem surprise
+            _BUILD_ERROR = f"native ingest unavailable: {e}"
+            raise NativeIngestUnavailable(_BUILD_ERROR) from e
         return _MOD
 
 

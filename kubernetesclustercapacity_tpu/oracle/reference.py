@@ -180,30 +180,12 @@ def healthy_nodes(
     result = [NodeView() for _ in raw_nodes]
     for i, raw in enumerate(raw_nodes):
         allocatable = raw.get("allocatable", {})
-        cpu_milli = cpu_to_milli_reference(allocatable.get("cpu", "0"))
-        try:
-            mem_bytes = to_bytes_reference(allocatable.get("memory", ""))
-        except QuantityParseError:
-            mem_bytes = 0  # :202-206 — silent zero
-        pods_str = allocatable.get("pods", "0")
-        try:
-            alloc_pods = parse_quantity(pods_str).value()
-        except QuantityParseError:
-            alloc_pods = 0
-
-        conditions = raw.get("conditions", [])
-        flag_healthy = True
-        for j in range(4):  # :212 — hardcoded first four
-            if j >= len(conditions):
-                raise ReferencePanic(
-                    f"index out of range [{j}] with length {len(conditions)} "
-                    f"(node {raw.get('name', '?')!r}, ClusterCapacity.go:213)"
-                )
-            if conditions[j].get("status") != "False":
-                flag_healthy = False
-                break
-
-        if flag_healthy:
+        cpu_milli, mem_bytes, alloc_pods = node_allocatable_values(
+            allocatable.get("cpu", "0"),
+            allocatable.get("memory", ""),
+            allocatable.get("pods", "0"),
+        )
+        if node_is_healthy_reference(raw):
             result[i] = NodeView(
                 name=raw.get("name", ""),
                 allocatable_cpu=cpu_milli,
@@ -211,6 +193,44 @@ def healthy_nodes(
                 allocatable_pods=alloc_pods,
             )
     return result
+
+
+def node_allocatable_values(
+    cpu_str, mem_str, pods_str
+) -> tuple[int, int, int]:
+    """One node's allocatable parses with ``getHealthyNodes``' exact error
+    semantics: CPU codec errors raise through (``:196-197``), memory
+    parse failure is a silent zero (``:202-206``), pods parse failure is
+    zero (``.Pods().Value()`` of a missing/invalid quantity, ``:208``).
+    Single-sourced here so the columnar packer (``snapshot.py``) and the
+    per-node walk above cannot drift.
+    """
+    cpu_milli = cpu_to_milli_reference(cpu_str)
+    try:
+        mem_bytes = to_bytes_reference(mem_str)
+    except QuantityParseError:
+        mem_bytes = 0  # :202-206 — silent zero
+    try:
+        alloc_pods = parse_quantity(pods_str).value()
+    except QuantityParseError:
+        alloc_pods = 0
+    return cpu_milli, mem_bytes, alloc_pods
+
+
+def node_is_healthy_reference(raw: dict) -> bool:
+    """The first-four-conditions health check, bug-for-bug (``:212-219``):
+    any of the first 4 conditions not ``"False"`` → unhealthy; fewer than
+    4 conditions → the reference's index-out-of-range panic."""
+    conditions = raw.get("conditions", [])
+    for j in range(4):  # :212 — hardcoded first four
+        if j >= len(conditions):
+            raise ReferencePanic(
+                f"index out of range [{j}] with length {len(conditions)} "
+                f"(node {raw.get('name', '?')!r}, ClusterCapacity.go:213)"
+            )
+        if conditions[j].get("status") != "False":
+            return False
+    return True
 
 
 def _survives_field_selector(pod: dict) -> bool:

@@ -225,25 +225,56 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
     oracle's per-row walk produces.  Pinned equal to the row-wise walk by
     ``tests/test_snapshot.py::TestReferenceColumnarParity``.
     """
-    nodes = _oracle.healthy_nodes(fixture)
     raw_nodes = fixture.get("nodes", [])
-    n = len(nodes)
-    names = [v.name for v in nodes]
+    n = len(raw_nodes)
     labels = [raw.get("labels", {}) for raw in raw_nodes]
     taints = [raw.get("taints", []) for raw in raw_nodes]
-
     snap = _empty_arrays(n)
+
+    # Columnar node walk, pinned equal to the oracle's healthy_nodes walk
+    # (via _pack_reference_rowwise) by TestReferenceColumnarParity.  Each
+    # distinct (cpu, memory, pods) allocatable triple parses ONCE, at
+    # first sight — parsing must happen inline (not in a post-walk LUT
+    # pass) because the oracle parses each node's allocatables BEFORE its
+    # conditions check: a bad cpu string on node 5 must raise before node
+    # 7's <4-conditions panic, in exactly the rowwise order.
+    names: list[str] = []
+    triple_vals: dict = {}  # triple -> (code, cpu_milli, mem_bytes, pods)
+    healthy_rows: list[int] = []
+    row_codes: list[int] = []
+    for i, raw in enumerate(raw_nodes):
+        allocatable = raw.get("allocatable", {})
+        triple = (
+            allocatable.get("cpu", "0"),
+            allocatable.get("memory", ""),
+            allocatable.get("pods", "0"),
+        )
+        vals = triple_vals.get(triple)
+        if vals is None:
+            cpu, mem, pods = _oracle.node_allocatable_values(*triple)
+            vals = triple_vals[triple] = (
+                len(triple_vals), _clamp_i64(cpu), _clamp_i64(mem), pods,
+            )
+
+        if _oracle.node_is_healthy_reference(raw):
+            # Phantom rows (unhealthy → zero-valued node) keep the empty
+            # name and zero allocatables (ClusterCapacity.go:221-226).
+            names.append(raw.get("name", ""))
+            healthy_rows.append(i)
+            row_codes.append(vals[0])
+        else:
+            names.append("")
+
+    if healthy_rows:
+        lut = np.empty((len(triple_vals), 3), dtype=np.int64)
+        for code, cpu, mem, pods in triple_vals.values():
+            lut[code] = (cpu, mem, pods)
+        hr = np.asarray(healthy_rows, dtype=np.int64)
+        rc = np.asarray(row_codes, dtype=np.int64)
+        snap["alloc_cpu_milli"][hr] = lut[rc, 0]
+        snap["alloc_mem_bytes"][hr] = lut[rc, 1]
+        snap["alloc_pods"][hr] = lut[rc, 2]
     if n:
-        snap["alloc_cpu_milli"] = np.fromiter(
-            (_clamp_i64(v.allocatable_cpu) for v in nodes), np.int64, n
-        )
-        snap["alloc_mem_bytes"] = np.fromiter(
-            (_clamp_i64(v.allocatable_memory) for v in nodes), np.int64, n
-        )
-        snap["alloc_pods"] = np.fromiter(
-            (v.allocatable_pods for v in nodes), np.int64, n
-        )
-        # Phantom rows (unhealthy → zero-valued node) carry the empty name.
         snap["healthy"] = np.fromiter(
             (bool(nm) for nm in names), np.bool_, n
         )
@@ -411,6 +442,13 @@ def _pack_strict(
     }
     names, labels, taints = [], [], []
     index = {}
+    # Columnar node walk: each distinct allocatable tuple parses once into
+    # a LUT row; nodes gather their row (clusters have few distinct node
+    # shapes).  Pinned equal to the per-node assignments it replaced by
+    # the strict packing tests + TestStrictColumnarParity.
+    node_keys: dict = {}
+    node_codes: list[int] = []
+    healthy_list: list[bool] = []
     for i, raw in enumerate(raw_nodes):
         name = raw.get("name", "")
         names.append(name)
@@ -418,12 +456,28 @@ def _pack_strict(
         labels.append(raw.get("labels", {}))
         taints.append(raw.get("taints", []))
         allocatable = raw.get("allocatable", {})
-        snap["alloc_cpu_milli"][i] = _strict_parse(allocatable.get("cpu"), milli=True)
-        snap["alloc_mem_bytes"][i] = _strict_parse(allocatable.get("memory"))
-        snap["alloc_pods"][i] = _strict_parse(allocatable.get("pods"))
-        snap["healthy"][i] = _strict_healthy(raw.get("conditions", []))
-        for r in extended_resources:
-            ext[r][0][i] = _strict_parse(allocatable.get(r))
+        key = (
+            allocatable.get("cpu"),
+            allocatable.get("memory"),
+            allocatable.get("pods"),
+            *(allocatable.get(r) for r in extended_resources),
+        )
+        node_codes.append(node_keys.setdefault(key, len(node_keys)))
+        healthy_list.append(_strict_healthy(raw.get("conditions", [])))
+    if n:
+        n_cols = 3 + len(extended_resources)
+        node_lut = np.empty((len(node_keys), n_cols), dtype=np.int64)
+        for key, code in node_keys.items():
+            node_lut[code, 0] = _strict_parse(key[0], milli=True)
+            for k in range(1, n_cols):
+                node_lut[code, k] = _strict_parse(key[k])
+        codes = np.asarray(node_codes, dtype=np.int64)
+        snap["alloc_cpu_milli"] = node_lut[codes, 0]
+        snap["alloc_mem_bytes"] = node_lut[codes, 1]
+        snap["alloc_pods"] = node_lut[codes, 2]
+        snap["healthy"] = np.asarray(healthy_list, dtype=np.bool_)
+        for e, r in enumerate(extended_resources):
+            ext[r] = (node_lut[codes, 3 + e], ext[r][1])
 
     # Columnar pod ingestion — the 100k-pod hot path.  One Python walk
     # interns each container's quantity strings (cpu req/lim, mem
