@@ -1227,9 +1227,12 @@ def _run() -> None:
     # an approximate public VPU number (8 sublanes × 128 lanes × ~4 ALU
     # ops/cycle × ~0.94 GHz ≈ 3.9e12 int32 ops/s per v5e core) — an anchor
     # for trend lines, not a datasheet claim.
-    # rcp: cpu+mem each cost ~16 ops (cmp, sub, clamp, 2 cvt, mul, floor,
-    # cvt, one 9-op fixup round shared across the set) + min/epilogue/acc.
-    _VPU_OPS_PER_CELL = {"pallas_i32_rcp_fused": 38, "pallas_i32_fused": 150}
+    # rcp (fused-min form): 2 est muls + min + floor + cvt + ONE combined
+    # fixup over both resources (2 mul, 2 sub, 4 cmp, and/or, 2 cvt, 2
+    # add) = 19 core ops + ~3 epilogue + mask + acc, plus the
+    # sublane-amortized (1,LANES) headroom/clamp work ≈ 28/cell (was 38
+    # with two independent divides, two fixup rounds and two selects).
+    _VPU_OPS_PER_CELL = {"pallas_i32_rcp_fused": 28, "pallas_i32_fused": 150}
     _VPU_PEAK_BY_PREFIX = (("TPU v5", 3.9e12),)
 
     p50 = fast_per_sweep if fast_per_sweep is not None else exact_per_sweep

@@ -56,11 +56,28 @@ def _load() -> ctypes.CDLL:
             return _LIB
         if _BUILD_ERROR is not None:
             raise NativeUnavailable(_BUILD_ERROR)
-        try:
-            so_path = _build_util.build_so(
+        def _build_and_open() -> ctypes.CDLL:
+            so = _build_util.build_so(
                 _SRC, "libkcccapacity.so", link_args=("-lpthread",)
             )
-            lib = ctypes.CDLL(so_path)  # OSError on a bad/unloadable .so
+            try:
+                return ctypes.CDLL(so)  # OSError on a bad/unloadable .so
+            except OSError:
+                # A cached object that no longer loads (corrupt file,
+                # foreign arch): rebuild once from scratch, like the
+                # ingest extension loader.
+                try:
+                    os.unlink(so)
+                except OSError:
+                    pass
+                return ctypes.CDLL(
+                    _build_util.build_so(
+                        _SRC, "libkcccapacity.so", link_args=("-lpthread",)
+                    )
+                )
+
+        try:
+            lib = _build_and_open()
         except (RuntimeError, OSError) as e:
             _BUILD_ERROR = f"native build failed: {e}"
             raise NativeUnavailable(_BUILD_ERROR) from e

@@ -218,20 +218,50 @@ def _fit_row(ac, am, ap, uc, um, pc, mk, cr, mr, strict):
 
 
 def _fit_row_rcp(ac, am, ap, uc, um, pc, mk, cr, mr, crr, mrr, strict):
-    """:func:`_fit_row` with reciprocal division (rcp-eligible domain only).
+    """:func:`_fit_row`'s fit via fused reciprocal division — one floor and
+    ONE combined fixup for the two-resource min (rcp-eligible domain only).
 
-    Dividends clamp at 0 before the divide: negative headrooms are where'd
-    out anyway, and the clamp keeps them inside the exactness proof's
-    ``[0, max(alloc)]`` dividend domain.
+    Dividends clamp at 0: negative headroom gives estimate 0 whose fixup
+    cannot fire upward (``r = 0 - 0 < cr``), so the explicit
+    ``ac <= uc`` select the exact kernel needs is redundant here — the
+    clamp IS the zero-fit branch, and it keeps dividends inside the
+    exactness proof's ``[0, max(alloc)]`` domain.
+
+    Why fusing min into the floor stays exact (on top of
+    :func:`rcp_division_eligible`'s per-divide proof):
+
+    * each float estimate is within 0.5 of its REAL quotient
+      (``|est_c − hc/cr| < 0.5``, the proof's error-stack bound), so
+      ``|min(est_c, est_m) − min(hc/cr, hm/mr)| < 0.5`` (min is
+      1-Lipschitz in each argument);
+    * ``floor(min(x, y)) == min(floor(x), floor(y))`` for reals, so
+      ``f = floor(min est) ∈ {M−1, M, M+1}`` where
+      ``M = min(hc//cr, hm//mr)`` is the true fit;
+    * one combined fixup resolves all three: with
+      ``r1 = hc − f·cr, r2 = hm − f·mr``, feasibility of ``f+1`` is
+      ``r1 ≥ cr ∧ r2 ≥ mr`` (fires exactly when ``f = M−1``), and
+      infeasibility of ``f`` is ``r1 < 0 ∨ r2 < 0`` (fires exactly when
+      ``f = M+1``); both intermediates stay in int32 because ``f`` is at
+      most one above its own resource's quotient, so ``r1 ∈ (−2·cr, hc]``
+      (divisors ≤ 2^29, dividends ≤ int32 max — the same wraparound
+      argument as the per-divide fixup).
+
+    Versus two independent ``_rcp_div`` calls + min + two selects this
+    drops ~8 of ~25 per-cell VPU ops — the second floor/convert chain,
+    the second fixup's compares, and both zero-selects.
     """
     zero = jnp.int32(0)
-    cpu_fit = jnp.where(
-        ac <= uc, zero, _rcp_div(jnp.maximum(ac - uc, zero), cr, crr)
+    hc = jnp.maximum(ac - uc, zero)
+    hm = jnp.maximum(am - um, zero)
+    est = jnp.minimum(
+        hc.astype(jnp.float32) * crr, hm.astype(jnp.float32) * mrr
     )
-    mem_fit = jnp.where(
-        am <= um, zero, _rcp_div(jnp.maximum(am - um, zero), mr, mrr)
-    )
-    fit = jnp.minimum(cpu_fit, mem_fit)
+    f = jnp.floor(est).astype(jnp.int32)
+    r1 = hc - f * cr
+    r2 = hm - f * mr
+    up = ((r1 >= cr) & (r2 >= mr)).astype(jnp.int32)
+    down = ((r1 < 0) | (r2 < 0)).astype(jnp.int32)
+    fit = f + up - down
     return _epilogue(fit, ap, pc, mk, strict)
 
 

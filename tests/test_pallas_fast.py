@@ -269,6 +269,42 @@ class TestRcpDivision:
         expect = (alloc_cpu // d_cpu).clip(max=alloc_mem // (d_mem_kib * 1024))
         assert int(t_div[0]) == int(expect.sum())
 
+    def test_fused_fixup_wrapping_product(self):
+        # The fused fixup's worst case: dividend at int32 max, divisor at
+        # the 2^29 bound, and an estimate that floors to q+1 (f32(2^31-1)
+        # rounds UP to 2^31, so est = 4.0 exactly while q = 3).  The
+        # correction product f*cr = 2^31 then WRAPS int32; exactness rests
+        # on the fixup's two's-complement argument (r1 = -1 survives the
+        # wrap).  The cpu resource must also be the binding min so the
+        # fused floor really lands on q+1.
+        n = 128
+        snap = synthetic_snapshot(n, seed=3)
+        snap.alloc_cpu_milli[:] = (1 << 31) - 1
+        snap.alloc_mem_bytes[:] = 1 << 30  # 2^20 KiB -> q_mem = 2^20
+        snap.used_cpu_req_milli[:] = 0
+        snap.used_mem_req_bytes[:] = 0
+        snap.pods_count[:] = 0
+        snap.alloc_pods[:] = 1 << 30
+        cpu_reqs = np.array([1 << 29], dtype=np.int64)
+        mem_reqs = np.array([1024], dtype=np.int64)
+        reps = np.array([1], dtype=np.int64)
+        assert rcp_division_eligible(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            cpu_reqs, mem_reqs,
+        )
+        for mode in ("reference", "strict"):
+            t_rcp, _ = sweep_pallas(
+                *_args(snap), cpu_reqs, mem_reqs, reps,
+                mode=mode, interpret=True, use_rcp=True,
+            )
+            t_div, _ = sweep_pallas(
+                *_args(snap), cpu_reqs, mem_reqs, reps,
+                mode=mode, interpret=True, use_rcp=False,
+            )
+            np.testing.assert_array_equal(t_rcp, t_div)
+            assert int(t_rcp[0]) == n * 3  # q = floor((2^31-1)/2^29) = 3
+
     def test_randomized_rcp_exactness_property(self):
         # Hammer the divide itself across the eligible domain: random
         # divisors, dividends biased to land near multiples of the divisor.
