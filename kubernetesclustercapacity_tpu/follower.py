@@ -24,11 +24,13 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 
 from kubernetesclustercapacity_tpu.kubeapi import (
     KubeAPIError,
     KubeClient,
     KubeConfig,
+    KubeConfigError,
     node_to_fixture,
     pod_to_fixture,
 )
@@ -57,25 +59,42 @@ class ClusterFollower:
         on_event=None,
         stop_on_idle_window: bool = False,
         idle_rewatch_backoff: float = 1.0,
+        resync_failure_deadline: float = 900.0,
     ) -> None:
         """``client_factory() -> KubeClient`` builds one client per stream
         (each watch occupies a connection); defaults to clients over the
         given kubeconfig.  ``on_event(kind, type, obj)`` is an optional
-        observer called after each applied event.
+        observer called after each applied event — and with
+        ``("*", "RELIST", {})`` after every error-path relist swaps in a
+        fresh store, so consumers republish state that arrived without
+        per-object events.
 
         A real apiserver regularly ends watch windows with no events and no
         version progress; the follower re-watches after
-        ``idle_rewatch_backoff`` seconds.  ``stop_on_idle_window=True``
+        ``idle_rewatch_backoff`` seconds (also the BASE of the exponential
+        failure backoff, capped at 30 s).  ``stop_on_idle_window=True``
         instead ends that resource's watch loop — ONLY for tests driving
         finite mock streams; in production it would silently stop syncing.
+
+        ``resync_failure_deadline``: when BOTH the watch and the relist
+        keep failing for this many seconds straight (expired unrefreshable
+        credentials, revoked RBAC, dead apiserver), the follower goes
+        fatal and stops — the served snapshot is visibly stale at that
+        point, and the module contract is that staleness is never silent.
         """
         if client_factory is None:
-            config = KubeConfig.load(kubeconfig, context=context)
+            # Validate the kubeconfig up front (fail fast on a bad file)...
+            KubeConfig.load(kubeconfig, context=context)
 
             def client_factory() -> KubeClient:  # noqa: F811 - default
-                return KubeClient(config)
+                # ...but re-resolve credentials per client: exec-plugin /
+                # OIDC / tokenFile tokens expire (EKS: ~15 min), and a
+                # factory pinned to the startup token would 401 on every
+                # reconnect forever after expiry.
+                return KubeClient(KubeConfig.load(kubeconfig, context=context))
 
         self._factory = client_factory
+        self._resync_deadline = resync_failure_deadline
         self._semantics = semantics
         self._extended = tuple(extended_resources)
         self.on_event = on_event
@@ -92,6 +111,10 @@ class ClusterFollower:
         self._epoch = 0  # bumped by every relist; stale streams stop applying
         self._fatal: str | None = None
         self._errors: collections.deque = collections.deque(maxlen=100)
+        # Live clients (watch streams mid-read, in-flight relists), guarded
+        # by _lock: stop() severs their sockets so a reader parked in
+        # readline() unblocks now, not after the watch watchdog.
+        self._active_clients: set[KubeClient] = set()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, *, watch: bool = True) -> "ClusterFollower":
@@ -116,6 +139,17 @@ class ClusterFollower:
 
     def stop(self) -> None:
         self._stop.set()
+        # Sever in-flight streams: a watch reader blocked in readline()
+        # would otherwise hold join() for up to the watch watchdog
+        # (timeoutSeconds + grace, minutes).  The reader surfaces the
+        # closed socket as a KubeAPIError, sees _stop, and exits.
+        with self._lock:
+            clients = list(self._active_clients)
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
 
     def wait_stopped(self, timeout: float | None = None) -> bool:
         """Block until :meth:`stop` is called (by a user or by a fatal
@@ -168,7 +202,14 @@ class ClusterFollower:
     def _relist(self) -> None:
         """Full list of both resources → fresh store, under one lock hold."""
         client = self._factory()
+        with self._lock:
+            self._active_clients.add(client)
         try:
+            # Registration races stop(): a client created after stop()
+            # snapshotted the set would never be severed — re-check now
+            # that we're visible, so either stop() closes us or we abort.
+            if self._stop.is_set():
+                raise KubeAPIError("follower stopping")
             fixture: dict = {"nodes": [], "pods": []}
             versions = {}
             for path, (kind, convert) in _RESOURCES.items():
@@ -182,12 +223,19 @@ class ClusterFollower:
                 extended_resources=self._extended,
             )
         finally:
+            with self._lock:
+                self._active_clients.discard(client)
             client.close()
         with self._lock:
             self._store = store
             self._versions = versions
             self._epoch += 1
         self._synced.set()
+        # The swapped-in store may hold changes that never flowed through
+        # per-object events (that's what a relist is FOR) — consumers
+        # (e.g. the serve path's coalescer) must republish.
+        if self.on_event is not None:
+            self.on_event("*", "RELIST", {})
 
     def _watch_loop(self, path: str) -> None:
         try:
@@ -205,6 +253,8 @@ class ClusterFollower:
 
     def _watch_loop_inner(self, path: str) -> None:
         kind, convert = _RESOURCES[path]
+        consecutive_failures = 0
+        failing_since: float | None = None
         while not self._stop.is_set():
             with self._lock:
                 version = self._versions.get(path)
@@ -213,23 +263,55 @@ class ClusterFollower:
                 stream_ended = self._consume_stream(
                     path, kind, convert, version, epoch
                 )
-            except (KubeAPIError, StoreError) as e:
+            except (KubeAPIError, KubeConfigError, StoreError) as e:
                 self._errors.append(f"{path}: {e}")
-                # Back off, then relist (410 Gone / transport loss / bad
-                # apply).  A failing relist retries forever with backoff —
-                # a transient outage must never permanently stop the sync
+                # Back off exponentially (client-go reflector style: base
+                # idle_backoff, doubling, capped at 30 s), then relist
+                # (410 Gone / transport loss / bad apply).  A failing
+                # relist retries forever within the resync deadline — a
+                # transient outage must never permanently stop the sync
                 # loop — and a persistently rejected watch (e.g. RBAC
-                # grants list but not watch) cannot hot-loop full LISTs.
+                # grants list but not watch) drives at most ~2 full LISTs
+                # a minute, not one per second.
+                consecutive_failures += 1
+                if failing_since is None:
+                    failing_since = time.monotonic()
+                # Exponent clamped: a watch denied for hours must keep the
+                # capped cadence, not overflow float conversion.
+                delay = min(
+                    self._idle_backoff
+                    * 2.0 ** min(consecutive_failures - 1, 16),
+                    30.0,
+                )
                 while not self._stop.is_set():
-                    self._stop.wait(self._idle_backoff)
+                    self._stop.wait(delay)
                     if self._stop.is_set():
                         return
                     try:
                         self._relist()
+                        # Data is fresh again (even if the WATCH is still
+                        # being rejected) — the staleness clock resets.
+                        failing_since = None
                         break
-                    except KubeAPIError as e2:
+                    except (KubeAPIError, KubeConfigError) as e2:
                         self._errors.append(f"relist {path}: {e2}")
+                        stale_for = time.monotonic() - failing_since
+                        if stale_for > self._resync_deadline:
+                            # Watch AND relist failing past the deadline:
+                            # credentials expired unrefreshably, RBAC
+                            # revoked, apiserver gone.  The served
+                            # snapshot is stale and getting staler —
+                            # go fatal (via _watch_loop) rather than
+                            # retry silently forever.
+                            raise RuntimeError(
+                                f"resync failing for {stale_for:.0f}s "
+                                f"(deadline {self._resync_deadline:.0f}s); "
+                                f"last error: {e2}"
+                            ) from e2
+                        delay = min(delay * 2, 30.0)
                 continue
+            consecutive_failures = 0
+            failing_since = None
             if stream_ended:
                 with self._lock:
                     unchanged = version == self._versions.get(path)
@@ -251,7 +333,11 @@ class ClusterFollower:
         applied — the epoch check drops them and ends the stream, and the
         loop re-watches from the post-relist version."""
         client = self._factory()
+        with self._lock:
+            self._active_clients.add(client)
         try:
+            if self._stop.is_set():  # registration/stop() race — see _relist
+                return False
             for event in client.watch_events(
                 path, resource_version=version or None
             ):
@@ -265,8 +351,10 @@ class ClusterFollower:
                         return False  # stale epoch: abandon this stream
                     continue
                 if etype == "ERROR":
+                    code = obj.get("code")
                     raise KubeAPIError(
-                        f"watch error event: {obj.get('message', obj)}"
+                        f"watch error event: {obj.get('message', obj)}",
+                        status=code if isinstance(code, int) else None,
                     )
                 rv = (obj.get("metadata") or {}).get("resourceVersion")
                 if not self._apply(kind, etype, convert(obj), epoch):
@@ -275,6 +363,8 @@ class ClusterFollower:
                     return False
             return True
         finally:
+            with self._lock:
+                self._active_clients.discard(client)
             client.close()
 
     def _set_version(self, path: str, rv: str, epoch: int) -> bool:

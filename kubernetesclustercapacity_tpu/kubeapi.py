@@ -51,6 +51,7 @@ __all__ = [
     "KubeConfig",
     "KubeClient",
     "default_kubeconfig_path",
+    "default_kubeconfig_paths",
     "live_fixture",
     "node_to_fixture",
     "pod_to_fixture",
@@ -68,18 +69,34 @@ class KubeConfigError(ValueError):
 
 
 class KubeAPIError(RuntimeError):
-    """Non-2xx apiserver response or transport failure."""
+    """Non-2xx apiserver response or transport failure.
+
+    ``status`` carries the HTTP status (or a watch ERROR event's ``code``)
+    when one exists — consumers distinguish e.g. 410 Gone (relist
+    required) from transport loss (re-watch suffices).
+    """
+
+    def __init__(self, message: str, *, status: int | None = None) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+def default_kubeconfig_paths() -> list[str]:
+    """``$KUBECONFIG`` entries if set (all of them — client-go merges the
+    list), else ``$HOME/.kube/config`` with the reference's HOME/USERPROFILE
+    fallback (``ClusterCapacity.go:152-157``)."""
+    env = os.environ.get("KUBECONFIG")
+    if env:
+        return [p for p in env.split(os.pathsep) if p]
+    home = os.environ.get("HOME") or os.environ.get("USERPROFILE") or ""
+    return [os.path.join(home, ".kube", "config")] if home else []
 
 
 def default_kubeconfig_path() -> str:
-    """``$KUBECONFIG`` if set (first path entry, client-go semantics), else
-    ``$HOME/.kube/config`` with the reference's HOME/USERPROFILE fallback
-    (``ClusterCapacity.go:152-157``)."""
-    env = os.environ.get("KUBECONFIG")
-    if env:
-        return env.split(os.pathsep)[0]
-    home = os.environ.get("HOME") or os.environ.get("USERPROFILE") or ""
-    return os.path.join(home, ".kube", "config") if home else ""
+    """First default path entry — display/single-file use; :meth:`KubeConfig.
+    load` merges every entry like client-go does."""
+    paths = default_kubeconfig_paths()
+    return paths[0] if paths else ""
 
 
 def _b64_or_file(data_b64: str | None, path: str | None, what: str) -> bytes | None:
@@ -133,27 +150,58 @@ class KubeConfig:
                 "load_snapshot() for offline operation"
             ) from e
 
-        path = path or default_kubeconfig_path()
-        if not path or not os.path.exists(path):
-            raise KubeConfigError(f"kubeconfig not found: {path!r}")
-        with open(path) as f:
-            try:
-                doc = yaml.safe_load(f) or {}
-            except yaml.YAMLError as e:
-                raise KubeConfigError(f"cannot parse kubeconfig {path}: {e}") from e
+        # client-go merge semantics: an explicit path is a single file
+        # (missing → error); $KUBECONFIG lists several, missing entries are
+        # skipped, and for every map (contexts/clusters/users by name,
+        # current-context) the FIRST file to define a key wins.
+        if path:
+            paths = [path]
+        else:
+            paths = default_kubeconfig_paths()
+        docs: list[tuple[str, dict]] = []
+        for p in paths:
+            if not os.path.exists(p):
+                if path:  # explicit single file must exist
+                    raise KubeConfigError(f"kubeconfig not found: {p!r}")
+                continue
+            with open(p) as f:
+                try:
+                    docs.append((p, yaml.safe_load(f) or {}))
+                except yaml.YAMLError as e:
+                    raise KubeConfigError(
+                        f"cannot parse kubeconfig {p}: {e}"
+                    ) from e
+        if not docs:
+            raise KubeConfigError(
+                f"kubeconfig not found: {paths if paths else '(no path)'}"
+            )
 
-        def by_name(section: str, name: str) -> dict:
-            for entry in doc.get(section) or []:
-                if entry.get("name") == name:
-                    return entry.get(section.rstrip("s"), {}) or {}
-            raise KubeConfigError(f"kubeconfig has no {section[:-1]} named {name!r}")
+        def by_name(section: str, name: str) -> tuple[dict, str, dict]:
+            """First entry named ``name`` across the merged files — returns
+            ``(body, owning_path, owning_doc)`` so credential write-backs
+            land in the file that defined the stanza."""
+            for p, d in docs:
+                for entry in d.get(section) or []:
+                    if entry.get("name") == name:
+                        return entry.get(section.rstrip("s"), {}) or {}, p, d
+            raise KubeConfigError(
+                f"kubeconfig has no {section[:-1]} named {name!r}"
+            )
 
-        ctx_name = context or doc.get("current-context")
+        ctx_name = context or next(
+            (d.get("current-context") for _, d in docs
+             if d.get("current-context")),
+            None,
+        )
         if not ctx_name:
             raise KubeConfigError("kubeconfig has no current-context")
-        ctx = by_name("contexts", ctx_name)
-        cluster = by_name("clusters", ctx.get("cluster", ""))
-        user = by_name("users", ctx.get("user", "")) if ctx.get("user") else {}
+        ctx, _, _ = by_name("contexts", ctx_name)
+        cluster, _, _ = by_name("clusters", ctx.get("cluster", ""))
+        user, user_path, user_doc = (
+            by_name("users", ctx.get("user", ""))
+            if ctx.get("user")
+            else ({}, docs[0][0], docs[0][1])
+        )
 
         server = cluster.get("server")
         if not server:
@@ -192,25 +240,27 @@ class KubeConfig:
                     # into the kubeconfig; IdPs with refresh-token rotation
                     # invalidate the old one on first use, so dropping the
                     # rotation would brick every later run.  `provider` is
-                    # a live reference into `doc`.  Write atomically
-                    # (temp file + rename in the same directory): an
-                    # in-place truncating write that dies mid-dump would
-                    # destroy the kubeconfig — which holds credentials for
-                    # every cluster — with the old refresh token already
-                    # consumed server-side.
+                    # a live reference into the FILE that defined the user
+                    # stanza (`user_doc`/`user_path` — under $KUBECONFIG
+                    # merging that may not be the first file).  Write
+                    # atomically (temp file + rename in the same
+                    # directory): an in-place truncating write that dies
+                    # mid-dump would destroy the kubeconfig — which holds
+                    # credentials for every cluster — with the old refresh
+                    # token already consumed server-side.
                     block = provider.setdefault("config", {})
                     block["id-token"] = new_id
                     if new_refresh:
                         block["refresh-token"] = new_refresh
                     try:
-                        d = os.path.dirname(os.path.abspath(path))
+                        d = os.path.dirname(os.path.abspath(user_path))
                         fd, tmp = tempfile.mkstemp(
                             dir=d, prefix=".kubeconfig-"
                         )
                         try:
                             with os.fdopen(fd, "w") as f:
-                                yaml.safe_dump(doc, f)
-                            os.replace(tmp, path)
+                                yaml.safe_dump(user_doc, f)
+                            os.replace(tmp, user_path)
                         except BaseException:
                             os.unlink(tmp)
                             raise
@@ -223,9 +273,9 @@ class KubeConfig:
 
                         print(
                             "warning: could not persist refreshed OIDC "
-                            f"tokens to {path}: {e} (if your IdP rotates "
-                            "refresh tokens, the next run will need to "
-                            "re-authenticate)",
+                            f"tokens to {user_path}: {e} (if your IdP "
+                            "rotates refresh tokens, the next run will "
+                            "need to re-authenticate)",
                             file=sys.stderr,
                         )
 
@@ -547,7 +597,8 @@ class KubeClient:
         if status // 100 != 2:
             raise KubeAPIError(
                 f"GET {path} -> {status} {reason}: "
-                f"{body[:200].decode(errors='replace')}"
+                f"{body[:200].decode(errors='replace')}",
+                status=status,
             )
         try:
             return json.loads(body)
@@ -639,6 +690,10 @@ class KubeClient:
         url = f"{self._prefix}{path}?{query}"
         self.close()  # a watch always runs on its own fresh connection
         conn = self._connect(timeout=read_timeout)
+        # Register the stream's connection as the client's: close() from
+        # another thread (follower.stop()) must be able to sever a reader
+        # blocked in readline() instead of waiting out the watchdog.
+        self._conn = conn
         try:
             conn.request(
                 "GET",
@@ -650,7 +705,8 @@ class KubeClient:
                 body = resp.read()
                 raise KubeAPIError(
                     f"WATCH {path} -> {resp.status} {resp.reason}: "
-                    f"{body[:200].decode(errors='replace')}"
+                    f"{body[:200].decode(errors='replace')}",
+                    status=resp.status,
                 )
             while True:
                 try:
@@ -676,6 +732,8 @@ class KubeClient:
             raise KubeAPIError(f"WATCH {path} failed: {e}") from e
         finally:
             conn.close()
+            if self._conn is conn:
+                self._conn = None
 
 
 def _containers_fixture(containers: list | None) -> list:

@@ -45,17 +45,20 @@ class _Handler(socketserver.BaseRequestHandler):
         while True:
             try:
                 msg = protocol.recv_msg(self.request)
-            except protocol.ProtocolError:
+            except (protocol.ProtocolError, OSError):
+                # Mid-frame resets/aborts are routine client behavior, not
+                # server errors — drop the connection quietly.
                 return
             if msg is None:
                 return
             try:
-                result = server.dispatch(msg)
-                protocol.send_msg(self.request, {"ok": True, "result": result})
+                reply = {"ok": True, "result": server.dispatch(msg)}
             except Exception as e:  # noqa: BLE001 - service boundary
-                protocol.send_msg(
-                    self.request, {"ok": False, "error": f"{type(e).__name__}: {e}"}
-                )
+                reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            try:
+                protocol.send_msg(self.request, reply)
+            except OSError:
+                return  # peer went away while we answered
 
 
 class _ThreadingServer(socketserver.ThreadingTCPServer):

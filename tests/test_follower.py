@@ -197,10 +197,41 @@ class TestFollower:
             NODES: [[{"type": "ADDED",
                       "object": _with_rv(_k8s_node(_mk_node("obs")), 777)}]],
         }
-        f = _follower(server, on_event=lambda k, t, o: seen.append((k, t, o["name"])))
+        f = _follower(
+            server,
+            on_event=lambda k, t, o: seen.append((k, t, o.get("name"))),
+        )
         f.start()
         f.join(10)
         assert ("Node", "ADDED", "obs") in seen
+
+    def test_resync_deadline_goes_fatal(self, srv):
+        """Watch AND relist failing past the deadline must be VISIBLE:
+        fatal + stopped, never a silent retry loop behind an ever-staler
+        snapshot (expired unrefreshable creds, dead apiserver)."""
+        _, server = srv
+        f = _follower(
+            server,
+            stop_on_idle_window=False,
+            idle_rewatch_backoff=0.02,
+            resync_failure_deadline=0.2,
+        )
+        f.start()
+        assert f.wait_synced(5)
+        server.close()  # apiserver gone: watch and relist now both fail
+        assert f.wait_stopped(15)
+        assert f.fatal is not None and "resync failing" in f.fatal
+
+    def test_on_event_fires_for_relists(self, srv):
+        """Every relist must notify: relisted state can hold changes that
+        never flowed through per-object events, and a consumer that
+        republishes on events only would serve the pre-relist snapshot
+        forever on a quiet cluster (the 410-recovery staleness bug)."""
+        _, server = srv
+        seen = []
+        f = _follower(server, on_event=lambda k, t, o: seen.append((k, t)))
+        f.start(watch=False)
+        assert ("*", "RELIST") in seen
 
 
 class TestFailureVisibility:

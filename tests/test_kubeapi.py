@@ -356,7 +356,7 @@ class TestLiveFixture:
         )
 
     def test_kubeconfig_env_var_wins(self, monkeypatch, tmp_path, cluster):
-        """$KUBECONFIG is honored (first path entry), like client-go."""
+        """$KUBECONFIG is honored, like client-go (missing entries skipped)."""
         _, srv = cluster
         path = _write_kubeconfig(
             tmp_path, f"http://127.0.0.1:{srv.port}", {"token": "sekrit"}
@@ -364,6 +364,35 @@ class TestLiveFixture:
         monkeypatch.setenv("KUBECONFIG", path + os.pathsep + "/nonexistent")
         got = live_fixture(None)  # no explicit path: env must resolve it
         assert len(got["nodes"]) == 23
+
+    def test_kubeconfig_env_merges_files(self, monkeypatch, tmp_path):
+        """client-go merges every $KUBECONFIG entry: the current-context /
+        cluster / user may each live in a LATER file, and for duplicate
+        names the first file wins."""
+        import yaml as _yaml
+
+        a = tmp_path / "a.yaml"
+        a.write_text(_yaml.safe_dump({
+            "apiVersion": "v1", "kind": "Config",
+            # no current-context here; a decoy user that must win by name
+            "users": [{"name": "u", "user": {"token": "first-wins"}}],
+        }))
+        b = tmp_path / "b.yaml"
+        b.write_text(_yaml.safe_dump({
+            "apiVersion": "v1", "kind": "Config",
+            "current-context": "merged",
+            "contexts": [
+                {"name": "merged", "context": {"cluster": "c", "user": "u"}}
+            ],
+            "clusters": [
+                {"name": "c", "cluster": {"server": "http://10.0.0.9:8080"}}
+            ],
+            "users": [{"name": "u", "user": {"token": "shadowed"}}],
+        }))
+        monkeypatch.setenv("KUBECONFIG", f"{a}{os.pathsep}{b}")
+        cfg = kubeapi.KubeConfig.load()
+        assert cfg.server == "http://10.0.0.9:8080"
+        assert cfg.token == "first-wins"  # duplicate user: first file wins
 
     def test_connection_reuse_across_pages(self, tmp_path, cluster):
         """Paginated listing rides ONE keep-alive connection, and a client
