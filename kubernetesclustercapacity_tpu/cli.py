@@ -230,6 +230,12 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
         reference_run,
     )
     from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+    from kubernetesclustercapacity_tpu.utils.quantity import int64_bits
+
+    # Scenario CPU values are raw uint64 (codec wrap, printing parity);
+    # the int64-carrier kernels and the native ABI take their bit
+    # patterns — the same reinterpretation the snapshot arrays carry.
+    cpu_req_bits = int64_bits(scenario.cpu_request_milli)
 
     ext_requests = _parse_extended_requests(args)
     if ext_requests is None:
@@ -251,7 +257,7 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
                 snapshot, mode=args.semantics, fixture=fixture
             ).evaluate(
                 PodSpec(
-                    cpu_request_milli=scenario.cpu_request_milli,
+                    cpu_request_milli=cpu_req_bits,
                     mem_request_bytes=scenario.mem_request_bytes,
                     replicas=scenario.replicas,
                     cpu_limit_milli=scenario.cpu_limit_milli,
@@ -275,7 +281,7 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
                 snapshot.used_cpu_req_milli,
                 snapshot.used_mem_req_bytes,
                 snapshot.pods_count,
-                scenario.cpu_request_milli,
+                cpu_req_bits,
                 scenario.mem_request_bytes,
                 mode=args.semantics,
                 healthy=snapshot.healthy,
@@ -321,7 +327,7 @@ def _run_single(args, fixture, snapshot, scenario) -> int:
                 snapshot.used_mem_req_bytes,
                 snapshot.pods_count,
                 snapshot.healthy,
-                scenario.cpu_request_milli,
+                cpu_req_bits,
                 scenario.mem_request_bytes,
                 mode=args.semantics,
             )
@@ -359,6 +365,14 @@ def _run_grid(args, snapshot) -> int:
     from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
     from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
 
+    if args.backend != "tpu":
+        # Silently running the JAX sweep under -backend cpu/native would
+        # defeat a cross-check; the sequential backends are single-spec.
+        print(
+            "ERROR : -grid sweeps run on the TPU kernels (-backend tpu); "
+            "cpu/native backends are single-spec cross-checks ...exiting"
+        )
+        return 1
     ext_requests = _parse_extended_requests(args)
     if ext_requests is None:
         return 1
@@ -375,6 +389,8 @@ def _run_grid(args, snapshot) -> int:
         )
         from kubernetesclustercapacity_tpu.scenario import MultiResourceGrid
 
+        from kubernetesclustercapacity_tpu.scenario import ScenarioError
+
         mgrid = MultiResourceGrid.from_grid(
             grid,
             {
@@ -382,6 +398,11 @@ def _run_grid(args, snapshot) -> int:
                 for name, qty in ext_requests.items()
             },
         )
+        try:
+            mgrid.validate()  # e.g. a negative -extended-request quantity
+        except ScenarioError as e:
+            print(f"ERROR : {e} ...exiting")
+            return 1
         try:
             alloc_rn, used_rn = snapshot.resource_matrix(mgrid.resources)
         except KeyError as e:
@@ -411,6 +432,28 @@ def _run_grid(args, snapshot) -> int:
             kernel=args.kernel,
             node_mask=mask,
         )
+    if args.output == "table":
+        header = (
+            f"{'CPU(m)':>8} {'MEM(MiB)':>10} {'REPLICAS':>9} "
+            f"{'TOTAL':>8}  SCHED"
+        )
+        lines = [header, "-" * len(header)]
+        mib = 1024 * 1024
+        for i in range(grid.size):
+            lines.append(
+                f"{int(grid.cpu_request_milli[i]):>8} "
+                f"{int(grid.mem_request_bytes[i]) // mib:>10} "
+                f"{int(grid.replicas[i]):>9} "
+                f"{int(totals[i]):>8}  "
+                f"{'yes' if sched[i] else 'NO'}"
+            )
+        lines.append("-" * len(header))
+        lines.append(
+            f"kernel: {kernel}   schedulable: "
+            f"{int(np.sum(sched))}/{grid.size}"
+        )
+        print("\n".join(lines))
+        return 0
     summary = {
         "scenarios": args.grid,
         "seed": args.seed,

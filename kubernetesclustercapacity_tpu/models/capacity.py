@@ -57,6 +57,31 @@ class PodSpec:
     spread: int | None = None
 
     def __post_init__(self) -> None:
+        # CPU values may arrive as raw uint64 (the reference codec wraps
+        # negatives mod 2^64, e.g. "-5" → 2^64−5000); normalize to the
+        # int64 bit pattern every kernel / numpy array carries, HERE, so
+        # no consumer (service fit/place, CLI, library users) can feed
+        # an out-of-int64 Python int into jnp/np.int64 conversions.
+        from kubernetesclustercapacity_tpu.utils.quantity import int64_bits
+
+        object.__setattr__(
+            self, "cpu_request_milli", int64_bits(self.cpu_request_milli)
+        )
+        object.__setattr__(
+            self, "cpu_limit_milli", int64_bits(self.cpu_limit_milli)
+        )
+        if self.replicas < 0:
+            # Reference parity accepts negative replicas on the fit
+            # VERDICT (total >= replicas); placement has no coherent
+            # semantics for them (a lax.scan length must be >= 0) and
+            # evaluate() reports schedulable correctly with replicas
+            # normalized at the comparison — the spec itself stays the
+            # single gate for the placement surfaces.
+            raise ValueError(
+                "replicas must be >= 0 for PodSpec surfaces (the reference"
+                "-parity negative-replicas verdict is a Scenario/fit-path "
+                "behavior)"
+            )
         if self.spread is not None and self.spread < 1:
             raise ValueError("spread must be >= 1 (or None for unlimited)")
         for name, qty in self.extended_requests.items():

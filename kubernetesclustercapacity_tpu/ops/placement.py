@@ -89,6 +89,8 @@ def place_replicas(
     """
     if policy not in POLICIES:
         raise ValueError(f"unknown policy {policy!r} (want one of {POLICIES})")
+    if n_replicas < 0:
+        raise ValueError("n_replicas must be >= 0")
     alloc_cpu = jnp.asarray(alloc_cpu, jnp.int64)
     alloc_mem = jnp.asarray(alloc_mem, jnp.int64)
     c = jnp.asarray(cpu_req, jnp.int64)
@@ -200,6 +202,8 @@ def place_replicas_bulk(
     """
     if policy not in POLICIES:
         raise ValueError(f"unknown policy {policy!r} (want one of {POLICIES})")
+    if int(n_replicas) < 0:
+        raise ValueError("n_replicas must be >= 0")
     ac = np.asarray(alloc_cpu, dtype=np.int64)
     am = np.asarray(alloc_mem, dtype=np.int64)
     c, m = int(cpu_req), int(mem_req)
@@ -435,6 +439,8 @@ def place_replicas_multi(
     """
     if policy not in POLICIES:
         raise ValueError(f"unknown policy {policy!r} (want one of {POLICIES})")
+    if n_replicas < 0:
+        raise ValueError("n_replicas must be >= 0")
     alloc_rn = jnp.asarray(alloc_rn, jnp.int64)
     reqs = jnp.asarray(reqs_r, jnp.int64)
     n = alloc_rn.shape[1]
@@ -526,6 +532,8 @@ def place_replicas_bulk_multi(
     """
     if policy not in POLICIES:
         raise ValueError(f"unknown policy {policy!r} (want one of {POLICIES})")
+    if int(n_replicas) < 0:
+        raise ValueError("n_replicas must be >= 0")
     alloc_rn = np.asarray(alloc_rn, dtype=np.int64)
     used_rn = np.asarray(used_rn, dtype=np.int64)
     reqs = np.asarray(reqs_r, dtype=np.int64)

@@ -53,6 +53,7 @@ from dataclasses import dataclass, field
 from kubernetesclustercapacity_tpu.scenario import Scenario
 from kubernetesclustercapacity_tpu.utils.quantity import (
     QuantityParseError,
+    cpu_parse_error_payload,
     cpu_to_milli_reference,
     parse_quantity,
     to_bytes_reference,
@@ -180,7 +181,7 @@ def healthy_nodes(
     result = [NodeView() for _ in raw_nodes]
     for i, raw in enumerate(raw_nodes):
         allocatable = raw.get("allocatable", {})
-        cpu_milli, mem_bytes, alloc_pods = node_allocatable_values(
+        cpu_milli, mem_bytes, alloc_pods, _ = node_allocatable_values(
             allocatable.get("cpu", "0"),
             allocatable.get("memory", ""),
             allocatable.get("pods", "0"),
@@ -197,13 +198,16 @@ def healthy_nodes(
 
 def node_allocatable_values(
     cpu_str, mem_str, pods_str
-) -> tuple[int, int, int]:
+) -> tuple[int, int, int, str | None]:
     """One node's allocatable parses with ``getHealthyNodes``' exact error
     semantics: CPU codec errors raise through (``:196-197``), memory
     parse failure is a silent zero (``:202-206``), pods parse failure is
     zero (``.Pods().Value()`` of a missing/invalid quantity, ``:208``).
-    Single-sourced here so the columnar packer (``snapshot.py``) and the
-    per-node walk above cannot drift.
+    The fourth element is the CPU codec's error-line payload (the
+    suffix-stripped string ``convertCPUToMilis`` prints, ``:314-317``)
+    or ``None`` — transcript parity replays it.  Single-sourced here so
+    the columnar packer (``snapshot.py``) and the per-node walk above
+    cannot drift.
     """
     cpu_milli = cpu_to_milli_reference(cpu_str)
     try:
@@ -214,7 +218,7 @@ def node_allocatable_values(
         alloc_pods = parse_quantity(pods_str).value()
     except QuantityParseError:
         alloc_pods = 0
-    return cpu_milli, mem_bytes, alloc_pods
+    return cpu_milli, mem_bytes, alloc_pods, cpu_parse_error_payload(cpu_str)
 
 
 def node_is_healthy_reference(raw: dict) -> bool:

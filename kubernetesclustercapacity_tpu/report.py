@@ -48,6 +48,21 @@ def _go_percent(num: int, den: int) -> float:
     return float(num) * 100 / float(den)
 
 
+def _u64(v: int) -> int:
+    """The unsigned view of an int64 bit pattern.
+
+    Go keeps allocatable CPU and the CPU request/limit sums in uint64
+    (``ClusterCapacity.go:41-46,255-258``) and prints/divides them as such;
+    the snapshot arrays carry the same bits in int64, so wrapped sums
+    (>= 2^63) must be reinterpreted before rendering.  Memory is int64 in
+    Go too — it stays signed.
+    """
+    return v & ((1 << 64) - 1) if v < 0 else v
+
+
+_CPU_CODEC_ERR = "\nError converting string to int for %s\n"
+
+
 def reference_report(
     snapshot: ClusterSnapshot,
     fits: np.ndarray,
@@ -57,32 +72,47 @@ def reference_report(
 ) -> str:
     """The reference's stdout transcript, reconstructed from arrays.
 
-    Mirrors ``main``'s prints in order: the parsed-input line (``:85``), the
-    node count (``:174``), per-node blocks (``:107-137``), and the final
-    verdict (``:142-149``).  The per-node struct print matches Go's ``%v`` of
-    the ``node`` struct: ``{name cpu mem pods}``.
+    Mirrors ``main``'s prints in order: the flag-codec error lines
+    (``:64-65`` → ``:316``), the parsed-input line (``:85``), the node
+    count (``:174``) followed by getHealthyNodes' codec-error/skip lines
+    (``:215,316``), per-node blocks (``:107-137``) each preceded by its
+    pods' codec-error lines (``:279-284``), and the final verdict
+    (``:142-149``).  The per-node struct print matches Go's ``%v`` of the
+    ``node`` struct: ``{name cpu mem pods}``.  CPU quantities render as
+    uint64 (see :func:`_u64`).
     """
     out = []
+    pod_errs = snapshot.pod_cpu_errs
     if include_preamble:
+        for payload in getattr(scenario, "input_cpu_error_payloads", ()):
+            out.append(_CPU_CODEC_ERR % payload)
         out.append(
             "\nCPU limits, requests, Memory limits, requests and replicas "
-            f"parsed from input : {scenario.cpu_limit_milli} "
-            f"{scenario.cpu_request_milli} {scenario.mem_limit_bytes} "
+            f"parsed from input : {_u64(scenario.cpu_limit_milli)} "
+            f"{_u64(scenario.cpu_request_milli)} {scenario.mem_limit_bytes} "
             f"{scenario.mem_request_bytes} {scenario.replicas}\n"
         )
         out.append(
             f"\nThere are total {snapshot.n_nodes} nodes in the cluster\n\n"
         )
+        for kind, payload in snapshot.node_log:
+            if kind == "cpu_err":
+                out.append(_CPU_CODEC_ERR % payload)
+            else:  # "skip" — Go prints the REAL name of the phantom row
+                out.append(f"Skipping node {payload} as it is not healthy\n")
 
     total = 0
     for i in range(snapshot.n_nodes):
         name = snapshot.names[i]
-        alloc_cpu = int(snapshot.alloc_cpu_milli[i])
+        alloc_cpu = _u64(int(snapshot.alloc_cpu_milli[i]))
         alloc_mem = int(snapshot.alloc_mem_bytes[i])
-        cpu_lim = int(snapshot.used_cpu_lim_milli[i])
-        cpu_req = int(snapshot.used_cpu_req_milli[i])
+        cpu_lim = _u64(int(snapshot.used_cpu_lim_milli[i]))
+        cpu_req = _u64(int(snapshot.used_cpu_req_milli[i]))
         mem_lim = int(snapshot.used_mem_lim_bytes[i])
         mem_req = int(snapshot.used_mem_req_bytes[i])
+        if i < len(pod_errs):  # the pod walk's codec errors print first
+            for payload in pod_errs[i]:
+                out.append(_CPU_CODEC_ERR % payload)
         out.append(
             f"\n{{{name} {alloc_cpu} {alloc_mem} "
             f"{int(snapshot.alloc_pods[i])}}} - "
@@ -134,9 +164,10 @@ def json_report(
     total = int(np.sum(fits))
     nodes = []
     for i in range(snapshot.n_nodes):
-        alloc_cpu = int(snapshot.alloc_cpu_milli[i])
+        # CPU fields are uint64 in Go (see _u64); memory is int64.
+        alloc_cpu = _u64(int(snapshot.alloc_cpu_milli[i]))
         alloc_mem = int(snapshot.alloc_mem_bytes[i])
-        cpu_req = int(snapshot.used_cpu_req_milli[i])
+        cpu_req = _u64(int(snapshot.used_cpu_req_milli[i]))
         mem_req = int(snapshot.used_mem_req_bytes[i])
         nodes.append(
             {
@@ -152,7 +183,7 @@ def json_report(
                     "memory_bytes": mem_req,
                 },
                 "used_limits": {
-                    "cpu_milli": int(snapshot.used_cpu_lim_milli[i]),
+                    "cpu_milli": _u64(int(snapshot.used_cpu_lim_milli[i])),
                     "memory_bytes": int(snapshot.used_mem_lim_bytes[i]),
                 },
                 "pods_count": int(snapshot.pods_count[i]),

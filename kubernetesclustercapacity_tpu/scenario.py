@@ -15,9 +15,12 @@ import numpy as np
 
 from kubernetesclustercapacity_tpu.utils.quantity import (
     QuantityParseError,
+    cpu_parse_error_payload,
     cpu_to_milli_reference,
     go_atoi,
+    go_atoi_clamped,
     go_atoi_error,
+    int64_bits,
     to_bytes_reference,
 )
 
@@ -65,6 +68,12 @@ class Scenario:
     replicas: int
     cpu_limit_milli: int = 0
     mem_limit_bytes: int = 0
+    # Transcript provenance: the suffix-stripped payloads of CPU flag
+    # values the reference codec failed to parse (requests first, then
+    # limits — main's conversion order at ClusterCapacity.go:64-65); the
+    # reference prints one error line per payload before the parsed-input
+    # line, and report.reference_report replays them.
+    input_cpu_error_payloads: tuple[str, ...] = ()
 
     def validate(self) -> None:
         """Reject requests the reference would crash on.
@@ -76,16 +85,21 @@ class Scenario:
         float, so ``"0.5B"`` passes the check and truncates to 0 bytes,
         panicking at ``:129``.  Divergence (SURVEY.md §2.4 Q8): we validate
         instead of panicking.
+
+        CPU requests are uint64 (the codec wraps negatives mod 2^64, e.g.
+        ``-cpuRequests=-5`` → 2^64−5000): any NONZERO value is a valid —
+        if enormous — divisor the reference runs with (every node fits 0),
+        so only zero is rejected.  Negative replicas are likewise accepted:
+        Go's ``Atoi`` parses them and the verdict comparison
+        ``total >= replicas`` simply always schedules.
         """
-        if self.cpu_request_milli <= 0:
+        if self.cpu_request_milli % (1 << 64) == 0:
             raise ScenarioError(
-                "cpuRequests must be > 0 (the reference integer-divides by it "
-                "and would panic on zero)"
+                "cpuRequests must be nonzero (the reference integer-divides "
+                "by it and would panic on zero)"
             )
         if self.mem_request_bytes <= 0:
             raise ScenarioError("memRequests must be > 0")
-        if self.replicas < 0:
-            raise ScenarioError("replicas must be >= 0")
 
 
 def scenario_from_flags(
@@ -106,6 +120,16 @@ def scenario_from_flags(
     """
     cpu_req = cpu_to_milli_reference(cpuRequests)
     cpu_lim = cpu_to_milli_reference(cpuLimits)
+    # Requests convert before limits in main (:64-65); each failure is one
+    # codec error line printed before the parsed-input line.
+    cpu_error_payloads = tuple(
+        p
+        for p in (
+            cpu_parse_error_payload(cpuRequests),
+            cpu_parse_error_payload(cpuLimits),
+        )
+        if p is not None
+    )
     # Fatal-flag errors carry the reference's exact Println output: the
     # zeroed value ToBytes/Atoi returned alongside its error, space-joined
     # (ClusterCapacity.go:69,75,81).
@@ -125,10 +149,13 @@ def scenario_from_flags(
         ) from e
     n_replicas = go_atoi(replicas)  # Go strconv.Atoi acceptance rules (:79)
     if n_replicas is None:
+        # Go prints the VALUE Atoi returned with its error — 0 for syntax
+        # errors but the int64-CLAMPED value for range errors (:81).
         raise ScenarioError(
             f"Invalid input replicas: {replicas!r}",
             reference_line=(
-                f"ERROR : Invalid input replicas = 0 "
+                f"ERROR : Invalid input replicas = "
+                f"{go_atoi_clamped(replicas)} "
                 f"{go_atoi_error(replicas)} ...exiting"
             ),
         )
@@ -138,6 +165,7 @@ def scenario_from_flags(
         replicas=n_replicas,
         cpu_limit_milli=cpu_lim,
         mem_limit_bytes=mem_lim,
+        input_cpu_error_payloads=cpu_error_payloads,
     )
 
 
@@ -169,18 +197,22 @@ class ScenarioGrid:
         return int(self.cpu_request_milli.shape[0])
 
     def validate(self) -> None:
-        if (self.cpu_request_milli <= 0).any():
-            raise ScenarioError("all cpu requests must be > 0")
+        # CPU entries are uint64 bit patterns in an int64 carrier (negative
+        # = wrapped huge request, fits 0 everywhere, reference semantics) —
+        # only a true zero is the divide-by-zero panic case (Q8).
+        if (self.cpu_request_milli == 0).any():
+            raise ScenarioError("all cpu requests must be nonzero")
         if (self.mem_request_bytes <= 0).any():
             raise ScenarioError("all mem requests must be > 0")
-        if (self.replicas < 0).any():
-            raise ScenarioError("all replicas must be >= 0")
 
     @classmethod
     def from_scenarios(cls, scenarios: list[Scenario]) -> "ScenarioGrid":
         return cls(
+            # Scenario carries raw uint64 CPU values (printing parity);
+            # the arrays carry their int64 bit patterns (kernel carrier).
             cpu_request_milli=np.array(
-                [s.cpu_request_milli for s in scenarios], dtype=np.int64
+                [int64_bits(s.cpu_request_milli) for s in scenarios],
+                dtype=np.int64,
             ),
             mem_request_bytes=np.array(
                 [s.mem_request_bytes for s in scenarios], dtype=np.int64

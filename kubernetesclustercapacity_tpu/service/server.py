@@ -319,6 +319,10 @@ class CapacityServer:
                 dtype=np.int64,
             )
         else:
+            from kubernetesclustercapacity_tpu.utils.quantity import (
+                int64_bits,
+            )
+
             fits = np.asarray(
                 fit_per_node(
                     snap.alloc_cpu_milli,
@@ -328,7 +332,8 @@ class CapacityServer:
                     snap.used_mem_req_bytes,
                     snap.pods_count,
                     snap.healthy,
-                    scenario.cpu_request_milli,
+                    # raw uint64 request -> the kernel's int64 bit pattern
+                    int64_bits(scenario.cpu_request_milli),
                     scenario.mem_request_bytes,
                     mode=snap.semantics,
                     node_mask=node_mask,

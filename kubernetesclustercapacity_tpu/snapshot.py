@@ -85,6 +85,18 @@ class ClusterSnapshot:
     extended: dict[str, tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
     labels: list[dict] = field(default_factory=list)
     taints: list[list] = field(default_factory=list)
+    # Transcript provenance (reference packing only): the stdout side
+    # effects the Go binary emits while building ITS view of the cluster,
+    # replayed by report.reference_report for byte parity.  node_log is
+    # the getHealthyNodes-phase event list in emission order — ("cpu_err",
+    # stripped_string) for each allocatable-CPU codec failure
+    # (ClusterCapacity.go:314-317) and ("skip", real_node_name) for each
+    # unhealthy node (:215; the snapshot's phantom row keeps "" but Go
+    # prints the REAL name).  pod_cpu_errs is the per-row lists of
+    # container-CPU codec-failure payloads (limits before requests,
+    # :279-284) printed just before each node's block in main's loop.
+    node_log: list[tuple[str, str]] = field(default_factory=list)
+    pod_cpu_errs: list[list[str]] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         n = len(self.names)
@@ -140,13 +152,18 @@ class ClusterSnapshot:
             "labels": self.labels,
             "taints": self.taints,
             "extended_names": sorted(self.extended),
+            "node_log": [list(t) for t in self.node_log],
+            "pod_cpu_errs": self.pod_cpu_errs,
             "version": 1,
         }
         arrays = {
             f.name: getattr(self, f.name)
             for f in fields(self)
             if f.name
-            not in ("names", "semantics", "extended", "labels", "taints")
+            not in (
+                "names", "semantics", "extended", "labels", "taints",
+                "node_log", "pod_cpu_errs",
+            )
         }
         for r_name, (alloc, used) in self.extended.items():
             arrays[f"ext_alloc::{r_name}"] = alloc
@@ -176,6 +193,9 @@ def load_snapshot(path: str) -> ClusterSnapshot:
             extended=extended,
             labels=meta["labels"],
             taints=meta["taints"],
+            node_log=[tuple(t) for t in meta.get("node_log", [])],
+            pod_cpu_errs=meta.get("pod_cpu_errs")
+            or [[] for _ in meta["names"]],
         )
 
 
@@ -239,7 +259,8 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
     # conditions check: a bad cpu string on node 5 must raise before node
     # 7's <4-conditions panic, in exactly the rowwise order.
     names: list[str] = []
-    triple_vals: dict = {}  # triple -> (code, cpu_milli, mem_bytes, pods)
+    node_log: list[tuple[str, str]] = []
+    triple_vals: dict = {}  # triple -> (code, cpu, mem, pods, cpu_err)
     healthy_rows: list[int] = []
     row_codes: list[int] = []
     for i, raw in enumerate(raw_nodes):
@@ -251,23 +272,30 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
         )
         vals = triple_vals.get(triple)
         if vals is None:
-            cpu, mem, pods = _oracle.node_allocatable_values(*triple)
+            cpu, mem, pods, cpu_err = _oracle.node_allocatable_values(
+                *triple
+            )
             vals = triple_vals[triple] = (
                 len(triple_vals), _clamp_i64(cpu), _clamp_i64(mem), pods,
+                cpu_err,
             )
+        if vals[4] is not None:  # codec error prints per OCCURRENCE
+            node_log.append(("cpu_err", vals[4]))
 
         if _oracle.node_is_healthy_reference(raw):
-            # Phantom rows (unhealthy → zero-valued node) keep the empty
-            # name and zero allocatables (ClusterCapacity.go:221-226).
             names.append(raw.get("name", ""))
             healthy_rows.append(i)
             row_codes.append(vals[0])
         else:
+            # Phantom row (unhealthy → zero-valued node) keeps the empty
+            # name and zero allocatables (ClusterCapacity.go:221-226);
+            # the skip line prints the REAL name (:215).
             names.append("")
+            node_log.append(("skip", raw.get("name", "")))
 
     if healthy_rows:
         lut = np.empty((len(triple_vals), 3), dtype=np.int64)
-        for code, cpu, mem, pods in triple_vals.values():
+        for code, cpu, mem, pods, _err in triple_vals.values():
             lut[code] = (cpu, mem, pods)
         hr = np.asarray(healthy_rows, dtype=np.int64)
         rc = np.asarray(row_codes, dtype=np.int64)
@@ -290,6 +318,7 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
         fixture.get("pods", [])
     )
 
+    pod_cpu_errs: list[list[str]] = [[] for _ in range(n)]
     if name_gid and n:
         # Per-column LUTs over the distinct quads: each string parses once.
         lut = np.empty((4, len(interned)), dtype=np.int64)
@@ -324,8 +353,47 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
         ):
             snap[field_name] = np.where(hit, by_name[key][safe], 0)
 
+        # Transcript events: container cpu strings that fail the codec
+        # print once per OCCURRENCE, limits before requests
+        # (ClusterCapacity.go:279-284), grouped before each node's block
+        # in main's loop order.  Failing quads are known from the LUT
+        # vocabulary; per-row lists replay through (c_gids, c_codes) with
+        # no extra fixture walk.  Phantom rows share the "" group's list,
+        # exactly as each phantom node's degenerate selector re-fetches
+        # the same orphan pods.
+        quad_errs: list[list[str]] = []
+        any_err = False
+        for quad in interned:
+            errs = [
+                p
+                for p in (
+                    _q.cpu_parse_error_payload(quad[1]),  # limits first
+                    _q.cpu_parse_error_payload(quad[0]),
+                )
+                if p is not None
+            ]
+            quad_errs.append(errs)
+            any_err = any_err or bool(errs)
+        if any_err:
+            gid_errs: dict[int, list[str]] = {}
+            for gid_i, code_i in zip(c_gids, c_codes):
+                errs = quad_errs[code_i]
+                if errs:
+                    gid_errs.setdefault(int(gid_i), []).extend(errs)
+            for i in range(n):
+                if hit[i]:
+                    pod_cpu_errs[i] = list(
+                        gid_errs.get(int(row_gid[i]), ())
+                    )
+
     return ClusterSnapshot(
-        names=names, semantics="reference", labels=labels, taints=taints, **snap
+        names=names,
+        semantics="reference",
+        labels=labels,
+        taints=taints,
+        node_log=node_log,
+        pod_cpu_errs=pod_cpu_errs,
+        **snap,
     )
 
 
@@ -387,6 +455,19 @@ def _pack_reference_rowwise(fixture: dict) -> ClusterSnapshot:
     rows = []
     names, labels, taints = [], [], []
     raw_nodes = fixture.get("nodes", [])
+    node_log: list[tuple[str, str]] = []
+    pod_cpu_errs: list[list[str]] = []
+    for raw in raw_nodes:
+        allocatable = raw.get("allocatable", {})
+        payload = _oracle.node_allocatable_values(
+            allocatable.get("cpu", "0"),
+            allocatable.get("memory", ""),
+            allocatable.get("pods", "0"),
+        )[3]  # the single-sourced codec-error payload
+        if payload is not None:
+            node_log.append(("cpu_err", payload))
+        if not _oracle.node_is_healthy_reference(raw):
+            node_log.append(("skip", raw.get("name", "")))
     for i, node in enumerate(nodes):
         pods = pods_by_node.get(node.name, [])
         cpu_lim, cpu_req, mem_lim, mem_req = _oracle.pod_requests_limits(pods)
@@ -405,6 +486,17 @@ def _pack_reference_rowwise(fixture: dict) -> ClusterSnapshot:
         )
         labels.append(raw_nodes[i].get("labels", {}))
         taints.append(raw_nodes[i].get("taints", []))
+        errs: list[str] = []
+        for pod in pods:
+            for c in pod.get("containers", []):
+                res = c.get("resources", {})
+                req = res.get("requests", {})
+                lim = res.get("limits", {})
+                for s in (lim.get("cpu", "0"), req.get("cpu", "0")):
+                    p = _q.cpu_parse_error_payload(s)
+                    if p is not None:
+                        errs.append(p)
+        pod_cpu_errs.append(errs)
 
     mat = np.array(rows, dtype=np.int64).reshape(n, 8)
     snap = dict(
@@ -425,7 +517,13 @@ def _pack_reference_rowwise(fixture: dict) -> ClusterSnapshot:
     snap["healthy"] = np.array([bool(nm) for nm in names], dtype=np.bool_)
 
     return ClusterSnapshot(
-        names=names, semantics="reference", labels=labels, taints=taints, **snap
+        names=names,
+        semantics="reference",
+        labels=labels,
+        taints=taints,
+        node_log=node_log,
+        pod_cpu_errs=pod_cpu_errs,
+        **snap,
     )
 
 

@@ -48,7 +48,10 @@ _PARSE_CACHE_SIZE = 1 << 16
 __all__ = [
     "QuantityParseError",
     "go_atoi",
+    "go_atoi_clamped",
+    "int64_bits",
     "cpu_to_milli_reference",
+    "cpu_parse_error_payload",
     "to_bytes_reference",
     "byte_size",
     "to_megabytes",
@@ -93,6 +96,44 @@ def go_atoi(s: str) -> int | None:
     if not (-(1 << 63) <= value < (1 << 63)):
         return None
     return value
+
+
+def go_atoi_clamped(s: str) -> int:
+    """The VALUE Go ``strconv.Atoi`` returns alongside a failed parse.
+
+    Syntax errors return 0, but range errors return the int64-CLAMPED
+    value (``strconv.ParseInt`` semantics) — and the reference's fatal
+    replicas line prints that value (``fmt.Println(..., replicas, ...)``
+    at ``ClusterCapacity.go:81``), so byte parity needs it.
+    """
+    body = s[1:] if s[:1] in "+-" else s
+    if body and body.isascii() and body.isdigit():
+        value = int(s, 10)
+        if value >= 1 << 63:
+            return (1 << 63) - 1
+        if value < -(1 << 63):
+            return -(1 << 63)
+        return value
+    return 0
+
+
+def int64_bits(u: int) -> int:
+    """Reinterpret an arbitrary integer as its int64 bit pattern
+    (mod 2^64, two's complement) — the carrier the kernels/native code
+    use for Go's uint64 values."""
+    u %= 1 << 64
+    return u - (1 << 64) if u >= 1 << 63 else u
+
+
+def cpu_parse_error_payload(cpu: str) -> str | None:
+    """The ``%s`` of the reference codec's error line, or ``None``.
+
+    ``convertCPUToMilis`` prints ``"\\nError converting string to int for
+    %s\\n"`` with the SUFFIX-STRIPPED string whenever ``Atoi`` fails
+    (``ClusterCapacity.go:314-317``) — transcript parity replays these.
+    """
+    body = cpu[:-1] if cpu.endswith("m") else cpu
+    return None if go_atoi(body) is not None else body
 
 
 def go_atoi_error(s: str) -> str:

@@ -167,12 +167,24 @@ class TestCli:
         )
 
     def test_replicas_range_error_line_parity(self, capsys):
+        # Go's Atoi returns the int64-CLAMPED value alongside ErrRange, and
+        # the reference prints that value — not 0 (only syntax errors
+        # return 0).
         huge = "99999999999999999999"  # valid digits, overflows int64
         rc = main(["-snapshot", KIND, f"-replicas={huge}"])
         assert rc == 1
         assert capsys.readouterr().out == (
-            f'ERROR : Invalid input replicas = 0 strconv.Atoi: '
-            f'parsing "{huge}": value out of range ...exiting\n'
+            f'ERROR : Invalid input replicas = 9223372036854775807 '
+            f'strconv.Atoi: parsing "{huge}": value out of range ...exiting\n'
+        )
+
+    def test_replicas_negative_range_error_line_parity(self, capsys):
+        tiny = "-99999999999999999999"
+        rc = main(["-snapshot", KIND, f"-replicas={tiny}"])
+        assert rc == 1
+        assert capsys.readouterr().out == (
+            f'ERROR : Invalid input replicas = -9223372036854775808 '
+            f'strconv.Atoi: parsing "{tiny}": value out of range ...exiting\n'
         )
 
     def test_zero_cpu_request_validated(self, capsys):
@@ -354,3 +366,180 @@ class TestExtendedRequestsCLI:
             node_masks=implicit_taint_mask(snap),
         )
         assert got["totals"] == np.asarray(exact[0]).tolist()
+
+
+class TestTranscriptSideEffects:
+    """The reference's stdout SIDE EFFECTS — getHealthyNodes' skip lines,
+    convertCPUToMilis' codec-error lines, uint64 rendering — replayed for
+    byte parity (ClusterCapacity.go:215,316,279-284; uint64 fields at
+    :41-46)."""
+
+    def _node(self, name, *, cpu="4", unhealthy=False):
+        conds = [{"type": "c", "status": "False"}] * 4
+        if unhealthy:
+            conds = [{"type": "c", "status": "True"}] + conds[1:]
+        return {
+            "name": name,
+            "allocatable": {"cpu": cpu, "memory": "8388608Ki", "pods": "110"},
+            "conditions": conds,
+        }
+
+    def _write(self, tmp_path, fx):
+        import json as _json
+
+        p = tmp_path / "fx.json"
+        p.write_text(_json.dumps(fx))
+        return str(p)
+
+    def test_skip_lines_after_node_count(self, tmp_path, capsys):
+        fx = {
+            "nodes": [
+                self._node("good-1"),
+                self._node("sick", unhealthy=True),
+                self._node("good-2"),
+            ],
+            "pods": [],
+        }
+        rc = main(["-snapshot", self._write(tmp_path, fx)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        # The skip line names the REAL node (the phantom row keeps "").
+        want = (
+            "There are total 3 nodes in the cluster\n\n"
+            "Skipping node sick as it is not healthy\n"
+        )
+        assert want in out
+        assert "\n{ 0 0 0} - " in out  # the phantom row block still prints
+
+    def test_node_codec_error_lines(self, tmp_path, capsys):
+        fx = {
+            "nodes": [self._node("weird", cpu="4.5")],
+            "pods": [],
+        }
+        rc = main(["-snapshot", self._write(tmp_path, fx)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert (
+            "There are total 1 nodes in the cluster\n\n"
+            "\nError converting string to int for 4.5\n"
+        ) in out
+
+    def test_pod_codec_error_lines_before_node_block(self, tmp_path, capsys):
+        fx = {
+            "nodes": [self._node("n0")],
+            "pods": [
+                {
+                    "name": "p", "namespace": "d", "nodeName": "n0",
+                    "phase": "Running",
+                    "containers": [
+                        {"resources": {
+                            "requests": {"cpu": "0.25"},
+                            "limits": {"cpu": "bogus"},
+                        }}
+                    ],
+                }
+            ],
+        }
+        rc = main(["-snapshot", self._write(tmp_path, fx)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        # Limits convert before requests (:279-284), both lines land just
+        # before the node's block.
+        assert (
+            "\nError converting string to int for bogus\n"
+            "\nError converting string to int for 0.25\n"
+            "\n{n0 4000 8589934592 110} - " in out
+        )
+
+    def test_flag_codec_error_lines_before_parsed_input(self, capsys):
+        rc = main(["-snapshot", KIND, "-cpuRequests=250m",
+                   "-cpuLimits=2.5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert (
+            "\nError converting string to int for 2.5\n"
+            "\nCPU limits, requests, Memory limits, requests and replicas "
+            "parsed from input : 0 250 " in out
+        )
+
+    def test_wrapped_cpu_request_runs_and_matches_cpu_backend(
+        self, capsys
+    ):
+        # '-5' wraps to 2^64-5000 through Go's uint64(int(...)): a huge
+        # divisor, 0 fits everywhere — the reference RUNS (and so must
+        # every backend; the TPU path once crashed with OverflowError).
+        outs = {}
+        for backend in ("tpu", "cpu", "native"):
+            rc = main(["-snapshot", KIND, "-cpuRequests=-5",
+                       "-backend", backend])
+            assert rc == 0, backend
+            outs[backend] = capsys.readouterr().out
+        assert outs["tpu"] == outs["cpu"] == outs["native"]
+        assert (
+            "parsed from input : 200 18446744073709546616 " in outs["tpu"]
+        )
+        assert "Total possible replicas for the pod with required input " \
+               "specs : 0" in outs["tpu"]
+
+    def test_negative_replicas_accepted_like_reference(self, capsys):
+        rc = main(["-snapshot", KIND, "-replicas=-5"])
+        assert rc == 0
+        assert (
+            "So you can go ahead with deployment of -5 pod replicas"
+            in capsys.readouterr().out
+        )
+
+    def test_wrapped_cpu_sums_render_unsigned(self, tmp_path, capsys):
+        # Two containers at int64-max millicores: the uint64 running sum
+        # wraps to 2^64-2, which Go prints as 18446744073709551614 (and
+        # uses for the float64 percent), never as -2.
+        huge = "9223372036854775807m"
+        fx = {
+            "nodes": [self._node("n0")],
+            "pods": [
+                {
+                    "name": "p", "namespace": "d", "nodeName": "n0",
+                    "phase": "Running",
+                    "containers": [
+                        {"resources": {"requests": {"cpu": huge}}},
+                        {"resources": {"requests": {"cpu": huge}}},
+                    ],
+                }
+            ],
+        }
+        rc = main(["-snapshot", self._write(tmp_path, fx)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert (
+            "Sum of CPU Limits, Requests and Memory Limits, Requests for "
+            "all pods : 0 18446744073709551614 0 0"
+        ) in out
+        assert "-2" not in out.split("Sum of CPU")[1].split("\n")[0]
+
+
+class TestGridFlagInteractions:
+    def test_grid_rejects_non_tpu_backend(self, capsys):
+        rc = main(["-snapshot", KIND, "-grid", "4", "-backend", "cpu"])
+        assert rc == 1
+        assert "-grid sweeps run on the TPU kernels" in capsys.readouterr().out
+
+    def test_grid_table_output(self, capsys):
+        rc = main(["-snapshot", KIND, "-grid", "4", "-output", "table"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "CPU(m)" in out and "kernel:" in out
+
+    def test_grid_negative_extended_request_rejected(self, tmp_path, capsys):
+        import json as _json
+
+        fx = load_fixture(KIND)
+        fx["nodes"][0]["allocatable"]["nvidia.com/gpu"] = "8"
+        p = tmp_path / "gpu.json"
+        p.write_text(_json.dumps(fx))
+        rc = main([
+            "-snapshot", str(p), "-semantics", "strict",
+            "-extended-resources", "nvidia.com/gpu",
+            "-grid", "4", "-extended-request", "nvidia.com/gpu=-2",
+        ])
+        assert rc == 1
+        assert "requests must be >= 0" in capsys.readouterr().out

@@ -59,6 +59,22 @@ class TestOps:
         b = client.fit(backend="cpu")
         assert a["fits"] == b["fits"]
 
+    def test_fit_wrapped_cpu_request_runs(self, client):
+        """'-5' wraps to a huge uint64 divisor (reference semantics): the
+        service must answer 0 fits everywhere, not crash converting the
+        raw value to int64 (the CLI fix must cover this surface too)."""
+        a = client.fit(cpuRequests="-5", backend="tpu")
+        b = client.fit(cpuRequests="-5", backend="cpu")
+        assert a["fits"] == b["fits"]
+        assert a["total"] == 0
+        assert "parsed from input : 200 18446744073709546616 " in a["report"]
+
+    def test_place_negative_replicas_rejected(self, client):
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="replicas must be >= 0"):
+            client.place(replicas="-3")
+
     def test_bad_flags_are_service_errors(self, client):
         with pytest.raises(RuntimeError, match="memRequests"):
             client.fit(memRequests="garbage")

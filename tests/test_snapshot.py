@@ -160,6 +160,17 @@ class TestCheckpoint:
         assert loaded.semantics == "reference"
         assert loaded.labels[0]["kubernetes.io/hostname"] == "kind-control-plane"
 
+    def test_roundtrip_preserves_transcript_events(self, tmp_path):
+        fx = synthetic_fixture(12, seed=5, unhealthy_frac=0.5)
+        fx["nodes"][0]["allocatable"]["cpu"] = "4.5"  # codec error line
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        assert snap.node_log  # unhealthy_frac=0.5 guarantees skip events
+        p = str(tmp_path / "snap.npz")
+        snap.save(p)
+        loaded = load_snapshot(p)
+        assert loaded.node_log == snap.node_log
+        assert loaded.pod_cpu_errs == snap.pod_cpu_errs
+
     def test_roundtrip_with_extended(self, tmp_path):
         fx = {"nodes": [{"name": "n", "allocatable": {
             "cpu": "8", "memory": "32Gi", "pods": "110", "nvidia.com/gpu": "4"},
@@ -288,6 +299,10 @@ class TestReferenceColumnarParity:
                 getattr(got, f), getattr(want, f), err_msg=f
             )
         assert got.labels == want.labels and got.taints == want.taints
+        # Transcript provenance (skip lines + codec-error payloads) must
+        # replay identically from either walk.
+        assert got.node_log == want.node_log
+        assert got.pod_cpu_errs == want.pod_cpu_errs
 
     @pytest.mark.parametrize("seed", [0, 1, 2, 3])
     def test_randomized_fixture(self, seed):
