@@ -81,12 +81,12 @@ def _load() -> ctypes.CDLL:
         except (RuntimeError, OSError) as e:
             _BUILD_ERROR = f"native build failed: {e}"
             raise NativeUnavailable(_BUILD_ERROR) from e
-        lib.kcc_cpu_to_milli.argtypes = [ctypes.c_char_p]
-        lib.kcc_cpu_to_milli.restype = ctypes.c_uint64
-        lib.kcc_to_bytes.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)
+        lib.kcc_cpu_to_milli_n.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.kcc_cpu_to_milli_n.restype = ctypes.c_uint64
+        lib.kcc_to_bytes_n.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)
         ]
-        lib.kcc_to_bytes.restype = ctypes.c_int
+        lib.kcc_to_bytes_n.restype = ctypes.c_int
         lib.kcc_fit_arrays.argtypes = [
             ctypes.c_int64, _I64P, _I64P, _I64P, _I64P, _I64P, _I64P, _U8P,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int, _I64P,
@@ -111,14 +111,20 @@ def available() -> bool:
 
 
 def cpu_to_milli(s: str) -> int:
-    """Native ``convertCPUToMilis`` — returns the uint64 value."""
-    return int(_load().kcc_cpu_to_milli(s.encode()))
+    """Native ``convertCPUToMilis`` — returns the uint64 value.
+
+    Length passes explicitly so embedded NUL bytes reject exactly like
+    the Python codec instead of silently truncating at the NUL.
+    """
+    b = s.encode()
+    return int(_load().kcc_cpu_to_milli_n(b, len(b)))
 
 
 def to_bytes(s: str) -> int:
     """Native ``bytefmt.ToBytes``; raises ValueError on the reference error."""
     out = ctypes.c_int64()
-    if _load().kcc_to_bytes(s.encode(), ctypes.byref(out)) != 0:
+    b = s.encode()
+    if _load().kcc_to_bytes_n(b, len(b), ctypes.byref(out)) != 0:
         raise ValueError(
             "byte quantity must be a positive integer with a unit of "
             "measurement like M, MB, MiB, G, GiB, or GB"

@@ -14,9 +14,12 @@
 //  * kcc_to_bytes mirrors bytefmt.ToBytes (bytes.go:75-105): trim + upper,
 //    split at first (ASCII) letter, all-base-2 suffix table with the GI/TI
 //    gap, value <= 0 or no suffix -> error, int64 truncation with the
-//    amd64 out-of-range convention (INT64_MIN).  Divergences (documented,
-//    same as the Python codec): inf/nan/hex spellings and underscore digit
-//    separators are rejected; only ASCII letters split the suffix.
+//    amd64 out-of-range convention (INT64_MIN), underscore digit
+//    separators accepted between digits (Go 1.13+/Python float()).
+//    Divergences (documented, same as the Python codec): inf/nan/hex
+//    spellings are rejected; only ASCII letters split the suffix; the
+//    whitespace trim is ASCII-only (exotic Unicode spaces that Go's
+//    TrimSpace would strip are rejected here and by honest fixtures).
 //  * kcc_fit_arrays / kcc_sweep: mode 0 = reference (conditional pod-cap
 //    overwrite, may go negative), mode 1 = strict (3-way min, clamp at 0,
 //    healthy mask).  A zero divisor reached behind a positive headroom
@@ -30,6 +33,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 #include <string>
 #include <thread>
 #include <vector>
@@ -66,9 +70,11 @@ static int go_atoi(const char* s, size_t len, int64_t* out) {
   return 1;
 }
 
-// convertCPUToMilis semantics; returns the uint64 bit pattern.
-uint64_t kcc_cpu_to_milli(const char* cpu) {
-  size_t len = strlen(cpu);
+// convertCPUToMilis semantics; returns the uint64 bit pattern.  Length is
+// explicit so embedded NUL bytes parse exactly like the Python codec
+// (which would reject the full string) instead of silently truncating.
+uint64_t kcc_cpu_to_milli_n(const char* cpu, int64_t len_in) {
+  size_t len = (size_t)len_in;
   int has_m = len > 0 && cpu[len - 1] == 'm';
   if (has_m) len--;
   int64_t v;
@@ -78,10 +84,11 @@ uint64_t kcc_cpu_to_milli(const char* cpu) {
   return u;
 }
 
+
 // bytefmt.ToBytes semantics; returns 0 and stores into *out on success,
 // -1 on the reference's invalid-byte-quantity error.
-int kcc_to_bytes(const char* s_in, int64_t* out) {
-  std::string s(s_in);
+int kcc_to_bytes_n(const char* s_in, int64_t len_in, int64_t* out) {
+  std::string s(s_in, (size_t)len_in);
   // TrimSpace + ToUpper.
   size_t b = 0, e = s.size();
   while (b < e && isspace((unsigned char)s[b])) b++;
@@ -100,16 +107,36 @@ int kcc_to_bytes(const char* s_in, int64_t* out) {
 
   std::string num = s.substr(0, li), suffix = s.substr(li);
   if (num.empty()) return -1;
-  for (char c : num) {
-    // Reject whitespace (Go ParseFloat would), underscores and anything
-    // strtod might creatively accept; the suffix split already took the
-    // first letter, so inf/nan/hex cannot appear here.
+  // Underscore digit separators: both Go ParseFloat and Python float()
+  // accept them, but only BETWEEN digits ("1_5" ok, "_1"/"1_"/"1_.5"
+  // rejected).  Validate, then strip for strtod (which knows nothing of
+  // them).  Everything else strtod might creatively accept (whitespace,
+  // inf/nan/hex — the suffix split already took the first letter) is
+  // rejected by the char filter.
+  std::string cleaned;
+  cleaned.reserve(num.size());
+  for (size_t i = 0; i < num.size(); i++) {
+    char c = num[i];
+    if (c == '_') {
+      if (i == 0 || i + 1 >= num.size() ||
+          !isdigit((unsigned char)num[i - 1]) ||
+          !isdigit((unsigned char)num[i + 1]))
+        return -1;
+      continue;  // valid separator: drop it
+    }
     if (!(isdigit((unsigned char)c) || c == '.' || c == '+' || c == '-'))
       return -1;
+    cleaned.push_back(c);
   }
+  // Locale-independent parse: the embedding process may have called
+  // setlocale (GUI toolkits do), and strtod honors LC_NUMERIC's decimal
+  // point — Go/Python semantics never do.
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
   char* endp = nullptr;
-  double v = strtod(num.c_str(), &endp);
-  if (endp != num.c_str() + num.size()) return -1;
+  double v = c_loc != (locale_t)0
+                 ? strtod_l(cleaned.c_str(), &endp, c_loc)
+                 : strtod(cleaned.c_str(), &endp);
+  if (endp != cleaned.c_str() + cleaned.size()) return -1;
   // Overflow-to-infinity is Go's ErrRange -> the reference's error path.
   if (!std::isfinite(v)) return -1;
   if (!(v > 0)) return -1;  // <= 0 (or NaN) -> error (bytes.go:87-89)
@@ -131,6 +158,7 @@ int kcc_to_bytes(const char* s_in, int64_t* out) {
   return 0;
 }
 
+
 // One node's fit, Go semantics.  Returns 0 ok, -1 divide-by-zero "panic".
 static int fit_one(int64_t alloc_cpu, int64_t alloc_mem, int64_t alloc_pods,
                    int64_t used_cpu, int64_t used_mem, int64_t pods_count,
@@ -150,15 +178,21 @@ static int fit_one(int64_t alloc_cpu, int64_t alloc_mem, int64_t alloc_pods,
     mem_fit = 0;
   } else {
     if (mem_req == 0) return -1;  // :129 panic
-    // Wrap-around subtraction via unsigned cast; C++ '/' truncates like Go.
+    // Wrap-around subtraction via unsigned cast; C++ '/' truncates like
+    // Go.  INT64_MIN / -1 is UB in C++ (SIGFPE on x86-64) but defined in
+    // Go (wraps to INT64_MIN); negate through unsigned space instead.
     int64_t head = (int64_t)((uint64_t)alloc_mem - (uint64_t)used_mem);
-    mem_fit = head / mem_req;
+    mem_fit = mem_req == -1 ? (int64_t)(0ull - (uint64_t)head)
+                            : head / mem_req;
   }
   int64_t fit = cpu_fit <= mem_fit ? cpu_fit : mem_fit;  // findMin :159-164
+  // Subtractions wrap through unsigned space: Go wraps, C++ signed
+  // overflow is UB.
   if (mode == 0) {  // reference: conditional overwrite (:134-136)
-    if (fit >= alloc_pods) fit = alloc_pods - pods_count;
+    if (fit >= alloc_pods)
+      fit = (int64_t)((uint64_t)alloc_pods - (uint64_t)pods_count);
   } else {  // strict: 3-way min, clamp, health mask
-    int64_t slots = alloc_pods - pods_count;
+    int64_t slots = (int64_t)((uint64_t)alloc_pods - (uint64_t)pods_count);
     if (slots < 0) slots = 0;
     if (fit > slots) fit = slots;
     if (fit < 0) fit = 0;
@@ -209,7 +243,8 @@ int kcc_sweep(int64_t n, int64_t s, const int64_t* alloc_cpu,
             errs[(size_t)t] = 1;
             return;
           }
-          total += fit;
+          // Running sum wraps like Go's int accumulator, not UB.
+          total = (int64_t)((uint64_t)total + (uint64_t)fit);
         }
         totals_out[j] = total;
       }

@@ -27,6 +27,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <new>
 #include <vector>
 
 namespace {
@@ -42,6 +43,18 @@ PyObject *s_resources, *s_requests, *s_limits, *s_cpu, *s_memory, *s_zero,
 struct Fallback {};
 // Real error: a Python exception is set and must propagate.
 struct Raised {};
+
+// RAII strong reference: several dict/set operations below can execute
+// arbitrary Python (__hash__/__eq__ of hostile keys colliding with ours),
+// which may mutate the containers we borrowed from — every object we keep
+// using across such a call is pinned for the duration.
+struct Ref {
+  PyObject* o;
+  explicit Ref(PyObject* obj) : o(obj) { Py_XINCREF(o); }
+  ~Ref() { Py_XDECREF(o); }
+  Ref(const Ref&) = delete;
+  Ref& operator=(const Ref&) = delete;
+};
 
 PyObject* dict_get(PyObject* dict, PyObject* key) {
   // dict.get(key) -> borrowed ref or nullptr (absent).
@@ -86,34 +99,49 @@ PyObject* vec_to_bytes(const std::vector<int64_t>& v) {
 PyObject* build_quad(PyObject* container, PyObject* cpu_default,
                      PyObject* extended /* tuple or nullptr */) {
   if (!PyDict_CheckExact(container)) throw Fallback{};
+  // Every fetched dict is pinned BEFORE the next hostile-capable lookup:
+  // a colliding key's __eq__ during the s_requests lookup must not be
+  // able to free res (via del container['resources']), nor the s_limits
+  // lookup free req — each object's only other strong ref is the parent
+  // dict slot such a callback can clear.
   PyObject* res = dict_get(container, s_resources);
+  Ref pin_res(res);
   PyObject* req = nullptr;
   PyObject* lim = nullptr;
   if (res != nullptr) {
     if (!PyDict_CheckExact(res)) throw Fallback{};
     req = get_dict_or_empty(res, s_requests);
+  }
+  Ref pin_req(req);
+  if (res != nullptr) {
     lim = get_dict_or_empty(res, s_limits);
   }
+  Ref pin_lim(lim);
   Py_ssize_t n_ext = extended ? PyTuple_GET_SIZE(extended) : 0;
   PyObject* quad = PyTuple_New(4 + n_ext);
   if (quad == nullptr) throw Raised{};
-  PyObject* v;
-  v = req ? dict_get(req, s_cpu) : nullptr;
-  if (v == nullptr) v = cpu_default;
-  Py_INCREF(v); PyTuple_SET_ITEM(quad, 0, v);
-  v = lim ? dict_get(lim, s_cpu) : nullptr;
-  if (v == nullptr) v = cpu_default;
-  Py_INCREF(v); PyTuple_SET_ITEM(quad, 1, v);
-  v = req ? dict_get(req, s_memory) : nullptr;
-  if (v == nullptr) v = Py_None;
-  Py_INCREF(v); PyTuple_SET_ITEM(quad, 2, v);
-  v = lim ? dict_get(lim, s_memory) : nullptr;
-  if (v == nullptr) v = Py_None;
-  Py_INCREF(v); PyTuple_SET_ITEM(quad, 3, v);
-  for (Py_ssize_t e = 0; e < n_ext; ++e) {
-    v = req ? dict_get(req, PyTuple_GET_ITEM(extended, e)) : nullptr;
+  try {
+    PyObject* v;
+    v = req ? dict_get(req, s_cpu) : nullptr;
+    if (v == nullptr) v = cpu_default;
+    Py_INCREF(v); PyTuple_SET_ITEM(quad, 0, v);
+    v = lim ? dict_get(lim, s_cpu) : nullptr;
+    if (v == nullptr) v = cpu_default;
+    Py_INCREF(v); PyTuple_SET_ITEM(quad, 1, v);
+    v = req ? dict_get(req, s_memory) : nullptr;
     if (v == nullptr) v = Py_None;
-    Py_INCREF(v); PyTuple_SET_ITEM(quad, 4 + e, v);
+    Py_INCREF(v); PyTuple_SET_ITEM(quad, 2, v);
+    v = lim ? dict_get(lim, s_memory) : nullptr;
+    if (v == nullptr) v = Py_None;
+    Py_INCREF(v); PyTuple_SET_ITEM(quad, 3, v);
+    for (Py_ssize_t e = 0; e < n_ext; ++e) {
+      v = req ? dict_get(req, PyTuple_GET_ITEM(extended, e)) : nullptr;
+      if (v == nullptr) v = Py_None;
+      Py_INCREF(v); PyTuple_SET_ITEM(quad, 4 + e, v);
+    }
+  } catch (...) {
+    Py_DECREF(quad);  // unfilled slots are NULL — safe to deallocate
+    throw;
   }
   return quad;
 }
@@ -134,33 +162,34 @@ PyObject* walk_reference(PyObject*, PyObject* args) {
   std::vector<int64_t> pod_gids, c_gids, c_codes;
 
   try {
-    Py_ssize_t n_pods = PyList_GET_SIZE(pods);
-    for (Py_ssize_t p = 0; p < n_pods; ++p) {
-      PyObject* pod = PyList_GET_ITEM(pods, p);
-      if (!PyDict_CheckExact(pod)) throw Fallback{};
-      PyObject* phase = dict_get(pod, s_phase);
-      int ex = PySet_Contains(excluded, phase ? phase : Py_None);
+    // List sizes re-read per iteration and items pinned while hostile
+    // __hash__/__eq__ callbacks could run: a callback that mutates the
+    // fixture mid-walk gets odd-but-memory-safe behavior, never UAF.
+    for (Py_ssize_t p = 0; p < PyList_GET_SIZE(pods); ++p) {
+      Ref pod(PyList_GET_ITEM(pods, p));
+      if (!PyDict_CheckExact(pod.o)) throw Fallback{};
+      Ref phase(dict_get(pod.o, s_phase));
+      int ex = PySet_Contains(excluded, phase.o ? phase.o : Py_None);
       if (ex < 0) throw Raised{};
       if (ex) continue;  // does not survive the field selector
 
-      PyObject* node_name = dict_get(pod, s_nodeName);
-      if (node_name == nullptr) node_name = s_empty;
+      Ref node_name(dict_get(pod.o, s_nodeName));
       PyObject* def = PyLong_FromSsize_t(PyDict_Size(name_gid));
       if (def == nullptr) throw Raised{};
-      PyObject* got = PyDict_SetDefault(name_gid, node_name, def);
+      PyObject* got = PyDict_SetDefault(
+          name_gid, node_name.o ? node_name.o : s_empty, def);
       Py_DECREF(def);
       if (got == nullptr) throw Raised{};
       Py_ssize_t gid = PyLong_AsSsize_t(got);
       if (gid == -1 && PyErr_Occurred()) throw Raised{};
       pod_gids.push_back(gid);
 
-      PyObject* containers = dict_get(pod, s_containers);
-      if (containers == nullptr) continue;
-      if (!PyList_CheckExact(containers)) throw Fallback{};
-      Py_ssize_t n_c = PyList_GET_SIZE(containers);
-      for (Py_ssize_t ci = 0; ci < n_c; ++ci) {
-        PyObject* quad =
-            build_quad(PyList_GET_ITEM(containers, ci), s_zero, nullptr);
+      Ref containers(dict_get(pod.o, s_containers));
+      if (containers.o == nullptr) continue;
+      if (!PyList_CheckExact(containers.o)) throw Fallback{};
+      for (Py_ssize_t ci = 0; ci < PyList_GET_SIZE(containers.o); ++ci) {
+        Ref container(PyList_GET_ITEM(containers.o, ci));
+        PyObject* quad = build_quad(container.o, s_zero, nullptr);
         c_gids.push_back(gid);
         c_codes.push_back(intern_code(interned, quad));
       }
@@ -170,6 +199,10 @@ PyObject* walk_reference(PyObject*, PyObject* args) {
     Py_RETURN_NONE;
   } catch (Raised&) {
     Py_DECREF(interned); Py_DECREF(name_gid);
+    return nullptr;
+  } catch (const std::bad_alloc&) {
+    Py_DECREF(interned); Py_DECREF(name_gid);
+    PyErr_NoMemory();
     return nullptr;
   }
 
@@ -196,24 +229,24 @@ PyObject* walk_strict(PyObject*, PyObject* args) {
   std::vector<int64_t> pod_nodes, c_pod, c_codes, i_pod, i_codes;
 
   try {
-    Py_ssize_t n_pods = PyList_GET_SIZE(pods);
-    for (Py_ssize_t p = 0; p < n_pods; ++p) {
-      PyObject* pod = PyList_GET_ITEM(pods, p);
-      if (!PyDict_CheckExact(pod)) throw Fallback{};
-      PyObject* node_name = dict_get(pod, s_nodeName);
-      if (node_name == nullptr) continue;  // pod.get("nodeName", "") falsy
-      if (!PyUnicode_CheckExact(node_name)) throw Fallback{};
-      if (PyUnicode_GetLength(node_name) == 0) continue;
-      PyObject* row = dict_get(index, node_name);
+    // Same pinning/re-read discipline as walk_reference — see there.
+    for (Py_ssize_t p = 0; p < PyList_GET_SIZE(pods); ++p) {
+      Ref pod(PyList_GET_ITEM(pods, p));
+      if (!PyDict_CheckExact(pod.o)) throw Fallback{};
+      Ref node_name(dict_get(pod.o, s_nodeName));
+      if (node_name.o == nullptr) continue;  // .get("nodeName", "") falsy
+      if (!PyUnicode_CheckExact(node_name.o)) throw Fallback{};
+      if (PyUnicode_GetLength(node_name.o) == 0) continue;
+      PyObject* row = dict_get(index, node_name.o);
       if (row == nullptr) continue;  // not a known node
+      Py_ssize_t row_i = PyLong_AsSsize_t(row);
+      if (row_i == -1 && PyErr_Occurred()) throw Raised{};
 
-      PyObject* phase = dict_get(pod, s_phase);
-      int term = PySet_Contains(terminated, phase ? phase : Py_None);
+      Ref phase(dict_get(pod.o, s_phase));
+      int term = PySet_Contains(terminated, phase.o ? phase.o : Py_None);
       if (term < 0) throw Raised{};
       if (term) continue;
 
-      Py_ssize_t row_i = PyLong_AsSsize_t(row);
-      if (row_i == -1 && PyErr_Occurred()) throw Raised{};
       int64_t pid = static_cast<int64_t>(pod_nodes.size());
       pod_nodes.push_back(row_i);
 
@@ -222,13 +255,12 @@ PyObject* walk_strict(PyObject*, PyObject* args) {
       const Kind kinds[2] = {{s_containers, &c_pod, &c_codes},
                              {s_initContainers, &i_pod, &i_codes}};
       for (const Kind& k : kinds) {
-        PyObject* seq = dict_get(pod, k.key);
-        if (seq == nullptr) continue;
-        if (!PyList_CheckExact(seq)) throw Fallback{};
-        Py_ssize_t n_c = PyList_GET_SIZE(seq);
-        for (Py_ssize_t ci = 0; ci < n_c; ++ci) {
-          PyObject* quad =
-              build_quad(PyList_GET_ITEM(seq, ci), Py_None, extended);
+        Ref seq(dict_get(pod.o, k.key));
+        if (seq.o == nullptr) continue;
+        if (!PyList_CheckExact(seq.o)) throw Fallback{};
+        for (Py_ssize_t ci = 0; ci < PyList_GET_SIZE(seq.o); ++ci) {
+          Ref container(PyList_GET_ITEM(seq.o, ci));
+          PyObject* quad = build_quad(container.o, Py_None, extended);
           k.pods_v->push_back(pid);
           k.codes_v->push_back(intern_code(interned, quad));
         }
@@ -239,6 +271,10 @@ PyObject* walk_strict(PyObject*, PyObject* args) {
     Py_RETURN_NONE;
   } catch (Raised&) {
     Py_DECREF(interned);
+    return nullptr;
+  } catch (const std::bad_alloc&) {
+    Py_DECREF(interned);
+    PyErr_NoMemory();
     return nullptr;
   }
 

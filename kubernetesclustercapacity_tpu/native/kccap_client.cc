@@ -99,15 +99,33 @@ struct JsonScanner {
           case '\\': result += '\\'; break;
           case '/': result += '/'; break;
           case 'u': {
-            if (p + 4 >= s.size()) return false;  // truncated escape
+            // Exactly four hex digits, validated by hand: sscanf("%4x")
+            // would skip whitespace, accept signs/0x, and parse FEWER
+            // than four digits — desynchronizing the scanner on
+            // malformed input (the cursor advances by four regardless).
+            auto hex4 = [this](size_t at, unsigned* out4) -> bool {
+              unsigned v = 0;
+              for (size_t k = 0; k < 4; ++k) {
+                if (at + k >= s.size()) return false;
+                char h = s[at + k];
+                unsigned d;
+                if (h >= '0' && h <= '9') d = (unsigned)(h - '0');
+                else if (h >= 'a' && h <= 'f') d = 10u + (unsigned)(h - 'a');
+                else if (h >= 'A' && h <= 'F') d = 10u + (unsigned)(h - 'A');
+                else return false;
+                v = (v << 4) | d;
+              }
+              *out4 = v;
+              return true;
+            };
             unsigned code = 0;
-            if (sscanf(s.c_str() + p + 1, "%4x", &code) != 1) return false;
+            if (!hex4(p + 1, &code)) return false;
             p += 4;
             if (code >= 0xD800 && code <= 0xDBFF) {
-              if (p + 6 >= s.size() || s[p + 1] != '\\' || s[p + 2] != 'u')
+              if (p + 2 >= s.size() || s[p + 1] != '\\' || s[p + 2] != 'u')
                 return false;
               unsigned low = 0;
-              if (sscanf(s.c_str() + p + 3, "%4x", &low) != 1) return false;
+              if (!hex4(p + 3, &low)) return false;
               if (low < 0xDC00 || low > 0xDFFF) return false;
               p += 6;
               code = 0x10000 + ((code - 0xD800) << 10) + (low - 0xDC00);

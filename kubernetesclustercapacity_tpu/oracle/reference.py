@@ -90,9 +90,12 @@ def _to_go_int(u: int) -> int:
 
 
 def _go_div(num: int, den: int) -> int:
-    """Go integer division: truncates toward zero (Python ``//`` floors)."""
+    """Go int64 division: truncates toward zero (Python ``//`` floors) and
+    WRAPS the one overflowing quotient — ``INT64_MIN / -1 == INT64_MIN``
+    in Go (two's-complement overflow is defined there)."""
     q = abs(num) // abs(den)
-    return -q if (num < 0) != (den < 0) else q
+    q = -q if (num < 0) != (den < 0) else q
+    return _to_go_int(q)
 
 
 def _go_float_div(num: float, den: float) -> float:

@@ -181,13 +181,15 @@ def _go_parse_float(s: str) -> float | None:
 
     Accepts decimal and exponent forms (and underscore digit separators, as
     both languages do).  Whitespace is rejected (Python ``float()`` would
-    strip it; Go does not), and overflow-to-infinity is a range error like
-    Go's ``ErrRange``.  Divergence (documented): Go also accepts ``inf`` /
+    strip it; Go does not), non-ASCII input is rejected (Go parses ASCII
+    only; Python ``float()`` would transform Unicode decimal digits like
+    ``"١٥"``), and overflow-to-infinity is a range error like Go's
+    ``ErrRange``.  Divergence (documented): Go also accepts ``inf`` /
     ``nan`` / hex-float spellings, for which the reference's downstream
     ``int64(float * mult)`` conversion is unspecified — those spellings are
     rejected here instead of reproducing undefined behavior.
     """
-    if s != s.strip():
+    if s != s.strip() or not s.isascii():
         return None
     t = s.lower().lstrip("+-")
     if t.startswith(("inf", "nan")) or t.startswith("0x"):

@@ -131,3 +131,71 @@ class TestNativeKernelParity:
             snap.pods_count, grid.cpu_request_milli, grid.mem_request_bytes,
         )
         np.testing.assert_array_equal(native_totals, jax_totals)
+
+
+class TestAdversarialParity:
+    """UB/parity corners from the C++ review: the native path must match
+    the Python oracle bit-for-bit (and never crash the process) on inputs
+    a hostile or degenerate fixture can produce."""
+
+    @pytest.mark.parametrize(
+        "s", ["1_5MB", "1_234KB", "_15MB", "15_MB", "1__5MB", "1_.5MB"]
+    )
+    def test_underscore_separator_parity(self, s):
+        """Go ParseFloat and Python float() accept digit-separating
+        underscores (only BETWEEN digits); the native codec must agree."""
+        try:
+            want = to_bytes_reference(s)
+        except QuantityParseError:
+            with pytest.raises(ValueError):
+                native.to_bytes(s)
+        else:
+            assert native.to_bytes(s) == want
+
+    def test_embedded_nul_parity(self):
+        s = "12\x003"
+        assert native.cpu_to_milli(s) == cpu_to_milli_reference(s) == 0
+
+    def test_int64_min_divided_by_minus_one_no_sigfpe(self):
+        # alloc-used wraps to INT64_MIN; mem_req=-1: C++ idiv overflow
+        # would SIGFPE the whole process; Go defines the wrap
+        # (INT64_MIN / -1 == INT64_MIN) and both ground-truth layers
+        # must agree on it.
+        args = (
+            [8000], [1 << 62], [110], [0], [-(1 << 62)], [0],
+        )
+        want = fit_arrays_python(*args, 100, -1, mode="reference")
+        got = native.fit_arrays(*args, 100, -1, mode="reference")
+        assert got.tolist() == want == [-(1 << 63)]
+
+    def test_pod_cap_subtraction_wrap_parity(self):
+        # fit >= alloc_pods with pods_count driving the subtraction
+        # through INT64_MIN: Go wraps, C++ signed overflow is UB unless
+        # routed through unsigned space.
+        args = (
+            [8000], [1 << 40], [-(1 << 62)], [0], [0], [(1 << 62)],
+        )
+        want = fit_arrays_python(*args, 1, 1, mode="reference")
+        got = native.fit_arrays(*args, 1, 1, mode="reference")
+        assert got.tolist() == want
+
+    def test_sweep_total_wrap_parity(self):
+        # Two nodes each fitting 2^62: the running total reaches 2^63 and
+        # wraps to INT64_MIN in Go's int accumulator; the threaded sweep
+        # must agree with the python oracle's sum semantics (C++ signed
+        # overflow would be UB without the unsigned-space accumulation).
+        from kubernetesclustercapacity_tpu.oracle import reference as _oref
+
+        big = 1 << 62
+        args = (
+            [big, big], [big, big], [big, big],
+            [0, 0], [0, 0], [0, 0],
+        )
+        fits = fit_arrays_python(*args, 1, 1, mode="reference")
+        assert fits == [big, big]  # each node really fits 2^62
+        want = 0
+        for f in fits:
+            want = _oref._to_go_int(want + f)
+        assert want == -(1 << 63)  # the sum genuinely wrapped
+        totals = native.sweep(*args, [1], [1])
+        assert int(totals[0]) == want

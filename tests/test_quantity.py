@@ -203,3 +203,22 @@ class TestStrictQuantity:
     def test_helpers(self):
         assert cpu_to_milli_strict("0.5") == 500
         assert mem_to_bytes_strict("16Gi") == 16 * GIB
+
+
+class TestAsciiOnlyParseFloat:
+    """Go strconv.ParseFloat is ASCII-only: Unicode decimal digits that
+    Python's float() would transform (e.g. Arabic-Indic "١٥") must be a
+    parse error, exactly as the Go reference and the native codec treat
+    them."""
+
+    def test_unicode_digits_rejected(self):
+        import pytest as _pytest
+
+        from kubernetesclustercapacity_tpu.utils.quantity import (
+            QuantityParseError,
+            to_bytes_reference,
+        )
+
+        assert float("١٥") == 15.0  # the trap this guards
+        with _pytest.raises(QuantityParseError):
+            to_bytes_reference("١٥MB")
