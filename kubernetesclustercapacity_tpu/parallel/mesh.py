@@ -72,6 +72,8 @@ def make_mesh(
     """
     devices = list(jax.devices()) if devices is None else list(devices)
     n_dev = len(devices)
+    if node_parallel < 1:
+        raise ValueError(f"node_parallel must be >= 1, got {node_parallel}")
     if scenario_parallel is None:
         scenario_parallel = n_dev // node_parallel
     if scenario_parallel * node_parallel != n_dev:

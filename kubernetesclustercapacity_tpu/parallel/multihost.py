@@ -49,14 +49,34 @@ def initialize(
     process_id: int | None = None,
     **kwargs,
 ) -> bool:
-    """Join the multi-process JAX runtime; no-op for single-process runs.
+    """Join the multi-process JAX runtime.
 
     Returns True when distributed mode was initialized.  Call once per
-    process before any other JAX use, exactly like
-    ``jax.distributed.initialize`` (which this wraps).
+    process before any other JAX use.
+
+    * ``num_processes=1`` → single-process run, a clean no-op returning
+      False whatever else is set (the same launch recipe runs unchanged
+      on a laptop, per the module docstring).
+    * no arguments at all → also a no-op.  (Divergence from upstream,
+      documented: ``jax.distributed.initialize()`` with no args attempts
+      cluster AUTO-DETECTION — request that explicitly here, e.g.
+      ``initialize(cluster_detection_method="deprecated_slurm")`` or by
+      passing the pod's coordinator arguments — so library users on
+      single machines are not greeted with a failed detection.)
+    * anything else → passed straight through to
+      ``jax.distributed.initialize``; in particular a partial argument
+      set (coordinator WITHOUT num_processes, ...) is no longer a silent
+      no-op — upstream validates, auto-completes, or raises.
     """
-    if not num_processes or num_processes == 1:
-        return False
+    if num_processes == 1:
+        return False  # explicitly single-process
+    if (
+        num_processes is None
+        and coordinator_address is None
+        and process_id is None
+        and not kwargs
+    ):
+        return False  # nothing requested
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -27,10 +27,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map_mod  # type: ignore[attr-defined]
-
     shard_map = jax.shard_map
-except (ImportError, AttributeError):  # pragma: no cover - older jax
+except AttributeError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from kubernetesclustercapacity_tpu.ops.fit import fit_per_node, sweep_grid

@@ -84,12 +84,33 @@ class SnapshotCoalescer:
             self.events += 1
             self._cv.notify()
 
-    def stop(self, timeout: float | None = 10.0) -> None:
-        """Drain (flush any pending state) and stop the worker."""
+    def stop(self, timeout: float | None = 10.0) -> bool:
+        """Drain (flush any pending state) and stop the worker.
+
+        Returns True when the worker exited (drain complete).  A False
+        return means the drain timed out — a wedged flush callback — and
+        the final pending state may never publish; that broken contract
+        is recorded in :attr:`last_error` and reported to ``on_error``
+        exactly like a raising flush, so a supervised server treats it
+        as the publish failure it is.
+        """
         with self._cv:
             self._stopping = True
             self._cv.notify()
         self._thread.join(timeout)
+        if self._thread.is_alive():
+            err = (
+                f"coalescer drain timed out after {timeout}s "
+                "(flush callback wedged); final state may be unpublished"
+            )
+            self.last_error = err
+            if self._on_error is not None:
+                try:
+                    self._on_error(err)
+                except Exception:  # noqa: BLE001 - observer must not kill us
+                    pass
+            return False
+        return True
 
     # -- worker ------------------------------------------------------------
     def _run(self) -> None:

@@ -18,6 +18,12 @@ sweep      ``cpu_request_milli``/``mem_request_bytes``/``replicas``
            (numeric arrays) OR ``random: {n, seed}``; optional
            ``kernel`` (``auto`` — Pallas fast path when provably
            bit-exact — | ``exact``); result carries the kernel used
+sweep_multi  R-resource grid sweep: ``resources`` (``[R]`` names —
+           ``cpu`` in millicores, ``memory`` in bytes, anything else an
+           extended column of the served snapshot), ``requests``
+           (``[S][R]`` numeric), ``replicas`` (``[S]``); optional
+           ``kernel`` as for sweep; result carries totals/schedulable
+           and the kernel used
 place      the fit flag/spec fields plus optional ``policy``
            (``first-fit`` | ``best-fit`` | ``spread``) and optional
            ``assignments`` (bool, default true) — placement
@@ -70,22 +76,33 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def recv_msg(sock: socket.socket) -> dict | None:
-    """Read one frame; None on clean EOF at a frame boundary."""
+    """Read one frame; None on clean EOF (or reset) at a frame boundary.
+
+    The error taxonomy is total: every OS-level socket failure surfaces
+    as :class:`ProtocolError` (reset before any frame byte is a clean
+    None), so callers handle exactly two shapes — None = no more frames,
+    ProtocolError = broken peer/transport.
+    """
     try:
         header = sock.recv(4)
     except ConnectionResetError:
         return None
+    except OSError as e:
+        raise ProtocolError(f"socket error awaiting frame: {e}") from e
     if not header:
         return None
-    while len(header) < 4:
-        more = sock.recv(4 - len(header))
-        if not more:
-            raise ProtocolError("connection closed mid-header")
-        header += more
-    (length,) = struct.unpack(">I", header)
-    if length > MAX_FRAME:
-        raise ProtocolError(f"frame too large: {length}")
-    body = _recv_exact(sock, length)
+    try:
+        while len(header) < 4:
+            more = sock.recv(4 - len(header))
+            if not more:
+                raise ProtocolError("connection closed mid-header")
+            header += more
+        (length,) = struct.unpack(">I", header)
+        if length > MAX_FRAME:
+            raise ProtocolError(f"frame too large: {length}")
+        body = _recv_exact(sock, length)
+    except OSError as e:  # reset/abort/timeout mid-frame
+        raise ProtocolError(f"socket error mid-frame: {e}") from e
     try:
         return json.loads(body)
     except ValueError as e:  # malformed/empty body is a protocol error
