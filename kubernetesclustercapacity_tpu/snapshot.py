@@ -397,6 +397,27 @@ def _pack_reference(fixture: dict) -> ClusterSnapshot:
     )
 
 
+def container_cpu_error_payloads(pods) -> list[str]:
+    """Codec-error payloads of the pods' containers, in the reference
+    walk's emission order: per pod, per container, LIMITS before REQUESTS
+    (``ClusterCapacity.go:279-284``), one entry per failing occurrence.
+    The single source for the rowwise packer and the store's incremental
+    rows (the columnar packer replays the same payloads through its
+    interned-quad vocabulary).
+    """
+    errs: list[str] = []
+    for pod in pods:
+        for c in pod.get("containers", []):
+            res = c.get("resources", {})
+            req = res.get("requests", {})
+            lim = res.get("limits", {})
+            for s in (lim.get("cpu", "0"), req.get("cpu", "0")):
+                p = _q.cpu_parse_error_payload(s)
+                if p is not None:
+                    errs.append(p)
+    return errs
+
+
 def _walk_pods_reference(pods):
     """Reference-mode columnar pod walk: the ΣP hot loop of packing.
 
@@ -486,17 +507,7 @@ def _pack_reference_rowwise(fixture: dict) -> ClusterSnapshot:
         )
         labels.append(raw_nodes[i].get("labels", {}))
         taints.append(raw_nodes[i].get("taints", []))
-        errs: list[str] = []
-        for pod in pods:
-            for c in pod.get("containers", []):
-                res = c.get("resources", {})
-                req = res.get("requests", {})
-                lim = res.get("limits", {})
-                for s in (lim.get("cpu", "0"), req.get("cpu", "0")):
-                    p = _q.cpu_parse_error_payload(s)
-                    if p is not None:
-                        errs.append(p)
-        pod_cpu_errs.append(errs)
+        pod_cpu_errs.append(container_cpu_error_payloads(pods))
 
     mat = np.array(rows, dtype=np.int64).reshape(n, 8)
     snap = dict(

@@ -60,9 +60,9 @@ def resolve_source(
         return None, snap, snap.semantics
     semantics = semantics or "reference"
     if extended_resources and semantics != "strict":
-        # One place owns this rule (the module contract): silently packing
-        # without the requested columns would strand every front-end's
-        # sweep_multi surface with no error.
+        # The PACKER owns this rule (snapshot_from_fixture raises for
+        # every fixture path); this pre-check only rewraps it as a
+        # SourceError so front-ends report it like other source problems.
         raise SourceError(
             "extended resources require strict semantics (reference "
             "semantics has no extended-column concept)"

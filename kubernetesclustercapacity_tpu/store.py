@@ -34,6 +34,10 @@ from kubernetesclustercapacity_tpu.snapshot import (
     _strict_healthy,
     _strict_parse,
     _STRICT_TERMINATED,
+    container_cpu_error_payloads as _container_cpu_error_payloads,
+)
+from kubernetesclustercapacity_tpu.utils.quantity import (
+    cpu_parse_error_payload,
 )
 
 __all__ = ["StoreError", "ClusterStore"]
@@ -71,8 +75,9 @@ class ClusterStore:
         if semantics not in ("reference", "strict"):
             raise ValueError(f"unknown semantics {semantics!r}")
         if extended_resources and semantics != "strict":
-            # Same packer-level rule as snapshot_from_fixture: reference
-            # rows would silently carry all-zero extended columns.
+            # The packer (snapshot_from_fixture) owns this rule; the store
+            # re-raises it as a StoreError because its repack-equality
+            # invariant would otherwise die later inside a recompute.
             raise StoreError(
                 "extended resources require strict semantics"
             )
@@ -119,6 +124,13 @@ class ClusterStore:
         # an O(N) name scan (the round-3 churn bottleneck), and node events
         # locate rows by raw name the same way.
         self._view_names: list[str] = [""] * n
+        # Reference-mode transcript provenance, maintained per row so the
+        # SERVED snapshot replays the same skip/codec-error lines a fresh
+        # pack would (node_log assembles in row order; see snapshot()).
+        self._node_events: list[tuple[str | None, str | None]] = [
+            (None, None)
+        ] * n  # (cpu_err_payload, skip_name)
+        self._pod_errs: list[list[str]] = [[] for _ in range(n)]
         self._rows_by_view: dict[str, set[int]] = {"": set(range(n))}
         self._rows_by_raw: dict[str, set[int]] = {}
         for i, node in enumerate(self._nodes):
@@ -148,6 +160,15 @@ class ClusterStore:
         # Reference mode reports the NodeView name — "" for phantom rows,
         # exactly what the Go slice holds (Q4); strict reports raw names.
         n = len(self._nodes)
+        node_log: list[tuple[str, str]] = []
+        pod_cpu_errs: list[list[str]] = []
+        if self.semantics == "reference":
+            for cpu_err, skip_name in self._node_events:
+                if cpu_err is not None:
+                    node_log.append(("cpu_err", cpu_err))
+                if skip_name is not None:
+                    node_log.append(("skip", skip_name))
+            pod_cpu_errs = [list(errs) for errs in self._pod_errs]
         return ClusterSnapshot(
             names=list(self._view_names),
             semantics=self.semantics,
@@ -155,8 +176,16 @@ class ClusterStore:
                 r: (a[:n].copy(), u[:n].copy())
                 for r, (a, u) in self._ext.items()
             },
-            labels=[node.get("labels", {}) for node in self._nodes],
-            taints=[node.get("taints", []) for node in self._nodes],
+            # Copied (labels shallowly, taints per-entry): the snapshot is
+            # immutable-by-copy, so a caller mutating it must never write
+            # through into the store's raw state.
+            labels=[dict(node.get("labels", {})) for node in self._nodes],
+            taints=[
+                [dict(t) for t in node.get("taints", [])]
+                for node in self._nodes
+            ],
+            node_log=node_log,
+            pod_cpu_errs=pod_cpu_errs,
             healthy=self._healthy[:n].copy(),
             **{c: self._cols[c][:n].copy() for c in _INT_COLS},
         )
@@ -196,6 +225,10 @@ class ClusterStore:
             key = _pod_key(pod)
             hash(key)
             hash(pod.get("nodeName", ""))  # it indexes _pods_by_node
+            # The phase feeds frozenset membership on every recompute —
+            # an unhashable phase must be rejected HERE, not crash later.
+            phase = pod.get("phase")
+            phase in _STRICT_TERMINATED  # noqa: B015 - hashability probe
             if self.semantics == "reference":
                 _oracle.pod_requests_limits([pod])
             else:
@@ -312,6 +345,12 @@ class ClusterStore:
             self._view_names = [
                 v for i, v in enumerate(self._view_names) if keep[i]
             ]
+            self._node_events = [
+                e for i, e in enumerate(self._node_events) if keep[i]
+            ]
+            self._pod_errs = [
+                e for i, e in enumerate(self._pod_errs) if keep[i]
+            ]
             self._rebuild_indices()
 
     def _append_row(self) -> None:
@@ -337,6 +376,8 @@ class ClusterStore:
                 for r, (a, u) in self._ext.items()
             }
         self._view_names.append("")
+        self._node_events.append((None, None))
+        self._pod_errs.append([])
         self._rows_by_view.setdefault("", set()).add(n)
 
     # -- row packing (the single source of per-row truth) ------------------
@@ -361,6 +402,19 @@ class ClusterStore:
             if _oracle._survives_field_selector(p)
         ]
         cpu_lim, cpu_req, mem_lim, mem_req = _oracle.pod_requests_limits(pods)
+        # Transcript provenance (same events _pack_reference records): the
+        # node's cpu codec error, its skip line when unhealthy (with the
+        # REAL name — the phantom row keeps ""), and its pods' container
+        # codec errors in walk order, limits before requests (:279-284).
+        allocatable = raw.get("allocatable", {})
+        cpu_err = cpu_parse_error_payload(allocatable.get("cpu", "0"))
+        skip = (
+            None
+            if _oracle.node_is_healthy_reference(raw)
+            else raw.get("name", "")
+        )
+        self._node_events[i] = (cpu_err, skip)
+        self._pod_errs[i] = _container_cpu_error_payloads(pods)
         c = self._cols
         c["alloc_cpu_milli"][i] = _clamp_i64(view.allocatable_cpu)
         c["alloc_mem_bytes"][i] = _clamp_i64(view.allocatable_memory)

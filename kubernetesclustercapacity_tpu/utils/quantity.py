@@ -125,6 +125,7 @@ def int64_bits(u: int) -> int:
     return u - (1 << 64) if u >= 1 << 63 else u
 
 
+@functools.lru_cache(maxsize=_PARSE_CACHE_SIZE)
 def cpu_parse_error_payload(cpu: str) -> str | None:
     """The ``%s`` of the reference codec's error line, or ``None``.
 
@@ -332,17 +333,36 @@ class Quantity:
     original: str
 
     def value(self) -> int:
-        return _ceil_fraction(self.amount)
+        return _int64_capped(_round_away_from_zero(self.amount))
 
     def milli_value(self) -> int:
-        return _ceil_fraction(self.amount * 1000)
+        return _int64_capped(_round_away_from_zero(self.amount * 1000))
 
     def __float__(self) -> float:
         return float(self.amount)
 
 
-def _ceil_fraction(f: Fraction) -> int:
-    return -((-f.numerator) // f.denominator)
+def _round_away_from_zero(f: Fraction) -> int:
+    """Upstream ``Quantity.Value()`` rounding: AWAY from zero, not toward
+    +inf — ``"-100m".Value() == -1`` (ceil would give 0)."""
+    if f.numerator >= 0:
+        return -((-f.numerator) // f.denominator)
+    return f.numerator // f.denominator
+
+
+def _int64_capped(v: int) -> int:
+    """Upstream caps values the int64 cannot hold (quantity.go: numbers
+    larger than the format represents are capped at MaxInt64) instead of
+    erroring — a 16E node serves max capacity, never a crash."""
+    if v > _INT64_MAX_Q:
+        return _INT64_MAX_Q
+    if v < _INT64_MIN_Q:
+        return _INT64_MIN_Q
+    return v
+
+
+_INT64_MAX_Q = (1 << 63) - 1
+_INT64_MIN_Q = -(1 << 63)
 
 
 @functools.lru_cache(maxsize=_PARSE_CACHE_SIZE)
@@ -360,7 +380,8 @@ def parse_quantity(s: str) -> Quantity:
     (``:208``).
     """
     original = s
-    s = s.strip()
+    # No whitespace trim: upstream ParseQuantity rejects ' 1Gi' outright
+    # (first byte must be a sign or digit).
     if not s:
         raise QuantityParseError("empty quantity string")
 
@@ -388,15 +409,22 @@ def parse_quantity(s: str) -> Quantity:
     elif suffix and suffix[0] in "eE":
         exp_str = suffix[1:]
         exp_body = exp_str[1:] if exp_str[:1] in "+-" else exp_str
-        if not exp_body.isdigit():
+        if not exp_body.isdigit() or not exp_body.isascii():
+            # isascii: int()/isdigit would accept Unicode decimal digits
+            # upstream's ASCII scanner rejects.
             raise QuantityParseError(f"invalid quantity exponent: {original!r}")
         exp = int(exp_str)
-        # Real quantities span n (1e-9) to E (1e18); beyond ±64 the exponent
-        # is hostile/corrupt input, and materializing 10**exp exactly would
-        # allocate an exp-digit integer.
-        if abs(exp) > 64:
-            raise QuantityParseError(f"quantity exponent out of range: {original!r}")
-        mult = Fraction(10) ** exp
+        # Real quantities span n (1e-9) to E (1e18), but the exponent must
+        # not materialize 10**exp for hostile magnitudes.  Clamping is
+        # only sound once the MANTISSA's own decimal magnitude is
+        # accounted for (len(num_part) bounds it in both directions): with
+        # |exp| <= 64 + len the value computes exactly in input-linear
+        # space; beyond that bound the true value is provably > int64 max
+        # (caps) or < 1 (rounds away from zero to +-1) — the clamped
+        # multiplier lands in the same regime, so value()/milli_value()
+        # return exactly what upstream's uncapped arithmetic would.
+        bound = 64 + len(num_part)
+        mult = Fraction(10) ** max(min(exp, bound), -bound)
     else:
         raise QuantityParseError(f"invalid quantity suffix: {original!r}")
 

@@ -154,7 +154,8 @@ class TestStrictQuantity:
             ("0.5", 1),
             ("1.5Gi", 1610612736),
             ("0", 0),
-            ("-1500m", -1),  # ceil toward +inf
+            ("-1500m", -2),  # away from zero, like upstream Value()
+            ("-100m", -1),  # upstream MustParse("-100m").Value() == -1
         ],
     )
     def test_value(self, s, expected):
@@ -187,12 +188,35 @@ class TestStrictQuantity:
             "1e",
             "1ee3",
             "--1",
-            "1e1000000000",  # unbounded exponent must not materialize 10**exp
+            " 1Gi",  # upstream rejects surrounding whitespace
+            "1Gi ",
+            "5e\u0663",  # Unicode exponent digits: ASCII-only upstream
         ],
     )
     def test_invalid(self, s):
         with pytest.raises(QuantityParseError):
             parse_quantity(s)
+
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            # Upstream caps what int64 cannot hold instead of erroring.
+            ("16E", (1 << 63) - 1),
+            ("1e19", (1 << 63) - 1),
+            ("-16E", -(1 << 63)),
+            # Unbounded exponents clamp (never materialize 10**exp): huge
+            # caps, tiny rounds away from zero.
+            ("1e1000000000", (1 << 63) - 1),
+            ("1e-1000000000", 1),
+            ("-1e-1000000000", -1),
+            ("0e1000000000", 0),
+        ],
+    )
+    def test_int64_capping(self, s, expected):
+        assert parse_quantity(s).value() == expected
+
+    def test_milli_value_caps(self):
+        assert parse_quantity("10E").milli_value() == (1 << 63) - 1
 
     def test_exact_decimal_no_float_drift(self):
         # 0.1 is exactly 1/10, so 0.1 * 3 * 10 == 3 exactly.

@@ -40,6 +40,8 @@ def assert_matches_repack(store: ClusterStore):
         extended_resources=store.extended_resources,
     )
     assert snap.names == repack.names
+    assert snap.node_log == repack.node_log
+    assert snap.pod_cpu_errs == repack.pod_cpu_errs
     for col in _COLS:
         np.testing.assert_array_equal(
             getattr(snap, col), getattr(repack, col), err_msg=col
@@ -360,3 +362,63 @@ class TestScaleAndIndices:
         )
         assert_matches_repack(store)
         assert store.snapshot().pods_count[0] == 0
+
+
+class TestMalformedAndExtremeObjects:
+    """Validate-before-mutate holds for the cases a hostile/degenerate
+    event can produce: unhashable phases reject PRE-mutation, int64-capped
+    quantities (upstream semantics) flow through, and served snapshots
+    never alias live raw state."""
+
+    def test_unhashable_phase_rejected_pre_mutation(self):
+        for semantics in ("reference", "strict"):
+            fx = synthetic_fixture(3, seed=11)
+            store = ClusterStore(fx, semantics=semantics)
+            node0 = fx["nodes"][0]["name"]
+            bad = _mk_pod("bad-phase", node0)
+            bad["phase"] = ["Running"]  # unhashable
+            with pytest.raises(StoreError, match="malformed pod"):
+                store.apply_event(
+                    {"type": "ADDED", "kind": "Pod", "object": bad}
+                )
+            assert not store.has_pod("default", "bad-phase")
+            assert_matches_repack(store)
+
+    def test_capped_quantity_node_matches_repack(self):
+        # '16E' exceeds int64; upstream Quantity caps at MaxInt64 — the
+        # store must accept it and stay element-identical to a repack
+        # (this once crashed with OverflowError AFTER mutating raw state).
+        fx = synthetic_fixture(3, seed=12)
+        store = ClusterStore(fx, semantics="strict")
+        big = _mk_node("huge-mem")
+        big["allocatable"]["memory"] = "16E"
+        store.apply_event({"type": "ADDED", "kind": "Node", "object": big})
+        snap = store.snapshot()
+        i = snap.names.index("huge-mem")
+        assert int(snap.alloc_mem_bytes[i]) == (1 << 63) - 1
+        assert_matches_repack(store)
+
+    def test_snapshot_labels_do_not_alias_store(self):
+        fx = synthetic_fixture(3, seed=13)
+        store = ClusterStore(fx, semantics="reference")
+        snap = store.snapshot()
+        snap.labels[0]["mutated"] = "yes"
+        if snap.taints[0]:
+            snap.taints[0][0]["mutated"] = "yes"
+        assert "mutated" not in store.fixture_view()["nodes"][0]["labels"]
+        assert_matches_repack(store)
+
+    def test_transcript_provenance_survives_updates(self):
+        # A store-served reference snapshot must replay the same skip and
+        # codec-error lines a fresh pack would — including after events.
+        fx = synthetic_fixture(6, seed=14, unhealthy_frac=0.5)
+        fx["nodes"][0]["allocatable"]["cpu"] = "4.5"  # codec error line
+        store = ClusterStore(fx, semantics="reference")
+        assert_matches_repack(store)  # node_log/pod_cpu_errs included
+        node0 = fx["nodes"][1]["name"]
+        weird = _mk_pod("weird-cpu", node0, cpu="bogus")
+        store.apply_event({"type": "ADDED", "kind": "Pod", "object": weird})
+        snap = store.snapshot()
+        assert any(k == "cpu_err" for k, _ in snap.node_log)
+        assert any("bogus" in errs for errs in snap.pod_cpu_errs)
+        assert_matches_repack(store)
