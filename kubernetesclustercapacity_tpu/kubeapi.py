@@ -289,6 +289,27 @@ class KubeConfig:
                     "v1.26 — migrate the kubeconfig to an exec plugin)"
                 )
 
+        client_cert_pem = _b64_or_file(
+            user.get("client-certificate-data"),
+            user.get("client-certificate"),
+            "client-certificate",
+        )
+        client_key_pem = _b64_or_file(
+            user.get("client-key-data"), user.get("client-key"), "client-key"
+        )
+        if bool(client_cert_pem) != bool(client_key_pem):
+            # A half-present mTLS credential must fail loudly (client-go:
+            # "client-cert specified without client-key") — silently
+            # connecting anonymously turns a config typo into an opaque
+            # 401 from the apiserver.
+            have, missing = (
+                ("client-certificate", "client-key")
+                if client_cert_pem
+                else ("client-key", "client-certificate")
+            )
+            raise KubeConfigError(
+                f"kubeconfig user has {have} but no {missing}"
+            )
         return cls(
             server,
             ca_pem=_b64_or_file(
@@ -297,26 +318,25 @@ class KubeConfig:
                 "certificate-authority",
             ),
             insecure=bool(cluster.get("insecure-skip-tls-verify")),
-            client_cert_pem=_b64_or_file(
-                user.get("client-certificate-data"),
-                user.get("client-certificate"),
-                "client-certificate",
-            ),
-            client_key_pem=_b64_or_file(
-                user.get("client-key-data"), user.get("client-key"), "client-key"
-            ),
+            client_cert_pem=client_cert_pem,
+            client_key_pem=client_key_pem,
             token=token,
             username=user.get("username"),
             password=user.get("password"),
         )
 
     def ssl_context(self) -> ssl.SSLContext:
-        ctx = ssl.create_default_context()
+        # A kubeconfig CA is the ONLY trust root (client-go semantics):
+        # create_default_context(cadata=...) skips the system store, so a
+        # publicly-trusted interception cert for the apiserver host fails
+        # closed instead of silently receiving the bearer credentials.
+        if self.ca_pem and not self.insecure:
+            ctx = ssl.create_default_context(cadata=_cadata(self.ca_pem))
+        else:
+            ctx = ssl.create_default_context()
         if self.insecure:
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE
-        elif self.ca_pem:
-            ctx.load_verify_locations(cadata=self.ca_pem.decode())
         if self.client_cert_pem and self.client_key_pem:
             # load_cert_chain only takes paths; stage the PEMs in a private
             # temp dir for the duration of the load.
@@ -342,14 +362,26 @@ class KubeConfig:
         return {}
 
 
+def _cadata(ca: bytes):
+    """``load_verify_locations``-ready CA material: PEM decodes to str,
+    anything undecodable is passed as bytes (DER) — never an uncaught
+    UnicodeDecodeError for a Windows-exported ``.cer``."""
+    try:
+        return ca.decode()
+    except UnicodeDecodeError:
+        return ca
+
+
 def _exec_credential_token(spec: dict) -> str:
     """Run a client-go ``exec`` credential plugin and return its token."""
     cmd = [spec.get("command", "")] + list(spec.get("args") or [])
     env = dict(os.environ)
     for pair in spec.get("env") or []:
         env[pair.get("name", "")] = pair.get("value", "")
-    env.setdefault(
-        "KUBERNETES_EXEC_INFO",
+    # Always OVERWRITE (client-go does): a stale KUBERNETES_EXEC_INFO
+    # inherited from the parent environment must not steer the plugin to
+    # another cluster/apiVersion.
+    env["KUBERNETES_EXEC_INFO"] = (
         json.dumps(
             {
                 "apiVersion": spec.get(
@@ -358,7 +390,7 @@ def _exec_credential_token(spec: dict) -> str:
                 "kind": "ExecCredential",
                 "spec": {"interactive": False},
             }
-        ),
+        )
     )
     try:
         out = subprocess.run(
@@ -367,7 +399,14 @@ def _exec_credential_token(spec: dict) -> str:
         cred = json.loads(out)
         token = cred.get("status", {}).get("token")
     except (OSError, subprocess.SubprocessError, ValueError) as e:
-        raise KubeConfigError(f"exec credential plugin failed: {e}") from e
+        # The plugin's own stderr is the actionable diagnostic ("Unable to
+        # locate credentials...") — client-go passes it through; so do we.
+        stderr = getattr(e, "stderr", b"") or b""
+        detail = stderr.decode(errors="replace").strip()
+        raise KubeConfigError(
+            "exec credential plugin failed: "
+            f"{e}{': ' + detail if detail else ''}"
+        ) from e
     if not token:
         raise KubeConfigError("exec credential plugin returned no status.token")
     return str(token)
@@ -392,15 +431,14 @@ def _jwt_expired(token: str, *, skew_s: float = 30.0) -> bool:
 
 
 def _oidc_ssl_context(cfg: dict) -> ssl.SSLContext:
-    ctx = ssl.create_default_context()
     ca = _b64_or_file(
         cfg.get("idp-certificate-authority-data"),
         cfg.get("idp-certificate-authority"),
         "idp-certificate-authority",
     )
-    if ca:
-        ctx.load_verify_locations(cadata=ca.decode())
-    return ctx
+    if ca:  # pinned: the idp CA is the only root (see ssl_context)
+        return ssl.create_default_context(cadata=_cadata(ca))
+    return ssl.create_default_context()
 
 
 def _oidc_http_json(
@@ -694,20 +732,30 @@ class KubeClient:
         # another thread (follower.stop()) must be able to sever a reader
         # blocked in readline() instead of waiting out the watchdog.
         self._conn = conn
+        # Transport-error conversion wraps ONLY the transport calls, never
+        # a yield: an exception the CONSUMER raises while processing an
+        # event re-enters the generator at the yield, and converting it
+        # would mask a caller bug as a stream failure.
         try:
-            conn.request(
-                "GET",
-                url,
-                headers={"Accept": "application/json", **self.config.auth_headers()},
-            )
-            resp = conn.getresponse()
-            if resp.status // 100 != 2:
-                body = resp.read()
-                raise KubeAPIError(
-                    f"WATCH {path} -> {resp.status} {resp.reason}: "
-                    f"{body[:200].decode(errors='replace')}",
-                    status=resp.status,
+            try:
+                conn.request(
+                    "GET",
+                    url,
+                    headers={
+                        "Accept": "application/json",
+                        **self.config.auth_headers(),
+                    },
                 )
+                resp = conn.getresponse()
+                if resp.status // 100 != 2:
+                    body = resp.read()
+                    raise KubeAPIError(
+                        f"WATCH {path} -> {resp.status} {resp.reason}: "
+                        f"{body[:200].decode(errors='replace')}",
+                        status=resp.status,
+                    )
+            except (OSError, http.client.HTTPException) as e:
+                raise KubeAPIError(f"WATCH {path} failed: {e}") from e
             while True:
                 try:
                     line = resp.readline()
@@ -717,19 +765,23 @@ class KubeClient:
                     # coming (dead peer, no FIN).  Clean end-of-window —
                     # the caller re-watches on a fresh connection.
                     return
+                except (OSError, http.client.HTTPException, ValueError) as e:
+                    # ValueError: readline() on a response another thread
+                    # close()d between events ("readline of closed file")
+                    # — a severed stream, same taxonomy as a socket error.
+                    raise KubeAPIError(f"WATCH {path} failed: {e}") from e
                 if not line:
                     return  # server closed the watch window
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    yield json.loads(line)
+                    event = json.loads(line)
                 except ValueError as e:
                     raise KubeAPIError(
                         f"WATCH {path}: invalid event frame: {e}"
                     ) from e
-        except (OSError, http.client.HTTPException) as e:
-            raise KubeAPIError(f"WATCH {path} failed: {e}") from e
+                yield event
         finally:
             conn.close()
             if self._conn is conn:
@@ -814,10 +866,14 @@ def live_fixture(
         client = KubeClient(KubeConfig.load(kubeconfig, context=context))
 
     fixture: dict = {"nodes": [], "pods": []}
-    for n in client.list_all("/api/v1/nodes", limit=page_limit):
-        fixture["nodes"].append(node_to_fixture(n))
-    for p in client.list_all("/api/v1/pods", limit=page_limit):
-        fixture["pods"].append(pod_to_fixture(p))
-    if own_client:
-        client.close()
+    try:
+        for n in client.list_all("/api/v1/nodes", limit=page_limit):
+            fixture["nodes"].append(node_to_fixture(n))
+        for p in client.list_all("/api/v1/pods", limit=page_limit):
+            fixture["pods"].append(pod_to_fixture(p))
+    finally:
+        # Error paths must not leak the TLS connection (a token expiring
+        # mid-pagination would otherwise strand a socket per retry).
+        if own_client:
+            client.close()
     return fixture

@@ -111,26 +111,55 @@ def _expr_matches(labels: dict, expr: dict) -> bool:
     raise ValueError(f"unknown match-expression operator {op!r}")
 
 
+def _field_matches(node_name: str, expr: dict) -> bool:
+    """``matchFields`` expression against the one field Kubernetes
+    supports: ``metadata.name`` with ``In``/``NotIn`` (the DaemonSet
+    controller's node-pinning pattern).  Anything else is a malformed
+    spec kube-scheduler rejects — raise, like :func:`_expr_matches`
+    does for unknown operators, never silently match nothing."""
+    key = expr.get("key")
+    if key != "metadata.name":
+        raise ValueError(
+            f"unsupported matchFields key {key!r} (only metadata.name)"
+        )
+    op = expr.get("operator", "In")
+    values = expr.get("values", [])
+    if op == "In":
+        return node_name in values
+    if op == "NotIn":
+        return node_name not in values
+    raise ValueError(f"unknown matchFields operator {op!r}")
+
+
 def node_affinity_mask(
     snapshot: ClusterSnapshot, node_selector_terms: list[dict] | None
 ) -> np.ndarray:
-    """Required node-affinity: terms OR-ed, expressions within a term AND-ed.
+    """Required node-affinity: terms OR-ed; a term's ``matchExpressions``
+    AND ``matchFields`` must ALL hold (kube-scheduler ANDs the two lists).
 
-    An empty/expressionless term matches NO nodes (kube-scheduler's
-    nodeaffinity helper treats a nil term as selecting nothing — it is not a
-    match-everything wildcard).
+    An empty term (neither list) matches NO nodes — kube-scheduler's
+    nodeaffinity helper treats a nil term as selecting nothing, not as a
+    match-everything wildcard.  ``matchFields`` supports the one field
+    the API defines, ``metadata.name`` (the DaemonSet controller's
+    node-pinning pattern).
     """
     if not node_selector_terms:
         return np.ones(snapshot.n_nodes, dtype=np.bool_)
+
+    def term_matches(term: dict, labels: dict, node_name: str) -> bool:
+        exprs = term.get("matchExpressions") or []
+        fields = term.get("matchFields") or []
+        if not exprs and not fields:
+            return False  # nil term selects nothing
+        return all(_expr_matches(labels, e) for e in exprs) and all(
+            _field_matches(node_name, f) for f in fields
+        )
+
     mask = np.zeros(snapshot.n_nodes, dtype=np.bool_)
     for i, labels in enumerate(snapshot.labels):
         labels = labels or {}
         mask[i] = any(
-            bool(term.get("matchExpressions"))
-            and all(
-                _expr_matches(labels, e)
-                for e in term.get("matchExpressions", [])
-            )
+            term_matches(term, labels, snapshot.names[i])
             for term in node_selector_terms
         )
     return mask
@@ -140,17 +169,27 @@ def anti_affinity_existing_mask(
     snapshot: ClusterSnapshot,
     fixture: dict,
     label_selector: dict,
+    *,
+    namespace: str | None = None,
 ) -> np.ndarray:
     """Anti-affinity vs existing pods: exclude nodes hosting a matching pod.
 
     Hostname topology (the overwhelmingly common case): a node is infeasible
     if any non-terminated pod already on it carries all the selector labels.
     Label data comes from the fixture's pods (``labels`` key, optional).
+
+    ``namespace`` scopes the match the way a real ``PodAffinityTerm`` with
+    no ``namespaces`` field does — to the INCOMING pod's own namespace
+    (an ``app=db`` pod in another namespace does not repel).  ``None``
+    matches cluster-wide, for what-if specs that model no namespace
+    (documented divergence: kube-scheduler has no namespace-less pods).
     """
     node_index = {name: i for i, name in enumerate(snapshot.names)}
     mask = np.ones(snapshot.n_nodes, dtype=np.bool_)
     for pod in fixture.get("pods", []):
         if pod.get("phase") in ("Succeeded", "Failed"):
+            continue
+        if namespace is not None and pod.get("namespace", "") != namespace:
             continue
         i = node_index.get(pod.get("nodeName", ""))
         if i is None:

@@ -54,6 +54,11 @@ class PodSpec:
     node_selector: dict = field(default_factory=dict)
     affinity_terms: tuple = ()
     anti_affinity_labels: dict = field(default_factory=dict)
+    # Scopes anti_affinity_labels the way a PodAffinityTerm with no
+    # namespaces field is scoped: to the incoming pod's own namespace.
+    # None = match existing pods cluster-wide (a what-if spec that models
+    # no namespace; real pods always have one).
+    namespace: str | None = None
     spread: int | None = None
 
     def __post_init__(self) -> None:
@@ -70,6 +75,14 @@ class PodSpec:
         object.__setattr__(
             self, "cpu_limit_milli", int64_bits(self.cpu_limit_milli)
         )
+        if self.namespace is not None and not isinstance(self.namespace, str):
+            # A non-string namespace would compare unequal to every
+            # existing pod's namespace and silently DISABLE anti-affinity
+            # scoping — reject like every other malformed spec field.
+            raise ValueError(
+                f"namespace must be a string, got "
+                f"{type(self.namespace).__name__}"
+            )
         if self.replicas < 0:
             # Reference parity accepts negative replicas on the fit
             # VERDICT (total >= replicas); placement has no coherent
@@ -85,6 +98,14 @@ class PodSpec:
         if self.spread is not None and self.spread < 1:
             raise ValueError("spread must be >= 1 (or None for unlimited)")
         for name, qty in self.extended_requests.items():
+            if name in ("cpu", "memory"):
+                # These alias the core columns: resource_matrix would
+                # build a DUPLICATE row with a conflicting request and
+                # silently constrain the resource twice.
+                raise ValueError(
+                    f"extended request {name!r} aliases a core resource — "
+                    "use cpu_request_milli / mem_request_bytes"
+                )
             # Zero means "does not consume"; negative has no coherent
             # semantics and the kernels disagree on it (the fit kernel
             # divides as-is, placement would treat it as non-consuming) —
@@ -218,7 +239,10 @@ class CapacityModel:
                 )
             parts.append(
                 _masks.anti_affinity_existing_mask(
-                    snap, self.fixture, spec.anti_affinity_labels
+                    snap,
+                    self.fixture,
+                    spec.anti_affinity_labels,
+                    namespace=spec.namespace,
                 )
             )
         return _masks.combine_masks(*parts)

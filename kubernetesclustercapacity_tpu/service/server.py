@@ -253,6 +253,7 @@ class CapacityServer:
                 anti_affinity_labels=dict(
                     msg.get("anti_affinity_labels") or {}
                 ),
+                namespace=msg.get("namespace"),
                 spread=int(spread) if spread is not None else None,
                 extended_requests={
                     k: int(v)

@@ -403,3 +403,76 @@ class TestSweepMulti:
                 requests=np.array([[1, 2, 3]]),
                 replicas=np.array([1]),
             )
+
+
+class TestSchedulerFidelity:
+    """Round-4 review items: matchFields, anti-affinity namespace scoping,
+    and core-resource aliasing in extended_requests."""
+
+    def _snap(self):
+        from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+        fx = {
+            "nodes": [
+                {"name": f"n{i}",
+                 "allocatable": {"cpu": "4", "memory": "8388608Ki",
+                                 "pods": "110"},
+                 "conditions": [{"type": "c", "status": "False"}] * 4,
+                 "labels": {"zone": f"z{i % 2}"}}
+                for i in range(3)
+            ],
+            "pods": [
+                {"name": "db-web", "namespace": "web", "nodeName": "n0",
+                 "phase": "Running", "labels": {"app": "db"},
+                 "containers": []},
+                {"name": "db-staging", "namespace": "staging",
+                 "nodeName": "n1", "phase": "Running",
+                 "labels": {"app": "db"}, "containers": []},
+            ],
+        }
+        return fx, snapshot_from_fixture(fx, semantics="strict")
+
+    def test_match_fields_metadata_name(self):
+        from kubernetesclustercapacity_tpu.masks import node_affinity_mask
+
+        _, snap = self._snap()
+        # The DaemonSet-controller pattern: pin to one node by name.
+        mask = node_affinity_mask(
+            snap,
+            [{"matchFields": [{"key": "metadata.name", "operator": "In",
+                               "values": ["n1"]}]}],
+        )
+        assert mask.tolist() == [False, True, False]
+        # Expressions AND fields within one term.
+        mask = node_affinity_mask(
+            snap,
+            [{"matchExpressions": [{"key": "zone", "operator": "In",
+                                    "values": ["z0"]}],
+              "matchFields": [{"key": "metadata.name", "operator": "NotIn",
+                               "values": ["n0"]}]}],
+        )
+        assert mask.tolist() == [False, False, True]  # z0 minus n0 = n2
+
+    def test_anti_affinity_namespace_scoping(self):
+        from kubernetesclustercapacity_tpu.masks import (
+            anti_affinity_existing_mask,
+        )
+
+        fx, snap = self._snap()
+        # Cluster-wide (no namespace): both db pods repel.
+        mask = anti_affinity_existing_mask(snap, fx, {"app": "db"})
+        assert mask.tolist() == [False, False, True]
+        # Scoped to 'web' (real PodAffinityTerm default): only n0 repels.
+        mask = anti_affinity_existing_mask(
+            snap, fx, {"app": "db"}, namespace="web"
+        )
+        assert mask.tolist() == [False, True, True]
+
+    def test_extended_request_core_alias_rejected(self):
+        import pytest as _pytest
+
+        from kubernetesclustercapacity_tpu.models import PodSpec
+
+        with _pytest.raises(ValueError, match="aliases a core resource"):
+            PodSpec(cpu_request_milli=500, mem_request_bytes=1 << 30,
+                    extended_requests={"cpu": 2})
