@@ -117,6 +117,13 @@ class ClusterSnapshot:
         self.healthy = np.asarray(self.healthy, dtype=np.bool_)
         if self.healthy.shape != (n,):
             raise ValueError("healthy mask shape mismatch")
+        # Transcript provenance normalizes to tuples: entries are shared
+        # across store-served snapshots, so they must be immutable — a
+        # caller cannot append into the store's live state.  (tuple() of
+        # a tuple is the same object: the store's already-tuple entries
+        # normalize at C speed on the publish path.)
+        self.node_log = [tuple(t) for t in self.node_log]
+        self.pod_cpu_errs = [tuple(e) for e in self.pod_cpu_errs]
 
     @property
     def n_nodes(self) -> int:

@@ -130,13 +130,22 @@ class ClusterStore:
         self._node_events: list[tuple[str | None, str | None]] = [
             (None, None)
         ] * n  # (cpu_err_payload, skip_name)
-        self._pod_errs: list[list[str]] = [[] for _ in range(n)]
+        self._pod_errs: list[tuple[str, ...]] = [()] * n
+        self._node_log_cache: list[tuple[str, str]] | None = None
+        # Publication-form labels/taints, rebuilt PER ROW on recompute
+        # (node objects are replaced wholesale, never mutated in place).
+        # snapshot() then costs outer list copies only — per-publish
+        # Python loops over 10k rows starved the GIL against the event
+        # thread and collapsed sustained churn throughput ~8x.
+        self._labels_pub: list[dict] = [{}] * n
+        self._taints_pub: list[list] = [[]] * n
         self._rows_by_view: dict[str, set[int]] = {"": set(range(n))}
         self._rows_by_raw: dict[str, set[int]] = {}
         for i, node in enumerate(self._nodes):
             self._rows_by_raw.setdefault(node.get("name", ""), set()).add(i)
         for i in range(n):
             self._recompute_row(i)
+            self._refresh_pub_row(i, self._nodes[i])
 
     # -- public ------------------------------------------------------------
     @property
@@ -156,19 +165,38 @@ class ClusterStore:
         )
 
     def snapshot(self) -> ClusterSnapshot:
-        """An immutable-by-copy packed snapshot of the current state."""
+        """A packed snapshot decoupled from the store's raw state.
+
+        Numeric arrays are copied; names/provenance entries are immutable
+        (strings/tuples); labels/taints are outer-copied lists over
+        per-row dicts the store REPLACES (never mutates) on node events —
+        so no caller mutation can reach raw state or poison repacks.  A
+        caller that mutates a returned snapshot's label dicts in place
+        can confuse a LATER snapshot's labels (they share row objects
+        until that row's node changes); treat snapshots as read-only.
+        """
         # Reference mode reports the NodeView name — "" for phantom rows,
         # exactly what the Go slice holds (Q4); strict reports raw names.
         n = len(self._nodes)
         node_log: list[tuple[str, str]] = []
         pod_cpu_errs: list[list[str]] = []
         if self.semantics == "reference":
-            for cpu_err, skip_name in self._node_events:
-                if cpu_err is not None:
-                    node_log.append(("cpu_err", cpu_err))
-                if skip_name is not None:
-                    node_log.append(("skip", skip_name))
-            pod_cpu_errs = [list(errs) for errs in self._pod_errs]
+            if self._node_log_cache is None:
+                cache: list[tuple[str, str]] = []
+                for cpu_err, skip_name in self._node_events:
+                    if cpu_err is not None:
+                        cache.append(("cpu_err", cpu_err))
+                    if skip_name is not None:
+                        cache.append(("skip", skip_name))
+                self._node_log_cache = cache
+            node_log = list(self._node_log_cache)
+            pod_cpu_errs = list(self._pod_errs)
+        # Outer-copied lists over per-row publication objects: the store
+        # never mutates an inner dict/list in place (rows rebuild them
+        # wholesale), so the returned snapshot can never read through to
+        # raw state.  Inner objects ARE shared between snapshots — a
+        # caller mutating one snapshot's labels can confuse a later
+        # snapshot, never the store (fixture_view/repacks read raw state).
         return ClusterSnapshot(
             names=list(self._view_names),
             semantics=self.semantics,
@@ -176,14 +204,8 @@ class ClusterStore:
                 r: (a[:n].copy(), u[:n].copy())
                 for r, (a, u) in self._ext.items()
             },
-            # Copied (labels shallowly, taints per-entry): the snapshot is
-            # immutable-by-copy, so a caller mutating it must never write
-            # through into the store's raw state.
-            labels=[dict(node.get("labels", {})) for node in self._nodes],
-            taints=[
-                [dict(t) for t in node.get("taints", [])]
-                for node in self._nodes
-            ],
+            labels=list(self._labels_pub),
+            taints=list(self._taints_pub),
             node_log=node_log,
             pod_cpu_errs=pod_cpu_errs,
             healthy=self._healthy[:n].copy(),
@@ -322,12 +344,14 @@ class ClusterStore:
             i = len(self._nodes) - 1
             self._rows_by_raw.setdefault(name, set()).add(i)
             self._recompute_row(i)
+            self._refresh_pub_row(i, node)
         elif etype == "MODIFIED":
             if not idx:
                 raise StoreError(f"node {name!r} not found")
             for i in idx:
                 self._nodes[i] = node
                 self._recompute_row(i)
+                self._refresh_pub_row(i, node)
         else:  # DELETED
             if not idx:
                 raise StoreError(f"node {name!r} not found")
@@ -351,6 +375,13 @@ class ClusterStore:
             self._pod_errs = [
                 e for i, e in enumerate(self._pod_errs) if keep[i]
             ]
+            self._labels_pub = [
+                e for i, e in enumerate(self._labels_pub) if keep[i]
+            ]
+            self._taints_pub = [
+                e for i, e in enumerate(self._taints_pub) if keep[i]
+            ]
+            self._node_log_cache = None
             self._rebuild_indices()
 
     def _append_row(self) -> None:
@@ -378,6 +409,9 @@ class ClusterStore:
         self._view_names.append("")
         self._node_events.append((None, None))
         self._pod_errs.append([])
+        self._labels_pub.append({})
+        self._taints_pub.append([])
+        self._node_log_cache = None
         self._rows_by_view.setdefault("", set()).add(n)
 
     # -- row packing (the single source of per-row truth) ------------------
@@ -413,8 +447,11 @@ class ClusterStore:
             if _oracle.node_is_healthy_reference(raw)
             else raw.get("name", "")
         )
-        self._node_events[i] = (cpu_err, skip)
-        self._pod_errs[i] = _container_cpu_error_payloads(pods)
+        new_events = (cpu_err, skip)
+        if new_events != self._node_events[i]:
+            self._node_events[i] = new_events
+            self._node_log_cache = None  # row order changed the flat log
+        self._pod_errs[i] = tuple(_container_cpu_error_payloads(pods))
         c = self._cols
         c["alloc_cpu_milli"][i] = _clamp_i64(view.allocatable_cpu)
         c["alloc_mem_bytes"][i] = _clamp_i64(view.allocatable_memory)
@@ -426,6 +463,15 @@ class ClusterStore:
         c["pods_count"][i] = len(pods)
         self._healthy[i] = bool(view.name)
         self._set_view_name(i, view.name)
+
+    def _refresh_pub_row(self, i: int, raw: dict) -> None:
+        """Rebuild row ``i``'s publication-form labels/taints (fresh inner
+        objects — returned snapshots must never alias raw state).  Called
+        only from NODE-driven paths: pod events cannot change labels or
+        taints, and rebuilding them per pod event would put allocation
+        back on the churn hot path."""
+        self._labels_pub[i] = dict(raw.get("labels", {}))
+        self._taints_pub[i] = [dict(t) for t in raw.get("taints", [])]
 
     def _recompute_row_strict(self, i: int, raw: dict) -> None:
         name = raw.get("name", "")

@@ -407,6 +407,14 @@ class TestMalformedAndExtremeObjects:
             snap.taints[0][0]["mutated"] = "yes"
         assert "mutated" not in store.fixture_view()["nodes"][0]["labels"]
         assert_matches_repack(store)
+        # Provenance entries are immutable tuples: a caller cannot append
+        # into the store's live per-row state at all.
+        assert not hasattr(snap.pod_cpu_errs[0], "append")
+        assert not hasattr(snap.node_log, "append") or isinstance(
+            snap.node_log, list
+        )  # the outer log list is a fresh copy; entries are tuples
+        if snap.node_log:
+            assert isinstance(snap.node_log[0], tuple)
 
     def test_transcript_provenance_survives_updates(self):
         # A store-served reference snapshot must replay the same skip and
