@@ -311,6 +311,40 @@ class TestReferenceColumnarParity:
         )
         self._assert_equal(fx)
 
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_randomized_fixture_with_codec_errors(self, seed):
+        """The generator emits only parseable CPU strings, so the plain
+        randomized runs never exercise NONEMPTY transcript provenance —
+        inject unparseable cpu values into random nodes and containers so
+        node_log/pod_cpu_errs parity is fuzzed with real payloads (incl.
+        orphan pods shared by phantom rows)."""
+        import json as _json
+        import random as _random
+
+        fx = _json.loads(_json.dumps(synthetic_fixture(
+            40, seed=seed, unhealthy_frac=0.25, unscheduled_running_pods=4
+        )))
+        rng = _random.Random(seed)
+        bad = ["4.5", "bogus", "1e3", "-0.5m", "", "9" * 30]
+        for node in fx["nodes"]:
+            if rng.random() < 0.3:
+                node["allocatable"]["cpu"] = rng.choice(bad)
+        for pod in fx["pods"]:
+            for c in pod.get("containers", []):
+                if rng.random() < 0.2:
+                    res = c.setdefault("resources", {})
+                    res.setdefault("requests", {})["cpu"] = rng.choice(bad)
+                if rng.random() < 0.1:
+                    res = c.setdefault("resources", {})
+                    res.setdefault("limits", {})["cpu"] = rng.choice(bad)
+        self._assert_equal(fx)
+        from kubernetesclustercapacity_tpu.snapshot import _pack_reference
+
+        got = _pack_reference(fx)
+        assert any(k == "cpu_err" for k, _ in got.node_log) or any(
+            got.pod_cpu_errs
+        )  # the injection really produced payload traffic
+
     def test_adversarial_wrap_dups_and_orphans(self):
         # Duplicate node names, phantom rows, uint64-wrapping cpu sums,
         # int64-wrapping memory sums, parse-fail strings, missing dicts.
