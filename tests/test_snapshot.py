@@ -303,6 +303,7 @@ class TestReferenceColumnarParity:
         # replay identically from either walk.
         assert got.node_log == want.node_log
         assert got.pod_cpu_errs == want.pod_cpu_errs
+        return got
 
     @pytest.mark.parametrize("seed", [0, 1, 2, 3])
     def test_randomized_fixture(self, seed):
@@ -337,10 +338,7 @@ class TestReferenceColumnarParity:
                 if rng.random() < 0.1:
                     res = c.setdefault("resources", {})
                     res.setdefault("limits", {})["cpu"] = rng.choice(bad)
-        self._assert_equal(fx)
-        from kubernetesclustercapacity_tpu.snapshot import _pack_reference
-
-        got = _pack_reference(fx)
+        got = self._assert_equal(fx)
         assert any(k == "cpu_err" for k, _ in got.node_log) or any(
             got.pod_cpu_errs
         )  # the injection really produced payload traffic
