@@ -69,6 +69,30 @@ def main() -> None:
     assert gplace.all_placed
     assert gplace.by_node().get(fixture["nodes"][0]["name"], 0) == 0
 
+    # Anti-affinity against EXISTING pods, namespace-scoped like a real
+    # PodAffinityTerm: an app=db pod in another namespace does not repel.
+    fixture["pods"].append({
+        "name": "db-0", "namespace": "prod", "nodeName":
+        fixture["nodes"][1]["name"], "phase": "Running",
+        "labels": {"app": "db"}, "containers": [],
+    })
+    asnap = kcc.snapshot_from_fixture(fixture, semantics="strict")
+    amodel = CapacityModel(asnap, mode="strict", fixture=fixture)
+    repelled = amodel.evaluate(PodSpec(
+        cpu_request_milli=250, mem_request_bytes=512 << 20,
+        anti_affinity_labels={"app": "db"}, namespace="prod",
+        tolerations=({"operator": "Exists"},),
+    ))
+    other_ns = amodel.evaluate(PodSpec(
+        cpu_request_milli=250, mem_request_bytes=512 << 20,
+        anti_affinity_labels={"app": "db"}, namespace="staging",
+        tolerations=({"operator": "Exists"},),
+    ))
+    print(f"\nanti-affinity vs prod/db: node-1 fits "
+          f"{int(repelled.fits[1])}; from another namespace: "
+          f"{int(other_ns.fits[1])}")
+    assert repelled.fits[1] == 0 and other_ns.fits[1] > 0
+
 
 if __name__ == "__main__":
     main()
