@@ -78,13 +78,60 @@ def _env_num(name: str, default: float, cast) -> float:
         return default
 
 
-_INIT_ATTEMPTS = max(1, _env_num("KCC_BENCH_INIT_ATTEMPTS", 5, int))
+_INIT_ATTEMPTS = max(1, _env_num("KCC_BENCH_INIT_ATTEMPTS", 3, int))
 _INIT_TIMEOUT_S = max(1.0, _env_num("KCC_BENCH_INIT_TIMEOUT_S", 150, float))
 _MEASURE_TIMEOUT_S = max(
     10.0, _env_num("KCC_BENCH_MEASURE_TIMEOUT_S", 2400, float)
 )
+_PROBE_TIMEOUT_S = max(1.0, _env_num("KCC_BENCH_PROBE_TIMEOUT_S", 150, float))
+_PROBE_ENABLED = os.environ.get("KCC_BENCH_PROBE", "1") != "0"
+_STDERR_TAIL_LINES = 20
 _CHILD_ENV = "KCC_BENCH_CHILD"
+_BOOT_MARK = "@@KCC_BENCH_CHILD_BOOTED@@"
 _READY_MARK = "@@KCC_BENCH_BACKEND_READY@@"
+
+# Children arm a faulthandler stack dump a few seconds before the
+# parent's kill deadline: a hang then leaves WHERE-it-hung (the blocked
+# jax/PJRT frame) in the stderr tail of the attempt record.  The parent
+# passes its own SPAWN wall-clock so the child can arm relative to the
+# parent's deadline, not its own start — interpreter boot + module
+# imports must not eat the pre-kill margin and lose the dump.
+_FAULT_DUMP_ENV = "KCC_BENCH_FAULT_DUMP_S"
+_SPAWN_T_ENV = "KCC_BENCH_SPAWN_T"
+_FAULT_DUMP_ARM = """\
+import faulthandler as _fh, os as _os, time as _time
+_d = float(_os.environ.get('%s', '0') or 0)
+_t0 = float(_os.environ.get('%s', '0') or 0)
+if _d > 0:
+    _delay = max(_t0 + _d - _time.time(), 1.0) if _t0 else _d
+    _fh.dump_traceback_later(_delay, exit=False)
+""" % (_FAULT_DUMP_ENV, _SPAWN_T_ENV)
+
+# The probe child's entire program: stdlib + jax only, no repo imports.
+# Mirrors exactly what the environment does on any `import jax` +
+# `jax.devices()` — the minimal reproduction of round 4's init hang.
+_PROBE_CODE = _FAULT_DUMP_ARM + """\
+import time
+t0 = time.time()
+import jax
+print('@@PROBE_JAX_IMPORTED@@ %.1fs' % (time.time() - t0), flush=True)
+t1 = time.time()
+d = jax.devices()
+print('@@PROBE_DEVICES_OK@@ %.1fs %s' % (time.time() - t1, d[0]), flush=True)
+"""
+
+
+def _fault_dump_env(timeout_s: float) -> dict:
+    """Arm the child's pre-kill stack dump ~5 s before the watchdog.
+
+    ``_SPAWN_T_ENV`` anchors the dump to the parent's spawn time so slow
+    child boot (cold caches, loaded host) shrinks the delay instead of
+    pushing the dump past the SIGKILL.
+    """
+    return {
+        _FAULT_DUMP_ENV: str(max(timeout_s - 5.0, 1.0)),
+        _SPAWN_T_ENV: str(time.time()),
+    }
 
 
 def _emit(payload: dict) -> None:
@@ -119,50 +166,165 @@ def _kill_group(proc: subprocess.Popen) -> None:
         pass
 
 
-def _run_child_attempt() -> tuple[dict | None, str, bool]:
-    """One measurement attempt in a fresh subprocess.
+class _ChildIO:
+    """Pump a child's stdout into a queue; tee stderr to the parent's
+    stderr while keeping a bounded tail for the attempt record.
 
-    Returns ``(payload, phase, ready)``: the child's JSON line (or ``None``
-    on a hang/crash), which phase the attempt reached (``"init"`` /
-    ``"measure"`` / ``"done"``), and whether backend init succeeded (the
-    ready-marker was seen) — the parent only re-dials failures that
-    happened *before* ready; post-init failures are deterministic and are
-    not worth re-running the whole measurement for.  The child prints the
-    ready-marker line the moment ``jax.devices()`` returns, then its one
-    JSON line; stderr passes straight through for interactive diagnosis.
+    Round 4 lost all five attempts' diagnostics because stderr passed
+    straight through and the artifact recorded only "hung in init": the
+    failure record now carries the child's own last words.
     """
-    env = dict(os.environ, **{_CHILD_ENV: "1"})
+
+    def __init__(self, proc: subprocess.Popen) -> None:
+        import collections
+        import queue
+        import threading
+
+        self.proc = proc
+        self.lines: "queue.Queue" = queue.Queue()
+        self._tail: "collections.deque" = collections.deque(maxlen=200)
+        self._empty = queue.Empty
+        threading.Thread(target=self._pump_out, daemon=True).start()
+        threading.Thread(target=self._pump_err, daemon=True).start()
+
+    def _pump_out(self) -> None:
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            self.lines.put(line)
+        self.lines.put(None)  # EOF sentinel
+
+    def _pump_err(self) -> None:
+        assert self.proc.stderr is not None
+        for line in self.proc.stderr:
+            self._tail.append(line.rstrip("\n"))
+            sys.stderr.write(line)  # interactive diagnosis stays live
+
+    def get(self, timeout: float):
+        try:
+            return self.lines.get(timeout=timeout)
+        except self._empty:
+            return ""  # distinguishable from the None EOF sentinel
+
+    def drain_nowait(self):
+        out = []
+        while True:
+            try:
+                line = self.lines.get_nowait()
+            except self._empty:
+                return out
+            if line is not None:
+                out.append(line)
+
+    def stderr_tail(self, n: int = _STDERR_TAIL_LINES) -> list[str]:
+        return list(self._tail)[-n:]
+
+
+def _spawn(argv: list[str], extra_env: dict | None = None) -> _ChildIO:
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__)],
+        argv,
         stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
         text=True,
         start_new_session=True,  # own process group → killable wholesale
-        env=env,
+        env=dict(os.environ, **(extra_env or {})),
         cwd=_REPO_ROOT,
     )
+    return _ChildIO(proc)
 
-    import queue
-    import threading
 
-    lines: queue.Queue = queue.Queue()
+def _run_probe_attempt() -> dict:
+    """A minimal child that ONLY imports jax and calls ``jax.devices()``.
 
-    def pump() -> None:
-        assert proc.stdout is not None
-        for line in proc.stdout:
-            lines.put(line)
-        lines.put(None)  # EOF sentinel
+    No repo code runs in the probe (its whole source is ``_PROBE_CODE``),
+    so its record discriminates the two causes round 4's artifact could
+    not tell apart: a hang here is the backend/tunnel environment; a probe
+    that succeeds while the full child then hangs in init would indict
+    this repo's import path.  The record lands in the artifact either way.
+    """
+    t0 = time.monotonic()
+    io = _spawn(
+        [sys.executable, "-c", _PROBE_CODE],
+        _fault_dump_env(_PROBE_TIMEOUT_S),
+    )
+    phase = "import-jax"
+    ok = False
+    eof = False
+    deadline = t0 + _PROBE_TIMEOUT_S
+    def probe_handle(line: str) -> None:
+        nonlocal phase, ok
+        if "@@PROBE_JAX_IMPORTED@@" in line:
+            phase = "jax.devices()"
+        elif "@@PROBE_DEVICES_OK@@" in line:
+            phase, ok = "done", True
 
-    threading.Thread(target=pump, daemon=True).start()
+    while not eof and not ok:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        line = io.get(min(remaining, 1.0))
+        if line is None:
+            eof = True
+        elif line:
+            probe_handle(line)
+    # Same race guard as the measure loop: a success marker enqueued just
+    # before the deadline must not be misrecorded as a hang.
+    for line in io.drain_nowait():
+        probe_handle(line)
+    record = {
+        "kind": "probe",
+        "phase": phase,
+        "timeout_s": _PROBE_TIMEOUT_S,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    if ok:
+        record["outcome"] = "ok"
+    elif eof:
+        try:
+            rc: object = io.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            rc = "wedged"
+        record["outcome"] = f"probe exited rc={rc} before devices"
+    else:
+        record["outcome"] = f"probe hung in {phase} > {_PROBE_TIMEOUT_S:.0f}s (killed)"
+    record["stderr_tail"] = io.stderr_tail()
+    _kill_group(io.proc)
+    return record
 
-    phase = "init"
+
+def _run_child_attempt(init_timeout_s: float) -> tuple[dict | None, dict, bool]:
+    """One measurement attempt in a fresh subprocess.
+
+    Returns ``(payload, record, ready)``: the child's JSON line (or
+    ``None`` on a hang/crash), a structured attempt record for the
+    artifact (``{kind, phase, timeout_s, elapsed_s, outcome,
+    stderr_tail}``), and whether backend init succeeded (the ready-marker
+    was seen) — the parent only re-dials failures that happened *before*
+    ready; post-init failures are deterministic and are not worth
+    re-running the whole measurement for.  The child prints a boot marker
+    before importing jax (so a hang provably happened inside backend
+    init, not this repo's imports), the ready-marker the moment
+    ``jax.devices()`` returns, then its one JSON line.
+    """
+    t0 = time.monotonic()
+    io = _spawn(
+        [sys.executable, os.path.abspath(__file__)],
+        {_CHILD_ENV: "1", **_fault_dump_env(init_timeout_s)},
+    )
+
+    phase = "boot"
     ready = False
-    deadline = time.monotonic() + _INIT_TIMEOUT_S
+    deadline = t0 + init_timeout_s
     payload = None
 
     def handle(raw: str) -> None:
         nonlocal phase, ready, deadline, payload
         raw = raw.strip()
         if not raw:
+            return
+        if raw.startswith(_BOOT_MARK):
+            # Repo-side imports finished; the child is now inside
+            # jax.devices().  A later init-hang is provably environmental.
+            phase = "init"
             return
         if raw.startswith(_READY_MARK):
             phase, ready = "measure", True
@@ -186,34 +348,59 @@ def _run_child_attempt() -> tuple[dict | None, str, bool]:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break
-        try:
-            line = lines.get(timeout=min(remaining, 1.0))
-        except queue.Empty:
-            continue
+        line = io.get(min(remaining, 1.0))
         if line is None:
             eof = True
-        else:
+        elif line:
             handle(line)
     # Final non-blocking drain: a JSON line enqueued just before the
     # deadline (or before EOF) must not be thrown away as a "hang".
-    while True:
-        try:
-            line = lines.get_nowait()
-        except queue.Empty:
-            break
-        if line is not None:
-            handle(line)
-    if eof and payload is None:
+    for line in io.drain_nowait():
+        handle(line)
+    record = {
+        "kind": "measure",
+        "phase": phase,
+        "timeout_s": init_timeout_s if not ready else _MEASURE_TIMEOUT_S,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    if payload is not None:
+        record["outcome"] = (
+            "ok"
+            if payload.get("value") is not None
+            else f"child error: {payload.get('error', 'unknown')}"
+        )
+    elif eof:
         # Crash before any JSON — label it as such, not as a hang.  The
         # wait is bounded: stdout EOF with a wedged process exit must not
         # stall the parent past the watchdog.
         try:
-            rc: object = proc.wait(timeout=10)
+            rc: object = io.proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             rc = "wedged"
-        phase = f"{phase} (child exited rc={rc} without JSON)"
-    _kill_group(proc)
-    return payload, phase, ready
+        record["outcome"] = f"child exited rc={rc} in {phase} without JSON"
+    else:
+        record["outcome"] = (
+            f"child hung in {phase} > {record['timeout_s']:.0f}s (killed)"
+        )
+    record["stderr_tail"] = io.stderr_tail()
+    _kill_group(io.proc)
+    return payload, record, ready
+
+
+def _init_timeout_ladder() -> list[float]:
+    """Escalating per-attempt init timeouts: 150 → 300 → 600 s by default.
+
+    Round 4 burned five identical 150 s attempts (750 s of init budget)
+    against a slow tunnel and captured nothing; the default ladder spends
+    a comparable-order worst case (1050 s + a 150 s probe + short sleeps,
+    ~1230 s total before the measure budget) but can ride out an init
+    that is slow rather than dead.  The base and attempt count stay
+    env-tunable; the cap keeps a large base override from compounding.
+    """
+    cap = max(_INIT_TIMEOUT_S, 600.0)
+    return [
+        min(_INIT_TIMEOUT_S * (2.0 ** i), cap) for i in range(_INIT_ATTEMPTS)
+    ]
 
 
 def _parent_main() -> None:
@@ -221,49 +408,54 @@ def _parent_main() -> None:
 
     Never imports jax: a hung PJRT init can only be recovered by killing
     the process that attempted it, so the process that owns the output
-    contract must stay clean.
+    contract must stay clean.  EVERY attempt — the probe included — gets
+    a complete record in the artifact (no truncation: a failed run's JSON
+    alone must be enough to diagnose env-vs-code).
     """
-    failures: list[str] = []
+    attempts: list[dict] = []
+    if _PROBE_ENABLED:
+        attempts.append(_run_probe_attempt())
     last_payload = None
-    for attempt in range(_INIT_ATTEMPTS):
-        payload, phase, ready = _run_child_attempt()
+    ladder = _init_timeout_ladder()
+    measures_run = 0
+    for attempt, timeout_s in enumerate(ladder):
+        payload, record, ready = _run_child_attempt(timeout_s)
+        attempts.append(record)
+        measures_run += 1
         if payload is not None and payload.get("value") is not None:
-            if attempt or failures:
+            # The probe's record is never discarded: its init timing is
+            # evidence even on a healthy run.
+            if attempts:
                 payload.setdefault("init_retries", attempt)
-                payload.setdefault("init_failures", failures[-3:])
+                payload.setdefault("attempts", attempts)
             _emit(payload)
             return
         if payload is not None:  # structured in-child failure
             last_payload = payload
-            failures.append(str(payload.get("error", "unknown")))
             if ready:
                 # Post-init failure (correctness gate, kernel bug, ...) is
                 # deterministic: re-running the whole measurement would
                 # just replay it N times.  Emit once, now.
                 break
-        elif "exited" in phase:  # crash before any JSON — not a hang
-            failures.append(f"child {phase}")
-        else:
-            timeout_s = (
-                _INIT_TIMEOUT_S if phase == "init" else _MEASURE_TIMEOUT_S
-            )
-            failures.append(
-                f"child hung in {phase} > {timeout_s:.0f}s (killed)"
-            )
-        if attempt + 1 < _INIT_ATTEMPTS:
+        if attempt + 1 < len(ladder):
             time.sleep(min(2.0 ** attempt, 30.0))
     # Exhausted (or broke early on a deterministic failure): relay the
-    # most informative failure with the number of attempts actually run.
+    # most informative failure with every attempt's complete record.
+    # init_attempts counts measure children actually RUN (an early break
+    # must not claim the failure reproduced ladder-many times).
+    failures = [a["outcome"] for a in attempts if a["outcome"] != "ok"]
     if last_payload is not None:
-        last_payload["init_attempts"] = len(failures)
-        last_payload["init_failures"] = failures[-3:]
+        last_payload["init_attempts"] = measures_run
+        last_payload["init_failures"] = failures
+        last_payload["attempts"] = attempts
         _emit(last_payload)
     else:
         _fail(
-            f"all {_INIT_ATTEMPTS} subprocess attempts failed",
-            init_attempts=_INIT_ATTEMPTS,
-            init_timeout_s=_INIT_TIMEOUT_S,
-            init_failures=failures[-3:],
+            f"all {measures_run} subprocess attempts failed",
+            init_attempts=measures_run,
+            init_timeout_ladder_s=ladder,
+            init_failures=failures,
+            attempts=attempts,
         )
 
 
@@ -294,6 +486,26 @@ def main() -> None:
 
 
 def _run() -> None:
+    # Repo-side module imports are done; everything past this marker is
+    # jax/backend territory — the parent uses it to prove an init hang
+    # happened in the environment, not in this repo's import path.
+    print(_BOOT_MARK, flush=True)
+    import faulthandler
+
+    dump_after = _env_num(_FAULT_DUMP_ENV, 0.0, float)
+    spawn_t = _env_num(_SPAWN_T_ENV, 0.0, float)
+    if dump_after > 0:
+        # A hang past this point dumps every thread's stack to stderr just
+        # before the parent kills the group — the attempt record's
+        # stderr_tail then names the blocked PJRT/jax frame.  Anchored to
+        # the parent's spawn time: boot latency must not push the dump
+        # past the parent's SIGKILL.
+        delay = (
+            max(spawn_t + dump_after - time.time(), 1.0)
+            if spawn_t
+            else dump_after
+        )
+        faulthandler.dump_traceback_later(delay, exit=False)
     import jax
 
     # A TPU-plugin sitecustomize may re-pin jax_platforms at interpreter
@@ -312,6 +524,10 @@ def _run() -> None:
     except Exception as e:  # noqa: BLE001 - structured, parent re-dials
         _fail(f"backend init failed: {type(e).__name__}: {e}")
         return
+    if dump_after > 0:
+        # Init survived: disarm the pre-kill dump so it can't fire mid-
+        # measurement (the measure phase has its own, much longer budget).
+        faulthandler.cancel_dump_traceback_later()
     print(f"{_READY_MARK} {devices[0]}", flush=True)
 
     import kubernetesclustercapacity_tpu as kcc
