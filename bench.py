@@ -219,14 +219,21 @@ class _ChildIO:
         return list(self._tail)[-n:]
 
 
-def _spawn(argv: list[str], extra_env: dict | None = None) -> _ChildIO:
+def _spawn(
+    argv: list[str],
+    extra_env: dict | None = None,
+    drop_env: tuple[str, ...] = (),
+) -> _ChildIO:
+    env = dict(os.environ, **(extra_env or {}))
+    for key in drop_env:
+        env.pop(key, None)
     proc = subprocess.Popen(
         argv,
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
         start_new_session=True,  # own process group → killable wholesale
-        env=dict(os.environ, **(extra_env or {})),
+        env=env,
         cwd=_REPO_ROOT,
     )
     return _ChildIO(proc)
@@ -439,12 +446,27 @@ def _parent_main() -> None:
                 break
         if attempt + 1 < len(ladder):
             time.sleep(min(2.0 ** attempt, 30.0))
-    # Exhausted (or broke early on a deterministic failure): relay the
-    # most informative failure with every attempt's complete record.
-    # init_attempts counts measure children actually RUN (an early break
-    # must not claim the failure reproduced ladder-many times).
+    # Exhausted (or broke early on a deterministic failure): salvage the
+    # device-free metrics (ingestion, churn) in a TPU-plugin-stripped CPU
+    # child — a dead tunnel must not void numbers that never needed it —
+    # then relay the most informative failure with every attempt's
+    # complete record.  init_attempts counts measure children actually
+    # RUN (an early break must not claim the failure reproduced
+    # ladder-many times).
     failures = [a["outcome"] for a in attempts if a["outcome"] != "ok"]
+    extra: dict = {}
+    if last_payload is None or "pack_10k_nodes_ms" not in last_payload:
+        # Only re-measure host-side metrics if no failed child already
+        # carried them out (a post-ladder deterministic failure does).
+        host_aux, aux_record = _run_host_aux_fallback()
+        attempts.append(aux_record)
+        extra = dict(host_aux or {})
+        if host_aux is not None:
+            extra["aux_host_fallback"] = True
     if last_payload is not None:
+        for k, v in extra.items():
+            # Never clobber a value the measurement child itself produced.
+            last_payload.setdefault(k, v)
         last_payload["init_attempts"] = measures_run
         last_payload["init_failures"] = failures
         last_payload["attempts"] = attempts
@@ -456,6 +478,7 @@ def _parent_main() -> None:
             init_timeout_ladder_s=ladder,
             init_failures=failures,
             attempts=attempts,
+            **extra,
         )
 
 
@@ -465,6 +488,23 @@ def main() -> None:
             _parent_main()
         except Exception as e:  # noqa: BLE001 - contract: one JSON line
             _fail(f"parent orchestrator error: {type(e).__name__}: {e}")
+        return
+    if os.environ.get(_HOST_AUX_ENV) == "1":
+        # Host-aux fallback child: device-free metrics only.  A failure
+        # leaves its traceback on stderr for the attempt record's tail —
+        # but metrics measured before the failure still go out (the dict
+        # is written incrementally).
+        metrics: dict = {}
+        try:
+            _host_side_metrics(metrics)
+        except Exception as e:  # noqa: BLE001 - partial capture survives
+            print(traceback.format_exc(), file=sys.stderr)
+            metrics["host_aux_error"] = f"{type(e).__name__}: {e}"
+        metrics = {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in metrics.items()
+        }
+        print(json.dumps({"host_aux": metrics}), flush=True)
         return
     try:
         _run()
@@ -483,6 +523,185 @@ def main() -> None:
             traceback_tail=lines[-2:],
         )
         sys.exit(0)
+
+
+def _host_side_metrics(out: dict | None = None) -> dict:
+    """Ingestion + live-serve churn metrics — pure host CPU, no device.
+
+    Shared by the normal measurement child (as part of its aux ladder) and
+    the parent's host-aux fallback: these numbers characterize the
+    informer/store/packer machinery (numpy + Python, never ``jax.devices``),
+    so a dead TPU tunnel must not void them — round 4 lost its churn
+    capture to exactly that.
+
+    Writes each metric into ``out`` AS IT IS PRODUCED (mutating the
+    caller's dict) so an exception mid-way — e.g. in the churn section —
+    preserves the pack timings already measured, matching the aux
+    ladder's "entries measured before the failing section must survive"
+    policy.
+    """
+    import gc
+
+    if out is None:
+        out = {}
+    import kubernetesclustercapacity_tpu as kcc
+    from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+    from kubernetesclustercapacity_tpu.utils.timing import PhaseTimer
+
+    # --- ingestion (SURVEY §7 "snapshot ingestion at 10k nodes"): the
+    # fixture-object walk is the production path (a live 2-List + convert
+    # yields the same fixture schema); pack is timed per semantics over a
+    # 10k-node / ~115k-pod synthetic fixture.
+    timer = PhaseTimer()
+    with timer.phase("fixture_build"):
+        fx10k = synthetic_fixture(10_000, seed=11)
+    # De-intern before timing pack: production ingestion (a JSON file or
+    # live Lists) hands the packers all-unique objects, while the
+    # generator shares container dicts per request shape — pack is timed
+    # on the production shape so generator-side sharing (today's or a
+    # future memoization keyed on it) can never flatter it.  The round
+    # trip just allocated a few hundred MB of small objects; collect now
+    # so the timed packs don't pay its deferred GC.
+    fx10k = json.loads(json.dumps(fx10k))
+    gc.collect()
+    with timer.phase("pack_reference"):
+        kcc.snapshot_from_fixture(fx10k, semantics="reference")
+    with timer.phase("pack_strict"):
+        kcc.snapshot_from_fixture(fx10k, semantics="strict")
+    out["fixture_10k_build_ms"] = timer.phases["fixture_build"] * 1e3
+    out["pack_10k_nodes_ms"] = timer.phases["pack_reference"] * 1e3
+    out["pack_10k_nodes_strict_ms"] = timer.phases["pack_strict"] * 1e3
+    from kubernetesclustercapacity_tpu.native import ingest as _ingest
+
+    # Which pod-walk the timed packs ran (the C extension when a
+    # toolchain exists, the pure-Python loop otherwise).
+    out["pack_native_walk"] = _ingest.available()
+
+    # --- live-serve churn at 10k nodes: watch events applied per-row to
+    # the store while a SnapshotCoalescer publishes full repacks at the
+    # production default cadence (100 ms).  The measured rate is the real
+    # sustained events/sec of the -follow serve path, publication cost
+    # included.
+    from kubernetesclustercapacity_tpu.service.coalesce import (
+        SnapshotCoalescer,
+    )
+    from kubernetesclustercapacity_tpu.store import ClusterStore
+
+    store = ClusterStore(fx10k, semantics="reference")
+    n_events = 2_000
+    pods = fx10k["pods"]
+    churn = [
+        {
+            "type": "MODIFIED",
+            "kind": "Pod",
+            "object": dict(
+                pods[i % len(pods)],
+                containers=[
+                    {
+                        "resources": {
+                            "requests": {
+                                "cpu": f"{(i % 900) + 100}m",
+                                "memory": "256Mi",
+                            },
+                            "limits": {},
+                        }
+                    }
+                ],
+            ),
+        }
+        for i in range(n_events)
+    ]
+    # Apply and publish serialize under one lock, as they do under
+    # follower._lock in the real -follow path — repacks block event
+    # application, so the measured rate includes that contention.
+    import threading as _threading
+
+    store_lock = _threading.Lock()
+
+    def _publish():
+        with store_lock:
+            store.snapshot()
+
+    coal = SnapshotCoalescer(_publish, min_interval_s=0.1)
+    t0 = time.perf_counter()
+    for ev in churn:
+        with store_lock:
+            store.apply_event(ev)
+        coal.notify()
+    coal.stop()  # drains the trailing publish
+    churn_s = time.perf_counter() - t0
+    if coal.last_error is not None:
+        out["churn_error"] = coal.last_error
+    else:
+        out["churn_events_per_sec_10k"] = round(n_events / churn_s)
+        out["churn_repacks"] = coal.flushes
+    return out
+
+
+_HOST_AUX_ENV = "KCC_BENCH_HOST_AUX"
+_HOST_AUX_TIMEOUT_S = max(
+    10.0, _env_num("KCC_BENCH_HOST_AUX_TIMEOUT_S", 600, float)
+)
+
+
+def _run_host_aux_fallback() -> tuple[dict | None, dict]:
+    """When every TPU attempt failed, salvage the host-side metrics.
+
+    Spawns a child with the TPU plugin environment stripped
+    (``PALLAS_AXON_POOL_IPS`` removed so no PJRT plugin registers,
+    ``JAX_PLATFORMS=cpu``) that runs ONLY :func:`_host_side_metrics`.
+    Returns ``(metrics_or_None, attempt_record)``.
+    """
+    t0 = time.monotonic()
+    io = _spawn(
+        [sys.executable, os.path.abspath(__file__)],
+        {
+            _CHILD_ENV: "1",
+            _HOST_AUX_ENV: "1",
+            "JAX_PLATFORMS": "cpu",
+            **_fault_dump_env(_HOST_AUX_TIMEOUT_S),
+        },
+        drop_env=("PALLAS_AXON_POOL_IPS",),
+    )
+    deadline = t0 + _HOST_AUX_TIMEOUT_S
+    metrics = None
+    eof = False
+    while not eof and metrics is None:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        line = io.get(min(remaining, 1.0))
+        if line is None:
+            eof = True
+        elif line:
+            try:
+                candidate = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(candidate, dict) and "host_aux" in candidate:
+                metrics = candidate["host_aux"]
+    if metrics is None:
+        for line in io.drain_nowait():
+            try:
+                candidate = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(candidate, dict) and "host_aux" in candidate:
+                metrics = candidate["host_aux"]
+    record = {
+        "kind": "host-aux",
+        "phase": "done" if metrics is not None else "host-aux",
+        "timeout_s": _HOST_AUX_TIMEOUT_S,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "outcome": (
+            "ok"
+            if metrics is not None
+            else "host-aux child produced no metrics"
+        ),
+        "stderr_tail": io.stderr_tail(),
+    }
+    _kill_group(io.proc)
+    return metrics, record
 
 
 def _run() -> None:
@@ -1375,98 +1594,7 @@ def _run() -> None:
         else:
             ladder["placement_engine_mismatch"] = True
 
-        # --- ingestion (SURVEY §7 "snapshot ingestion at 10k nodes"): the
-        # fixture-object walk is the production path (a live 2-List +
-        # convert yields the same fixture schema); pack is timed per
-        # semantics over a 10k-node / ~115k-pod synthetic fixture.
-        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
-        from kubernetesclustercapacity_tpu.utils.timing import PhaseTimer
-
-        timer = PhaseTimer()
-        with timer.phase("fixture_build"):
-            fx10k = synthetic_fixture(10_000, seed=11)
-        # De-intern before timing pack: production ingestion (a JSON file
-        # or live Lists) hands the packers all-unique objects, while the
-        # generator shares container dicts per request shape — pack is
-        # timed on the production shape so generator-side sharing (today's
-        # or a future memoization keyed on it) can never flatter it.  The
-        # round trip just allocated a few hundred MB of small objects;
-        # collect now so the timed packs don't pay its deferred GC.
-        import gc
-
-        fx10k = json.loads(json.dumps(fx10k))
-        gc.collect()
-        with timer.phase("pack_reference"):
-            kcc.snapshot_from_fixture(fx10k, semantics="reference")
-        with timer.phase("pack_strict"):
-            kcc.snapshot_from_fixture(fx10k, semantics="strict")
-        ladder["fixture_10k_build_ms"] = timer.phases["fixture_build"] * 1e3
-        ladder["pack_10k_nodes_ms"] = timer.phases["pack_reference"] * 1e3
-        ladder["pack_10k_nodes_strict_ms"] = timer.phases["pack_strict"] * 1e3
-        from kubernetesclustercapacity_tpu.native import ingest as _ingest
-
-        # Which pod-walk the timed packs ran (the C extension when a
-        # toolchain exists, the pure-Python loop otherwise).
-        ladder["pack_native_walk"] = _ingest.available()
-
-        # --- live-serve churn at 10k nodes: watch events applied per-row
-        # to the store while a SnapshotCoalescer publishes full repacks at
-        # the production default cadence (100 ms).  The measured rate is
-        # the real sustained events/sec of the -follow serve path,
-        # publication cost included.
-        from kubernetesclustercapacity_tpu.service.coalesce import (
-            SnapshotCoalescer,
-        )
-        from kubernetesclustercapacity_tpu.store import ClusterStore
-
-        store = ClusterStore(fx10k, semantics="reference")
-        n_events = 2_000
-        pods = fx10k["pods"]
-        churn = [
-            {
-                "type": "MODIFIED",
-                "kind": "Pod",
-                "object": dict(
-                    pods[i % len(pods)],
-                    containers=[
-                        {
-                            "resources": {
-                                "requests": {
-                                    "cpu": f"{(i % 900) + 100}m",
-                                    "memory": "256Mi",
-                                },
-                                "limits": {},
-                            }
-                        }
-                    ],
-                ),
-            }
-            for i in range(n_events)
-        ]
-        # Apply and publish serialize under one lock, as they do under
-        # follower._lock in the real -follow path — repacks block event
-        # application, so the measured rate includes that contention.
-        import threading as _threading
-
-        store_lock = _threading.Lock()
-
-        def _publish():
-            with store_lock:
-                store.snapshot()
-
-        coal = SnapshotCoalescer(_publish, min_interval_s=0.1)
-        t0 = time.perf_counter()
-        for ev in churn:
-            with store_lock:
-                store.apply_event(ev)
-            coal.notify()
-        coal.stop()  # drains the trailing publish
-        churn_s = time.perf_counter() - t0
-        if coal.last_error is not None:
-            ladder["churn_error"] = coal.last_error
-        else:
-            ladder["churn_events_per_sec_10k"] = round(n_events / churn_s)
-            ladder["churn_repacks"] = coal.flushes
+        _host_side_metrics(ladder)
 
     except Exception as e:  # noqa: BLE001 - aux must never kill the bench
         # MERGE the error: entries measured before the failing section
@@ -1506,11 +1634,14 @@ def _run() -> None:
         fast_per_sweep = None
     p50 = fast_per_sweep if fast_per_sweep is not None else exact_per_sweep
     if p50 <= 0:
-        # Both paths jitter-voided: never publish a nonsense latency.
+        # Both paths jitter-voided: never publish a nonsense latency —
+        # but the aux ladder (minutes of measured entries, host metrics
+        # included) rides along so the parent need not re-measure it.
         _fail(
             "non-positive timing slope (dispatch jitter)",
             exact_int64_per_sweep_ms=round(exact_per_sweep, 3),
             dispatch_floor_ms=round(dispatch_floor_ms, 3),
+            **ladder,
         )
         return
     scenarios_per_sec = n_scenarios / (p50 / 1e3)
