@@ -1525,6 +1525,7 @@ def _run() -> None:
         from kubernetesclustercapacity_tpu.ops.placement import (
             place_replicas,
             place_replicas_bulk,
+            place_replicas_trace,
         )
 
         place_node_args = (
@@ -1593,6 +1594,31 @@ def _run() -> None:
             ladder["placement_bulk_ms"] = min(ts_bulk)
         else:
             ladder["placement_engine_mismatch"] = True
+        # Closed-form TRACE engine: the scan's full per-replica order
+        # without the scan (host math) — the production route for
+        # identical replicas at scale; counts cross-checked against the
+        # bulk engine per request pair.
+        ts_trace = []
+        trace_counts = {}
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for cr, mr in place_reqs:
+                _, trace_counts[(cr, mr)], _ = place_replicas_trace(
+                    *place_node_args, cr, mr, **place_kw
+                )
+            ts_trace.append(
+                (time.perf_counter() - t0) * 1e3 / len(place_reqs)
+            )
+        # Parity check OUTSIDE the timed window (the bulk metric's check
+        # is outside its window too — keep the crossover numbers fair).
+        trace_ok = all(
+            np.array_equal(trace_counts[k], bulk_by_req[k])
+            for k in trace_counts
+        )
+        if trace_ok:
+            ladder["placement_trace_1k_ms"] = min(ts_trace)
+        else:
+            ladder["placement_trace_mismatch"] = True
 
         _host_side_metrics(ladder)
 

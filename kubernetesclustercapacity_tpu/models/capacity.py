@@ -150,7 +150,7 @@ class PlacementResult:
     node_names: list[str]
     policy: str
     requested: int = 0
-    engine: str = "scan"  # "scan" (lax.scan) or "bulk" (closed form)
+    engine: str = "scan"  # "scan" (lax.scan), "trace" or "bulk" (closed form)
 
     @property
     def placed(self) -> int:
@@ -354,25 +354,31 @@ class CapacityModel:
         ``assignments`` picks the engine:
 
         * ``True``  — the ``lax.scan`` scheduler; result carries the
-          per-replica assignment order.
+          per-replica assignment order, computed on-device.
+        * ``"trace"`` — the closed-form trace engine
+          (:func:`..ops.placement.place_replicas_trace`): the scan's
+          exact per-replica order in O(R log R) host math, no scan.
+          Raises for specs it cannot serve (extended resources,
+          zero requests).
         * ``False`` — the closed-form bulk engine
           (:func:`..ops.placement.place_replicas_bulk`): identical
           per-node counts in O(N) instead of R dependent scan steps;
           ``result.assignments`` is ``None``.
         * ``"auto"`` (default) — scan up to :data:`PLACE_SCAN_MAX`
-          replicas, bulk beyond (1k replicas on 10k nodes was 1k
-          sequential argmin steps; nobody reads a 1k-row order table).
+          replicas; beyond that the trace engine when eligible (same
+          order, closed form), else bulk (counts only).
 
         A spec with ``extended_requests`` routes to the R-resource engines
         (:func:`..ops.placement.place_replicas_multi` / ``_bulk_multi``)
         over the snapshot's extended columns — same policies, same
-        engine-selection rule.
+        engine-selection rule (no trace engine there yet).
         """
         from kubernetesclustercapacity_tpu.ops.placement import (
             place_replicas,
             place_replicas_bulk,
             place_replicas_bulk_multi,
             place_replicas_multi,
+            place_replicas_trace,
         )
 
         self._check_extensions(
@@ -415,11 +421,31 @@ class CapacityModel:
             bulk_ok = (
                 spec.cpu_request_milli > 0 and spec.mem_request_bytes > 0
             )
-        use_bulk = (
-            assignments is False
-            or (assignments == "auto" and spec.replicas > self.PLACE_SCAN_MAX)
-        ) and bulk_ok
-        if use_bulk:
+        # The trace engine serves the 2-resource positive-request family
+        # only (its closed form is proven there); extended or degenerate
+        # specs keep the scan/bulk routes.
+        trace_ok = bulk_ok and not spec.extended_requests
+        if assignments == "trace":
+            if not trace_ok:
+                raise ValueError(
+                    "trace engine needs positive cpu/mem requests and no "
+                    "extended resources; use assignments=True (scan) or "
+                    "False (bulk counts)"
+                )
+            engine = "trace"
+        elif assignments is False and bulk_ok:
+            engine = "bulk"
+        elif (
+            assignments == "auto"
+            and spec.replicas > self.PLACE_SCAN_MAX
+            and bulk_ok
+        ):
+            engine = "trace" if trace_ok else "bulk"
+        else:
+            engine = "scan"
+        if engine == "trace":
+            order, per_node, _ = place_replicas_trace(*args, **kwargs)
+        elif engine == "bulk":
             per_node, _ = bulk_fn(*args, **kwargs)
             order = None
         else:
@@ -431,7 +457,7 @@ class CapacityModel:
             node_names=list(snap.names),
             policy=policy,
             requested=spec.replicas,
-            engine="bulk" if use_bulk else "scan",
+            engine=engine,
         )
 
     def sweep(

@@ -36,6 +36,7 @@ import numpy as np
 __all__ = [
     "place_replicas",
     "place_replicas_bulk",
+    "place_replicas_trace",
     "place_replicas_python",
     "place_replicas_multi",
     "place_replicas_bulk_multi",
@@ -53,6 +54,24 @@ def _normalized_headroom(hc, hm, alloc_cpu, alloc_mem):
         den > 0, num.astype(jnp.float64) / den.astype(jnp.float64), 0.0
     )
     return safe(hc, alloc_cpu) + safe(hm, alloc_mem)
+
+
+def _np_score_after(hc0, hm0, ac, am, c, m, j):
+    """``score_after(j)`` — the f64 score after the ``j``-th placement —
+    in numpy, elementwise over broadcastable inputs.
+
+    The ONE definition of the host-side score math: the bulk engine's
+    order/waterline search and the trace engine's keys both call it, so
+    their f64 values are bit-identical to each other (and to the scan's
+    ``_normalized_headroom`` epilogue: same int64 headroom subtract, two
+    guarded divides, left-to-right sum)."""
+    j1 = np.asarray(j, dtype=np.int64) + 1
+    num_c = (hc0 - j1 * c).astype(np.float64)
+    num_m = (hm0 - j1 * m).astype(np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        sc = np.where(ac > 0, num_c / ac.astype(np.float64), 0.0)
+        sm = np.where(am > 0, num_m / am.astype(np.float64), 0.0)
+    return sc + sm
 
 
 @partial(jax.jit, static_argnames=("n_replicas", "policy", "max_per_node"))
@@ -108,31 +127,65 @@ def place_replicas(
     n = hc0.shape[0]
     idx_arange = jnp.arange(n)
 
-    def body(state, _):
-        hc, hm, slots, mine = state
-        feasible = (hc >= c) & (hm >= m) & (slots >= 1) & eligible
-        if max_per_node is not None:
-            feasible = feasible & (mine < max_per_node)
+    # Incremental-score scan: each step changes ONE node's state, so the
+    # [N] score vector is carried (pre-masked: infeasible lanes hold +inf)
+    # and only the placed lane is recomputed, with scalar math.  The
+    # original formulation recomputed two [N]-wide f64 divides per step —
+    # on TPU f64 is software-emulated, so at R=1k replicas those divides
+    # dominated the whole engine (BENCH r03: 51 ms / 1k placements).
+    # Bit-exactness is by construction: untouched lanes keep the fl()
+    # value a full recompute would reproduce (their state is unchanged),
+    # and the placed lane's scalar ops are the same sequence (int64
+    # subtract, two f64 divides, left-to-right sum) the vector form runs.
+    def scalar_score(i, hc_i, hm_i):
+        """Policy-signed after-placement score of one node —
+        ``_normalized_headroom`` applied to the single updated lane (it is
+        shape-polymorphic, so vector seed and scalar rescore share one
+        definition and cannot drift apart)."""
         if policy == "first-fit":
-            score = idx_arange.astype(jnp.float64)
-        else:
-            after = _normalized_headroom(hc - c, hm - m, alloc_cpu, alloc_mem)
-            score = after if policy == "best-fit" else -after
-        score = jnp.where(feasible, score, jnp.inf)
-        idx = jnp.argmin(score)
-        ok = feasible[idx]
-        one_hot = (idx_arange == idx) & ok
-        hc = hc - jnp.where(one_hot, c, 0)
-        hm = hm - jnp.where(one_hot, m, 0)
-        one = jnp.where(one_hot, jnp.int64(1), jnp.int64(0))
-        slots = slots - one
-        mine = mine + one
+            return i.astype(jnp.float64)
+        after = _normalized_headroom(
+            hc_i - c, hm_i - m, alloc_cpu[i], alloc_mem[i]
+        )
+        return after if policy == "best-fit" else -after
+
+    feasible0 = (hc0 >= c) & (hm0 >= m) & (slots0 >= 1) & eligible
+    if max_per_node is not None and max_per_node <= 0:
+        # Static degenerate cap: no node may take even one replica.
+        feasible0 = jnp.zeros_like(feasible0)
+    if policy == "first-fit":
+        score0 = idx_arange.astype(jnp.float64)
+    else:
+        after0 = _normalized_headroom(hc0 - c, hm0 - m, alloc_cpu, alloc_mem)
+        score0 = after0 if policy == "best-fit" else -after0
+    masked0 = jnp.where(feasible0, score0, jnp.inf)
+
+    def body(state, _):
+        hc, hm, slots, mine, masked = state
+        idx = jnp.argmin(masked)
+        ok = jnp.isfinite(masked[idx])
+        dec_c = jnp.where(ok, c, jnp.int64(0))
+        dec_m = jnp.where(ok, m, jnp.int64(0))
+        one = jnp.where(ok, jnp.int64(1), jnp.int64(0))
+        hc = hc.at[idx].add(-dec_c)
+        hm = hm.at[idx].add(-dec_m)
+        slots = slots.at[idx].add(-one)
+        mine = mine.at[idx].add(one)
+        # Scalar re-feasibility + re-score of the single updated lane.
+        hc_i, hm_i = hc[idx], hm[idx]
+        feas_i = (
+            (hc_i >= c) & (hm_i >= m) & (slots[idx] >= 1) & eligible[idx]
+        )
+        if max_per_node is not None:
+            feas_i = feas_i & (mine[idx] < max_per_node)
+        new_val = jnp.where(feas_i, scalar_score(idx, hc_i, hm_i), jnp.inf)
+        masked = masked.at[idx].set(jnp.where(ok, new_val, masked[idx]))
         assignment = jnp.where(ok, idx.astype(jnp.int64), jnp.int64(-1))
-        return (hc, hm, slots, mine), assignment
+        return (hc, hm, slots, mine, masked), assignment
 
     mine0 = jnp.zeros(n, dtype=jnp.int64)
     _, assignments = jax.lax.scan(
-        body, (hc0, hm0, slots0, mine0), None, length=n_replicas
+        body, (hc0, hm0, slots0, mine0, masked0), None, length=n_replicas
     )
     counts = jnp.sum(
         (assignments[:, None] == idx_arange[None, :]), axis=0, dtype=jnp.int64
@@ -251,19 +304,10 @@ def place_replicas_bulk(
     def score_after(j):
         """Score after the ``j``-th placement on each node — bit-identical
         to the scan step's ``_normalized_headroom(hc - c, hm - m, ...)``
-        when the node has already taken ``j`` replicas (int64 headroom
-        math, then two f64 divides, summed in the same order).  ``j`` may
-        be a scalar or an ``[N]`` array."""
-        num_c = (hc0 - (np.asarray(j, dtype=np.int64) + 1) * c).astype(
-            np.float64
-        )
-        num_m = (hm0 - (np.asarray(j, dtype=np.int64) + 1) * m).astype(
-            np.float64
-        )
-        with np.errstate(divide="ignore", invalid="ignore"):
-            sc = np.where(ac > 0, num_c / ac.astype(np.float64), 0.0)
-            sm = np.where(am > 0, num_m / am.astype(np.float64), 0.0)
-        return sc + sm
+        when the node has already taken ``j`` replicas.  ``j`` may be a
+        scalar or an ``[N]`` array.  Shared with the trace engine via
+        :func:`_np_score_after`."""
+        return _np_score_after(hc0, hm0, ac, am, c, m, j)
 
     if policy == "best-fit":
         s0 = score_after(0)
@@ -338,6 +382,95 @@ def place_replicas_bulk(
     before = np.concatenate(([0], np.cumsum(at)[:-1]))
     take = np.clip(r - n_gt - before, 0, at)
     return strict + take, r
+
+
+def place_replicas_trace(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_req: int,
+    mem_req: int,
+    *,
+    n_replicas: int,
+    policy: str = "first-fit",
+    node_mask=None,
+    max_per_node: int | None = None,
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Closed-form per-replica assignment SEQUENCE — the scan's full trace
+    without the scan.
+
+    Returns ``(assignments[n_replicas], counts[N], placed)`` where
+    ``assignments`` is element-for-element what :func:`place_replicas`
+    emits (``-1`` once nothing fits).  :func:`place_replicas_bulk` proves
+    the per-node counts collapse to closed form for identical replicas;
+    the placement ORDER collapses too:
+
+    * ``first-fit`` / ``best-fit``: the greedy argmin stays on the filling
+      node until exhausted (the bulk engine's trajectory argument), so the
+      trace is each fill-order node's index repeated ``counts`` times;
+    * ``spread``: the greedy walk is a k-way head merge of per-node
+      non-increasing key sequences (``key(i, t) = score_after(t)`` for the
+      ``t+1``-th placement on node ``i``), so the trace is the placed
+      multiset sorted by (key desc, node index asc, t asc) — ties resolve
+      to the lowest index with that node's plateau exhausted first,
+      exactly the scan's ``argmin`` rule.
+
+    O(R log R) host math; exactness is pinned against the scan by
+    ``tests/test_placement.py`` (all policies, tie grids, boundary R).
+    Use this (or :func:`place_replicas_bulk` when only counts matter)
+    for identical replicas; the ``lax.scan`` engine remains for on-device
+    composition into jitted pipelines.
+    """
+    counts, placed = place_replicas_bulk(
+        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
+        healthy, cpu_req, mem_req, n_replicas=n_replicas, policy=policy,
+        node_mask=node_mask, max_per_node=max_per_node,
+    )
+    r = int(n_replicas)
+    assignments = np.full(r, -1, dtype=np.int64)
+    if placed == 0:
+        return assignments, counts, 0
+    idx = np.arange(counts.shape[0])
+
+    if policy in ("first-fit", "best-fit"):
+        if policy == "first-fit":
+            order = idx
+        else:
+            ac = np.asarray(alloc_cpu, dtype=np.int64)
+            am = np.asarray(alloc_mem, dtype=np.int64)
+            hc0 = ac - np.asarray(used_cpu, dtype=np.int64)
+            hm0 = am - np.asarray(used_mem, dtype=np.int64)
+            s0 = _np_score_after(
+                hc0, hm0, ac, am, int(cpu_req), int(mem_req), 0
+            )
+            order = np.lexsort((idx, s0))
+        order = order[counts[order] > 0]
+        assignments[:placed] = np.repeat(order, counts[order])
+        return assignments, counts, placed
+
+    # spread: expand each placed node's (i, t) elements, key them with the
+    # SAME f64 score math the scan compares, and sort by (key desc, index
+    # asc, t asc).  Non-increasing per-node sequences make the multiset
+    # sort equal to the greedy head-merge.
+    ac = np.asarray(alloc_cpu, dtype=np.int64)
+    am = np.asarray(alloc_mem, dtype=np.int64)
+    hc0 = ac - np.asarray(used_cpu, dtype=np.int64)
+    hm0 = am - np.asarray(used_mem, dtype=np.int64)
+    i_arr = np.repeat(idx, counts)
+    # t = 0..counts_i-1 within each node, in one vectorized ramp.
+    ends = np.cumsum(counts)
+    t_arr = np.arange(placed) - np.repeat(ends - counts, counts)
+    key = _np_score_after(
+        hc0[i_arr], hm0[i_arr], ac[i_arr], am[i_arr],
+        int(cpu_req), int(mem_req), t_arr,
+    )
+    order = np.lexsort((t_arr, i_arr, -key))
+    assignments[:placed] = i_arr[order]
+    return assignments, counts, placed
 
 
 def place_replicas_python(
@@ -471,34 +604,64 @@ def place_replicas_multi(
             acc = acc + term
         return acc
 
+    # Incremental-score scan, as in :func:`place_replicas`: the [N]
+    # pre-masked score vector rides in the carry and only the placed
+    # lane is recomputed (scalar left-fold over the R rows, same order
+    # as the vector form — R wide f64 divides per step become R scalar
+    # ones).  Bit-exact vs the full recompute for the same reasons.
+    def scalar_score(i, h_col):
+        if policy == "first-fit":
+            return i.astype(jnp.float64)
+        acc = jnp.float64(0.0)
+        for r in range(n_res):  # static unroll: row order = caller order
+            acc = acc + jnp.where(
+                alloc_rn[r, i] > 0,
+                (h_col[r] - sub[r, 0]).astype(jnp.float64)
+                / alloc_rn[r, i].astype(jnp.float64),
+                0.0,
+            )
+        return acc if policy == "best-fit" else -acc
+
+    feasible0 = (
+        jnp.all(~active[:, None] | (h0 >= reqs[:, None]), axis=0)
+        & (slots0 >= 1)
+        & eligible
+    )
+    if max_per_node is not None and max_per_node <= 0:
+        # Static degenerate cap: no node may take even one replica.
+        feasible0 = jnp.zeros_like(feasible0)
+    if policy == "first-fit":
+        score0 = idx_arange.astype(jnp.float64)
+    else:
+        after0 = score_of(h0)
+        score0 = after0 if policy == "best-fit" else -after0
+    masked0 = jnp.where(feasible0, score0, jnp.inf)
+
     def body(state, _):
-        h, slots, mine = state
-        feasible = (
-            jnp.all(~active[:, None] | (h >= reqs[:, None]), axis=0)
-            & (slots >= 1)
-            & eligible
+        h, slots, mine, masked = state
+        idx = jnp.argmin(masked)
+        ok = jnp.isfinite(masked[idx])
+        dec = jnp.where(ok, sub[:, 0], jnp.int64(0))  # [R]
+        one = jnp.where(ok, jnp.int64(1), jnp.int64(0))
+        h = h.at[:, idx].add(-dec)
+        slots = slots.at[idx].add(-one)
+        mine = mine.at[idx].add(one)
+        h_col = h[:, idx]  # [R]
+        feas_i = (
+            jnp.all(~active | (h_col >= reqs))
+            & (slots[idx] >= 1)
+            & eligible[idx]
         )
         if max_per_node is not None:
-            feasible = feasible & (mine < max_per_node)
-        if policy == "first-fit":
-            score = idx_arange.astype(jnp.float64)
-        else:
-            after = score_of(h)
-            score = after if policy == "best-fit" else -after
-        score = jnp.where(feasible, score, jnp.inf)
-        idx = jnp.argmin(score)
-        ok = feasible[idx]
-        one_hot = (idx_arange == idx) & ok
-        h = h - jnp.where(one_hot[None, :], sub, 0)
-        one = jnp.where(one_hot, jnp.int64(1), jnp.int64(0))
-        slots = slots - one
-        mine = mine + one
+            feas_i = feas_i & (mine[idx] < max_per_node)
+        new_val = jnp.where(feas_i, scalar_score(idx, h_col), jnp.inf)
+        masked = masked.at[idx].set(jnp.where(ok, new_val, masked[idx]))
         assignment = jnp.where(ok, idx.astype(jnp.int64), jnp.int64(-1))
-        return (h, slots, mine), assignment
+        return (h, slots, mine, masked), assignment
 
     mine0 = jnp.zeros(n, dtype=jnp.int64)
     _, assignments = jax.lax.scan(
-        body, (h0, slots0, mine0), None, length=n_replicas
+        body, (h0, slots0, mine0, masked0), None, length=n_replicas
     )
     counts = jnp.sum(
         (assignments[:, None] == idx_arange[None, :]), axis=0, dtype=jnp.int64

@@ -13,6 +13,7 @@ from kubernetesclustercapacity_tpu.ops.placement import (
     place_replicas,
     place_replicas_bulk,
     place_replicas_python,
+    place_replicas_trace,
 )
 from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
 
@@ -196,6 +197,52 @@ class TestBulkParity:
             np.testing.assert_array_equal(
                 c_bulk, np.asarray(c_py), err_msg=f"r={r}")
 
+    def test_trace_matches_oracle_sequence_through_boundaries(self):
+        """The closed-form TRACE must reproduce the scan's per-replica
+        assignment sequence element-for-element (not just counts) — the
+        exactness claim of ``place_replicas_trace``'s docstring."""
+        for policy in POLICIES:
+            for trial in range(24):
+                args, mask, mpn = _random_cluster(trial)
+                kw = dict(policy=policy, node_mask=mask, max_per_node=mpn)
+                _, c_full = place_replicas_python(*args, n_replicas=200, **kw)
+                total = sum(c_full)
+                for r in sorted({0, 1, total // 2, max(total - 1, 0), total,
+                                 total + 3}):
+                    a_py, c_py = place_replicas_python(
+                        *args, n_replicas=r, **kw
+                    )
+                    a_tr, c_tr, placed = place_replicas_trace(
+                        *args, n_replicas=r, **kw
+                    )
+                    np.testing.assert_array_equal(
+                        a_tr, np.asarray(a_py, dtype=np.int64),
+                        err_msg=f"{policy} trial={trial} r={r}")
+                    np.testing.assert_array_equal(c_tr, np.asarray(c_py))
+                    assert placed == min(r, total)
+
+    @pytest.mark.parametrize("policy", ("best-fit", "spread"))
+    def test_trace_adversarial_exact_f64_ties(self, policy):
+        """Same collided-score lattice as the counts test: the trace's
+        (key desc, index asc, plateau-consecutive) sort must still walk
+        nodes exactly as the scan's argmin tie rule does."""
+        n = 6
+        ac = np.full(n, 4000, dtype=np.int64)
+        am = np.full(n, 4096, dtype=np.int64)
+        uc = np.zeros(n, dtype=np.int64)
+        um = np.zeros(n, dtype=np.int64)
+        ap = np.full(n, 5, dtype=np.int64)
+        pc = np.zeros(n, dtype=np.int64)
+        healthy = np.ones(n, dtype=bool)
+        args = (ac, am, ap, uc, um, pc, healthy, 500, 512)
+        for r in range(0, n * 5 + 2):
+            a_py, _ = place_replicas_python(*args, n_replicas=r,
+                                            policy=policy)
+            a_tr, _, _ = place_replicas_trace(*args, n_replicas=r,
+                                              policy=policy)
+            np.testing.assert_array_equal(
+                a_tr, np.asarray(a_py, dtype=np.int64), err_msg=f"r={r}")
+
     def test_spread_waterline_plateau_partial_fill(self):
         """Staggered used-resources: nodes hit the waterline mid-sequence
         with multi-element plateaus; the cumsum tie fill must hand the
@@ -269,14 +316,25 @@ class TestModelAndService:
         assert bulk.all_placed == scan.all_placed
         # auto: small R keeps the scan...
         assert model.place(spec).engine == "scan"
-        # ...and R above the threshold switches to bulk.
+        # ...and R above the threshold switches to the closed-form trace
+        # engine — same per-replica order, no scan.
         model.PLACE_SCAN_MAX = 10
         auto = model.place(spec, policy="spread")
-        assert auto.engine == "bulk"
+        assert auto.engine == "trace" and auto.assignments is not None
+        scan_big = model.place(spec, policy="spread", assignments=True)
+        np.testing.assert_array_equal(auto.per_node, scan_big.per_node)
         np.testing.assert_array_equal(
-            auto.per_node,
-            model.place(spec, policy="spread", assignments=True).per_node,
+            auto.assignments, np.asarray(scan_big.assignments)
         )
+        # Explicit trace engine; ineligible specs fail loudly.
+        forced = model.place(spec, policy="spread", assignments="trace")
+        assert forced.engine == "trace"
+        with pytest.raises(ValueError, match="trace engine"):
+            model.place(
+                PodSpec(cpu_request_milli=0, mem_request_bytes=0,
+                        replicas=3),
+                assignments="trace",
+            )
 
     def test_model_place_unknown_extended_column_errors(self, snap):
         # Placement with extended requests is supported (round 4); a
