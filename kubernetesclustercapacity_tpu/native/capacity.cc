@@ -16,10 +16,11 @@
 //    gap, value <= 0 or no suffix -> error, int64 truncation with the
 //    amd64 out-of-range convention (INT64_MIN), underscore digit
 //    separators accepted between digits (Go 1.13+/Python float()).
-//    Divergences (documented, same as the Python codec): inf/nan/hex
-//    spellings are rejected; only ASCII letters split the suffix; the
-//    whitespace trim is ASCII-only (exotic Unicode spaces that Go's
-//    TrimSpace would strip are rejected here and by honest fixtures).
+//    The whitespace trim is Go's exact TrimSpace set (Unicode
+//    White_Space, UTF-8 aware — go_space_len below), matching the Python
+//    codec's _GO_SPACE_CHARS.  Divergences (documented, same as the
+//    Python codec): inf/nan/hex spellings are rejected; only ASCII
+//    letters split the suffix.
 //  * kcc_fit_arrays / kcc_sweep: mode 0 = reference (conditional pod-cap
 //    overwrite, may go negative), mode 1 = strict (3-way min, clamp at 0,
 //    healthy mask).  A zero divisor reached behind a positive headroom
@@ -87,12 +88,52 @@ uint64_t kcc_cpu_to_milli_n(const char* cpu, int64_t len_in) {
 
 // bytefmt.ToBytes semantics; returns 0 and stores into *out on success,
 // -1 on the reference's invalid-byte-quantity error.
+// Byte length of one Go-White_Space rune at s[i..e) in UTF-8, else 0 —
+// the exact set Go's strings.TrimSpace trims (unicode.IsSpace ==
+// White_Space: ASCII \t\n\v\f\r space, U+0085, U+00A0, U+1680,
+// U+2000-200A, U+2028, U+2029, U+202F, U+205F, U+3000).  C isspace()
+// was wrong in both directions: it misses every non-ASCII space and the
+// multi-byte checks below can never false-match mid-rune (space runes
+// start with 0xC2/0xE1/0xE2/0xE3, never a continuation byte).
+static size_t go_space_len(const std::string& s, size_t i, size_t e) {
+  unsigned char c0 = (unsigned char)s[i];
+  if (c0 == 0x09 || c0 == 0x0a || c0 == 0x0b || c0 == 0x0c ||
+      c0 == 0x0d || c0 == 0x20)
+    return 1;
+  if (i + 1 < e && c0 == 0xC2) {
+    unsigned char c1 = (unsigned char)s[i + 1];
+    if (c1 == 0x85 || c1 == 0xA0) return 2;  // U+0085, U+00A0
+  }
+  if (i + 2 < e) {
+    unsigned char c1 = (unsigned char)s[i + 1];
+    unsigned char c2 = (unsigned char)s[i + 2];
+    if (c0 == 0xE1 && c1 == 0x9A && c2 == 0x80) return 3;  // U+1680
+    if (c0 == 0xE2 && c1 == 0x80 &&
+        ((c2 >= 0x80 && c2 <= 0x8A) ||  // U+2000-200A
+         c2 == 0xA8 || c2 == 0xA9 ||    // U+2028, U+2029
+         c2 == 0xAF))                   // U+202F
+      return 3;
+    if (c0 == 0xE2 && c1 == 0x81 && c2 == 0x9F) return 3;  // U+205F
+    if (c0 == 0xE3 && c1 == 0x80 && c2 == 0x80) return 3;  // U+3000
+  }
+  return 0;
+}
+
 int kcc_to_bytes_n(const char* s_in, int64_t len_in, int64_t* out) {
   std::string s(s_in, (size_t)len_in);
-  // TrimSpace + ToUpper.
+  // Go strings.TrimSpace (White_Space runes, UTF-8 aware) + ToUpper.
   size_t b = 0, e = s.size();
-  while (b < e && isspace((unsigned char)s[b])) b++;
-  while (e > b && isspace((unsigned char)s[e - 1])) e--;
+  for (size_t l; b < e && (l = go_space_len(s, b, e)) > 0;) b += l;
+  for (bool more = true; more && e > b;) {
+    more = false;
+    for (size_t l = 1; l <= 3 && l <= e - b; l++) {
+      if (go_space_len(s, e - l, e) == l) {
+        e -= l;
+        more = true;
+        break;
+      }
+    }
+  }
   s = s.substr(b, e - b);
   for (auto& c : s) c = (char)toupper((unsigned char)c);
 

@@ -33,6 +33,7 @@ ingestion, never inside the TPU hot loop.
 from __future__ import annotations
 
 import functools
+import unicodedata
 from dataclasses import dataclass
 from fractions import Fraction
 
@@ -137,19 +138,80 @@ def cpu_parse_error_payload(cpu: str) -> str | None:
     return None if go_atoi(body) is not None else body
 
 
+# Go ``unicode.IsSpace`` == the Unicode White_Space property — the exact
+# set ``strings.TrimSpace`` trims (``bytes.go:76``).  Python's bare
+# ``str.strip()`` trims a SUPERSET (U+001C–U+001F, the ASCII separator
+# controls, are Python-space but not Go-space), so the reference codec
+# trims with this explicit set to stay byte-compatible: ``"\x1c100MB"``
+# must FAIL to parse, as it does in Go.
+_GO_SPACE_CHARS = (
+    "\t\n\v\f\r \x85\xa0\u1680"
+    "\u2000\u2001\u2002\u2003\u2004\u2005\u2006\u2007\u2008"
+    "\u2009\u200a\u2028\u2029\u202f\u205f\u3000"
+)
+
+
+_GO_QUOTE_ESCAPES = {
+    "\a": "\\a", "\b": "\\b", "\f": "\\f", "\n": "\\n",
+    "\r": "\\r", "\t": "\\t", "\v": "\\v",
+    "\\": "\\\\", '"': '\\"',
+}
+
+
+def _go_is_print(ch: str) -> bool:
+    """Go ``unicode.IsPrint``: letters, marks, numbers, punctuation,
+    symbols, and the ASCII space — category classes L/M/N/P/S plus
+    U+0020 (doc of ``unicode.IsPrint``; graphic minus the other spaces).
+    """
+    if ch == " ":
+        return True
+    return unicodedata.category(ch)[0] in "LMNPS"
+
+
+def go_quote(s: str) -> str:
+    """Go ``strconv.Quote`` — the ``%q`` verb's quoting, byte-exact.
+
+    The reference's fatal replicas line embeds ``strconv.Atoi``'s error,
+    whose ``parsing %q`` quotes the input: double-quote wrapping, the
+    standard single-char escapes, ``\\xhh`` for other non-printable
+    ASCII, ``\\uhhhh`` / ``\\Uhhhhhhhh`` for non-printable non-ASCII
+    (``unicode.IsPrint`` decides).  Invalid UTF-8 bytes in argv arrive
+    here as surrogate escapes (PEP 383) and print as ``\\xhh`` of the
+    original byte, exactly as Go quotes invalid bytes.
+    """
+    out = ['"']
+    for ch in s:
+        if ch in _GO_QUOTE_ESCAPES:
+            out.append(_GO_QUOTE_ESCAPES[ch])
+        elif _go_is_print(ch):
+            out.append(ch)
+        else:
+            cp = ord(ch)
+            if 0xDC80 <= cp <= 0xDCFF:  # PEP 383 surrogate: a raw byte
+                out.append(f"\\x{cp - 0xDC00:02x}")
+            elif cp < 0x80:
+                out.append(f"\\x{cp:02x}")
+            elif cp < 0x10000:
+                out.append(f"\\u{cp:04x}")
+            else:
+                out.append(f"\\U{cp:08x}")
+    out.append('"')
+    return "".join(out)
+
+
 def go_atoi_error(s: str) -> str:
     """The ``strconv.Atoi`` error text Go prints for a failed parse.
 
     Byte-parity helper for the reference's fatal replicas line
     (``ClusterCapacity.go:81``): syntactically-valid digits that overflow
-    int64 are a range error, anything else is a syntax error.  (Go quotes
-    the input with ``%q``; plain double quotes here — control characters in
-    flag values are out of scope.)
+    int64 are a range error, anything else is a syntax error.  The input
+    is quoted with full ``%q`` semantics (:func:`go_quote`), so control
+    characters and non-printables in flag values match Go byte-for-byte.
     """
     body = s[1:] if s[:1] in "+-" else s
     if body and body.isascii() and body.isdigit():
-        return f'strconv.Atoi: parsing "{s}": value out of range'
-    return f'strconv.Atoi: parsing "{s}": invalid syntax'
+        return f"strconv.Atoi: parsing {go_quote(s)}: value out of range"
+    return f"strconv.Atoi: parsing {go_quote(s)}: invalid syntax"
 
 
 @functools.lru_cache(maxsize=_PARSE_CACHE_SIZE)
@@ -222,7 +284,8 @@ def to_bytes_reference(s: str) -> int:
 
     Raises :class:`QuantityParseError` with the reference's error message.
     """
-    s = s.strip().upper()
+    # Go's TrimSpace set exactly — not Python's broader str.strip() set.
+    s = s.strip(_GO_SPACE_CHARS).upper()
 
     letter_idx = -1
     for i, ch in enumerate(s):

@@ -166,6 +166,16 @@ class TestCli:
             'parsing "ten": invalid syntax ...exiting\n'
         )
 
+    def test_bad_replicas_control_char_quoted_like_go(self, capsys):
+        """%q parity: a control character in the flag value prints as
+        Go's \\xhh escape inside the quoted parse input."""
+        rc = main(["-snapshot", KIND, "-replicas=\x01en"])
+        assert rc == 1
+        assert capsys.readouterr().out == (
+            'ERROR : Invalid input replicas = 0 strconv.Atoi: '
+            'parsing "\\x01en": invalid syntax ...exiting\n'
+        )
+
     def test_replicas_range_error_line_parity(self, capsys):
         # Go's Atoi returns the int64-CLAMPED value alongside ErrRange, and
         # the reference prints that value — not 0 (only syntax errors

@@ -152,6 +152,37 @@ class TestAdversarialParity:
         else:
             assert native.to_bytes(s) == want
 
+    @pytest.mark.parametrize(
+        "s",
+        [
+            " 100MB ",   # NBSP: Go TrimSpace strips it
+            "　250mb　",   # ideographic space
+            "  1K",           # line separator + ASCII space
+            "\x85 2g",             # U+0085 NEL (C2 85 in UTF-8)
+            "\x1c100MB",           # ASCII file separator: NOT Go-space
+            "\x1f100MB",           # unit separator: NOT Go-space
+            "​100MB",         # zero-width space: NOT White_Space
+        ],
+    )
+    def test_go_trimspace_parity(self, s):
+        """Both codecs must trim EXACTLY Go's White_Space set
+        (``bytes.go:76``): exotic Unicode spaces parse, while Python-only
+        whitespace (U+001C-1F) and zero-width space fail as in Go."""
+        try:
+            want = to_bytes_reference(s)
+        except QuantityParseError:
+            with pytest.raises(ValueError):
+                native.to_bytes(s)
+        else:
+            assert native.to_bytes(s) == want
+
+    def test_go_trimspace_go_space_only_cases(self):
+        # Pin the direction of each parity case, not just agreement.
+        assert to_bytes_reference(" 100MB") == 100 * 1024 * 1024
+        for bad in ("\x1c100MB", "​100MB"):
+            with pytest.raises(QuantityParseError):
+                to_bytes_reference(bad)
+
     def test_embedded_nul_parity(self):
         s = "12\x003"
         assert native.cpu_to_milli(s) == cpu_to_milli_reference(s) == 0

@@ -246,3 +246,48 @@ class TestAsciiOnlyParseFloat:
         assert float("١٥") == 15.0  # the trap this guards
         with _pytest.raises(QuantityParseError):
             to_bytes_reference("١٥MB")
+
+
+class TestGoQuote:
+    """``go_quote`` must match Go ``strconv.Quote`` byte-for-byte — the
+    ``%q`` inside the fatal replicas line's ``strconv.Atoi`` error
+    (``ClusterCapacity.go:81``).  Expected strings below are Go outputs."""
+
+    CASES = [
+        ("ten", '"ten"'),
+        ("a\nb", '"a\\nb"'),
+        ("tab\there", '"tab\\there"'),
+        ("\x01", '"\\x01"'),
+        ("\x7f", '"\\x7f"'),
+        ('say "hi"', '"say \\"hi\\""'),
+        ("back\\slash", '"back\\\\slash"'),
+        ("héllo", '"héllo"'),
+        (" ", '"\\u00a0"'),  # NBSP: Zs, non-print under Go IsPrint
+        (" ", '"\\u202f"'),  # narrow NBSP
+        ("﻿", '"\\ufeff"'),  # BOM: Cf
+        ("\U0001f600", '"\U0001f600"'),  # emoji: So, printable
+        (" spaced ", '" spaced "'),  # ASCII space stays literal
+    ]
+
+    def test_known_go_outputs(self):
+        from kubernetesclustercapacity_tpu.utils.quantity import go_quote
+
+        for raw, want in self.CASES:
+            assert go_quote(raw) == want, repr(raw)
+
+    def test_pep383_surrogate_prints_original_byte(self):
+        """argv bytes that are invalid UTF-8 reach Python as surrogate
+        escapes; Go quotes the raw byte as \\xhh."""
+        from kubernetesclustercapacity_tpu.utils.quantity import go_quote
+
+        raw = b"ab\xffc".decode("utf-8", "surrogateescape")
+        assert go_quote(raw) == '"ab\\xffc"'
+
+    def test_atoi_error_embeds_quoting(self):
+        from kubernetesclustercapacity_tpu.utils.quantity import (
+            go_atoi_error,
+        )
+
+        assert go_atoi_error("\x01en") == (
+            'strconv.Atoi: parsing "\\x01en": invalid syntax'
+        )
