@@ -50,6 +50,16 @@ def main() -> None:
         print(f"  {node:<24} {count}")
     assert placement.all_placed
 
+    # At scale, "auto" switches to the closed-form trace engine: the
+    # scan's exact per-replica order without running R dependent steps.
+    big = model.place(
+        PodSpec(cpu_request_milli=50, mem_request_bytes=32 << 20,
+                replicas=500),
+        policy="best-fit",
+    )
+    print(f"\n500 replicas via engine={big.engine}: "
+          f"first five land on {[int(i) for i in big.assignments[:5]]}")
+
     # Placement understands extended resources too: pack GPU columns and
     # the R-resource engines place only where GPUs exist.
     for i, node in enumerate(fixture["nodes"]):
