@@ -58,6 +58,31 @@ class StoreError(ValueError):
     """Malformed or inapplicable watch event."""
 
 
+def _isolate(obj):
+    """Deep copy of a JSON-shaped object — the store's aliasing barrier.
+
+    Raw state must never alias caller objects (a caller mutating a pod
+    dict after ``apply_event`` would silently corrupt the
+    repack-equality invariant).  Watch/fixture objects are plain
+    dict/list/scalar trees, for which a direct recursion is ~4x cheaper
+    than ``copy.deepcopy``'s memo machinery — this is the per-event hot
+    path of the ``-follow`` serve loop.  Anything exotic falls back to
+    ``copy.deepcopy``; immutable scalars are shared, which is safe.
+    """
+    t = type(obj)
+    if t is str:  # the overwhelmingly common leaf — test first
+        return obj
+    if t is dict:
+        # Keys are isolated too: deepcopy copies keys, and a mutable-but-
+        # hashable custom key must not reach through the barrier.
+        return {_isolate(k): _isolate(v) for k, v in obj.items()}
+    if t is list:
+        return [_isolate(v) for v in obj]
+    if t in (int, float, bool, type(None)):
+        return obj
+    return copy.deepcopy(obj)
+
+
 def _pod_key(pod: dict) -> tuple[str, str]:
     return (pod.get("namespace", ""), pod.get("name", ""))
 
@@ -84,7 +109,7 @@ class ClusterStore:
         self.semantics = semantics
         self.extended_resources = tuple(extended_resources)
         # Raw state, deep-copied: events must never alias caller objects.
-        self._nodes: list[dict] = [copy.deepcopy(n) for n in fixture.get("nodes", [])]
+        self._nodes: list[dict] = [_isolate(n) for n in fixture.get("nodes", [])]
         if semantics == "strict":
             # Strict mode matches pods to rows BY NAME, so duplicate or
             # empty names would diverge from _pack_strict (whose name index
@@ -102,7 +127,7 @@ class ClusterStore:
         self._pods: dict[tuple[str, str], dict] = {}
         self._pods_by_node: dict[str, dict[tuple[str, str], dict]] = {}
         for p in fixture.get("pods", []):
-            p = copy.deepcopy(p)
+            p = _isolate(p)
             key = _pod_key(p)
             if key in self._pods:
                 raise StoreError(f"duplicate pod {key} in fixture")
@@ -160,7 +185,7 @@ class ClusterStore:
 
     def fixture_view(self) -> dict:
         """Current raw state in fixture schema (deep copy)."""
-        return copy.deepcopy(
+        return _isolate(
             {"nodes": self._nodes, "pods": list(self._pods.values())}
         )
 
@@ -231,7 +256,14 @@ class ClusterStore:
             raise StoreError(f"unknown event type {etype!r}")
         if not isinstance(obj, dict):
             raise StoreError("event has no object")
-        obj = copy.deepcopy(obj)
+        try:
+            obj = _isolate(obj)
+        except RecursionError as e:
+            # A self-referential object is a malformed event, not a crash:
+            # keep apply_event's "bad event raises StoreError" contract
+            # (copy.deepcopy would have memoized the cycle; the fast
+            # copier declines it instead).
+            raise StoreError(f"cyclic event object: {e}") from e
         if kind == "Pod":
             self._apply_pod(etype, obj)
         elif kind == "Node":

@@ -430,3 +430,38 @@ class TestMalformedAndExtremeObjects:
         assert any(k == "cpu_err" for k, _ in snap.node_log)
         assert any("bogus" in errs for errs in snap.pod_cpu_errs)
         assert_matches_repack(store)
+
+
+class TestIsolationBarrier:
+    """The fast deep-copier must keep the store's aliasing barrier."""
+
+    def test_cyclic_event_object_raises_store_error(self):
+        from kubernetesclustercapacity_tpu.store import ClusterStore, StoreError
+
+        store = ClusterStore({"nodes": [], "pods": []})
+        obj = {"namespace": "d", "name": "p"}
+        obj["self"] = obj
+        import pytest as _pytest
+
+        with _pytest.raises(StoreError, match="cyclic"):
+            store.apply_event(
+                {"type": "ADDED", "kind": "Pod", "object": obj}
+            )
+
+    def test_applied_object_does_not_alias_caller(self):
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+        from kubernetesclustercapacity_tpu.store import ClusterStore
+
+        fx = synthetic_fixture(3, seed=9, unhealthy_frac=0.0)
+        store = ClusterStore(fx, semantics="reference")
+        pod = dict(fx["pods"][0], namespace="iso", name="iso-pod")
+        ev = {"type": "ADDED", "kind": "Pod", "object": pod}
+        store.apply_event(ev)
+        before = store.snapshot()
+        # Caller mutates its object after apply: the store must not see it.
+        pod["containers"][0]["resources"]["requests"]["cpu"] = "999999m"
+        after = store.snapshot()
+        assert (
+            before.used_cpu_req_milli.tolist()
+            == after.used_cpu_req_milli.tolist()
+        )
