@@ -44,7 +44,31 @@ __all__ = [
     "sweep_pallas",
     "sweep_auto",
     "sweep_snapshot_auto",
+    "fast_path_error",
+    "reset_fast_path",
 ]
+
+# Most recent in-dispatch fast-path failure (compile/legalization), or
+# None.  sweep_auto degrades to the exact kernel when the fused kernel
+# raises AND trips a circuit breaker: a Mosaic failure is deterministic
+# per (kernel, chip), and JAX does not cache failed compiles, so
+# re-attempting on every request would bolt seconds of failing compile
+# onto each ~1 ms sweep.  Read via fast_path_error() — a `from ...
+# import` of the bare global would snapshot None forever.
+last_fast_path_error: str | None = None
+_fast_path_broken: bool = False
+
+
+def fast_path_error() -> str | None:
+    """The failure that tripped the fused-path circuit breaker, or None."""
+    return last_fast_path_error
+
+
+def reset_fast_path() -> None:
+    """Re-arm the fused path after a breaker trip (tests / operators)."""
+    global last_fast_path_error, _fast_path_broken
+    last_fast_path_error = None
+    _fast_path_broken = False
 
 LANES = 128
 # Node tile: 16 sublanes x 128 lanes = 2048 nodes per step; scenario tile 256.
@@ -550,6 +574,7 @@ def sweep_auto(
     off-TPU (the real chip may register under a plugin platform name, so
     detect the one backend that NEEDS interpret mode).
     """
+    global last_fast_path_error, _fast_path_broken
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     if mode == "strict":
@@ -565,20 +590,37 @@ def sweep_auto(
         )
     else:
         kernel_mask = node_mask
-    if not force_exact and fast_sweep_eligible(
-        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
-        cpu_reqs, mem_reqs,
+    if (
+        not force_exact
+        and not _fast_path_broken
+        and fast_sweep_eligible(
+            alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+            pods_count, cpu_reqs, mem_reqs,
+        )
     ):
         use_rcp = rcp_division_eligible(
             alloc_cpu, alloc_mem, used_cpu, used_mem, cpu_reqs, mem_reqs
         )
-        totals, sched = sweep_pallas(
-            alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
-            cpu_reqs, mem_reqs, replicas, mode=mode, node_mask=kernel_mask,
-            interpret=interpret, use_rcp=use_rcp,
-        )
-        name = "pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused"
-        return totals, sched, name
+        try:
+            totals, sched = sweep_pallas(
+                alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+                pods_count, cpu_reqs, mem_reqs, replicas, mode=mode,
+                node_mask=kernel_mask, interpret=interpret, use_rcp=use_rcp,
+            )
+        except Exception as e:  # noqa: BLE001 - availability over speed
+            # The value-domain eligibility proof cannot anticipate a
+            # Mosaic/compiler failure on the real chip (round 4 recorded
+            # two legalization failures that only reproduce there).  A
+            # fast path that will not COMPILE must degrade to the exact
+            # kernel, not take down the serve path — and must not re-pay
+            # the failing compile per request: trip the breaker, keep the
+            # error observable (fast_path_error()), re-arm only via
+            # reset_fast_path().
+            last_fast_path_error = f"{type(e).__name__}: {e}"
+            _fast_path_broken = True
+        else:
+            name = "pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused"
+            return totals, sched, name
     totals, sched = sweep_grid(
         alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
         healthy, cpu_reqs, mem_reqs, replicas, mode=mode,

@@ -469,11 +469,22 @@ class CapacityServer:
             kernel=msg.get("kernel", "auto"),
             node_mask=implicit_mask,
         )
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            fast_path_error,
+        )
+
         return {
             "totals": totals.tolist(),
             "schedulable": sched.tolist(),
             "scenarios": grid.size,
             "kernel": kernel,
+            # A tripped fused-path circuit breaker (Mosaic failure on this
+            # chip) is visible to clients, not just in the kernel name.
+            **(
+                {"fast_path_error": fast_path_error()}
+                if fast_path_error()
+                else {}
+            ),
         }
 
     def _op_sweep_multi(

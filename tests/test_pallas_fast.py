@@ -352,6 +352,44 @@ class TestAuto:
         exact_totals, _ = sweep_snapshot(snap, grid)
         np.testing.assert_array_equal(totals, exact_totals)
 
+    def test_auto_degrades_to_exact_when_fused_kernel_raises(self, monkeypatch):
+        """A Mosaic/compiler failure on the real chip (which the value-
+        domain eligibility proof cannot anticipate) must degrade to the
+        exact kernel — availability over speed — trip the circuit breaker
+        (a failed compile must not be re-paid per request), and stay
+        observable via fast_path_error()."""
+        import kubernetesclustercapacity_tpu.ops.pallas_fit as pf
+
+        calls = []
+
+        def boom(*a, **kw):
+            calls.append(1)
+            raise RuntimeError("Mosaic legalization failed (synthetic)")
+
+        monkeypatch.setattr(pf, "sweep_pallas", boom)
+        pf.reset_fast_path()
+        try:
+            snap = synthetic_snapshot(300, seed=9)
+            grid = random_scenario_grid(16, seed=10)
+            totals, sched, kernel = pf.sweep_auto(
+                *_args(snap), snap.healthy, grid.cpu_request_milli,
+                grid.mem_request_bytes, grid.replicas, interpret=True,
+            )
+            assert kernel == "xla_int64"
+            assert "Mosaic" in pf.fast_path_error()
+            exact_totals, _ = sweep_snapshot(snap, grid)
+            np.testing.assert_array_equal(totals, exact_totals)
+            # Breaker: the second dispatch must not re-attempt the
+            # failing kernel.
+            totals2, _, kernel2 = pf.sweep_auto(
+                *_args(snap), snap.healthy, grid.cpu_request_milli,
+                grid.mem_request_bytes, grid.replicas, interpret=True,
+            )
+            assert kernel2 == "xla_int64" and len(calls) == 1
+            np.testing.assert_array_equal(totals2, exact_totals)
+        finally:
+            pf.reset_fast_path()
+
     def test_auto_falls_back_when_ineligible(self):
         snap = synthetic_snapshot(300, seed=9, kib_quantized=False)
         grid = random_scenario_grid(16, seed=10)
