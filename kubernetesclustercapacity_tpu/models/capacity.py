@@ -348,18 +348,18 @@ class CapacityModel:
         The fit kernels answer "how many"; this answers "which node gets
         replica k", each placement shrinking the headroom the next one
         sees (:mod:`..ops.placement`).  Strict feasibility semantics;
-        constraint masks compose like :meth:`evaluate`.  Extended
-        resources are not simulated (fit-check them via :meth:`evaluate`).
+        constraint masks compose like :meth:`evaluate`; extended
+        resources route to the R-resource engine family (below).
 
         ``assignments`` picks the engine:
 
         * ``True``  — the ``lax.scan`` scheduler; result carries the
           per-replica assignment order, computed on-device.
         * ``"trace"`` — the closed-form trace engine
-          (:func:`..ops.placement.place_replicas_trace`): the scan's
-          exact per-replica order in O(R log R) host math, no scan.
-          Raises for specs it cannot serve (extended resources,
-          zero requests).
+          (:func:`..ops.placement.place_replicas_trace` /
+          ``_trace_multi`` for extended resources): the scan's exact
+          per-replica order in O(R log R) host math, no scan.  Raises
+          for degenerate zero-request specs (scan only).
         * ``False`` — the closed-form bulk engine
           (:func:`..ops.placement.place_replicas_bulk`): identical
           per-node counts in O(N) instead of R dependent scan steps;
@@ -369,9 +369,9 @@ class CapacityModel:
           order, closed form), else bulk (counts only).
 
         A spec with ``extended_requests`` routes to the R-resource engines
-        (:func:`..ops.placement.place_replicas_multi` / ``_bulk_multi``)
-        over the snapshot's extended columns — same policies, same
-        engine-selection rule (no trace engine there yet).
+        (:func:`..ops.placement.place_replicas_multi` / ``_bulk_multi`` /
+        ``_trace_multi``) over the snapshot's extended columns — same
+        policies, same engine-selection rule.
         """
         from kubernetesclustercapacity_tpu.ops.placement import (
             place_replicas,
@@ -379,6 +379,7 @@ class CapacityModel:
             place_replicas_bulk_multi,
             place_replicas_multi,
             place_replicas_trace,
+            place_replicas_trace_multi,
         )
 
         self._check_extensions(
@@ -421,16 +422,22 @@ class CapacityModel:
             bulk_ok = (
                 spec.cpu_request_milli > 0 and spec.mem_request_bytes > 0
             )
-        # The trace engine serves the 2-resource positive-request family
-        # only (its closed form is proven there); extended or degenerate
-        # specs keep the scan/bulk routes.
-        trace_ok = bulk_ok and not spec.extended_requests
+        # The trace engines cover both resource families wherever the
+        # bulk closed form is proven; only degenerate (zero-request)
+        # specs keep the scan route.
+        trace_ok = bulk_ok
+        trace_fn = (
+            place_replicas_trace_multi
+            if spec.extended_requests
+            else place_replicas_trace
+        )
         if assignments == "trace":
             if not trace_ok:
                 raise ValueError(
-                    "trace engine needs positive cpu/mem requests and no "
-                    "extended resources; use assignments=True (scan) or "
-                    "False (bulk counts)"
+                    "trace engine needs positive cpu AND mem requests "
+                    "(or, with extended resources, at least one positive "
+                    "request row) — its closed form is proven there; use "
+                    "assignments=True (scan) for degenerate specs"
                 )
             engine = "trace"
         elif assignments is False and bulk_ok:
@@ -444,7 +451,7 @@ class CapacityModel:
         else:
             engine = "scan"
         if engine == "trace":
-            order, per_node, _ = place_replicas_trace(*args, **kwargs)
+            order, per_node, _ = trace_fn(*args, **kwargs)
         elif engine == "bulk":
             per_node, _ = bulk_fn(*args, **kwargs)
             order = None

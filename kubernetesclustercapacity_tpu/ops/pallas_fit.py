@@ -60,8 +60,23 @@ _fast_path_broken: bool = False
 
 
 def fast_path_error() -> str | None:
-    """The failure that tripped the fused-path circuit breaker, or None."""
+    """The most recent fused-path failure (breaker-tripping or not)."""
     return last_fast_path_error
+
+
+# Compiler-shaped failure markers: Mosaic legalization/lowering errors,
+# XLA compilation errors, and the observed i64→i32 lowering
+# non-termination (RecursionError at trace time).  Deliberately NOT
+# matched: RESOURCE_EXHAUSTED / device runtime errors — those are
+# data- or moment-dependent, not deterministic per (kernel, chip).
+_COMPILE_MARKERS = ("mosaic", "legal", "lower", "compil", "unsupported")
+
+
+def _is_compile_failure(e: Exception) -> bool:
+    if isinstance(e, RecursionError):
+        return True
+    text = f"{type(e).__name__}: {e}".lower()
+    return any(m in text for m in _COMPILE_MARKERS)
 
 
 def reset_fast_path() -> None:
@@ -615,9 +630,14 @@ def sweep_auto(
             # kernel, not take down the serve path — and must not re-pay
             # the failing compile per request: trip the breaker, keep the
             # error observable (fast_path_error()), re-arm only via
-            # reset_fast_path().
+            # reset_fast_path().  Only compiler-shaped failures trip it —
+            # they are deterministic per (kernel, chip); a transient
+            # runtime error (device OOM, tunnel hiccup) degrades THIS
+            # request only, so one oversized sweep cannot disable the
+            # fast path process-wide.
             last_fast_path_error = f"{type(e).__name__}: {e}"
-            _fast_path_broken = True
+            if _is_compile_failure(e):
+                _fast_path_broken = True
         else:
             name = "pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused"
             return totals, sched, name

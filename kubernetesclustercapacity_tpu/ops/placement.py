@@ -40,6 +40,7 @@ __all__ = [
     "place_replicas_python",
     "place_replicas_multi",
     "place_replicas_bulk_multi",
+    "place_replicas_trace_multi",
     "place_replicas_multi_python",
     "POLICIES",
 ]
@@ -54,6 +55,32 @@ def _normalized_headroom(hc, hm, alloc_cpu, alloc_mem):
         den > 0, num.astype(jnp.float64) / den.astype(jnp.float64), 0.0
     )
     return safe(hc, alloc_cpu) + safe(hm, alloc_mem)
+
+
+def _np_score_after_multi(h0, alloc_rn, reqs, sel, j):
+    """R-row left-fold ``score_after(j)`` for the selected node columns.
+
+    The ONE definition of the host-side R-resource score math (the
+    analog of :func:`_np_score_after` for the multi family): the bulk
+    engine's order/waterline search and the trace engine's keys both
+    call it, so their f64 values are bit-identical — same per-row
+    guarded divide, same left-to-right fold order as the scan's
+    ``score_of``.  ``sel`` is an index array of node columns; ``j``
+    broadcasts against it.
+    """
+    j1 = np.asarray(j, dtype=np.int64) + 1
+    sel = np.asarray(sel)
+    acc = np.zeros(np.broadcast(sel, j1).shape, dtype=np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        for r in range(alloc_rn.shape[0]):
+            sub = int(reqs[r]) if reqs[r] > 0 else 0
+            acc = acc + np.where(
+                alloc_rn[r, sel] > 0,
+                (h0[r, sel] - j1 * sub).astype(np.float64)
+                / alloc_rn[r, sel].astype(np.float64),
+                0.0,
+            )
+    return acc
 
 
 def _np_score_after(hc0, hm0, ac, am, c, m, j):
@@ -741,20 +768,11 @@ def place_replicas_bulk_multi(
     if policy == "first-fit":
         return fill_in_order(np.arange(caps.shape[0])), r_want
 
+    _all_nodes = np.arange(alloc_rn.shape[1])
+
     def score_after(j):
-        j1 = np.asarray(j, dtype=np.int64) + 1
-        acc = np.zeros(alloc_rn.shape[1], dtype=np.float64)
-        with np.errstate(divide="ignore", invalid="ignore"):
-            for r in range(alloc_rn.shape[0]):
-                sub = int(reqs[r]) if reqs[r] > 0 else 0
-                term = np.where(
-                    alloc_rn[r] > 0,
-                    (h0[r] - j1 * sub).astype(np.float64)
-                    / alloc_rn[r].astype(np.float64),
-                    0.0,
-                )
-                acc = acc + term
-        return acc
+        # Shared with the trace engine via _np_score_after_multi.
+        return _np_score_after_multi(h0, alloc_rn, reqs, _all_nodes, j)
 
     if policy == "best-fit":
         s0 = score_after(0)
@@ -806,6 +824,66 @@ def place_replicas_bulk_multi(
     before = np.concatenate(([0], np.cumsum(at)[:-1]))
     take = np.clip(r_want - n_gt - before, 0, at)
     return strict + take, r_want
+
+
+def place_replicas_trace_multi(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_r,
+    *,
+    n_replicas: int,
+    policy: str = "first-fit",
+    node_mask=None,
+    max_per_node: int | None = None,
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """R-resource closed-form trace — see :func:`place_replicas_trace`.
+
+    The 2-resource order arguments generalize verbatim because the score
+    is a left-fold of R monotone non-increasing f64 terms (the same
+    argument :func:`place_replicas_bulk_multi` makes for counts):
+    first/best-fit fill nodes to capacity in (initial score, index)
+    order, and spread is the multiset of ``score_after(t)`` keys sorted
+    by (key desc, index asc, t asc).  Exactness pinned against the scan
+    by ``tests/test_placement.py``.
+    """
+    counts, placed = place_replicas_bulk_multi(
+        alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs_r,
+        n_replicas=n_replicas, policy=policy,
+        node_mask=node_mask, max_per_node=max_per_node,
+    )
+    r_want = int(n_replicas)
+    assignments = np.full(r_want, -1, dtype=np.int64)
+    if placed == 0:
+        return assignments, counts, 0
+    alloc_rn = np.asarray(alloc_rn, dtype=np.int64)
+    used_rn = np.asarray(used_rn, dtype=np.int64)
+    reqs = np.asarray(reqs_r, dtype=np.int64)
+    h0 = alloc_rn - used_rn
+    idx = np.arange(counts.shape[0])
+
+    def score_after(sel, j):
+        # Shared with the bulk engine via _np_score_after_multi.
+        return _np_score_after_multi(h0, alloc_rn, reqs, sel, j)
+
+    if policy in ("first-fit", "best-fit"):
+        if policy == "first-fit":
+            order = idx
+        else:
+            order = np.lexsort((idx, score_after(idx, 0)))
+        order = order[counts[order] > 0]
+        assignments[:placed] = np.repeat(order, counts[order])
+        return assignments, counts, placed
+
+    i_arr = np.repeat(idx, counts)
+    ends = np.cumsum(counts)
+    t_arr = np.arange(placed) - np.repeat(ends - counts, counts)
+    key = score_after(i_arr, t_arr)
+    order = np.lexsort((t_arr, i_arr, -key))
+    assignments[:placed] = i_arr[order]
+    return assignments, counts, placed
 
 
 def place_replicas_multi_python(

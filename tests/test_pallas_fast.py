@@ -390,6 +390,44 @@ class TestAuto:
         finally:
             pf.reset_fast_path()
 
+    def test_transient_runtime_error_degrades_without_tripping_breaker(
+        self, monkeypatch
+    ):
+        """A device OOM / transient runtime error degrades THIS request
+        only: one oversized sweep must not disable the fast path
+        process-wide (only compiler-shaped failures are deterministic
+        per (kernel, chip))."""
+        import kubernetesclustercapacity_tpu.ops.pallas_fit as pf
+
+        calls = []
+        real = pf.sweep_pallas
+
+        def flaky(*a, **kw):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pf, "sweep_pallas", flaky)
+        pf.reset_fast_path()
+        try:
+            snap = synthetic_snapshot(300, seed=9)
+            grid = random_scenario_grid(16, seed=10)
+            _, _, kernel = pf.sweep_auto(
+                *_args(snap), snap.healthy, grid.cpu_request_milli,
+                grid.mem_request_bytes, grid.replicas, interpret=True,
+            )
+            assert kernel == "xla_int64"  # degraded this once
+            assert "RESOURCE_EXHAUSTED" in pf.fast_path_error()
+            _, _, kernel2 = pf.sweep_auto(
+                *_args(snap), snap.healthy, grid.cpu_request_milli,
+                grid.mem_request_bytes, grid.replicas, interpret=True,
+            )
+            assert kernel2.startswith("pallas_")  # fast path re-attempted
+            assert len(calls) == 2
+        finally:
+            pf.reset_fast_path()
+
     def test_auto_falls_back_when_ineligible(self):
         snap = synthetic_snapshot(300, seed=9, kib_quantized=False)
         grid = random_scenario_grid(16, seed=10)

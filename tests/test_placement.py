@@ -488,6 +488,64 @@ class TestMultiResourcePlacement:
             np.testing.assert_array_equal(c_bulk, np.asarray(c_py),
                                           err_msg=f"{policy} r={r}")
 
+    def test_trace_multi_matches_truth_sequences(self):
+        """R-resource trace: per-replica assignment sequences must match
+        the sequential truth element-for-element through boundaries."""
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_replicas_multi_python,
+            place_replicas_trace_multi,
+        )
+
+        for policy in POLICIES:
+            for trial in range(8):
+                args, mask, mpn = self._random_multi(trial)
+                kw = dict(policy=policy, node_mask=mask, max_per_node=mpn)
+                _, c_full = place_replicas_multi_python(
+                    *args, n_replicas=300, **kw
+                )
+                total = sum(c_full)
+                for r in sorted({0, 1, total // 2, max(total - 1, 0),
+                                 total, total + 3}):
+                    a_py, c_py = place_replicas_multi_python(
+                        *args, n_replicas=r, **kw
+                    )
+                    a_tr, c_tr, placed = place_replicas_trace_multi(
+                        *args, n_replicas=r, **kw
+                    )
+                    np.testing.assert_array_equal(
+                        a_tr, np.asarray(a_py, dtype=np.int64),
+                        err_msg=f"{policy} trial={trial} r={r}")
+                    np.testing.assert_array_equal(c_tr, np.asarray(c_py))
+                    assert placed == min(r, total)
+
+    @pytest.mark.parametrize("policy", ("best-fit", "spread"))
+    def test_trace_multi_adversarial_ties(self, policy):
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_replicas_multi_python,
+            place_replicas_trace_multi,
+        )
+
+        n = 5
+        alloc_rn = np.stack([
+            np.full(n, 4000), np.full(n, 1 << 32), np.full(n, 4),
+        ]).astype(np.int64)
+        used_rn = np.zeros_like(alloc_rn)
+        args = (
+            alloc_rn, used_rn, np.full(n, 50, dtype=np.int64),
+            np.zeros(n, dtype=np.int64), np.ones(n, dtype=bool),
+            np.array([500, 1 << 29, 1], dtype=np.int64),
+        )
+        for r in range(0, 4 * n + 2):
+            a_py, _ = place_replicas_multi_python(
+                *args, n_replicas=r, policy=policy
+            )
+            a_tr, _, _ = place_replicas_trace_multi(
+                *args, n_replicas=r, policy=policy
+            )
+            np.testing.assert_array_equal(
+                a_tr, np.asarray(a_py, dtype=np.int64),
+                err_msg=f"{policy} r={r}")
+
     def test_capacity_invariant_matches_fit_kernel(self):
         from kubernetesclustercapacity_tpu.ops.fit import fit_per_node_multi
         from kubernetesclustercapacity_tpu.ops.placement import (
@@ -525,7 +583,10 @@ class TestModelExtendedPlacement:
                        extended_requests={"nvidia.com/gpu": 1})
         placement = model.place(spec, policy="first-fit")
         capacity = model.evaluate(spec).total
-        assert placement.engine == "bulk"  # replicas > PLACE_SCAN_MAX
+        # replicas > PLACE_SCAN_MAX: auto routes to the closed-form trace
+        # engine (order included) even with extended resources.
+        assert placement.engine == "trace"
+        assert placement.assignments is not None
         assert placement.placed == capacity
         # GPU-less nodes took nothing.
         gpu_alloc = snap.extended["nvidia.com/gpu"][0]
