@@ -423,16 +423,15 @@ class CapacityModel:
                 spec.cpu_request_milli > 0 and spec.mem_request_bytes > 0
             )
         # The trace engines cover both resource families wherever the
-        # bulk closed form is proven; only degenerate (zero-request)
-        # specs keep the scan route.
-        trace_ok = bulk_ok
+        # bulk closed form is proven (bulk_ok); only degenerate
+        # zero-request specs keep the scan route.
         trace_fn = (
             place_replicas_trace_multi
             if spec.extended_requests
             else place_replicas_trace
         )
         if assignments == "trace":
-            if not trace_ok:
+            if not bulk_ok:
                 raise ValueError(
                     "trace engine needs positive cpu AND mem requests "
                     "(or, with extended resources, at least one positive "
@@ -447,7 +446,7 @@ class CapacityModel:
             and spec.replicas > self.PLACE_SCAN_MAX
             and bulk_ok
         ):
-            engine = "trace" if trace_ok else "bulk"
+            engine = "trace"
         else:
             engine = "scan"
         if engine == "trace":

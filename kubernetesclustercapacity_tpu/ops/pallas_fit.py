@@ -64,19 +64,32 @@ def fast_path_error() -> str | None:
     return last_fast_path_error
 
 
-# Compiler-shaped failure markers: Mosaic legalization/lowering errors,
-# XLA compilation errors, and the observed i64→i32 lowering
-# non-termination (RecursionError at trace time).  Deliberately NOT
-# matched: RESOURCE_EXHAUSTED / device runtime errors — those are
-# data- or moment-dependent, not deterministic per (kernel, chip).
-_COMPILE_MARKERS = ("mosaic", "legal", "lower", "compil", "unsupported")
+# Transient-failure markers: device/runtime conditions that are data- or
+# moment-dependent (OOM, tunnel drops, deadlines).  Anything NOT
+# transient trips the breaker: the dispatch's inputs are already proven
+# eligible, so an unexplained in-dispatch failure is near-certainly a
+# deterministic compile/legalization problem for this (kernel, chip) —
+# defaulting the unknown case to "trip" avoids re-paying a failing
+# multi-second compile on every request, at worst costing fast-path
+# speed until reset_fast_path().
+_TRANSIENT_MARKERS = (
+    "resource_exhausted",
+    "out of memory",
+    "unavailable",
+    "deadline",
+    "cancelled",
+    "connection",
+    "socket",
+    "interrupt",
+)
 
 
-def _is_compile_failure(e: Exception) -> bool:
+def _is_transient_failure(e: Exception) -> bool:
     if isinstance(e, RecursionError):
-        return True
+        # The observed i64→i32 lowering non-termination — deterministic.
+        return False
     text = f"{type(e).__name__}: {e}".lower()
-    return any(m in text for m in _COMPILE_MARKERS)
+    return any(m in text for m in _TRANSIENT_MARKERS)
 
 
 def reset_fast_path() -> None:
@@ -630,15 +643,21 @@ def sweep_auto(
             # kernel, not take down the serve path — and must not re-pay
             # the failing compile per request: trip the breaker, keep the
             # error observable (fast_path_error()), re-arm only via
-            # reset_fast_path().  Only compiler-shaped failures trip it —
-            # they are deterministic per (kernel, chip); a transient
-            # runtime error (device OOM, tunnel hiccup) degrades THIS
-            # request only, so one oversized sweep cannot disable the
-            # fast path process-wide.
+            # reset_fast_path().  Recognizably-transient runtime errors
+            # (device OOM, tunnel hiccup) degrade THIS request only, so
+            # one oversized sweep cannot disable the fast path
+            # process-wide; everything else — compile/legalization
+            # failures included — trips the breaker (see
+            # _is_transient_failure for why unknown defaults to trip).
             last_fast_path_error = f"{type(e).__name__}: {e}"
-            if _is_compile_failure(e):
+            if not _is_transient_failure(e):
                 _fast_path_broken = True
         else:
+            # A fused success clears any prior transient failure: the
+            # service must not report a stale fast_path_error alongside
+            # a healthy fast-path kernel.  (A tripped breaker never
+            # reaches here, so ITS error stays visible.)
+            last_fast_path_error = None
             name = "pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused"
             return totals, sched, name
     totals, sched = sweep_grid(

@@ -1,0 +1,124 @@
+"""Unit tests for bench.py's parent-side harness helpers.
+
+The harness is the round's capture-or-nothing machinery (a dead TPU
+tunnel voided every round-4 number), so its pure pieces are pinned here:
+the escalating init-timeout ladder, the probe child's source, and the
+stdout/stderr plumbing every attempt record depends on.  Child-spawning
+integration paths are exercised by running ``bench.py`` directly (smoke
+scripts), not here — these tests stay sub-second.
+"""
+
+import pathlib
+import sys
+
+import pytest
+
+_REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch):
+    """Import (or re-import) bench with a clean env, restoring after."""
+
+    def load(**env):
+        sys.modules.pop("bench", None)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        sys.path.insert(0, _REPO_ROOT)
+        try:
+            import bench
+            return bench
+        finally:
+            sys.path.pop(0)
+
+    yield load
+    # Restore a pristine module for any later importer.
+    sys.modules.pop("bench", None)
+
+
+class TestInitTimeoutLadder:
+    def test_default_ladder_escalates_150_300_600(self, bench_mod):
+        bench = bench_mod()
+        assert bench._init_timeout_ladder() == [150.0, 300.0, 600.0]
+
+    def test_env_base_scales_with_cap(self, bench_mod):
+        bench = bench_mod(
+            KCC_BENCH_INIT_TIMEOUT_S="200", KCC_BENCH_INIT_ATTEMPTS="4"
+        )
+        # 200 -> 400 -> 800-capped-to-600 -> 600
+        assert bench._init_timeout_ladder() == [200.0, 400.0, 600.0, 600.0]
+
+    def test_large_base_override_not_compounded(self, bench_mod):
+        bench = bench_mod(
+            KCC_BENCH_INIT_TIMEOUT_S="900", KCC_BENCH_INIT_ATTEMPTS="2"
+        )
+        # cap = max(base, 600): a deliberate large base is honored flat.
+        assert bench._init_timeout_ladder() == [900.0, 900.0]
+
+    def test_bad_env_never_breaks_the_contract(self, bench_mod):
+        bench = bench_mod(KCC_BENCH_INIT_TIMEOUT_S="not-a-number")
+        assert bench._init_timeout_ladder()[0] == 150.0
+
+
+class TestProbeChild:
+    def test_probe_code_is_valid_python(self, bench_mod):
+        bench = bench_mod()
+        compile(bench._PROBE_CODE, "<probe>", "exec")
+
+    def test_probe_code_has_no_repo_imports(self, bench_mod):
+        # The probe's whole value is that a hang in it indicts the
+        # environment: stdlib + jax only.
+        bench = bench_mod()
+        assert "kubernetesclustercapacity" not in bench._PROBE_CODE
+        assert "import jax" in bench._PROBE_CODE
+
+    def test_fault_dump_env_arms_before_the_watchdog(self, bench_mod):
+        bench = bench_mod()
+        env = bench._fault_dump_env(150.0)
+        assert float(env[bench._FAULT_DUMP_ENV]) == 145.0
+        assert float(env[bench._SPAWN_T_ENV]) > 0
+
+
+class TestChildIO:
+    def test_stdout_queue_and_stderr_tail(self, bench_mod):
+        bench = bench_mod()
+        io = bench._spawn(
+            [
+                sys.executable,
+                "-c",
+                "import sys\n"
+                "print('out-line')\n"
+                "print('err-line', file=sys.stderr)\n",
+            ]
+        )
+        lines = []
+        while True:
+            line = io.lines.get(timeout=10)
+            if line is None:
+                break
+            lines.append(line.strip())
+        io.proc.wait(timeout=10)
+        assert "out-line" in lines
+        # Give the stderr pump a moment, then the tail must carry it.
+        import time
+
+        for _ in range(50):
+            if io.stderr_tail():
+                break
+            time.sleep(0.05)
+        assert io.stderr_tail() == ["err-line"]
+
+    def test_drop_env_removes_variables(self, bench_mod, monkeypatch):
+        monkeypatch.setenv("KCC_TEST_SENTINEL", "1")
+        bench = bench_mod()
+        io = bench._spawn(
+            [
+                sys.executable,
+                "-c",
+                "import os; print(os.environ.get('KCC_TEST_SENTINEL'))",
+            ],
+            drop_env=("KCC_TEST_SENTINEL",),
+        )
+        first = io.lines.get(timeout=10)
+        io.proc.wait(timeout=10)
+        assert first.strip() == "None"

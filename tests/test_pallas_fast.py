@@ -425,6 +425,9 @@ class TestAuto:
             )
             assert kernel2.startswith("pallas_")  # fast path re-attempted
             assert len(calls) == 2
+            # Success clears the transient error: the service must not
+            # report a stale fast_path_error next to a healthy kernel.
+            assert pf.fast_path_error() is None
         finally:
             pf.reset_fast_path()
 
