@@ -51,7 +51,8 @@ if _os.environ.get("JAX_PLATFORMS"):
     except RuntimeError:  # pragma: no cover - backends already initialized
         pass
 
-__version__ = "0.1.0"
+# Keep in lockstep with pyproject.toml's [project] version.
+__version__ = "0.4.0"
 
 from kubernetesclustercapacity_tpu.utils import quantity  # noqa: E402,F401
 from kubernetesclustercapacity_tpu.snapshot import (  # noqa: E402,F401

@@ -84,6 +84,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-replica request for an extended resource "
                         "(repeatable; strict quantity grammar, e.g. "
                         "nvidia.com/gpu=2, ephemeral-storage=10Gi)")
+    p.add_argument("-doctor", action="store_true",
+                   help="diagnose the environment (backend probe with a "
+                        "hang-proof timeout, native toolchain, fast-path "
+                        "state) and exit; exit code 1 on any hard failure")
+    p.add_argument("-doctor-timeout", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="how long -doctor waits for backend init before "
+                        "declaring it wedged")
     return p
 
 
@@ -108,6 +116,13 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(
         _split_single_dash_eq(sys.argv[1:] if argv is None else list(argv))
     )
+
+    if args.doctor:
+        from kubernetesclustercapacity_tpu.utils.doctor import run_doctor
+
+        report, code = run_doctor(backend_timeout_s=args.doctor_timeout)
+        print(report)
+        return code
 
     try:
         scenario = scenario_from_flags(

@@ -1,0 +1,202 @@
+"""Environment diagnostics: a hang-proof report of the stack's health.
+
+The accelerator here can sit behind a tunnel whose PJRT init hangs
+*indefinitely* (it cost two benchmark rounds their numbers): any probe
+of ``jax.devices()`` therefore runs in a KILLED-ON-TIMEOUT subprocess,
+never in the caller's process — a stuck init can only be recovered by
+killing the process that attempted it, and the doctor must never become
+the thing it diagnoses.
+
+Surfaced via ``kccap -doctor`` (``cli.py``).  The reference has no
+equivalent; this exists because a live-cluster tool whose backend can
+wedge needs a first-line triage command.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["run_doctor", "doctor_report", "healthy"]
+
+# The probe child's entire program: stdlib + jax only, so a hang here
+# indicts the environment, not this package (same discrimination logic
+# as bench.py's probe child).
+_PROBE_CODE = """\
+import time
+t0 = time.time()
+import jax
+d = jax.devices()
+print("DEVICES %.1fs %s x%d" % (time.time() - t0, d[0], len(d)), flush=True)
+"""
+
+
+def _probe_backend(timeout_s: float, probe_code: str = _PROBE_CODE) -> str:
+    """Run the jax.devices() probe in a killable child; never hangs.
+
+    Output is read by a pump thread, not ``communicate()``: on this
+    path (single merged pipe + text mode + timeout) CPython's
+    retry-without-loss guarantee proved unreliable — partial output
+    written before the hang vanished, and that partial output is
+    exactly the diagnostic a wedged-init report needs.
+    """
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", probe_code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+    )
+    lines: list[str] = []
+
+    def pump() -> None:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.append(line.rstrip("\n"))
+
+    reader = threading.Thread(target=pump, daemon=True)
+    reader.start()
+    try:
+        proc.wait(timeout=timeout_s)
+        hung = False
+    except subprocess.TimeoutExpired:
+        hung = True
+        # Whole-group SIGKILL: PJRT spawns threads that ignore SIGTERM
+        # while blocked in C++ (same rationale as bench.py::_kill_group —
+        # kept in lockstep by hand; bench's parent may not import this
+        # package, whose __init__ pulls in jax).
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        try:
+            proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001 - best-effort reap
+            pass
+    reader.join(timeout=5)  # EOF follows the kill; bounded regardless
+    if proc.stdout is not None:
+        proc.stdout.close()
+    if hung:
+        tail = [ln for ln in lines if ln][-2:]
+        return (
+            f"HUNG: backend init did not return within {timeout_s:.0f}s "
+            "(killed) — the accelerator plugin/tunnel is wedged; CPU "
+            "surfaces (-backend native, packing, store) still work"
+            + (f" | last output: {' | '.join(tail)}" if tail else "")
+        )
+    for line in lines:
+        if line.startswith("DEVICES"):
+            return "ok: " + line[len("DEVICES "):]
+    tail = [ln for ln in lines if ln][-3:]
+    return "FAILED: " + (" | ".join(tail) if tail else "no output")
+
+
+def doctor_report(
+    *,
+    backend_timeout_s: float = 30.0,
+    probe_code: str | None = None,
+) -> list[tuple[str, str]]:
+    """Collect (check, result) pairs.  Pure data; rendering is the CLI's.
+
+    ``probe_code`` defaults to the module's probe at CALL time (not def
+    time) so tests can swap ``_PROBE_CODE`` without re-binding defaults.
+    """
+    if probe_code is None:
+        probe_code = _PROBE_CODE
+    checks: list[tuple[str, str]] = []
+
+    def check(name: str, fn) -> None:
+        # One broken subsystem must become a FAILED line, never abort the
+        # report — broken environments are exactly what -doctor triages,
+        # and the backend probe's result must survive whatever follows.
+        try:
+            checks.append((name, fn()))
+        except Exception as e:  # noqa: BLE001 - diagnostic must complete
+            checks.append((name, f"FAILED: {type(e).__name__}: {e}"))
+
+    def _pkg():
+        import kubernetesclustercapacity_tpu as kcc
+
+        return f"kubernetesclustercapacity_tpu {kcc.__version__}"
+
+    check("package", _pkg)
+    check(
+        "platform env",
+        lambda: os.environ.get("JAX_PLATFORMS", "(default)"),
+    )
+    check(
+        "backend probe",
+        lambda: _probe_backend(backend_timeout_s, probe_code),
+    )
+
+    def _x64():
+        # In-process jax state: config only — never touches a backend.
+        import jax
+
+        return ("ok" if jax.config.jax_enable_x64 else
+                "DISABLED — int64 Go-semantics kernels need jax_enable_x64")
+
+    check("x64 ints", _x64)
+
+    def _native():
+        from kubernetesclustercapacity_tpu import native as _ncap
+
+        return ("ok: compiled" if _ncap.available() else
+                "unavailable (g++ missing or build failed) — "
+                "-backend native off")
+
+    check("native kernel (C++)", _native)
+
+    def _walk():
+        from kubernetesclustercapacity_tpu.native import ingest as _ingest
+
+        return ("ok: compiled" if _ingest.available() else
+                "unavailable — packers use the pure-Python walk")
+
+    check("native pod-walk (C ext)", _walk)
+
+    def _fast():
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            fast_path_error,
+        )
+
+        err = fast_path_error()
+        return f"degraded: {err}" if err else "armed (trips only on failure)"
+
+    check("fused fast path", _fast)
+    return checks
+
+
+def healthy(checks: list[tuple[str, str]]) -> bool:
+    """True when no check reports a hard failure (HUNG/FAILED/DISABLED).
+
+    "unavailable"/"degraded" results are soft (the CLI still works on
+    fallback paths) and do not fail the exit code.
+    """
+    return not any(
+        result.startswith(("HUNG", "FAILED", "DISABLED"))
+        for _, result in checks
+    )
+
+
+def run_doctor(
+    *, backend_timeout_s: float = 30.0, probe_code: str | None = None
+) -> tuple[str, int]:
+    """Render the report; returns ``(text, exit_code)``.
+
+    Exit code 1 when any check is a hard failure (HUNG/FAILED/DISABLED)
+    so wrappers and CI gates can trust the command, not parse its prose.
+    """
+    t0 = time.time()
+    checks = doctor_report(
+        backend_timeout_s=backend_timeout_s, probe_code=probe_code
+    )
+    width = max(len(name) for name, _ in checks)
+    lines = [f"{name:<{width}}  {result}" for name, result in checks]
+    lines.append(f"{'elapsed':<{width}}  {time.time() - t0:.1f}s")
+    return "\n".join(lines), (0 if healthy(checks) else 1)
