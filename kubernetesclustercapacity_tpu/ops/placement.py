@@ -57,6 +57,36 @@ def _normalized_headroom(hc, hm, alloc_cpu, alloc_mem):
     return safe(hc, alloc_cpu) + safe(hm, alloc_mem)
 
 
+def _assemble_trace(counts, placed, n_replicas, policy, score0, key_of):
+    """Per-replica assignment sequence from closed-form counts — the
+    shared skeleton of both trace engines.
+
+    ``score0`` is the [N] initial after-placement score (ignored for
+    first-fit); ``key_of(i_arr, t_arr)`` computes the spread multiset
+    keys.  The order arguments live in :func:`place_replicas_trace`'s
+    docstring; this helper only assembles.
+    """
+    r = int(n_replicas)
+    assignments = np.full(r, -1, dtype=np.int64)
+    if placed == 0:
+        return assignments
+    idx = np.arange(counts.shape[0])
+    if policy in ("first-fit", "best-fit"):
+        order = idx if policy == "first-fit" else np.lexsort((idx, score0))
+        order = order[counts[order] > 0]
+        assignments[:placed] = np.repeat(order, counts[order])
+        return assignments
+    # spread: expand each placed node's (i, t) elements and sort by
+    # (key desc, node index asc, t asc).
+    i_arr = np.repeat(idx, counts)
+    ends = np.cumsum(counts)
+    t_arr = np.arange(placed) - np.repeat(ends - counts, counts)
+    key = key_of(i_arr, t_arr)
+    order = np.lexsort((t_arr, i_arr, -key))
+    assignments[:placed] = i_arr[order]
+    return assignments
+
+
 def _np_score_after_multi(h0, alloc_rn, reqs, sel, j):
     """R-row left-fold ``score_after(j)`` for the selected node columns.
 
@@ -457,46 +487,22 @@ def place_replicas_trace(
         healthy, cpu_req, mem_req, n_replicas=n_replicas, policy=policy,
         node_mask=node_mask, max_per_node=max_per_node,
     )
-    r = int(n_replicas)
-    assignments = np.full(r, -1, dtype=np.int64)
-    if placed == 0:
-        return assignments, counts, 0
-    idx = np.arange(counts.shape[0])
-
-    if policy in ("first-fit", "best-fit"):
-        if policy == "first-fit":
-            order = idx
-        else:
-            ac = np.asarray(alloc_cpu, dtype=np.int64)
-            am = np.asarray(alloc_mem, dtype=np.int64)
-            hc0 = ac - np.asarray(used_cpu, dtype=np.int64)
-            hm0 = am - np.asarray(used_mem, dtype=np.int64)
-            s0 = _np_score_after(
-                hc0, hm0, ac, am, int(cpu_req), int(mem_req), 0
-            )
-            order = np.lexsort((idx, s0))
-        order = order[counts[order] > 0]
-        assignments[:placed] = np.repeat(order, counts[order])
-        return assignments, counts, placed
-
-    # spread: expand each placed node's (i, t) elements, key them with the
-    # SAME f64 score math the scan compares, and sort by (key desc, index
-    # asc, t asc).  Non-increasing per-node sequences make the multiset
-    # sort equal to the greedy head-merge.
     ac = np.asarray(alloc_cpu, dtype=np.int64)
     am = np.asarray(alloc_mem, dtype=np.int64)
     hc0 = ac - np.asarray(used_cpu, dtype=np.int64)
     hm0 = am - np.asarray(used_mem, dtype=np.int64)
-    i_arr = np.repeat(idx, counts)
-    # t = 0..counts_i-1 within each node, in one vectorized ramp.
-    ends = np.cumsum(counts)
-    t_arr = np.arange(placed) - np.repeat(ends - counts, counts)
-    key = _np_score_after(
-        hc0[i_arr], hm0[i_arr], ac[i_arr], am[i_arr],
-        int(cpu_req), int(mem_req), t_arr,
+    c, m = int(cpu_req), int(mem_req)
+    score0 = (
+        _np_score_after(hc0, hm0, ac, am, c, m, 0)
+        if policy == "best-fit"
+        else None
     )
-    order = np.lexsort((t_arr, i_arr, -key))
-    assignments[:placed] = i_arr[order]
+    assignments = _assemble_trace(
+        counts, placed, n_replicas, policy, score0,
+        lambda i_arr, t_arr: _np_score_after(
+            hc0[i_arr], hm0[i_arr], ac[i_arr], am[i_arr], c, m, t_arr
+        ),
+    )
     return assignments, counts, placed
 
 
@@ -854,35 +860,23 @@ def place_replicas_trace_multi(
         n_replicas=n_replicas, policy=policy,
         node_mask=node_mask, max_per_node=max_per_node,
     )
-    r_want = int(n_replicas)
-    assignments = np.full(r_want, -1, dtype=np.int64)
-    if placed == 0:
-        return assignments, counts, 0
     alloc_rn = np.asarray(alloc_rn, dtype=np.int64)
     used_rn = np.asarray(used_rn, dtype=np.int64)
     reqs = np.asarray(reqs_r, dtype=np.int64)
     h0 = alloc_rn - used_rn
-    idx = np.arange(counts.shape[0])
-
-    def score_after(sel, j):
-        # Shared with the bulk engine via _np_score_after_multi.
-        return _np_score_after_multi(h0, alloc_rn, reqs, sel, j)
-
-    if policy in ("first-fit", "best-fit"):
-        if policy == "first-fit":
-            order = idx
-        else:
-            order = np.lexsort((idx, score_after(idx, 0)))
-        order = order[counts[order] > 0]
-        assignments[:placed] = np.repeat(order, counts[order])
-        return assignments, counts, placed
-
-    i_arr = np.repeat(idx, counts)
-    ends = np.cumsum(counts)
-    t_arr = np.arange(placed) - np.repeat(ends - counts, counts)
-    key = score_after(i_arr, t_arr)
-    order = np.lexsort((t_arr, i_arr, -key))
-    assignments[:placed] = i_arr[order]
+    score0 = (
+        _np_score_after_multi(
+            h0, alloc_rn, reqs, np.arange(counts.shape[0]), 0
+        )
+        if policy == "best-fit"
+        else None
+    )
+    assignments = _assemble_trace(
+        counts, placed, n_replicas, policy, score0,
+        lambda i_arr, t_arr: _np_score_after_multi(
+            h0, alloc_rn, reqs, i_arr, t_arr
+        ),
+    )
     return assignments, counts, placed
 
 
