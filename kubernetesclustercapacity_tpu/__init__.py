@@ -74,5 +74,10 @@ from kubernetesclustercapacity_tpu.ops.fit import (  # noqa: E402,F401
     sweep_grid,
     sweep_snapshot,
 )
+from kubernetesclustercapacity_tpu.ops.preemption import (  # noqa: E402,F401
+    PriorityTable,
+    build_priority_table,
+    fit_with_preemption,
+)
 from kubernetesclustercapacity_tpu.store import ClusterStore  # noqa: E402,F401
 from kubernetesclustercapacity_tpu.follower import ClusterFollower  # noqa: E402,F401

@@ -834,7 +834,7 @@ def pod_to_fixture(p: dict) -> dict:
     meta = p.get("metadata") or {}
     spec = p.get("spec") or {}
     status = p.get("status") or {}
-    return {
+    out = {
         "name": meta.get("name", ""),
         "namespace": meta.get("namespace", ""),
         "nodeName": spec.get("nodeName") or "",
@@ -844,6 +844,12 @@ def pod_to_fixture(p: dict) -> dict:
         "containers": _containers_fixture(spec.get("containers")),
         "initContainers": _containers_fixture(spec.get("initContainers")),
     }
+    # The admission-resolved integer priority feeds preemption-aware
+    # capacity (ops/preemption.py); absent stays absent (fixture readers
+    # default it to 0, the no-global-default-PriorityClass value).
+    if spec.get("priority") is not None:
+        out["priority"] = spec["priority"]
+    return out
 
 
 def live_fixture(

@@ -42,6 +42,13 @@ class PodSpec:
     optional and default to unconstrained.  ``spread`` caps replicas per node
     (self-anti-affinity over the hostname topology; 1 = classic one-per-node
     spread, ``None`` = unlimited; must be >= 1 when set).
+
+    ``priority`` (``None`` = no preemption) makes capacity
+    preemption-aware: existing pods of strictly lower priority are
+    treated as evictable, so only pods with ``priority >= this`` consume
+    headroom (:mod:`..ops.preemption` — the kube-scheduler preemption
+    upper bound).  Strict semantics only; needs the model's ``fixture``
+    (pod priorities are not part of the dense snapshot).
     """
 
     cpu_request_milli: int
@@ -60,6 +67,7 @@ class PodSpec:
     # no namespace; real pods always have one).
     namespace: str | None = None
     spread: int | None = None
+    priority: int | None = None
 
     def __post_init__(self) -> None:
         # CPU values may arrive as raw uint64 (the reference codec wraps
@@ -97,6 +105,13 @@ class PodSpec:
             )
         if self.spread is not None and self.spread < 1:
             raise ValueError("spread must be >= 1 (or None for unlimited)")
+        if self.priority is not None and not isinstance(self.priority, int):
+            # A non-int priority would compare incoherently against the
+            # table's int64 levels (bool is fine: it IS an int).
+            raise ValueError(
+                f"priority must be an int, got "
+                f"{type(self.priority).__name__}"
+            )
         for name, qty in self.extended_requests.items():
             if name in ("cpu", "memory"):
                 # These alias the core columns: resource_matrix would
@@ -202,11 +217,16 @@ class CapacityModel:
         mode: str = "strict",
         fixture: dict | None = None,
         allow_extensions: bool = True,
+        priority_table=None,
     ) -> None:
         self.snapshot = snapshot
         self.mode = mode
         self.fixture = fixture
         self.allow_extensions = allow_extensions
+        # Lazy PriorityTable (preemption surfaces); a caller that already
+        # holds the fixture's table (the service's cross-request cache)
+        # seeds it to skip the O(pods) fixture walk.
+        self._ptable = priority_table
 
     # -- mask assembly -----------------------------------------------------
     def _masks_for(self, spec: PodSpec) -> np.ndarray | None:
@@ -258,6 +278,50 @@ class CapacityModel:
                 "reference semantics; pass allow_extensions=True"
             )
 
+    # -- preemption (PodSpec.priority) -------------------------------------
+    def _priority_table(self):
+        """The snapshot's suffix-sum priority table, built once per model
+        over ALL extended columns (any spec's subset gathers from it)."""
+        if self._ptable is None:
+            from kubernetesclustercapacity_tpu.ops.preemption import (
+                build_priority_table,
+            )
+
+            self._ptable = build_priority_table(
+                self.fixture,
+                self.snapshot,
+                tuple(sorted(self.snapshot.extended)),
+            )
+        return self._ptable
+
+    def _check_preemption(self, spec: PodSpec) -> None:
+        if spec.priority is None:
+            return
+        if self.mode != "strict":
+            raise ValueError(
+                "preemption-aware capacity (PodSpec.priority) requires "
+                "strict semantics — the reference has no priority concept"
+            )
+        if self.fixture is None:
+            raise ValueError(
+                "preemption needs the source fixture (pod priorities are "
+                "not part of the dense snapshot)"
+            )
+
+    def _usage_arrays(self, spec: PodSpec):
+        """``(used_cpu, used_mem, pods_count)`` the kernels should see:
+        the snapshot's own arrays, or — when the spec carries a
+        ``priority`` — the preemption table's threshold columns (pods of
+        strictly lower priority treated as evictable)."""
+        snap = self.snapshot
+        if spec.priority is None:
+            return (
+                snap.used_cpu_req_milli,
+                snap.used_mem_req_bytes,
+                snap.pods_count,
+            )
+        return self._priority_table().columns(spec.priority)
+
     def _multi_fit_args(self, spec: PodSpec):
         """The R-dim kernel operands for a spec with extended requests —
         ONE definition of the row ordering and request vector, shared by
@@ -265,6 +329,16 @@ class CapacityModel:
         invariant)."""
         resources = ("cpu", "memory", *sorted(spec.extended_requests))
         alloc_rn, used_rn = self.snapshot.resource_matrix(resources)
+        if spec.priority is not None:
+            t = self._priority_table()
+            k = t.column_index(spec.priority)
+            used_rn = np.stack(
+                [
+                    t.used_cpu_ge[:, k],
+                    t.used_mem_ge[:, k],
+                    *(t.used_ext_ge[r][:, k] for r in resources[2:]),
+                ]
+            )
         reqs = np.array(
             [
                 spec.cpu_request_milli,
@@ -286,17 +360,19 @@ class CapacityModel:
         """
         snap = self.snapshot
         self._check_extensions(spec.constrained or bool(spec.extended_requests))
+        self._check_preemption(spec)
         mask = self._masks_for(spec)
 
         if not spec.extended_requests:
+            used_cpu, used_mem, pods_count = self._usage_arrays(spec)
             fits = np.asarray(
                 fit_per_node(
                     snap.alloc_cpu_milli,
                     snap.alloc_mem_bytes,
                     snap.alloc_pods,
-                    snap.used_cpu_req_milli,
-                    snap.used_mem_req_bytes,
-                    snap.pods_count,
+                    used_cpu,
+                    used_mem,
+                    pods_count,
                     snap.healthy,
                     spec.cpu_request_milli,
                     spec.mem_request_bytes,
@@ -310,12 +386,15 @@ class CapacityModel:
                     fits = np.where(mask, fits, 0)
         else:
             alloc_rn, used_rn, reqs = self._multi_fit_args(spec)
+            # cpu/mem usage already rides used_rn; only the pod count
+            # needs the (possibly preemption-adjusted) column here.
+            pods_count = self._usage_arrays(spec)[2]
             fits = np.asarray(
                 fit_per_node_multi(
                     alloc_rn,
                     used_rn,
                     snap.alloc_pods,
-                    snap.pods_count,
+                    pods_count,
                     snap.healthy,
                     reqs,
                     mode=self.mode,
@@ -372,6 +451,11 @@ class CapacityModel:
         (:func:`..ops.placement.place_replicas_multi` / ``_bulk_multi`` /
         ``_trace_multi``) over the snapshot's extended columns — same
         policies, same engine-selection rule.
+
+        A spec with ``priority`` places against the preemption-adjusted
+        headroom (lower-priority pods treated as already evicted) — the
+        "where would they land after preemption" upper bound; which
+        specific victims a real scheduler would pick is out of scope.
         """
         from kubernetesclustercapacity_tpu.ops.placement import (
             place_replicas,
@@ -385,6 +469,7 @@ class CapacityModel:
         self._check_extensions(
             spec.constrained or bool(spec.extended_requests)
         )
+        self._check_preemption(spec)
         snap = self.snapshot
         mask = self._masks_for(spec)
         kwargs = dict(
@@ -396,21 +481,22 @@ class CapacityModel:
         if spec.extended_requests:
             alloc_rn, used_rn, reqs = self._multi_fit_args(spec)
             args = (
-                alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
-                snap.healthy, reqs,
+                alloc_rn, used_rn, snap.alloc_pods,
+                self._usage_arrays(spec)[2], snap.healthy, reqs,
             )
             scan_fn, bulk_fn = place_replicas_multi, place_replicas_bulk_multi
             # The bulk multi engine needs at least one positive request
             # row (the 2-resource rule generalized).
             bulk_ok = (reqs > 0).any() and (reqs >= 0).all()
         else:
+            used_cpu, used_mem, pods_count = self._usage_arrays(spec)
             args = (
                 snap.alloc_cpu_milli,
                 snap.alloc_mem_bytes,
                 snap.alloc_pods,
-                snap.used_cpu_req_milli,
-                snap.used_mem_req_bytes,
-                snap.pods_count,
+                used_cpu,
+                used_mem,
+                pods_count,
                 snap.healthy,
                 spec.cpu_request_milli,
                 spec.mem_request_bytes,
@@ -505,6 +591,67 @@ class CapacityModel:
             snap.healthy,
             grid.cpu_request_milli,
             grid.mem_request_bytes,
+            grid.replicas,
+            mode=self.mode,
+            node_mask=mask,
+        )
+        return np.asarray(totals), np.asarray(sched)
+
+    def sweep_preemption(
+        self,
+        grid: ScenarioGrid,
+        priorities,
+        *,
+        tolerations: tuple = (),
+        node_selector: dict | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Preemption-aware grid sweep: scenario ``s`` evicts pods of
+        priority below ``priorities[s]``.
+
+        The ``[S]`` priority vector rides the scenario axis — an
+        in-graph ``searchsorted`` over the table's levels plus a
+        per-scenario column gather (:func:`..ops.preemption
+        .sweep_preemption`); strict semantics only, needs the model's
+        ``fixture``.  Shared constraints compose like :meth:`sweep`.
+        """
+        from kubernetesclustercapacity_tpu.ops.preemption import (
+            sweep_preemption,
+        )
+
+        grid.validate()
+        priorities = np.asarray(priorities, dtype=np.int64)
+        if priorities.shape != (grid.size,):
+            raise ValueError(
+                f"priorities: expected shape ({grid.size},), got "
+                f"{priorities.shape}"
+            )
+        # Reuse the spec gate with a minimal carrier spec: same errors,
+        # one wording.
+        self._check_preemption(
+            PodSpec(cpu_request_milli=1, mem_request_bytes=1, priority=0)
+        )
+        snap = self.snapshot
+        shared_spec = PodSpec(
+            cpu_request_milli=1,
+            mem_request_bytes=1,
+            tolerations=tolerations,
+            node_selector=node_selector or {},
+        )
+        self._check_extensions(shared_spec.constrained)
+        mask = self._masks_for(shared_spec)
+        t = self._priority_table()
+        totals, sched = sweep_preemption(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.healthy,
+            t.levels,
+            t.used_cpu_ge,
+            t.used_mem_ge,
+            t.pods_ge,
+            grid.cpu_request_milli,
+            grid.mem_request_bytes,
+            priorities,
             grid.replicas,
             mode=self.mode,
             node_mask=mask,

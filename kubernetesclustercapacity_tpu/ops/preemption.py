@@ -1,0 +1,248 @@
+"""Preemption-aware capacity: priority-threshold suffix tables + fit.
+
+The reference has no notion of pod priority — every Running pod consumes
+capacity unconditionally (`ClusterCapacity.go:105-140` sums all of them).
+Real kube-scheduler may *preempt*: a pending pod of priority ``p`` can
+evict pods of strictly lower priority to make room.  The capacity
+question this module answers is the preemption-aware upper bound:
+
+    "how many replicas of a priority-``p`` pod could the cluster hold if
+     every lower-priority pod may be evicted?"
+
+Survivors are exactly the pods with ``priority >= p``, so the usable
+per-node headroom is ``alloc - used_by(priority >= p)`` — a *suffix sum*
+over the sorted distinct priority levels present in the cluster.  That
+shape is TPU-friendly by construction:
+
+* :func:`build_priority_table` walks the fixture once (host side, same
+  strict-semantics rules as the packer: assigned, non-terminated pods,
+  ``max(sum(containers), max(initContainers))`` effective resources) and
+  materializes dense ``[N, K+1]`` tables — one suffix-summed column per
+  distinct priority level plus a final all-zero column for thresholds
+  above every level.
+* Any threshold is then ONE gathered column, and the standard fit kernel
+  (:func:`..fit.fit_per_node`) runs unchanged on the adjusted arrays —
+  preemption composes with masks, spread, and extended resources because
+  it only substitutes the ``used``/``pods_count`` operands.
+* The scenario axis extends naturally: a ``[S]`` priority vector becomes
+  ``searchsorted`` + a per-scenario column gather under ``vmap``
+  (:func:`sweep_preemption`) — the same compiled shape as every other
+  sweep in the framework.
+
+This is a strict-semantics extension (the reference cannot express it);
+:class:`..models.capacity.CapacityModel` gates it accordingly.  Pod
+priority is read from the fixture pod dict's ``"priority"`` key (the
+admission-resolved ``pod.spec.priority`` integer; absent → 0, matching
+the cluster default when no global-default PriorityClass exists).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+from kubernetesclustercapacity_tpu.snapshot import (
+    ClusterSnapshot,
+    _STRICT_TERMINATED,
+    _effective_pod_resources,
+)
+
+__all__ = [
+    "PriorityTable",
+    "build_priority_table",
+    "fit_with_preemption",
+    "sweep_preemption",
+]
+
+
+@dataclass
+class PriorityTable:
+    """Dense suffix-sum usage tables keyed by priority threshold.
+
+    ``levels`` is the ascending ``[K]`` vector of distinct priorities
+    present among counted pods.  Every usage array is ``[N, K+1]`` int64:
+    column ``k`` holds the resources consumed by pods with
+    ``priority >= levels[k]``; the extra final column is all zeros (a
+    threshold above every level evicts everything).  Column 0 therefore
+    equals the snapshot's plain strict usage — pinned by
+    ``tests/test_preemption.py``.  :func:`column_index` maps a threshold
+    to its column.
+    """
+
+    levels: np.ndarray  # [K] int64, ascending
+    used_cpu_ge: np.ndarray  # [N, K+1] int64
+    used_mem_ge: np.ndarray  # [N, K+1] int64
+    pods_ge: np.ndarray  # [N, K+1] int64
+    used_ext_ge: dict[str, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def n_nodes(self) -> int:
+        return self.used_cpu_ge.shape[0]
+
+    def column_index(self, priority: int) -> int:
+        """Column for threshold ``priority``: the first level >= it
+        (``side='left'``), or the zero column when it exceeds them all."""
+        return int(np.searchsorted(self.levels, int(priority), side="left"))
+
+    def columns(self, priority: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """``(used_cpu[N], used_mem[N], pods_count[N])`` for one threshold."""
+        k = self.column_index(priority)
+        return self.used_cpu_ge[:, k], self.used_mem_ge[:, k], self.pods_ge[:, k]
+
+
+def _suffix_sum(per_level: np.ndarray) -> np.ndarray:
+    """``[N, K]`` per-level sums → ``[N, K+1]`` suffix sums + zero column."""
+    n = per_level.shape[0]
+    ge = np.cumsum(per_level[:, ::-1], axis=1)[:, ::-1]
+    return np.concatenate([ge, np.zeros((n, 1), dtype=np.int64)], axis=1)
+
+
+def build_priority_table(
+    fixture: dict,
+    snapshot: ClusterSnapshot,
+    extended_resources: tuple[str, ...] = (),
+) -> PriorityTable:
+    """One host-side fixture walk → the dense ``[N, K+1]`` tables.
+
+    Pod filtering and effective-resource math mirror the strict packer
+    exactly (assigned to a known node, phase not terminated,
+    ``max(sum(containers), max(initContainers))`` — the walk shares
+    :func:`..snapshot._effective_pod_resources`), so column 0 reproduces
+    the snapshot's ``used_*``/``pods_count`` arrays bit-for-bit.
+    """
+    index = {name: i for i, name in enumerate(snapshot.names)}
+    n = snapshot.n_nodes
+    node_idx: list[int] = []
+    prios: list[int] = []
+    cpu_eff: list[int] = []
+    mem_eff: list[int] = []
+    ext_eff: dict[str, list[int]] = {r: [] for r in extended_resources}
+    for pod in fixture.get("pods", []):
+        node_name = pod.get("nodeName", "")
+        if not node_name or node_name not in index:
+            continue
+        if pod.get("phase") in _STRICT_TERMINATED:
+            continue
+        eff = _effective_pod_resources(pod, extended_resources)
+        node_idx.append(index[node_name])
+        prios.append(int(pod.get("priority", 0)))
+        cpu_eff.append(eff["cpu_req"])
+        mem_eff.append(eff["mem_req"])
+        for r in extended_resources:
+            ext_eff[r].append(eff["ext"][r])
+
+    levels = np.array(sorted(set(prios)), dtype=np.int64)  # [K]
+    k = levels.shape[0]
+    idx = np.asarray(node_idx, dtype=np.int64)
+    li = np.searchsorted(levels, np.asarray(prios, dtype=np.int64))
+
+    def table_for(values: list[int]) -> np.ndarray:
+        per_level = np.zeros((n, k), dtype=np.int64)
+        np.add.at(per_level, (idx, li), np.asarray(values, dtype=np.int64))
+        return _suffix_sum(per_level)
+
+    return PriorityTable(
+        levels=levels,
+        used_cpu_ge=table_for(cpu_eff),
+        used_mem_ge=table_for(mem_eff),
+        pods_ge=table_for([1] * len(node_idx)),
+        used_ext_ge={r: table_for(ext_eff[r]) for r in extended_resources},
+    )
+
+
+def fit_with_preemption(
+    snapshot: ClusterSnapshot,
+    table: PriorityTable,
+    cpu_req,
+    mem_req,
+    priority: int,
+    *,
+    mode: str = "strict",
+    node_mask=None,
+) -> np.ndarray:
+    """Per-node preemptive fit for ONE spec — ``[N]`` int64.
+
+    Substitutes the threshold's usage columns into the standard kernel;
+    everything else (mode epilogue, mask) is :func:`..fit.fit_per_node`
+    unchanged.
+    """
+    used_cpu, used_mem, pods_count = table.columns(priority)
+    return np.asarray(
+        fit_per_node(
+            snapshot.alloc_cpu_milli,
+            snapshot.alloc_mem_bytes,
+            snapshot.alloc_pods,
+            used_cpu,
+            used_mem,
+            pods_count,
+            snapshot.healthy,
+            cpu_req,
+            mem_req,
+            mode=mode,
+            node_mask=node_mask,
+        )
+    )
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def sweep_preemption(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    healthy,
+    levels,
+    used_cpu_ge,
+    used_mem_ge,
+    pods_ge,
+    cpu_reqs,
+    mem_reqs,
+    priorities,
+    replicas,
+    *,
+    mode: str = "strict",
+    node_mask=None,
+):
+    """S preemption scenarios in one compiled program.
+
+    ``priorities[S]`` maps to table columns via an in-graph
+    ``searchsorted`` over ``levels[K]``; each scenario gathers its
+    ``[N]`` usage columns and runs the standard fit — ``vmap`` over
+    ``(cpu_reqs, mem_reqs, priorities)``.  Returns
+    ``(totals[S], schedulable[S])``.
+    """
+    levels = jnp.asarray(levels, jnp.int64)
+    used_cpu_ge = jnp.asarray(used_cpu_ge, jnp.int64)
+    used_mem_ge = jnp.asarray(used_mem_ge, jnp.int64)
+    pods_ge = jnp.asarray(pods_ge, jnp.int64)
+    kidx = jnp.searchsorted(
+        levels, jnp.asarray(priorities, jnp.int64), side="left"
+    )
+
+    def one(c, m, k):
+        return fit_per_node(
+            alloc_cpu,
+            alloc_mem,
+            alloc_pods,
+            used_cpu_ge[:, k],
+            used_mem_ge[:, k],
+            pods_ge[:, k],
+            healthy,
+            c,
+            m,
+            mode=mode,
+            node_mask=node_mask,
+        )
+
+    fits = jax.vmap(one)(
+        jnp.asarray(cpu_reqs, jnp.int64),
+        jnp.asarray(mem_reqs, jnp.int64),
+        kidx,
+    )
+    totals = jnp.sum(fits, axis=1)
+    schedulable = totals >= jnp.asarray(replicas, jnp.int64)
+    return totals, schedulable

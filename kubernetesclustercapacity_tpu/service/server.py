@@ -101,6 +101,7 @@ class CapacityServer:
         self.fixture = fixture
         self._store = None  # lazy ClusterStore, built on first update op
         self._fixture_dirty = False  # fixture lags the store until needed
+        self._ptable_cache = None  # (fixture, snapshot, PriorityTable)
         self._implicit_mask = _implicit_taint_mask(snapshot)
         self._auth_token = auth_token
         self._max_inflight = max(1, int(max_inflight))
@@ -214,6 +215,7 @@ class CapacityServer:
         "anti_affinity_labels",
         "spread",
         "extended_requests",
+        "priority",
     )
 
     @staticmethod
@@ -240,6 +242,7 @@ class CapacityServer:
         from kubernetesclustercapacity_tpu.models import PodSpec
 
         spread = msg.get("spread")
+        priority = msg.get("priority")
         try:
             return PodSpec(
                 cpu_request_milli=scenario.cpu_request_milli,
@@ -255,6 +258,7 @@ class CapacityServer:
                 ),
                 namespace=msg.get("namespace"),
                 spread=int(spread) if spread is not None else None,
+                priority=int(priority) if priority is not None else None,
                 extended_requests={
                     k: int(v)
                     for k, v in (msg.get("extended_requests") or {}).items()
@@ -263,6 +267,45 @@ class CapacityServer:
         except (TypeError, KeyError, ValueError) as e:
             raise ValueError(f"bad pod spec: {e}") from e
 
+    def _priority_table_for(self, fixture: dict, snap: ClusterSnapshot):
+        """The preemption table, cached across dispatches.
+
+        Self-validating by ``(fixture, snapshot)`` object identity: both
+        are REPLACED, never mutated, on reload/update rematerialization,
+        so a stale pair cannot match and no invalidation hooks are
+        needed.  Concurrent misses may build twice; the atomic tuple
+        swap keeps the cache coherent either way.
+        """
+        from kubernetesclustercapacity_tpu.ops.preemption import (
+            build_priority_table,
+        )
+
+        cached = self._ptable_cache
+        if (
+            cached is not None
+            and cached[0] is fixture
+            and cached[1] is snap
+        ):
+            return cached[2]
+        table = build_priority_table(
+            fixture, snap, tuple(sorted(snap.extended))
+        )
+        self._ptable_cache = (fixture, snap, table)
+        return table
+
+    def _model_for(self, spec, snap: ClusterSnapshot, fixture: dict | None):
+        """CapacityModel with the cached preemption table pre-seeded when
+        the spec needs one (and the fixture exists to build it — a
+        missing fixture keeps the model's own error path)."""
+        from kubernetesclustercapacity_tpu.models import CapacityModel
+
+        table = None
+        if spec.priority is not None and fixture is not None:
+            table = self._priority_table_for(fixture, snap)
+        return CapacityModel(
+            snap, mode=snap.semantics, fixture=fixture, priority_table=table
+        )
+
     @staticmethod
     def _fit_consumes_fixture(msg: dict, semantics: str) -> bool:
         """The fit paths that read raw objects, not just packed arrays:
@@ -270,8 +313,13 @@ class CapacityServer:
         labels are not in the arrays).  dispatch() uses this to decide
         whether a store-dirty fixture must be rematerialized."""
         return (
-            msg.get("backend") == "cpu" and semantics == "reference"
-        ) or "anti_affinity_labels" in msg
+            (msg.get("backend") == "cpu" and semantics == "reference")
+            or "anti_affinity_labels" in msg
+            # Preemption builds its priority table from raw pod objects
+            # (priorities are not in the arrays); _priority_table_for
+            # caches it across dispatches by fixture/snapshot identity.
+            or "priority" in msg
+        )
 
     def _op_fit(
         self,
@@ -376,13 +424,9 @@ class CapacityServer:
         flags could not express (SURVEY.md §5 "failure detection" masks,
         BASELINE configs 4-5).
         """
-        from kubernetesclustercapacity_tpu.models import CapacityModel
-
         spec = self._spec_from_msg(msg, scenario)
         try:
-            model = CapacityModel(
-                snap, mode=snap.semantics, fixture=fixture
-            )
+            model = self._model_for(spec, snap, fixture)
             result = model.evaluate(spec)
         except (TypeError, KeyError, ValueError) as e:
             raise ValueError(f"bad pod spec: {e}") from e
@@ -401,8 +445,6 @@ class CapacityServer:
         Accepts the same spec fields as fit (one shared msg→PodSpec
         parser), so (anti-)affinity constraints bind placements too.
         """
-        from kubernetesclustercapacity_tpu.models import CapacityModel
-
         scenario = self._scenario_from_msg(msg)
         spec = self._spec_from_msg(msg, scenario)
         # Wire flag ``assignments``: false = counts-only (bulk engine,
@@ -416,7 +458,7 @@ class CapacityServer:
                 f"assignments must be a JSON bool, got {want_order!r}"
             )
         try:
-            model = CapacityModel(snap, mode=snap.semantics, fixture=fixture)
+            model = self._model_for(spec, snap, fixture)
             result = model.place(
                 spec,
                 policy=msg.get("policy", "first-fit"),

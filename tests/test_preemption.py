@@ -1,0 +1,390 @@
+"""Preemption-aware capacity (``ops/preemption.py``, ``PodSpec.priority``).
+
+The oracle here is an INDEPENDENT per-node Python loop (its own container
+walk and strict fit math), so the suffix-table construction, the column
+gather, and the kernel substitution are all cross-checked against a
+different implementation — the same pattern that pins the fit kernels to
+``oracle/reference.py``.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
+from kubernetesclustercapacity_tpu.ops.preemption import (
+    build_priority_table,
+    fit_with_preemption,
+    sweep_preemption,
+)
+from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+from kubernetesclustercapacity_tpu.utils.quantity import (
+    QuantityParseError,
+    parse_quantity,
+)
+
+MIB = 1024 * 1024
+
+
+# -- independent oracle ----------------------------------------------------
+def _parse(s, milli=False):
+    if s is None:
+        return 0
+    try:
+        q = parse_quantity(s)
+    except QuantityParseError:
+        return 0
+    return q.milli_value() if milli else q.value()
+
+
+def _pod_eff(pod):
+    """max(sum(containers), max(initContainers)) — written independently."""
+    sums = [0, 0]
+    for c in pod.get("containers", []):
+        req = c.get("resources", {}).get("requests", {})
+        sums[0] += _parse(req.get("cpu"), milli=True)
+        sums[1] += _parse(req.get("memory"))
+    for c in pod.get("initContainers", []):
+        req = c.get("resources", {}).get("requests", {})
+        sums[0] = max(sums[0], _parse(req.get("cpu"), milli=True))
+        sums[1] = max(sums[1], _parse(req.get("memory")))
+    return sums
+
+
+def oracle_preemptive_fits(fixture, priority, cpu_req, mem_req):
+    """Strict per-node fits counting only pods with priority >= threshold."""
+    fits = []
+    for node in fixture.get("nodes", []):
+        name = node.get("name", "")
+        alloc = node.get("allocatable", {})
+        alloc_cpu = _parse(alloc.get("cpu"), milli=True)
+        alloc_mem = _parse(alloc.get("memory"))
+        alloc_pods = _parse(alloc.get("pods"))
+        ready = False
+        pressured = False
+        for c in node.get("conditions", []):
+            if c.get("type") == "Ready":
+                ready = c.get("status") == "True"
+            elif c.get("status") == "True":
+                pressured = True
+        used_cpu = used_mem = n_pods = 0
+        for pod in fixture.get("pods", []):
+            if pod.get("nodeName") != name or not name:
+                continue
+            if pod.get("phase") in ("Succeeded", "Failed"):
+                continue
+            if int(pod.get("priority", 0)) < priority:
+                continue  # evictable — does not survive preemption
+            eff = _pod_eff(pod)
+            used_cpu += eff[0]
+            used_mem += eff[1]
+            n_pods += 1
+        cpu_fit = 0 if alloc_cpu <= used_cpu else (alloc_cpu - used_cpu) // cpu_req
+        mem_fit = 0 if alloc_mem <= used_mem else (alloc_mem - used_mem) // mem_req
+        slots = max(alloc_pods - n_pods, 0)
+        fit = max(min(cpu_fit, mem_fit, slots), 0)
+        fits.append(fit if (ready and not pressured) else 0)
+    return np.array(fits, dtype=np.int64)
+
+
+def _prioritized_fixture(n_nodes=20, seed=7):
+    """A synthetic strict cluster with priorities stamped on deep-copied
+    pods (synthetic_fixture aliases pod dicts — stamping without the copy
+    would smear one priority across many pods)."""
+    fx = copy.deepcopy(synthetic_fixture(n_nodes, seed=seed))
+    rng = np.random.default_rng(seed)
+    choices = np.array([-100, -5, 0, 0, 10, 1000, 2**20])
+    for pod in fx["pods"]:
+        p = int(rng.choice(choices))
+        if p != 0:  # absent key must mean 0 — leave some pods keyless
+            pod["priority"] = p
+    return fx
+
+
+@pytest.fixture(scope="module")
+def prio_setup():
+    fx = _prioritized_fixture()
+    snap = snapshot_from_fixture(fx, semantics="strict")
+    table = build_priority_table(fx, snap)
+    return fx, snap, table
+
+
+# -- table invariants ------------------------------------------------------
+class TestTable:
+    def test_column0_is_snapshot_usage(self, prio_setup):
+        _, snap, t = prio_setup
+        np.testing.assert_array_equal(t.used_cpu_ge[:, 0], snap.used_cpu_req_milli)
+        np.testing.assert_array_equal(t.used_mem_ge[:, 0], snap.used_mem_req_bytes)
+        np.testing.assert_array_equal(t.pods_ge[:, 0], snap.pods_count)
+
+    def test_last_column_zero(self, prio_setup):
+        _, _, t = prio_setup
+        for arr in (t.used_cpu_ge, t.used_mem_ge, t.pods_ge):
+            assert not arr[:, -1].any()
+
+    def test_columns_monotone_nonincreasing(self, prio_setup):
+        _, _, t = prio_setup
+        for arr in (t.used_cpu_ge, t.used_mem_ge, t.pods_ge):
+            assert (np.diff(arr, axis=1) <= 0).all()
+
+    def test_levels_sorted_distinct(self, prio_setup):
+        _, _, t = prio_setup
+        assert (np.diff(t.levels) > 0).all()
+
+    def test_column_index_thresholds(self, prio_setup):
+        _, _, t = prio_setup
+        assert t.column_index(int(t.levels[0]) - 1) == 0
+        assert t.column_index(int(t.levels[0])) == 0
+        assert t.column_index(int(t.levels[-1])) == len(t.levels) - 1
+        assert t.column_index(int(t.levels[-1]) + 1) == len(t.levels)
+
+    def test_empty_cluster_table(self):
+        fx = {"nodes": [{"name": "n", "allocatable": {
+            "cpu": "4", "memory": "8388608Ki", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}]}], "pods": []}
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        t = build_priority_table(fx, snap)
+        assert t.levels.shape == (0,)
+        assert t.used_cpu_ge.shape == (1, 1)
+        fits = fit_with_preemption(snap, t, 1000, 256 * MIB, priority=0)
+        assert fits[0] == 4  # cpu-bound on the empty node
+
+
+# -- oracle parity ---------------------------------------------------------
+class TestOracleParity:
+    @pytest.mark.parametrize("offset", ["below", "exact", "between", "above"])
+    def test_fits_match_oracle(self, prio_setup, offset):
+        fx, snap, t = prio_setup
+        levels = t.levels
+        priority = {
+            "below": int(levels[0]) - 7,
+            "exact": int(levels[len(levels) // 2]),
+            "between": int(levels[0]) + 1,  # -100+1: between -100 and -5
+            "above": int(levels[-1]) + 1,
+        }[offset]
+        got = fit_with_preemption(snap, t, 250, 96 * MIB, priority=priority)
+        want = oracle_preemptive_fits(fx, priority, 250, 96 * MIB)
+        np.testing.assert_array_equal(got, want)
+
+    def test_min_priority_equals_plain_strict_fit(self, prio_setup):
+        fx, snap, t = prio_setup
+        model = CapacityModel(snap, mode="strict", fixture=fx)
+        plain = model.evaluate(PodSpec(cpu_request_milli=250,
+                                       mem_request_bytes=96 * MIB))
+        pre = model.evaluate(PodSpec(cpu_request_milli=250,
+                                     mem_request_bytes=96 * MIB,
+                                     priority=int(t.levels[0])))
+        np.testing.assert_array_equal(pre.fits, plain.fits)
+
+    def test_above_max_priority_sees_empty_cluster(self, prio_setup):
+        fx, snap, t = prio_setup
+        empty = copy.deepcopy(fx)
+        empty["pods"] = []
+        snap_empty = snapshot_from_fixture(empty, semantics="strict")
+        model_empty = CapacityModel(snap_empty, mode="strict", fixture=empty)
+        want = model_empty.evaluate(
+            PodSpec(cpu_request_milli=250, mem_request_bytes=96 * MIB)
+        ).fits
+        got = fit_with_preemption(
+            snap, t, 250, 96 * MIB, priority=int(t.levels[-1]) + 1
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_totals_monotone_in_priority(self, prio_setup):
+        """Higher priority can only free capacity, never reduce it."""
+        _, snap, t = prio_setup
+        totals = [
+            fit_with_preemption(snap, t, 250, 96 * MIB, priority=p).sum()
+            for p in [int(x) for x in t.levels] + [int(t.levels[-1]) + 1]
+        ]
+        assert all(a <= b for a, b in zip(totals, totals[1:]))
+
+
+# -- model surface ---------------------------------------------------------
+class TestModelSurface:
+    def test_reference_mode_rejected(self, prio_setup):
+        fx, _, _ = prio_setup
+        snap_ref = snapshot_from_fixture(fx, semantics="reference")
+        model = CapacityModel(snap_ref, mode="reference", fixture=fx)
+        with pytest.raises(ValueError, match="strict semantics"):
+            model.evaluate(PodSpec(cpu_request_milli=250,
+                                   mem_request_bytes=96 * MIB, priority=0))
+
+    def test_missing_fixture_rejected(self, prio_setup):
+        _, snap, _ = prio_setup
+        model = CapacityModel(snap, mode="strict")
+        with pytest.raises(ValueError, match="fixture"):
+            model.evaluate(PodSpec(cpu_request_milli=250,
+                                   mem_request_bytes=96 * MIB, priority=0))
+
+    def test_non_int_priority_rejected(self):
+        with pytest.raises(ValueError, match="priority"):
+            PodSpec(cpu_request_milli=1, mem_request_bytes=1, priority="high")
+
+    def test_composes_with_spread_and_selector(self, prio_setup):
+        fx, snap, t = prio_setup
+        model = CapacityModel(snap, mode="strict", fixture=fx)
+        high = int(t.levels[-1]) + 1
+        spec = PodSpec(cpu_request_milli=250, mem_request_bytes=96 * MIB,
+                       priority=high, spread=2)
+        r = model.evaluate(spec)
+        assert r.fits.max() <= 2
+        # spread caps on top of the preemption-freed headroom
+        uncapped = model.evaluate(
+            PodSpec(cpu_request_milli=250, mem_request_bytes=96 * MIB,
+                    priority=high)
+        )
+        np.testing.assert_array_equal(r.fits, np.minimum(uncapped.fits, 2))
+
+    def test_extended_requests_route(self):
+        fx = {
+            "nodes": [{
+                "name": "g", "allocatable": {
+                    "cpu": "64", "memory": "8388608Ki", "pods": "110",
+                    "nvidia.com/gpu": "8"},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            }],
+            "pods": [{
+                "name": "lowprio-gpu-hog", "namespace": "d", "nodeName": "g",
+                "phase": "Running", "priority": -1,
+                "containers": [{"resources": {"requests": {
+                    "cpu": "1", "memory": "1048576Ki",
+                    "nvidia.com/gpu": "6"}}}],
+            }],
+        }
+        snap = snapshot_from_fixture(
+            fx, semantics="strict", extended_resources=("nvidia.com/gpu",)
+        )
+        model = CapacityModel(snap, mode="strict", fixture=fx)
+        spec = dict(cpu_request_milli=1000, mem_request_bytes=64 * MIB,
+                    extended_requests={"nvidia.com/gpu": 2})
+        without = model.evaluate(PodSpec(**spec))
+        assert without.total == 1  # 2 GPUs free of 8
+        evicting = model.evaluate(PodSpec(**spec, priority=0))
+        assert evicting.total == 4  # all 8 GPUs after evicting the hog
+
+    def test_place_with_priority(self, prio_setup):
+        fx, snap, t = prio_setup
+        model = CapacityModel(snap, mode="strict", fixture=fx)
+        high = int(t.levels[-1]) + 1
+        spec = PodSpec(cpu_request_milli=250, mem_request_bytes=96 * MIB,
+                       replicas=40, priority=high)
+        fits = model.evaluate(spec).fits
+        for engine in (True, False):
+            placement = model.place(spec, policy="first-fit",
+                                    assignments=engine)
+            assert placement.placed == min(40, int(fits.sum()))
+            assert (placement.per_node <= fits).all()
+
+
+# -- sweep -----------------------------------------------------------------
+class TestSweep:
+    def test_sweep_matches_per_scenario_evaluate(self, prio_setup):
+        fx, snap, t = prio_setup
+        model = CapacityModel(snap, mode="strict", fixture=fx)
+        rng = np.random.default_rng(3)
+        s = 17
+        grid = ScenarioGrid(
+            cpu_request_milli=rng.integers(50, 2000, s),
+            mem_request_bytes=rng.integers(MIB, 512 * MIB, s),
+            replicas=rng.integers(0, 50, s),
+        )
+        lo = int(t.levels[0]) - 1
+        hi = int(t.levels[-1]) + 1
+        priorities = rng.integers(lo, hi + 1, s)
+        totals, sched = model.sweep_preemption(grid, priorities)
+        for i in range(s):
+            r = model.evaluate(PodSpec(
+                cpu_request_milli=int(grid.cpu_request_milli[i]),
+                mem_request_bytes=int(grid.mem_request_bytes[i]),
+                replicas=int(grid.replicas[i]),
+                priority=int(priorities[i]),
+            ))
+            assert totals[i] == r.total
+            assert sched[i] == r.schedulable
+
+    def test_sweep_priorities_shape_checked(self, prio_setup):
+        fx, snap, _ = prio_setup
+        model = CapacityModel(snap, mode="strict", fixture=fx)
+        grid = ScenarioGrid(
+            cpu_request_milli=np.array([100]),
+            mem_request_bytes=np.array([MIB]),
+            replicas=np.array([1]),
+        )
+        with pytest.raises(ValueError, match="priorities"):
+            model.sweep_preemption(grid, [0, 1])
+
+    def test_ops_sweep_empty_levels(self):
+        """K=0 (no pods): every threshold gathers the zero column."""
+        totals, sched = sweep_preemption(
+            np.array([4000]), np.array([8 * 1024 * MIB]), np.array([110]),
+            np.array([True]),
+            np.zeros(0, dtype=np.int64),
+            np.zeros((1, 1), dtype=np.int64),
+            np.zeros((1, 1), dtype=np.int64),
+            np.zeros((1, 1), dtype=np.int64),
+            np.array([1000]), np.array([256 * MIB]), np.array([0]),
+            np.array([4]),
+            mode="strict",
+        )
+        assert int(totals[0]) == 4 and bool(sched[0])
+
+
+# -- live-cluster plumbing -------------------------------------------------
+class TestLiveFixtureSchema:
+    def test_pod_to_fixture_carries_priority(self):
+        from kubernetesclustercapacity_tpu.kubeapi import pod_to_fixture
+
+        rest_pod = {
+            "metadata": {"name": "p", "namespace": "d"},
+            "spec": {"nodeName": "n", "priority": 2000000000,
+                     "containers": []},
+            "status": {"phase": "Running"},
+        }
+        assert pod_to_fixture(rest_pod)["priority"] == 2000000000
+        # Absent stays absent: fixture readers default it to 0.
+        del rest_pod["spec"]["priority"]
+        assert "priority" not in pod_to_fixture(rest_pod)
+
+
+# -- service wire ----------------------------------------------------------
+class TestServiceWire:
+    def test_fit_priority_over_the_wire(self):
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        fx = _prioritized_fixture(8, seed=11)
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        srv = CapacityServer(snap, port=0, fixture=fx)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                base = c.fit(cpuRequests="250m", memRequests="96mb")
+                pre = c.fit(cpuRequests="250m", memRequests="96mb",
+                            priority=2**21)  # above every stamped level
+                assert pre["total"] >= base["total"]
+                table = build_priority_table(fx, snap)
+                want = fit_with_preemption(
+                    snap, table, 250, 96 * MIB, priority=2**21
+                )
+                np.testing.assert_array_equal(np.array(pre["fits"]), want)
+        finally:
+            srv.shutdown()
+
+    def test_server_table_cache_identity(self):
+        from kubernetesclustercapacity_tpu.service import CapacityServer
+
+        fx = _prioritized_fixture(5, seed=2)
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        srv = CapacityServer(snap, port=0, fixture=fx)
+        t1 = srv._priority_table_for(fx, snap)
+        assert srv._priority_table_for(fx, snap) is t1  # cache hit
+        fx2 = copy.deepcopy(fx)  # rematerialized fixture = new object
+        t2 = srv._priority_table_for(fx2, snap)
+        assert t2 is not t1
+        assert srv._priority_table_for(fx2, snap) is t2
