@@ -103,6 +103,28 @@ def main() -> None:
           f"{int(other_ns.fits[1])}")
     assert repelled.fits[1] == 0 and other_ns.fits[1] > 0
 
+    # Preemption-aware capacity: a batch pod at priority -100 is
+    # evictable for anything at priority >= its own+1, so a
+    # priority-1000 spec sees the headroom it would free (the
+    # kube-scheduler preemption upper bound, ops/preemption.py).
+    fixture["pods"].append({
+        "name": "batch-hog", "namespace": "batch",
+        "nodeName": fixture["nodes"][2]["name"], "phase": "Running",
+        "priority": -100,
+        "containers": [{"resources": {"requests": {
+            "cpu": "3", "memory": "4194304Ki"}}}],
+    })
+    psnap = kcc.snapshot_from_fixture(fixture, semantics="strict")
+    pmodel = CapacityModel(psnap, mode="strict", fixture=fixture)
+    ask = dict(cpu_request_milli=1000, mem_request_bytes=1 << 30,
+               tolerations=({"operator": "Exists"},))
+    squeezed = pmodel.evaluate(PodSpec(**ask))
+    preempting = pmodel.evaluate(PodSpec(**ask, priority=1000))
+    print(f"\npreemption: node-2 fits {int(squeezed.fits[2])} around the "
+          f"batch hog, {int(preempting.fits[2])} when priority 1000 may "
+          f"evict it")
+    assert preempting.fits[2] > squeezed.fits[2]
+
 
 if __name__ == "__main__":
     main()
