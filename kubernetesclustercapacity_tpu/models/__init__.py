@@ -3,6 +3,7 @@
 from kubernetesclustercapacity_tpu.models.capacity import (  # noqa: F401
     CapacityModel,
     CapacityResult,
+    DrainResult,
     PlacementResult,
     PodSpec,
 )

@@ -187,6 +187,29 @@ class PlacementResult:
 
 
 @dataclass
+class DrainResult:
+    """Outcome of a drain simulation: a rehoming target per evicted pod.
+
+    ``assignments[i]`` is the node name that takes ``pods[i]`` (placed in
+    the order given, size-descending), or ``None`` if no remaining node
+    can — ``evictable`` is the drain verdict.
+    """
+
+    node: str
+    pods: list[str]  # "namespace/name" keys, in placement order
+    assignments: list[str | None]
+    per_node: np.ndarray  # [N] rehomed-pod counts (0 at the drained node)
+    policy: str
+
+    @property
+    def evictable(self) -> bool:
+        return all(a is not None for a in self.assignments)
+
+    def by_pod(self) -> dict[str, str | None]:
+        return dict(zip(self.pods, self.assignments))
+
+
+@dataclass
 class CapacityResult:
     """Outcome of one evaluation: per-node fits, total, and the verdict."""
 
@@ -550,6 +573,118 @@ class CapacityModel:
             policy=policy,
             requested=spec.replicas,
             engine=engine,
+        )
+
+    def drain(
+        self, node_name: str, *, policy: str = "best-fit"
+    ) -> DrainResult:
+        """Simulate ``kubectl drain``: can this node's pods be rehomed?
+
+        Collects the node's counted pods (strict rules: non-terminated,
+        scheduler-effective requests), sorts them size-descending (the
+        first-fit-decreasing heuristic), and places each — with its OWN
+        requests — onto the remaining nodes via
+        :func:`..ops.placement.place_pods`.  The drained node is masked
+        out; hard-tainted nodes are excluded as rehoming targets (the
+        conservative strict-mode assumption — evicted pods' tolerations
+        are not part of the fixture schema).
+
+        Strict semantics only; needs the model's ``fixture`` (per-pod
+        requests are not recoverable from the dense per-node sums).
+        Rehoming feasibility covers cpu/memory/pod slots, plus every
+        extended column some evicted pod actually requests (GPU pods
+        only land where GPUs are free).  DaemonSet pods are NOT
+        distinguished (the fixture schema carries no ownerReferences) —
+        a real ``kubectl drain`` skips them; filter the fixture first if
+        that distinction matters.
+        """
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_pods_multi,
+        )
+        from kubernetesclustercapacity_tpu.snapshot import (
+            _STRICT_TERMINATED,
+            _effective_pod_resources,
+        )
+
+        if self.mode != "strict":
+            raise ValueError(
+                "drain simulation requires strict semantics (reference "
+                "semantics has no eviction concept)"
+            )
+        if self.fixture is None:
+            raise ValueError(
+                "drain needs the source fixture (per-pod requests are not "
+                "part of the dense snapshot)"
+            )
+        snap = self.snapshot
+        try:
+            node_idx = snap.names.index(node_name)
+        except ValueError:
+            raise ValueError(f"unknown node {node_name!r}") from None
+
+        ext_names = tuple(sorted(snap.extended))
+        pods: list[tuple[str, dict]] = []
+        for pod in self.fixture.get("pods", []):
+            if pod.get("nodeName") != node_name:
+                continue
+            if pod.get("phase") in _STRICT_TERMINATED:
+                continue
+            key = f"{pod.get('namespace', '')}/{pod.get('name', '')}"
+            pods.append((key, _effective_pod_resources(pod, ext_names)))
+        # First-fit-decreasing order; name breaks ties so the plan is
+        # deterministic across runs.
+        pods.sort(
+            key=lambda t: (-t[1]["cpu_req"], -t[1]["mem_req"], t[0])
+        )
+
+        if not pods:
+            return DrainResult(
+                node=node_name, pods=[], assignments=[],
+                per_node=np.zeros(snap.n_nodes, dtype=np.int64),
+                policy=policy,
+            )
+        # Resource rows: cpu/mem plus only the extended columns the
+        # evicted pods actually request (inactive rows change nothing
+        # and would widen the compiled shape for every drain).
+        live_ext = tuple(
+            r for r in ext_names if any(e["ext"][r] > 0 for _, e in pods)
+        )
+        resources = ("cpu", "memory", *live_ext)
+        alloc_rn, used_rn = snap.resource_matrix(resources)
+        reqs_rp = np.array(
+            [
+                [e["cpu_req"] for _, e in pods],
+                [e["mem_req"] for _, e in pods],
+                *([e["ext"][r] for _, e in pods] for r in live_ext),
+            ],
+            dtype=np.int64,
+        )
+
+        mask = self._masks_for(
+            PodSpec(cpu_request_milli=1, mem_request_bytes=1)
+        )
+        mask = np.ones(snap.n_nodes, dtype=bool) if mask is None else mask.copy()
+        mask[node_idx] = False
+
+        assignments, counts = place_pods_multi(
+            alloc_rn,
+            used_rn,
+            snap.alloc_pods,
+            snap.pods_count,
+            snap.healthy,
+            reqs_rp,
+            policy=policy,
+            node_mask=mask,
+        )
+        return DrainResult(
+            node=node_name,
+            pods=[k for k, _ in pods],
+            assignments=[
+                snap.names[i] if i >= 0 else None
+                for i in assignments.tolist()
+            ],
+            per_node=np.asarray(counts),
+            policy=policy,
         )
 
     def sweep(

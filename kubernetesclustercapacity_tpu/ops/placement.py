@@ -38,6 +38,10 @@ __all__ = [
     "place_replicas_bulk",
     "place_replicas_trace",
     "place_replicas_python",
+    "place_pods",
+    "place_pods_python",
+    "place_pods_multi",
+    "place_pods_multi_python",
     "place_replicas_multi",
     "place_replicas_bulk_multi",
     "place_replicas_trace_multi",
@@ -568,6 +572,285 @@ def place_replicas_python(
         counts[best] += 1
         assignments.append(best)
     return assignments, counts
+
+
+# --- Heterogeneous-pod placement (drain / rehoming simulation).
+#
+# place_replicas places R IDENTICAL replicas; a drain simulation must
+# rehome a node's EXISTING pods, each with its own requests.  The scan
+# body therefore re-derives feasibility and scores for every node at
+# every step (the per-step request changes, so the incremental-score
+# trick above does not apply — nothing is reusable between steps), and
+# pods place in the caller's order (callers sort; CapacityModel.drain
+# uses size-descending, the classic first-fit-decreasing heuristic).
+# The general engine is R-resource (the zero-request "does not consume"
+# convention of place_replicas_multi, which per-pod zero entries need
+# anyway: a requestless pod consumes only a slot); place_pods is the
+# (cpu, mem) row-stacking wrapper.  The pod axis pads to power-of-two
+# buckets with an in-scan validity lane, so a serving path draining
+# differently-populated nodes compiles once per (policy, R, bucket)
+# instead of once per pod count.
+
+
+def _pod_bucket(p: int) -> int:
+    """Smallest power of two >= p (min 8) — the scan-length pad target."""
+    b = 8
+    while b < p:
+        b *= 2
+    return b
+
+
+@partial(jax.jit, static_argnames=("policy",))
+def _place_pods_scan(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_rp,
+    valid,
+    *,
+    policy: str,
+    node_mask=None,
+):
+    """The padded heterogeneous scan: ``reqs_rp`` is ``[R, B]`` (one
+    request column per step), ``valid[B]`` False for pad steps (they can
+    never place, so the carried state is untouched).  Returns
+    ``assignments[B]``."""
+    alloc_rn = jnp.asarray(alloc_rn, jnp.int64)
+    reqs_rp = jnp.asarray(reqs_rp, jnp.int64)
+    n = alloc_rn.shape[1]
+    n_res = alloc_rn.shape[0]
+    eligible = jnp.asarray(healthy, jnp.bool_)
+    if node_mask is not None:
+        eligible = eligible & jnp.asarray(node_mask, jnp.bool_)
+
+    h0 = alloc_rn - jnp.asarray(used_rn, jnp.int64)  # [R, N]
+    slots0 = jnp.maximum(
+        jnp.asarray(alloc_pods, jnp.int64)
+        - jnp.asarray(pods_count, jnp.int64),
+        0,
+    )
+    idx_f64 = jnp.arange(n).astype(jnp.float64)
+
+    def body(state, xs):
+        h, slots = state
+        req_r, ok_step = xs  # [R], scalar bool
+        active = req_r > 0
+        sub = jnp.where(active, req_r, jnp.int64(0))  # [R]
+        feasible = (
+            jnp.all(~active[:, None] | (h >= req_r[:, None]), axis=0)
+            & (slots >= 1)
+            & eligible
+            & ok_step
+        )
+        if policy == "first-fit":
+            score = idx_f64
+        else:
+            acc = jnp.zeros(n, dtype=jnp.float64)
+            for r in range(n_res):  # static unroll: row order = caller order
+                acc = acc + jnp.where(
+                    alloc_rn[r] > 0,
+                    (h[r] - sub[r]).astype(jnp.float64)
+                    / alloc_rn[r].astype(jnp.float64),
+                    0.0,
+                )
+            score = acc if policy == "best-fit" else -acc
+        masked = jnp.where(feasible, score, jnp.inf)
+        idx = jnp.argmin(masked)
+        ok = jnp.isfinite(masked[idx])
+        h = h.at[:, idx].add(-jnp.where(ok, sub, jnp.int64(0)))
+        slots = slots.at[idx].add(-jnp.where(ok, jnp.int64(1), jnp.int64(0)))
+        assignment = jnp.where(ok, idx.astype(jnp.int64), jnp.int64(-1))
+        return (h, slots), assignment
+
+    _, assignments = jax.lax.scan(
+        body, (h0, slots0), (reqs_rp.T, jnp.asarray(valid, jnp.bool_))
+    )
+    return assignments
+
+
+def place_pods_multi(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_rp,
+    *,
+    policy: str = "first-fit",
+    node_mask=None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Greedily place P pods with PER-POD request vectors, one step each.
+
+    ``reqs_rp`` is ``[R, P]`` int64 — pod ``p`` places at step ``p`` with
+    request column ``reqs_rp[:, p]`` (zero entries do not consume,
+    :func:`place_replicas_multi`'s convention).  Same policy family and
+    argmin tie rule as the identical-replica engines; ``-1`` for a pod
+    no node can take — later pods still try (a small pod may fit where a
+    big one did not, so a ``-1`` is not absorbing).  Returns
+    ``(assignments[P], per_node_counts[N])`` numpy int64.
+    """
+    if policy not in POLICIES:
+        raise ValueError(f"unknown policy {policy!r} (want one of {POLICIES})")
+    reqs_rp = np.asarray(reqs_rp, dtype=np.int64)
+    if reqs_rp.ndim != 2:
+        raise ValueError(f"reqs_rp must be [R, P], got shape {reqs_rp.shape}")
+    n = np.asarray(alloc_pods).shape[0]
+    p = reqs_rp.shape[1]
+    if p == 0:
+        return (
+            np.zeros(0, dtype=np.int64),
+            np.zeros(n, dtype=np.int64),
+        )
+    b = _pod_bucket(p)
+    padded = np.zeros((reqs_rp.shape[0], b), dtype=np.int64)
+    padded[:, :p] = reqs_rp
+    assignments = np.asarray(
+        _place_pods_scan(
+            alloc_rn,
+            used_rn,
+            alloc_pods,
+            pods_count,
+            healthy,
+            padded,
+            np.arange(b) < p,
+            policy=policy,
+            node_mask=node_mask,
+        )
+    )[:p]
+    counts = np.bincount(
+        assignments[assignments >= 0], minlength=n
+    ).astype(np.int64)
+    return assignments, counts
+
+
+def place_pods(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_reqs,
+    mem_reqs,
+    *,
+    policy: str = "first-fit",
+    node_mask=None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """2-resource :func:`place_pods_multi`: rows stack as (cpu, mem)."""
+    return place_pods_multi(
+        np.stack([np.asarray(alloc_cpu), np.asarray(alloc_mem)]),
+        np.stack([np.asarray(used_cpu), np.asarray(used_mem)]),
+        alloc_pods,
+        pods_count,
+        healthy,
+        np.stack(
+            [
+                np.asarray(cpu_reqs, dtype=np.int64),
+                np.asarray(mem_reqs, dtype=np.int64),
+            ]
+        ),
+        policy=policy,
+        node_mask=node_mask,
+    )
+
+
+def place_pods_multi_python(
+    alloc_rn,
+    used_rn,
+    alloc_pods,
+    pods_count,
+    healthy,
+    reqs_rp,
+    *,
+    policy: str = "first-fit",
+    node_mask=None,
+) -> tuple[list[int], list[int]]:
+    """Sequential ground truth for :func:`place_pods_multi` (same tie
+    rules and zero-request convention)."""
+    if policy not in POLICIES:
+        raise ValueError(f"unknown policy {policy!r}")
+    alloc_rn = np.asarray(alloc_rn, dtype=np.int64)
+    reqs_rp = np.asarray(reqs_rp, dtype=np.int64)
+    n_res, n = alloc_rn.shape
+    h = [
+        [int(alloc_rn[r, i]) - int(used_rn[r][i]) for i in range(n)]
+        for r in range(n_res)
+    ]
+    slots = [max(int(a) - int(p), 0) for a, p in zip(alloc_pods, pods_count)]
+    eligible = [
+        bool(healthy[i]) and (node_mask is None or bool(node_mask[i]))
+        for i in range(n)
+    ]
+    assignments: list[int] = []
+    counts = [0] * n
+    for p in range(reqs_rp.shape[1]):
+        req = [int(reqs_rp[r, p]) for r in range(n_res)]
+        best, best_score = -1, None
+        for i in range(n):
+            if not (
+                eligible[i]
+                and slots[i] >= 1
+                and all(
+                    req[r] <= 0 or h[r][i] >= req[r] for r in range(n_res)
+                )
+            ):
+                continue
+            if policy == "first-fit":
+                score = float(i)
+            else:
+                after = 0.0
+                for r in range(n_res):
+                    if alloc_rn[r, i] > 0:
+                        sub = req[r] if req[r] > 0 else 0
+                        after += (h[r][i] - sub) / float(alloc_rn[r, i])
+                score = after if policy == "best-fit" else -after
+            if best_score is None or score < best_score:
+                best, best_score = i, score
+        if best < 0:
+            assignments.append(-1)
+            continue
+        for r in range(n_res):
+            if req[r] > 0:
+                h[r][best] -= req[r]
+        slots[best] -= 1
+        counts[best] += 1
+        assignments.append(best)
+    return assignments, counts
+
+
+def place_pods_python(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_reqs,
+    mem_reqs,
+    *,
+    policy: str = "first-fit",
+    node_mask=None,
+) -> tuple[list[int], list[int]]:
+    """2-resource :func:`place_pods_multi_python`."""
+    return place_pods_multi_python(
+        np.stack([np.asarray(alloc_cpu), np.asarray(alloc_mem)]),
+        np.stack([np.asarray(used_cpu), np.asarray(used_mem)]),
+        alloc_pods,
+        pods_count,
+        healthy,
+        np.stack(
+            [
+                np.asarray(cpu_reqs, dtype=np.int64),
+                np.asarray(mem_reqs, dtype=np.int64),
+            ]
+        ),
+        policy=policy,
+        node_mask=node_mask,
+    )
 
 
 # --- R-resource generalization (placement with GPUs / ephemeral-storage).

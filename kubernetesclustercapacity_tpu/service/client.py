@@ -75,3 +75,7 @@ class CapacityClient:
     def place(self, **flags) -> dict:
         """Simulate where each replica lands (greedy scheduler)."""
         return self.call("place", **flags)
+
+    def drain(self, node: str, **flags) -> dict:
+        """Simulate draining a node: a rehoming target per evicted pod."""
+        return self.call("drain", node=node, **flags)

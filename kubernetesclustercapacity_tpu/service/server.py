@@ -147,7 +147,7 @@ class CapacityServer:
                 token.encode(), self._auth_token.encode()
             ):
                 raise PermissionError("missing or invalid auth token")
-        if op in ("fit", "sweep", "sweep_multi", "place"):
+        if op in ("fit", "sweep", "sweep_multi", "place", "drain"):
             # Bounded concurrency for the compute ops: each holds device
             # dispatch + host packing; unbounded fan-in from one noisy
             # client must not starve the box.
@@ -171,10 +171,12 @@ class CapacityServer:
         # watch-event batch.
         with self._lock:
             snap = self.snapshot
-            if (
-                self._fixture_dirty
-                and op in ("fit", "place")
-                and self._fit_consumes_fixture(msg, snap.semantics)
+            if self._fixture_dirty and (
+                op == "drain"  # always reads per-pod requests
+                or (
+                    op in ("fit", "place")
+                    and self._fit_consumes_fixture(msg, snap.semantics)
+                )
             ):
                 # The one path that reads the raw fixture (_op_fit's
                 # reference cpu cross-check) rebuilds it here, under the
@@ -200,6 +202,8 @@ class CapacityServer:
             return self._op_sweep_multi(msg, snap, implicit_mask)
         if op == "place":
             return self._op_place(msg, snap, fixture)
+        if op == "drain":
+            return self._op_drain(msg, snap, fixture)
         if op == "reload":
             return self._op_reload(msg, snap)
         if op == "update":
@@ -482,6 +486,35 @@ class CapacityServer:
             "all_placed": result.all_placed,
             "policy": result.policy,
             "engine": result.engine,
+        }
+
+    def _op_drain(
+        self, msg: dict, snap: ClusterSnapshot, fixture: dict | None
+    ) -> dict:
+        """Drain simulation over the wire: a rehoming target per pod on
+        the named node, and the evictable verdict."""
+        from kubernetesclustercapacity_tpu.models import CapacityModel
+
+        node = msg.get("node")
+        if not isinstance(node, str) or not node:
+            raise ValueError("drain wants a non-empty node name string")
+        if fixture is None:
+            raise ValueError(
+                "drain needs a fixture-backed source (.json); an .npz "
+                "checkpoint carries no per-pod requests"
+            )
+        try:
+            model = CapacityModel(snap, mode=snap.semantics, fixture=fixture)
+            result = model.drain(node, policy=msg.get("policy", "best-fit"))
+        except (TypeError, KeyError, ValueError) as e:
+            raise ValueError(f"bad drain request: {e}") from e
+        return {
+            "node": result.node,
+            "pods": result.pods,
+            "assignments": result.assignments,
+            "by_pod": result.by_pod(),
+            "evictable": result.evictable,
+            "policy": result.policy,
         }
 
     def _op_sweep(

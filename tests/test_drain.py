@@ -1,0 +1,337 @@
+"""Drain simulation: heterogeneous-pod placement (``place_pods``) and
+``CapacityModel.drain`` / the service ``drain`` op."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
+from kubernetesclustercapacity_tpu.ops.placement import (
+    POLICIES,
+    place_pods,
+    place_pods_python,
+    place_replicas,
+)
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+MIB = 1024 * 1024
+GIB = 1024 * MIB
+
+
+def _random_cluster(rng, n):
+    return dict(
+        alloc_cpu=rng.integers(1000, 64000, n),
+        alloc_mem=rng.integers(1 * GIB, 64 * GIB, n),
+        alloc_pods=rng.integers(3, 30, n),
+        used_cpu=rng.integers(0, 32000, n),
+        used_mem=rng.integers(0, 32 * GIB, n),
+        pods_count=rng.integers(0, 25, n),
+        healthy=rng.random(n) > 0.1,
+    )
+
+
+class TestPlacePods:
+    @pytest.mark.parametrize("policy", POLICIES)
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_python_ground_truth(self, policy, seed):
+        rng = np.random.default_rng(seed)
+        c = _random_cluster(rng, 24)
+        p = 40
+        cpu_reqs = rng.integers(1, 9000, p)
+        mem_reqs = rng.integers(1, 9 * GIB, p)
+        mask = rng.random(24) > 0.15
+        got_a, got_c = place_pods(
+            *c.values(), cpu_reqs, mem_reqs, policy=policy, node_mask=mask
+        )
+        want_a, want_c = place_pods_python(
+            *c.values(), cpu_reqs, mem_reqs, policy=policy, node_mask=mask
+        )
+        np.testing.assert_array_equal(np.asarray(got_a), want_a)
+        np.testing.assert_array_equal(np.asarray(got_c), want_c)
+
+    @pytest.mark.parametrize("policy", POLICIES)
+    def test_identical_requests_match_place_replicas(self, policy):
+        rng = np.random.default_rng(7)
+        c = _random_cluster(rng, 16)
+        r = 25
+        got_a, got_c = place_pods(
+            *c.values(),
+            np.full(r, 700, dtype=np.int64),
+            np.full(r, GIB, dtype=np.int64),
+            policy=policy,
+        )
+        want_a, want_c = place_replicas(
+            *c.values(), 700, GIB, n_replicas=r, policy=policy
+        )
+        np.testing.assert_array_equal(np.asarray(got_a), np.asarray(want_a))
+        np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+
+    def test_small_pod_fits_after_big_pod_fails(self):
+        """Unlike the identical-replica scan, a -1 is not absorbing."""
+        assignments, counts = place_pods(
+            np.array([2000]), np.array([4 * GIB]), np.array([10]),
+            np.array([0]), np.array([0]), np.array([0]), np.array([True]),
+            np.array([99999, 1000]), np.array([GIB, GIB]),
+            policy="first-fit",
+        )
+        assert np.asarray(assignments).tolist() == [-1, 0]
+        assert np.asarray(counts).tolist() == [1]
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="unknown policy"):
+            place_pods(
+                np.array([1]), np.array([1]), np.array([1]),
+                np.array([0]), np.array([0]), np.array([0]),
+                np.array([True]), np.array([1]), np.array([1]),
+                policy="tetris",
+            )
+
+    @pytest.mark.parametrize("policy", POLICIES)
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_multi_matches_python_ground_truth(self, policy, seed):
+        """R=3 rows with zero entries (the does-not-consume convention)."""
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_pods_multi,
+            place_pods_multi_python,
+        )
+
+        rng = np.random.default_rng(seed)
+        n, p = 12, 30
+        alloc_rn = np.stack([
+            rng.integers(1000, 64000, n),
+            rng.integers(1 * GIB, 64 * GIB, n),
+            rng.integers(0, 8, n),  # GPU-ish: many nodes have none
+        ]).astype(np.int64)
+        used_rn = (alloc_rn * rng.random((3, n)) * 0.6).astype(np.int64)
+        alloc_pods = rng.integers(3, 30, n)
+        pods_count = rng.integers(0, 25, n)
+        healthy = rng.random(n) > 0.1
+        reqs = np.stack([
+            rng.integers(1, 9000, p),
+            rng.integers(1, 9 * GIB, p),
+            rng.integers(0, 3, p),  # zero entries exercise non-consumption
+        ]).astype(np.int64)
+        got_a, got_c = place_pods_multi(
+            alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs,
+            policy=policy,
+        )
+        want_a, want_c = place_pods_multi_python(
+            alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs,
+            policy=policy,
+        )
+        np.testing.assert_array_equal(got_a, want_a)
+        np.testing.assert_array_equal(got_c, want_c)
+
+    def test_bucket_padding_reuses_compiles(self):
+        """Pod counts in one power-of-two bucket share a compile."""
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            _place_pods_scan,
+        )
+
+        rng = np.random.default_rng(0)
+        c = _random_cluster(rng, 6)
+        before = _place_pods_scan._cache_size()
+        for p in (1, 3, 8):  # all pad to bucket 8
+            place_pods(
+                *c.values(),
+                rng.integers(1, 500, p), rng.integers(1, GIB, p),
+                policy="best-fit",
+            )
+        assert _place_pods_scan._cache_size() == before + 1
+        place_pods(
+            *c.values(),
+            rng.integers(1, 500, 9), rng.integers(1, GIB, 9),
+            policy="best-fit",
+        )  # bucket 16: one more compile
+        assert _place_pods_scan._cache_size() == before + 2
+
+    def test_zero_pods(self):
+        rng = np.random.default_rng(0)
+        c = _random_cluster(rng, 4)
+        assignments, counts = place_pods(
+            *c.values(), np.zeros(0, np.int64), np.zeros(0, np.int64)
+        )
+        assert assignments.shape == (0,) and counts.tolist() == [0] * 4
+
+
+@pytest.fixture()
+def drain_fixture():
+    """node d0 hosts two pods; d1 has room for both; d2 is full; d3 is
+    hard-tainted (must not be a rehoming target)."""
+    def node(name, cpu, mem_ki, taints=()):
+        return {"name": name,
+                "allocatable": {"cpu": cpu, "memory": mem_ki, "pods": "10"},
+                "conditions": [{"type": "Ready", "status": "True"}],
+                "taints": list(taints)}
+    return {
+        "nodes": [
+            node("d0", "4", "8388608Ki"),
+            node("d1", "8", "16777216Ki"),
+            node("d2", "1", "1048576Ki"),
+            node("d3", "64", "67108864Ki",
+                 taints=({"key": "k", "value": "v", "effect": "NoSchedule"},)),
+        ],
+        "pods": [
+            {"name": "big", "namespace": "d", "nodeName": "d0",
+             "phase": "Running",
+             "containers": [{"resources": {"requests": {
+                 "cpu": "2", "memory": "4194304Ki"}}}]},
+            {"name": "small", "namespace": "d", "nodeName": "d0",
+             "phase": "Running",
+             "containers": [{"resources": {"requests": {
+                 "cpu": "500m", "memory": "1048576Ki"}}}]},
+            {"name": "filler", "namespace": "d", "nodeName": "d2",
+             "phase": "Running",
+             "containers": [{"resources": {"requests": {
+                 "cpu": "900m", "memory": "943718400"}}}]},
+        ],
+    }
+
+
+class TestDrain:
+    def _model(self, fx):
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        return CapacityModel(snap, mode="strict", fixture=fx)
+
+    def test_feasible_drain(self, drain_fixture):
+        result = self._model(drain_fixture).drain("d0")
+        assert result.evictable
+        assert result.pods == ["d/big", "d/small"]  # size-descending
+        assert result.by_pod() == {"d/big": "d1", "d/small": "d1"}
+        np.testing.assert_array_equal(result.per_node, [0, 2, 0, 0])
+
+    def test_tainted_node_never_a_target(self, drain_fixture):
+        # Make d1 too small: only tainted d3 could take the big pod.
+        drain_fixture["nodes"][1]["allocatable"]["cpu"] = "2"
+        drain_fixture["nodes"][1]["allocatable"]["memory"] = "2097152Ki"
+        result = self._model(drain_fixture).drain("d0")
+        assert not result.evictable
+        assert result.by_pod()["d/big"] is None
+        # The small pod still rehomes (the -1 is not absorbing).
+        assert result.by_pod()["d/small"] == "d1"
+
+    def test_drained_node_not_its_own_target(self, drain_fixture):
+        # d0 trivially has room for its own pods — but it is being drained.
+        result = self._model(drain_fixture).drain("d0", policy="first-fit")
+        assert all(a != "d0" for a in result.assignments)
+
+    def test_pod_slots_respected(self, drain_fixture):
+        drain_fixture["nodes"][1]["allocatable"]["pods"] = "1"
+        result = self._model(drain_fixture).drain("d0")
+        # One pod lands on d1, the other has nowhere (d2 full, d3 tainted).
+        assert sorted(
+            a if a is not None else "-" for a in result.assignments
+        ) == ["-", "d1"]
+
+    def test_empty_node(self, drain_fixture):
+        result = self._model(drain_fixture).drain("d1")
+        assert result.evictable and result.pods == []
+
+    def test_unknown_node(self, drain_fixture):
+        with pytest.raises(ValueError, match="unknown node"):
+            self._model(drain_fixture).drain("nope")
+
+    def test_reference_mode_rejected(self, drain_fixture):
+        snap = snapshot_from_fixture(drain_fixture, semantics="reference")
+        model = CapacityModel(snap, mode="reference", fixture=drain_fixture)
+        with pytest.raises(ValueError, match="strict semantics"):
+            model.drain("d0")
+
+    def test_missing_fixture_rejected(self, drain_fixture):
+        snap = snapshot_from_fixture(drain_fixture, semantics="strict")
+        with pytest.raises(ValueError, match="fixture"):
+            CapacityModel(snap, mode="strict").drain("d0")
+
+    def test_extended_requests_gate_targets(self, drain_fixture):
+        """A GPU pod only rehomes where GPUs are free, even though a
+        GPU-less node has more cpu/mem headroom and a lower index."""
+        drain_fixture["nodes"][0]["allocatable"]["nvidia.com/gpu"] = "8"
+        drain_fixture["nodes"].append({
+            "name": "d4",
+            "allocatable": {"cpu": "2", "memory": "4194304Ki", "pods": "10",
+                            "nvidia.com/gpu": "2"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        })
+        drain_fixture["pods"][0]["containers"][0]["resources"]["requests"][
+            "nvidia.com/gpu"] = "1"
+        snap = snapshot_from_fixture(
+            drain_fixture, semantics="strict",
+            extended_resources=("nvidia.com/gpu",),
+        )
+        model = CapacityModel(snap, mode="strict", fixture=drain_fixture)
+        result = model.drain("d0", policy="first-fit")
+        assert result.evictable
+        # big (the GPU pod) skips roomy-but-GPU-less d1 for d4; small is
+        # free to take d1.
+        assert result.by_pod() == {"d/big": "d4", "d/small": "d1"}
+
+    def test_requestless_pod_consumes_only_a_slot(self, drain_fixture):
+        drain_fixture["pods"].append({
+            "name": "bare", "namespace": "d", "nodeName": "d0",
+            "phase": "Running", "containers": [{}]})
+        # d2 is resource-full but has free pod slots: the requestless pod
+        # may land there (zero requests do not consume resources).
+        result = self._model(drain_fixture).drain("d0", policy="first-fit")
+        assert result.by_pod()["d/bare"] == "d1"  # first-fit: lowest index
+        drain_fixture["nodes"][1]["allocatable"]["pods"] = "0"
+        result = self._model(drain_fixture).drain("d0", policy="first-fit")
+        assert result.by_pod()["d/bare"] == "d2"
+
+    def test_randomized_capacity_respected(self):
+        """Every rehomed pod set must fit inside each target's strict
+        headroom — checked by re-summing assignments on a random cluster."""
+        fx = copy.deepcopy(synthetic_fixture(15, seed=5))
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        model = CapacityModel(snap, mode="strict", fixture=fx)
+        node = snap.names[0]
+        result = model.drain(node, policy="best-fit")
+        from kubernetesclustercapacity_tpu.snapshot import (
+            _effective_pod_resources,
+        )
+        eff = {
+            f"{p.get('namespace', '')}/{p.get('name', '')}":
+                _effective_pod_resources(p, ())
+            for p in fx["pods"] if p.get("nodeName") == node
+        }
+        for i, name in enumerate(snap.names):
+            landed = [p for p, a in result.by_pod().items() if a == name]
+            if not landed:
+                continue
+            assert name != node and bool(snap.healthy[i])
+            cpu = sum(eff[p]["cpu_req"] for p in landed)
+            mem = sum(eff[p]["mem_req"] for p in landed)
+            assert snap.used_cpu_req_milli[i] + cpu <= snap.alloc_cpu_milli[i]
+            assert snap.used_mem_req_bytes[i] + mem <= snap.alloc_mem_bytes[i]
+            assert snap.pods_count[i] + len(landed) <= snap.alloc_pods[i]
+
+
+class TestDrainWire:
+    def test_drain_over_the_wire(self, drain_fixture):
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        snap = snapshot_from_fixture(drain_fixture, semantics="strict")
+        srv = CapacityServer(snap, port=0, fixture=drain_fixture)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                r = c.drain("d0")
+                assert r["evictable"] and r["by_pod"] == {
+                    "d/big": "d1", "d/small": "d1"
+                }
+                # Events flow into drain answers: fill d1, drain again.
+                c.update([{"type": "ADDED", "kind": "Pod", "object": {
+                    "name": "blocker", "namespace": "d", "nodeName": "d1",
+                    "phase": "Running",
+                    "containers": [{"resources": {"requests": {
+                        "cpu": "7", "memory": "14680064Ki"}}}]}}])
+                r2 = c.drain("d0")
+                assert not r2["evictable"]
+                with pytest.raises(Exception, match="node name"):
+                    c.drain("")
+        finally:
+            srv.shutdown()
