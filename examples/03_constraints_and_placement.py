@@ -125,6 +125,14 @@ def main() -> None:
           f"evict it")
     assert preempting.fits[2] > squeezed.fits[2]
 
+    # Drain simulation (kubectl drain dry-run): every pod on node-2 gets
+    # a rehoming target with its OWN requests, or the verdict says the
+    # node cannot be emptied.
+    plan = pmodel.drain(fixture["nodes"][2]["name"], policy="best-fit")
+    print(f"\ndrain {plan.node}: evictable={plan.evictable}")
+    for pod, target in plan.by_pod().items():
+        print(f"  {pod:<40} -> {target or 'UNPLACEABLE'}")
+
 
 if __name__ == "__main__":
     main()

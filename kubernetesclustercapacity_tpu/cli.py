@@ -84,6 +84,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-replica request for an extended resource "
                         "(repeatable; strict quantity grammar, e.g. "
                         "nvidia.com/gpu=2, ephemeral-storage=10Gi)")
+    p.add_argument("-drain", default="", metavar="NODE",
+                   help="simulate kubectl drain: rehome NODE's pods (each "
+                        "with its own requests) onto the remaining nodes "
+                        "and print the plan; exit 1 if any pod cannot be "
+                        "rehomed (strict semantics, fixture/live sources)")
+    p.add_argument("-drain-policy", dest="drain_policy", default="best-fit",
+                   choices=("first-fit", "best-fit", "spread"),
+                   help="bin-packing policy for -drain rehoming")
     p.add_argument("-doctor", action="store_true",
                    help="diagnose the environment (backend probe with a "
                         "hang-proof timeout, native toolchain, fast-path "
@@ -156,9 +164,41 @@ def main(argv: list[str] | None = None) -> int:
         snapshot.save(args.save_snapshot)
         print(f"snapshot checkpointed to {args.save_snapshot}", file=sys.stderr)
 
+    if args.drain:
+        return _run_drain(args, fixture, snapshot)
     if args.grid > 0:
         return _run_grid(args, snapshot)
     return _run_single(args, fixture, snapshot, scenario)
+
+
+def _run_drain(args, fixture, snapshot) -> int:
+    """-drain NODE: print the rehoming plan; exit by the verdict."""
+    from kubernetesclustercapacity_tpu.models import CapacityModel
+
+    if args.semantics != "strict":
+        print("ERROR : -drain requires strict semantics "
+              "(-semantics strict)")
+        return 1
+    # Live sources arrive WITH their fixture (_load_source lists once for
+    # both); only an .npz checkpoint leaves it None, and the model's own
+    # error explains that limitation.
+    try:
+        model = CapacityModel(snapshot, mode="strict", fixture=fixture)
+        plan = model.drain(args.drain, policy=args.drain_policy)
+    except ValueError as e:
+        print(f"ERROR : {e}")
+        return 1
+    print(f"drain {plan.node}: {len(plan.pods)} pod(s) to rehome "
+          f"(policy {plan.policy})")
+    for pod, target in plan.by_pod().items():
+        print(f"  {pod:<48} -> {target if target else 'UNPLACEABLE'}")
+    if plan.evictable:
+        print(f"verdict: {plan.node} is evictable")
+        return 0
+    stuck = sum(1 for a in plan.assignments if a is None)
+    print(f"verdict: {plan.node} is NOT evictable "
+          f"({stuck} pod(s) cannot be rehomed)")
+    return 1
 
 
 def _load_source(args):
@@ -191,6 +231,21 @@ def _load_source(args):
               "(reference semantics has no extended-column concept)")
         return None, None
     try:
+        if args.drain:
+            # -drain needs the raw objects too (per-pod requests): ONE
+            # listing serves both the fixture and the packed snapshot, so
+            # eviction candidates and target headroom are the same
+            # instant of the cluster.
+            from kubernetesclustercapacity_tpu.kubeapi import live_fixture
+            from kubernetesclustercapacity_tpu.snapshot import (
+                snapshot_from_fixture,
+            )
+
+            fixture = live_fixture(args.kubeconfig or None)
+            return fixture, snapshot_from_fixture(
+                fixture, semantics=args.semantics,
+                extended_resources=extended,
+            )
         return None, snapshot_from_live_cluster(
             args.kubeconfig or None, semantics=args.semantics,
             extended_resources=extended,

@@ -307,6 +307,71 @@ class TestDrain:
             assert snap.pods_count[i] + len(landed) <= snap.alloc_pods[i]
 
 
+class TestDrainCLI:
+    FIXTURE = "tests/fixtures/kind-3node.json"
+
+    def _run(self, capsys, *argv):
+        from kubernetesclustercapacity_tpu.cli import main
+
+        code = main(list(argv))
+        return code, capsys.readouterr().out
+
+    def test_evictable_exit_zero(self, capsys):
+        code, out = self._run(
+            capsys, "-snapshot", self.FIXTURE, "-semantics", "strict",
+            "-drain", "kind-worker2",
+        )
+        assert code == 0
+        assert "verdict: kind-worker2 is evictable" in out
+        assert "kube-system/kube-proxy-kind-worker2" in out
+
+    def test_requires_strict(self, capsys):
+        code, out = self._run(
+            capsys, "-snapshot", self.FIXTURE, "-drain", "kind-worker2",
+        )
+        assert code == 1 and "requires strict semantics" in out
+
+    def test_unknown_node_exit_one(self, capsys):
+        code, out = self._run(
+            capsys, "-snapshot", self.FIXTURE, "-semantics", "strict",
+            "-drain", "ghost",
+        )
+        assert code == 1 and "unknown node" in out
+
+    def test_npz_checkpoint_rejected(self, capsys, tmp_path):
+        import json
+
+        from kubernetesclustercapacity_tpu.fixtures import load_fixture
+        from kubernetesclustercapacity_tpu.snapshot import (
+            snapshot_from_fixture,
+        )
+
+        snap = snapshot_from_fixture(
+            load_fixture(self.FIXTURE), semantics="strict"
+        )
+        path = tmp_path / "c.npz"
+        snap.save(str(path))
+        code, out = self._run(
+            capsys, "-snapshot", str(path), "-semantics", "strict",
+            "-drain", "kind-worker2",
+        )
+        assert code == 1 and "fixture" in out
+
+    def test_not_evictable_exit_one(self, capsys, tmp_path, drain_fixture):
+        import json
+
+        # Shrink every other node so d0's big pod has nowhere to go.
+        drain_fixture["nodes"][1]["allocatable"]["cpu"] = "1"
+        path = tmp_path / "c.json"
+        path.write_text(json.dumps(drain_fixture))
+        code, out = self._run(
+            capsys, "-snapshot", str(path), "-semantics", "strict",
+            "-drain", "d0", "-drain-policy", "first-fit",
+        )
+        assert code == 1
+        assert "UNPLACEABLE" in out and "NOT evictable" in out
+
+
 class TestDrainWire:
     def test_drain_over_the_wire(self, drain_fixture):
         from kubernetesclustercapacity_tpu.service import (
