@@ -2,6 +2,7 @@
 
 from kubernetesclustercapacity_tpu.models.capacity import (  # noqa: F401
     CapacityModel,
+    CapacityPlan,
     CapacityResult,
     DrainResult,
     PlacementResult,

@@ -29,7 +29,14 @@ from kubernetesclustercapacity_tpu.scenario import (
 )
 from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
 
-__all__ = ["PodSpec", "CapacityModel", "CapacityResult", "PlacementResult"]
+__all__ = [
+    "PodSpec",
+    "CapacityModel",
+    "CapacityPlan",
+    "CapacityResult",
+    "DrainResult",
+    "PlacementResult",
+]
 
 
 @dataclass(frozen=True)
@@ -207,6 +214,26 @@ class DrainResult:
 
     def by_pod(self) -> dict[str, str | None]:
         return dict(zip(self.pods, self.assignments))
+
+
+@dataclass
+class CapacityPlan:
+    """Outcome of a scale-up plan: nodes to add so the spec fits.
+
+    ``nodes_needed`` is ``0`` when current capacity already suffices and
+    ``None`` when no count of template nodes can help (the template
+    itself fits 0 replicas — wrong shape, untolerated taint, selector
+    mismatch, …).
+    """
+
+    replicas_requested: int
+    current_total: int
+    per_node_fit: int  # replicas ONE empty template node takes
+    nodes_needed: int | None
+
+    @property
+    def satisfiable(self) -> bool:
+        return self.nodes_needed is not None
 
 
 @dataclass
@@ -685,6 +712,98 @@ class CapacityModel:
             ],
             per_node=np.asarray(counts),
             policy=policy,
+        )
+
+    def _template_model(self, node_template: dict) -> "CapacityModel":
+        """A one-node model over an EMPTY template node — the
+        scale-planning unit.  Built through the ordinary packer, so the
+        per-node fit inherits every surface for free: strict quantity
+        grammar, health, taints vs the spec's tolerations, selectors,
+        spread, extended columns."""
+        from kubernetesclustercapacity_tpu.snapshot import (
+            snapshot_from_fixture,
+        )
+
+        template = dict(node_template)
+        template.setdefault("name", "template-node")
+        template.setdefault(
+            "conditions", [{"type": "Ready", "status": "True"}]
+        )
+        fixture = {"nodes": [template], "pods": []}
+        snap = snapshot_from_fixture(
+            fixture, semantics="strict",
+            extended_resources=tuple(sorted(self.snapshot.extended)),
+        )
+        return CapacityModel(snap, mode="strict", fixture=fixture)
+
+    def nodes_needed(
+        self, spec: PodSpec, node_template: dict
+    ) -> CapacityPlan:
+        """Scale-up planning: how many ``node_template`` nodes must be
+        added so ``spec.replicas`` fit? — the cluster-autoscaler what-if.
+
+        ``node_template`` is a fixture-schema node dict (``allocatable``
+        plus optional ``labels``/``taints``/``conditions``).  Closed
+        form: the deficit over current capacity divided by one empty
+        template node's fit for this spec (ceil); constraints bind both
+        sides (a selector the template's labels miss, or a template
+        taint the spec does not tolerate, makes the plan unsatisfiable).
+        Strict semantics only.
+        """
+        if self.mode != "strict":
+            raise ValueError(
+                "capacity planning requires strict semantics (the "
+                "conditional-cap reference mode has no coherent "
+                "per-empty-node fit)"
+            )
+        current = int(self.evaluate(spec).total)
+        per_node = int(self._template_model(node_template).evaluate(spec).total)
+        deficit = spec.replicas - current
+        if deficit <= 0:
+            needed = 0
+        elif per_node <= 0:
+            needed = None
+        else:
+            needed = -(-deficit // per_node)  # ceil
+        return CapacityPlan(
+            replicas_requested=spec.replicas,
+            current_total=current,
+            per_node_fit=per_node,
+            nodes_needed=needed,
+        )
+
+    def nodes_needed_grid(
+        self,
+        grid: ScenarioGrid,
+        node_template: dict,
+        *,
+        tolerations: tuple = (),
+        node_selector: dict | None = None,
+    ) -> np.ndarray:
+        """Vectorized :meth:`nodes_needed` over a scenario grid.
+
+        Returns ``needed[S]`` int64: ``0`` = already fits, ``-1`` =
+        unsatisfiable with this template, else the node count.  Two
+        sweeps total — the cluster and the one-node template — then
+        elementwise closed form.  The shared constraints bind both
+        sweeps (a tolerated template taint stays satisfiable here, like
+        the scalar path's ``PodSpec`` constraints).
+        """
+        if self.mode != "strict":
+            raise ValueError(
+                "capacity planning requires strict semantics (the "
+                "conditional-cap reference mode has no coherent "
+                "per-empty-node fit)"
+            )
+        shared = dict(tolerations=tolerations, node_selector=node_selector)
+        totals, _ = self.sweep(grid, **shared)
+        per_node, _ = self._template_model(node_template).sweep(grid, **shared)
+        deficit = grid.replicas.astype(np.int64) - totals
+        ceil_div = -(-deficit // np.maximum(per_node, 1))
+        return np.where(
+            deficit <= 0,
+            np.int64(0),
+            np.where(per_node > 0, ceil_div, np.int64(-1)),
         )
 
     def sweep(

@@ -1,0 +1,161 @@
+"""Scale-up planning (``CapacityModel.nodes_needed`` / ``_grid``)."""
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
+from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+MIB = 1024 * 1024
+GIB = 1024 * MIB
+
+TEMPLATE = {"allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "10"}}
+
+
+@pytest.fixture()
+def tight_model():
+    """One nearly-full node: 1 core / 2Gi free."""
+    fx = {
+        "nodes": [{
+            "name": "n0",
+            "allocatable": {"cpu": "4", "memory": "8388608Ki", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        }],
+        "pods": [{
+            "name": "p", "namespace": "d", "nodeName": "n0",
+            "phase": "Running",
+            "containers": [{"resources": {"requests": {
+                "cpu": "3", "memory": "6291456Ki"}}}],
+        }],
+    }
+    snap = snapshot_from_fixture(fx, semantics="strict")
+    return CapacityModel(snap, mode="strict", fixture=fx)
+
+
+class TestNodesNeeded:
+    def test_deficit_ceil(self, tight_model):
+        # spec 1cpu/2Gi: current total 1; template takes min(4, 4) = 4.
+        plan = tight_model.nodes_needed(
+            PodSpec(cpu_request_milli=1000, mem_request_bytes=2 * GIB,
+                    replicas=10),
+            TEMPLATE,
+        )
+        assert (plan.current_total, plan.per_node_fit) == (1, 4)
+        assert plan.nodes_needed == 3  # ceil(9 / 4)
+        assert plan.satisfiable
+
+    def test_already_fits(self, tight_model):
+        plan = tight_model.nodes_needed(
+            PodSpec(cpu_request_milli=1000, mem_request_bytes=2 * GIB,
+                    replicas=1),
+            TEMPLATE,
+        )
+        assert plan.nodes_needed == 0
+
+    def test_pod_slot_cap_binds_template(self, tight_model):
+        # 100m pods: template fits min(40, pods=10) = 10 per node.
+        plan = tight_model.nodes_needed(
+            PodSpec(cpu_request_milli=100, mem_request_bytes=1 * MIB,
+                    replicas=60),
+            TEMPLATE,
+        )
+        assert plan.per_node_fit == 10
+
+    def test_selector_mismatch_unsatisfiable(self, tight_model):
+        plan = tight_model.nodes_needed(
+            PodSpec(cpu_request_milli=100, mem_request_bytes=1 * MIB,
+                    replicas=5, node_selector={"zone": "z9"}),
+            TEMPLATE,
+        )
+        assert plan.nodes_needed is None and not plan.satisfiable
+        labeled = dict(TEMPLATE, labels={"zone": "z9"})
+        assert tight_model.nodes_needed(
+            PodSpec(cpu_request_milli=100, mem_request_bytes=1 * MIB,
+                    replicas=5, node_selector={"zone": "z9"}),
+            labeled,
+        ).satisfiable
+
+    def test_template_taint_honored(self, tight_model):
+        tainted = dict(
+            TEMPLATE,
+            taints=[{"key": "k", "value": "v", "effect": "NoSchedule"}],
+        )
+        spec = PodSpec(cpu_request_milli=100, mem_request_bytes=1 * MIB,
+                       replicas=50)
+        assert tight_model.nodes_needed(spec, tainted).nodes_needed is None
+        tol = PodSpec(cpu_request_milli=100, mem_request_bytes=1 * MIB,
+                      replicas=50, tolerations=({"operator": "Exists"},))
+        assert tight_model.nodes_needed(tol, tainted).satisfiable
+
+    def test_spread_caps_template_fit(self, tight_model):
+        plan = tight_model.nodes_needed(
+            PodSpec(cpu_request_milli=100, mem_request_bytes=1 * MIB,
+                    replicas=9, spread=2),
+            TEMPLATE,
+        )
+        assert plan.per_node_fit == 2
+        assert plan.nodes_needed == 4  # current fits 2; ceil(7/2)
+
+    def test_gpu_template(self):
+        fx = {"nodes": [], "pods": []}
+        snap = snapshot_from_fixture(
+            fx, semantics="strict", extended_resources=("nvidia.com/gpu",)
+        )
+        model = CapacityModel(snap, mode="strict", fixture=fx)
+        plan = model.nodes_needed(
+            PodSpec(cpu_request_milli=100, mem_request_bytes=1 * MIB,
+                    replicas=8, extended_requests={"nvidia.com/gpu": 2}),
+            {"allocatable": {"cpu": "64", "memory": "67108864Ki",
+                             "pods": "110", "nvidia.com/gpu": "4"}},
+        )
+        assert plan.per_node_fit == 2  # GPU-bound: 4 // 2
+        assert plan.nodes_needed == 4
+
+    def test_reference_mode_rejected(self, tight_model):
+        snap = snapshot_from_fixture(
+            {"nodes": [], "pods": []}, semantics="reference"
+        )
+        model = CapacityModel(snap, mode="reference")
+        with pytest.raises(ValueError, match="strict semantics"):
+            model.nodes_needed(
+                PodSpec(cpu_request_milli=1, mem_request_bytes=1), TEMPLATE
+            )
+
+    def test_grid_forwards_shared_constraints(self, tight_model):
+        tainted = dict(
+            TEMPLATE,
+            taints=[{"key": "k", "value": "v", "effect": "NoSchedule"}],
+        )
+        grid = ScenarioGrid(
+            cpu_request_milli=np.array([100]),
+            mem_request_bytes=np.array([MIB]),
+            replicas=np.array([50]),
+        )
+        assert tight_model.nodes_needed_grid(grid, tainted)[0] == -1
+        with_tol = tight_model.nodes_needed_grid(
+            grid, tainted, tolerations=({"operator": "Exists"},)
+        )
+        assert with_tol[0] > 0
+
+    def test_grid_matches_scalar(self, tight_model):
+        rng = np.random.default_rng(0)
+        s = 12
+        grid = ScenarioGrid(
+            cpu_request_milli=rng.integers(100, 3000, s),
+            mem_request_bytes=rng.integers(MIB, 4 * GIB, s),
+            replicas=rng.integers(0, 40, s),
+        )
+        needed = tight_model.nodes_needed_grid(grid, TEMPLATE)
+        assert needed.shape == (s,)
+        for i in range(s):
+            plan = tight_model.nodes_needed(
+                PodSpec(
+                    cpu_request_milli=int(grid.cpu_request_milli[i]),
+                    mem_request_bytes=int(grid.mem_request_bytes[i]),
+                    replicas=int(grid.replicas[i]),
+                ),
+                TEMPLATE,
+            )
+            want = -1 if plan.nodes_needed is None else plan.nodes_needed
+            assert needed[i] == want
