@@ -191,13 +191,22 @@ def _run_drain(args, fixture, snapshot) -> int:
     print(f"drain {plan.node}: {len(plan.pods)} pod(s) to rehome "
           f"(policy {plan.policy})")
     for pod, target in plan.by_pod().items():
-        print(f"  {pod:<48} -> {target if target else 'UNPLACEABLE'}")
+        line = f"  {pod:<48} -> {target if target else 'UNPLACEABLE'}"
+        if pod in plan.blocked:
+            line += f"  [BLOCKED by PDB {', '.join(plan.blocked[pod])}]"
+        print(line)
     if plan.evictable:
         print(f"verdict: {plan.node} is evictable")
         return 0
     stuck = sum(1 for a in plan.assignments if a is None)
-    print(f"verdict: {plan.node} is NOT evictable "
-          f"({stuck} pod(s) cannot be rehomed)")
+    reasons = []
+    if stuck:
+        reasons.append(f"{stuck} pod(s) cannot be rehomed")
+    if plan.blocked:
+        reasons.append(
+            f"{len(plan.blocked)} pod(s) blocked by disruption budgets"
+        )
+    print(f"verdict: {plan.node} is NOT evictable ({'; '.join(reasons)})")
     return 1
 
 

@@ -27,11 +27,13 @@ import threading
 import time
 
 from kubernetesclustercapacity_tpu.kubeapi import (
+    PDB_PATH,
     KubeAPIError,
     KubeClient,
     KubeConfig,
     KubeConfigError,
     node_to_fixture,
+    pdb_to_fixture,
     pod_to_fixture,
 )
 from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
@@ -42,7 +44,14 @@ __all__ = ["ClusterFollower"]
 _RESOURCES = {
     "/api/v1/nodes": ("Node", node_to_fixture),
     "/api/v1/pods": ("Pod", pod_to_fixture),
+    # PDBs feed drain's eviction gate.  Optional: a 403/404 on the policy
+    # API at relist marks them unavailable and their watch thread exits
+    # (the other streams are unaffected); RBAC granted mid-run takes
+    # effect at the next relist, streaming again after a restart.
+    PDB_PATH: ("PodDisruptionBudget", pdb_to_fixture),
 }
+
+_FIXTURE_KEYS = {"Node": "nodes", "Pod": "pods", "PodDisruptionBudget": "pdbs"}
 
 
 class ClusterFollower:
@@ -110,6 +119,7 @@ class ClusterFollower:
         self._versions: dict[str, str] = {}
         self._epoch = 0  # bumped by every relist; stale streams stop applying
         self._fatal: str | None = None
+        self._pdb_unavailable = False  # policy API 403/404 at relist
         self._errors: collections.deque = collections.deque(maxlen=100)
         # Live clients (watch streams mid-read, in-flight relists), guarded
         # by _lock: stop() severs their sockets so a reader parked in
@@ -213,9 +223,22 @@ class ClusterFollower:
             fixture: dict = {"nodes": [], "pods": []}
             versions = {}
             for path, (kind, convert) in _RESOURCES.items():
-                items, version = client.list_with_version(path)
-                key = "nodes" if kind == "Node" else "pods"
-                fixture[key] = [convert(o) for o in items]
+                try:
+                    items, version = client.list_with_version(path)
+                except KubeAPIError as e:
+                    if (
+                        kind == "PodDisruptionBudget"
+                        and e.status in (403, 404)
+                    ):
+                        # Policy API unreadable for this principal —
+                        # degrade to a budget-less fixture (list_pdbs's
+                        # rule); transport/5xx still fails the relist.
+                        self._pdb_unavailable = True
+                        continue
+                    raise
+                if kind == "PodDisruptionBudget":
+                    self._pdb_unavailable = False
+                fixture[_FIXTURE_KEYS[kind]] = [convert(o) for o in items]
                 versions[path] = version
             store = ClusterStore(
                 fixture,
@@ -256,6 +279,10 @@ class ClusterFollower:
         consecutive_failures = 0
         failing_since: float | None = None
         while not self._stop.is_set():
+            if kind == "PodDisruptionBudget" and self._pdb_unavailable:
+                # The optional stream stands down instead of hammering a
+                # 403-ing endpoint; relists keep retrying the list side.
+                return
             with self._lock:
                 version = self._versions.get(path)
                 epoch = self._epoch
@@ -383,6 +410,10 @@ class ClusterFollower:
             store = self._store
             if kind == "Node":
                 exists = store.has_node(obj.get("name", ""))
+            elif kind == "PodDisruptionBudget":
+                exists = store.has_pdb(
+                    obj.get("namespace", ""), obj.get("name", "")
+                )
             else:
                 exists = store.has_pod(
                     obj.get("namespace", ""), obj.get("name", "")

@@ -852,6 +852,45 @@ def pod_to_fixture(p: dict) -> dict:
     return out
 
 
+def pdb_to_fixture(b: dict) -> dict:
+    """K8s REST PodDisruptionBudget → the fixture-schema pdb dict.
+
+    Exactly one of minAvailable/maxUnavailable survives (the API
+    enforces that on its side; :mod:`..pdb` re-validates)."""
+    meta = b.get("metadata") or {}
+    spec = b.get("spec") or {}
+    out = {
+        "name": meta.get("name", ""),
+        "namespace": meta.get("namespace", ""),
+        "selector": spec.get("selector") or {},
+    }
+    for key in ("minAvailable", "maxUnavailable"):
+        if spec.get(key) is not None:
+            out[key] = spec[key]
+    return out
+
+
+PDB_PATH = "/apis/policy/v1/poddisruptionbudgets"
+
+
+def list_pdbs(client: "KubeClient", *, page_limit: int = 500) -> list[dict]:
+    """List every PDB in fixture schema, degrading to ``[]`` only when
+    this principal cannot read the policy API (403) or the apiserver
+    lacks it (404) — budgets are an optional safety surface there.
+    Transport loss and server errors still raise: silently dropping the
+    eviction gate on a flaky connection would turn a PDB-blocked drain
+    verdict into "evictable"."""
+    try:
+        return [
+            pdb_to_fixture(b)
+            for b in client.list_all(PDB_PATH, limit=page_limit)
+        ]
+    except KubeAPIError as e:
+        if e.status in (403, 404):
+            return []
+        raise
+
+
 def live_fixture(
     kubeconfig: str | None = None,
     *,
@@ -861,11 +900,14 @@ def live_fixture(
 ) -> dict:
     """Snapshot a live cluster into the framework's fixture schema.
 
-    Two paginated Lists total (vs. the reference's ``1 + 2N + ΣP`` pattern,
-    ``ClusterCapacity.go:168,183,238,264``).  Pods are fetched across all
-    namespaces with **no** phase field-selector: phases travel in the fixture
-    so reference/strict filtering stays a local, testable decision
-    (PARITY.md Q7).
+    Three paginated Lists total (vs. the reference's ``1 + 2N + ΣP``
+    pattern, ``ClusterCapacity.go:168,183,238,264``).  Pods are fetched
+    across all namespaces with **no** phase field-selector: phases travel
+    in the fixture so reference/strict filtering stays a local, testable
+    decision (PARITY.md Q7).  PodDisruptionBudgets feed the drain
+    simulator's eviction gate; clusters where the policy API is
+    unreadable (403/404) degrade to a budget-less fixture — see
+    :func:`list_pdbs`.
     """
     own_client = client is None
     if client is None:
@@ -877,6 +919,9 @@ def live_fixture(
             fixture["nodes"].append(node_to_fixture(n))
         for p in client.list_all("/api/v1/pods", limit=page_limit):
             fixture["pods"].append(pod_to_fixture(p))
+        pdbs = list_pdbs(client, page_limit=page_limit)
+        if pdbs:
+            fixture["pdbs"] = pdbs
     finally:
         # Error paths must not leak the TLS connection (a token expiring
         # mid-pagination would otherwise strand a socket per retry).

@@ -199,7 +199,10 @@ class DrainResult:
 
     ``assignments[i]`` is the node name that takes ``pods[i]`` (placed in
     the order given, size-descending), or ``None`` if no remaining node
-    can — ``evictable`` is the drain verdict.
+    can.  ``blocked`` maps pods whose eviction the disruption-budget
+    gate refuses right now to the exhausted PDB names covering them
+    (:mod:`..pdb`); ``evictable`` is the drain verdict — every pod has a
+    home AND none is budget-blocked.
     """
 
     node: str
@@ -207,10 +210,13 @@ class DrainResult:
     assignments: list[str | None]
     per_node: np.ndarray  # [N] rehomed-pod counts (0 at the drained node)
     policy: str
+    blocked: dict[str, list[str]] = field(default_factory=dict)
 
     @property
     def evictable(self) -> bool:
-        return all(a is not None for a in self.assignments)
+        return not self.blocked and all(
+            a is not None for a in self.assignments
+        )
 
     def by_pod(self) -> dict[str, str | None]:
         return dict(zip(self.pods, self.assignments))
@@ -620,10 +626,14 @@ class CapacityModel:
         requests are not recoverable from the dense per-node sums).
         Rehoming feasibility covers cpu/memory/pod slots, plus every
         extended column some evicted pod actually requests (GPU pods
-        only land where GPUs are free).  DaemonSet pods are NOT
-        distinguished (the fixture schema carries no ownerReferences) —
-        a real ``kubectl drain`` skips them; filter the fixture first if
-        that distinction matters.
+        only land where GPUs are free).  PodDisruptionBudgets carried by
+        the fixture (``"pdbs"``) gate evictions the way the eviction API
+        would: a pod covered by a zero-allowance budget lands in
+        ``result.blocked`` and the node is not evictable
+        (:mod:`..pdb` documents the point-in-time semantics).
+        DaemonSet pods are NOT distinguished (the fixture schema
+        carries no ownerReferences) — a real ``kubectl drain`` skips
+        them; filter the fixture first if that distinction matters.
         """
         from kubernetesclustercapacity_tpu.ops.placement import (
             place_pods_multi,
@@ -670,6 +680,9 @@ class CapacityModel:
                 per_node=np.zeros(snap.n_nodes, dtype=np.int64),
                 policy=policy,
             )
+        from kubernetesclustercapacity_tpu.pdb import blocked_evictions
+
+        blocked = blocked_evictions(self.fixture, [k for k, _ in pods])
         # Resource rows: cpu/mem plus only the extended columns the
         # evicted pods actually request (inactive rows change nothing
         # and would widen the compiled shape for every drain).
@@ -712,6 +725,7 @@ class CapacityModel:
             ],
             per_node=np.asarray(counts),
             policy=policy,
+            blocked=blocked,
         )
 
     def _template_model(self, node_template: dict) -> "CapacityModel":

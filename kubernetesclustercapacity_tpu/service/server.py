@@ -513,6 +513,7 @@ class CapacityServer:
             "pods": result.pods,
             "assignments": result.assignments,
             "by_pod": result.by_pod(),
+            "blocked": result.blocked,
             "evictable": result.evictable,
             "policy": result.policy,
         }

@@ -124,6 +124,16 @@ class ClusterStore:
             dups = sorted(x for x, c in names.items() if c > 1)
             if dups:
                 raise StoreError(f"duplicate node names in fixture: {dups}")
+        # PDBs ride along raw (no packed-array footprint): drain's budget
+        # gate reads them from fixture_view, so a store-fed service must
+        # not drop them on rematerialization.  Keyed by (namespace, name)
+        # so watch events upsert/delete in O(1), like pods.
+        self._pdbs: dict[tuple[str, str], dict] = {}
+        for b in fixture.get("pdbs", []):
+            key = self._validate_pdb(b)
+            if key in self._pdbs:
+                raise StoreError(f"duplicate PDB {key} in fixture")
+            self._pdbs[key] = _isolate(b)
         self._pods: dict[tuple[str, str], dict] = {}
         self._pods_by_node: dict[str, dict[tuple[str, str], dict]] = {}
         for p in fixture.get("pods", []):
@@ -183,11 +193,15 @@ class ClusterStore:
     def has_pod(self, namespace: str, name: str) -> bool:
         return (namespace, name) in self._pods
 
+    def has_pdb(self, namespace: str, name: str) -> bool:
+        return (namespace, name) in self._pdbs
+
     def fixture_view(self) -> dict:
         """Current raw state in fixture schema (deep copy)."""
-        return _isolate(
-            {"nodes": self._nodes, "pods": list(self._pods.values())}
-        )
+        out = {"nodes": self._nodes, "pods": list(self._pods.values())}
+        if self._pdbs:
+            out["pdbs"] = list(self._pdbs.values())
+        return _isolate(out)
 
     def snapshot(self) -> ClusterSnapshot:
         """A packed snapshot decoupled from the store's raw state.
@@ -268,8 +282,25 @@ class ClusterStore:
             self._apply_pod(etype, obj)
         elif kind == "Node":
             self._apply_node(etype, obj)
+        elif kind == "PodDisruptionBudget":
+            self._apply_pdb(etype, obj)
         else:
             raise StoreError(f"unknown event kind {kind!r}")
+
+    def _apply_pdb(self, etype: str, obj: dict) -> None:
+        """PDB events touch only the raw side (no packed arrays): upsert
+        or delete by (namespace, name); drain reads the result from
+        fixture_view.  A DELETED event only needs the key — real watch
+        streams send the full last-known object, but a key-only delete
+        (the service ``update`` op's natural shape) must not fail the
+        spec-field validation."""
+        if etype == "DELETED":
+            self._pdbs.pop(
+                (str(obj.get("namespace", "")), str(obj.get("name", ""))),
+                None,
+            )
+        else:
+            self._pdbs[self._validate_pdb(obj)] = obj
 
     # -- validation (before ANY mutation: a malformed object must never
     # enter raw state, or it would poison every later recompute AND the
@@ -289,6 +320,26 @@ class ClusterStore:
                 _effective_pod_resources(pod, self.extended_resources)
         except Exception as e:
             raise StoreError(f"malformed pod object: {e}") from e
+        return key
+
+    def _validate_pdb(self, pdb: dict) -> tuple[str, str]:
+        """Run the budget arithmetic once against a synthetic pod in the
+        budget's namespace — the ONE definition of PDB well-formedness
+        (``pdb.budget_statuses``) owns the rules, and the probe pod
+        forces the selector to actually evaluate (an empty pod set would
+        wave through a malformed selector that then poisons every later
+        drain)."""
+        from kubernetesclustercapacity_tpu.pdb import budget_statuses
+
+        try:
+            key = (str(pdb.get("namespace", "")), str(pdb.get("name", "")))
+            probe = {
+                "namespace": key[0], "name": "", "nodeName": "probe",
+                "phase": "Running", "labels": {},
+            }
+            budget_statuses({"pdbs": [pdb], "pods": [probe]})
+        except Exception as e:
+            raise StoreError(f"malformed PDB object: {e}") from e
         return key
 
     def _validate_node(self, node: dict) -> None:

@@ -60,6 +60,19 @@ def _k8s_pod(p: dict) -> dict:
     }
 
 
+def _k8s_pdb(b: dict) -> dict:
+    """Fixture-schema pdb → K8s REST PodDisruptionBudget object."""
+    spec = {"selector": b.get("selector") or {}}
+    for k in ("minAvailable", "maxUnavailable"):
+        if k in b:
+            spec[k] = b[k]
+    return {
+        "metadata": {"name": b.get("name", ""),
+                     "namespace": b.get("namespace", "")},
+        "spec": spec,
+    }
+
+
 class MockApiserver:
     """Paginated + watchable apiserver over the fixture schema.
 
@@ -74,6 +87,12 @@ class MockApiserver:
             "/api/v1/nodes": [_k8s_node(n) for n in fixture["nodes"]],
             "/api/v1/pods": [_k8s_pod(p) for p in fixture["pods"]],
         }
+        if fixture.get("pdbs"):
+            # Fixtures without PDBs leave the policy path unregistered —
+            # the 404 exercises the followers' degrade path.
+            self.items["/apis/policy/v1/poddisruptionbudgets"] = [
+                _k8s_pdb(b) for b in fixture["pdbs"]
+            ]
         self.requests: list[str] = []
         self.watch_streams: dict[str, list[list]] = {}
         self._rv = 100
@@ -281,10 +300,16 @@ class TestLiveFixture:
             assert mine["nodeName"] == orig["nodeName"]
             assert mine["phase"] == orig["phase"]
         # Pagination actually happened: >1 request per resource, and only
-        # the two resources were ever queried (no N+1 pattern).
+        # whole-resource Lists were ever issued (no N+1 pattern) — nodes,
+        # pods, and the optional policy probe (404 here: no PDBs).
         paths = {r.split("?")[0] for r in srv.requests}
-        assert paths == {"/api/v1/nodes", "/api/v1/pods"}
-        assert len(srv.requests) > 2
+        assert paths == {
+            "/api/v1/nodes",
+            "/api/v1/pods",
+            "/apis/policy/v1/poddisruptionbudgets",
+        }
+        assert len(srv.requests) > 3
+        assert "pdbs" not in got
 
     def test_snapshot_from_live_cluster_stdlib_fallback(self, tmp_path, cluster):
         """snapshot_from_live_cluster → stdlib client → identical packing."""

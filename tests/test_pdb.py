@@ -1,0 +1,303 @@
+"""PodDisruptionBudget gate (``pdb.py``) and its drain integration."""
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.models import CapacityModel
+from kubernetesclustercapacity_tpu.pdb import (
+    blocked_evictions,
+    budget_statuses,
+)
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+
+def _pod(name, ns, node, labels=None, phase="Running"):
+    return {"name": name, "namespace": ns, "nodeName": node, "phase": phase,
+            "labels": labels or {}, "containers": [{"resources": {
+                "requests": {"cpu": "100m", "memory": "67108864"}}}]}
+
+
+def _node(name, cpu="8"):
+    return {"name": name,
+            "allocatable": {"cpu": cpu, "memory": "16777216Ki", "pods": "20"},
+            "conditions": [{"type": "Ready", "status": "True"}]}
+
+
+@pytest.fixture()
+def pdb_fixture():
+    return {
+        "nodes": [_node("a"), _node("b")],
+        "pods": [
+            _pod("db-0", "prod", "a", {"app": "db"}),
+            _pod("db-1", "prod", "b", {"app": "db"}),
+            _pod("web-0", "prod", "a", {"app": "web"}),
+            _pod("db-x", "staging", "a", {"app": "db"}),  # other namespace
+        ],
+        "pdbs": [{
+            "name": "db-pdb", "namespace": "prod",
+            "selector": {"matchLabels": {"app": "db"}},
+            "minAvailable": 2,
+        }],
+    }
+
+
+class TestBudgetMath:
+    def test_min_available_exhausted(self, pdb_fixture):
+        (s,) = budget_statuses(pdb_fixture)
+        assert (s.expected, s.healthy) == (2, 2)  # prod/db only
+        assert s.desired_healthy == 2 and s.allowed_disruptions == 0
+
+    def test_min_available_with_slack(self, pdb_fixture):
+        pdb_fixture["pdbs"][0]["minAvailable"] = 1
+        (s,) = budget_statuses(pdb_fixture)
+        assert s.allowed_disruptions == 1
+
+    def test_max_unavailable(self, pdb_fixture):
+        del pdb_fixture["pdbs"][0]["minAvailable"]
+        pdb_fixture["pdbs"][0]["maxUnavailable"] = 1
+        (s,) = budget_statuses(pdb_fixture)
+        assert s.desired_healthy == 1 and s.allowed_disruptions == 1
+
+    def test_percentage_rounds_up(self, pdb_fixture):
+        pdb_fixture["pdbs"][0]["minAvailable"] = "51%"
+        (s,) = budget_statuses(pdb_fixture)
+        assert s.desired_healthy == 2  # ceil(1.02)
+        assert s.allowed_disruptions == 0
+
+    def test_pending_pod_counts_expected_not_healthy(self, pdb_fixture):
+        pdb_fixture["pods"].append(
+            _pod("db-2", "prod", "", {"app": "db"}, phase="Pending"))
+        pdb_fixture["pdbs"][0]["minAvailable"] = "50%"
+        (s,) = budget_statuses(pdb_fixture)
+        assert (s.expected, s.healthy) == (3, 2)
+        assert s.desired_healthy == 2 and s.allowed_disruptions == 0
+
+    def test_both_fields_rejected(self, pdb_fixture):
+        pdb_fixture["pdbs"][0]["maxUnavailable"] = 1
+        with pytest.raises(ValueError, match="exactly one"):
+            budget_statuses(pdb_fixture)
+
+    def test_empty_selector_matches_namespace(self, pdb_fixture):
+        pdb_fixture["pdbs"][0]["selector"] = {}
+        (s,) = budget_statuses(pdb_fixture)
+        assert s.expected == 3  # every prod pod, not staging
+
+    def test_match_expressions(self, pdb_fixture):
+        pdb_fixture["pdbs"][0]["selector"] = {
+            "matchExpressions": [
+                {"key": "app", "operator": "In", "values": ["db", "cache"]}
+            ]
+        }
+        (s,) = budget_statuses(pdb_fixture)
+        assert s.expected == 2
+
+    def test_blocked_evictions_scoped(self, pdb_fixture):
+        blocked = blocked_evictions(
+            pdb_fixture,
+            ["prod/db-0", "prod/web-0", "staging/db-x"],
+        )
+        assert blocked == {"prod/db-0": ["db-pdb"]}
+
+    def test_no_pdbs_no_blocks(self):
+        assert blocked_evictions({"pods": []}, ["a/b"]) == {}
+
+    def test_multi_coverage_blocks_regardless_of_allowance(self, pdb_fixture):
+        """The eviction API errors on >1 covering PDB even with slack."""
+        pdb_fixture["pdbs"][0]["minAvailable"] = 0  # ample allowance
+        pdb_fixture["pdbs"].append({
+            "name": "db-pdb-2", "namespace": "prod",
+            "selector": {"matchLabels": {"app": "db"}},
+            "maxUnavailable": 2,  # ample allowance too
+        })
+        blocked = blocked_evictions(pdb_fixture, ["prod/db-0", "prod/web-0"])
+        assert blocked == {"prod/db-0": ["db-pdb", "db-pdb-2"]}
+
+
+class TestDrainIntegration:
+    def _drain(self, fx, node="a"):
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        return CapacityModel(snap, mode="strict", fixture=fx).drain(node)
+
+    def test_exhausted_budget_blocks_drain(self, pdb_fixture):
+        result = self._drain(pdb_fixture)
+        assert result.blocked == {"prod/db-0": ["db-pdb"]}
+        assert not result.evictable
+        # The plan still shows where the pod WOULD go.
+        assert result.by_pod()["prod/db-0"] == "b"
+
+    def test_budget_with_slack_allows_drain(self, pdb_fixture):
+        pdb_fixture["pdbs"][0]["minAvailable"] = 1
+        result = self._drain(pdb_fixture)
+        assert result.blocked == {} and result.evictable
+
+    def test_wire_carries_blocked_and_survives_update(self, pdb_fixture):
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        snap = snapshot_from_fixture(pdb_fixture, semantics="strict")
+        srv = CapacityServer(snap, port=0, fixture=pdb_fixture)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                r = c.drain("a")
+                assert not r["evictable"]
+                assert r["blocked"] == {"prod/db-0": ["db-pdb"]}
+                # A store rematerialization must keep the budgets: add an
+                # unrelated pod, then re-drain.
+                c.update([{"type": "ADDED", "kind": "Pod", "object":
+                           _pod("web-1", "prod", "b", {"app": "web"})}])
+                r2 = c.drain("a")
+                assert r2["blocked"] == {"prod/db-0": ["db-pdb"]}
+        finally:
+            srv.shutdown()
+
+    def test_cli_renders_blocked(self, capsys, tmp_path, pdb_fixture):
+        import json
+
+        from kubernetesclustercapacity_tpu.cli import main
+
+        path = tmp_path / "c.json"
+        path.write_text(json.dumps(pdb_fixture))
+        code = main(["-snapshot", str(path), "-semantics", "strict",
+                     "-drain", "a"])
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "[BLOCKED by PDB db-pdb]" in out
+        assert "blocked by disruption budgets" in out
+
+
+class TestStoreEvents:
+    def test_pdb_events_upsert_and_delete(self, pdb_fixture):
+        from kubernetesclustercapacity_tpu.store import ClusterStore
+
+        store = ClusterStore(pdb_fixture, semantics="strict")
+        assert store.has_pdb("prod", "db-pdb")
+        store.apply_event({
+            "type": "MODIFIED", "kind": "PodDisruptionBudget",
+            "object": {"name": "db-pdb", "namespace": "prod",
+                       "selector": {"matchLabels": {"app": "db"}},
+                       "minAvailable": 1},
+        })
+        view = store.fixture_view()
+        assert view["pdbs"][0]["minAvailable"] == 1
+        store.apply_event({
+            "type": "DELETED", "kind": "PodDisruptionBudget",
+            "object": {"name": "db-pdb", "namespace": "prod"},
+        })
+        assert "pdbs" not in store.fixture_view()
+
+    @pytest.mark.parametrize("bad", [
+        # both fields (API forbids)
+        {"minAvailable": 1, "maxUnavailable": 1},
+        # selector faults must surface at ADMISSION, not at drain time
+        {"minAvailable": 1, "selector": {"matchExpressions": [
+            {"key": "app", "operator": "Wat"}]}},
+        {"minAvailable": 1, "selector": {"matchLabels": "notadict"}},
+        {"minAvailable": "x%"},
+    ])
+    def test_malformed_pdb_event_rejected(self, pdb_fixture, bad):
+        from kubernetesclustercapacity_tpu.store import (
+            ClusterStore,
+            StoreError,
+        )
+
+        store = ClusterStore(pdb_fixture, semantics="strict")
+        with pytest.raises(StoreError, match="malformed PDB"):
+            store.apply_event({
+                "type": "ADDED", "kind": "PodDisruptionBudget",
+                "object": {"name": "bad", "namespace": "prod", **bad},
+            })
+        # The rejected event left raw state intact, and drain still works.
+        view = store.fixture_view()
+        assert [b["name"] for b in view["pdbs"]] == ["db-pdb"]
+
+    def test_duplicate_pdbs_rejected(self, pdb_fixture):
+        from kubernetesclustercapacity_tpu.store import (
+            ClusterStore,
+            StoreError,
+        )
+
+        pdb_fixture["pdbs"].append(dict(pdb_fixture["pdbs"][0]))
+        with pytest.raises(StoreError, match="duplicate PDB"):
+            ClusterStore(pdb_fixture, semantics="strict")
+
+
+class TestFollowerStream:
+    def test_follower_lists_and_streams_pdbs(self, pdb_fixture):
+        """List picks the budgets up; a watch event updates them; the
+        degrade path (no policy API) leaves the follower healthy."""
+        import json as _json
+
+        from kubernetesclustercapacity_tpu.follower import ClusterFollower
+        from kubernetesclustercapacity_tpu.kubeapi import (
+            PDB_PATH,
+            KubeClient,
+            KubeConfig,
+        )
+        from test_kubeapi import MockApiserver, _k8s_pdb
+
+        server = MockApiserver(pdb_fixture, require_token="tok")
+        updated = dict(pdb_fixture["pdbs"][0], minAvailable=1)
+        ev_obj = _k8s_pdb(updated)
+        ev_obj["metadata"]["resourceVersion"] = "901"
+        server.watch_streams = {
+            PDB_PATH: [[{"type": "MODIFIED", "object": ev_obj}]],
+        }
+        cfg = KubeConfig(f"http://127.0.0.1:{server.port}", token="tok")
+        f = ClusterFollower(
+            client_factory=lambda: KubeClient(cfg),
+            semantics="strict", stop_on_idle_window=True,
+        ).start()
+        try:
+            assert f.wait_synced(5)
+            f.join(5)
+            view = f.fixture_view()
+            assert view["pdbs"] == [
+                _json.loads(_json.dumps(updated))
+            ]
+        finally:
+            f.stop()
+            server.close()
+
+    def test_follower_degrades_without_policy_api(self):
+        from kubernetesclustercapacity_tpu.follower import ClusterFollower
+        from kubernetesclustercapacity_tpu.kubeapi import (
+            KubeClient,
+            KubeConfig,
+        )
+        from test_kubeapi import MockApiserver
+
+        fx = {"nodes": [_node("a")], "pods": []}  # no pdbs → policy 404s
+        server = MockApiserver(fx, require_token="tok")
+        cfg = KubeConfig(f"http://127.0.0.1:{server.port}", token="tok")
+        f = ClusterFollower(
+            client_factory=lambda: KubeClient(cfg),
+            semantics="strict", stop_on_idle_window=True,
+        ).start()
+        try:
+            assert f.wait_synced(5)
+            assert f._pdb_unavailable
+            assert "pdbs" not in f.fixture_view()
+            assert f.fatal is None
+        finally:
+            f.stop()
+            server.close()
+
+
+class TestLiveConversion:
+    def test_pdb_to_fixture(self):
+        from kubernetesclustercapacity_tpu.kubeapi import pdb_to_fixture
+
+        rest = {
+            "metadata": {"name": "db", "namespace": "prod"},
+            "spec": {"selector": {"matchLabels": {"app": "db"}},
+                     "minAvailable": "50%"},
+        }
+        out = pdb_to_fixture(rest)
+        assert out == {"name": "db", "namespace": "prod",
+                       "selector": {"matchLabels": {"app": "db"}},
+                       "minAvailable": "50%"}
+        rest["spec"] = {"selector": {}, "maxUnavailable": 1}
+        assert pdb_to_fixture(rest)["maxUnavailable"] == 1
