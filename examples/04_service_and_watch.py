@@ -57,6 +57,41 @@ def main() -> None:
     finally:
         server.shutdown()
 
+    # Strict-mode server: the drain op (kubectl drain dry-run) with a
+    # PodDisruptionBudget gating evictions the way the eviction API would.
+    # Empty selector = every pod in the namespace; default holds exactly
+    # the two web replicas, so minAvailable=2 leaves zero disruption
+    # allowance.
+    fixture["pdbs"] = [{
+        "name": "default-pdb", "namespace": "default",
+        "selector": {},
+        "minAvailable": 2,
+    }]
+    strict = CapacityServer(
+        snapshot_from_fixture(fixture, semantics="strict"),
+        port=0, fixture=fixture,
+    )
+    strict.start()
+    try:
+        with CapacityClient(*strict.address) as client:
+            worker2 = fixture["nodes"][2]["name"]
+            plan = client.drain(worker2)
+            print(f"\ndrain {worker2}: evictable={plan['evictable']}")
+            for pod, target in plan["by_pod"].items():
+                note = (f"  [BLOCKED by {', '.join(plan['blocked'][pod])}]"
+                        if pod in plan["blocked"] else "")
+                print(f"  {pod:<40} -> {target}{note}")
+            # The worker2 web replica is part of the exhausted budget.
+            assert any("web" in p for p in plan["blocked"])
+            # Relax the budget via a watch-style event; the verdict flips.
+            client.update([{"type": "MODIFIED", "kind": "PodDisruptionBudget",
+                            "object": dict(fixture["pdbs"][0],
+                                           minAvailable=1)}])
+            assert client.drain(worker2)["evictable"]
+            print("after relaxing the budget: evictable")
+    finally:
+        strict.shutdown()
+
 
 if __name__ == "__main__":
     main()
