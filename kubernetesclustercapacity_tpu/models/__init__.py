@@ -7,4 +7,5 @@ from kubernetesclustercapacity_tpu.models.capacity import (  # noqa: F401
     DrainResult,
     PlacementResult,
     PodSpec,
+    TopologySpreadResult,
 )

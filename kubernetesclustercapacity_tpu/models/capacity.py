@@ -36,6 +36,7 @@ __all__ = [
     "CapacityResult",
     "DrainResult",
     "PlacementResult",
+    "TopologySpreadResult",
 ]
 
 
@@ -223,6 +224,34 @@ class DrainResult:
 
 
 @dataclass
+class TopologySpreadResult:
+    """Capacity under a PodTopologySpreadConstraint (DoNotSchedule).
+
+    ``zones`` maps each eligible topology domain to its raw capacity
+    (sum of per-node fits); ``allowed`` to the replicas it may actually
+    take under the skew bound — ``min(c_z, min_zone_capacity +
+    max_skew)``, the reachable optimum for identical replicas filling
+    round-robin.  A domain with zero remaining capacity still anchors
+    the global minimum, capping every other domain at ``max_skew`` —
+    exactly kube-scheduler's skew arithmetic.  ``unkeyed_nodes`` counts
+    eligible nodes missing the topology key (excluded from domains and
+    from capacity, the constraint's default node-inclusion behavior).
+    """
+
+    topology_key: str
+    max_skew: int
+    zones: dict[str, int]
+    allowed: dict[str, int]
+    total: int
+    replicas_requested: int
+    unkeyed_nodes: int
+
+    @property
+    def schedulable(self) -> bool:
+        return self.total >= self.replicas_requested
+
+
+@dataclass
 class CapacityPlan:
     """Outcome of a scale-up plan: nodes to add so the spec fits.
 
@@ -285,6 +314,44 @@ class CapacityModel:
         self._ptable = priority_table
 
     # -- mask assembly -----------------------------------------------------
+    def _mask_parts(
+        self, spec: PodSpec
+    ) -> tuple[np.ndarray | None, np.ndarray | None, np.ndarray | None]:
+        """``(taint, node_affinity, pod_anti_affinity)`` masks — split
+        the way topology-spread domain discovery needs them: the
+        node-affinity family (selector + affinity) filters domains under
+        the default ``nodeAffinityPolicy: Honor``, taints by
+        ``node_taints_policy``, while inter-pod anti-affinity is a
+        separate predicate that never filters domains."""
+        snap = self.snapshot
+        has_taints = bool(snap.taints) and any(snap.taints)
+        taint = None
+        if has_taints and (self.mode == "strict" or spec.tolerations):
+            taint = _masks.tolerations_mask(snap, list(spec.tolerations))
+        affinity_parts = []
+        if spec.node_selector:
+            affinity_parts.append(
+                _masks.node_selector_mask(snap, spec.node_selector)
+            )
+        if spec.affinity_terms:
+            affinity_parts.append(
+                _masks.node_affinity_mask(snap, list(spec.affinity_terms))
+            )
+        anti = None
+        if spec.anti_affinity_labels:
+            if self.fixture is None:
+                raise ValueError(
+                    "anti-affinity vs existing pods needs the source fixture "
+                    "(pod labels are not part of the dense snapshot)"
+                )
+            anti = _masks.anti_affinity_existing_mask(
+                snap,
+                self.fixture,
+                spec.anti_affinity_labels,
+                namespace=spec.namespace,
+            )
+        return taint, _masks.combine_masks(*affinity_parts), anti
+
     def _masks_for(self, spec: PodSpec) -> np.ndarray | None:
         """Mask policy, by mode.
 
@@ -296,32 +363,7 @@ class CapacityModel:
           and require ``allow_extensions`` (else :meth:`evaluate` raised
           already).
         """
-        snap = self.snapshot
-        has_taints = bool(snap.taints) and any(snap.taints)
-        parts = []
-        if has_taints and (self.mode == "strict" or spec.tolerations):
-            parts.append(_masks.tolerations_mask(snap, list(spec.tolerations)))
-        if spec.node_selector:
-            parts.append(_masks.node_selector_mask(snap, spec.node_selector))
-        if spec.affinity_terms:
-            parts.append(
-                _masks.node_affinity_mask(snap, list(spec.affinity_terms))
-            )
-        if spec.anti_affinity_labels:
-            if self.fixture is None:
-                raise ValueError(
-                    "anti-affinity vs existing pods needs the source fixture "
-                    "(pod labels are not part of the dense snapshot)"
-                )
-            parts.append(
-                _masks.anti_affinity_existing_mask(
-                    snap,
-                    self.fixture,
-                    spec.anti_affinity_labels,
-                    namespace=spec.namespace,
-                )
-            )
-        return _masks.combine_masks(*parts)
+        return _masks.combine_masks(*self._mask_parts(spec))
 
     def _check_extensions(self, constrained: bool) -> None:
         if (
@@ -406,18 +448,28 @@ class CapacityModel:
         return alloc_rn, used_rn, reqs
 
     # -- evaluation --------------------------------------------------------
-    def evaluate(self, spec: PodSpec) -> CapacityResult:
+    _MASK_UNSET = object()
+
+    def evaluate(self, spec: PodSpec, *, _node_mask=_MASK_UNSET) -> CapacityResult:
         """One spec → per-node fits + verdict.
 
         Resource arithmetic always runs on the appropriate kernel: the
         bit-exact 2-resource kernel unless the spec requests extended
         resources (which need the R-dim generalization).  Constraint masks
         and the spread clamp compose around either kernel.
+        (``_node_mask`` is an internal hook: a caller that already built
+        the spec's mask — :meth:`topology_spread` needs its parts —
+        passes it to skip the rebuild, which walks the fixture for
+        anti-affinity specs.)
         """
         snap = self.snapshot
         self._check_extensions(spec.constrained or bool(spec.extended_requests))
         self._check_preemption(spec)
-        mask = self._masks_for(spec)
+        mask = (
+            self._masks_for(spec)
+            if _node_mask is self._MASK_UNSET
+            else _node_mask
+        )
 
         if not spec.extended_requests:
             used_cpu, used_mem, pods_count = self._usage_arrays(spec)
@@ -726,6 +778,94 @@ class CapacityModel:
             per_node=np.asarray(counts),
             policy=policy,
             blocked=blocked,
+        )
+
+    def topology_spread(
+        self,
+        spec: PodSpec,
+        *,
+        topology_key: str,
+        max_skew: int = 1,
+        node_taints_policy: str = "ignore",
+    ) -> TopologySpreadResult:
+        """Capacity under a topology spread constraint — how many
+        replicas fit when their counts across ``topology_key`` domains
+        may differ by at most ``max_skew`` (the PodTopologySpread
+        ``DoNotSchedule`` predicate).
+
+        Closed form over the ordinary per-node fits (so every other
+        surface — masks, taints, per-node ``spread``, extended
+        resources, preemption ``priority`` — composes): group fits into
+        zone capacities ``c_z``, then each zone may take
+        ``min(c_z, min_z c_z + max_skew)``.  Domains are the key's
+        values among domain-eligible nodes, so a selector that excludes
+        a zone removes it from the skew minimum, and a full-but-eligible
+        zone anchors it at 0.  Domain filtering mirrors upstream's
+        node-inclusion policies: the node-affinity family (selector +
+        affinity) filters domains (``nodeAffinityPolicy: Honor``, the
+        default); ``node_taints_policy`` mirrors the constraint field —
+        the upstream default ``"ignore"`` keeps a zone whose only nodes
+        are hard-tainted as a 0-capacity domain (the classic
+        pending-pods surprise), ``"honor"`` drops it; inter-pod
+        anti-affinity never filters domains (it is a separate predicate
+        — an anti-affinity-excluded zone stays and anchors the
+        minimum).  Counts new replicas only — the fresh-deployment
+        model, where the constraint's selector matches just the spec's
+        own pods.
+
+        Strict semantics only.
+        """
+        if self.mode != "strict":
+            raise ValueError(
+                "topology spread requires strict semantics (the reference "
+                "has no constraint concept)"
+            )
+        if max_skew < 1:
+            raise ValueError("max_skew must be >= 1")
+        if node_taints_policy not in ("ignore", "honor"):
+            raise ValueError(
+                f"node_taints_policy must be 'ignore' or 'honor', got "
+                f"{node_taints_policy!r}"
+            )
+        snap = self.snapshot
+        taint_mask, affinity_mask, anti_mask = self._mask_parts(spec)
+        full_mask = _masks.combine_masks(taint_mask, affinity_mask, anti_mask)
+        fits = self.evaluate(spec, _node_mask=full_mask).fits
+        domain_mask = (
+            affinity_mask
+            if node_taints_policy == "ignore"
+            else _masks.combine_masks(taint_mask, affinity_mask)
+        )
+        zones: dict[str, int] = {}
+        unkeyed = 0
+        for i in range(snap.n_nodes):
+            if not snap.healthy[i] or (
+                domain_mask is not None and not domain_mask[i]
+            ):
+                continue
+            labels = snap.labels[i] if i < len(snap.labels) else {}
+            zone = labels.get(topology_key)
+            if zone is None:
+                unkeyed += 1
+                continue
+            zones[zone] = zones.get(zone, 0) + int(fits[i])
+        if not zones:
+            allowed: dict[str, int] = {}
+            total = 0
+        else:
+            floor = min(zones.values())
+            allowed = {
+                z: min(c, floor + max_skew) for z, c in zones.items()
+            }
+            total = sum(allowed.values())
+        return TopologySpreadResult(
+            topology_key=topology_key,
+            max_skew=max_skew,
+            zones=zones,
+            allowed=allowed,
+            total=total,
+            replicas_requested=spec.replicas,
+            unkeyed_nodes=unkeyed,
         )
 
     def _template_model(self, node_template: dict) -> "CapacityModel":

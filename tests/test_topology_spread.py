@@ -1,0 +1,156 @@
+"""Topology spread capacity (``CapacityModel.topology_spread``)."""
+
+import pytest
+
+from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
+from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+MIB = 1024 * 1024
+GIB = 1024 * MIB
+
+
+def _node(name, zone=None, cpu="4", taints=(), labels=None):
+    labels = dict(labels or {})
+    if zone is not None:
+        labels["zone"] = zone
+    return {"name": name,
+            "allocatable": {"cpu": cpu, "memory": "16777216Ki",
+                            "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "labels": labels, "taints": list(taints)}
+
+
+def _model(nodes, pods=()):
+    fx = {"nodes": nodes, "pods": list(pods)}
+    snap = snapshot_from_fixture(fx, semantics="strict")
+    return CapacityModel(snap, mode="strict", fixture=fx)
+
+
+SPEC = PodSpec(cpu_request_milli=1000, mem_request_bytes=1 * GIB, replicas=8)
+
+
+class TestTopologySpread:
+    def test_balanced_zones_unconstrained(self):
+        # 4 fits per node, zones a/b each one node: skew never binds.
+        model = _model([_node("n0", "a"), _node("n1", "b")])
+        r = model.topology_spread(SPEC, topology_key="zone", max_skew=4)
+        assert r.zones == {"a": 4, "b": 4}
+        assert r.allowed == {"a": 4, "b": 4} and r.total == 8
+        assert r.schedulable
+
+    def test_small_zone_anchors_minimum(self):
+        # zone a: 8 cores -> 8 fits; zone b: 1 core -> 1 fit.
+        model = _model([_node("n0", "a", cpu="8"), _node("n1", "b", cpu="1")])
+        r = model.topology_spread(SPEC, topology_key="zone", max_skew=1)
+        assert r.zones == {"a": 8, "b": 1}
+        assert r.allowed == {"a": 2, "b": 1}  # min(8, 1+1), min(1, 2)
+        assert r.total == 3 and not r.schedulable
+
+    def test_full_zone_caps_everything_at_skew(self):
+        # zone b exists (eligible node) but has zero remaining capacity.
+        hog = {"name": "hog", "namespace": "d", "nodeName": "n1",
+               "phase": "Running",
+               "containers": [{"resources": {"requests": {
+                   "cpu": "4", "memory": "16777216Ki"}}}]}
+        model = _model([_node("n0", "a", cpu="8"), _node("n1", "b")], [hog])
+        r = model.topology_spread(SPEC, topology_key="zone", max_skew=2)
+        assert r.zones == {"a": 8, "b": 0}
+        assert r.allowed == {"a": 2, "b": 0} and r.total == 2
+
+    def test_selector_excluded_zone_leaves_the_minimum(self):
+        nodes = [_node("n0", "a", cpu="8", labels={"tier": "fast"}),
+                 _node("n1", "b", cpu="1")]
+        model = _model(nodes)
+        narrowed = model.topology_spread(
+            PodSpec(cpu_request_milli=1000, mem_request_bytes=1 * GIB,
+                    replicas=8, node_selector={"tier": "fast"}),
+            topology_key="zone", max_skew=1,
+        )
+        # zone b is constraint-ineligible: no longer a domain, no anchor.
+        assert narrowed.zones == {"a": 8} and narrowed.total == 8
+
+    def test_unkeyed_nodes_excluded_and_counted(self):
+        model = _model([_node("n0", "a"), _node("n1", zone=None)])
+        r = model.topology_spread(SPEC, topology_key="zone")
+        assert r.zones == {"a": 4} and r.unkeyed_nodes == 1
+        assert r.total == 4
+
+    def test_no_domains(self):
+        model = _model([_node("n0", zone=None)])
+        r = model.topology_spread(SPEC, topology_key="zone")
+        assert r.zones == {} and r.total == 0 and not r.schedulable
+
+    def test_composes_with_per_node_spread(self):
+        # Two nodes in zone a (8 fits each), one in b (1 fit); the
+        # per-node spread=3 cap shrinks a's capacity before skew math.
+        model = _model([_node("n0", "a", cpu="8"), _node("n1", "a", cpu="8"),
+                        _node("n2", "b", cpu="1")])
+        spec = PodSpec(cpu_request_milli=1000, mem_request_bytes=1 * GIB,
+                       replicas=8, spread=3)
+        r = model.topology_spread(spec, topology_key="zone", max_skew=2)
+        assert r.zones == {"a": 6, "b": 1}
+        assert r.allowed == {"a": 3, "b": 1}
+
+    def test_tainted_zone_by_policy(self):
+        """Upstream default (nodeTaintsPolicy: Ignore): a zone whose only
+        node is hard-tainted stays a 0-capacity domain and pins the skew
+        minimum — the classic pending-pods surprise.  Honor drops it."""
+        taint = ({"key": "k", "value": "v", "effect": "NoSchedule"},)
+        model = _model([_node("n0", "a", cpu="8"),
+                        _node("n1", "b", cpu="1", taints=taint)])
+        ignore = model.topology_spread(SPEC, topology_key="zone", max_skew=1)
+        assert ignore.zones == {"a": 8, "b": 0}
+        assert ignore.allowed == {"a": 1, "b": 0} and ignore.total == 1
+        honor = model.topology_spread(
+            SPEC, topology_key="zone", max_skew=1,
+            node_taints_policy="honor",
+        )
+        assert honor.zones == {"a": 8} and honor.total == 8
+        tol = PodSpec(cpu_request_milli=1000, mem_request_bytes=1 * GIB,
+                      replicas=8, tolerations=({"operator": "Exists"},))
+        r2 = model.topology_spread(tol, topology_key="zone", max_skew=1)
+        assert r2.zones == {"a": 8, "b": 1}
+
+    def test_anti_affinity_zone_stays_a_domain(self):
+        """Inter-pod anti-affinity is a predicate, not a domain filter:
+        a zone emptied by anti-affinity still anchors the skew minimum
+        (real deployments go Pending here — the capacity must say so)."""
+        db = {"name": "db", "namespace": "prod", "nodeName": "n1",
+              "phase": "Running", "labels": {"app": "db"},
+              "containers": []}
+        model = _model([_node("n0", "a", cpu="8"), _node("n1", "b")], [db])
+        spec = PodSpec(cpu_request_milli=1000, mem_request_bytes=1 * GIB,
+                       replicas=8, anti_affinity_labels={"app": "db"},
+                       namespace="prod")
+        r = model.topology_spread(spec, topology_key="zone", max_skew=1)
+        assert r.zones == {"a": 8, "b": 0}
+        assert r.total == 1 and not r.schedulable
+        # but a node_selector DOES filter domains (nodeAffinityPolicy
+        # Honor): narrowing to zone a removes b from the minimum.
+        sel = PodSpec(cpu_request_milli=1000, mem_request_bytes=1 * GIB,
+                      replicas=8, node_selector={"zone": "a"})
+        r2 = model.topology_spread(sel, topology_key="zone", max_skew=1)
+        assert r2.zones == {"a": 8} and r2.total == 8
+
+    def test_bad_taints_policy_rejected(self):
+        model = _model([_node("n0", "a")])
+        with pytest.raises(ValueError, match="node_taints_policy"):
+            model.topology_spread(SPEC, topology_key="zone",
+                                  node_taints_policy="maybe")
+
+    def test_reference_mode_rejected(self):
+        fx = {"nodes": [], "pods": []}
+        snap = snapshot_from_fixture(fx, semantics="reference")
+        model = CapacityModel(snap, mode="reference")
+        with pytest.raises(ValueError, match="strict semantics"):
+            model.topology_spread(SPEC, topology_key="zone")
+
+    def test_bad_skew_rejected(self):
+        model = _model([_node("n0", "a")])
+        with pytest.raises(ValueError, match="max_skew"):
+            model.topology_spread(SPEC, topology_key="zone", max_skew=0)
+
+    def test_large_skew_equals_plain_capacity(self):
+        model = _model([_node("n0", "a", cpu="8"), _node("n1", "b", cpu="2")])
+        r = model.topology_spread(SPEC, topology_key="zone", max_skew=100)
+        assert r.total == model.evaluate(SPEC).total == sum(r.zones.values())
