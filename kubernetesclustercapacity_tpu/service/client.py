@@ -79,3 +79,11 @@ class CapacityClient:
     def drain(self, node: str, **flags) -> dict:
         """Simulate draining a node: a rehoming target per evicted pod."""
         return self.call("drain", node=node, **flags)
+
+    def topology_spread(self, topology_key: str, **flags) -> dict:
+        """Capacity under a maxSkew topology spread constraint."""
+        return self.call("topology_spread", topology_key=topology_key, **flags)
+
+    def plan(self, node_template: dict, **flags) -> dict:
+        """Scale-up plan: nodes of this shape needed to fit the spec."""
+        return self.call("plan", node_template=node_template, **flags)

@@ -76,8 +76,9 @@ class CapacityServer:
       exposing the port beyond localhost, since ``reload``/``update``
       mutate served state.
     * ``max_inflight`` — cap on concurrently-executing compute ops
-      (fit/sweep/place); excess requests wait up to ``inflight_wait_s``
-      then fail with "server busy" instead of queuing unboundedly.
+      (fit/sweep/sweep_multi/place/drain/topology_spread/plan); excess
+      requests wait up to ``inflight_wait_s`` then fail with "server
+      busy" instead of queuing unboundedly.
     * ``reload_roots`` — when non-empty, ``reload`` paths must resolve
       (symlinks followed) under one of these directories; otherwise any
       server-readable path can be probed through reload errors.
@@ -147,7 +148,10 @@ class CapacityServer:
                 token.encode(), self._auth_token.encode()
             ):
                 raise PermissionError("missing or invalid auth token")
-        if op in ("fit", "sweep", "sweep_multi", "place", "drain"):
+        if op in (
+            "fit", "sweep", "sweep_multi", "place", "drain",
+            "topology_spread", "plan",
+        ):
             # Bounded concurrency for the compute ops: each holds device
             # dispatch + host packing; unbounded fan-in from one noisy
             # client must not starve the box.
@@ -174,7 +178,7 @@ class CapacityServer:
             if self._fixture_dirty and (
                 op == "drain"  # always reads per-pod requests
                 or (
-                    op in ("fit", "place")
+                    op in ("fit", "place", "topology_spread", "plan")
                     and self._fit_consumes_fixture(msg, snap.semantics)
                 )
             ):
@@ -204,6 +208,10 @@ class CapacityServer:
             return self._op_place(msg, snap, fixture)
         if op == "drain":
             return self._op_drain(msg, snap, fixture)
+        if op == "topology_spread":
+            return self._op_topology_spread(msg, snap, fixture)
+        if op == "plan":
+            return self._op_plan(msg, snap, fixture)
         if op == "reload":
             return self._op_reload(msg, snap)
         if op == "update":
@@ -518,6 +526,61 @@ class CapacityServer:
             "policy": result.policy,
         }
 
+    def _op_topology_spread(
+        self, msg: dict, snap: ClusterSnapshot, fixture: dict | None
+    ) -> dict:
+        """Capacity under a PodTopologySpread maxSkew constraint —
+        :meth:`CapacityModel.topology_spread` over the wire."""
+        key = msg.get("topology_key")
+        if not isinstance(key, str) or not key:
+            raise ValueError(
+                "topology_spread wants a non-empty topology_key string"
+            )
+        scenario = self._scenario_from_msg(msg)
+        spec = self._spec_from_msg(msg, scenario)
+        try:
+            model = self._model_for(spec, snap, fixture)
+            r = model.topology_spread(
+                spec,
+                topology_key=key,
+                max_skew=int(msg.get("max_skew", 1)),
+                node_taints_policy=msg.get("node_taints_policy", "ignore"),
+            )
+        except (TypeError, KeyError, ValueError) as e:
+            raise ValueError(f"bad topology_spread request: {e}") from e
+        return {
+            "topology_key": r.topology_key,
+            "max_skew": r.max_skew,
+            "zones": r.zones,
+            "allowed": r.allowed,
+            "total": r.total,
+            "schedulable": r.schedulable,
+            "unkeyed_nodes": r.unkeyed_nodes,
+        }
+
+    def _op_plan(
+        self, msg: dict, snap: ClusterSnapshot, fixture: dict | None
+    ) -> dict:
+        """Scale-up planning — :meth:`CapacityModel.nodes_needed` over
+        the wire (``nodes_needed`` is null when unsatisfiable)."""
+        template = msg.get("node_template")
+        if not isinstance(template, dict):
+            raise ValueError("plan wants a node_template object")
+        scenario = self._scenario_from_msg(msg)
+        spec = self._spec_from_msg(msg, scenario)
+        try:
+            model = self._model_for(spec, snap, fixture)
+            plan = model.nodes_needed(spec, template)
+        except (TypeError, KeyError, ValueError) as e:
+            raise ValueError(f"bad plan request: {e}") from e
+        return {
+            "replicas_requested": plan.replicas_requested,
+            "current_total": plan.current_total,
+            "per_node_fit": plan.per_node_fit,
+            "nodes_needed": plan.nodes_needed,
+            "satisfiable": plan.satisfiable,
+        }
+
     def _op_sweep(
         self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
     ) -> dict:
@@ -728,7 +791,8 @@ def main(argv=None) -> int:
                         "$KCCAP_AUTH_TOKEN is), every op except ping must "
                         "carry it")
     p.add_argument("-max-inflight", type=int, default=8, dest="max_inflight",
-                   help="max concurrently-executing fit/sweep/place requests")
+                   help="max concurrently-executing compute requests "
+                        "(fit/sweep/place/drain/topology_spread/plan)")
     p.add_argument("-reload-root", action="append", default=[],
                    dest="reload_roots", metavar="DIR",
                    help="restrict reload paths to this directory "

@@ -150,6 +150,47 @@ class TestTopologySpread:
         with pytest.raises(ValueError, match="max_skew"):
             model.topology_spread(SPEC, topology_key="zone", max_skew=0)
 
+    def test_over_the_wire(self):
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        fx = {"nodes": [_node("n0", "a", cpu="8"), _node("n1", "b", cpu="1")],
+              "pods": []}
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        srv = CapacityServer(snap, port=0, fixture=fx)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                r = c.topology_spread(
+                    "zone", cpuRequests="1", memRequests="1024mb",
+                    replicas="8", max_skew=1,
+                )
+                assert r["zones"] == {"a": 8, "b": 1}
+                assert r["allowed"] == {"a": 2, "b": 1} and r["total"] == 3
+                assert not r["schedulable"]
+                plan = c.plan(
+                    {"allocatable": {"cpu": "4", "memory": "8388608Ki",
+                                     "pods": "110"}},
+                    cpuRequests="1", memRequests="1024mb", replicas="21",
+                )
+                # current 9; template fits min(4 cpu, 8 mem) = 4.
+                assert plan["current_total"] == 9
+                assert plan["per_node_fit"] == 4
+                assert plan["nodes_needed"] == 3 and plan["satisfiable"]
+                unsat = c.plan(
+                    {"allocatable": {"cpu": "4", "memory": "8388608Ki",
+                                     "pods": "110"}},
+                    cpuRequests="1", memRequests="1024mb", replicas="21",
+                    node_selector={"zone": "z9"},
+                )
+                assert unsat["nodes_needed"] is None
+                with pytest.raises(Exception, match="topology_key"):
+                    c.topology_spread("")
+        finally:
+            srv.shutdown()
+
     def test_large_skew_equals_plain_capacity(self):
         model = _model([_node("n0", "a", cpu="8"), _node("n1", "b", cpu="2")])
         r = model.topology_spread(SPEC, topology_key="zone", max_skew=100)
