@@ -102,6 +102,7 @@ class CapacityServer:
         self.fixture = fixture
         self._store = None  # lazy ClusterStore, built on first update op
         self._fixture_dirty = False  # fixture lags the store until needed
+        self._fixture_source = None  # lazy fixture provider (follower feed)
         self._ptable_cache = None  # (fixture, snapshot, PriorityTable)
         self._implicit_mask = _implicit_taint_mask(snapshot)
         self._auth_token = auth_token
@@ -175,22 +176,47 @@ class CapacityServer:
         # watch-event batch.
         with self._lock:
             snap = self.snapshot
-            if self._fixture_dirty and (
-                op == "drain"  # always reads per-pod requests
-                or (
-                    op in ("fit", "place", "topology_spread", "plan")
-                    and self._fit_consumes_fixture(msg, snap.semantics)
-                )
-            ):
-                # The one path that reads the raw fixture (_op_fit's
-                # reference cpu cross-check) rebuilds it here, under the
-                # same lock hold that captured the snapshot.
+            needs_fixture = op == "drain" or (  # drain always reads pods
+                op in ("fit", "place", "topology_spread", "plan")
+                and self._fit_consumes_fixture(msg, snap.semantics)
+            )
+            if needs_fixture and self._fixture_dirty and self._store is not None:
+                # Store-fed staleness rematerializes under the same lock
+                # hold that captured the snapshot: exact pairing (the
+                # fixture rebuilds from the state the snapshot came from).
                 self.fixture = self._store.fixture_view()
                 self._fixture_dirty = False
             # A dirty fixture is NEVER served: consumers see None (and
             # fall back to packed-array walks) rather than stale objects.
             fixture = None if self._fixture_dirty else self.fixture
+            # Follower-fed publishes swap snapshots without a fixture;
+            # pull one lazily — but only for consumers that correlate
+            # fixture to snapshot BY NODE NAME (drain, anti-affinity,
+            # the priority table), which tolerate the follower moving a
+            # little ahead of the published snapshot.  The reference
+            # cpu cross-check pairs fits to rows POSITIONALLY, so it
+            # keeps the self-consistent packed-array fallback instead.
+            source = None
+            if (
+                needs_fixture
+                and fixture is None
+                and self._fixture_source is not None
+                and (
+                    op == "drain"
+                    or "anti_affinity_labels" in msg
+                    or "priority" in msg
+                )
+            ):
+                source = self._fixture_source
             implicit_mask = self._implicit_mask
+        if source is not None:
+            # The O(N) deep copy runs OUTSIDE the dispatch lock (it also
+            # takes the follower's lock — holding both would stall every
+            # concurrent request AND watch-event application).
+            fixture = source()
+            with self._lock:
+                if self.snapshot is snap and self.fixture is None:
+                    self.fixture = fixture  # cache until the next publish
         if op == "info":
             return {
                 "nodes": snap.n_nodes,
@@ -671,13 +697,35 @@ class CapacityServer:
         }
 
     def replace_snapshot(
-        self, snapshot: ClusterSnapshot, fixture: dict | None = None
+        self,
+        snapshot: ClusterSnapshot,
+        fixture: dict | None = None,
+        *,
+        fixture_source=None,
     ) -> None:
-        """Atomically swap the served snapshot (e.g. from a live follower)."""
+        """Atomically swap the served snapshot (e.g. from a live follower).
+
+        ``fixture_source`` is an optional zero-arg callable yielding the
+        raw fixture for THIS snapshot on demand (the follower's
+        ``fixture_view``).  Publishers that swap snapshots at watch-event
+        rates pass the source instead of a materialized fixture, so the
+        O(N) deep copy is paid only when a fixture-consuming request
+        (drain, anti-affinity, priority, reference-cpu) actually
+        arrives — without it, those requests would see ``fixture=None``
+        forever after the first publish.
+
+        Consistency: a lazily-pulled fixture reflects the follower's
+        CURRENT state, which may lead the served snapshot by events that
+        arrived since this publish — bounded by the coalescer window,
+        since those same events schedule the next snapshot swap.  The
+        store-fed ``update`` path keeps its exact pairing (fixture
+        rebuilt from the same store state the snapshot came from).
+        """
         mask = _implicit_taint_mask(snapshot)
         with self._lock:
             self.snapshot = snapshot
             self.fixture = fixture
+            self._fixture_source = fixture_source
             self._store = None  # stale after a wholesale replace
             self._fixture_dirty = False
             self._implicit_mask = mask
@@ -875,7 +923,12 @@ def main(argv=None) -> int:
             follower.stop()
 
         coalescer = SnapshotCoalescer(
-            lambda: server.replace_snapshot(follower.snapshot()),
+            lambda: server.replace_snapshot(
+                follower.snapshot(),
+                # Raw objects on demand only (drain/anti-affinity/
+                # priority): the publish itself stays O(arrays).
+                fixture_source=follower.fixture_view,
+            ),
             min_interval_s=max(args.coalesce_ms, 0) / 1e3,
             on_error=_publish_failed,
         )

@@ -372,6 +372,44 @@ class TestDrainCLI:
         assert "UNPLACEABLE" in out and "NOT evictable" in out
 
 
+class TestFollowerFedFixture:
+    def test_replace_snapshot_with_fixture_source(self, drain_fixture):
+        """The follower-feed pattern: publishes swap snapshots WITHOUT a
+        fixture; drain lazily pulls one from the source instead of
+        failing forever (the pre-fix behavior)."""
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        snap = snapshot_from_fixture(drain_fixture, semantics="strict")
+        srv = CapacityServer(snap, port=0, fixture=drain_fixture)
+        srv.start()
+        try:
+            pulls = []
+
+            def source():
+                pulls.append(1)
+                return drain_fixture
+
+            srv.replace_snapshot(snap, fixture_source=source)
+            with CapacityClient(*srv.address) as c:
+                assert c.fit(cpuRequests="100m")["total"] >= 0
+                assert not pulls  # plain fits never materialize
+                r = c.drain("d0")
+                assert r["evictable"] and pulls == [1]
+                c.drain("d0")
+                assert pulls == [1]  # cached until the next publish
+            # Without a source (the old wiring), drain reports the
+            # limitation instead of crashing.
+            srv.replace_snapshot(snap)
+            with CapacityClient(*srv.address) as c:
+                with pytest.raises(Exception, match="fixture"):
+                    c.drain("d0")
+        finally:
+            srv.shutdown()
+
+
 class TestDrainWire:
     def test_drain_over_the_wire(self, drain_fixture):
         from kubernetesclustercapacity_tpu.service import (
