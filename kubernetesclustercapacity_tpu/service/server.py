@@ -733,6 +733,15 @@ class CapacityServer:
     def _op_reload(self, msg: dict, snap: ClusterSnapshot) -> dict:
         """``snap`` is the dispatch's lock-captured snapshot — reading
         ``self.snapshot`` here could tear against a concurrent reload."""
+        with self._lock:
+            if self._fixture_source is not None:
+                # Same rule as update: the next coalesced publish would
+                # silently clobber the reloaded state — and dropping
+                # _fixture_source here would re-open the update guard.
+                raise ValueError(
+                    "this server follows a live cluster (-follow); "
+                    "reload is only for file-backed servers"
+                )
         path = msg["path"]
         # An unspecified semantics keeps the CURRENTLY-SERVED packing (a
         # plain reload must not flip a strict server to reference and
@@ -785,6 +794,15 @@ class CapacityServer:
         if not isinstance(events, list):
             raise ValueError("update needs an 'events' list")
         with self._lock:
+            if self._fixture_source is not None:
+                # A follower feeds this server: an op-side store would be
+                # clobbered by the next coalesced publish, silently
+                # discarding the client's events.  The cluster itself is
+                # the write surface here.
+                raise ValueError(
+                    "this server follows a live cluster (-follow); "
+                    "update events must go to the cluster, not the server"
+                )
             if self._store is None:
                 if self.fixture is None:
                     raise ValueError(

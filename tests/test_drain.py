@@ -400,6 +400,14 @@ class TestFollowerFedFixture:
                 assert r["evictable"] and pulls == [1]
                 c.drain("d0")
                 assert pulls == [1]  # cached until the next publish
+            # A follower-fed server rejects op-side updates (the next
+            # publish would silently clobber them).
+            with CapacityClient(*srv.address) as c:
+                with pytest.raises(Exception, match="follows a live"):
+                    c.update([{"type": "DELETED", "kind": "Pod",
+                               "object": {"name": "x", "namespace": "d"}}])
+                with pytest.raises(Exception, match="follows a live"):
+                    c.reload("/tmp/nope.json")
             # Without a source (the old wiring), drain reports the
             # limitation instead of crashing.
             srv.replace_snapshot(snap)
