@@ -176,9 +176,20 @@ class CapacityServer:
         # watch-event batch.
         with self._lock:
             snap = self.snapshot
-            needs_fixture = op == "drain" or (  # drain always reads pods
-                op in ("fit", "place", "topology_spread", "plan")
-                and self._fit_consumes_fixture(msg, snap.semantics)
+            needs_fixture = (
+                op == "drain"  # always reads per-pod requests
+                # A sweep reads the fixture only on the priorities path
+                # (strict-only; no point rematerializing for a request
+                # the strict gate will reject anyway).
+                or (
+                    op == "sweep"
+                    and "priorities" in msg
+                    and snap.semantics == "strict"
+                )
+                or (
+                    op in ("fit", "place", "topology_spread", "plan")
+                    and self._fit_consumes_fixture(msg, snap.semantics)
+                )
             )
             if needs_fixture and self._fixture_dirty and self._store is not None:
                 # Store-fed staleness rematerializes under the same lock
@@ -205,6 +216,7 @@ class CapacityServer:
                     op == "drain"
                     or "anti_affinity_labels" in msg
                     or "priority" in msg
+                    or "priorities" in msg
                 )
             ):
                 source = self._fixture_source
@@ -227,7 +239,7 @@ class CapacityServer:
         if op == "fit":
             return self._op_fit(msg, snap, fixture, implicit_mask)
         if op == "sweep":
-            return self._op_sweep(msg, snap, implicit_mask)
+            return self._op_sweep(msg, snap, implicit_mask, fixture)
         if op == "sweep_multi":
             return self._op_sweep_multi(msg, snap, implicit_mask)
         if op == "place":
@@ -356,7 +368,10 @@ class CapacityServer:
             # Preemption builds its priority table from raw pod objects
             # (priorities are not in the arrays); _priority_table_for
             # caches it across dispatches by fixture/snapshot identity.
+            # "priority" is the fit/place threshold, "priorities" the
+            # sweep's [S] vector.
             or "priority" in msg
+            or "priorities" in msg
         )
 
     def _op_fit(
@@ -608,7 +623,11 @@ class CapacityServer:
         }
 
     def _op_sweep(
-        self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
+        self,
+        msg: dict,
+        snap: ClusterSnapshot,
+        implicit_mask=None,
+        fixture: dict | None = None,
     ) -> dict:
         from kubernetesclustercapacity_tpu.ops.pallas_fit import (
             sweep_snapshot_auto,
@@ -623,6 +642,10 @@ class CapacityServer:
                 cpu_request_milli=np.asarray(msg["cpu_request_milli"]),
                 mem_request_bytes=np.asarray(msg["mem_request_bytes"]),
                 replicas=np.asarray(msg.get("replicas", [1])),
+            )
+        if "priorities" in msg:
+            return self._sweep_with_priorities(
+                msg, snap, grid, implicit_mask, fixture
             )
         # The same implicit taint mask the fit op applies: a strict sweep
         # over a tainted snapshot must not report higher totals than fit
@@ -650,6 +673,57 @@ class CapacityServer:
                 if fast_path_error()
                 else {}
             ),
+        }
+
+    def _sweep_with_priorities(
+        self, msg, snap, grid, implicit_mask, fixture: dict | None
+    ) -> dict:
+        """The preemption axis over the wire: scenario ``s`` evicts pods
+        below ``priorities[s]`` (:func:`..ops.preemption.sweep_preemption`
+        — searchsorted + column gather under vmap)."""
+        from kubernetesclustercapacity_tpu.ops.preemption import (
+            sweep_preemption,
+        )
+
+        if snap.semantics != "strict":
+            raise ValueError(
+                "priorities require strict semantics (the reference has "
+                "no priority concept)"
+            )
+        if fixture is None:
+            raise ValueError(
+                "priorities need a fixture-backed source (pod priorities "
+                "are not part of the dense snapshot)"
+            )
+        priorities = np.asarray(msg["priorities"], dtype=np.int64)
+        if priorities.shape != (grid.size,):
+            raise ValueError(
+                f"priorities: expected shape ({grid.size},), got "
+                f"{priorities.shape}"
+            )
+        grid.validate()
+        t = self._priority_table_for(fixture, snap)
+        totals, sched = sweep_preemption(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.healthy,
+            t.levels,
+            t.used_cpu_ge,
+            t.used_mem_ge,
+            t.pods_ge,
+            grid.cpu_request_milli,
+            grid.mem_request_bytes,
+            priorities,
+            grid.replicas,
+            mode="strict",
+            node_mask=implicit_mask,
+        )
+        return {
+            "totals": np.asarray(totals).tolist(),
+            "schedulable": np.asarray(sched).tolist(),
+            "scenarios": grid.size,
+            "kernel": "exact-preemption",
         }
 
     def _op_sweep_multi(

@@ -376,6 +376,35 @@ class TestServiceWire:
         finally:
             srv.shutdown()
 
+    def test_sweep_priorities_over_the_wire(self):
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        fx = _prioritized_fixture(8, seed=13)
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        srv = CapacityServer(snap, port=0, fixture=fx)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                cpu, mem = [250, 250, 250], [96 * MIB] * 3
+                pr = [-(2**40), 0, 2**40]
+                r = c.sweep(cpu_request_milli=cpu, mem_request_bytes=mem,
+                            replicas=[1, 1, 1], priorities=pr)
+                assert r["kernel"] == "exact-preemption"
+                # Each scenario must equal the fit op's threshold answer.
+                for total, p in zip(r["totals"], pr):
+                    fit = c.fit(cpuRequests="250m", memRequests="96mb",
+                                priority=p)
+                    assert total == fit["total"]
+                assert r["totals"][0] <= r["totals"][1] <= r["totals"][2]
+                with pytest.raises(Exception, match="expected shape"):
+                    c.sweep(cpu_request_milli=cpu, mem_request_bytes=mem,
+                            replicas=[1, 1, 1], priorities=[0])
+        finally:
+            srv.shutdown()
+
     def test_server_table_cache_identity(self):
         from kubernetesclustercapacity_tpu.service import CapacityServer
 

@@ -710,9 +710,9 @@ class TestGuardrails:
         release = threading.Event()
         orig = srv._op_sweep
 
-        def slow_sweep(msg, snap, implicit_mask=None):
+        def slow_sweep(msg, snap, implicit_mask=None, fixture=None):
             release.wait(5)
-            return orig(msg, snap, implicit_mask)
+            return orig(msg, snap, implicit_mask, fixture)
 
         srv._op_sweep = slow_sweep
         errs: list = []
