@@ -18,7 +18,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))  # noqa: 
 
 import kubernetesclustercapacity_tpu as kcc
 from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
-from kubernetesclustercapacity_tpu.models import CapacityModel
+from kubernetesclustercapacity_tpu.models import CapacityModel, PodSpec
 from kubernetesclustercapacity_tpu.ops.pallas_fit import sweep_snapshot_auto
 
 
@@ -50,6 +50,37 @@ def main() -> None:
     gpu_rows = mgrid.requests[:, list(mgrid.resources).index("nvidia.com/gpu")]
     print(f"\nGPU-requesting scenarios: {(gpu_rows > 0).sum()} / 256")
     print(f"p50 headroom with GPU constraint: {int(np.median(mtotals))}")
+
+    # Capacity planning over the same scenario axis: how many nodes of a
+    # given shape must be ADDED per scenario (0 = fits already, -1 = the
+    # shape can never help)?
+    template = {"allocatable": {"cpu": "16", "memory": "67108864Ki",
+                                "pods": "110"}}
+    demand = kcc.ScenarioGrid(
+        cpu_request_milli=base.cpu_request_milli,
+        mem_request_bytes=base.mem_request_bytes,
+        replicas=base.replicas + 500_000,  # demand beyond today's cluster
+    )
+    needed = model.nodes_needed_grid(demand, template)
+    growth = needed[needed > 0]
+    print(f"\nscale-up plan over 256 scenarios vs a 16-core template: "
+          f"{int((needed == 0).sum())} fit already; the rest need "
+          f"p50 {int(np.median(growth)) if growth.size else 0} more nodes")
+
+    # And the zone axis: capacity under a maxSkew spread constraint.
+    zoned = synthetic_fixture(120, seed=11)
+    for i, node in enumerate(zoned["nodes"]):
+        node.setdefault("labels", {})["zone"] = f"z{i % 3}"
+    zmodel = CapacityModel(
+        kcc.snapshot_from_fixture(zoned, semantics="strict"), mode="strict"
+    )
+    spread = zmodel.topology_spread(
+        PodSpec(cpu_request_milli=500, mem_request_bytes=512 << 20,
+                replicas=100),
+        topology_key="zone", max_skew=5,
+    )
+    print(f"zone capacities {spread.zones} -> allowed {spread.allowed} "
+          f"(total {spread.total} under maxSkew=5)")
 
 
 if __name__ == "__main__":

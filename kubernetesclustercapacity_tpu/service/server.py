@@ -368,10 +368,7 @@ class CapacityServer:
             # Preemption builds its priority table from raw pod objects
             # (priorities are not in the arrays); _priority_table_for
             # caches it across dispatches by fixture/snapshot identity.
-            # "priority" is the fit/place threshold, "priorities" the
-            # sweep's [S] vector.
             or "priority" in msg
-            or "priorities" in msg
         )
 
     def _op_fit(
@@ -679,11 +676,12 @@ class CapacityServer:
         self, msg, snap, grid, implicit_mask, fixture: dict | None
     ) -> dict:
         """The preemption axis over the wire: scenario ``s`` evicts pods
-        below ``priorities[s]`` (:func:`..ops.preemption.sweep_preemption`
-        — searchsorted + column gather under vmap)."""
-        from kubernetesclustercapacity_tpu.ops.preemption import (
-            sweep_preemption,
-        )
+        below ``priorities[s]`` — delegated to
+        :meth:`CapacityModel.sweep_preemption` with the server's cached
+        table seeded, so the gate/shape/mask rules live in ONE place
+        (the model's bare-spec taint mask equals the implicit mask the
+        plain sweep applies)."""
+        from kubernetesclustercapacity_tpu.models import CapacityModel
 
         if snap.semantics != "strict":
             raise ValueError(
@@ -695,33 +693,14 @@ class CapacityServer:
                 "priorities need a fixture-backed source (pod priorities "
                 "are not part of the dense snapshot)"
             )
-        priorities = np.asarray(msg["priorities"], dtype=np.int64)
-        if priorities.shape != (grid.size,):
-            raise ValueError(
-                f"priorities: expected shape ({grid.size},), got "
-                f"{priorities.shape}"
-            )
-        grid.validate()
-        t = self._priority_table_for(fixture, snap)
-        totals, sched = sweep_preemption(
-            snap.alloc_cpu_milli,
-            snap.alloc_mem_bytes,
-            snap.alloc_pods,
-            snap.healthy,
-            t.levels,
-            t.used_cpu_ge,
-            t.used_mem_ge,
-            t.pods_ge,
-            grid.cpu_request_milli,
-            grid.mem_request_bytes,
-            priorities,
-            grid.replicas,
-            mode="strict",
-            node_mask=implicit_mask,
+        model = CapacityModel(
+            snap, mode="strict", fixture=fixture,
+            priority_table=self._priority_table_for(fixture, snap),
         )
+        totals, sched = model.sweep_preemption(grid, msg["priorities"])
         return {
-            "totals": np.asarray(totals).tolist(),
-            "schedulable": np.asarray(sched).tolist(),
+            "totals": totals.tolist(),
+            "schedulable": sched.tolist(),
             "scenarios": grid.size,
             "kernel": "exact-preemption",
         }
