@@ -827,7 +827,6 @@ class CapacityModel:
                 f"node_taints_policy must be 'ignore' or 'honor', got "
                 f"{node_taints_policy!r}"
             )
-        snap = self.snapshot
         taint_mask, affinity_mask, anti_mask = self._mask_parts(spec)
         full_mask = _masks.combine_masks(taint_mask, affinity_mask, anti_mask)
         fits = self.evaluate(spec, _node_mask=full_mask).fits
@@ -836,19 +835,12 @@ class CapacityModel:
             if node_taints_policy == "ignore"
             else _masks.combine_masks(taint_mask, affinity_mask)
         )
-        zones: dict[str, int] = {}
-        unkeyed = 0
-        for i in range(snap.n_nodes):
-            if not snap.healthy[i] or (
-                domain_mask is not None and not domain_mask[i]
-            ):
-                continue
-            labels = snap.labels[i] if i < len(snap.labels) else {}
-            zone = labels.get(topology_key)
-            if zone is None:
-                unkeyed += 1
-                continue
-            zones[zone] = zones.get(zone, 0) + int(fits[i])
+        zone_ids, member, unkeyed = self._zone_membership(
+            topology_key, domain_mask
+        )
+        zones = dict.fromkeys(zone_ids, 0)
+        for zone, idx in zone_ids.items():
+            zones[zone] = int(fits[member == idx + 1].sum())
         if not zones:
             allowed: dict[str, int] = {}
             total = 0
@@ -867,6 +859,111 @@ class CapacityModel:
             replicas_requested=spec.replicas,
             unkeyed_nodes=unkeyed,
         )
+
+    def _zone_membership(
+        self, topology_key: str, domain_mask
+    ) -> tuple[dict[str, int], np.ndarray, int]:
+        """THE topology-domain membership rule, shared by the scalar and
+        grid paths (they must never disagree): a node belongs to a domain
+        iff it is healthy, domain-mask-eligible, and carries the key.
+        Returns ``(zone→index, member[N] = index+1 or 0, unkeyed_count)``
+        — ``unkeyed`` counts eligible nodes missing the key."""
+        snap = self.snapshot
+        zone_ids: dict[str, int] = {}
+        member = np.zeros(snap.n_nodes, dtype=np.int64)
+        unkeyed = 0
+        for i in range(snap.n_nodes):
+            if not snap.healthy[i] or (
+                domain_mask is not None and not domain_mask[i]
+            ):
+                continue
+            labels = snap.labels[i] if i < len(snap.labels) else {}
+            zone = labels.get(topology_key)
+            if zone is None:
+                unkeyed += 1
+                continue
+            member[i] = zone_ids.setdefault(zone, len(zone_ids)) + 1
+        return zone_ids, member, unkeyed
+
+    def topology_spread_grid(
+        self,
+        grid: ScenarioGrid,
+        *,
+        topology_key: str,
+        max_skew: int = 1,
+        node_taints_policy: str = "ignore",
+        tolerations: tuple = (),
+        node_selector: dict | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized :meth:`topology_spread` over a scenario grid.
+
+        One per-node sweep gives ``fits[S, N]``; zone aggregation is a
+        ``[S, N] @ [N, Z]`` one-hot matmul (the MXU-shaped form of the
+        group-by), then the skew clamp is elementwise row math.  Shared
+        constraints compose like :meth:`sweep`.  Returns
+        ``(totals[S], schedulable[S])``.
+        """
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
+
+        if self.mode != "strict":
+            raise ValueError(
+                "topology spread requires strict semantics (the reference "
+                "has no constraint concept)"
+            )
+        if max_skew < 1:
+            raise ValueError("max_skew must be >= 1")
+        if node_taints_policy not in ("ignore", "honor"):
+            raise ValueError(
+                f"node_taints_policy must be 'ignore' or 'honor', got "
+                f"{node_taints_policy!r}"
+            )
+        grid.validate()
+        snap = self.snapshot
+        shared_spec = PodSpec(
+            cpu_request_milli=1,
+            mem_request_bytes=1,
+            tolerations=tolerations,
+            node_selector=node_selector or {},
+        )
+        self._check_extensions(shared_spec.constrained)
+        taint_mask, affinity_mask, _ = self._mask_parts(shared_spec)
+        full_mask = _masks.combine_masks(taint_mask, affinity_mask)
+        domain_mask = (
+            affinity_mask
+            if node_taints_policy == "ignore"
+            else full_mask
+        )
+        zone_ids, member, _ = self._zone_membership(topology_key, domain_mask)
+        n_zones = len(zone_ids)
+        s = grid.size
+        if n_zones == 0:
+            return (
+                np.zeros(s, dtype=np.int64),
+                grid.replicas.astype(np.int64) <= 0,
+            )
+        _, _, fits = sweep_grid(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.used_cpu_req_milli,
+            snap.used_mem_req_bytes,
+            snap.pods_count,
+            snap.healthy,
+            grid.cpu_request_milli,
+            grid.mem_request_bytes,
+            grid.replicas,
+            mode="strict",
+            node_mask=full_mask,
+            return_per_node=True,
+        )
+        onehot = np.zeros((snap.n_nodes, n_zones), dtype=np.int64)
+        keyed = member > 0
+        onehot[np.arange(snap.n_nodes)[keyed], member[keyed] - 1] = 1
+        c = np.asarray(fits, dtype=np.int64) @ onehot  # [S, Z]
+        floor = c.min(axis=1)
+        allowed = np.minimum(c, (floor + max_skew)[:, None])
+        totals = allowed.sum(axis=1)
+        return totals, totals >= grid.replicas.astype(np.int64)
 
     def _template_model(self, node_template: dict) -> "CapacityModel":
         """A one-node model over an EMPTY template node — the
