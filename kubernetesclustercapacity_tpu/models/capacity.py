@@ -838,9 +838,12 @@ class CapacityModel:
         zone_ids, member, unkeyed = self._zone_membership(
             topology_key, domain_mask
         )
-        zones = dict.fromkeys(zone_ids, 0)
-        for zone, idx in zone_ids.items():
-            zones[zone] = int(fits[member == idx + 1].sum())
+        # One int64 scatter-add pass (bincount's float64 weights could
+        # lose exactness on adversarial fit magnitudes); slot 0 absorbs
+        # non-members.
+        sums = np.zeros(len(zone_ids) + 1, dtype=np.int64)
+        np.add.at(sums, member, np.asarray(fits, dtype=np.int64))
+        zones = {z: int(sums[i + 1]) for z, i in zone_ids.items()}
         if not zones:
             allowed: dict[str, int] = {}
             total = 0

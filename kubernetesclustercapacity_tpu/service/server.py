@@ -568,12 +568,49 @@ class CapacityServer:
         self, msg: dict, snap: ClusterSnapshot, fixture: dict | None
     ) -> dict:
         """Capacity under a PodTopologySpread maxSkew constraint —
-        :meth:`CapacityModel.topology_spread` over the wire."""
+        :meth:`CapacityModel.topology_spread` over the wire; a message
+        carrying scenario ARRAYS instead of the six flags rides the
+        vectorized grid path (``topology_spread_grid``)."""
         key = msg.get("topology_key")
         if not isinstance(key, str) or not key:
             raise ValueError(
                 "topology_spread wants a non-empty topology_key string"
             )
+        if "cpu_request_milli" in msg:
+            from kubernetesclustercapacity_tpu.models import CapacityModel
+
+            try:
+                grid = ScenarioGrid(
+                    cpu_request_milli=np.asarray(msg["cpu_request_milli"]),
+                    mem_request_bytes=np.asarray(msg["mem_request_bytes"]),
+                    replicas=np.asarray(msg.get("replicas", [1])),
+                )
+                model = CapacityModel(
+                    snap, mode=snap.semantics, fixture=fixture
+                )
+                totals, sched = model.topology_spread_grid(
+                    grid,
+                    topology_key=key,
+                    max_skew=int(msg.get("max_skew", 1)),
+                    node_taints_policy=msg.get(
+                        "node_taints_policy", "ignore"
+                    ),
+                    # The shared constraints the scalar branch honors via
+                    # the spec must not silently drop on the grid form.
+                    tolerations=tuple(msg.get("tolerations") or ()),
+                    node_selector=dict(msg.get("node_selector") or {}),
+                )
+            except (ScenarioError, KeyError, TypeError, ValueError) as e:
+                raise ValueError(
+                    f"bad topology_spread request: {e}"
+                ) from e
+            return {
+                "topology_key": key,
+                "max_skew": int(msg.get("max_skew", 1)),
+                "totals": totals.tolist(),
+                "schedulable": sched.tolist(),
+                "scenarios": grid.size,
+            }
         scenario = self._scenario_from_msg(msg)
         spec = self._spec_from_msg(msg, scenario)
         try:

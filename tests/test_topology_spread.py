@@ -188,6 +188,28 @@ class TestTopologySpread:
                 assert unsat["nodes_needed"] is None
                 with pytest.raises(Exception, match="topology_key"):
                     c.topology_spread("")
+                # Grid form: scenario arrays ride the vectorized path.
+                g = c.topology_spread(
+                    "zone",
+                    cpu_request_milli=[1000, 2000],
+                    mem_request_bytes=[GIB, GIB],
+                    replicas=[3, 3],
+                    max_skew=1,
+                )
+                assert g["scenarios"] == 2
+                assert g["totals"][0] == r["total"]  # same question, same answer
+                assert g["totals"][1] <= g["totals"][0]
+                # Shared constraints bind the grid form like the scalar:
+                # selecting zone a removes b from the skew minimum.
+                sel = c.topology_spread(
+                    "zone",
+                    cpu_request_milli=[1000],
+                    mem_request_bytes=[GIB],
+                    replicas=[8],
+                    max_skew=1,
+                    node_selector={"zone": "a"},
+                )
+                assert sel["totals"] == [8] and sel["schedulable"] == [True]
         finally:
             srv.shutdown()
 
