@@ -529,6 +529,9 @@ class CapacityModel:
         *,
         policy: str = "first-fit",
         assignments: bool | str = "auto",
+        topology_key: str | None = None,
+        max_skew: int = 1,
+        node_taints_policy: str = "ignore",
     ) -> PlacementResult:
         """Simulate WHERE each replica lands under a bin-packing policy.
 
@@ -564,6 +567,14 @@ class CapacityModel:
         headroom (lower-priority pods treated as already evicted) — the
         "where would they land after preemption" upper bound; which
         specific victims a real scheduler would pick is out of scope.
+
+        ``topology_key`` adds the PodTopologySpread DoNotSchedule gate:
+        every placement is checked against ``max_skew`` over the key's
+        domains (the same arithmetic kube-scheduler runs per pod), with
+        domain discovery per :meth:`topology_spread`'s node-inclusion
+        policies.  The skew couples placements globally, so only the
+        scan engine applies (closed-form ``assignments`` modes raise);
+        strict semantics, 2-resource specs.
         """
         from kubernetesclustercapacity_tpu.ops.placement import (
             place_replicas,
@@ -578,6 +589,22 @@ class CapacityModel:
             spec.constrained or bool(spec.extended_requests)
         )
         self._check_preemption(spec)
+        if topology_key is not None:
+            return self._place_spread(
+                spec,
+                policy=policy,
+                assignments=assignments,
+                topology_key=topology_key,
+                max_skew=max_skew,
+                node_taints_policy=node_taints_policy,
+            )
+        if max_skew != 1 or node_taints_policy != "ignore":
+            # A caller who set the skew knobs but forgot the key would
+            # otherwise run a completely unconstrained placement.
+            raise ValueError(
+                "max_skew/node_taints_policy need topology_key — without "
+                "it the placement has no spread constraint"
+            )
         snap = self.snapshot
         mask = self._masks_for(spec)
         kwargs = dict(
@@ -658,6 +685,100 @@ class CapacityModel:
             policy=policy,
             requested=spec.replicas,
             engine=engine,
+        )
+
+    def _place_spread(
+        self,
+        spec: PodSpec,
+        *,
+        policy: str,
+        assignments,
+        topology_key: str,
+        max_skew: int,
+        node_taints_policy: str,
+    ) -> PlacementResult:
+        """Placement under the per-step maxSkew gate — scan engine only
+        (the moving skew minimum couples every placement)."""
+        from kubernetesclustercapacity_tpu.ops.placement import (
+            place_replicas_spread,
+        )
+
+        if self.mode != "strict":
+            raise ValueError(
+                "topology spread requires strict semantics (the reference "
+                "has no constraint concept)"
+            )
+        if node_taints_policy not in ("ignore", "honor"):
+            raise ValueError(
+                f"node_taints_policy must be 'ignore' or 'honor', got "
+                f"{node_taints_policy!r}"
+            )
+        if spec.extended_requests:
+            raise ValueError(
+                "topology-spread placement covers cpu/memory specs "
+                "(extended resources: place without the constraint, or "
+                "evaluate capacity via topology_spread)"
+            )
+        if assignments in ("trace", False):
+            raise ValueError(
+                "the skew gate couples placements — closed-form engines "
+                "cannot apply; use assignments=True/'auto' (scan)"
+            )
+        # Argument validation must not depend on cluster contents (the
+        # zero-domain early return below never reaches the kernel's own
+        # checks).
+        from kubernetesclustercapacity_tpu.ops.placement import POLICIES
+
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r} (want one of {POLICIES})"
+            )
+        if max_skew < 1:
+            raise ValueError("max_skew must be >= 1")
+        snap = self.snapshot
+        taint_mask, affinity_mask, anti_mask = self._mask_parts(spec)
+        full_mask = _masks.combine_masks(taint_mask, affinity_mask, anti_mask)
+        domain_mask = (
+            affinity_mask
+            if node_taints_policy == "ignore"
+            else _masks.combine_masks(taint_mask, affinity_mask)
+        )
+        zone_ids, member, _ = self._zone_membership(topology_key, domain_mask)
+        used_cpu, used_mem, pods_count = self._usage_arrays(spec)
+        if not zone_ids:
+            return PlacementResult(
+                assignments=np.full(spec.replicas, -1, dtype=np.int64),
+                per_node=np.zeros(snap.n_nodes, dtype=np.int64),
+                node_names=list(snap.names),
+                policy=policy,
+                requested=spec.replicas,
+                engine="scan",
+            )
+        order, per_node, _ = place_replicas_spread(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            used_cpu,
+            used_mem,
+            pods_count,
+            snap.healthy,
+            spec.cpu_request_milli,
+            spec.mem_request_bytes,
+            member - 1,  # zone index, -1 = no domain
+            n_replicas=spec.replicas,
+            n_zones=len(zone_ids),
+            policy=policy,
+            max_skew=max_skew,
+            node_mask=full_mask,
+            max_per_node=spec.spread,
+        )
+        return PlacementResult(
+            assignments=np.asarray(order),
+            per_node=np.asarray(per_node),
+            node_names=list(snap.names),
+            policy=policy,
+            requested=spec.replicas,
+            engine="scan",
         )
 
     def drain(

@@ -42,6 +42,7 @@ __all__ = [
     "place_pods_python",
     "place_pods_multi",
     "place_pods_multi_python",
+    "place_replicas_spread",
     "place_replicas_multi",
     "place_replicas_bulk_multi",
     "place_replicas_trace_multi",
@@ -572,6 +573,120 @@ def place_replicas_python(
         counts[best] += 1
         assignments.append(best)
     return assignments, counts
+
+
+# --- Placement under a topology spread constraint.
+#
+# The PodTopologySpread DoNotSchedule predicate, checked the way
+# kube-scheduler checks it: at EVERY placement, the candidate zone's
+# count after placing may exceed the global minimum by at most maxSkew.
+# The minimum moves as zones fill, so feasibility changes globally each
+# step — like place_pods, the scan re-derives it fully (the
+# incremental-score carry of place_replicas cannot apply).  For
+# identical replicas this greedy provably lands exactly the closed form
+# sum(min(c_z, min_z c_z + maxSkew)) the capacity method reports
+# (tested): at termination the minimum-count zone must be
+# resource-capped (a skew block at the minimum needs maxSkew < 1), so
+# the terminal counts are min(c_z, min_z c_z + maxSkew) per zone.
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_replicas", "policy", "max_skew", "n_zones", "max_per_node",
+    ),
+)
+def place_replicas_spread(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_req,
+    mem_req,
+    zone_of,
+    *,
+    n_replicas: int,
+    n_zones: int,
+    policy: str = "first-fit",
+    max_skew: int = 1,
+    node_mask=None,
+    max_per_node: int | None = None,
+):
+    """Greedy placement with the per-step maxSkew gate.
+
+    ``zone_of`` is ``[N]`` int: the node's topology-domain index in
+    ``[0, n_zones)``, or ``-1`` for nodes outside every domain (missing
+    the key, or domain-ineligible) — those are infeasible, the
+    DoNotSchedule rule.  ``max_per_node`` composes the hostname-level
+    spread cap on top of the zone constraint (two simultaneous
+    topology constraints, as real pod specs carry).  Returns
+    ``(assignments[R], per_node[N], per_zone[n_zones])``.
+    """
+    if policy not in POLICIES:
+        raise ValueError(f"unknown policy {policy!r} (want one of {POLICIES})")
+    if n_replicas < 0:
+        raise ValueError("n_replicas must be >= 0")
+    if n_zones < 1:
+        raise ValueError("n_zones must be >= 1 (no domains = nothing places)")
+    if max_skew < 1:
+        raise ValueError("max_skew must be >= 1")
+    alloc_cpu = jnp.asarray(alloc_cpu, jnp.int64)
+    alloc_mem = jnp.asarray(alloc_mem, jnp.int64)
+    c = jnp.asarray(cpu_req, jnp.int64)
+    m = jnp.asarray(mem_req, jnp.int64)
+    zone_of = jnp.asarray(zone_of, jnp.int64)
+    eligible = jnp.asarray(healthy, jnp.bool_) & (zone_of >= 0)
+    if node_mask is not None:
+        eligible = eligible & jnp.asarray(node_mask, jnp.bool_)
+
+    hc0 = alloc_cpu - jnp.asarray(used_cpu, jnp.int64)
+    hm0 = alloc_mem - jnp.asarray(used_mem, jnp.int64)
+    slots0 = jnp.maximum(
+        jnp.asarray(alloc_pods, jnp.int64) - jnp.asarray(pods_count, jnp.int64),
+        0,
+    )
+    n = hc0.shape[0]
+    idx_f64 = jnp.arange(n).astype(jnp.float64)
+    zone_gather = jnp.where(zone_of >= 0, zone_of, 0)  # safe index
+
+    def body(state, _):
+        hc, hm, slots, counts, mine = state
+        zone_ok = (
+            counts[zone_gather] + 1 - jnp.min(counts)
+        ) <= jnp.int64(max_skew)
+        feasible = (
+            (hc >= c) & (hm >= m) & (slots >= 1) & eligible & zone_ok
+        )
+        if max_per_node is not None:
+            feasible = feasible & (mine < max_per_node)
+        if policy == "first-fit":
+            score = idx_f64
+        else:
+            after = _normalized_headroom(hc - c, hm - m, alloc_cpu, alloc_mem)
+            score = after if policy == "best-fit" else -after
+        masked = jnp.where(feasible, score, jnp.inf)
+        idx = jnp.argmin(masked)
+        ok = jnp.isfinite(masked[idx])
+        one = jnp.where(ok, jnp.int64(1), jnp.int64(0))
+        hc = hc.at[idx].add(-jnp.where(ok, c, jnp.int64(0)))
+        hm = hm.at[idx].add(-jnp.where(ok, m, jnp.int64(0)))
+        slots = slots.at[idx].add(-one)
+        counts = counts.at[zone_gather[idx]].add(one)
+        mine = mine.at[idx].add(one)
+        assignment = jnp.where(ok, idx.astype(jnp.int64), jnp.int64(-1))
+        return (hc, hm, slots, counts, mine), assignment
+
+    counts0 = jnp.zeros(n_zones, dtype=jnp.int64)
+    mine0 = jnp.zeros(n, dtype=jnp.int64)
+    # The final `mine` carry IS the per-node count (it increments at the
+    # chosen node on every successful step) — no R×N re-derivation.
+    (_, _, _, per_zone, per_node), assignments = jax.lax.scan(
+        body, (hc0, hm0, slots0, counts0, mine0), None, length=n_replicas
+    )
+    return assignments, per_node, per_zone
 
 
 # --- Heterogeneous-pod placement (drain / rehoming simulation).

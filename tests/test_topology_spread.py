@@ -268,6 +268,85 @@ class TestTopologySpread:
         totals, sched = model.topology_spread_grid(grid, topology_key="zone")
         assert totals.tolist() == [0] and sched.tolist() == [True]
 
+    @pytest.mark.parametrize("policy", ["first-fit", "best-fit", "spread"])
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_place_spread_achieves_closed_form(self, policy, seed):
+        """For identical replicas, greedy placement under the per-step
+        skew gate lands EXACTLY the capacity method's closed-form total
+        (the terminal minimum-count zone must be resource-capped)."""
+        import copy
+
+        import numpy as np
+
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+
+        fx = copy.deepcopy(synthetic_fixture(25, seed=seed))
+        for i, node in enumerate(fx["nodes"]):
+            if i % 6 != 0:
+                node.setdefault("labels", {})["zone"] = f"z{i % 3}"
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        model = CapacityModel(snap, mode="strict", fixture=fx)
+        spec = PodSpec(cpu_request_milli=700, mem_request_bytes=256 * MIB,
+                       replicas=500)  # demand beyond any skew-capped total
+        cap = model.topology_spread(spec, topology_key="zone", max_skew=2)
+        placed = model.place(spec, policy=policy, topology_key="zone",
+                             max_skew=2)
+        assert placed.placed == cap.total
+        # per-zone landing counts equal the closed-form allowed counts
+        landed: dict = {}
+        for i, count in enumerate(placed.per_node):
+            zone = snap.labels[i].get("zone")
+            if count:
+                landed[zone] = landed.get(zone, 0) + int(count)
+        assert landed == {z: a for z, a in cap.allowed.items() if a}
+        # and per-placement skew never exceeded the bound
+        counts: dict = {}
+        for node_idx in placed.assignments:
+            if node_idx < 0:
+                continue
+            zone = snap.labels[int(node_idx)].get("zone")
+            counts[zone] = counts.get(zone, 0) + 1
+            skew = max(counts.get(f"z{k}", 0) for k in range(3)) - min(
+                counts.get(f"z{k}", 0) for k in range(3)
+            )
+            assert skew <= 2
+
+    def test_place_spread_composes_with_per_node_cap(self):
+        model = _model([_node("n0", "a", cpu="8"), _node("n1", "a", cpu="8"),
+                        _node("n2", "b", cpu="8")])
+        spec = PodSpec(cpu_request_milli=1000, mem_request_bytes=1 * GIB,
+                       replicas=20, spread=2)
+        placed = model.place(spec, topology_key="zone", max_skew=1)
+        assert placed.per_node.max() <= 2
+        # zone a: ≤4 (two capped nodes), zone b: ≤2 → skew binds at b+1=3
+        assert placed.placed == 5  # a: 3, b: 2 (skew ≤ 1)
+
+    def test_place_spread_guards(self):
+        model = _model([_node("n0", "a")])
+        spec = PodSpec(cpu_request_milli=100, mem_request_bytes=MIB,
+                       replicas=2)
+        with pytest.raises(ValueError, match="closed-form"):
+            model.place(spec, topology_key="zone", assignments="trace")
+        with pytest.raises(ValueError, match="cpu/memory"):
+            model.place(
+                PodSpec(cpu_request_milli=100, mem_request_bytes=MIB,
+                        replicas=2, extended_requests={"g": 1}),
+                topology_key="zone",
+            )
+        # no domains → nothing places
+        nomodel = _model([_node("n0", zone=None)])
+        r = nomodel.place(spec, topology_key="zone")
+        assert r.placed == 0 and list(r.assignments) == [-1, -1]
+        # bad arguments raise regardless of cluster contents
+        for bad_model in (model, nomodel):
+            with pytest.raises(ValueError, match="max_skew"):
+                bad_model.place(spec, topology_key="zone", max_skew=0)
+            with pytest.raises(ValueError, match="unknown policy"):
+                bad_model.place(spec, topology_key="zone", policy="tetris")
+        # skew knobs without the key must not silently no-op
+        with pytest.raises(ValueError, match="topology_key"):
+            model.place(spec, max_skew=2)
+
     def test_large_skew_equals_plain_capacity(self):
         model = _model([_node("n0", "a", cpu="8"), _node("n1", "b", cpu="2")])
         r = model.topology_spread(SPEC, topology_key="zone", max_skew=100)
