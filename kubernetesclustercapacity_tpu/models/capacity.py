@@ -365,6 +365,38 @@ class CapacityModel:
         """
         return _masks.combine_masks(*self._mask_parts(spec))
 
+    def _require_strict(self, feature: str) -> None:
+        """One wording for every strict-only surface's gate."""
+        if self.mode != "strict":
+            raise ValueError(
+                f"{feature} requires strict semantics (the reference "
+                "cannot express it)"
+            )
+
+    @staticmethod
+    def _check_spread_args(max_skew: int, node_taints_policy: str) -> None:
+        if max_skew < 1:
+            raise ValueError("max_skew must be >= 1")
+        if node_taints_policy not in ("ignore", "honor"):
+            raise ValueError(
+                f"node_taints_policy must be 'ignore' or 'honor', got "
+                f"{node_taints_policy!r}"
+            )
+
+    def _spread_masks(self, spec: PodSpec, node_taints_policy: str):
+        """``(full_mask, domain_mask)`` for the topology-spread family:
+        fits always see every family; domain discovery honors the
+        node-affinity family, taints by policy, and never inter-pod
+        anti-affinity (a separate predicate)."""
+        taint_mask, affinity_mask, anti_mask = self._mask_parts(spec)
+        full = _masks.combine_masks(taint_mask, affinity_mask, anti_mask)
+        domain = (
+            affinity_mask
+            if node_taints_policy == "ignore"
+            else _masks.combine_masks(taint_mask, affinity_mask)
+        )
+        return full, domain
+
     def _check_extensions(self, constrained: bool) -> None:
         if (
             constrained
@@ -395,11 +427,7 @@ class CapacityModel:
     def _check_preemption(self, spec: PodSpec) -> None:
         if spec.priority is None:
             return
-        if self.mode != "strict":
-            raise ValueError(
-                "preemption-aware capacity (PodSpec.priority) requires "
-                "strict semantics — the reference has no priority concept"
-            )
+        self._require_strict("preemption-aware capacity (PodSpec.priority)")
         if self.fixture is None:
             raise ValueError(
                 "preemption needs the source fixture (pod priorities are "
@@ -703,16 +731,8 @@ class CapacityModel:
             place_replicas_spread,
         )
 
-        if self.mode != "strict":
-            raise ValueError(
-                "topology spread requires strict semantics (the reference "
-                "has no constraint concept)"
-            )
-        if node_taints_policy not in ("ignore", "honor"):
-            raise ValueError(
-                f"node_taints_policy must be 'ignore' or 'honor', got "
-                f"{node_taints_policy!r}"
-            )
+        self._require_strict("topology spread")
+        self._check_spread_args(max_skew, node_taints_policy)
         if spec.extended_requests:
             raise ValueError(
                 "topology-spread placement covers cpu/memory specs "
@@ -733,16 +753,8 @@ class CapacityModel:
             raise ValueError(
                 f"unknown policy {policy!r} (want one of {POLICIES})"
             )
-        if max_skew < 1:
-            raise ValueError("max_skew must be >= 1")
         snap = self.snapshot
-        taint_mask, affinity_mask, anti_mask = self._mask_parts(spec)
-        full_mask = _masks.combine_masks(taint_mask, affinity_mask, anti_mask)
-        domain_mask = (
-            affinity_mask
-            if node_taints_policy == "ignore"
-            else _masks.combine_masks(taint_mask, affinity_mask)
-        )
+        full_mask, domain_mask = self._spread_masks(spec, node_taints_policy)
         zone_ids, member, _ = self._zone_membership(topology_key, domain_mask)
         used_cpu, used_mem, pods_count = self._usage_arrays(spec)
         if not zone_ids:
@@ -816,11 +828,7 @@ class CapacityModel:
             _effective_pod_resources,
         )
 
-        if self.mode != "strict":
-            raise ValueError(
-                "drain simulation requires strict semantics (reference "
-                "semantics has no eviction concept)"
-            )
+        self._require_strict("drain simulation")
         if self.fixture is None:
             raise ValueError(
                 "drain needs the source fixture (per-pod requests are not "
@@ -936,26 +944,10 @@ class CapacityModel:
 
         Strict semantics only.
         """
-        if self.mode != "strict":
-            raise ValueError(
-                "topology spread requires strict semantics (the reference "
-                "has no constraint concept)"
-            )
-        if max_skew < 1:
-            raise ValueError("max_skew must be >= 1")
-        if node_taints_policy not in ("ignore", "honor"):
-            raise ValueError(
-                f"node_taints_policy must be 'ignore' or 'honor', got "
-                f"{node_taints_policy!r}"
-            )
-        taint_mask, affinity_mask, anti_mask = self._mask_parts(spec)
-        full_mask = _masks.combine_masks(taint_mask, affinity_mask, anti_mask)
+        self._require_strict("topology spread")
+        self._check_spread_args(max_skew, node_taints_policy)
+        full_mask, domain_mask = self._spread_masks(spec, node_taints_policy)
         fits = self.evaluate(spec, _node_mask=full_mask).fits
-        domain_mask = (
-            affinity_mask
-            if node_taints_policy == "ignore"
-            else _masks.combine_masks(taint_mask, affinity_mask)
-        )
         zone_ids, member, unkeyed = self._zone_membership(
             topology_key, domain_mask
         )
@@ -1029,18 +1021,8 @@ class CapacityModel:
         """
         from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
 
-        if self.mode != "strict":
-            raise ValueError(
-                "topology spread requires strict semantics (the reference "
-                "has no constraint concept)"
-            )
-        if max_skew < 1:
-            raise ValueError("max_skew must be >= 1")
-        if node_taints_policy not in ("ignore", "honor"):
-            raise ValueError(
-                f"node_taints_policy must be 'ignore' or 'honor', got "
-                f"{node_taints_policy!r}"
-            )
+        self._require_strict("topology spread")
+        self._check_spread_args(max_skew, node_taints_policy)
         grid.validate()
         snap = self.snapshot
         shared_spec = PodSpec(
@@ -1050,12 +1032,8 @@ class CapacityModel:
             node_selector=node_selector or {},
         )
         self._check_extensions(shared_spec.constrained)
-        taint_mask, affinity_mask, _ = self._mask_parts(shared_spec)
-        full_mask = _masks.combine_masks(taint_mask, affinity_mask)
-        domain_mask = (
-            affinity_mask
-            if node_taints_policy == "ignore"
-            else full_mask
+        full_mask, domain_mask = self._spread_masks(
+            shared_spec, node_taints_policy
         )
         zone_ids, member, _ = self._zone_membership(topology_key, domain_mask)
         n_zones = len(zone_ids)
@@ -1125,12 +1103,7 @@ class CapacityModel:
         taint the spec does not tolerate, makes the plan unsatisfiable).
         Strict semantics only.
         """
-        if self.mode != "strict":
-            raise ValueError(
-                "capacity planning requires strict semantics (the "
-                "conditional-cap reference mode has no coherent "
-                "per-empty-node fit)"
-            )
+        self._require_strict("capacity planning")
         current = int(self.evaluate(spec).total)
         per_node = int(self._template_model(node_template).evaluate(spec).total)
         deficit = spec.replicas - current
@@ -1164,12 +1137,7 @@ class CapacityModel:
         sweeps (a tolerated template taint stays satisfiable here, like
         the scalar path's ``PodSpec`` constraints).
         """
-        if self.mode != "strict":
-            raise ValueError(
-                "capacity planning requires strict semantics (the "
-                "conditional-cap reference mode has no coherent "
-                "per-empty-node fit)"
-            )
+        self._require_strict("capacity planning")
         shared = dict(tolerations=tolerations, node_selector=node_selector)
         totals, _ = self.sweep(grid, **shared)
         per_node, _ = self._template_model(node_template).sweep(grid, **shared)
