@@ -317,6 +317,37 @@ class TestSweep:
         with pytest.raises(ValueError, match="priorities"):
             model.sweep_preemption(grid, [0, 1])
 
+    def test_sweep_sharded_scenario_axis(self, prio_setup):
+        """The preemption sweep compiles and answers identically with the
+        scenario axis sharded across the 8-device mesh — the searchsorted
+        + column gather are scenario-local, so GSPMD partitions them with
+        no cross-device traffic on the [N, K+1] tables."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubernetesclustercapacity_tpu.parallel import make_mesh
+
+        fx, snap, t = prio_setup
+        rng = np.random.default_rng(5)
+        s = 64
+        cpu = rng.integers(50, 2000, s)
+        mem = rng.integers(MIB, 512 * MIB, s)
+        pr = rng.integers(int(t.levels[0]) - 1, int(t.levels[-1]) + 2, s)
+        reps = rng.integers(0, 50, s)
+        args = (snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+                snap.healthy, t.levels, t.used_cpu_ge, t.used_mem_ge,
+                t.pods_ge)
+        want_t, want_s = sweep_preemption(*args, cpu, mem, pr, reps)
+        # make_mesh fails loudly if the 8 virtual devices are missing
+        # (a vacuous 1-device "sharding" test would prove nothing).
+        plan = make_mesh(8, 1)
+        shard = NamedSharding(plan.mesh, P("scenario"))
+        sharded = [jax.device_put(np.asarray(x), shard)
+                   for x in (cpu, mem, pr, reps)]
+        got_t, got_s = sweep_preemption(*args, *sharded)
+        np.testing.assert_array_equal(np.asarray(got_t), np.asarray(want_t))
+        np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+
     def test_ops_sweep_empty_levels(self):
         """K=0 (no pods): every threshold gathers the zero column."""
         totals, sched = sweep_preemption(
