@@ -100,6 +100,12 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SECONDS",
                    help="how long -doctor waits for backend init before "
                         "declaring it wedged")
+    p.add_argument("-doctor-service", dest="doctor_service", default=None,
+                   metavar="HOST:PORT",
+                   help="with -doctor: also probe a running capacity "
+                        "service's resilience counters (deadline sheds, "
+                        "fused-path breaker, follower backoff) over its "
+                        "info op")
     return p
 
 
@@ -128,7 +134,18 @@ def main(argv: list[str] | None = None) -> int:
     if args.doctor:
         from kubernetesclustercapacity_tpu.utils.doctor import run_doctor
 
-        report, code = run_doctor(backend_timeout_s=args.doctor_timeout)
+        service_addr = None
+        if args.doctor_service:
+            host, _, port = args.doctor_service.rpartition(":")
+            try:
+                service_addr = (host or "127.0.0.1", int(port))
+            except ValueError:
+                print(f"ERROR : bad -doctor-service {args.doctor_service!r} "
+                      "(want HOST:PORT)", file=sys.stderr)
+                return 1
+        report, code = run_doctor(
+            backend_timeout_s=args.doctor_timeout, service_addr=service_addr
+        )
         print(report)
         return code
 

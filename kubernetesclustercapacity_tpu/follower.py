@@ -23,8 +23,11 @@ Watch-protocol handling follows the standard informer contract:
 from __future__ import annotations
 
 import collections
+import random
 import threading
 import time
+
+from kubernetesclustercapacity_tpu.resilience import decorrelated_jitter
 
 from kubernetesclustercapacity_tpu.kubeapi import (
     PDB_PATH,
@@ -53,6 +56,9 @@ _RESOURCES = {
 
 _FIXTURE_KEYS = {"Node": "nodes", "Pod": "pods", "PodDisruptionBudget": "pdbs"}
 
+# Ceiling on the jittered failure backoff (client-go reflector's cap).
+_BACKOFF_CAP_S = 30.0
+
 
 class ClusterFollower:
     """Keep a packed :class:`ClusterStore` synced to a live cluster."""
@@ -69,6 +75,7 @@ class ClusterFollower:
         stop_on_idle_window: bool = False,
         idle_rewatch_backoff: float = 1.0,
         resync_failure_deadline: float = 900.0,
+        backoff_seed: int | None = None,
     ) -> None:
         """``client_factory() -> KubeClient`` builds one client per stream
         (each watch occupies a connection); defaults to clients over the
@@ -81,7 +88,11 @@ class ClusterFollower:
         A real apiserver regularly ends watch windows with no events and no
         version progress; the follower re-watches after
         ``idle_rewatch_backoff`` seconds (also the BASE of the exponential
-        failure backoff, capped at 30 s).  ``stop_on_idle_window=True``
+        failure backoff, capped at 30 s).  Failure backoff uses
+        decorrelated jitter (:mod:`..resilience`) so a fleet of followers
+        recovering from a shared apiserver outage spreads its relists out
+        instead of stampeding in lockstep; ``backoff_seed`` pins the
+        jitter RNG for deterministic tests.  ``stop_on_idle_window=True``
         instead ends that resource's watch loop — ONLY for tests driving
         finite mock streams; in production it would silently stop syncing.
 
@@ -121,6 +132,18 @@ class ClusterFollower:
         self._fatal: str | None = None
         self._pdb_unavailable = False  # policy API 403/404 at relist
         self._errors: collections.deque = collections.deque(maxlen=100)
+        # Jittered-backoff RNG (seedable) + resilience counters, all
+        # guarded by _lock.  _backoff_s tracks each stream's CURRENT
+        # retry delay (0 = healthy) so info/doctor can see a struggling
+        # sync loop, not just its final failure.
+        self._backoff_rng = random.Random(backoff_seed)
+        self._backoff_s: dict[str, float] = {}
+        self._counters = {
+            "relists": 0,
+            "relist_failures": 0,
+            "watch_failures": 0,
+            "events_applied": 0,
+        }
         # Live clients (watch streams mid-read, in-flight relists), guarded
         # by _lock: stop() severs their sockets so a reader parked in
         # readline() unblocks now, not after the watch watchdog.
@@ -196,6 +219,42 @@ class ClusterFollower:
         bounded to the last 100)."""
         return list(self._errors)
 
+    def stats(self) -> dict:
+        """Retry/backoff/degradation counters (JSON-able), surfaced by
+        the capacity service's ``info`` op and ``-doctor``: relist and
+        watch failure totals, events applied, each stream's current
+        backoff delay (0 when healthy), and the fatal state."""
+        with self._lock:
+            return {
+                **self._counters,
+                "backoff_s": {
+                    p: round(d, 3)
+                    for p, d in self._backoff_s.items()
+                    if d > 0
+                },
+                "recent_errors": len(self._errors),
+                "pdb_unavailable": self._pdb_unavailable,
+                "fatal": self._fatal,
+            }
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[counter] += n
+
+    def _next_backoff(self, path: str, prev: float | None) -> float:
+        """One capped decorrelated-jitter backoff step, recorded so
+        :meth:`stats` shows the stream as backing off."""
+        with self._lock:
+            delay = decorrelated_jitter(
+                self._backoff_rng, self._idle_backoff, prev, _BACKOFF_CAP_S
+            )
+            self._backoff_s[path] = delay
+        return delay
+
+    def _clear_backoff(self, path: str) -> None:
+        with self._lock:
+            self._backoff_s[path] = 0.0
+
     @property
     def fatal(self) -> str | None:
         """Non-``None`` when a watch thread died on an unexpected error.
@@ -253,6 +312,7 @@ class ClusterFollower:
             self._store = store
             self._versions = versions
             self._epoch += 1
+            self._counters["relists"] += 1
         self._synced.set()
         # The swapped-in store may hold changes that never flowed through
         # per-object events (that's what a relist is FOR) — consumers
@@ -276,7 +336,7 @@ class ClusterFollower:
 
     def _watch_loop_inner(self, path: str) -> None:
         kind, convert = _RESOURCES[path]
-        consecutive_failures = 0
+        prev_delay: float | None = None
         failing_since: float | None = None
         while not self._stop.is_set():
             if kind == "PodDisruptionBudget" and self._pdb_unavailable:
@@ -292,24 +352,22 @@ class ClusterFollower:
                 )
             except (KubeAPIError, KubeConfigError, StoreError) as e:
                 self._errors.append(f"{path}: {e}")
-                # Back off exponentially (client-go reflector style: base
-                # idle_backoff, doubling, capped at 30 s), then relist
-                # (410 Gone / transport loss / bad apply).  A failing
-                # relist retries forever within the resync deadline — a
-                # transient outage must never permanently stop the sync
-                # loop — and a persistently rejected watch (e.g. RBAC
-                # grants list but not watch) drives at most ~2 full LISTs
-                # a minute, not one per second.
-                consecutive_failures += 1
+                self._bump("watch_failures")
+                # Back off (client-go reflector cadence: base
+                # idle_backoff, growing, capped at 30 s) with
+                # decorrelated jitter — many followers recovering from
+                # one outage must not relist in lockstep against the
+                # shared apiserver — then relist (410 Gone / transport
+                # loss / bad apply).  A failing relist retries forever
+                # within the resync deadline — a transient outage must
+                # never permanently stop the sync loop — and a
+                # persistently rejected watch (e.g. RBAC grants list but
+                # not watch) keeps the capped cadence, not one LIST per
+                # second.
                 if failing_since is None:
                     failing_since = time.monotonic()
-                # Exponent clamped: a watch denied for hours must keep the
-                # capped cadence, not overflow float conversion.
-                delay = min(
-                    self._idle_backoff
-                    * 2.0 ** min(consecutive_failures - 1, 16),
-                    30.0,
-                )
+                delay = self._next_backoff(path, prev_delay)
+                prev_delay = delay
                 while not self._stop.is_set():
                     self._stop.wait(delay)
                     if self._stop.is_set():
@@ -322,6 +380,7 @@ class ClusterFollower:
                         break
                     except (KubeAPIError, KubeConfigError) as e2:
                         self._errors.append(f"relist {path}: {e2}")
+                        self._bump("relist_failures")
                         stale_for = time.monotonic() - failing_since
                         if stale_for > self._resync_deadline:
                             # Watch AND relist failing past the deadline:
@@ -335,10 +394,12 @@ class ClusterFollower:
                                 f"(deadline {self._resync_deadline:.0f}s); "
                                 f"last error: {e2}"
                             ) from e2
-                        delay = min(delay * 2, 30.0)
+                        delay = self._next_backoff(path, delay)
+                        prev_delay = delay
                 continue
-            consecutive_failures = 0
+            prev_delay = None
             failing_since = None
+            self._clear_backoff(path)
             if stream_ended:
                 with self._lock:
                     unchanged = version == self._versions.get(path)
@@ -425,6 +486,7 @@ class ClusterFollower:
             elif etype == "DELETED" and not exists:
                 return True
             store.apply_event({"type": etype, "kind": kind, "object": obj})
+            self._counters["events_applied"] += 1
         if self.on_event is not None:
             self.on_event(kind, etype, obj)
         return True

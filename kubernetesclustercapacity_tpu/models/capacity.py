@@ -826,6 +826,7 @@ class CapacityModel:
         from kubernetesclustercapacity_tpu.snapshot import (
             _STRICT_TERMINATED,
             _effective_pod_resources,
+            _strict_parse,
         )
 
         self._require_strict("drain simulation")
@@ -842,13 +843,44 @@ class CapacityModel:
 
         ext_names = tuple(sorted(snap.extended))
         pods: list[tuple[str, dict]] = []
+        unpacked: dict[str, set[str]] = {}  # pod key -> unpacked resources
         for pod in self.fixture.get("pods", []):
             if pod.get("nodeName") != node_name:
                 continue
             if pod.get("phase") in _STRICT_TERMINATED:
                 continue
             key = f"{pod.get('namespace', '')}/{pod.get('name', '')}"
+            # An evicted pod requesting an extended resource the snapshot
+            # does not PACK (e.g. a GPU pod against extended=(), the CLI
+            # -drain live default) must fail here: _effective_pod_resources
+            # silently drops the request, and the plan would report the
+            # pod rehomeable onto nodes with no free GPUs.
+            for c in (
+                *pod.get("containers", []), *pod.get("initContainers", [])
+            ):
+                for r, qty in (
+                    (c.get("resources", {}).get("requests") or {})
+                ).items():
+                    if (
+                        r in ("cpu", "memory", "ephemeral-storage")
+                        or r.startswith("hugepages-")
+                        or r in ext_names
+                    ):
+                        continue
+                    if _strict_parse(qty) > 0:
+                        unpacked.setdefault(key, set()).add(r)
             pods.append((key, _effective_pod_resources(pod, ext_names)))
+        if unpacked:
+            detail = "; ".join(
+                f"{k} requests {', '.join(sorted(rs))}"
+                for k, rs in sorted(unpacked.items())
+            )
+            raise ValueError(
+                f"drain {node_name!r}: pods request extended resources "
+                f"not packed in this snapshot ({detail}) — rehoming "
+                "feasibility would be wrong; repack with "
+                "extended_resources=(...) covering them"
+            )
         # First-fit-decreasing order; name breaks ties so the plan is
         # deterministic across runs.
         pods.sort(

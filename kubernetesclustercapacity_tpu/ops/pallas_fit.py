@@ -28,6 +28,7 @@ back to the exact int64 path; :func:`sweep_auto` picks automatically.
 
 from __future__ import annotations
 
+import threading as _threading
 from functools import partial
 
 import jax
@@ -37,6 +38,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
+from kubernetesclustercapacity_tpu.resilience import (
+    CircuitBreaker as _CircuitBreaker,
+)
 
 __all__ = [
     "fast_sweep_eligible",
@@ -45,6 +49,8 @@ __all__ = [
     "sweep_auto",
     "sweep_snapshot_auto",
     "fast_path_error",
+    "fast_path_breaker_snapshot",
+    "last_dispatch_fast_path",
     "reset_fast_path",
 ]
 
@@ -56,12 +62,46 @@ __all__ = [
 # onto each ~1 ms sweep.  Read via fast_path_error() — a `from ...
 # import` of the bare global would snapshot None forever.
 last_fast_path_error: str | None = None
-_fast_path_broken: bool = False
+
+# The real breaker (closed/open/half-open, resilience.CircuitBreaker)
+# replacing the old ad-hoc `_fast_path_broken` bool.  threshold=1: ONE
+# non-transient failure is already proof (the inputs were proven
+# eligible, so the failure is compiler-deterministic for this (kernel,
+# chip)).  recovery_timeout_s=None: a failed compile does not heal with
+# time — the breaker stays open until reset_fast_path() re-arms it.
+_breaker = _CircuitBreaker(
+    name="pallas_fused_sweep",
+    failure_threshold=1,
+    recovery_timeout_s=None,
+)
+
+# Per-dispatch-thread record of the LAST sweep_auto call on this thread:
+# did it attempt the fused path, and did that attempt fail?  The service
+# reads this to attach fast_path_error to exactly the responses whose
+# request attempted the fused kernel — never a stale error from an
+# earlier request (ADVICE.md, server.py:705).
+_dispatch_tls = _threading.local()
 
 
 def fast_path_error() -> str | None:
     """The most recent fused-path failure (breaker-tripping or not)."""
     return last_fast_path_error
+
+
+def fast_path_breaker_snapshot() -> dict:
+    """Breaker state + lifetime counters (service info op / doctor)."""
+    return _breaker.snapshot()
+
+
+def last_dispatch_fast_path() -> tuple[bool, str | None]:
+    """``(attempted, error)`` for the calling thread's most recent
+    :func:`sweep_auto` dispatch — ``attempted`` is True iff the fused
+    kernel actually ran (or tried to) for that request, and ``error``
+    is THAT attempt's failure, never a stale one."""
+    return (
+        getattr(_dispatch_tls, "attempted", False),
+        getattr(_dispatch_tls, "error", None),
+    )
 
 
 # Transient-failure markers: device/runtime conditions that are data- or
@@ -94,9 +134,11 @@ def _is_transient_failure(e: Exception) -> bool:
 
 def reset_fast_path() -> None:
     """Re-arm the fused path after a breaker trip (tests / operators)."""
-    global last_fast_path_error, _fast_path_broken
+    global last_fast_path_error
     last_fast_path_error = None
-    _fast_path_broken = False
+    _breaker.reset()
+    _dispatch_tls.attempted = False
+    _dispatch_tls.error = None
 
 LANES = 128
 # Node tile: 16 sublanes x 128 lanes = 2048 nodes per step; scenario tile 256.
@@ -602,7 +644,9 @@ def sweep_auto(
     off-TPU (the real chip may register under a plugin platform name, so
     detect the one backend that NEEDS interpret mode).
     """
-    global last_fast_path_error, _fast_path_broken
+    global last_fast_path_error
+    _dispatch_tls.attempted = False
+    _dispatch_tls.error = None
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     if mode == "strict":
@@ -620,12 +664,16 @@ def sweep_auto(
         kernel_mask = node_mask
     if (
         not force_exact
-        and not _fast_path_broken
         and fast_sweep_eligible(
             alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
             pods_count, cpu_reqs, mem_reqs,
         )
+        # The breaker check comes LAST: an open breaker for an eligible
+        # request is what counts as "degraded" (an ineligible request
+        # was never going to take the fused path anyway).
+        and _breaker.allow()
     ):
+        _dispatch_tls.attempted = True
         use_rcp = rcp_division_eligible(
             alloc_cpu, alloc_mem, used_cpu, used_mem, cpu_reqs, mem_reqs
         )
@@ -650,14 +698,16 @@ def sweep_auto(
             # failures included — trips the breaker (see
             # _is_transient_failure for why unknown defaults to trip).
             last_fast_path_error = f"{type(e).__name__}: {e}"
+            _dispatch_tls.error = last_fast_path_error
             if not _is_transient_failure(e):
-                _fast_path_broken = True
+                _breaker.record_failure(last_fast_path_error)
         else:
             # A fused success clears any prior transient failure: the
             # service must not report a stale fast_path_error alongside
             # a healthy fast-path kernel.  (A tripped breaker never
             # reaches here, so ITS error stays visible.)
             last_fast_path_error = None
+            _breaker.record_success()
             name = "pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused"
             return totals, sched, name
     totals, sched = sweep_grid(

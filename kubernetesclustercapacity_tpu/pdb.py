@@ -44,7 +44,59 @@ from dataclasses import dataclass
 from kubernetesclustercapacity_tpu.masks import _expr_matches
 from kubernetesclustercapacity_tpu.snapshot import _STRICT_TERMINATED
 
-__all__ = ["BudgetStatus", "budget_statuses", "blocked_evictions"]
+__all__ = [
+    "BudgetStatus",
+    "budget_statuses",
+    "blocked_evictions",
+    "validate_selector",
+]
+
+# LabelSelector operators _expr_matches evaluates.  In/NotIn require a
+# non-empty values list and Exists/DoesNotExist an empty one — upstream
+# LabelSelectorRequirement validation, enforced here so a malformed
+# selector fails at ADMISSION (store validation), not on a later drain.
+_SELECTOR_OPS = frozenset(
+    {"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"}
+)
+
+
+def validate_selector(selector: dict) -> None:
+    """Structural validation of a full LabelSelector — every
+    ``matchExpressions`` entry checked UNCONDITIONALLY (matching a probe
+    pod can short-circuit on ``matchLabels`` and never evaluate the
+    expressions, which is exactly how a malformed operator used to slip
+    into the store).  Raises ValueError."""
+    if not isinstance(selector, dict):
+        raise ValueError(f"selector must be an object, got {selector!r}")
+    match_labels = selector.get("matchLabels") or {}
+    if not isinstance(match_labels, dict):
+        raise ValueError(
+            f"matchLabels must be an object, got {match_labels!r}"
+        )
+    exprs = selector.get("matchExpressions") or []
+    if not isinstance(exprs, (list, tuple)):
+        raise ValueError(
+            f"matchExpressions must be a list, got {exprs!r}"
+        )
+    for expr in exprs:
+        if not isinstance(expr, dict):
+            raise ValueError(f"match expression must be an object: {expr!r}")
+        op = expr.get("operator", "In")
+        if op not in _SELECTOR_OPS:
+            raise ValueError(f"unknown match-expression operator {op!r}")
+        values = expr.get("values", [])
+        if not isinstance(values, (list, tuple)):
+            raise ValueError(
+                f"match-expression values must be a list, got {values!r}"
+            )
+        if op in ("In", "NotIn") and not values:
+            raise ValueError(
+                f"operator {op} requires a non-empty values list"
+            )
+        if op in ("Exists", "DoesNotExist") and values:
+            raise ValueError(
+                f"operator {op} must not carry values, got {list(values)!r}"
+            )
 
 
 @dataclass(frozen=True)
@@ -73,14 +125,25 @@ def _selector_matches(selector: dict, labels: dict) -> bool:
 
 
 def _scaled(value, expected: int, field: str) -> int:
-    """intstr: plain int, or "N%" scaled by expected, rounded UP."""
+    """intstr: plain int, or "N%" scaled by expected, rounded UP.
+
+    Negative values are rejected (the API validates both fields as
+    non-negative): a negative ``minAvailable`` would otherwise silently
+    yield ``allowed_disruptions == healthy`` — every eviction waved
+    through by a budget that was supposed to protect the workload.
+    """
     if isinstance(value, str) and value.endswith("%"):
         try:
             pct = int(value[:-1])
         except ValueError:
             raise ValueError(f"PDB {field}: bad percentage {value!r}") from None
+        if pct < 0:
+            raise ValueError(f"PDB {field}: must be >= 0, got {value!r}")
         return -(-pct * expected // 100)
-    return int(value)
+    n = int(value)
+    if n < 0:
+        raise ValueError(f"PDB {field}: must be >= 0, got {n}")
+    return n
 
 
 def budget_statuses(fixture: dict) -> list[BudgetStatus]:

@@ -1,16 +1,64 @@
-"""Python client for the capacity service (same protocol as the C++ CLI)."""
+"""Python client for the capacity service (same protocol as the C++ CLI).
+
+Hardened transport: connect/read timeouts, automatic reconnect, bounded
+jittered retry of *idempotent* ops, optional per-call deadlines threaded
+to the server, and an optional circuit breaker.  The retry boundary is
+the op table below — ``update`` and ``reload`` mutate served state and
+are NEVER auto-retried (a lost reply does not prove the op was lost:
+the server may have executed it before the transport died).
+
+==============  =======================================================
+op              auto-retry on transport failure?
+==============  =======================================================
+ping, info      yes (read-only)
+fit, sweep,     yes (pure queries against an immutable snapshot — a
+sweep_multi,    duplicate execution returns the identical result)
+place, drain,
+topology_spread,
+plan
+update, reload  NO (state mutations; at-most-once from this client)
+==============  =======================================================
+"""
 
 from __future__ import annotations
 
 import socket
+import time
 
+from kubernetesclustercapacity_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExpired,
+    RetryPolicy,
+)
 from kubernetesclustercapacity_tpu.service import protocol
 
-__all__ = ["CapacityClient"]
+__all__ = ["CapacityClient", "IDEMPOTENT_OPS"]
+
+#: Ops safe to re-send after a transport failure: they never mutate
+#: served state, so duplicate execution is invisible.  Anything not in
+#: this set (update/reload, future unknown ops) is at-most-once.
+IDEMPOTENT_OPS = frozenset(
+    {
+        "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
+        "topology_spread", "plan",
+    }
+)
 
 
 class CapacityClient:
-    """Connect once, issue many requests (context-manager friendly)."""
+    """Connect once, issue many requests (context-manager friendly).
+
+    ``retry`` (a :class:`~..resilience.RetryPolicy`) governs idempotent
+    ops only; ``None`` disables auto-retry entirely.  ``deadline_s``
+    sets a default per-call time budget, overridable per call
+    (``client.fit(deadline_s=0.5)``); the absolute deadline rides the
+    request so the server sheds it once expired.  ``breaker`` (a
+    :class:`~..resilience.CircuitBreaker`) fail-fasts every call while
+    open.  ``stats`` counts retries/reconnects/deadline hits for the
+    ``info``-op style of observability.
+    """
 
     def __init__(
         self,
@@ -18,9 +66,28 @@ class CapacityClient:
         port: int = 7077,
         *,
         token: str | None = None,
+        connect_timeout_s: float = 10.0,
+        timeout_s: float | None = 120.0,
+        retry: RetryPolicy | None = None,
+        deadline_s: float | None = None,
+        breaker: CircuitBreaker | None = None,
     ) -> None:
-        self._sock = socket.create_connection((host, port))
+        self._addr = (host, port)
         self._token = token
+        self._connect_timeout = connect_timeout_s
+        self._timeout = timeout_s
+        self._retry = retry if retry is not None else RetryPolicy()
+        self._deadline_s = deadline_s
+        self._breaker = breaker
+        self._sock: socket.socket | None = None
+        self.stats = {
+            "calls": 0,
+            "retries": 0,
+            "reconnects": 0,
+            "deadline_expired": 0,
+            "breaker_rejected": 0,
+        }
+        self._connect()  # fail fast, like the original one-shot client
 
     def __enter__(self) -> "CapacityClient":
         return self
@@ -29,25 +96,133 @@ class CapacityClient:
         self.close()
 
     def close(self) -> None:
-        self._sock.close()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
 
-    def call(self, op: str, **params):
-        if self._token is not None:
-            params.setdefault("token", self._token)
-        protocol.send_msg(self._sock, {"op": op, **params})
-        resp = protocol.recv_msg(self._sock)
+    # -- transport ---------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(
+            self._addr, timeout=self._connect_timeout
+        )
+        sock.settimeout(self._timeout)
+        self._sock = sock
+        return sock
+
+    def _ensure_connected(self) -> socket.socket:
+        if self._sock is None:
+            self.stats["reconnects"] += 1
+            return self._connect()
+        return self._sock
+
+    def _attempt(self, msg: dict, deadline: Deadline | None):
+        """One send/recv round trip.  Transport failures tear the socket
+        down (the stream may be desynced mid-frame) so the next attempt
+        reconnects cleanly."""
+        if deadline is not None and deadline.expired():
+            self.stats["deadline_expired"] += 1
+            raise DeadlineExpired(
+                f"deadline expired before sending {msg.get('op')!r}"
+            )
+        sock = self._ensure_connected()
+        if deadline is not None:
+            # The read must give up when the budget does, even if the
+            # configured read timeout is longer (or unset).
+            remaining = max(deadline.remaining(), 0.001)
+            sock.settimeout(
+                remaining
+                if self._timeout is None
+                else min(self._timeout, remaining)
+            )
+        try:
+            protocol.send_msg(sock, msg)
+            resp = protocol.recv_msg(sock)
+        except (protocol.ProtocolError, OSError):
+            self.close()
+            raise
+        finally:
+            if deadline is not None and self._sock is not None:
+                self._sock.settimeout(self._timeout)
         if resp is None:
+            self.close()
             raise protocol.ProtocolError("server closed connection")
         if not resp.get("ok"):
             raise RuntimeError(resp.get("error", "unknown server error"))
         return resp["result"]
 
-    # Convenience wrappers -------------------------------------------------
-    def ping(self) -> str:
-        return self.call("ping")
+    # -- the call loop -----------------------------------------------------
+    def call(self, op: str, deadline_s: float | None = None, **params):
+        """Issue one op.  ``deadline_s`` overrides the client default
+        for this call only.  Idempotent ops retry transport failures
+        under the retry policy (within the deadline); ``update`` /
+        ``reload`` surface the first transport failure unchanged."""
+        if self._token is not None:
+            params.setdefault("token", self._token)
+        budget = self._deadline_s if deadline_s is None else deadline_s
+        deadline = Deadline.after(budget) if budget is not None else None
+        msg = {"op": op, **params}
+        if deadline is not None:
+            msg["deadline"] = deadline.to_wire()
+        retryable_op = op in IDEMPOTENT_OPS
+        self.stats["calls"] += 1
+        prev_delay: float | None = None
+        attempt = 0
+        while True:
+            attempt += 1
+            if self._breaker is not None and not self._breaker.allow():
+                self.stats["breaker_rejected"] += 1
+                raise CircuitOpenError(
+                    f"circuit breaker open for {self._addr[0]}:"
+                    f"{self._addr[1]}"
+                    + (
+                        f" (last error: {self._breaker.last_error})"
+                        if self._breaker.last_error
+                        else ""
+                    )
+                )
+            try:
+                result = self._attempt(msg, deadline)
+            except Exception as e:
+                transport = RetryPolicy.is_transport_error(e)
+                if transport and self._breaker is not None:
+                    self._breaker.record_failure(f"{type(e).__name__}: {e}")
+                if deadline is not None and deadline.expired() and transport:
+                    # The budget, not the transport, is what gave out:
+                    # surface that (retrying cannot un-spend it).
+                    self.stats["deadline_expired"] += 1
+                    raise DeadlineExpired(
+                        f"deadline expired after {attempt} attempt(s) of "
+                        f"{op!r}; last transport error: "
+                        f"{type(e).__name__}: {e}"
+                    ) from e
+                if (
+                    not transport  # app error / deadline: deterministic
+                    or not retryable_op  # update/reload: at-most-once
+                    or attempt >= self._retry.max_attempts
+                ):
+                    raise
+                prev_delay = self._retry.next_delay(prev_delay)
+                if deadline is not None:
+                    prev_delay = min(
+                        prev_delay, max(deadline.remaining(), 0.0)
+                    )
+                time.sleep(prev_delay)
+                self.stats["retries"] += 1
+                continue
+            if self._breaker is not None:
+                self._breaker.record_success()
+            return result
 
-    def info(self) -> dict:
-        return self.call("info")
+    # Convenience wrappers -------------------------------------------------
+    # (each forwards **kwargs through ``call``, so every wrapper accepts
+    # a per-call ``deadline_s=...`` override for free)
+    def ping(self, **kw) -> str:
+        return self.call("ping", **kw)
+
+    def info(self, **kw) -> dict:
+        return self.call("info", **kw)
 
     def fit(self, **flags) -> dict:
         return self.call("fit", **flags)
@@ -68,9 +243,9 @@ class CapacityClient:
     def reload(self, path: str, **params) -> dict:
         return self.call("reload", path=path, **params)
 
-    def update(self, events: list[dict]) -> dict:
+    def update(self, events: list[dict], **kw) -> dict:
         """Apply watch-style node/pod events to the served snapshot."""
-        return self.call("update", events=events)
+        return self.call("update", events=events, **kw)
 
     def place(self, **flags) -> dict:
         """Simulate where each replica lands (greedy scheduler)."""

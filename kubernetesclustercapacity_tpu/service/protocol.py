@@ -38,6 +38,20 @@ update     ``events`` — watch-style node/pod event list applied
            incrementally to the served snapshot (fixture-backed only)
 =========  ==========================================================
 
+Any request may additionally carry:
+
+``token``
+    shared bearer token (required for every op except ``ping`` when the
+    server was started with auth enabled).
+``deadline``
+    absolute unix timestamp (``time.time()`` epoch seconds) after which
+    the caller no longer wants the answer.  The server sheds the request
+    with a ``DeadlineExpired`` error instead of dispatching — before
+    parsing, and again after any wait for a compute slot — so a queue of
+    abandoned requests cannot occupy the device.  Same-host deployments
+    share a clock exactly; cross-host callers should keep budgets above
+    their NTP skew (the client's own budget check is authoritative).
+
 Responses: ``{"ok": true, "result": ...}`` or ``{"ok": false, "error": "..."}``.
 Maximum frame size 64 MiB (a 10k-node JSON report is ~3 MB).
 """

@@ -95,10 +95,18 @@ class CapacityServer:
         max_inflight: int = 8,
         inflight_wait_s: float = 30.0,
         reload_roots: tuple[str, ...] = (),
+        stats_source=None,
     ) -> None:
+        """``stats_source`` is an optional zero-arg callable returning a
+        JSON-able dict of upstream-feed health (e.g.
+        :meth:`~..follower.ClusterFollower.stats`); it is surfaced under
+        ``info.resilience.follower`` so clients can see retry/backoff/
+        degradation counters without a side channel."""
         import os
 
         self.snapshot = snapshot
+        self._stats_source = stats_source
+        self._deadline_shed = 0  # requests dropped already-expired
         self.fixture = fixture
         self._store = None  # lazy ClusterStore, built on first update op
         self._fixture_dirty = False  # fixture lags the store until needed
@@ -135,8 +143,33 @@ class CapacityServer:
         self._tcp.server_close()
 
     # -- dispatch ----------------------------------------------------------
+    def _check_deadline(self, msg: dict, *, shed: bool = True):
+        """Parse the optional absolute ``deadline`` riding the request;
+        raise :class:`~..resilience.DeadlineExpired` (→ a normal error
+        response) when the caller's budget is already spent — the whole
+        point of threading deadlines is never burning a kernel dispatch
+        on an answer nobody is waiting for."""
+        from kubernetesclustercapacity_tpu.resilience import (
+            Deadline,
+            DeadlineExpired,
+        )
+
+        wire = msg.get("deadline")
+        if wire is None:
+            return None
+        deadline = Deadline.from_wire(wire)  # ValueError on junk
+        if shed and deadline.expired():
+            with self._lock:
+                self._deadline_shed += 1
+            raise DeadlineExpired(
+                f"request deadline expired {-deadline.remaining():.3f}s "
+                "ago; shedding without dispatch"
+            )
+        return deadline
+
     def dispatch(self, msg: dict) -> dict | str:
         op = msg.get("op")
+        deadline = self._check_deadline(msg)
         if op == "ping":
             return "pong"
         if self._auth_token is not None:
@@ -155,13 +188,20 @@ class CapacityServer:
         ):
             # Bounded concurrency for the compute ops: each holds device
             # dispatch + host packing; unbounded fan-in from one noisy
-            # client must not starve the box.
-            if not self._inflight.acquire(timeout=self._inflight_wait_s):
+            # client must not starve the box.  A request carrying a
+            # deadline never waits past it for a slot.
+            wait_s = self._inflight_wait_s
+            if deadline is not None:
+                wait_s = max(0.0, min(wait_s, deadline.remaining()))
+            if not self._inflight.acquire(timeout=wait_s):
                 raise RuntimeError(
                     f"server busy: {self._max_inflight} compute requests "
                     "already in flight"
                 )
             try:
+                # The slot wait may have consumed the caller's budget:
+                # shed now rather than dispatch a kernel nobody awaits.
+                self._check_deadline(msg)
                 return self._dispatch_inner(op, msg)
             finally:
                 self._inflight.release()
@@ -235,6 +275,7 @@ class CapacityServer:
                 "semantics": snap.semantics,
                 "healthy_nodes": int(np.sum(snap.healthy)),
                 "extended_resources": sorted(snap.extended),
+                "resilience": self._resilience_info(),
             }
         if op == "fit":
             return self._op_fit(msg, snap, fixture, implicit_mask)
@@ -255,6 +296,29 @@ class CapacityServer:
         if op == "update":
             return self._op_update(msg)
         raise ValueError(f"unknown op {op!r}")
+
+    def _resilience_info(self) -> dict:
+        """The service's degradation/health counters, folded into the
+        ``info`` op (the breaker-state home the per-response
+        ``fast_path_error`` reporting moved out of): fused-path breaker
+        snapshot, deadline sheds, and — when a follower feeds this
+        server — its retry/backoff counters."""
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            fast_path_breaker_snapshot,
+        )
+
+        with self._lock:
+            shed = self._deadline_shed
+        out = {
+            "deadline_shed": shed,
+            "fast_path_breaker": fast_path_breaker_snapshot(),
+        }
+        if self._stats_source is not None:
+            try:
+                out["follower"] = self._stats_source()
+            except Exception as e:  # noqa: BLE001 - info must not fail
+                out["follower"] = {"error": f"{type(e).__name__}: {e}"}
+        return out
 
     # PodSpec extension fields a fit message may carry beyond the
     # reference's six flags (kube-scheduler constraint families).
@@ -692,19 +756,23 @@ class CapacityServer:
             node_mask=implicit_mask,
         )
         from kubernetesclustercapacity_tpu.ops.pallas_fit import (
-            fast_path_error,
+            last_dispatch_fast_path,
         )
 
+        # Attach the fused-path failure ONLY when THIS request's dispatch
+        # attempted the fused kernel and it failed (thread-local, so a
+        # concurrent request's failure can't be misattributed).  A stale
+        # breaker error must never ride an exact-kernel response — the
+        # breaker's standing state lives in the info op instead.
+        attempted, attempt_error = last_dispatch_fast_path()
         return {
             "totals": totals.tolist(),
             "schedulable": sched.tolist(),
             "scenarios": grid.size,
             "kernel": kernel,
-            # A tripped fused-path circuit breaker (Mosaic failure on this
-            # chip) is visible to clients, not just in the kernel name.
             **(
-                {"fast_path_error": fast_path_error()}
-                if fast_path_error()
+                {"fast_path_error": attempt_error}
+                if attempted and attempt_error
                 else {}
             ),
         }
@@ -1007,6 +1075,9 @@ def main(argv=None) -> int:
         snap, host=args.host, port=args.port, fixture=fixture,
         auth_token=auth_token, max_inflight=args.max_inflight,
         reload_roots=tuple(args.reload_roots),
+        # -follow: the follower's retry/backoff/degradation counters ride
+        # the info op, so a client can see a struggling sync loop.
+        stats_source=follower.stats if follower is not None else None,
     )
     coalescer = None
     if follower is not None:

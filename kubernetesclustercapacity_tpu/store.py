@@ -325,14 +325,22 @@ class ClusterStore:
     def _validate_pdb(self, pdb: dict) -> tuple[str, str]:
         """Run the budget arithmetic once against a synthetic pod in the
         budget's namespace — the ONE definition of PDB well-formedness
-        (``pdb.budget_statuses``) owns the rules, and the probe pod
-        forces the selector to actually evaluate (an empty pod set would
-        wave through a malformed selector that then poisons every later
-        drain)."""
-        from kubernetesclustercapacity_tpu.pdb import budget_statuses
+        (``pdb.budget_statuses``) owns the rules — plus a structural
+        selector check (``pdb.validate_selector``): the probe pod
+        carries no labels, so a non-empty ``matchLabels`` short-circuits
+        ``_selector_matches`` before ``matchExpressions`` are ever
+        evaluated, and a malformed operator would sail through to poison
+        every later ``drain``/``budget_statuses`` read.  The structural
+        check evaluates every expression unconditionally, so malformed
+        selectors fail at admission."""
+        from kubernetesclustercapacity_tpu.pdb import (
+            budget_statuses,
+            validate_selector,
+        )
 
         try:
             key = (str(pdb.get("namespace", "")), str(pdb.get("name", "")))
+            validate_selector(pdb.get("selector") or {})
             probe = {
                 "namespace": key[0], "name": "", "nodeName": "probe",
                 "phase": "Running", "labels": {},

@@ -100,6 +100,7 @@ def doctor_report(
     *,
     backend_timeout_s: float = 30.0,
     probe_code: str | None = None,
+    service_addr: tuple[str, int] | None = None,
 ) -> list[tuple[str, str]]:
     """Collect (check, result) pairs.  Pure data; rendering is the CLI's.
 
@@ -162,13 +163,64 @@ def doctor_report(
 
     def _fast():
         from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            fast_path_breaker_snapshot,
             fast_path_error,
         )
 
-        err = fast_path_error()
-        return f"degraded: {err}" if err else "armed (trips only on failure)"
+        b = fast_path_breaker_snapshot()
+        err = fast_path_error() or b["last_error"]
+        if b["state"] != "closed" or err:
+            return (
+                f"degraded: breaker {b['state']}, trips={b['trips']}, "
+                f"rejected={b['rejected']}"
+                + (f" — {err}" if err else "")
+            )
+        return (
+            "armed (trips only on failure; breaker closed, "
+            f"successes={b['successes']})"
+        )
 
     check("fused fast path", _fast)
+
+    if service_addr is not None:
+        # A LIVE service's resilience counters (deadline sheds, breaker
+        # state, follower retry/backoff) — the doctor probes the same
+        # info op clients use, with a short budget so a wedged server
+        # cannot hang the report.
+        def _service():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                info = c.info()
+            r = info.get("resilience", {})
+            fp = r.get("fast_path_breaker", {})
+            parts = [
+                f"ok: {info.get('nodes')} nodes ({info.get('semantics')})",
+                f"deadline_shed={r.get('deadline_shed')}",
+                f"fast_path={fp.get('state')}",
+            ]
+            follower = r.get("follower")
+            if follower:
+                parts.append(
+                    "follower relists=%s watch_failures=%s backoff=%s"
+                    % (
+                        follower.get("relists"),
+                        follower.get("watch_failures"),
+                        follower.get("backoff_s") or "none",
+                    )
+                )
+            return " ".join(parts)
+
+        check("capacity service", _service)
     return checks
 
 
@@ -185,7 +237,10 @@ def healthy(checks: list[tuple[str, str]]) -> bool:
 
 
 def run_doctor(
-    *, backend_timeout_s: float = 30.0, probe_code: str | None = None
+    *,
+    backend_timeout_s: float = 30.0,
+    probe_code: str | None = None,
+    service_addr: tuple[str, int] | None = None,
 ) -> tuple[str, int]:
     """Render the report; returns ``(text, exit_code)``.
 
@@ -194,7 +249,9 @@ def run_doctor(
     """
     t0 = time.time()
     checks = doctor_report(
-        backend_timeout_s=backend_timeout_s, probe_code=probe_code
+        backend_timeout_s=backend_timeout_s,
+        probe_code=probe_code,
+        service_addr=service_addr,
     )
     width = max(len(name) for name, _ in checks)
     lines = [f"{name:<{width}}  {result}" for name, result in checks]

@@ -233,6 +233,45 @@ class TestDrain:
         with pytest.raises(ValueError, match="unknown node"):
             self._model(drain_fixture).drain("nope")
 
+    def test_unpacked_extended_request_fails_not_lies(self, drain_fixture):
+        """ISSUE 1 satellite: a drained pod requesting an extended
+        resource the snapshot does not pack (the CLI -drain live path
+        packs extended=() by default) must FAIL — before this fix the
+        request was silently dropped and a GPU pod reported rehomeable
+        onto nodes with no free GPUs."""
+        drain_fixture["pods"][0]["containers"][0]["resources"][
+            "requests"]["nvidia.com/gpu"] = "2"
+        with pytest.raises(ValueError, match="nvidia.com/gpu"):
+            self._model(drain_fixture).drain("d0")
+
+    def test_packed_extended_request_still_drains(self, drain_fixture):
+        """Same pod, but with the column packed: the drain proceeds and
+        only GPU-bearing nodes are rehoming targets."""
+        for n in drain_fixture["nodes"]:
+            n["allocatable"]["nvidia.com/gpu"] = "0"
+        drain_fixture["nodes"][1]["allocatable"]["nvidia.com/gpu"] = "4"
+        drain_fixture["pods"][0]["containers"][0]["resources"][
+            "requests"]["nvidia.com/gpu"] = "2"
+        snap = snapshot_from_fixture(
+            drain_fixture, semantics="strict",
+            extended_resources=("nvidia.com/gpu",),
+        )
+        model = CapacityModel(
+            snap, mode="strict", fixture=drain_fixture
+        )
+        result = model.drain("d0")
+        assert result.by_pod()["d/big"] == "d1"
+
+    def test_native_resources_never_flagged(self, drain_fixture):
+        """ephemeral-storage / hugepages requests are native, not
+        extended: their presence must not fail the drain."""
+        reqs = drain_fixture["pods"][0]["containers"][0]["resources"][
+            "requests"]
+        reqs["ephemeral-storage"] = "1073741824"
+        reqs["hugepages-2Mi"] = "0"
+        result = self._model(drain_fixture).drain("d0")
+        assert result.evictable
+
     def test_reference_mode_rejected(self, drain_fixture):
         snap = snapshot_from_fixture(drain_fixture, semantics="reference")
         model = CapacityModel(snap, mode="reference", fixture=drain_fixture)

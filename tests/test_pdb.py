@@ -91,6 +91,49 @@ class TestBudgetMath:
         (s,) = budget_statuses(pdb_fixture)
         assert s.expected == 2
 
+    @pytest.mark.parametrize("field", ["minAvailable", "maxUnavailable"])
+    @pytest.mark.parametrize("bad", [-1, "-25%"])
+    def test_negative_intstr_rejected(self, pdb_fixture, field, bad):
+        """ISSUE 1 satellite: a negative minAvailable used to silently
+        yield allowed_disruptions == healthy — a protection budget that
+        waves every eviction through."""
+        pdb = pdb_fixture["pdbs"][0]
+        pdb.pop("minAvailable", None)
+        pdb[field] = bad
+        with pytest.raises(ValueError, match=">= 0"):
+            budget_statuses(pdb_fixture)
+
+    def test_validate_selector_checks_expressions_unconditionally(self):
+        from kubernetesclustercapacity_tpu.pdb import validate_selector
+
+        # The poison shape: non-empty matchLabels would short-circuit a
+        # probe-pod evaluation before the malformed expression runs.
+        bad = {
+            "matchLabels": {"app": "db"},
+            "matchExpressions": [{"key": "k", "operator": "Sideways"}],
+        }
+        with pytest.raises(ValueError, match="Sideways"):
+            validate_selector(bad)
+        with pytest.raises(ValueError, match="non-empty values"):
+            validate_selector(
+                {"matchExpressions": [{"key": "k", "operator": "In",
+                                       "values": []}]}
+            )
+        with pytest.raises(ValueError, match="must not carry values"):
+            validate_selector(
+                {"matchExpressions": [{"key": "k", "operator": "Exists",
+                                       "values": ["x"]}]}
+            )
+        # Well-formed selectors (including empty) pass.
+        validate_selector({})
+        validate_selector({
+            "matchLabels": {"a": "b"},
+            "matchExpressions": [
+                {"key": "k", "operator": "NotIn", "values": ["v"]},
+                {"key": "k2", "operator": "DoesNotExist"},
+            ],
+        })
+
     def test_blocked_evictions_scoped(self, pdb_fixture):
         blocked = blocked_evictions(
             pdb_fixture,
@@ -194,8 +237,17 @@ class TestStoreEvents:
         # selector faults must surface at ADMISSION, not at drain time
         {"minAvailable": 1, "selector": {"matchExpressions": [
             {"key": "app", "operator": "Wat"}]}},
+        # ...including when non-empty matchLabels would short-circuit a
+        # probe-pod evaluation before the malformed expression ever ran
+        # (ISSUE 1 satellite: store.py _validate_pdb)
+        {"minAvailable": 1, "selector": {
+            "matchLabels": {"app": "db"},
+            "matchExpressions": [{"key": "app", "operator": "Wat"}]}},
         {"minAvailable": 1, "selector": {"matchLabels": "notadict"}},
         {"minAvailable": "x%"},
+        # negative budgets (silently evictable-everything before)
+        {"minAvailable": -2},
+        {"maxUnavailable": "-10%"},
     ])
     def test_malformed_pdb_event_rejected(self, pdb_fixture, bad):
         from kubernetesclustercapacity_tpu.store import (
