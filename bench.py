@@ -621,7 +621,29 @@ def main() -> None:
             bench_frames=bench_frames[-3:],
             traceback_tail=lines[-2:],
         )
+        _maybe_dump_metrics()
         sys.exit(0)
+    _maybe_dump_metrics()
+
+
+def _maybe_dump_metrics() -> None:
+    """KCC_BENCH_METRICS_OUT=path: dump the process telemetry registry
+    (fused-path counters, kernel-latency histograms — whatever the run
+    touched) as JSON alongside the one-line timing artifact.  Strictly
+    best-effort: the metrics dump must never break the JSON-line
+    contract or void a measurement."""
+    path = os.environ.get("KCC_BENCH_METRICS_OUT")
+    if not path:
+        return
+    try:
+        from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(REGISTRY.snapshot(), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    except Exception as e:  # noqa: BLE001 - observability is not the bench
+        print(f"metrics dump failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 def _host_side_metrics(out: dict | None = None) -> dict:

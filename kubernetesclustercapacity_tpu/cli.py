@@ -106,6 +106,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "service's resilience counters (deadline sheds, "
                         "fused-path breaker, follower backoff) over its "
                         "info op")
+    p.add_argument("-metrics-port", type=int, default=0, dest="metrics_port",
+                   metavar="PORT",
+                   help="serve Prometheus /metrics (the process telemetry "
+                        "registry: fused-path health, kernel latency) on "
+                        "localhost:PORT for the run's duration")
+    p.add_argument("-trace-log", default=None, dest="trace_log",
+                   metavar="PATH",
+                   help="append a JSONL span for this invocation (op, "
+                        "duration, status) to PATH")
     return p
 
 
@@ -122,11 +131,6 @@ def _split_single_dash_eq(argv: list[str]) -> list[str]:
 
 
 def main(argv: list[str] | None = None) -> int:
-    from kubernetesclustercapacity_tpu.scenario import (
-        ScenarioError,
-        scenario_from_flags,
-    )
-
     args = build_parser().parse_args(
         _split_single_dash_eq(sys.argv[1:] if argv is None else list(argv))
     )
@@ -148,6 +152,63 @@ def main(argv: list[str] | None = None) -> int:
         )
         print(report)
         return code
+
+    # Telemetry surfaces (both opt-in, zero cost otherwise): a scrape
+    # endpoint over the process registry — the fused-path counters and
+    # kernel-latency histograms the sweep below feeds — and a JSONL
+    # span for the whole invocation.
+    metrics_server = None
+    trace_log = None
+    if args.metrics_port:
+        from kubernetesclustercapacity_tpu.telemetry.exposition import (
+            start_metrics_server,
+        )
+        from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+
+        try:
+            metrics_server = start_metrics_server(
+                REGISTRY, port=args.metrics_port
+            )
+        except OSError as e:
+            print(f"ERROR : cannot bind metrics port: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"metrics on http://{metrics_server.address[0]}:"
+            f"{metrics_server.address[1]}/metrics",
+            file=sys.stderr,
+        )
+    if args.trace_log:
+        from kubernetesclustercapacity_tpu.telemetry.tracing import (
+            Span,
+            TraceLog,
+        )
+
+        trace_log = TraceLog(args.trace_log)
+    try:
+        if trace_log is not None:
+            mode = (
+                "drain" if args.drain else
+                "grid" if args.grid > 0 else "fit"
+            )
+            with Span(f"kccap:{mode}", trace_log=trace_log) as span:
+                rc = _run_command(args)
+                span._extra["exit_code"] = rc
+                return rc
+        return _run_command(args)
+    finally:
+        if trace_log is not None:
+            trace_log.close()
+        if metrics_server is not None:
+            metrics_server.shutdown()
+
+
+def _run_command(args) -> int:
+    """Everything after flag parsing/telemetry setup: source resolution
+    and the fit/grid/drain dispatch (the pre-telemetry ``main`` body)."""
+    from kubernetesclustercapacity_tpu.scenario import (
+        ScenarioError,
+        scenario_from_flags,
+    )
 
     try:
         scenario = scenario_from_flags(

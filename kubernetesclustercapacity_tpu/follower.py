@@ -76,6 +76,7 @@ class ClusterFollower:
         idle_rewatch_backoff: float = 1.0,
         resync_failure_deadline: float = 900.0,
         backoff_seed: int | None = None,
+        registry=None,
     ) -> None:
         """``client_factory() -> KubeClient`` builds one client per stream
         (each watch occupies a connection); defaults to clients over the
@@ -101,7 +102,16 @@ class ClusterFollower:
         credentials, revoked RBAC, dead apiserver), the follower goes
         fatal and stops — the served snapshot is visibly stale at that
         point, and the module contract is that staleness is never silent.
+
+        ``registry`` is the :class:`~.telemetry.MetricsRegistry` holding
+        this follower's sync counters — the single source of truth
+        :meth:`stats` is a view over.  Default: a fresh private registry
+        (per-follower counts, as before); the serve path passes the
+        process registry so the scrape includes them.
         """
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            MetricsRegistry,
+        )
         if client_factory is None:
             # Validate the kubeconfig up front (fail fast on a bad file)...
             KubeConfig.load(kubeconfig, context=context)
@@ -138,12 +148,27 @@ class ClusterFollower:
         # sync loop, not just its final failure.
         self._backoff_rng = random.Random(backoff_seed)
         self._backoff_s: dict[str, float] = {}
+        # The sync counters live in the registry (stats() and the
+        # Prometheus scrape read the same cells); counter names keep the
+        # stats()-dict keys as their last path segment so the two views
+        # are visibly the same quantity.
+        self.registry = registry if registry is not None else MetricsRegistry()
         self._counters = {
-            "relists": 0,
-            "relist_failures": 0,
-            "watch_failures": 0,
-            "events_applied": 0,
+            name: self.registry.counter(
+                f"kccap_follower_{name}_total", help_
+            )
+            for name, help_ in (
+                ("relists", "Full list+repack cycles completed."),
+                ("relist_failures", "Relist attempts that failed."),
+                ("watch_failures", "Watch streams that failed/expired."),
+                ("events_applied", "Watch events applied to the store."),
+            )
         }
+        self._m_backoff = self.registry.gauge(
+            "kccap_follower_backoff_seconds",
+            "Current retry backoff per watch stream (0 = healthy).",
+            ("stream",),
+        )
         # Live clients (watch streams mid-read, in-flight relists), guarded
         # by _lock: stop() severs their sockets so a reader parked in
         # readline() unblocks now, not after the watch watchdog.
@@ -225,35 +250,43 @@ class ClusterFollower:
         watch failure totals, events applied, each stream's current
         backoff delay (0 when healthy), and the fatal state."""
         with self._lock:
-            return {
-                **self._counters,
-                "backoff_s": {
-                    p: round(d, 3)
-                    for p, d in self._backoff_s.items()
-                    if d > 0
-                },
-                "recent_errors": len(self._errors),
-                "pdb_unavailable": self._pdb_unavailable,
-                "fatal": self._fatal,
+            backoff = {
+                p: round(d, 3)
+                for p, d in self._backoff_s.items()
+                if d > 0
             }
+            recent, pdb_un, fatal = (
+                len(self._errors), self._pdb_unavailable, self._fatal
+            )
+        return {
+            # Views over the registry counters (same cells the scrape
+            # renders); the dict shape is pinned by test_telemetry.py.
+            **{name: int(c.value) for name, c in self._counters.items()},
+            "backoff_s": backoff,
+            "recent_errors": recent,
+            "pdb_unavailable": pdb_un,
+            "fatal": fatal,
+        }
 
     def _bump(self, counter: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[counter] += n
+        self._counters[counter].inc(n)
 
     def _next_backoff(self, path: str, prev: float | None) -> float:
         """One capped decorrelated-jitter backoff step, recorded so
-        :meth:`stats` shows the stream as backing off."""
+        :meth:`stats` (and the backoff gauge) show the stream as
+        backing off."""
         with self._lock:
             delay = decorrelated_jitter(
                 self._backoff_rng, self._idle_backoff, prev, _BACKOFF_CAP_S
             )
             self._backoff_s[path] = delay
+        self._m_backoff.set(delay, stream=path)
         return delay
 
     def _clear_backoff(self, path: str) -> None:
         with self._lock:
             self._backoff_s[path] = 0.0
+        self._m_backoff.set(0.0, stream=path)
 
     @property
     def fatal(self) -> str | None:
@@ -312,7 +345,7 @@ class ClusterFollower:
             self._store = store
             self._versions = versions
             self._epoch += 1
-            self._counters["relists"] += 1
+        self._counters["relists"].inc()
         self._synced.set()
         # The swapped-in store may hold changes that never flowed through
         # per-object events (that's what a relist is FOR) — consumers
@@ -486,7 +519,7 @@ class ClusterFollower:
             elif etype == "DELETED" and not exists:
                 return True
             store.apply_event({"type": etype, "kind": kind, "object": obj})
-            self._counters["events_applied"] += 1
+        self._counters["events_applied"].inc()
         if self.on_event is not None:
             self.on_event(kind, etype, obj)
         return True

@@ -41,6 +41,9 @@ from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
 from kubernetesclustercapacity_tpu.resilience import (
     CircuitBreaker as _CircuitBreaker,
 )
+from kubernetesclustercapacity_tpu.telemetry.metrics import (
+    enabled as _telemetry_enabled,
+)
 
 __all__ = [
     "fast_sweep_eligible",
@@ -63,6 +66,61 @@ __all__ = [
 # import` of the bare global would snapshot None forever.
 last_fast_path_error: str | None = None
 
+# Fused-path health metrics on the process-default registry, built
+# lazily (first dispatch) so merely importing this module registers
+# nothing.  All calls are host-side, OUTSIDE jitted code — the registry
+# never appears inside a kernel — and every call site checks
+# _telemetry_enabled() first, so KCCAP_TELEMETRY=0 leaves the hot sweep
+# path with zero registry calls.
+_MET: dict | None = None
+
+
+def _metrics() -> dict:
+    global _MET
+    if _MET is None:
+        from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+
+        _MET = {
+            "hits": REGISTRY.counter(
+                "kccap_fused_path_hits_total",
+                "Sweeps served by the fused Pallas kernel.",
+            ),
+            "misses": REGISTRY.counter(
+                "kccap_fused_path_misses_total",
+                "Sweeps that fell back to the exact int64 kernel, "
+                "by reason.",
+                ("reason",),
+            ),
+            "failures": REGISTRY.counter(
+                "kccap_fused_path_failures_total",
+                "In-dispatch fused-kernel failures (compile/legalization "
+                "or runtime), by disposition.",
+                ("disposition",),
+            ),
+            "latency": REGISTRY.histogram(
+                "kccap_sweep_kernel_seconds",
+                "Sweep kernel latency by kernel (host-timed around the "
+                "dispatch; the numpy materialization is the "
+                "block_until_ready sync point).",
+                ("kernel",),
+            ),
+            "transitions": REGISTRY.counter(
+                "kccap_breaker_transitions_total",
+                "Circuit-breaker state transitions, by breaker and "
+                "destination state.",
+                ("breaker", "to"),
+            ),
+        }
+    return _MET
+
+
+def _breaker_transition(old: str, new: str) -> None:
+    if _telemetry_enabled():
+        _metrics()["transitions"].labels(
+            breaker="pallas_fused_sweep", to=new
+        ).inc()
+
+
 # The real breaker (closed/open/half-open, resilience.CircuitBreaker)
 # replacing the old ad-hoc `_fast_path_broken` bool.  threshold=1: ONE
 # non-transient failure is already proof (the inputs were proven
@@ -73,6 +131,7 @@ _breaker = _CircuitBreaker(
     name="pallas_fused_sweep",
     failure_threshold=1,
     recovery_timeout_s=None,
+    on_state_change=_breaker_transition,
 )
 
 # Per-dispatch-thread record of the LAST sweep_auto call on this thread:
@@ -644,9 +703,12 @@ def sweep_auto(
     off-TPU (the real chip may register under a plugin platform name, so
     detect the one backend that NEEDS interpret mode).
     """
+    import time as _time
+
     global last_fast_path_error
     _dispatch_tls.attempted = False
     _dispatch_tls.error = None
+    tel = _metrics() if _telemetry_enabled() else None
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     if mode == "strict":
@@ -662,21 +724,29 @@ def sweep_auto(
         )
     else:
         kernel_mask = node_mask
-    if (
-        not force_exact
-        and fast_sweep_eligible(
-            alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
-            pods_count, cpu_reqs, mem_reqs,
-        )
+    # Decomposed (rather than one short-circuit conditional) so the
+    # telemetry miss counter can say WHY a sweep fell back — the
+    # breaker-vs-ineligible distinction is exactly what an operator
+    # needs when fused-path throughput drops.
+    fallback_reason = None
+    if force_exact:
+        fallback_reason = "forced_exact"
+    elif not fast_sweep_eligible(
+        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+        pods_count, cpu_reqs, mem_reqs,
+    ):
+        fallback_reason = "ineligible"
+    elif not _breaker.allow():
         # The breaker check comes LAST: an open breaker for an eligible
         # request is what counts as "degraded" (an ineligible request
         # was never going to take the fused path anyway).
-        and _breaker.allow()
-    ):
+        fallback_reason = "breaker_open"
+    if fallback_reason is None:
         _dispatch_tls.attempted = True
         use_rcp = rcp_division_eligible(
             alloc_cpu, alloc_mem, used_cpu, used_mem, cpu_reqs, mem_reqs
         )
+        t0 = _time.perf_counter()
         try:
             totals, sched = sweep_pallas(
                 alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
@@ -699,8 +769,14 @@ def sweep_auto(
             # _is_transient_failure for why unknown defaults to trip).
             last_fast_path_error = f"{type(e).__name__}: {e}"
             _dispatch_tls.error = last_fast_path_error
-            if not _is_transient_failure(e):
+            transient = _is_transient_failure(e)
+            if not transient:
                 _breaker.record_failure(last_fast_path_error)
+            if tel is not None:
+                tel["failures"].labels(
+                    disposition="transient" if transient else "breaker_trip"
+                ).inc()
+            fallback_reason = "kernel_error"
         else:
             # A fused success clears any prior transient failure: the
             # service must not report a stale fast_path_error alongside
@@ -709,13 +785,31 @@ def sweep_auto(
             last_fast_path_error = None
             _breaker.record_success()
             name = "pallas_i32_rcp_fused" if use_rcp else "pallas_i32_fused"
+            if tel is not None:
+                # sweep_pallas materialized numpy totals, so perf_counter
+                # here has already waited for the device (np.asarray IS
+                # the block_until_ready sync for this dispatch).
+                tel["latency"].labels(kernel=name).observe(
+                    _time.perf_counter() - t0
+                )
+                tel["hits"].inc()
             return totals, sched, name
+    if tel is not None:
+        tel["misses"].labels(reason=fallback_reason).inc()
+        t0 = _time.perf_counter()
     totals, sched = sweep_grid(
         alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
         healthy, cpu_reqs, mem_reqs, replicas, mode=mode,
         node_mask=node_mask,
     )
-    return np.asarray(totals), np.asarray(sched), "xla_int64"
+    totals, sched = np.asarray(totals), np.asarray(sched)
+    if tel is not None:
+        # np.asarray blocked on the device result above — same sync
+        # policy as the fused branch.
+        tel["latency"].labels(kernel="xla_int64").observe(
+            _time.perf_counter() - t0
+        )
+    return totals, sched, "xla_int64"
 
 
 def sweep_snapshot_auto(

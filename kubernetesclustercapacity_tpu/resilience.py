@@ -177,7 +177,11 @@ class CircuitBreaker:
       failure re-opens it (and restarts the cooldown).
 
     All transitions happen under one lock; ``clock`` is injectable for
-    tests (monotonic seconds).
+    tests (monotonic seconds).  ``on_state_change(old, new)`` is an
+    optional observer fired AFTER the lock is released on every state
+    transition (telemetry counters hang here — see
+    :mod:`.ops.pallas_fit`); a raising observer is swallowed, since a
+    metrics hook must never change breaker behavior.
     """
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -190,6 +194,7 @@ class CircuitBreaker:
         half_open_max_calls: int = 1,
         name: str = "",
         clock=time.monotonic,
+        on_state_change=None,
     ) -> None:
         if failure_threshold < 1:
             raise ValueError(
@@ -200,6 +205,7 @@ class CircuitBreaker:
                 f"half_open_max_calls must be >= 1, got {half_open_max_calls}"
             )
         self.name = name
+        self._on_state_change = on_state_change
         self._threshold = int(failure_threshold)
         self._recovery = (
             None if recovery_timeout_s is None else float(recovery_timeout_s)
@@ -222,6 +228,7 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """May a call proceed right now?  (Open→half-open transitions
         happen here, when the cooldown elapses.)"""
+        transition = None
         with self._lock:
             if self._state == self.CLOSED:
                 return True
@@ -233,15 +240,20 @@ class CircuitBreaker:
                 ):
                     self._state = self.HALF_OPEN
                     self._half_open_inflight = 0
+                    transition = (self.OPEN, self.HALF_OPEN)
                 else:
                     self._rejected += 1
                     return False
             # HALF_OPEN: admit a bounded number of probes.
             if self._half_open_inflight < self._half_open_max:
                 self._half_open_inflight += 1
-                return True
-            self._rejected += 1
-            return False
+                admitted = True
+            else:
+                self._rejected += 1
+                admitted = False
+        if transition is not None:
+            self._notify(*transition)
+        return admitted
 
     def call(self, fn, *args, **kwargs):
         """Run ``fn`` under the breaker: refuse with
@@ -263,6 +275,7 @@ class CircuitBreaker:
 
     # -- outcomes ----------------------------------------------------------
     def record_success(self) -> None:
+        transition = None
         with self._lock:
             self._successes += 1
             self._consecutive_failures = 0
@@ -272,13 +285,18 @@ class CircuitBreaker:
                 self._state = self.CLOSED
                 self._half_open_inflight = 0
                 self._opened_at = None
+                transition = (self.HALF_OPEN, self.CLOSED)
             elif self._state == self.OPEN:
                 # A success recorded while open (caller raced the trip):
                 # evidence the dependency works — close.
                 self._state = self.CLOSED
                 self._opened_at = None
+                transition = (self.OPEN, self.CLOSED)
+        if transition is not None:
+            self._notify(*transition)
 
     def record_failure(self, error: str | None = None) -> None:
+        transition = None
         with self._lock:
             self._failures += 1
             self._consecutive_failures += 1
@@ -286,12 +304,16 @@ class CircuitBreaker:
                 self._last_error = error
             if self._state == self.HALF_OPEN:
                 # The probe failed: straight back to open, cooldown restarts.
+                transition = (self.HALF_OPEN, self.OPEN)
                 self._trip_locked()
             elif (
                 self._state == self.CLOSED
                 and self._consecutive_failures >= self._threshold
             ):
+                transition = (self.CLOSED, self.OPEN)
                 self._trip_locked()
+        if transition is not None:
+            self._notify(*transition)
 
     def _trip_locked(self) -> None:
         self._state = self.OPEN
@@ -299,14 +321,27 @@ class CircuitBreaker:
         self._half_open_inflight = 0
         self._trips += 1
 
+    def _notify(self, old: str, new: str) -> None:
+        """Fire the transition observer — outside the lock (it may take
+        its own, e.g. a metrics registry's), never allowed to raise."""
+        if self._on_state_change is None:
+            return
+        try:
+            self._on_state_change(old, new)
+        except Exception:  # noqa: BLE001 - observers must not change behavior
+            pass
+
     def reset(self) -> None:
         """Force-close and clear the error (operator/tests re-arm)."""
         with self._lock:
+            old = self._state
             self._state = self.CLOSED
             self._consecutive_failures = 0
             self._opened_at = None
             self._half_open_inflight = 0
             self._last_error = None
+        if old != self.CLOSED:
+            self._notify(old, self.CLOSED)
 
     # -- observability -----------------------------------------------------
     @property

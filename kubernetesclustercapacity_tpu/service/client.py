@@ -57,8 +57,28 @@ class CapacityClient:
     request so the server sheds it once expired.  ``breaker`` (a
     :class:`~..resilience.CircuitBreaker`) fail-fasts every call while
     open.  ``stats`` counts retries/reconnects/deadline hits for the
-    ``info``-op style of observability.
+    ``info``-op style of observability — a dict view over the client's
+    ``registry`` counters (default: a fresh private
+    :class:`~..telemetry.MetricsRegistry`; pass a shared one to fold
+    client transport health into a process scrape).  ``trace`` adds a
+    fresh ``trace_id`` to every call (kept on :attr:`last_trace_id`) so
+    client attempts correlate with server-side trace-log spans; an
+    explicit ``trace_id=...`` per call always wins.
     """
+
+    #: stats() keys → (metric name, help) — one table so the dict view
+    #: and the registry can never drift.
+    _STAT_METRICS = (
+        ("calls", "kccap_client_calls_total", "Ops issued."),
+        ("retries", "kccap_client_retries_total",
+         "Transport-failure retries of idempotent ops."),
+        ("reconnects", "kccap_client_reconnects_total",
+         "Socket reconnects after teardown."),
+        ("deadline_expired", "kccap_client_deadline_expired_total",
+         "Calls abandoned because their budget ran out."),
+        ("breaker_rejected", "kccap_client_breaker_rejected_total",
+         "Calls refused fail-fast by an open circuit breaker."),
+    )
 
     def __init__(
         self,
@@ -71,7 +91,13 @@ class CapacityClient:
         retry: RetryPolicy | None = None,
         deadline_s: float | None = None,
         breaker: CircuitBreaker | None = None,
+        registry=None,
+        trace: bool = False,
     ) -> None:
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            MetricsRegistry,
+        )
+
         self._addr = (host, port)
         self._token = token
         self._connect_timeout = connect_timeout_s
@@ -80,14 +106,32 @@ class CapacityClient:
         self._deadline_s = deadline_s
         self._breaker = breaker
         self._sock: socket.socket | None = None
-        self.stats = {
-            "calls": 0,
-            "retries": 0,
-            "reconnects": 0,
-            "deadline_expired": 0,
-            "breaker_rejected": 0,
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._m = {
+            key: self.registry.counter(name, help_)
+            for key, name, help_ in self._STAT_METRICS
         }
+        if breaker is not None:
+            # Callback gauge: reads the breaker's CURRENT state at
+            # collection time (0 closed / 1 half-open / 2 open), so the
+            # scrape can never show a stale transition.
+            self.registry.gauge(
+                "kccap_client_breaker_state",
+                "Circuit breaker state (0=closed, 1=half_open, 2=open).",
+            ).labels().set_function(
+                lambda: {"closed": 0, "half_open": 1, "open": 2}.get(
+                    breaker.state, -1
+                )
+            )
+        self._trace = bool(trace)
+        self.last_trace_id: str | None = None
         self._connect()  # fail fast, like the original one-shot client
+
+    @property
+    def stats(self) -> dict:
+        """Transport-health counters (the historical dict shape), read
+        straight from the registry — one source of truth."""
+        return {key: int(c.value) for key, c in self._m.items()}
 
     def __enter__(self) -> "CapacityClient":
         return self
@@ -113,7 +157,7 @@ class CapacityClient:
 
     def _ensure_connected(self) -> socket.socket:
         if self._sock is None:
-            self.stats["reconnects"] += 1
+            self._m["reconnects"].inc()
             return self._connect()
         return self._sock
 
@@ -122,7 +166,7 @@ class CapacityClient:
         down (the stream may be desynced mid-frame) so the next attempt
         reconnects cleanly."""
         if deadline is not None and deadline.expired():
-            self.stats["deadline_expired"] += 1
+            self._m["deadline_expired"].inc()
             raise DeadlineExpired(
                 f"deadline expired before sending {msg.get('op')!r}"
             )
@@ -157,22 +201,32 @@ class CapacityClient:
         """Issue one op.  ``deadline_s`` overrides the client default
         for this call only.  Idempotent ops retry transport failures
         under the retry policy (within the deadline); ``update`` /
-        ``reload`` surface the first transport failure unchanged."""
+        ``reload`` surface the first transport failure unchanged.  A
+        ``trace_id=...`` param rides the envelope to the server's trace
+        log; with ``trace=True`` one is generated per call (every retry
+        attempt reuses it — the retries ARE the story a trace tells)."""
         if self._token is not None:
             params.setdefault("token", self._token)
+        if self._trace and "trace_id" not in params:
+            from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                new_trace_id,
+            )
+
+            params["trace_id"] = new_trace_id()
+        self.last_trace_id = params.get("trace_id", self.last_trace_id)
         budget = self._deadline_s if deadline_s is None else deadline_s
         deadline = Deadline.after(budget) if budget is not None else None
         msg = {"op": op, **params}
         if deadline is not None:
             msg["deadline"] = deadline.to_wire()
         retryable_op = op in IDEMPOTENT_OPS
-        self.stats["calls"] += 1
+        self._m["calls"].inc()
         prev_delay: float | None = None
         attempt = 0
         while True:
             attempt += 1
             if self._breaker is not None and not self._breaker.allow():
-                self.stats["breaker_rejected"] += 1
+                self._m["breaker_rejected"].inc()
                 raise CircuitOpenError(
                     f"circuit breaker open for {self._addr[0]}:"
                     f"{self._addr[1]}"
@@ -191,7 +245,7 @@ class CapacityClient:
                 if deadline is not None and deadline.expired() and transport:
                     # The budget, not the transport, is what gave out:
                     # surface that (retrying cannot un-spend it).
-                    self.stats["deadline_expired"] += 1
+                    self._m["deadline_expired"].inc()
                     raise DeadlineExpired(
                         f"deadline expired after {attempt} attempt(s) of "
                         f"{op!r}; last transport error: "
@@ -209,7 +263,7 @@ class CapacityClient:
                         prev_delay, max(deadline.remaining(), 0.0)
                     )
                 time.sleep(prev_delay)
-                self.stats["retries"] += 1
+                self._m["retries"].inc()
                 continue
             if self._breaker is not None:
                 self._breaker.record_success()

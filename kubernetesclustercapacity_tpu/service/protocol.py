@@ -6,7 +6,8 @@ Requests are JSON objects with an ``"op"`` field:
 op         params
 =========  ==========================================================
 ping       —
-info       —
+info       optional ``metrics`` (bool, default false) — include the
+           server's telemetry-registry snapshot under ``metrics``
 fit        ``cpuRequests``/``cpuLimits``/``memRequests``/``memLimits``/
            ``replicas`` (flag STRINGS, parsed server-side with exact
            reference semantics), optional ``output`` (``reference`` |
@@ -51,6 +52,11 @@ Any request may additionally carry:
     abandoned requests cannot occupy the device.  Same-host deployments
     share a clock exactly; cross-host callers should keep budgets above
     their NTP skew (the client's own budget check is authoritative).
+``trace_id``
+    opaque request-correlation string (conventionally 32 hex chars, see
+    :mod:`..telemetry.tracing`).  The server stamps it into its span
+    record when started with ``-trace-log``, so one client-side ID finds
+    the request in the server's trace log; it never changes the reply.
 
 Responses: ``{"ok": true, "result": ...}`` or ``{"ok": false, "error": "..."}``.
 Maximum frame size 64 MiB (a 10k-node JSON report is ~3 MB).

@@ -96,18 +96,65 @@ class CapacityServer:
         inflight_wait_s: float = 30.0,
         reload_roots: tuple[str, ...] = (),
         stats_source=None,
+        registry=None,
+        trace_log=None,
     ) -> None:
         """``stats_source`` is an optional zero-arg callable returning a
         JSON-able dict of upstream-feed health (e.g.
         :meth:`~..follower.ClusterFollower.stats`); it is surfaced under
         ``info.resilience.follower`` so clients can see retry/backoff/
-        degradation counters without a side channel."""
+        degradation counters without a side channel.
+
+        ``registry`` is the :class:`~..telemetry.MetricsRegistry` this
+        server instruments (default: a fresh private one, so co-hosted
+        servers/tests never share counters; pass the process registry —
+        as ``main`` does — to fold server metrics into one scrape).
+        ``trace_log`` (a path or :class:`~..telemetry.TraceLog`) records
+        one JSONL span per dispatched request, carrying the caller's
+        ``trace_id`` when the request rode one."""
         import os
+
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            MetricsRegistry,
+        )
+        from kubernetesclustercapacity_tpu.telemetry.tracing import TraceLog
 
         self.snapshot = snapshot
         self._stats_source = stats_source
-        self._deadline_shed = 0  # requests dropped already-expired
         self.fixture = fixture
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._trace_log = (
+            TraceLog(trace_log) if isinstance(trace_log, str) else trace_log
+        )
+        m = self.registry
+        self._m_requests = m.counter(
+            "kccap_requests_total", "Requests dispatched, by op.", ("op",)
+        )
+        self._m_errors = m.counter(
+            "kccap_request_errors_total",
+            "Requests that raised, by op and exception type.",
+            ("op", "error"),
+        )
+        self._m_latency = m.histogram(
+            "kccap_request_latency_seconds",
+            "End-to-end dispatch latency, by op.",
+            ("op",),
+        )
+        self._m_inflight = m.gauge(
+            "kccap_requests_in_flight",
+            "Requests currently being dispatched.",
+        )
+        self._m_slot_wait = m.gauge(
+            "kccap_compute_slot_waiting",
+            "Compute requests currently waiting for an inflight slot.",
+        )
+        # The resilience counter's single source of truth is the
+        # registry; info's resilience dict reads it back (one number,
+        # two surfaces).
+        self._m_shed = m.counter(
+            "kccap_deadline_shed_total",
+            "Requests shed because their deadline had already expired.",
+        )
         self._store = None  # lazy ClusterStore, built on first update op
         self._fixture_dirty = False  # fixture lags the store until needed
         self._fixture_source = None  # lazy fixture provider (follower feed)
@@ -159,15 +206,65 @@ class CapacityServer:
             return None
         deadline = Deadline.from_wire(wire)  # ValueError on junk
         if shed and deadline.expired():
-            with self._lock:
-                self._deadline_shed += 1
+            self._m_shed.inc()
             raise DeadlineExpired(
                 f"request deadline expired {-deadline.remaining():.3f}s "
                 "ago; shedding without dispatch"
             )
         return deadline
 
+    # Every op the dispatcher routes — the request-metrics label set.
+    # Anything else is labeled "unknown" so a misbehaving client cannot
+    # mint unbounded label cardinality through the op field.
+    _KNOWN_OPS = frozenset(
+        {
+            "ping", "info", "fit", "sweep", "sweep_multi", "place",
+            "drain", "topology_spread", "plan", "reload", "update",
+        }
+    )
+
     def dispatch(self, msg: dict) -> dict | str:
+        """Instrumented entry: count/time every request (by op), record
+        a trace span when a log is wired, then route.  The caller's
+        ``trace_id`` (an optional string riding the envelope like
+        ``deadline`` does) lands in the span record verbatim."""
+        import time as _time
+
+        op = msg.get("op")
+        op_label = op if op in self._KNOWN_OPS else "unknown"
+        trace_id = msg.get("trace_id")
+        if trace_id is not None and not isinstance(trace_id, str):
+            raise ValueError(
+                f"trace_id must be a string, got {trace_id!r}"
+            )
+        self._m_requests.labels(op=op_label).inc()
+        self._m_inflight.inc()
+        t0 = _time.perf_counter()
+        error: str | None = None
+        try:
+            return self._dispatch_routed(msg)
+        except Exception as e:
+            self._m_errors.labels(op=op_label, error=type(e).__name__).inc()
+            error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            dur = _time.perf_counter() - t0
+            self._m_inflight.dec()
+            self._m_latency.labels(op=op_label).observe(dur)
+            if self._trace_log is not None:
+                try:
+                    self._trace_log.record(
+                        ts=_time.time(),
+                        trace_id=trace_id or "",
+                        op=op_label,
+                        duration_ms=round(dur * 1e3, 3),
+                        status="error" if error else "ok",
+                        **({"error": error} if error else {}),
+                    )
+                except Exception:  # noqa: BLE001 - tracing must not fail ops
+                    pass
+
+    def _dispatch_routed(self, msg: dict) -> dict | str:
         op = msg.get("op")
         deadline = self._check_deadline(msg)
         if op == "ping":
@@ -193,7 +290,12 @@ class CapacityServer:
             wait_s = self._inflight_wait_s
             if deadline is not None:
                 wait_s = max(0.0, min(wait_s, deadline.remaining()))
-            if not self._inflight.acquire(timeout=wait_s):
+            self._m_slot_wait.inc()
+            try:
+                acquired = self._inflight.acquire(timeout=wait_s)
+            finally:
+                self._m_slot_wait.dec()
+            if not acquired:
                 raise RuntimeError(
                     f"server busy: {self._max_inflight} compute requests "
                     "already in flight"
@@ -270,13 +372,22 @@ class CapacityServer:
                 if self.snapshot is snap and self.fixture is None:
                     self.fixture = fixture  # cache until the next publish
         if op == "info":
-            return {
+            out = {
                 "nodes": snap.n_nodes,
                 "semantics": snap.semantics,
                 "healthy_nodes": int(np.sum(snap.healthy)),
                 "extended_resources": sorted(snap.extended),
                 "resilience": self._resilience_info(),
             }
+            # Opt-in (``info {metrics: true}``): the registry snapshot
+            # rides the info op so clients see the server's counters
+            # without scraping the (possibly un-exposed) metrics port.
+            # Opt-in because live latency tallies make the response
+            # non-deterministic, and info's default shape is pinned by
+            # clients that diff it (the chaos suite among them).
+            if msg.get("metrics"):
+                out["metrics"] = self.registry.snapshot()
+            return out
         if op == "fit":
             return self._op_fit(msg, snap, fixture, implicit_mask)
         if op == "sweep":
@@ -307,10 +418,11 @@ class CapacityServer:
             fast_path_breaker_snapshot,
         )
 
-        with self._lock:
-            shed = self._deadline_shed
         out = {
-            "deadline_shed": shed,
+            # A view over the registry counter (single source of truth;
+            # the wire shape predates the registry and is pinned by
+            # tests/test_telemetry.py).
+            "deadline_shed": int(self._m_shed.value),
             "fast_path_breaker": fast_path_breaker_snapshot(),
         }
         if self._stats_source is not None:
@@ -1021,6 +1133,14 @@ def main(argv=None) -> int:
                    dest="reload_roots", metavar="DIR",
                    help="restrict reload paths to this directory "
                         "(repeatable; default: unrestricted)")
+    p.add_argument("-metrics-port", type=int, default=0, dest="metrics_port",
+                   metavar="PORT",
+                   help="serve Prometheus /metrics and /healthz on this "
+                        "port (0 = disabled); binds the -host address")
+    p.add_argument("-trace-log", default=None, dest="trace_log",
+                   metavar="PATH",
+                   help="append one JSONL span per dispatched request "
+                        "(trace_id, op, duration, status) to PATH")
     args = p.parse_args(argv)
 
     import os as _os
@@ -1043,6 +1163,11 @@ def main(argv=None) -> int:
     extended = tuple(
         r.strip() for r in args.extended_resources.split(",") if r.strip()
     )
+    # One process registry feeds every layer — follower sync counters,
+    # server request metrics, the fused-path breaker (module-global on
+    # the same default registry) — so the scrape is the whole story.
+    from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+
     follower = None
     try:
         if args.follow:
@@ -1060,6 +1185,7 @@ def main(argv=None) -> int:
                 args.kubeconfig,
                 semantics=args.semantics or "reference",
                 extended_resources=extended,
+                registry=REGISTRY,
             ).start(watch=False)
             snap, fixture = follower.snapshot(), follower.fixture_view()
         elif args.snapshot:
@@ -1078,7 +1204,39 @@ def main(argv=None) -> int:
         # -follow: the follower's retry/backoff/degradation counters ride
         # the info op, so a client can see a struggling sync loop.
         stats_source=follower.stats if follower is not None else None,
+        registry=REGISTRY,
+        trace_log=args.trace_log,
     )
+    metrics_server = None
+    if args.metrics_port:
+        from kubernetesclustercapacity_tpu.telemetry.exposition import (
+            start_metrics_server,
+        )
+
+        try:
+            metrics_server = start_metrics_server(
+                REGISTRY,
+                host=args.host,
+                port=args.metrics_port,
+                # /healthz goes 503 the moment the feed is known-dead:
+                # a frozen snapshot must be visible to the scraper too.
+                healthy=(
+                    (lambda: follower.fatal is None)
+                    if follower is not None
+                    else None
+                ),
+            )
+        except OSError as e:
+            print(f"ERROR : cannot bind metrics port: {e}", file=sys.stderr)
+            if follower is not None:
+                follower.stop()
+            server.shutdown()
+            return 1
+        print(
+            f"metrics on http://{metrics_server.address[0]}:"
+            f"{metrics_server.address[1]}/metrics",
+            file=sys.stderr,
+        )
     coalescer = None
     if follower is not None:
         # Watch events are applied to the store per-row (O(1)); snapshot
@@ -1148,6 +1306,8 @@ def main(argv=None) -> int:
             follower.stop()
         if coalescer is not None:
             coalescer.stop()
+        if metrics_server is not None:
+            metrics_server.shutdown()
         server.shutdown()
     return 0
 

@@ -1,0 +1,43 @@
+"""Telemetry: metrics registry, Prometheus exposition, request tracing.
+
+The measurement substrate for the production-scale service (ROADMAP
+north-star): every layer of the capacity stack — server dispatch, client
+transport, follower sync loop, fused-kernel path — records counters,
+gauges and latency histograms into a :class:`~.metrics.MetricsRegistry`,
+and that one registry is rendered three ways:
+
+* :mod:`.exposition` — Prometheus text format v0.0.4 over a tiny
+  background-thread HTTP endpoint (``/metrics`` + ``/healthz``), the
+  scrape surface (``kccap-server -metrics-port``);
+* ``registry.snapshot()`` — a JSON-able dict riding the service's
+  ``info`` op and ``bench.py``'s artifact;
+* :mod:`.tracing` — per-request trace/span IDs threaded through the
+  service protocol envelope (the same way ``deadline`` already is), with
+  span timings feeding registry histograms and an optional JSONL log.
+
+Hot-path rule: no registry call ever executes inside jitted code.  All
+instrumentation lives host-side around kernel dispatch, and the
+dispatch-side hooks honor :func:`~.metrics.enabled` so telemetry can be
+switched off entirely (``KCCAP_TELEMETRY=0``).
+"""
+
+from kubernetesclustercapacity_tpu.telemetry.metrics import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS_S,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    enabled,
+)
+from kubernetesclustercapacity_tpu.telemetry.exposition import (  # noqa: F401
+    MetricsServer,
+    render_text,
+    start_metrics_server,
+)
+from kubernetesclustercapacity_tpu.telemetry.tracing import (  # noqa: F401
+    Span,
+    TraceLog,
+    new_span_id,
+    new_trace_id,
+)

@@ -1,0 +1,176 @@
+"""Prometheus exposition: text format v0.0.4 + a tiny scrape endpoint.
+
+:func:`render_text` turns a :class:`~.metrics.MetricsRegistry` into the
+text format every Prometheus-compatible scraper parses — ``# HELP`` /
+``# TYPE`` headers, samples with escaped label values in declaration
+order, histogram ``_bucket{le=...}`` series cumulative with the
+``+Inf`` bucket equal to ``_count``.
+
+:class:`MetricsServer` serves that rendering over HTTP from a
+background thread (stdlib ``http.server`` — no new dependencies):
+
+* ``GET /metrics``  — the scrape, ``text/plain; version=0.0.4``;
+* ``GET /healthz``  — liveness JSON; an embedder-supplied ``healthy``
+  callable flips it to 503 (e.g. a dead follower behind a serving
+  snapshot must be *visible* to the load balancer, the same
+  never-silently-stale rule the follower itself enforces).
+
+The endpoint is observability-only and carries no auth: bind it to
+localhost (the default) or scrape-net, never the request port.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from kubernetesclustercapacity_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    _format_value,
+    _HistogramChild,
+    escape_label_value,
+)
+
+__all__ = ["render_text", "MetricsServer", "start_metrics_server"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _label_block(labelnames, key, extra: str = "") -> str:
+    """``{a="x",b="y"}`` in declaration order; ``""`` when empty."""
+    parts = [
+        f'{ln}="{escape_label_value(v)}"'
+        for ln, v in zip(labelnames, key)
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_text(registry: MetricsRegistry) -> str:
+    """The registry as Prometheus text format v0.0.4 (one scrape body)."""
+    lines: list[str] = []
+    for fam in registry.collect():
+        lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        lines.append(f"# TYPE {fam.name} {fam.type}")
+        for key, child in fam._items():
+            if isinstance(child, _HistogramChild):
+                snap = child.snapshot()
+                for le, cum in snap["buckets"].items():
+                    le_pair = 'le="%s"' % le
+                    lines.append(
+                        f"{fam.name}_bucket"
+                        f"{_label_block(fam.labelnames, key, le_pair)}"
+                        f" {_format_value(cum)}"
+                    )
+                lines.append(
+                    f"{fam.name}_sum{_label_block(fam.labelnames, key)}"
+                    f" {_format_value(snap['sum'])}"
+                )
+                lines.append(
+                    f"{fam.name}_count{_label_block(fam.labelnames, key)}"
+                    f" {_format_value(snap['count'])}"
+                )
+            else:
+                lines.append(
+                    f"{fam.name}{_label_block(fam.labelnames, key)}"
+                    f" {_format_value(child.value)}"
+                )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class MetricsServer:
+    """Background-thread HTTP endpoint for ``/metrics`` + ``/healthz``.
+
+    ``healthy`` is an optional zero-arg callable returning truthy when
+    the embedding process considers itself live; a raise counts as
+    unhealthy (a health check that can crash the server it reports on
+    would be worse than no check).
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        healthy=None,
+    ) -> None:
+        import http.server
+
+        self.registry = registry
+        self._healthy = healthy
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - stdlib contract
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = render_text(outer.registry).encode()
+                    self._reply(200, CONTENT_TYPE, body)
+                elif path == "/healthz":
+                    ok = True
+                    if outer._healthy is not None:
+                        try:
+                            ok = bool(outer._healthy())
+                        except Exception:  # noqa: BLE001 - check != crash
+                            ok = False
+                    body = json.dumps({"ok": ok}).encode()
+                    self._reply(200 if ok else 503, "application/json", body)
+                else:
+                    self._reply(404, "text/plain", b"not found\n")
+
+            def _reply(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # scrapes are not news
+                pass
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._http = _Server((host, port), _Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._http.server_address  # type: ignore[return-value]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+
+
+def start_metrics_server(
+    registry: MetricsRegistry,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    healthy=None,
+) -> MetricsServer:
+    """Construct AND start a :class:`MetricsServer` (the one-liner every
+    embedder wants; ``port=0`` picks a free port — read ``.address``)."""
+    return MetricsServer(
+        registry, host=host, port=port, healthy=healthy
+    ).start()

@@ -182,6 +182,29 @@ def doctor_report(
 
     check("fused fast path", _fast)
 
+    def _telemetry():
+        # The process registry + one exposition render: proves the
+        # scrape surface works in THIS environment (and how big it is)
+        # without binding a port.
+        from kubernetesclustercapacity_tpu.telemetry.exposition import (
+            render_text,
+        )
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            REGISTRY,
+            enabled,
+        )
+
+        if not enabled():
+            return "disabled (KCCAP_TELEMETRY=0) — registry calls off"
+        families = REGISTRY.collect()
+        text = render_text(REGISTRY)
+        return (
+            f"ok: {len(families)} metric families, exposition renders "
+            f"{len(text)} bytes"
+        )
+
+    check("telemetry", _telemetry)
+
     if service_addr is not None:
         # A LIVE service's resilience counters (deadline sheds, breaker
         # state, follower retry/backoff) — the doctor probes the same
@@ -200,7 +223,7 @@ def doctor_report(
                 retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
                 deadline_s=5.0,
             ) as c:
-                info = c.info()
+                info = c.info(metrics=True)
             r = info.get("resilience", {})
             fp = r.get("fast_path_breaker", {})
             parts = [
@@ -208,6 +231,13 @@ def doctor_report(
                 f"deadline_shed={r.get('deadline_shed')}",
                 f"fast_path={fp.get('state')}",
             ]
+            reqs = (
+                info.get("metrics", {})
+                .get("kccap_requests_total", {})
+                .get("values", {})
+            )
+            if reqs:
+                parts.append(f"requests={int(sum(reqs.values()))}")
             follower = r.get("follower")
             if follower:
                 parts.append(

@@ -85,9 +85,21 @@ class PhaseTimer:
 
 @dataclass(frozen=True)
 class LatencyStats:
-    """Latency distribution of repeated runs, in milliseconds."""
+    """Latency distribution of repeated runs, in milliseconds.
+
+    Rejects empty samples at construction: every accessor percentiles
+    over ``samples_ms``, and ``np.percentile([])`` raises an opaque
+    IndexError long after the real mistake (a zero-rep measurement).
+    """
 
     samples_ms: tuple
+
+    def __post_init__(self) -> None:
+        if not self.samples_ms:
+            raise ValueError(
+                "LatencyStats needs at least one sample; an empty "
+                "samples_ms usually means the measurement ran 0 reps"
+            )
 
     @property
     def p50(self) -> float:
@@ -117,7 +129,16 @@ class LatencyStats:
 
 
 def measure_latency(fn, *, reps: int = 30, warmup: int = 1) -> LatencyStats:
-    """Time ``fn()`` (which must block on its own result) ``reps`` times."""
+    """Time ``fn()`` (which must block on its own result) ``reps`` times.
+
+    ``reps`` must be >= 1 and ``warmup`` >= 0 — validated here, because
+    ``reps=0`` would otherwise produce an empty sample set that only
+    explodes later, inside a percentile deep in reporting code.
+    """
+    if reps < 1:
+        raise ValueError(f"reps must be >= 1, got {reps}")
+    if warmup < 0:
+        raise ValueError(f"warmup must be >= 0, got {warmup}")
     for _ in range(warmup):
         fn()
     samples = []

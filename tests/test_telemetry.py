@@ -1,0 +1,590 @@
+"""Telemetry subsystem: registry semantics, exposition correctness,
+tracing, and the instrumented service/follower/fused-path surfaces.
+
+The exposition tests are the contract the smoke test leans on: if label
+escaping, label ordering and histogram cumulativity hold here, a scrape
+parsed by those same rules is trustworthy end-to-end.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from kubernetesclustercapacity_tpu.telemetry.exposition import (
+    render_text,
+    start_metrics_server,
+)
+from kubernetesclustercapacity_tpu.telemetry.metrics import (
+    DEFAULT_LATENCY_BUCKETS_S,
+    MetricsError,
+    MetricsRegistry,
+)
+from kubernetesclustercapacity_tpu.telemetry.tracing import (
+    Span,
+    TraceLog,
+    new_span_id,
+    new_trace_id,
+)
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "kind-3node.json"
+)
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse text-format v0.0.4 back into {name{labels}: float} — the
+    test-side half of the exposition contract."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_labels, _, value = line.rpartition(" ")
+        samples[name_labels] = float(value.replace("+Inf", "inf"))
+    return samples
+
+
+class TestRegistry:
+    def test_counter_inc_and_value(self):
+        r = MetricsRegistry()
+        c = r.counter("c_total", "help", ("op",))
+        c.labels(op="fit").inc()
+        c.inc(2, op="fit")
+        assert c.labels(op="fit").value == 3
+
+    def test_counter_rejects_negative(self):
+        r = MetricsRegistry()
+        with pytest.raises(MetricsError):
+            r.counter("c_total").inc(-1)
+
+    def test_family_idempotent_and_conflict_raises(self):
+        r = MetricsRegistry()
+        a = r.counter("x_total", "h", ("op",))
+        assert r.counter("x_total", "h", ("op",)) is a
+        with pytest.raises(MetricsError):
+            r.gauge("x_total")  # type conflict
+        with pytest.raises(MetricsError):
+            r.counter("x_total", "h", ("other",))  # labelnames conflict
+
+    def test_label_set_must_match_declaration(self):
+        r = MetricsRegistry()
+        c = r.counter("y_total", "h", ("op",))
+        with pytest.raises(MetricsError):
+            c.labels(op="a", extra="b")
+        with pytest.raises(MetricsError):
+            c.labels()
+
+    def test_invalid_names_raise(self):
+        r = MetricsRegistry()
+        with pytest.raises(MetricsError):
+            r.counter("0bad")
+        with pytest.raises(MetricsError):
+            r.counter("ok_total", "h", ("0bad",))
+        with pytest.raises(MetricsError):
+            r.counter("ok_total", "h", ("__reserved",))
+
+    def test_gauge_set_inc_dec_and_callback(self):
+        r = MetricsRegistry()
+        g = r.gauge("g")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.value == 4
+        g.labels().set_function(lambda: 42)
+        assert g.value == 42
+
+    def test_concurrent_counter_is_exact(self):
+        # The headline thread-safety claim: N threads hammering one
+        # child must land on exactly N * per-thread increments.
+        r = MetricsRegistry()
+        c = r.counter("hammer_total")
+        child = c.labels()
+        threads, per_thread = 16, 2000
+
+        def work():
+            for _ in range(per_thread):
+                child.inc()
+
+        ts = [threading.Thread(target=work) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert child.value == threads * per_thread
+
+    def test_snapshot_shape(self):
+        r = MetricsRegistry()
+        r.counter("a_total", "h", ("op",)).inc(op="fit")
+        r.histogram("h_seconds", "h", buckets=(1.0, 2.0)).observe(1.5)
+        snap = r.snapshot()
+        assert snap["a_total"]["type"] == "counter"
+        assert snap["a_total"]["values"]['op="fit"'] == 1
+        h = snap["h_seconds"]["values"][""]
+        assert h["count"] == 1 and h["buckets"]["+Inf"] == 1
+        json.dumps(snap)  # must be JSON-able as-is (info op / bench)
+
+
+class TestHistogram:
+    def test_buckets_cumulative_and_inf_equals_count(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat", "h", buckets=(0.001, 0.01, 0.1))
+        for v in (0.0005, 0.005, 0.05, 5.0):
+            h.observe(v)
+        snap = h.labels().snapshot()
+        assert snap["buckets"] == {
+            "0.001": 1, "0.01": 2, "0.1": 3, "+Inf": 4
+        }
+        assert snap["count"] == 4
+        assert snap["sum"] == pytest.approx(5.0555)
+        # Cumulativity invariant: monotonically non-decreasing.
+        vals = list(snap["buckets"].values())
+        assert vals == sorted(vals)
+
+    def test_boundary_is_le_not_lt(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat", "h", buckets=(1.0,))
+        h.observe(1.0)
+        assert h.labels().snapshot()["buckets"]["1"] == 1
+
+    def test_default_buckets_are_sorted_and_finite(self):
+        assert list(DEFAULT_LATENCY_BUCKETS_S) == sorted(
+            DEFAULT_LATENCY_BUCKETS_S
+        )
+        assert all(b > 0 and b != float("inf")
+                   for b in DEFAULT_LATENCY_BUCKETS_S)
+
+    def test_reserved_le_label_rejected(self):
+        with pytest.raises(MetricsError):
+            MetricsRegistry().histogram("lat", "h", ("le",))
+
+
+class TestExposition:
+    def test_help_type_and_sample_lines(self):
+        r = MetricsRegistry()
+        r.counter("req_total", "Requests seen.", ("op",)).inc(op="fit")
+        text = render_text(r)
+        assert "# HELP req_total Requests seen." in text
+        assert "# TYPE req_total counter" in text
+        assert 'req_total{op="fit"} 1' in text.splitlines()
+
+    def test_label_value_escaping(self):
+        r = MetricsRegistry()
+        nasty = 'a"b\\c\nd'
+        r.counter("esc_total", "h", ("v",)).inc(v=nasty)
+        text = render_text(r)
+        assert 'esc_total{v="a\\"b\\\\c\\nd"} 1' in text.splitlines()
+        # And it round-trips through the shared parser.
+        assert parse_exposition(text)['esc_total{v="a\\"b\\\\c\\nd"}'] == 1
+
+    def test_label_order_is_declaration_order_not_kwarg_order(self):
+        r = MetricsRegistry()
+        c = r.counter("ord_total", "h", ("zeta", "alpha"))
+        c.inc(alpha="1", zeta="2")  # kwargs reversed on purpose
+        c.labels(zeta="2", alpha="1").inc()
+        text = render_text(r)
+        assert 'ord_total{zeta="2",alpha="1"} 2' in text.splitlines()
+        # ONE child, one line — kwarg order must not mint a second series.
+        assert text.count("ord_total{") == 1
+
+    def test_histogram_exposition_series(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", "h", ("op",), buckets=(0.5, 1.5))
+        h.observe(1.0, op="fit")
+        h.observe(9.0, op="fit")
+        samples = parse_exposition(render_text(r))
+        assert samples['lat_seconds_bucket{op="fit",le="0.5"}'] == 0
+        assert samples['lat_seconds_bucket{op="fit",le="1.5"}'] == 1
+        assert samples['lat_seconds_bucket{op="fit",le="+Inf"}'] == 2
+        assert samples['lat_seconds_count{op="fit"}'] == 2
+        assert samples['lat_seconds_sum{op="fit"}'] == 10.0
+
+    def test_help_escaping(self):
+        r = MetricsRegistry()
+        r.counter("hh_total", "line1\nline2 \\ backslash")
+        assert "# HELP hh_total line1\\nline2 \\\\ backslash" in render_text(r)
+
+
+class TestMetricsServer:
+    def test_scrape_healthz_and_404(self):
+        r = MetricsRegistry()
+        r.counter("up_total").inc()
+        srv = start_metrics_server(r)
+        try:
+            base = srv.url
+            resp = urllib.request.urlopen(base + "/metrics")
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4"
+            )
+            body = resp.read().decode()
+            assert parse_exposition(body)["up_total"] == 1
+            health = json.loads(
+                urllib.request.urlopen(base + "/healthz").read()
+            )
+            assert health == {"ok": True}
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/nope")
+            assert ei.value.code == 404
+        finally:
+            srv.shutdown()
+
+    def test_unhealthy_and_raising_check_go_503(self):
+        for check in (lambda: False, lambda: 1 / 0):
+            srv = start_metrics_server(MetricsRegistry(), healthy=check)
+            try:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(srv.url + "/healthz")
+                assert ei.value.code == 503
+                assert json.loads(ei.value.read()) == {"ok": False}
+            finally:
+                srv.shutdown()
+
+
+class TestTracing:
+    def test_ids_are_hex_of_expected_width(self):
+        assert len(new_trace_id()) == 32 and len(new_span_id()) == 16
+        int(new_trace_id(), 16)
+        assert new_trace_id() != new_trace_id()
+
+    def test_span_feeds_histogram_and_log(self, tmp_path):
+        r = MetricsRegistry()
+        h = r.histogram("span_seconds", "h", ("op",))
+        log = TraceLog(str(tmp_path / "t.jsonl"))
+        with Span(
+            "sweep", trace_id="ab" * 16, histogram=h.labels(op="sweep"),
+            trace_log=log, extra={"scenarios": 64},
+        ) as span:
+            pass
+        log.close()
+        assert h.labels(op="sweep").count == 1
+        (rec,) = [
+            json.loads(ln)
+            for ln in open(tmp_path / "t.jsonl", encoding="utf-8")
+        ]
+        assert rec["trace_id"] == "ab" * 16
+        assert rec["span_id"] == span.span_id
+        assert rec["op"] == "sweep" and rec["status"] == "ok"
+        assert rec["scenarios"] == 64 and rec["duration_ms"] >= 0
+
+    def test_span_records_error_and_propagates(self, tmp_path):
+        log = TraceLog(str(tmp_path / "t.jsonl"))
+        with pytest.raises(ValueError, match="boom"):
+            with Span("fit", trace_log=log):
+                raise ValueError("boom")
+        log.close()
+        (rec,) = [
+            json.loads(ln)
+            for ln in open(tmp_path / "t.jsonl", encoding="utf-8")
+        ]
+        assert rec["status"] == "error"
+        assert rec["error"] == "ValueError: boom"
+
+    def test_trace_log_concurrent_lines_never_interleave(self, tmp_path):
+        log = TraceLog(str(tmp_path / "t.jsonl"))
+
+        def work(i):
+            for j in range(50):
+                log.record(thread=i, seq=j, pad="x" * 256)
+
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        log.close()
+        lines = open(tmp_path / "t.jsonl", encoding="utf-8").readlines()
+        assert len(lines) == 8 * 50
+        for ln in lines:
+            json.loads(ln)  # every line is a complete JSON record
+
+
+class TestTimingValidation:
+    """Satellite: measure_latency/LatencyStats argument validation."""
+
+    def test_measure_latency_rejects_zero_reps(self):
+        from kubernetesclustercapacity_tpu.utils.timing import (
+            measure_latency,
+        )
+
+        with pytest.raises(ValueError, match="reps"):
+            measure_latency(lambda: None, reps=0)
+        with pytest.raises(ValueError, match="warmup"):
+            measure_latency(lambda: None, reps=1, warmup=-1)
+
+    def test_latency_stats_rejects_empty_samples(self):
+        from kubernetesclustercapacity_tpu.utils.timing import LatencyStats
+
+        with pytest.raises(ValueError, match="at least one sample"):
+            LatencyStats(samples_ms=())
+        # The valid path still works.
+        assert LatencyStats(samples_ms=(1.0, 3.0)).p50 == 2.0
+
+
+@pytest.fixture()
+def server():
+    from kubernetesclustercapacity_tpu.fixtures import load_fixture
+    from kubernetesclustercapacity_tpu.service import CapacityServer
+    from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+
+    fixture = load_fixture(FIXTURE)
+    snap = snapshot_from_fixture(fixture, semantics="reference")
+    srv = CapacityServer(snap, port=0, fixture=fixture)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+class TestServerInstrumentation:
+    def test_dispatch_counts_and_latency(self, server):
+        server.dispatch({"op": "ping"})
+        server.dispatch({"op": "ping"})
+        server.dispatch({"op": "info"})
+        snap = server.registry.snapshot()
+        reqs = snap["kccap_requests_total"]["values"]
+        assert reqs['op="ping"'] == 2 and reqs['op="info"'] == 1
+        lat = snap["kccap_request_latency_seconds"]["values"]['op="ping"']
+        assert lat["count"] == 2
+        assert snap["kccap_requests_in_flight"]["values"][""] == 0
+
+    def test_unknown_op_is_bounded_label_and_counted_error(self, server):
+        for bogus in ("nope", "x" * 500, None):
+            with pytest.raises(ValueError):
+                server.dispatch({"op": bogus})
+        snap = server.registry.snapshot()
+        assert snap["kccap_requests_total"]["values"]['op="unknown"'] == 3
+        errs = snap["kccap_request_errors_total"]["values"]
+        assert errs['op="unknown",error="ValueError"'] == 3
+
+    def test_deadline_shed_counter_is_the_info_view(self, server):
+        from kubernetesclustercapacity_tpu.resilience import DeadlineExpired
+
+        with pytest.raises(DeadlineExpired):
+            server.dispatch({"op": "fit", "deadline": 1.0})  # long expired
+        snap = server.registry.snapshot()
+        assert snap["kccap_deadline_shed_total"]["values"][""] == 1
+        info = server.dispatch({"op": "info"})
+        assert info["resilience"]["deadline_shed"] == 1
+
+    def test_info_metrics_opt_in(self, server):
+        assert "metrics" not in server.dispatch({"op": "info"})
+        info = server.dispatch({"op": "info", "metrics": True})
+        assert "kccap_requests_total" in info["metrics"]
+        json.dumps(info)  # the wire must be able to carry it
+
+    def test_bad_trace_id_rejected(self, server):
+        with pytest.raises(ValueError, match="trace_id"):
+            server.dispatch({"op": "ping", "trace_id": 7})
+
+    def test_resilience_info_shape_pinned(self, server):
+        """Regression (satellite): migrating counters onto the registry
+        must not change the info op's resilience dict shape."""
+        r = server.dispatch({"op": "info"})["resilience"]
+        assert set(r) == {"deadline_shed", "fast_path_breaker"}
+        assert isinstance(r["deadline_shed"], int)
+        assert set(r["fast_path_breaker"]) == {
+            "state", "consecutive_failures", "failures", "successes",
+            "trips", "rejected", "last_error",
+        }
+
+
+class TestClientInstrumentation:
+    def test_stats_is_registry_view(self, server):
+        from kubernetesclustercapacity_tpu.service import CapacityClient
+
+        with CapacityClient(*server.address) as c:
+            c.ping()
+            c.info()
+            assert c.stats["calls"] == 2
+            assert c.registry.snapshot()[
+                "kccap_client_calls_total"
+            ]["values"][""] == 2
+            # The historical dict shape is pinned.
+            assert set(c.stats) == {
+                "calls", "retries", "reconnects", "deadline_expired",
+                "breaker_rejected",
+            }
+
+    def test_breaker_state_gauge(self, server):
+        from kubernetesclustercapacity_tpu.resilience import CircuitBreaker
+        from kubernetesclustercapacity_tpu.service import CapacityClient
+
+        breaker = CircuitBreaker(failure_threshold=1)
+        with CapacityClient(*server.address, breaker=breaker) as c:
+            c.ping()
+            snap = c.registry.snapshot()
+            assert snap["kccap_client_breaker_state"]["values"][""] == 0
+            breaker.record_failure("synthetic")
+            snap = c.registry.snapshot()
+            assert snap["kccap_client_breaker_state"]["values"][""] == 2
+
+    def test_auto_trace_generates_ids(self, server):
+        from kubernetesclustercapacity_tpu.service import CapacityClient
+
+        with CapacityClient(*server.address, trace=True) as c:
+            c.ping()
+            first = c.last_trace_id
+            c.ping()
+            assert first and c.last_trace_id and first != c.last_trace_id
+
+
+class TestFollowerStatsView:
+    def test_stats_shape_pinned_and_registry_backed(self):
+        """Regression (satellite): stats() keeps its exact dict shape
+        while the counters live in the registry."""
+        from kubernetesclustercapacity_tpu.follower import ClusterFollower
+
+        f = ClusterFollower(client_factory=lambda: None)
+        stats = f.stats()
+        assert stats == {
+            "relists": 0,
+            "relist_failures": 0,
+            "watch_failures": 0,
+            "events_applied": 0,
+            "backoff_s": {},
+            "recent_errors": 0,
+            "pdb_unavailable": False,
+            "fatal": None,
+        }
+        f._bump("watch_failures")
+        f._bump("events_applied", 3)
+        assert f.stats()["watch_failures"] == 1
+        assert f.stats()["events_applied"] == 3
+        snap = f.registry.snapshot()
+        assert snap["kccap_follower_watch_failures_total"]["values"][""] == 1
+        assert snap["kccap_follower_events_applied_total"]["values"][""] == 3
+
+    def test_backoff_gauge_tracks_stats_backoff(self):
+        from kubernetesclustercapacity_tpu.follower import ClusterFollower
+
+        f = ClusterFollower(client_factory=lambda: None, backoff_seed=7)
+        delay = f._next_backoff("/api/v1/nodes", None)
+        assert f.stats()["backoff_s"]["/api/v1/nodes"] == round(delay, 3)
+        snap = f.registry.snapshot()
+        g = snap["kccap_follower_backoff_seconds"]["values"]
+        assert g['stream="/api/v1/nodes"'] == delay
+        f._clear_backoff("/api/v1/nodes")
+        assert f.stats()["backoff_s"] == {}
+        snap = f.registry.snapshot()
+        assert snap["kccap_follower_backoff_seconds"]["values"][
+            'stream="/api/v1/nodes"'
+        ] == 0
+
+
+class TestBreakerTransitions:
+    def test_observer_sees_full_cycle(self):
+        from kubernetesclustercapacity_tpu.resilience import CircuitBreaker
+
+        seen = []
+        clock = [0.0]
+        b = CircuitBreaker(
+            failure_threshold=2,
+            recovery_timeout_s=10.0,
+            clock=lambda: clock[0],
+            on_state_change=lambda old, new: seen.append((old, new)),
+        )
+        b.record_failure("x")
+        b.record_failure("x")  # trips
+        clock[0] = 11.0
+        assert b.allow()  # open -> half_open, probe admitted
+        b.record_success()  # half_open -> closed
+        assert seen == [
+            ("closed", "open"),
+            ("open", "half_open"),
+            ("half_open", "closed"),
+        ]
+
+    def test_raising_observer_is_swallowed(self):
+        from kubernetesclustercapacity_tpu.resilience import CircuitBreaker
+
+        b = CircuitBreaker(
+            failure_threshold=1,
+            on_state_change=lambda *_: 1 / 0,
+        )
+        b.record_failure("x")  # must not raise
+        assert b.state == "open"
+
+
+class TestFusedPathMetrics:
+    def test_fallback_reasons_counted(self):
+        import numpy as np
+
+        from kubernetesclustercapacity_tpu.ops import pallas_fit as pf
+
+        tel = pf._metrics()
+        misses = tel["misses"]
+
+        def miss_count(reason):
+            return misses.labels(reason=reason).value
+
+        args = (
+            np.array([4000]), np.array([8 << 30]), np.array([110]),
+            np.array([0]), np.array([0]), np.array([0]),
+            np.array([True]),
+        )
+        before = miss_count("forced_exact")
+        pf.sweep_auto(
+            *args, np.array([100]), np.array([1 << 20]), np.array([1]),
+            force_exact=True,
+        )
+        assert miss_count("forced_exact") == before + 1
+        # Ineligible: negative value can never take the fused path.
+        before = miss_count("ineligible")
+        pf.sweep_auto(
+            np.array([-1]), *args[1:], np.array([100]),
+            np.array([1 << 20]), np.array([1]),
+        )
+        assert miss_count("ineligible") == before + 1
+        # Exact-kernel latency was observed for both fallbacks.
+        assert tel["latency"].labels(kernel="xla_int64").count >= 2
+
+    def test_breaker_open_reason_and_transition_counter(self):
+        import numpy as np
+
+        from kubernetesclustercapacity_tpu.ops import pallas_fit as pf
+
+        tel = pf._metrics()
+        pf.reset_fast_path()  # a prior test may have left the breaker open
+        args = (
+            np.array([4000]), np.array([8 << 30]), np.array([110]),
+            np.array([0]), np.array([0]), np.array([0]),
+            np.array([True]),
+        )
+        reqs = (np.array([100]), np.array([1 << 20]), np.array([1]))
+        before_open = tel["misses"].labels(reason="breaker_open").value
+        trans_before = tel["transitions"].labels(
+            breaker="pallas_fused_sweep", to="open"
+        ).value
+        pf._breaker.record_failure("synthetic trip")
+        try:
+            totals, sched, kernel = pf.sweep_auto(*args, *reqs)
+            assert kernel == "xla_int64"
+            assert tel["misses"].labels(
+                reason="breaker_open"
+            ).value == before_open + 1
+            assert tel["transitions"].labels(
+                breaker="pallas_fused_sweep", to="open"
+            ).value == trans_before + 1
+        finally:
+            pf.reset_fast_path()
+
+    def test_disabled_telemetry_skips_registry(self, monkeypatch):
+        import numpy as np
+
+        from kubernetesclustercapacity_tpu.ops import pallas_fit as pf
+        from kubernetesclustercapacity_tpu.telemetry import metrics as m
+
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        assert not m.enabled()
+        tel = pf._metrics()
+        before = tel["misses"].labels(reason="forced_exact").value
+        pf.sweep_auto(
+            np.array([4000]), np.array([8 << 30]), np.array([110]),
+            np.array([0]), np.array([0]), np.array([0]),
+            np.array([True]), np.array([100]), np.array([1 << 20]),
+            np.array([1]), force_exact=True,
+        )
+        # Zero registry traffic with telemetry off.
+        assert tel["misses"].labels(
+            reason="forced_exact"
+        ).value == before

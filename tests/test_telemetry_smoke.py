@@ -1,0 +1,184 @@
+"""End-to-end telemetry smoke: a served snapshot + a metrics port.
+
+The acceptance path in one test class: start a CapacityServer with an
+exposition endpoint over its registry, drive real ops through
+CapacityClient over TCP, scrape ``/metrics`` over HTTP, and assert the
+per-op counters and latency histograms moved; a client-sent trace ID
+must land in the server's JSONL trace log.  Also pins the bench-side
+registry dump (``KCC_BENCH_METRICS_OUT``).
+"""
+
+import json
+import os
+import pathlib
+import sys
+import urllib.request
+
+import pytest
+
+from test_telemetry import FIXTURE, parse_exposition
+
+_REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    """(server, client, metrics_url, trace_path) — the full wiring the
+    ``kccap-server -metrics-port ... -trace-log ...`` flags produce,
+    assembled in-process on a private registry."""
+    from kubernetesclustercapacity_tpu.fixtures import load_fixture
+    from kubernetesclustercapacity_tpu.service import (
+        CapacityClient,
+        CapacityServer,
+    )
+    from kubernetesclustercapacity_tpu.snapshot import snapshot_from_fixture
+    from kubernetesclustercapacity_tpu.telemetry.exposition import (
+        start_metrics_server,
+    )
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        MetricsRegistry,
+    )
+
+    fixture = load_fixture(FIXTURE)
+    snap = snapshot_from_fixture(fixture, semantics="reference")
+    registry = MetricsRegistry()
+    trace_path = str(tmp_path / "trace.jsonl")
+    server = CapacityServer(
+        snap, port=0, fixture=fixture, registry=registry,
+        trace_log=trace_path,
+    )
+    server.start()
+    metrics = start_metrics_server(registry)
+    client = CapacityClient(*server.address, registry=registry)
+    yield server, client, metrics.url, trace_path
+    client.close()
+    metrics.shutdown()
+    server.shutdown()
+
+
+def scrape(url: str) -> dict:
+    return parse_exposition(
+        urllib.request.urlopen(url + "/metrics").read().decode()
+    )
+
+
+class TestSmoke:
+    def test_counters_and_histograms_move_under_load(self, stack):
+        server, client, url, _ = stack
+        before = scrape(url)
+        assert before.get('kccap_requests_total{op="fit"}', 0) == 0
+
+        client.ping()
+        for _ in range(3):
+            client.fit(cpuRequests="200m", memRequests="250mb",
+                       replicas="10")
+        sweep = client.sweep(random={"n": 8, "seed": 1}, kernel="exact")
+        assert sweep["scenarios"] == 8
+
+        after = scrape(url)
+        assert after['kccap_requests_total{op="ping"}'] == 1
+        assert after['kccap_requests_total{op="fit"}'] == 3
+        assert after['kccap_requests_total{op="sweep"}'] == 1
+        # Latency histograms moved with the counters, and stayed
+        # internally consistent (cumulative, +Inf == count).
+        assert after['kccap_request_latency_seconds_count{op="fit"}'] == 3
+        assert (
+            after['kccap_request_latency_seconds_bucket{op="fit",le="+Inf"}']
+            == 3
+        )
+        assert after['kccap_request_latency_seconds_sum{op="fit"}'] > 0
+        # The client shares the registry: its transport counters are in
+        # the same scrape.
+        assert after["kccap_client_calls_total"] == 5
+        # Nothing in flight once the calls returned.
+        assert after["kccap_requests_in_flight"] == 0
+
+    def test_error_and_shed_counters_move(self, stack):
+        server, client, url, _ = stack
+        with pytest.raises(RuntimeError):
+            client.call("bogus_op")
+        with pytest.raises(RuntimeError):  # server-side DeadlineExpired
+            client.call("fit", deadline=1.0)  # epoch-second 1: long gone
+        after = scrape(url)
+        assert (
+            after['kccap_request_errors_total{op="unknown",error="ValueError"}']
+            == 1
+        )
+        assert after["kccap_deadline_shed_total"] == 1
+
+    def test_trace_id_round_trips_into_trace_log(self, stack):
+        from kubernetesclustercapacity_tpu.telemetry.tracing import (
+            new_trace_id,
+        )
+
+        server, client, url, trace_path = stack
+        tid = new_trace_id()
+        client.fit(cpuRequests="200m", memRequests="250mb", trace_id=tid)
+        client.ping()  # un-traced: logged with empty trace_id
+        records = [
+            json.loads(ln)
+            for ln in open(trace_path, encoding="utf-8")
+        ]
+        fit_recs = [r for r in records if r["op"] == "fit"]
+        assert [r["trace_id"] for r in fit_recs] == [tid]
+        assert fit_recs[0]["status"] == "ok"
+        assert fit_recs[0]["duration_ms"] >= 0
+
+    def test_healthz_ok(self, stack):
+        _, _, url, _ = stack
+        assert json.loads(
+            urllib.request.urlopen(url + "/healthz").read()
+        ) == {"ok": True}
+
+    def test_scrape_is_valid_prometheus_text(self, stack):
+        server, client, url, _ = stack
+        client.ping()
+        text = urllib.request.urlopen(url + "/metrics").read().decode()
+        seen_types: dict = {}
+        for line in text.splitlines():
+            if line.startswith("# TYPE"):
+                _, _, name, mtype = line.split(" ", 3)
+                assert mtype in ("counter", "gauge", "histogram")
+                assert name not in seen_types  # one TYPE per family
+                seen_types[name] = mtype
+            elif line and not line.startswith("#"):
+                name_labels, _, value = line.rpartition(" ")
+                float(value.replace("+Inf", "inf"))  # every value parses
+        assert "kccap_requests_total" in seen_types
+
+
+class TestBenchMetricsDump:
+    def test_dump_writes_registry_snapshot(self, tmp_path, monkeypatch):
+        out = tmp_path / "metrics.json"
+        monkeypatch.setenv("KCC_BENCH_METRICS_OUT", str(out))
+        sys.modules.pop("bench", None)
+        sys.path.insert(0, _REPO_ROOT)
+        try:
+            import bench
+
+            # Put something real in the default registry first (the
+            # same one the bench child's sweeps feed via sweep_auto).
+            from kubernetesclustercapacity_tpu.telemetry.metrics import (
+                REGISTRY,
+            )
+
+            REGISTRY.counter("bench_dump_probe_total").inc()
+            bench._maybe_dump_metrics()
+        finally:
+            sys.path.pop(0)
+            sys.modules.pop("bench", None)
+        snap = json.loads(out.read_text())
+        assert snap["bench_dump_probe_total"]["values"][""] == 1
+
+    def test_no_env_no_file(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("KCC_BENCH_METRICS_OUT", raising=False)
+        sys.modules.pop("bench", None)
+        sys.path.insert(0, _REPO_ROOT)
+        try:
+            import bench
+
+            bench._maybe_dump_metrics()  # must be a silent no-op
+        finally:
+            sys.path.pop(0)
+            sys.modules.pop("bench", None)
+        assert list(tmp_path.iterdir()) == []
