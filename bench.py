@@ -959,7 +959,10 @@ def _run() -> None:
         (K, seed) for K in (K_SMALL, K_BIG_FUSED) for seed in (99, 7 * K)
     ]
 
-    def measure_slope(make_run, make_args, *, ks=(K_SMALL, K_BIG), reps=REPS):
+    def measure_slope(
+        make_run, make_args, *, ks=(K_SMALL, K_BIG), reps=REPS,
+        compile_out=None,
+    ):
         """True per-sweep ms: marginal cost between two scan lengths.
 
         ``make_run(K)`` builds the jitted K-sweep runner; ``make_args(K,
@@ -967,13 +970,22 @@ def _run() -> None:
         is the sync point; min-of-reps at each K, then the slope.  Returns
         ``(per_sweep_ms, mins, outputs)`` with ``outputs[(K, seed)]`` the
         ``[K, S]`` totals of every timed batch.
+
+        ``compile_out`` (optional dict) receives per-K first-call
+        timings: the warm-up dispatch of each scan length IS its trace +
+        compile + first run, so its wall time, minus a steady rep, is the
+        compile cost — recorded separately so BENCH_* artifacts can track
+        compile-time regressions, not just runtime (``compile_s``).
         """
         k_small, k_big = ks
         mins = {}
         outputs = {}
         for K in ks:
             run = make_run(K)
+            t0c = time.perf_counter()
             np.asarray(run(*make_args(K, seed=99)))  # warm the compile
+            if compile_out is not None:
+                compile_out[K] = time.perf_counter() - t0c
             seed = 7 * K
             args = make_args(K, seed=seed)  # staged once per K
             ts = []
@@ -1004,8 +1016,9 @@ def _run() -> None:
         _, crs, mrs, rps = fresh_grids(K, seed)
         return tuple(jax.device_put(x) for x in (crs, mrs, rps))
 
+    exact_compile: dict = {}
     exact_per_sweep, exact_mins, exact_outputs = measure_slope(
-        make_run_exact, make_exact_args
+        make_run_exact, make_exact_args, compile_out=exact_compile
     )
 
     # Workload-level correctness gate: the kind-fixture gate above proves
@@ -1148,6 +1161,7 @@ def _run() -> None:
 
     fast_per_sweep = None
     fused_path_error = None
+    fast_compile: dict = {}
     if fast_used:
         n_pad = padded_node_shape(n_nodes)
         s_pad = padded_scenario_shape(n_scenarios)
@@ -1163,7 +1177,8 @@ def _run() -> None:
 
         try:
             fast_per_sweep, fast_mins, fast_outputs = measure_slope(
-                make_run_fast, make_fast_args, ks=(K_SMALL, K_BIG_FUSED)
+                make_run_fast, make_fast_args, ks=(K_SMALL, K_BIG_FUSED),
+                compile_out=fast_compile,
             )
         except Exception as e:  # noqa: BLE001 - Mosaic/compiler failures
             # A fused kernel that will not compile on THIS chip (Mosaic
@@ -1891,6 +1906,16 @@ def _run() -> None:
                     if fused_path_error
                     else {}
                 ),
+                # First-call (trace + XLA/Mosaic compile + first run)
+                # wall time of the headline kernel's K_SMALL warm-up —
+                # tracked apart from steady-state latency so BENCH_*
+                # rounds can catch compile-time regressions too.
+                "compile_s": (
+                    round(fast_compile[K_SMALL], 3)
+                    if fast_per_sweep is not None and K_SMALL in fast_compile
+                    else round(exact_compile.get(K_SMALL, 0.0), 3)
+                ),
+                "exact_compile_s": round(exact_compile.get(K_SMALL, 0.0), 3),
                 "exact_int64_per_sweep_ms": round(exact_per_sweep, 3),
                 "exact_single_dispatch_p50_ms": round(single_dispatch_p50, 3),
                 "dispatch_floor_ms": round(dispatch_floor_ms, 3),

@@ -81,3 +81,7 @@ from kubernetesclustercapacity_tpu.ops.preemption import (  # noqa: E402,F401
 )
 from kubernetesclustercapacity_tpu.store import ClusterStore  # noqa: E402,F401
 from kubernetesclustercapacity_tpu.follower import ClusterFollower  # noqa: E402,F401
+from kubernetesclustercapacity_tpu.explain import (  # noqa: E402,F401
+    ExplainResult,
+    explain_snapshot,
+)

@@ -115,6 +115,21 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="PATH",
                    help="append a JSONL span for this invocation (op, "
                         "duration, status) to PATH")
+    p.add_argument("-trace-log-max-bytes", type=int, default=0,
+                   dest="trace_log_max_bytes", metavar="N",
+                   help="rotate the -trace-log file to PATH.1 once it "
+                        "exceeds N bytes (0 = unbounded)")
+    p.add_argument("-explain", action="store_true",
+                   help="print per-node bottleneck attribution (binding "
+                        "constraint, per-resource fits, marginal '+1 "
+                        "replica' analysis) for the spec instead of the "
+                        "fit report; -output json selects the structured "
+                        "form (-backend tpu only)")
+    p.add_argument("-jax-profile", default="", dest="jax_profile",
+                   metavar="DIR",
+                   help="capture a jax.profiler trace of the run into "
+                        "DIR (view with TensorBoard/Perfetto) — opt-in "
+                        "compile/runtime visibility for kernel work")
     return p
 
 
@@ -183,18 +198,35 @@ def main(argv: list[str] | None = None) -> int:
             TraceLog,
         )
 
-        trace_log = TraceLog(args.trace_log)
+        trace_log = TraceLog(
+            args.trace_log, max_bytes=max(args.trace_log_max_bytes, 0)
+        )
+
+    def run() -> int:
+        if args.jax_profile:
+            # Opt-in jax.profiler capture of the whole run (compile +
+            # device work); the trace directory is TensorBoard/Perfetto
+            # food.  Wrapping here (after source/flag validation would
+            # be nicer, but the compile happens inside _run_command)
+            # keeps profiling a pure observation.
+            import jax
+
+            with jax.profiler.trace(args.jax_profile):
+                return _run_command(args)
+        return _run_command(args)
+
     try:
         if trace_log is not None:
             mode = (
                 "drain" if args.drain else
+                "explain" if args.explain else
                 "grid" if args.grid > 0 else "fit"
             )
             with Span(f"kccap:{mode}", trace_log=trace_log) as span:
-                rc = _run_command(args)
+                rc = run()
                 span._extra["exit_code"] = rc
                 return rc
-        return _run_command(args)
+        return run()
     finally:
         if trace_log is not None:
             trace_log.close()
@@ -244,9 +276,43 @@ def _run_command(args) -> int:
 
     if args.drain:
         return _run_drain(args, fixture, snapshot)
+    if args.explain:
+        return _run_explain(args, snapshot, scenario)
     if args.grid > 0:
         return _run_grid(args, snapshot)
     return _run_single(args, fixture, snapshot, scenario)
+
+
+def _run_explain(args, snapshot, scenario) -> int:
+    """-explain: WHY the fit stops — binding attribution + marginals.
+
+    Replaces the fit report (the reference transcript stays byte-exact
+    on the normal path; explanation is a new view the reference never
+    had).  Applies the same implicit strict-mode taint mask as every
+    other surface, so it explains the numbers fit/sweep actually return.
+    """
+    from kubernetesclustercapacity_tpu.explain import explain_snapshot
+    from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+    from kubernetesclustercapacity_tpu.report import (
+        explain_json_report,
+        explain_table_report,
+    )
+    from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+
+    if args.backend != "tpu":
+        print("ERROR : -explain runs on the JAX kernels (-backend tpu); "
+              "cpu/native backends are fit-only cross-checks ...exiting")
+        return 1
+    grid = ScenarioGrid.from_scenarios([scenario])
+    result = explain_snapshot(
+        snapshot, grid, mode=args.semantics,
+        node_mask=implicit_taint_mask(snapshot),
+    )
+    if args.output == "json":
+        print(explain_json_report(result))
+    else:
+        print(explain_table_report(result))
+    return 0
 
 
 def _run_drain(args, fixture, snapshot) -> int:

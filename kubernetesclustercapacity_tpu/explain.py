@@ -1,0 +1,508 @@
+"""Capacity explainability: WHY the sweep stopped at N replicas.
+
+The reference's entire diagnostic story is four ``fmt.Printf`` percentages
+that never influence the fit (``ClusterCapacity.go:113-117``, SURVEY.md §5).
+This module answers the question an operator actually asks: for every
+(scenario, node), which constraint is *binding* — cpu, memory, pod slots,
+or node health — how much headroom is left after the fit, and what is the
+smallest additional allocatable of each resource that would yield one more
+replica anywhere in the cluster.
+
+Two layers, split by where the math belongs:
+
+* a **vectorized JAX pass** (:func:`explain_per_node` / :func:`explain_grid`)
+  alongside :mod:`.ops.fit` — the same bit-faithful arithmetic as
+  ``fit_per_node`` (uint64 CPU views, wrap-around memory, truncating
+  division, the Q1 conditional pod-cap overwrite) extended to return the
+  per-constraint fit components and a binding-attribution code per node.
+  Pure array math: no registry call, no host object, jit/vmap-compatible.
+* **host-side analysis** (:class:`ExplainResult`) — binding histograms,
+  saturation distributions, and the marginal ("+1 replica") analysis,
+  numpy/Python over the kernel's outputs.  The marginal candidates come
+  from the monotone closed form and every reported delta is *verified*
+  against the sequential bug-compatible evaluator
+  (:func:`..oracle.fit_arrays_python`), so reference-mode non-monotonicity
+  (the Q1 overwrite can DECREASE a fit when capacity grows) can never
+  produce a wrong recommendation — a candidate the full semantics rejects
+  is skipped, never reported.
+
+Attribution rule (deterministic, shared with the brute-force oracle in
+``tests/test_explain.py``):
+
+* ``unhealthy`` — the node's ``healthy`` flag is false (strict: masked out
+  of the fit; reference: the phantom zero-row the packer produced);
+* ``masked``    — an explicit ``node_mask`` zeroed the node (constraint
+  infeasibility — an extension, like the kernel's own mask);
+* otherwise the FIRST minimum, in order ``cpu ≺ memory ≺ pods``, of the
+  values the mode's min actually compares: strict compares
+  ``(cpu_fit, mem_fit, slots)``; reference has no pod term in the min —
+  its ``pods`` attribution is the Q1 overwrite having fired
+  (``min(cpu_fit, mem_fit) >= allocatable_pods``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+from kubernetesclustercapacity_tpu.ops.fit import _trunc_div
+from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+
+__all__ = [
+    "BINDING_NAMES",
+    "BINDING_CPU",
+    "BINDING_MEMORY",
+    "BINDING_PODS",
+    "BINDING_UNHEALTHY",
+    "BINDING_MASKED",
+    "ExplainResult",
+    "explain_per_node",
+    "explain_grid",
+    "explain_snapshot",
+]
+
+# Attribution codes, in tie-break order (cpu ≺ memory ≺ pods); health and
+# mask overrides sit above the resource codes.
+BINDING_CPU = 0
+BINDING_MEMORY = 1
+BINDING_PODS = 2
+BINDING_UNHEALTHY = 3
+BINDING_MASKED = 4
+BINDING_NAMES = ("cpu", "memory", "pods", "unhealthy", "masked")
+
+_U64 = 1 << 64
+# Deltas beyond this are not actionable advice ("add 4 exabytes") and
+# would push the int64 carrier into wrap territory — treated as "this
+# resource cannot buy +1 here".
+_MAX_SANE_DELTA = 1 << 62
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def explain_per_node(
+    alloc_cpu: jnp.ndarray,
+    alloc_mem: jnp.ndarray,
+    alloc_pods: jnp.ndarray,
+    used_cpu: jnp.ndarray,
+    used_mem: jnp.ndarray,
+    pods_count: jnp.ndarray,
+    healthy: jnp.ndarray,
+    cpu_req,
+    mem_req,
+    *,
+    mode: str = "reference",
+    node_mask: jnp.ndarray | None = None,
+):
+    """Fit + binding attribution for ONE scenario.
+
+    Returns ``(fit, code, cpu_fit, mem_fit, slots)`` — all ``[N]``; ``fit``
+    is bit-identical to :func:`..ops.fit.fit_per_node` (pinned by
+    ``tests/test_explain.py``), ``code`` the attribution per the module
+    rule, ``cpu_fit``/``mem_fit`` the per-resource quotients on their
+    int64 carriers, and ``slots`` the pod term the mode compares
+    (``alloc_pods - pods_count``, clamped at 0 in strict mode only).
+    """
+    alloc_cpu = jnp.asarray(alloc_cpu, jnp.int64)
+    alloc_mem = jnp.asarray(alloc_mem, jnp.int64)
+    alloc_pods = jnp.asarray(alloc_pods, jnp.int64)
+    used_cpu = jnp.asarray(used_cpu, jnp.int64)
+    used_mem = jnp.asarray(used_mem, jnp.int64)
+    pods_count = jnp.asarray(pods_count, jnp.int64)
+    cpu_req = jnp.asarray(cpu_req, jnp.int64)
+    mem_req = jnp.asarray(mem_req, jnp.int64)
+    healthy_b = jnp.asarray(healthy, jnp.bool_)
+
+    # Identical prologue to fit_per_node: uint64 CPU compare/divide on the
+    # raw bit patterns, int64 wrap-around memory with truncating division.
+    alloc_cpu_u = alloc_cpu.astype(jnp.uint64)
+    used_cpu_u = used_cpu.astype(jnp.uint64)
+    cpu_req_u = jnp.maximum(cpu_req.astype(jnp.uint64), jnp.uint64(1))
+    cpu_fit = jnp.where(
+        alloc_cpu_u <= used_cpu_u,
+        jnp.uint64(0),
+        (alloc_cpu_u - used_cpu_u) // cpu_req_u,
+    ).astype(jnp.int64)
+    mem_head = alloc_mem - used_mem
+    mem_fit = jnp.where(
+        alloc_mem <= used_mem,
+        jnp.int64(0),
+        _trunc_div(mem_head, jnp.where(mem_req == 0, jnp.int64(1), mem_req)),
+    )
+    fit_pre = jnp.minimum(cpu_fit, mem_fit)
+
+    if mode == "reference":
+        slots = alloc_pods - pods_count  # unclamped: Q1's replacement value
+        q1 = fit_pre >= alloc_pods
+        fit = jnp.where(q1, slots, fit_pre)
+        code = jnp.where(
+            q1,
+            jnp.int32(BINDING_PODS),
+            jnp.where(
+                cpu_fit <= mem_fit,
+                jnp.int32(BINDING_CPU),
+                jnp.int32(BINDING_MEMORY),
+            ),
+        )
+    elif mode == "strict":
+        slots = jnp.maximum(alloc_pods - pods_count, jnp.int64(0))
+        fit = jnp.maximum(jnp.minimum(fit_pre, slots), jnp.int64(0))
+        fit = jnp.where(healthy_b, fit, jnp.int64(0))
+        code = jnp.where(
+            (cpu_fit <= mem_fit) & (cpu_fit <= slots),
+            jnp.int32(BINDING_CPU),
+            jnp.where(
+                mem_fit <= slots,
+                jnp.int32(BINDING_MEMORY),
+                jnp.int32(BINDING_PODS),
+            ),
+        )
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    # Health override: in strict mode the node contributes nothing BECAUSE
+    # it is unhealthy; in reference mode the phantom zero-row exists
+    # because getHealthyNodes skipped it — either way, "unhealthy" is the
+    # answer an operator needs, not "cpu is 0".
+    code = jnp.where(healthy_b, code, jnp.int32(BINDING_UNHEALTHY))
+    if node_mask is not None:
+        mask_b = jnp.asarray(node_mask, jnp.bool_)
+        fit = jnp.where(mask_b, fit, jnp.int64(0))
+        code = jnp.where(mask_b, code, jnp.int32(BINDING_MASKED))
+    return fit, code, cpu_fit, mem_fit, slots
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def explain_grid(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_reqs,
+    mem_reqs,
+    *,
+    mode: str = "reference",
+    node_mask=None,
+):
+    """S-scenario vectorized attribution: each output is ``[S, N]``.
+
+    The scenario axis is a ``vmap`` over the request vectors — one
+    compiled program explains a whole sweep, the same way ``sweep_grid``
+    evaluates one.
+    """
+    per_scenario = jax.vmap(
+        lambda c, m: explain_per_node(
+            alloc_cpu,
+            alloc_mem,
+            alloc_pods,
+            used_cpu,
+            used_mem,
+            pods_count,
+            healthy,
+            c,
+            m,
+            mode=mode,
+            node_mask=node_mask,
+        )
+    )
+    return per_scenario(
+        jnp.asarray(cpu_reqs, jnp.int64), jnp.asarray(mem_reqs, jnp.int64)
+    )
+
+
+@dataclass
+class ExplainResult:
+    """Host-side view of an explained sweep (numpy arrays throughout).
+
+    ``fits``/``binding``/``cpu_fit``/``mem_fit``/``slots`` are ``[S, N]``;
+    ``totals`` is ``[S]``.  The snapshot rides along for the host-side
+    analyses (marginals need the raw allocatable/used columns).
+    """
+
+    snapshot: ClusterSnapshot
+    mode: str
+    cpu_request_milli: np.ndarray  # [S] int64 carriers
+    mem_request_bytes: np.ndarray  # [S]
+    replicas: np.ndarray  # [S]
+    fits: np.ndarray  # [S, N]
+    binding: np.ndarray  # [S, N] int32 codes
+    cpu_fit: np.ndarray  # [S, N]
+    mem_fit: np.ndarray  # [S, N]
+    slots: np.ndarray  # [S, N]
+    node_mask: np.ndarray | None = field(default=None)
+
+    @property
+    def totals(self) -> np.ndarray:
+        return self.fits.sum(axis=1)
+
+    @property
+    def size(self) -> int:
+        return int(self.fits.shape[0])
+
+    def binding_names(self, s: int = 0) -> list[str]:
+        """Per-node attribution strings for scenario ``s``."""
+        return [BINDING_NAMES[int(c)] for c in self.binding[s]]
+
+    def binding_counts(self, s: int = 0) -> dict[str, int]:
+        """``{constraint: node count}`` for scenario ``s`` (zero-count
+        constraints included, so the dict shape is stable)."""
+        codes, counts = np.unique(self.binding[s], return_counts=True)
+        out = {name: 0 for name in BINDING_NAMES}
+        for c, n in zip(codes, counts):
+            out[BINDING_NAMES[int(c)]] = int(n)
+        return out
+
+    # -- headroom / saturation -------------------------------------------
+    def headroom(self, s: int = 0) -> dict[str, np.ndarray]:
+        """Per-node residual headroom AFTER placing scenario ``s``'s fit.
+
+        ``cpu_milli``/``mem_bytes`` are ``head - fit * request`` (what is
+        left once the reported replicas land); ``pod_slots`` the remaining
+        schedulable pod slots.  Python-int arithmetic (object arrays are
+        avoided by clamping to the sane domain): wrapped/degenerate rows
+        report 0 residual rather than garbage.
+        """
+        snap = self.snapshot
+        fit = self.fits[s]
+        cr = int(self.cpu_request_milli[s]) % _U64
+        mr = int(self.mem_request_bytes[s])
+        n = snap.n_nodes
+        cpu_res = np.zeros(n, dtype=np.int64)
+        mem_res = np.zeros(n, dtype=np.int64)
+        pod_res = np.zeros(n, dtype=np.int64)
+        for i in range(n):
+            f = max(int(fit[i]), 0)
+            ch = (int(snap.alloc_cpu_milli[i]) % _U64) - (
+                int(snap.used_cpu_req_milli[i]) % _U64
+            )
+            mh = int(snap.alloc_mem_bytes[i]) - int(
+                snap.used_mem_req_bytes[i]
+            )
+            cpu_res[i] = max(min(ch - f * cr, np.iinfo(np.int64).max), 0)
+            mem_res[i] = max(min(mh - f * mr, np.iinfo(np.int64).max), 0)
+            pod_res[i] = max(
+                int(snap.alloc_pods[i]) - int(snap.pods_count[i]) - f, 0
+            )
+        return {
+            "cpu_milli": cpu_res,
+            "mem_bytes": mem_res,
+            "pod_slots": pod_res,
+        }
+
+    def saturation(self, s: int = 0) -> dict:
+        """Cluster saturation summary for scenario ``s``: the binding
+        histogram, zero-fit node count, and per-resource utilization
+        quantiles over healthy nodes (display-grade floats — the fit
+        itself never consumes them, exactly like the reference's
+        percentages)."""
+        snap = self.snapshot
+        out = {
+            "binding_counts": self.binding_counts(s),
+            "zero_fit_nodes": int((self.fits[s] <= 0).sum()),
+            "nodes": snap.n_nodes,
+        }
+        healthy = np.asarray(snap.healthy, dtype=bool)
+        for name, used, alloc in (
+            ("cpu_utilization", snap.used_cpu_req_milli, snap.alloc_cpu_milli),
+            ("mem_utilization", snap.used_mem_req_bytes, snap.alloc_mem_bytes),
+            ("pod_utilization", snap.pods_count, snap.alloc_pods),
+        ):
+            a = np.asarray(alloc, dtype=np.float64)
+            u = np.asarray(used, dtype=np.float64)
+            ok = healthy & (a > 0)
+            if not ok.any():
+                out[name] = None
+                continue
+            util = u[ok] / a[ok]
+            out[name] = {
+                "p50": round(float(np.percentile(util, 50)), 4),
+                "p90": round(float(np.percentile(util, 90)), 4),
+                "max": round(float(util.max()), 4),
+                "saturated_nodes": int((util >= 1.0).sum()),
+            }
+        return out
+
+    # -- marginal analysis -----------------------------------------------
+    def marginal(
+        self, s: int = 0, *, verify_limit: int | None = 32
+    ) -> dict[str, dict | None]:
+        """Smallest additional allocatable of each resource buying +1.
+
+        For each resource R in (cpu, memory, pods): the minimal increment
+        to ONE node's allocatable R that raises the cluster total by at
+        least one replica, holding everything else fixed.  Candidates
+        come from the monotone closed form (the exact increment that
+        lifts that node's R-bound to ``fit+1``) and are accepted only
+        after the full mode semantics — Q1 overwrite included — confirm
+        the +1 by re-evaluating the node
+        (:func:`..oracle.fit_arrays_python`); candidates the bug-
+        compatible evaluator rejects are skipped.  ``verify_limit``
+        bounds how many candidates are re-evaluated per resource
+        (ascending delta; ``None`` = all).
+
+        Returns ``{resource: {"delta": int, "node": str, "unit": str}}``
+        with ``None`` for a resource no single-node increment can buy +1
+        through.  Units: millicores, bytes, pod slots.
+        """
+        snap = self.snapshot
+        mode = self.mode
+        fit = self.fits[s]
+        cpu_fit = self.cpu_fit[s]
+        mem_fit = self.mem_fit[s]
+        code = self.binding[s]
+        cr_u = int(self.cpu_request_milli[s]) % _U64
+        mr = int(self.mem_request_bytes[s])
+        healthy = np.asarray(snap.healthy, dtype=bool)
+        mask = (
+            np.ones(snap.n_nodes, dtype=bool)
+            if self.node_mask is None
+            else np.asarray(self.node_mask, dtype=bool)
+        )
+        out: dict[str, dict | None] = {}
+        for resource, unit in (
+            ("cpu", "milli"),
+            ("memory", "bytes"),
+            ("pods", "slots"),
+        ):
+            candidates: list[tuple[int, int]] = []  # (delta, node index)
+            for i in range(snap.n_nodes):
+                if not healthy[i] or not mask[i]:
+                    continue  # capacity cannot fix health or constraints
+                if code[i] in (BINDING_UNHEALTHY, BINDING_MASKED):
+                    continue
+                d = self._candidate_delta(
+                    resource, i, int(fit[i]) + 1,
+                    int(cpu_fit[i]), int(mem_fit[i]), cr_u, mr, mode,
+                )
+                if d is not None and 0 < d <= _MAX_SANE_DELTA:
+                    candidates.append((d, i))
+            candidates.sort()
+            chosen: dict | None = None
+            limit = len(candidates) if verify_limit is None else verify_limit
+            for d, i in candidates[:limit]:
+                if self._verify_plus_one(resource, i, d, s):
+                    chosen = {
+                        "delta": int(d),
+                        "node": snap.names[i],
+                        "node_index": int(i),
+                        "unit": unit,
+                    }
+                    break
+            out[resource] = chosen
+        return out
+
+    def _candidate_delta(
+        self, resource, i, target, cpu_fit_i, mem_fit_i, cr_u, mr, mode
+    ) -> int | None:
+        """Closed-form minimal increment lifting node ``i``'s R-bound to
+        ``target`` replicas — the MONOTONE model's answer, which
+        :meth:`_verify_plus_one` then checks against the full semantics.
+        Python-int arithmetic throughout (no int64 overflow)."""
+        snap = self.snapshot
+        ap = int(snap.alloc_pods[i])
+        pc = int(snap.pods_count[i])
+        if resource == "cpu":
+            if mem_fit_i < target:  # memory binds below target regardless
+                return None
+            head = (int(snap.alloc_cpu_milli[i]) % _U64) - (
+                int(snap.used_cpu_req_milli[i]) % _U64
+            )
+            return target * cr_u - head
+        if resource == "memory":
+            if cpu_fit_i < target:
+                return None
+            head = int(snap.alloc_mem_bytes[i]) - int(
+                snap.used_mem_req_bytes[i]
+            )
+            return target * mr - head
+        # pods: strict compares remaining slots; reference only consults
+        # alloc_pods through the Q1 overwrite, where raising it by 1 adds
+        # one replica iff min(cpu_fit, mem_fit) still clears the new cap.
+        if min(cpu_fit_i, mem_fit_i) < target:
+            return None
+        if mode == "strict":
+            return target - max(ap - pc, 0)
+        # Reference: the minimal useful increment is always 1 slot — the
+        # overwrite writes ``alloc_pods - pods_count``, so +1 allocatable
+        # is +1 replica exactly when the overwrite still fires at the new
+        # cap (min(cpu_fit, mem_fit) >= ap + 1, checked above and then
+        # confirmed by verification).
+        return 1
+
+    def _verify_plus_one(self, resource, i, delta, s) -> bool:
+        """Re-evaluate node ``i`` with ``alloc_R + delta`` under the FULL
+        mode semantics; True iff its fit strictly increases."""
+        snap = self.snapshot
+        ac = int(snap.alloc_cpu_milli[i])
+        am = int(snap.alloc_mem_bytes[i])
+        ap = int(snap.alloc_pods[i])
+        if resource == "cpu":
+            ac = ((ac % _U64) + delta) % _U64
+            if ac >= 1 << 63:
+                ac -= _U64  # back to the int64 carrier
+        elif resource == "memory":
+            am += delta
+            if not (-(1 << 63) <= am < 1 << 63):
+                return False
+        else:
+            ap += delta
+        before = int(self.fits[s][i])
+        after = fit_arrays_python(
+            [ac], [am], [ap],
+            [int(snap.used_cpu_req_milli[i])],
+            [int(snap.used_mem_req_bytes[i])],
+            [int(snap.pods_count[i])],
+            int(self.cpu_request_milli[s]),
+            int(self.mem_request_bytes[s]),
+            mode=self.mode,
+            healthy=[bool(snap.healthy[i])],
+        )[0]
+        return after > before
+
+
+def explain_snapshot(
+    snapshot: ClusterSnapshot,
+    grid: ScenarioGrid,
+    *,
+    mode: str | None = None,
+    node_mask=None,
+) -> ExplainResult:
+    """Explain a whole sweep: ``ClusterSnapshot`` × ``ScenarioGrid`` →
+    :class:`ExplainResult` (numpy).  ``mode`` defaults to the snapshot's
+    own packing semantics — the same rule the service applies."""
+    mode = mode or snapshot.semantics
+    grid.validate()
+    fits, code, cpu_fit, mem_fit, slots = explain_grid(
+        snapshot.alloc_cpu_milli,
+        snapshot.alloc_mem_bytes,
+        snapshot.alloc_pods,
+        snapshot.used_cpu_req_milli,
+        snapshot.used_mem_req_bytes,
+        snapshot.pods_count,
+        snapshot.healthy,
+        grid.cpu_request_milli,
+        grid.mem_request_bytes,
+        mode=mode,
+        node_mask=node_mask,
+    )
+    return ExplainResult(
+        snapshot=snapshot,
+        mode=mode,
+        cpu_request_milli=np.asarray(grid.cpu_request_milli),
+        mem_request_bytes=np.asarray(grid.mem_request_bytes),
+        replicas=np.asarray(grid.replicas),
+        fits=np.asarray(fits),
+        binding=np.asarray(code),
+        cpu_fit=np.asarray(cpu_fit),
+        mem_fit=np.asarray(mem_fit),
+        slots=np.asarray(slots),
+        node_mask=(
+            None if node_mask is None else np.asarray(node_mask, dtype=bool)
+        ),
+    )

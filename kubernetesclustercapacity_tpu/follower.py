@@ -139,6 +139,7 @@ class ClusterFollower:
         # any of them happens under it (two watch threads + callers race).
         self._versions: dict[str, str] = {}
         self._epoch = 0  # bumped by every relist; stale streams stop applying
+        self._last_relist_t: float | None = None  # monotonic; /healthz age
         self._fatal: str | None = None
         self._pdb_unavailable = False  # policy API 403/404 at relist
         self._errors: collections.deque = collections.deque(maxlen=100)
@@ -268,6 +269,16 @@ class ClusterFollower:
             "fatal": fatal,
         }
 
+    def last_relist_age_s(self) -> float | None:
+        """Seconds since the last successful full relist (``None`` before
+        the first).  The ``/healthz`` freshness signal: a follower whose
+        watches died can keep serving a stale snapshot indefinitely —
+        this number is how a load balancer notices (the stats() dict
+        shape is pinned, so the age rides its own accessor)."""
+        with self._lock:
+            t = self._last_relist_t
+        return None if t is None else round(time.monotonic() - t, 3)
+
     def _bump(self, counter: str, n: int = 1) -> None:
         self._counters[counter].inc(n)
 
@@ -345,6 +356,7 @@ class ClusterFollower:
             self._store = store
             self._versions = versions
             self._epoch += 1
+            self._last_relist_t = time.monotonic()
         self._counters["relists"].inc()
         self._synced.set()
         # The swapped-in store may hold changes that never flowed through

@@ -362,8 +362,15 @@ def sweep_snapshot(
     optional) zeroes constraint-infeasible nodes for every scenario.
     Returns numpy arrays.
     """
+    import time as _time
+
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        enabled as _telemetry_enabled,
+    )
+
     grid.validate()
     arrays = snapshot_device_arrays(snapshot)
+    t0 = _time.perf_counter()
     out = sweep_grid(
         *arrays,
         grid.cpu_request_milli,
@@ -373,4 +380,14 @@ def sweep_snapshot(
         return_per_node=return_per_node,
         node_mask=node_mask,
     )
-    return tuple(np.asarray(o) for o in out)
+    out = tuple(np.asarray(o) for o in out)
+    if _telemetry_enabled():
+        # Host-side, after the np.asarray sync — the first dispatch per
+        # kernel label lands as compile time, the rest as steady-state
+        # (telemetry/compilewatch; never called inside jitted code).
+        from kubernetesclustercapacity_tpu.telemetry.compilewatch import (
+            observe_dispatch,
+        )
+
+        observe_dispatch("xla_int64", _time.perf_counter() - t0)
+    return out

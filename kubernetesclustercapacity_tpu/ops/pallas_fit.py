@@ -41,6 +41,9 @@ from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
 from kubernetesclustercapacity_tpu.resilience import (
     CircuitBreaker as _CircuitBreaker,
 )
+from kubernetesclustercapacity_tpu.telemetry import (
+    compilewatch as _compilewatch,
+)
 from kubernetesclustercapacity_tpu.telemetry.metrics import (
     enabled as _telemetry_enabled,
 )
@@ -789,10 +792,10 @@ def sweep_auto(
                 # sweep_pallas materialized numpy totals, so perf_counter
                 # here has already waited for the device (np.asarray IS
                 # the block_until_ready sync for this dispatch).
-                tel["latency"].labels(kernel=name).observe(
-                    _time.perf_counter() - t0
-                )
+                dt = _time.perf_counter() - t0
+                tel["latency"].labels(kernel=name).observe(dt)
                 tel["hits"].inc()
+                _compilewatch.observe_dispatch(name, dt)
             return totals, sched, name
     if tel is not None:
         tel["misses"].labels(reason=fallback_reason).inc()
@@ -806,9 +809,9 @@ def sweep_auto(
     if tel is not None:
         # np.asarray blocked on the device result above — same sync
         # policy as the fused branch.
-        tel["latency"].labels(kernel="xla_int64").observe(
-            _time.perf_counter() - t0
-        )
+        dt = _time.perf_counter() - t0
+        tel["latency"].labels(kernel="xla_int64").observe(dt)
+        _compilewatch.observe_dispatch("xla_int64", dt)
     return totals, sched, "xla_int64"
 
 

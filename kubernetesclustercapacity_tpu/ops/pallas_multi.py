@@ -370,6 +370,15 @@ def sweep_multi_auto(
     :func:`.fit.sweep_grid_multi`.  Returns ``(totals, schedulable,
     kernel_name)``.
     """
+    import time as _time
+
+    from kubernetesclustercapacity_tpu.telemetry import (
+        compilewatch as _compilewatch,
+    )
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        enabled as _telemetry_enabled,
+    )
+
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     shared_mask = None
@@ -395,6 +404,7 @@ def sweep_multi_auto(
             else:
                 kernel_mask = shared_mask
             use_rcp = rcp_multi_eligible(alloc_rn, used_rn, reqs_sr, scales)
+            t0 = _time.perf_counter()
             totals, sched = sweep_pallas_multi(
                 alloc_rn, used_rn, alloc_pods, pods_count, reqs_sr,
                 replicas, scales, mode=mode, node_mask=kernel_mask,
@@ -405,10 +415,22 @@ def sweep_multi_auto(
                 if use_rcp
                 else "pallas_multi_i32_fused"
             )
+            if _telemetry_enabled():
+                # Host-side after sweep_pallas_multi's numpy
+                # materialization (the device sync for this dispatch).
+                _compilewatch.observe_dispatch(
+                    name, _time.perf_counter() - t0
+                )
             return totals, sched, name
+    t0 = _time.perf_counter()
     totals, sched = sweep_grid_multi(
         alloc_rn, used_rn, alloc_pods, pods_count, healthy, reqs_sr,
         replicas, mode=mode, node_masks=node_masks,
         max_per_node=max_per_node,
     )
-    return np.asarray(totals), np.asarray(sched), "xla_int64_multi"
+    totals, sched = np.asarray(totals), np.asarray(sched)
+    if _telemetry_enabled():
+        _compilewatch.observe_dispatch(
+            "xla_int64_multi", _time.perf_counter() - t0
+        )
+    return totals, sched, "xla_int64_multi"

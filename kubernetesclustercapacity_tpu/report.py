@@ -25,6 +25,8 @@ __all__ = [
     "reference_report",
     "json_report",
     "table_report",
+    "explain_table_report",
+    "explain_json_report",
 ]
 
 _RULE = "=" * 110  # the reference prints 110 '=' (ClusterCapacity.go:142,149)
@@ -219,6 +221,103 @@ def _nan_to_none(x: float):
     if math.isnan(x) or math.isinf(x):
         return None
     return round(x, 2)
+
+
+def _marginal_line(resource: str, m: dict | None) -> str:
+    """One human line per resource of the marginal analysis."""
+    if m is None:
+        return f"  {resource:<8} no single-node increment yields +1"
+    unit = {"milli": "m", "bytes": "B", "slots": " pod slot(s)"}.get(
+        m["unit"], m["unit"]
+    )
+    return (
+        f"  {resource:<8} +{m['delta']}{unit} on {m['node'] or '<phantom>'}"
+        " -> +1 replica"
+    )
+
+
+def explain_table_report(result, s: int = 0) -> str:
+    """Bottleneck attribution as a compact table + marginal summary.
+
+    ``result`` is an :class:`~..explain.ExplainResult`; ``s`` selects the
+    scenario.  The reference transcript is untouched by design — this is
+    a NEW view (the reference's percentages never influence the fit,
+    ``ClusterCapacity.go:113-117``); the summary block names the binding
+    constraint per node, the binding histogram, and the smallest
+    single-node capacity increment that buys one more replica.
+    """
+    snapshot = result.snapshot
+    fits = result.fits[s]
+    names = result.binding_names(s)
+    header = (
+        f"{'NODE':<24} {'HEALTHY':<8} {'BINDING':<10} {'FIT':>7} "
+        f"{'CPU_FIT':>9} {'MEM_FIT':>9} {'POD_SLOTS':>10}"
+    )
+    lines = [header, "-" * len(header)]
+    for i in range(snapshot.n_nodes):
+        lines.append(
+            f"{snapshot.names[i] or '<phantom>':<24} "
+            f"{'yes' if snapshot.healthy[i] else 'NO':<8} "
+            f"{names[i]:<10} "
+            f"{int(fits[i]):>7} "
+            f"{int(result.cpu_fit[s][i]):>9} "
+            f"{int(result.mem_fit[s][i]):>9} "
+            f"{int(result.slots[s][i]):>10}"
+        )
+    lines.append("-" * len(header))
+    counts = result.binding_counts(s)
+    lines.append(
+        "binding: "
+        + "  ".join(f"{k}={v}" for k, v in counts.items() if v)
+    )
+    total = int(np.sum(fits))
+    replicas = int(result.replicas[s])
+    verdict = "SCHEDULABLE" if total >= replicas else "NOT SCHEDULABLE"
+    lines.append(
+        f"total possible replicas: {total}   requested: {replicas}   "
+        f"verdict: {verdict}"
+    )
+    lines.append("marginal (+1 replica):")
+    for resource, m in result.marginal(s).items():
+        lines.append(_marginal_line(resource, m))
+    return "\n".join(lines)
+
+
+def explain_json_report(result, s: int = 0) -> str:
+    """The same explanation as structured JSON (machine surface)."""
+    snapshot = result.snapshot
+    fits = result.fits[s]
+    names = result.binding_names(s)
+    total = int(np.sum(fits))
+    nodes = [
+        {
+            "name": snapshot.names[i],
+            "healthy": bool(snapshot.healthy[i]),
+            "binding": names[i],
+            "fit": int(fits[i]),
+            "cpu_fit": int(result.cpu_fit[s][i]),
+            "mem_fit": int(result.mem_fit[s][i]),
+            "pod_slots": int(result.slots[s][i]),
+        }
+        for i in range(snapshot.n_nodes)
+    ]
+    return json.dumps(
+        {
+            "mode": result.mode,
+            "scenario": {
+                "cpu_request_milli": int(result.cpu_request_milli[s]),
+                "mem_request_bytes": int(result.mem_request_bytes[s]),
+                "replicas": int(result.replicas[s]),
+            },
+            "nodes": nodes,
+            "binding_counts": result.binding_counts(s),
+            "marginal": result.marginal(s),
+            "saturation": result.saturation(s),
+            "total_possible_replicas": total,
+            "schedulable": total >= int(result.replicas[s]),
+        },
+        indent=2,
+    )
 
 
 def table_report(

@@ -15,7 +15,10 @@ fit, sweep,     yes (pure queries against an immutable snapshot — a
 sweep_multi,    duplicate execution returns the identical result)
 place, drain,
 topology_spread,
-plan
+plan, explain
+dump            yes (read-only view of the flight recorder; a retry
+                re-reads the ring, which may have advanced — acceptable
+                for a diagnostic surface)
 update, reload  NO (state mutations; at-most-once from this client)
 ==============  =======================================================
 """
@@ -42,7 +45,7 @@ __all__ = ["CapacityClient", "IDEMPOTENT_OPS"]
 IDEMPOTENT_OPS = frozenset(
     {
         "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
-        "topology_spread", "plan",
+        "topology_spread", "plan", "explain", "dump",
     }
 )
 
@@ -316,3 +319,12 @@ class CapacityClient:
     def plan(self, node_template: dict, **flags) -> dict:
         """Scale-up plan: nodes of this shape needed to fit the spec."""
         return self.call("plan", node_template=node_template, **flags)
+
+    def explain(self, **flags) -> dict:
+        """Why the fit stops where it does: binding constraint per node,
+        binding histogram, saturation summary, marginal (+1) analysis."""
+        return self.call("explain", **flags)
+
+    def dump(self, **kw) -> dict:
+        """The server's flight recorder: its last K dispatched requests."""
+        return self.call("dump", **kw)

@@ -33,6 +33,17 @@ place      the fit flag/spec fields plus optional ``policy``
            closed-form bulk engine (O(N) instead of R scan steps):
            result ``assignments`` is null, ``by_node``/``placed``
            identical to the scan's; result ``engine`` says which ran
+explain    the fit flag fields — per-node bottleneck attribution for
+           the served snapshot: binding constraint (``cpu`` | ``memory``
+           | ``pods`` | ``unhealthy`` | ``masked``) per node, binding
+           histogram, saturation summary, and the marginal analysis
+           (smallest single-node capacity increment yielding +1
+           replica); optional ``output`` (``table`` | ``json``) adds a
+           rendered ``report``
+dump       — ; the server's flight recorder (ring buffer of the last K
+           dispatched requests: op, args digest, snapshot generation,
+           trace_id, latency, status, result digest) as
+           ``{records, count, capacity, dropped, generation}``
 reload     ``path`` — swap the served snapshot (fixture .json or .npz);
            optional ``semantics``
 update     ``events`` — watch-style node/pod event list applied

@@ -98,6 +98,8 @@ class CapacityServer:
         stats_source=None,
         registry=None,
         trace_log=None,
+        flight_records: int = 256,
+        flight_dump_path: str | None = None,
     ) -> None:
         """``stats_source`` is an optional zero-arg callable returning a
         JSON-able dict of upstream-feed health (e.g.
@@ -111,9 +113,18 @@ class CapacityServer:
         as ``main`` does — to fold server metrics into one scrape).
         ``trace_log`` (a path or :class:`~..telemetry.TraceLog`) records
         one JSONL span per dispatched request, carrying the caller's
-        ``trace_id`` when the request rode one."""
+        ``trace_id`` when the request rode one.
+
+        ``flight_records`` sizes the flight recorder — the ring buffer
+        of the last K dispatched requests served by the ``dump`` op.
+        ``flight_dump_path``, when set, appends the whole ring as JSONL
+        there every time a dispatch raises (the post-incident record of
+        what led up to the failure)."""
         import os
 
+        from kubernetesclustercapacity_tpu.telemetry.flightrec import (
+            FlightRecorder,
+        )
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             MetricsRegistry,
         )
@@ -155,6 +166,12 @@ class CapacityServer:
             "kccap_deadline_shed_total",
             "Requests shed because their deadline had already expired.",
         )
+        self._flight = FlightRecorder(flight_records)
+        self._flight_dump_path = flight_dump_path
+        # Served-state generation: bumped on every snapshot swap
+        # (reload, update, replace_snapshot) so flight-recorder entries
+        # and /healthz can say WHICH snapshot answered a request.
+        self._generation = 1
         self._store = None  # lazy ClusterStore, built on first update op
         self._fixture_dirty = False  # fixture lags the store until needed
         self._fixture_source = None  # lazy fixture provider (follower feed)
@@ -175,6 +192,17 @@ class CapacityServer:
     @property
     def address(self) -> tuple[str, int]:
         return self._tcp.server_address  # type: ignore[return-value]
+
+    @property
+    def generation(self) -> int:
+        """Monotonic served-snapshot generation (1 at construction)."""
+        with self._lock:
+            return self._generation
+
+    @property
+    def flight_recorder(self):
+        """The server's request flight recorder (read-mostly surface)."""
+        return self._flight
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -219,7 +247,8 @@ class CapacityServer:
     _KNOWN_OPS = frozenset(
         {
             "ping", "info", "fit", "sweep", "sweep_multi", "place",
-            "drain", "topology_spread", "plan", "reload", "update",
+            "drain", "topology_spread", "plan", "explain", "dump",
+            "reload", "update",
         }
     )
 
@@ -241,8 +270,10 @@ class CapacityServer:
         self._m_inflight.inc()
         t0 = _time.perf_counter()
         error: str | None = None
+        result = None
         try:
-            return self._dispatch_routed(msg)
+            result = self._dispatch_routed(msg)
+            return result
         except Exception as e:
             self._m_errors.labels(op=op_label, error=type(e).__name__).inc()
             error = f"{type(e).__name__}: {e}"
@@ -263,6 +294,36 @@ class CapacityServer:
                     )
                 except Exception:  # noqa: BLE001 - tracing must not fail ops
                     pass
+            self._flight_record(
+                msg, op_label, trace_id, dur, error, result
+            )
+
+    def _flight_record(
+        self, msg, op_label, trace_id, dur, error, result
+    ) -> None:
+        """One flight-recorder entry per dispatch (the failing request
+        included), then — on error, when configured — the whole ring
+        dumped as JSONL.  Strictly best-effort: observability never
+        fails the op it observes."""
+        from kubernetesclustercapacity_tpu.telemetry import flightrec
+
+        try:
+            self._flight.record(
+                op=op_label,
+                args_digest=flightrec.args_digest(msg),
+                generation=self.generation,
+                trace_id=(trace_id or "") if isinstance(trace_id, str) else "",
+                latency_ms=dur * 1e3,
+                status="error" if error else "ok",
+                result_digest=(
+                    "" if result is None else flightrec.result_digest(result)
+                ),
+                error=error,
+            )
+            if error and self._flight_dump_path:
+                self._flight.dump_jsonl(self._flight_dump_path)
+        except Exception:  # noqa: BLE001 - recorder must not fail ops
+            pass
 
     def _dispatch_routed(self, msg: dict) -> dict | str:
         op = msg.get("op")
@@ -281,7 +342,7 @@ class CapacityServer:
                 raise PermissionError("missing or invalid auth token")
         if op in (
             "fit", "sweep", "sweep_multi", "place", "drain",
-            "topology_spread", "plan",
+            "topology_spread", "plan", "explain",
         ):
             # Bounded concurrency for the compute ops: each holds device
             # dispatch + host packing; unbounded fan-in from one noisy
@@ -402,6 +463,10 @@ class CapacityServer:
             return self._op_topology_spread(msg, snap, fixture)
         if op == "plan":
             return self._op_plan(msg, snap, fixture)
+        if op == "explain":
+            return self._op_explain(msg, snap, implicit_mask)
+        if op == "dump":
+            return self._op_dump()
         if op == "reload":
             return self._op_reload(msg, snap)
         if op == "update":
@@ -832,6 +897,58 @@ class CapacityServer:
             "satisfiable": plan.satisfiable,
         }
 
+    def _op_explain(
+        self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
+    ) -> dict:
+        """Bottleneck attribution over the wire: the same six flag fields
+        as fit, answered with WHY — the binding constraint per node, the
+        binding histogram, the saturation summary, and the marginal
+        ("+1 replica") analysis.  Honors the served semantics and the
+        same implicit strict-mode taint mask the fit/sweep ops apply, so
+        the explanation explains the numbers those ops actually return.
+        """
+        from kubernetesclustercapacity_tpu.explain import explain_snapshot
+        from kubernetesclustercapacity_tpu.report import (
+            explain_json_report,
+            explain_table_report,
+        )
+
+        scenario = self._scenario_from_msg(msg)
+        grid = ScenarioGrid.from_scenarios([scenario])
+        result = explain_snapshot(
+            snap, grid, mode=snap.semantics, node_mask=implicit_mask
+        )
+        total = int(result.totals[0])
+        out = {
+            "total": total,
+            "schedulable": total >= scenario.replicas,
+            "mode": result.mode,
+            "binding": result.binding_names(0),
+            "binding_counts": result.binding_counts(0),
+            "marginal": result.marginal(0),
+            "saturation": result.saturation(0),
+        }
+        output = msg.get("output")
+        if output == "table":
+            out["report"] = explain_table_report(result)
+        elif output == "json":
+            out["report"] = explain_json_report(result)
+        return out
+
+    def _op_dump(self) -> dict:
+        """The flight recorder over the wire: the last K dispatched
+        requests (this ``dump`` itself lands in the ring only after its
+        own dispatch finishes, so the returned records end at the
+        request before it)."""
+        records = self._flight.records()
+        return {
+            "records": records,
+            "count": len(records),
+            "capacity": self._flight.capacity,
+            "dropped": self._flight.dropped,
+            "generation": self.generation,
+        }
+
     def _op_sweep(
         self,
         msg: dict,
@@ -999,6 +1116,7 @@ class CapacityServer:
             self._store = None  # stale after a wholesale replace
             self._fixture_dirty = False
             self._implicit_mask = mask
+            self._generation += 1
 
     def _op_reload(self, msg: dict, snap: ClusterSnapshot) -> dict:
         """``snap`` is the dispatch's lock-captured snapshot — reading
@@ -1090,6 +1208,7 @@ class CapacityServer:
                 snap = self.snapshot = self._store.snapshot()
                 self._fixture_dirty = True  # rebuilt on demand (cpu fit)
                 self._implicit_mask = _implicit_taint_mask(snap)
+                self._generation += 1
         return {
             "nodes": snap.n_nodes,
             "healthy_nodes": int(np.sum(snap.healthy)),
@@ -1141,6 +1260,18 @@ def main(argv=None) -> int:
                    metavar="PATH",
                    help="append one JSONL span per dispatched request "
                         "(trace_id, op, duration, status) to PATH")
+    p.add_argument("-trace-log-max-bytes", type=int, default=0,
+                   dest="trace_log_max_bytes", metavar="N",
+                   help="rotate the -trace-log file to PATH.1 once it "
+                        "exceeds N bytes (0 = unbounded)")
+    p.add_argument("-flight-records", type=int, default=256,
+                   dest="flight_records", metavar="K",
+                   help="flight-recorder depth: remember the last K "
+                        "dispatched requests (served by the dump op)")
+    p.add_argument("-flight-dump", default=None, dest="flight_dump",
+                   metavar="PATH",
+                   help="append the flight recorder as JSONL to PATH "
+                        "whenever a dispatch raises")
     args = p.parse_args(argv)
 
     import os as _os
@@ -1197,6 +1328,13 @@ def main(argv=None) -> int:
     except Exception as e:
         print(f"ERROR : {e}", file=sys.stderr)
         return 1
+    from kubernetesclustercapacity_tpu.telemetry.tracing import TraceLog
+
+    trace_log = None
+    if args.trace_log:
+        trace_log = TraceLog(
+            args.trace_log, max_bytes=max(args.trace_log_max_bytes, 0)
+        )
     server = CapacityServer(
         snap, host=args.host, port=args.port, fixture=fixture,
         auth_token=auth_token, max_inflight=args.max_inflight,
@@ -1205,13 +1343,29 @@ def main(argv=None) -> int:
         # the info op, so a client can see a struggling sync loop.
         stats_source=follower.stats if follower is not None else None,
         registry=REGISTRY,
-        trace_log=args.trace_log,
+        trace_log=trace_log,
+        flight_records=max(args.flight_records, 1),
+        flight_dump_path=args.flight_dump,
     )
     metrics_server = None
     if args.metrics_port:
         from kubernetesclustercapacity_tpu.telemetry.exposition import (
             start_metrics_server,
         )
+
+        def _healthz_status() -> dict:
+            # Snapshot freshness evidence for load balancers: the served
+            # generation always, and — when a follower feeds this
+            # server — how long ago the last full relist completed, so a
+            # follower that still answers liveness but stopped syncing
+            # is detectable from the scrape side alone.
+            out = {"snapshot_generation": server.generation}
+            if follower is not None:
+                out["follower"] = {
+                    "last_relist_age_s": follower.last_relist_age_s(),
+                    "fatal": follower.fatal,
+                }
+            return out
 
         try:
             metrics_server = start_metrics_server(
@@ -1225,6 +1379,7 @@ def main(argv=None) -> int:
                     if follower is not None
                     else None
                 ),
+                status=_healthz_status,
             )
         except OSError as e:
             print(f"ERROR : cannot bind metrics port: {e}", file=sys.stderr)

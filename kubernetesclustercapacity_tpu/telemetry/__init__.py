@@ -41,3 +41,12 @@ from kubernetesclustercapacity_tpu.telemetry.tracing import (  # noqa: F401
     new_span_id,
     new_trace_id,
 )
+from kubernetesclustercapacity_tpu.telemetry.flightrec import (  # noqa: F401
+    FlightRecorder,
+    args_digest,
+    result_digest,
+)
+from kubernetesclustercapacity_tpu.telemetry.compilewatch import (  # noqa: F401
+    observe_dispatch,
+    seen_kernels,
+)

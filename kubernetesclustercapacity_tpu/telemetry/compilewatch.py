@@ -1,0 +1,99 @@
+"""JAX compile visibility: first-call (compile) vs steady-state latency.
+
+A jitted kernel's first dispatch pays trace + XLA/Mosaic compile — on
+this stack that is seconds against a sub-millisecond steady state, and a
+recompile storm (shape churn, cache eviction) looks exactly like a
+latency regression unless the two are tracked apart.  This module is the
+one place that split lives: every auto-dispatch entry point
+(:func:`..ops.fit.sweep_snapshot`, :func:`..ops.pallas_fit.sweep_auto`,
+:func:`..ops.pallas_multi.sweep_multi_auto`) reports its host-timed
+dispatch here, and the FIRST observation per kernel label is recorded as
+the compile (gauge + counter) while the rest feed a steady-state
+histogram.
+
+"First per label" is an approximation of "compiled": jit caches per
+(shapes, static args), so a shape change recompiles without showing up
+here — honest enough for the scrape's purpose (catching compile-time
+regressions round over round; ``bench.py`` records the exact per-shape
+compile in its own artifact).
+
+Hot-path rule inherited from the package: everything here is host-side,
+after the device sync, and every entry checks
+:func:`~.metrics.enabled` — ``KCCAP_TELEMETRY=0`` means zero registry
+calls.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kubernetesclustercapacity_tpu.telemetry.metrics import enabled
+
+__all__ = ["observe_dispatch", "seen_kernels", "reset"]
+
+_lock = threading.Lock()
+_seen: set[str] = set()
+_MET: dict | None = None
+
+
+def _metrics() -> dict:
+    global _MET
+    if _MET is None:
+        from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+
+        _MET = {
+            "compiles": REGISTRY.counter(
+                "kccap_kernel_compiles_total",
+                "First-call (trace+compile) dispatches observed, by kernel.",
+                ("kernel",),
+            ),
+            "first_call": REGISTRY.gauge(
+                "kccap_kernel_first_call_seconds",
+                "Host-timed duration of the kernel's first dispatch "
+                "(includes trace + compile), by kernel.",
+                ("kernel",),
+            ),
+            "steady": REGISTRY.histogram(
+                "kccap_kernel_steady_seconds",
+                "Host-timed steady-state (post-compile) dispatch "
+                "latency, by kernel.",
+                ("kernel",),
+            ),
+        }
+    return _MET
+
+
+def observe_dispatch(kernel: str, seconds: float) -> str:
+    """Record one host-timed dispatch of ``kernel``.
+
+    Returns ``"compile"`` for the first observation of this kernel label
+    in the process, ``"steady"`` after, ``"disabled"`` when telemetry is
+    off (in which case nothing touches the registry).
+    """
+    if not enabled():
+        return "disabled"
+    with _lock:
+        first = kernel not in _seen
+        if first:
+            _seen.add(kernel)
+    m = _metrics()
+    if first:
+        m["compiles"].labels(kernel=kernel).inc()
+        m["first_call"].labels(kernel=kernel).set(float(seconds))
+        return "compile"
+    m["steady"].labels(kernel=kernel).observe(float(seconds))
+    return "steady"
+
+
+def seen_kernels() -> tuple[str, ...]:
+    """Kernel labels that have dispatched at least once (sorted)."""
+    with _lock:
+        return tuple(sorted(_seen))
+
+
+def reset() -> None:
+    """Forget which kernels have compiled (tests / operators re-arming
+    after a deliberate cache flush).  Registry values are left alone —
+    counters are monotonic by contract."""
+    with _lock:
+        _seen.clear()

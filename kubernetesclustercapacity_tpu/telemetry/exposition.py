@@ -90,6 +90,14 @@ class MetricsServer:
     the embedding process considers itself live; a raise counts as
     unhealthy (a health check that can crash the server it reports on
     would be worse than no check).
+
+    ``status`` is an optional zero-arg callable returning a JSON-able
+    dict merged into the ``/healthz`` body — the embedder's freshness
+    evidence (snapshot generation, follower last-relist age) so a load
+    balancer can detect a *stuck* follower behind a liveness check that
+    still answers.  A raise surfaces as ``{"status_error": ...}`` and
+    flips the reply to 503: a status source that cannot report is
+    indistinguishable from a wedged feed.
     """
 
     def __init__(
@@ -99,11 +107,13 @@ class MetricsServer:
         host: str = "127.0.0.1",
         port: int = 0,
         healthy=None,
+        status=None,
     ) -> None:
         import http.server
 
         self.registry = registry
         self._healthy = healthy
+        self._status = status
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -119,7 +129,17 @@ class MetricsServer:
                             ok = bool(outer._healthy())
                         except Exception:  # noqa: BLE001 - check != crash
                             ok = False
-                    body = json.dumps({"ok": ok}).encode()
+                    payload = {"ok": ok}
+                    if outer._status is not None:
+                        try:
+                            payload.update(outer._status() or {})
+                        except Exception as e:  # noqa: BLE001 - see class doc
+                            ok = False
+                            payload["ok"] = False
+                            payload["status_error"] = (
+                                f"{type(e).__name__}: {e}"
+                            )
+                    body = json.dumps(payload).encode()
                     self._reply(200 if ok else 503, "application/json", body)
                 else:
                     self._reply(404, "text/plain", b"not found\n")
@@ -168,9 +188,10 @@ def start_metrics_server(
     host: str = "127.0.0.1",
     port: int = 0,
     healthy=None,
+    status=None,
 ) -> MetricsServer:
     """Construct AND start a :class:`MetricsServer` (the one-liner every
     embedder wants; ``port=0`` picks a free port — read ``.address``)."""
     return MetricsServer(
-        registry, host=host, port=port, healthy=healthy
+        registry, host=host, port=port, healthy=healthy, status=status
     ).start()

@@ -40,10 +40,20 @@ class TraceLog:
     lock so concurrent dispatch threads can never interleave bytes.
     Opened lazily (first record) so constructing a server with a trace
     path that never traces costs nothing, and close() is idempotent.
+
+    ``max_bytes`` caps on-disk growth with one-deep rotation: when an
+    append pushes the file past the cap, it is renamed to ``PATH.1``
+    (clobbering any previous ``.1``) and a fresh file starts — a
+    long-lived server cannot fill the disk, and the most recent ~2x
+    ``max_bytes`` of spans always survive.  ``0`` (the default) keeps
+    the historical unbounded behavior.
     """
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, *, max_bytes: int = 0) -> None:
+        if max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
         self.path = path
+        self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
         self._fh = None
         self._closed = False
@@ -57,6 +67,22 @@ class TraceLog:
                 self._fh = open(self.path, "a", encoding="utf-8")
             self._fh.write(line + "\n")
             self._fh.flush()
+            if self.max_bytes and self._fh.tell() > self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Rename the full log to ``.1`` and reopen fresh (lock held).
+
+        The record that tripped the cap stays in the rotated file — a
+        span is never torn across the boundary, and a single oversized
+        span rotates rather than wedging the log.
+        """
+        try:
+            self._fh.close()
+        finally:
+            self._fh = None
+        os.replace(self.path, self.path + ".1")
+        self._fh = open(self.path, "a", encoding="utf-8")
 
     def close(self) -> None:
         with self._lock:

@@ -251,6 +251,44 @@ def doctor_report(
             return " ".join(parts)
 
         check("capacity service", _service)
+
+        # The service's flight recorder: its last-K request history over
+        # the dump op — one line of "what was this server just doing"
+        # before anyone attaches a debugger.  Same short budgets as the
+        # info probe; separate connection so a dump-op failure cannot
+        # contaminate the resilience line above.
+        def _flight():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                dump = c.dump()
+            records = dump.get("records", [])
+            parts = [
+                f"ok: {dump.get('count')}/{dump.get('capacity')} records",
+                f"generation={dump.get('generation')}",
+                f"dropped={dump.get('dropped')}",
+            ]
+            errors = sum(1 for r in records if r.get("status") == "error")
+            if errors:
+                parts.append(f"errors={errors}")
+            if records:
+                last = records[-1]
+                parts.append(
+                    f"last={last.get('op')}/{last.get('status')} "
+                    f"{last.get('latency_ms')}ms"
+                )
+            return " ".join(parts)
+
+        check("flight recorder", _flight)
     return checks
 
 

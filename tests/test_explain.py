@@ -1,0 +1,438 @@
+"""Explainability: vectorized attribution vs a brute-force re-derivation.
+
+The oracle here is deliberately independent: a per-node pure-Python loop
+that re-implements the mode semantics (uint64 CPU views, Go wrap/trunc
+memory math, the Q1 conditional pod-cap overwrite, strict clamping) and
+the documented attribution rule (first minimum in cpu ≺ memory ≺ pods
+order; health/mask overrides).  Marginal answers are checked against
+reality, not against a formula: the reported increment must actually
+buy +1 when the full evaluator re-runs the node, one less must not, and
+no other node may offer a cheaper verified increment.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import kubernetesclustercapacity_tpu as kcc
+from kubernetesclustercapacity_tpu.explain import (
+    BINDING_CPU,
+    BINDING_MASKED,
+    BINDING_MEMORY,
+    BINDING_NAMES,
+    BINDING_PODS,
+    BINDING_UNHEALTHY,
+    explain_snapshot,
+)
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "kind-3node.json"
+)
+
+_U64 = 1 << 64
+
+
+def _i64(v: int) -> int:
+    v %= _U64
+    return v - _U64 if v >= 1 << 63 else v
+
+
+def _go_trunc_div(a: int, b: int) -> int:
+    """Go int64 division: truncate toward zero (sane-divisor domain)."""
+    q = abs(a) // abs(b)
+    return q if (a < 0) == (b < 0) else -q
+
+
+def brute_force_explain(snap, cpu_req, mem_req, mode, node_mask=None):
+    """Independent per-node re-derivation of (fit, binding code)."""
+    cr = int(cpu_req) % _U64
+    mr = int(mem_req)
+    fits, codes = [], []
+    for i in range(snap.n_nodes):
+        ac = int(snap.alloc_cpu_milli[i]) % _U64
+        uc = int(snap.used_cpu_req_milli[i]) % _U64
+        cpu_fit = 0 if ac <= uc else _i64((ac - uc) // cr)
+        am = int(snap.alloc_mem_bytes[i])
+        um = int(snap.used_mem_req_bytes[i])
+        mem_fit = (
+            0 if am <= um else _i64(_go_trunc_div(_i64(am - um), mr))
+        )
+        ap = int(snap.alloc_pods[i])
+        pc = int(snap.pods_count[i])
+        healthy = bool(snap.healthy[i])
+        pre = min(cpu_fit, mem_fit)
+        if mode == "reference":
+            if pre >= ap:
+                fit, code = ap - pc, BINDING_PODS
+            else:
+                fit = pre
+                code = BINDING_CPU if cpu_fit <= mem_fit else BINDING_MEMORY
+        else:
+            slots = max(ap - pc, 0)
+            fit = max(min(pre, slots), 0)
+            if not healthy:
+                fit = 0
+            if cpu_fit <= mem_fit and cpu_fit <= slots:
+                code = BINDING_CPU
+            elif mem_fit <= slots:
+                code = BINDING_MEMORY
+            else:
+                code = BINDING_PODS
+        if not healthy:
+            code = BINDING_UNHEALTHY
+        if node_mask is not None and not bool(node_mask[i]):
+            fit, code = 0, BINDING_MASKED
+        fits.append(fit)
+        codes.append(code)
+    return np.asarray(fits, dtype=np.int64), np.asarray(codes)
+
+
+def random_snapshot(n, seed, *, q1_heavy=False):
+    """A synthetic snapshot mutated to hit every attribution branch:
+    unhealthy nodes, saturated (used > alloc) rows, and tiny/negative
+    pod headroom so the Q1 overwrite fires (including its negative
+    ``alloc_pods - pods_count`` replacement)."""
+    rng = np.random.default_rng(seed)
+    snap = kcc.synthetic_snapshot(n, seed=seed)
+    unhealthy = rng.random(n) < 0.1
+    snap.healthy[unhealthy] = False
+    sat = rng.random(n) < 0.15  # memory-saturated rows
+    snap.used_mem_req_bytes[sat] = snap.alloc_mem_bytes[sat] + rng.integers(
+        0, 1 << 20, size=int(sat.sum())
+    )
+    if q1_heavy:
+        # Small pod caps vs pod counts: min(cpu_fit, mem_fit) >= alloc_pods
+        # fires the Q1 overwrite, sometimes with a NEGATIVE replacement.
+        few = rng.random(n) < 0.5
+        snap.alloc_pods[few] = rng.integers(0, 4, size=int(few.sum()))
+        snap.pods_count[few] = rng.integers(0, 6, size=int(few.sum()))
+    return snap
+
+
+class TestAttributionProperty:
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_brute_force_1k_nodes(self, mode, seed):
+        snap = random_snapshot(1000, seed, q1_heavy=(seed % 2 == 0))
+        grid = kcc.random_scenario_grid(4, seed=seed + 100)
+        result = explain_snapshot(snap, grid, mode=mode)
+        assert result.size == 4
+        for s in range(grid.size):
+            bf_fits, bf_codes = brute_force_explain(
+                snap,
+                int(grid.cpu_request_milli[s]),
+                int(grid.mem_request_bytes[s]),
+                mode,
+            )
+            np.testing.assert_array_equal(result.fits[s], bf_fits)
+            np.testing.assert_array_equal(result.binding[s], bf_codes)
+
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    def test_fits_bit_identical_to_fit_kernel(self, mode):
+        snap = random_snapshot(257, 7, q1_heavy=True)
+        grid = kcc.random_scenario_grid(8, seed=9)
+        result = explain_snapshot(snap, grid, mode=mode)
+        for s in range(grid.size):
+            kernel = np.asarray(
+                fit_per_node(
+                    snap.alloc_cpu_milli,
+                    snap.alloc_mem_bytes,
+                    snap.alloc_pods,
+                    snap.used_cpu_req_milli,
+                    snap.used_mem_req_bytes,
+                    snap.pods_count,
+                    snap.healthy,
+                    int(grid.cpu_request_milli[s]),
+                    int(grid.mem_request_bytes[s]),
+                    mode=mode,
+                )
+            )
+            np.testing.assert_array_equal(result.fits[s], kernel)
+
+    def test_q1_overwrite_attributed_to_pods(self):
+        # One node where cpu/mem allow 10 but only 2 pod slots exist:
+        # reference overwrites (fit = 2 - 5 = -3!), strict clamps to 0.
+        snap = kcc.ClusterSnapshot(
+            names=["n0"],
+            alloc_cpu_milli=[10_000],
+            alloc_mem_bytes=[10 << 30],
+            alloc_pods=[2],
+            used_cpu_req_milli=[0],
+            used_cpu_lim_milli=[0],
+            used_mem_req_bytes=[0],
+            used_mem_lim_bytes=[0],
+            pods_count=[5],
+            healthy=[True],
+        )
+        grid = kcc.ScenarioGrid(
+            cpu_request_milli=[1000], mem_request_bytes=[1 << 30],
+            replicas=[1],
+        )
+        ref = explain_snapshot(snap, grid, mode="reference")
+        assert int(ref.fits[0][0]) == -3
+        assert int(ref.binding[0][0]) == BINDING_PODS
+        strict = explain_snapshot(snap, grid, mode="strict")
+        assert int(strict.fits[0][0]) == 0
+        assert int(strict.binding[0][0]) == BINDING_PODS
+
+    def test_unhealthy_and_masked_codes(self):
+        snap = random_snapshot(64, 3)
+        mask = np.ones(64, dtype=bool)
+        mask[:5] = False
+        grid = kcc.random_scenario_grid(2, seed=5)
+        result = explain_snapshot(
+            snap, grid, mode="strict", node_mask=mask
+        )
+        names = result.binding_names(0)
+        for i in range(64):
+            if not mask[i]:
+                assert names[i] == "masked"
+                assert result.fits[0][i] == 0
+            elif not snap.healthy[i]:
+                assert names[i] == "unhealthy"
+        counts = result.binding_counts(0)
+        assert counts["masked"] == 5
+        assert sum(counts.values()) == 64
+        assert set(counts) == set(BINDING_NAMES)
+
+
+def _apply_delta(snap, i, resource, delta):
+    """(alloc_cpu, alloc_mem, alloc_pods) for node i with +delta on R."""
+    ac = int(snap.alloc_cpu_milli[i])
+    am = int(snap.alloc_mem_bytes[i])
+    ap = int(snap.alloc_pods[i])
+    if resource == "cpu":
+        ac += delta
+    elif resource == "memory":
+        am += delta
+    else:
+        ap += delta
+    return ac, am, ap
+
+
+def _node_fit(snap, i, s, grid, mode, resource=None, delta=0):
+    ac, am, ap = _apply_delta(snap, i, resource, delta) if resource else (
+        int(snap.alloc_cpu_milli[i]),
+        int(snap.alloc_mem_bytes[i]),
+        int(snap.alloc_pods[i]),
+    )
+    return fit_arrays_python(
+        [ac], [am], [ap],
+        [int(snap.used_cpu_req_milli[i])],
+        [int(snap.used_mem_req_bytes[i])],
+        [int(snap.pods_count[i])],
+        int(grid.cpu_request_milli[s]),
+        int(grid.mem_request_bytes[s]),
+        mode=mode,
+        healthy=[bool(snap.healthy[i])],
+    )[0]
+
+
+class TestMarginal:
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_marginal_verified_minimal_and_globally_best(self, mode, seed):
+        snap = random_snapshot(200, seed, q1_heavy=True)
+        grid = kcc.random_scenario_grid(2, seed=seed + 50)
+        result = explain_snapshot(snap, grid, mode=mode)
+        for s in range(grid.size):
+            marginal = result.marginal(s, verify_limit=None)
+            assert set(marginal) == {"cpu", "memory", "pods"}
+            for resource, m in marginal.items():
+                if m is None:
+                    continue
+                i = m["node_index"]
+                before = int(result.fits[s][i])
+                # The reported delta delivers +1 under FULL semantics...
+                after = _node_fit(
+                    snap, i, s, grid, mode, resource, m["delta"]
+                )
+                assert after > before, (mode, s, resource, m)
+                # ...and is minimal on that node at integer resolution.
+                if m["delta"] > 1:
+                    almost = _node_fit(
+                        snap, i, s, grid, mode, resource, m["delta"] - 1
+                    )
+                    assert almost <= before, (mode, s, resource, m)
+            # Brute-force oracle: no node's verified minimal increment
+            # beats the reported one (scan ALL nodes independently).
+            for resource, m in marginal.items():
+                best = self._brute_best(snap, s, grid, mode, result, resource)
+                if m is None:
+                    assert best is None, (mode, s, resource, best)
+                else:
+                    assert best is not None
+                    assert best[0] == m["delta"], (mode, s, resource)
+
+    @staticmethod
+    def _brute_best(snap, s, grid, mode, result, resource):
+        """Independent minimal verified increment for resource R."""
+        best = None
+        cr = int(grid.cpu_request_milli[s]) % _U64
+        mr = int(grid.mem_request_bytes[s])
+        for i in range(snap.n_nodes):
+            if not snap.healthy[i]:
+                continue
+            before = int(result.fits[s][i])
+            target = before + 1
+            if resource == "cpu":
+                head = (int(snap.alloc_cpu_milli[i]) % _U64) - (
+                    int(snap.used_cpu_req_milli[i]) % _U64
+                )
+                delta = target * cr - head
+            elif resource == "memory":
+                head = int(snap.alloc_mem_bytes[i]) - int(
+                    snap.used_mem_req_bytes[i]
+                )
+                delta = target * mr - head
+            else:
+                if mode == "strict":
+                    delta = target - max(
+                        int(snap.alloc_pods[i]) - int(snap.pods_count[i]), 0
+                    )
+                else:
+                    delta = 1
+            if delta <= 0 or delta > 1 << 62:
+                continue
+            if best is not None and delta >= best[0]:
+                continue  # cannot improve; skip the expensive re-eval
+            if _node_fit(snap, i, s, grid, mode, resource, delta) > before:
+                best = (delta, i)
+        return best
+
+    def test_reference_q1_pods_marginal_is_one_slot(self):
+        # cpu/mem allow 10, cap is 3 with 1 pod running: fit = 3-1 = 2;
+        # +1 allocatable pod slot (and nothing else) buys the next one.
+        snap = kcc.ClusterSnapshot(
+            names=["n0"],
+            alloc_cpu_milli=[10_000],
+            alloc_mem_bytes=[10 << 30],
+            alloc_pods=[3],
+            used_cpu_req_milli=[0],
+            used_cpu_lim_milli=[0],
+            used_mem_req_bytes=[0],
+            used_mem_lim_bytes=[0],
+            pods_count=[1],
+            healthy=[True],
+        )
+        grid = kcc.ScenarioGrid(
+            cpu_request_milli=[1000], mem_request_bytes=[1 << 30],
+            replicas=[1],
+        )
+        result = explain_snapshot(snap, grid, mode="reference")
+        assert int(result.fits[0][0]) == 2
+        m = result.marginal(0)
+        assert m["pods"] == {
+            "delta": 1, "node": "n0", "node_index": 0, "unit": "slots",
+        }
+        # cpu/memory already clear the cap: no increment there buys +1.
+        assert m["cpu"] is None and m["memory"] is None
+
+
+class TestExplainSurfaces:
+    def test_headroom_and_saturation_shapes(self):
+        snap = random_snapshot(64, 11)
+        grid = kcc.random_scenario_grid(2, seed=3)
+        result = explain_snapshot(snap, grid, mode="strict")
+        head = result.headroom(0)
+        assert set(head) == {"cpu_milli", "mem_bytes", "pod_slots"}
+        for arr in head.values():
+            assert arr.shape == (64,)
+            assert (arr >= 0).all()
+        sat = result.saturation(1)
+        assert sat["nodes"] == 64
+        assert set(sat["binding_counts"]) == set(BINDING_NAMES)
+        assert 0 <= sat["cpu_utilization"]["p50"] <= sat["cpu_utilization"]["max"]
+        # Saturated rows exist by construction (used_mem > alloc_mem).
+        assert sat["mem_utilization"]["saturated_nodes"] >= 1
+
+    def test_report_renderers(self):
+        from kubernetesclustercapacity_tpu.fixtures import load_fixture
+        from kubernetesclustercapacity_tpu.report import (
+            explain_json_report,
+            explain_table_report,
+        )
+
+        snap = kcc.snapshot_from_fixture(load_fixture(FIXTURE))
+        scenario = kcc.scenario_from_flags(
+            cpuRequests="200m", memRequests="250mb", replicas="10"
+        )
+        grid = kcc.ScenarioGrid.from_scenarios([scenario])
+        result = explain_snapshot(snap, grid)
+        table = explain_table_report(result)
+        assert "BINDING" in table and "marginal (+1 replica):" in table
+        assert "total possible replicas: 109" in table
+        doc = json.loads(explain_json_report(result))
+        assert doc["total_possible_replicas"] == 109
+        assert len(doc["nodes"]) == snap.n_nodes
+        assert doc["binding_counts"]["cpu"] >= 1
+        assert set(doc["marginal"]) == {"cpu", "memory", "pods"}
+
+    def test_service_explain_op(self):
+        from kubernetesclustercapacity_tpu.fixtures import load_fixture
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        fixture = load_fixture(FIXTURE)
+        snap = kcc.snapshot_from_fixture(fixture)
+        server = CapacityServer(snap, port=0, fixture=fixture)
+        server.start()
+        try:
+            with CapacityClient(*server.address) as client:
+                out = client.explain(
+                    cpuRequests="200m", memRequests="250mb", replicas="10"
+                )
+                fit = client.fit(
+                    cpuRequests="200m", memRequests="250mb", replicas="10"
+                )
+                # explain explains the numbers fit actually returns.
+                assert out["total"] == fit["total"]
+                assert out["schedulable"] == fit["schedulable"]
+                assert len(out["binding"]) == snap.n_nodes
+                assert set(out["binding_counts"]) == set(BINDING_NAMES)
+                assert set(out["marginal"]) == {"cpu", "memory", "pods"}
+                assert "report" not in out
+                rendered = client.explain(
+                    cpuRequests="200m", memRequests="250mb",
+                    replicas="10", output="table",
+                )
+                assert "BINDING" in rendered["report"]
+        finally:
+            server.shutdown()
+
+    def test_cli_explain_flag(self, capsys):
+        from kubernetesclustercapacity_tpu.cli import main
+
+        rc = main(
+            [
+                "-snapshot", FIXTURE, "-cpuRequests=200m",
+                "-memRequests=250mb", "-replicas=10", "-explain",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "BINDING" in out and "marginal (+1 replica):" in out
+        rc = main(
+            [
+                "-snapshot", FIXTURE, "-cpuRequests=200m",
+                "-memRequests=250mb", "-replicas=10", "-explain",
+                "-output", "json",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["total_possible_replicas"] == 109
+
+    def test_cli_explain_rejects_cpu_backend(self, capsys):
+        from kubernetesclustercapacity_tpu.cli import main
+
+        rc = main(["-snapshot", FIXTURE, "-explain", "-backend", "cpu"])
+        assert rc == 1
+        assert "-backend tpu" in capsys.readouterr().out

@@ -1,0 +1,240 @@
+"""Flight recorder: ring semantics, digests, JSONL dumps, and the
+server wiring (dump op, on-error dump, snapshot generation)."""
+
+import json
+import os
+import threading
+
+import pytest
+
+import kubernetesclustercapacity_tpu as kcc
+from kubernetesclustercapacity_tpu.fixtures import load_fixture
+from kubernetesclustercapacity_tpu.service import (
+    CapacityClient,
+    CapacityServer,
+)
+from kubernetesclustercapacity_tpu.telemetry.flightrec import (
+    FlightRecorder,
+    args_digest,
+    result_digest,
+)
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "kind-3node.json"
+)
+
+
+def _rec(fr, op="ping", status="ok", **kw):
+    fr.record(
+        op=op,
+        args_digest="a" * 16,
+        generation=1,
+        latency_ms=1.0,
+        status=status,
+        **kw,
+    )
+
+
+class TestRing:
+    def test_capacity_and_drop_accounting(self):
+        fr = FlightRecorder(capacity=3)
+        for i in range(5):
+            _rec(fr, op=f"op{i}")
+        records = fr.records()
+        assert len(fr) == 3
+        assert [r["op"] for r in records] == ["op2", "op3", "op4"]
+        assert fr.dropped == 2
+        assert [r["seq"] for r in records] == [3, 4, 5]
+        fr.clear()
+        assert len(fr) == 0 and fr.dropped == 0
+
+    def test_bad_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(capacity=0)
+
+    def test_concurrent_records_all_land(self):
+        fr = FlightRecorder(capacity=10_000)
+        n_threads, per = 8, 250
+
+        def worker(t):
+            for _ in range(per):
+                _rec(fr, op=f"t{t}")
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        records = fr.records()
+        assert len(records) == n_threads * per
+        # seq is a gapless 1..N permutation ordered by ring position.
+        assert sorted(r["seq"] for r in records) == list(
+            range(1, n_threads * per + 1)
+        )
+
+    def test_records_are_copies(self):
+        fr = FlightRecorder()
+        _rec(fr)
+        fr.records()[0]["op"] = "tampered"
+        assert fr.records()[0]["op"] == "ping"
+
+    def test_error_field_only_on_error(self):
+        fr = FlightRecorder()
+        _rec(fr, status="ok")
+        _rec(fr, status="error", error="ValueError: boom")
+        ok, err = fr.records()
+        assert "error" not in ok
+        assert err["error"] == "ValueError: boom"
+
+
+class TestDigests:
+    def test_token_trace_deadline_never_digested(self):
+        base = {"op": "fit", "cpuRequests": "200m"}
+        noisy = dict(
+            base, token="secret", trace_id="t" * 32, deadline=123.0
+        )
+        assert args_digest(base) == args_digest(noisy)
+        assert args_digest(base) != args_digest(
+            dict(base, cpuRequests="300m")
+        )
+
+    def test_digest_shape_and_determinism(self):
+        d = args_digest({"op": "sweep", "random": {"n": 8, "seed": 1}})
+        assert len(d) == 16 and int(d, 16) >= 0
+        assert d == args_digest({"random": {"seed": 1, "n": 8}, "op": "sweep"})
+
+    def test_result_digest_handles_unjsonable(self):
+        class Weird:
+            pass
+
+        assert len(result_digest({"x": Weird()})) == 16
+
+
+class TestDumpJsonl:
+    def test_round_trip_with_header(self, tmp_path):
+        fr = FlightRecorder(capacity=2)
+        for i in range(3):
+            _rec(fr, op=f"op{i}")
+        path = str(tmp_path / "flight.jsonl")
+        assert fr.dump_jsonl(path) == 3  # header + 2 records
+        lines = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+        assert lines[0] == {
+            "flight_dump": True,
+            "ts": lines[0]["ts"],
+            "records": 2,
+            "dropped": 1,
+            "capacity": 2,
+        }
+        assert [r["op"] for r in lines[1:]] == ["op1", "op2"]
+
+    def test_appends_across_dumps(self, tmp_path):
+        fr = FlightRecorder()
+        _rec(fr)
+        path = str(tmp_path / "flight.jsonl")
+        fr.dump_jsonl(path)
+        fr.dump_jsonl(path)
+        lines = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+        assert sum(1 for ln in lines if ln.get("flight_dump")) == 2
+
+
+@pytest.fixture()
+def server(tmp_path):
+    fixture = load_fixture(FIXTURE)
+    snap = kcc.snapshot_from_fixture(fixture)
+    srv = CapacityServer(
+        snap,
+        port=0,
+        fixture=fixture,
+        flight_records=8,
+        flight_dump_path=str(tmp_path / "flight.jsonl"),
+    )
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+class TestServerWiring:
+    def test_dump_op_round_trips_requests(self, server):
+        with CapacityClient(*server.address) as c:
+            c.ping()
+            c.fit(cpuRequests="200m", memRequests="250mb", replicas="10")
+            c.sweep(random={"n": 4, "seed": 1}, kernel="exact")
+            dump = c.dump()
+        assert dump["capacity"] == 8
+        assert dump["generation"] == 1
+        ops = [r["op"] for r in dump["records"]]
+        assert ops == ["ping", "fit", "sweep"]
+        for r in dump["records"]:
+            assert r["status"] == "ok"
+            assert len(r["args_digest"]) == 16
+            assert len(r["result_digest"]) == 16
+            assert r["generation"] == 1
+            assert r["latency_ms"] >= 0
+
+    def test_identical_requests_share_args_digest(self, server):
+        with CapacityClient(*server.address) as c:
+            c.fit(cpuRequests="200m", memRequests="250mb", replicas="10")
+            c.fit(cpuRequests="200m", memRequests="250mb", replicas="10")
+            c.fit(cpuRequests="300m", memRequests="250mb", replicas="10")
+            dump = c.dump()
+        a, b, d = [r["args_digest"] for r in dump["records"]]
+        assert a == b != d
+
+    def test_trace_id_rides_the_record(self, server):
+        with CapacityClient(*server.address, trace=True) as c:
+            c.ping()
+            tid = c.last_trace_id
+            dump = c.dump()
+        assert dump["records"][0]["trace_id"] == tid
+
+    def test_error_recorded_and_dumped(self, server, tmp_path):
+        dump_path = str(tmp_path / "flight.jsonl")
+        with CapacityClient(*server.address) as c:
+            c.ping()
+            with pytest.raises(RuntimeError):
+                c.call("no_such_op")
+            dump = c.dump()
+        bad = dump["records"][-1]
+        assert bad["op"] == "unknown"
+        assert bad["status"] == "error"
+        assert "ValueError" in bad["error"]
+        # The on-error JSONL dump fired and contains the failing request.
+        lines = [
+            json.loads(ln) for ln in open(dump_path, encoding="utf-8")
+        ]
+        assert lines[0]["flight_dump"] is True
+        assert any(r.get("status") == "error" for r in lines[1:])
+
+    def test_generation_bumps_on_update_and_reload(self, server, tmp_path):
+        fixture = load_fixture(FIXTURE)
+        path = str(tmp_path / "reload.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(fixture, fh)
+        with CapacityClient(*server.address) as c:
+            assert c.dump()["generation"] == 1
+            c.update(
+                [
+                    {
+                        "type": "MODIFIED",
+                        "kind": "Node",
+                        "object": fixture["nodes"][0],
+                    }
+                ]
+            )
+            assert c.dump()["generation"] == 2
+            c.reload(path)
+            assert c.dump()["generation"] == 3
+            # Records carry the generation they ran against.
+            gens = [r["generation"] for r in c.dump()["records"]]
+        assert gens[0] == 1 and gens[-1] == 3
+
+    def test_ring_bounded_under_load(self, server):
+        with CapacityClient(*server.address) as c:
+            for _ in range(20):
+                c.ping()
+            dump = c.dump()
+        assert dump["count"] == 8
+        assert dump["dropped"] >= 12

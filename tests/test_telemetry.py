@@ -588,3 +588,194 @@ class TestFusedPathMetrics:
         assert tel["misses"].labels(
             reason="forced_exact"
         ).value == before
+
+
+class TestTraceLogRotation:
+    """Satellite (PR 3): TraceLog grows unbounded without a cap."""
+
+    def test_rotates_past_max_bytes(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        log = TraceLog(path, max_bytes=512)
+        for i in range(64):
+            log.record(seq=i, pad="x" * 64)
+        log.close()
+        rotated = path + ".1"
+        assert os.path.exists(rotated)
+        assert os.path.getsize(path) <= 512
+        # Every record is intact in exactly one of the two files, in
+        # order, nothing torn across the boundary.
+        seqs = []
+        for p in (rotated, path):
+            for ln in open(p, encoding="utf-8"):
+                seqs.append(json.loads(ln)["seq"])
+        # The rotated file holds an older contiguous window ending where
+        # the live file begins; the live file ends at the last record.
+        assert seqs == sorted(seqs)
+        assert seqs[-1] == 63
+
+    def test_second_rotation_clobbers_first(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        log = TraceLog(path, max_bytes=128)
+        for i in range(40):
+            log.record(seq=i, pad="y" * 64)
+        log.close()
+        # One-deep rotation: exactly PATH and PATH.1 exist.
+        files = sorted(os.listdir(tmp_path))
+        assert files == ["t.jsonl", "t.jsonl.1"]
+
+    def test_zero_keeps_unbounded_behavior(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        log = TraceLog(path)  # default max_bytes=0
+        for i in range(50):
+            log.record(seq=i, pad="z" * 128)
+        log.close()
+        assert not os.path.exists(path + ".1")
+        assert len(open(path, encoding="utf-8").readlines()) == 50
+
+    def test_negative_cap_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            TraceLog(str(tmp_path / "t.jsonl"), max_bytes=-1)
+
+    def test_concurrent_writes_with_rotation_never_tear(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        log = TraceLog(path, max_bytes=2048)
+
+        def work(i):
+            for j in range(50):
+                log.record(thread=i, seq=j, pad="x" * 64)
+
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        log.close()
+        for p in (path, path + ".1"):
+            if os.path.exists(p):
+                for ln in open(p, encoding="utf-8"):
+                    json.loads(ln)  # complete JSON, never torn
+
+    def test_cli_flag_plumbs_max_bytes(self, tmp_path):
+        from kubernetesclustercapacity_tpu.cli import main
+
+        fixture = os.path.join(
+            os.path.dirname(__file__), "fixtures", "kind-3node.json"
+        )
+        path = str(tmp_path / "trace.jsonl")
+        rc = main(
+            [
+                "-snapshot", fixture, "-replicas=1",
+                "-trace-log", path, "-trace-log-max-bytes", "1",
+            ]
+        )
+        assert rc == 0
+        # Cap of 1 byte: the single span rotated out immediately.
+        assert os.path.exists(path + ".1")
+
+
+class TestHealthzStatus:
+    """Satellite (PR 3): /healthz reports snapshot freshness evidence."""
+
+    def test_status_dict_merges_into_healthz(self):
+        srv = start_metrics_server(
+            MetricsRegistry(),
+            status=lambda: {
+                "snapshot_generation": 7,
+                "follower": {"last_relist_age_s": 1.5, "fatal": None},
+            },
+        )
+        try:
+            health = json.loads(
+                urllib.request.urlopen(srv.url + "/healthz").read()
+            )
+            assert health == {
+                "ok": True,
+                "snapshot_generation": 7,
+                "follower": {"last_relist_age_s": 1.5, "fatal": None},
+            }
+        finally:
+            srv.shutdown()
+
+    def test_raising_status_is_503(self):
+        srv = start_metrics_server(
+            MetricsRegistry(), status=lambda: 1 / 0
+        )
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/healthz")
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["ok"] is False
+            assert "ZeroDivisionError" in body["status_error"]
+        finally:
+            srv.shutdown()
+
+    def test_follower_last_relist_age(self):
+        from kubernetesclustercapacity_tpu.follower import ClusterFollower
+
+        f = ClusterFollower(client_factory=lambda: None)
+        assert f.last_relist_age_s() is None  # never relisted
+        f._last_relist_t = __import__("time").monotonic() - 2.0
+        age = f.last_relist_age_s()
+        assert age is not None and age >= 2.0
+        # The pinned stats() dict shape is untouched (regression guard).
+        assert "last_relist_age_s" not in f.stats()
+
+
+class TestCompileWatch:
+    """Tentpole (PR 3): first-call compile vs steady-state per kernel."""
+
+    def test_first_observation_is_compile_rest_steady(self):
+        from kubernetesclustercapacity_tpu.telemetry import compilewatch
+        from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+
+        kernel = "test_kernel_cw_a"
+        compilewatch.reset()
+        assert compilewatch.observe_dispatch(kernel, 1.25) == "compile"
+        assert compilewatch.observe_dispatch(kernel, 0.002) == "steady"
+        assert compilewatch.observe_dispatch(kernel, 0.003) == "steady"
+        assert kernel in compilewatch.seen_kernels()
+        snap = REGISTRY.snapshot()
+        label = f'kernel="{kernel}"'
+        assert snap["kccap_kernel_first_call_seconds"]["values"][label] == 1.25
+        hist = snap["kccap_kernel_steady_seconds"]["values"][label]
+        assert hist["count"] == 2
+        assert (
+            snap["kccap_kernel_compiles_total"]["values"][label] >= 1
+        )
+
+    def test_disabled_telemetry_no_registry_traffic(self, monkeypatch):
+        from kubernetesclustercapacity_tpu.telemetry import compilewatch
+
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        compilewatch.reset()
+        kernel = "test_kernel_cw_disabled"
+        assert compilewatch.observe_dispatch(kernel, 9.9) == "disabled"
+        assert kernel not in compilewatch.seen_kernels()
+
+    def test_sweep_paths_feed_compilewatch(self):
+        import numpy as np
+
+        import kubernetesclustercapacity_tpu as kcc
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+        from kubernetesclustercapacity_tpu.ops.pallas_multi import (
+            sweep_multi_auto,
+        )
+        from kubernetesclustercapacity_tpu.telemetry import compilewatch
+        from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+
+        snap = kcc.synthetic_snapshot(64, seed=1)
+        grid = kcc.random_scenario_grid(4, seed=2)
+        sweep_snapshot(snap, grid)
+        assert "xla_int64" in compilewatch.seen_kernels()
+        alloc_rn, used_rn = snap.resource_matrix(("cpu", "memory"))
+        sweep_multi_auto(
+            alloc_rn, used_rn, snap.alloc_pods, snap.pods_count,
+            snap.healthy, np.asarray([[100, 1 << 20]]), np.asarray([1]),
+            mode="strict", force_exact=True,
+        )
+        assert "xla_int64_multi" in compilewatch.seen_kernels()
+        snapshot = REGISTRY.snapshot()
+        assert 'kernel="xla_int64"' in (
+            snapshot["kccap_kernel_first_call_seconds"]["values"]
+        )
