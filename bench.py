@@ -95,6 +95,13 @@ _MEASURE_TIMEOUT_S = max(
 )
 _PROBE_TIMEOUT_S = max(1.0, _env_num("KCC_BENCH_PROBE_TIMEOUT_S", 150, float))
 _PROBE_ENABLED = os.environ.get("KCC_BENCH_PROBE", "1") != "0"
+# When the short probe child cannot reach the backend, skip the TPU init
+# ladder entirely and go straight to the CPU fallback: BENCH_r05 showed a
+# measure child burning >600 s inside xla_bridge init that the probe had
+# already predicted.  KCC_BENCH_PROBE_GATE=0 restores the old always-dial
+# behavior (e.g. when the probe is known-flaky but the tunnel usually
+# recovers).
+_PROBE_GATE = os.environ.get("KCC_BENCH_PROBE_GATE", "1") != "0"
 _STDERR_TAIL_LINES = 20
 _CHILD_ENV = "KCC_BENCH_CHILD"
 _BOOT_MARK = "@@KCC_BENCH_CHILD_BOOTED@@"
@@ -468,15 +475,37 @@ def _parent_main() -> None:
     budget_deadline = start + _TOTAL_BUDGET_S - 45.0
 
     attempts: list[dict] = []
+    probe_failed = False
     if _PROBE_ENABLED:
         if remaining() > _PROBE_TIMEOUT_S + 60.0:
-            attempts.append(_run_probe_attempt())
+            probe = _run_probe_attempt()
+            attempts.append(probe)
+            probe_failed = probe["outcome"] != "ok"
         else:
             attempts.append(skip_record("probe"))
     last_payload = None
     ladder = _init_timeout_ladder()
     measures_run = 0
     deterministic_break = False
+    if probe_failed and _PROBE_GATE:
+        # The backend is provably unreachable from a minimal child: do
+        # not burn the (up to ~1050 s) init ladder re-proving it — fall
+        # straight through to the CPU fallback below.
+        attempts.append(
+            {
+                "kind": "measure",
+                "phase": "skipped",
+                "timeout_s": 0.0,
+                "elapsed_s": 0.0,
+                "outcome": (
+                    "skipped: backend probe failed — going straight to "
+                    "the JAX_PLATFORMS=cpu fallback "
+                    "(KCC_BENCH_PROBE_GATE=0 to re-dial anyway)"
+                ),
+                "stderr_tail": [],
+            }
+        )
+        ladder = []
     for attempt, timeout_s in enumerate(ladder):
         if remaining() < timeout_s + 60.0:
             attempts.append(skip_record("measure"))
@@ -596,6 +625,7 @@ def main() -> None:
         metrics: dict = {}
         try:
             _host_side_metrics(metrics)
+            _hot_path_metrics(metrics)
         except Exception as e:  # noqa: BLE001 - partial capture survives
             print(traceback.format_exc(), file=sys.stderr)
             metrics["host_aux_error"] = f"{type(e).__name__}: {e}"
@@ -756,6 +786,106 @@ def _host_side_metrics(out: dict | None = None) -> dict:
     else:
         out["churn_events_per_sec_10k"] = round(n_events / churn_s)
         out["churn_repacks"] = coal.flushes
+    return out
+
+
+def _hot_path_metrics(out: dict | None = None) -> dict:
+    """Device-cache, bucket-ladder and micro-batching characterization.
+
+    Runs on whatever backend the child initialized (TPU in the measure
+    child, CPU in the host-aux fallback) against small fixed shapes:
+
+    * ``devcache_hit_rate`` + first-vs-steady sweep latency: repeated
+      same-snapshot sweeps must hit the device-resident arrays (the
+      compile is pre-paid on a warm-up snapshot of the same bucket, so
+      "first" isolates the upload cost the cache removes);
+    * ``bucket_recompile_avoided``: a 1000 → 1001 node change stays
+      inside the 1024 bucket — no new per-bucket compile label may
+      appear in the compilewatch scrape;
+    * ``mean_batch_size`` + ``batch_correctness_diffs``: concurrent
+      submits through a MicroBatcher, every scattered result compared
+      against its solo sweep (must be 0 diffs).
+    """
+    import threading
+
+    if out is None:
+        out = {}
+    import kubernetesclustercapacity_tpu as kcc
+    from kubernetesclustercapacity_tpu import devcache
+    from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+    from kubernetesclustercapacity_tpu.service.batching import MicroBatcher
+    from kubernetesclustercapacity_tpu.telemetry import compilewatch
+
+    grid = kcc.random_scenario_grid(256, seed=42)
+    # Pre-pay the bucket's compile on a different snapshot so the timed
+    # "first" sweep below isolates what the cache removes: the upload.
+    sweep_snapshot(kcc.synthetic_snapshot(1000, seed=40), grid)
+
+    snap = kcc.synthetic_snapshot(1000, seed=41)
+    st0 = devcache.CACHE.stats()
+    t0 = time.perf_counter()
+    first_totals, _ = sweep_snapshot(snap, grid)
+    first_ms = (time.perf_counter() - t0) * 1e3
+    steady, steady_diffs = [], 0
+    for _ in range(5):
+        t0 = time.perf_counter()
+        totals, _ = sweep_snapshot(snap, grid)
+        steady.append((time.perf_counter() - t0) * 1e3)
+        if not np.array_equal(totals, first_totals):
+            steady_diffs += 1
+    st1 = devcache.CACHE.stats()
+    hits = st1["hits"] - st0["hits"]
+    misses = st1["misses"] - st0["misses"]
+    out["devcache_hit_rate"] = round(hits / max(hits + misses, 1), 3)
+    out["devcache_first_sweep_ms"] = round(first_ms, 3)
+    out["devcache_steady_sweep_ms"] = round(min(steady), 3)
+
+    seen0 = set(compilewatch.seen_kernels())
+    sweep_snapshot(kcc.synthetic_snapshot(1001, seed=41), grid)
+    new_labels = set(compilewatch.seen_kernels()) - seen0
+    out["bucket_recompile_avoided"] = not any(
+        k.startswith("xla_int64@n") for k in new_labels
+    )
+
+    def dispatch(_key, items):
+        combined = kcc.ScenarioGrid(
+            np.concatenate([g.cpu_request_milli for g in items]),
+            np.concatenate([g.mem_request_bytes for g in items]),
+            np.concatenate([g.replicas for g in items]),
+        )
+        totals, _ = sweep_snapshot(snap, combined)
+        res, off = [], 0
+        for g in items:
+            res.append(totals[off:off + g.size])
+            off += g.size
+        return res
+
+    batcher = MicroBatcher(dispatch, window_s=0.01, max_batch=16)
+    small = [kcc.random_scenario_grid(16, seed=100 + i) for i in range(32)]
+    results: list = [None] * len(small)
+
+    def worker(i: int) -> None:
+        results[i] = batcher.submit("hot-path", small[i])
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(small))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    batch_diffs = steady_diffs
+    for i, g in enumerate(small):
+        solo, _ = sweep_snapshot(snap, g)
+        if results[i] is None or not np.array_equal(
+            np.asarray(results[i]), solo
+        ):
+            batch_diffs += 1
+    stats = batcher.stats
+    out["mean_batch_size"] = round(stats["mean_batch_size"], 2)
+    out["batch_dispatches"] = stats["dispatches"]
+    out["batch_correctness_diffs"] = batch_diffs
     return out
 
 
@@ -1809,6 +1939,9 @@ def _run() -> None:
             ladder["placement_trace_mismatch"] = True
 
         _host_side_metrics(ladder)
+        # Hot-path subsystem metrics (devcache hit rate, bucket-recompile
+        # proof, micro-batch mean size) — the PR-4 acceptance numbers.
+        _hot_path_metrics(ladder)
 
     except Exception as e:  # noqa: BLE001 - aux must never kill the bench
         # MERGE the error: entries measured before the failing section

@@ -130,6 +130,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the run into "
                         "DIR (view with TensorBoard/Perfetto) — opt-in "
                         "compile/runtime visibility for kernel work")
+    p.add_argument("-node-bucket-floor", type=int, default=0,
+                   dest="node_bucket_floor", metavar="N",
+                   help="floor of the node-axis shape-bucket ladder for "
+                        "the exact sweep kernels (node counts pad to the "
+                        "next power of two >= the floor; 0 = keep the "
+                        "default/KCCAP_NODE_BUCKET_FLOOR setting)")
     return p
 
 
@@ -241,6 +247,11 @@ def _run_command(args) -> int:
         ScenarioError,
         scenario_from_flags,
     )
+
+    if args.node_bucket_floor > 0:
+        from kubernetesclustercapacity_tpu import devcache
+
+        devcache.set_node_bucket_floor(args.node_bucket_floor)
 
     try:
         scenario = scenario_from_flags(

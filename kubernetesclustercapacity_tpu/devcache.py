@@ -1,0 +1,320 @@
+"""Device-resident snapshot cache + the shape-bucket ladder (hot path).
+
+Every ``fit``/``sweep`` request used to re-upload the snapshot's seven
+node arrays host→device (``jnp.asarray`` inside the dispatch) and to
+compile a fresh executable whenever the node count changed by one.  The
+per-request *work* is tiny; the per-request *overhead* was the product —
+the same observation the inference-serving world made about KV caches
+and shape buckets.  This module is both fixes in one place:
+
+* **Device cache** — :class:`DeviceCache` holds already-``device_put``
+  node arrays keyed by ``(snapshot, form, shape-bucket)``.  Snapshots
+  are immutable by contract (the packers build them once; the server
+  swaps whole objects on reload/update), so identity is the cache key:
+  a per-snapshot token is lazily attached and entries die with LRU
+  eviction or an explicit :meth:`DeviceCache.invalidate` on snapshot
+  swap.  ``KCCAP_DEVCACHE=0`` disables caching AND bucketing — the
+  escape hatch restores the exact pre-cache dispatch.
+* **Bucket ladder** — :func:`node_bucket` pads the node axis up a small
+  geometric ladder (next power of two above a configurable floor), and
+  :func:`scenario_bucket` does the same for the scenario axis.  Zero
+  node rows are fit-neutral in both semantics modes (proven in
+  ``parallel/sweep.py``: ``alloc <= used`` guards to 0, then the Q1 cap
+  rewrites ``0 >= 0`` to ``0 - 0``), and padded scenarios are harmless
+  ``(1 milli, 1 byte)`` probes whose outputs are sliced off — so a
+  cluster growing 1000 → 1001 nodes reuses the 1024-bucket executable
+  instead of recompiling.
+
+Cache hit/miss counters land on the process telemetry registry
+(``kccap_devcache_*``); ``doctor``, the service ``info`` op and
+``bench.py`` all read :meth:`DeviceCache.stats`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = [
+    "DeviceCache",
+    "CACHE",
+    "enabled",
+    "node_bucket",
+    "scenario_bucket",
+    "node_bucket_floor",
+    "set_node_bucket_floor",
+]
+
+#: Default floor of the node-axis bucket ladder.  Below the floor every
+#: cluster shares one executable; above it buckets double, so a snapshot
+#: sees at most ``log2(N/floor)`` distinct compiled shapes over its life.
+DEFAULT_NODE_BUCKET_FLOOR = 256
+
+#: Scenario-axis floor: grids are usually small and request-shaped, so a
+#: low floor keeps padding waste bounded while collapsing the long tail
+#: of distinct S values onto a handful of executables.
+SCENARIO_BUCKET_FLOOR = 16
+
+_floor_lock = threading.Lock()
+_node_floor: int | None = None
+
+
+def enabled() -> bool:
+    """Process-wide hot-path switch (``KCCAP_DEVCACHE=0`` disables).
+
+    Checked per dispatch so the escape hatch works without a restart;
+    off means no caching *and* no shape bucketing — byte-for-byte the
+    pre-cache dispatch behavior.
+    """
+    return os.environ.get("KCCAP_DEVCACHE", "1") != "0"
+
+
+def node_bucket_floor() -> int:
+    """The active node-bucket floor (flag/env-configurable)."""
+    global _node_floor
+    with _floor_lock:
+        if _node_floor is None:
+            try:
+                env = int(os.environ.get("KCCAP_NODE_BUCKET_FLOOR", "0"))
+            except ValueError:
+                env = 0
+            _node_floor = env if env > 0 else DEFAULT_NODE_BUCKET_FLOOR
+        return _node_floor
+
+
+def set_node_bucket_floor(floor: int) -> None:
+    """Set the node-bucket floor (``kccap-server -node-bucket-floor``)."""
+    global _node_floor
+    if floor < 1:
+        raise ValueError("node bucket floor must be >= 1")
+    with _floor_lock:
+        _node_floor = int(floor)
+
+
+def _next_pow2_at_least(n: int, floor: int) -> int:
+    b = max(int(floor), 1)
+    n = max(int(n), 1)
+    while b < n:
+        b <<= 1
+    return b
+
+
+def node_bucket(n: int, floor: int | None = None) -> int:
+    """Node axis padded size: next power of two ``>= max(n, floor)``."""
+    return _next_pow2_at_least(n, node_bucket_floor() if floor is None else floor)
+
+
+def scenario_bucket(s: int) -> int:
+    """Scenario axis padded size (fixed low floor, then powers of two)."""
+    return _next_pow2_at_least(s, SCENARIO_BUCKET_FLOOR)
+
+
+# Lazily-built telemetry handles on the process registry (importing this
+# module must register nothing; KCCAP_TELEMETRY=0 means zero registry
+# calls on the hot path — same policy as ops/pallas_fit).
+_MET: dict | None = None
+_met_lock = threading.Lock()
+
+
+def _metrics() -> dict:
+    global _MET
+    if _MET is None:
+        with _met_lock:
+            if _MET is None:
+                from kubernetesclustercapacity_tpu.telemetry.metrics import (
+                    REGISTRY,
+                )
+
+                _MET = {
+                    "hits": REGISTRY.counter(
+                        "kccap_devcache_hits_total",
+                        "Device-cache hits, by staged form.",
+                        ("form",),
+                    ),
+                    "misses": REGISTRY.counter(
+                        "kccap_devcache_misses_total",
+                        "Device-cache misses (staged fresh), by form.",
+                        ("form",),
+                    ),
+                }
+    return _MET
+
+
+def _telemetry_enabled() -> bool:
+    from kubernetesclustercapacity_tpu.telemetry.metrics import enabled as en
+
+    return en()
+
+
+class DeviceCache:
+    """Thread-safe LRU of device-staged node arrays, keyed per snapshot.
+
+    Generic storage: :meth:`get` takes any hashable ``key`` (its first
+    element names the *form* for the hit/miss counters) and a zero-arg
+    ``build`` callable.  The exact-kernel and fused-kernel forms have
+    dedicated helpers below; the GSPMD path stages through :meth:`get`
+    directly with its mesh in the key.
+
+    Keys are scoped by a token lazily attached to the snapshot object —
+    snapshots are immutable by contract, so object identity IS content
+    identity.  ``max_entries`` bounds device memory: each entry is
+    O(bucket) per array, and a serving process holds at most the current
+    and the about-to-be-published generation.
+    """
+
+    def __init__(self, max_entries: int = 8) -> None:
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, object] = OrderedDict()
+        self._max_entries = max(1, int(max_entries))
+        self._hits = 0
+        self._misses = 0
+        self._next_token = 0
+
+    def _token(self, snapshot) -> int:
+        tok = snapshot.__dict__.get("_devcache_token")
+        if tok is None:
+            with self._lock:
+                tok = snapshot.__dict__.get("_devcache_token")
+                if tok is None:
+                    tok = self._next_token
+                    self._next_token += 1
+                    snapshot.__dict__["_devcache_token"] = tok
+        return tok
+
+    def get(self, snapshot, key: tuple, build):
+        """The cached value for ``(snapshot, key)``; built once.
+
+        ``build`` runs OUTSIDE the lock (it does host padding + a device
+        transfer); a concurrent miss may build twice — last store wins,
+        both values are equal by construction.
+        """
+        if not enabled():
+            return build()
+        form = str(key[0]) if key else "unknown"
+        full = (self._token(snapshot), *key)
+        with self._lock:
+            hit = self._entries.get(full)
+            if hit is not None:
+                self._entries.move_to_end(full)
+                self._hits += 1
+        if hit is not None:
+            if _telemetry_enabled():
+                _metrics()["hits"].labels(form=form).inc()
+            return hit
+        value = build()
+        with self._lock:
+            self._entries[full] = value
+            self._entries.move_to_end(full)
+            self._misses += 1
+            while len(self._entries) > self._max_entries:
+                self._entries.popitem(last=False)
+        if _telemetry_enabled():
+            _metrics()["misses"].labels(form=form).inc()
+        return value
+
+    # -- staged forms ------------------------------------------------------
+    def exact_arrays(self, snapshot, *, bucket: int | None = None) -> tuple:
+        """The 7 exact-kernel inputs, zero-padded to the node bucket and
+        device-resident: ``(alloc_cpu, alloc_mem, alloc_pods, used_cpu,
+        used_mem, pods_count, healthy)`` each ``[bucket]``.  Zero rows
+        are fit-neutral in both modes; ``healthy`` pads False."""
+        import jax.numpy as jnp
+
+        n = snapshot.n_nodes
+        b = node_bucket(n) if bucket is None else int(bucket)
+
+        def build() -> tuple:
+            pad = b - n
+            out = []
+            for a in (
+                snapshot.alloc_cpu_milli,
+                snapshot.alloc_mem_bytes,
+                snapshot.alloc_pods,
+                snapshot.used_cpu_req_milli,
+                snapshot.used_mem_req_bytes,
+                snapshot.pods_count,
+                snapshot.healthy,
+            ):
+                a = np.asarray(a)
+                out.append(jnp.asarray(np.pad(a, (0, pad)) if pad else a))
+            return tuple(out)
+
+        return self.get(snapshot, ("exact", b), build)
+
+    def pallas_arrays(self, snapshot) -> tuple:
+        """The 6 fused-kernel node operands in kernel layout
+        (``(n_pad/LANES, LANES)`` int32, memory KiB-rescaled), padded to
+        the Pallas tile grid and device-resident."""
+        import jax.numpy as jnp
+
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            pad_node_array,
+            padded_node_shape,
+        )
+
+        n_pad = padded_node_shape(snapshot.n_nodes)
+
+        def build() -> tuple:
+            return tuple(
+                jnp.asarray(pad_node_array(a, n_pad, kib=kib))
+                for a, kib in (
+                    (snapshot.alloc_cpu_milli, False),
+                    (snapshot.alloc_mem_bytes, True),
+                    (snapshot.alloc_pods, False),
+                    (snapshot.used_cpu_req_milli, False),
+                    (snapshot.used_mem_req_bytes, True),
+                    (snapshot.pods_count, False),
+                )
+            )
+
+        return self.get(snapshot, ("pallas", n_pad), build)
+
+    # -- lifecycle ---------------------------------------------------------
+    def warm(self, snapshot, forms: tuple[str, ...] = ("exact", "pallas")) -> None:
+        """Pre-stage a snapshot's arrays (the coalescer publish path runs
+        this on ITS worker thread so a relist never stalls a reader).
+        Strictly best-effort: warming must never fail a publish."""
+        for form in forms:
+            try:
+                if form == "exact":
+                    self.exact_arrays(snapshot)
+                elif form == "pallas":
+                    self.pallas_arrays(snapshot)
+            except Exception:  # noqa: BLE001 - warm is an optimization
+                pass
+
+    def invalidate(self, snapshot=None) -> None:
+        """Drop a snapshot's entries (or everything when ``None``) —
+        called on snapshot swap so retired device buffers free promptly
+        instead of waiting out the LRU."""
+        with self._lock:
+            if snapshot is None:
+                self._entries.clear()
+                return
+            tok = snapshot.__dict__.get("_devcache_token")
+            if tok is None:
+                return  # never cached: nothing to drop
+            for key in [k for k in self._entries if k[0] == tok]:
+                del self._entries[key]
+
+    def stats(self) -> dict:
+        """JSON-able counters for doctor / the info op / bench.py."""
+        with self._lock:
+            hits, misses, entries = self._hits, self._misses, len(self._entries)
+        total = hits + misses
+        return {
+            "enabled": enabled(),
+            "entries": entries,
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": (hits / total) if total else 0.0,
+        }
+
+
+#: The process-wide default cache (the dispatch wrappers, the server and
+#: bench all share it; invalidation is per-snapshot, so co-hosted
+#: servers never interfere).
+CACHE = DeviceCache()

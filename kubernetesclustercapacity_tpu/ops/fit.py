@@ -42,6 +42,7 @@ __all__ = [
     "fit_per_node",
     "fit_totals",
     "sweep_grid",
+    "sweep_grid_bucketed",
     "sweep_snapshot",
     "snapshot_device_arrays",
     "fit_per_node_multi",
@@ -347,6 +348,112 @@ def snapshot_device_arrays(snapshot: ClusterSnapshot) -> tuple:
     )
 
 
+def _pad_scenarios_bucketed(cpu_reqs, mem_reqs, replicas, s_pad: int):
+    """Pad scenario arrays to ``s_pad`` with harmless (1 milli, 1 byte)
+    probes (replicas 0) — same semantics as ``parallel/sweep``'s padding;
+    the probe outputs are sliced off by the caller."""
+    cpu_reqs = np.asarray(cpu_reqs, dtype=np.int64)
+    mem_reqs = np.asarray(mem_reqs, dtype=np.int64)
+    replicas = np.asarray(replicas, dtype=np.int64)
+    pad = s_pad - cpu_reqs.shape[0]
+    if pad:
+        cpu_reqs = np.pad(cpu_reqs, (0, pad), constant_values=1)
+        mem_reqs = np.pad(mem_reqs, (0, pad), constant_values=1)
+        replicas = np.pad(replicas, (0, pad), constant_values=0)
+    return cpu_reqs, mem_reqs, replicas
+
+
+def sweep_grid_bucketed(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+    node_mask=None,
+    return_per_node: bool = False,
+    snapshot: ClusterSnapshot | None = None,
+):
+    """Shape-bucketed exact sweep: :func:`sweep_grid` behind the bucket
+    ladder, sliced back to the true ``[S]``/``[S, N]`` shapes.
+
+    Both axes pad up the geometric ladder (``devcache.node_bucket`` /
+    ``devcache.scenario_bucket``) so a ±1 change in node count or grid
+    size within a bucket reuses the compiled executable.  Zero node rows
+    yield fit 0 in both modes and scenario probes are sliced off, so the
+    result is bit-exact against the unbucketed dispatch.  When
+    ``snapshot`` is given, the padded node arrays come device-resident
+    from the :mod:`..devcache` (the per-request host→device upload
+    disappears); with ``KCCAP_DEVCACHE=0`` this is exactly the plain
+    :func:`sweep_grid` call.  Returns numpy arrays.
+    """
+    import time as _time
+
+    from kubernetesclustercapacity_tpu import devcache as _devcache
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        enabled as _telemetry_enabled,
+    )
+
+    if not _devcache.enabled():
+        out = sweep_grid(
+            alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+            pods_count, healthy, cpu_reqs, mem_reqs, replicas,
+            mode=mode, node_mask=node_mask, return_per_node=return_per_node,
+        )
+        return tuple(np.asarray(o) for o in out)
+
+    n = int(np.asarray(alloc_cpu).shape[0])
+    s = int(np.asarray(cpu_reqs).shape[0])
+    if snapshot is not None:
+        arrays = _devcache.CACHE.exact_arrays(snapshot)
+        bucket = int(arrays[0].shape[0])
+    else:
+        bucket = _devcache.node_bucket(n)
+        pad = bucket - n
+        arrays = tuple(
+            np.pad(np.asarray(a), (0, pad)) if pad else np.asarray(a)
+            for a in (
+                alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+                pods_count, healthy,
+            )
+        )
+    mask = node_mask
+    if mask is not None:
+        mask = np.asarray(mask, dtype=bool)
+        if bucket > n:
+            mask = np.pad(mask, (0, bucket - n))  # padded rows masked out
+    cpu_p, mem_p, rep_p = _pad_scenarios_bucketed(
+        cpu_reqs, mem_reqs, replicas, _devcache.scenario_bucket(s)
+    )
+    t0 = _time.perf_counter()
+    out = sweep_grid(
+        *arrays, cpu_p, mem_p, rep_p,
+        mode=mode, node_mask=mask, return_per_node=return_per_node,
+    )
+    out = tuple(np.asarray(o) for o in out)
+    if _telemetry_enabled():
+        # Per-bucket compile visibility: "first observation per label"
+        # now means "first per padded shape", so a ±1 node change inside
+        # a bucket provably adds no compile to the scrape.
+        from kubernetesclustercapacity_tpu.telemetry.compilewatch import (
+            observe_dispatch,
+        )
+
+        observe_dispatch(
+            f"xla_int64@n{bucket}", _time.perf_counter() - t0
+        )
+    result = (out[0][:s], out[1][:s])
+    if return_per_node:
+        result += (out[2][:s, :n],)
+    return result
+
+
 def sweep_snapshot(
     snapshot: ClusterSnapshot,
     grid: ScenarioGrid,
@@ -358,9 +465,12 @@ def sweep_snapshot(
     """Convenience wrapper: ``ClusterSnapshot`` × ``ScenarioGrid`` → results.
 
     Validates the grid the way the reference's flag layer would (nonzero
-    requests), then dispatches the jitted sweep.  ``node_mask`` ([N] bool,
-    optional) zeroes constraint-infeasible nodes for every scenario.
-    Returns numpy arrays.
+    requests), then dispatches the jitted sweep through the device cache
+    and shape-bucket ladder (:func:`sweep_grid_bucketed`): repeated
+    sweeps of one snapshot reuse its device-resident padded arrays, and
+    node/scenario counts recompile only when they cross a bucket edge.
+    ``node_mask`` ([N] bool, optional) zeroes constraint-infeasible
+    nodes for every scenario.  Returns numpy arrays.
     """
     import time as _time
 
@@ -369,18 +479,23 @@ def sweep_snapshot(
     )
 
     grid.validate()
-    arrays = snapshot_device_arrays(snapshot)
     t0 = _time.perf_counter()
-    out = sweep_grid(
-        *arrays,
+    out = sweep_grid_bucketed(
+        snapshot.alloc_cpu_milli,
+        snapshot.alloc_mem_bytes,
+        snapshot.alloc_pods,
+        snapshot.used_cpu_req_milli,
+        snapshot.used_mem_req_bytes,
+        snapshot.pods_count,
+        snapshot.healthy,
         grid.cpu_request_milli,
         grid.mem_request_bytes,
         grid.replicas,
         mode=mode,
         return_per_node=return_per_node,
         node_mask=node_mask,
+        snapshot=snapshot,
     )
-    out = tuple(np.asarray(o) for o in out)
     if _telemetry_enabled():
         # Host-side, after the np.asarray sync — the first dispatch per
         # kernel label lands as compile time, the rest as steady-state

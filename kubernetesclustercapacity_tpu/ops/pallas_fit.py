@@ -37,7 +37,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from kubernetesclustercapacity_tpu.ops.fit import sweep_grid
+from kubernetesclustercapacity_tpu import devcache as _devcache
+from kubernetesclustercapacity_tpu.ops.fit import sweep_grid_bucketed
 from kubernetesclustercapacity_tpu.resilience import (
     CircuitBreaker as _CircuitBreaker,
 )
@@ -615,6 +616,7 @@ def sweep_pallas(
     node_mask=None,
     interpret: bool = False,
     use_rcp: bool | None = None,
+    staged_nodes=None,
 ):
     """Fused Pallas sweep. Caller must check eligibility.
 
@@ -630,7 +632,12 @@ def sweep_pallas(
     slots); a present mask pads with 0 (masked out).  Scenarios pad with
     ``(1, 1)`` probes whose outputs are dropped.  ``use_rcp`` selects the
     reciprocal-division kernel (~6x faster divides); ``None`` auto-enables
-    it when :func:`rcp_division_eligible` proves it exact.  Returns
+    it when :func:`rcp_division_eligible` proves it exact.
+    ``staged_nodes`` (optional) is the devcache's already-padded,
+    device-resident 6-tuple of node operands in kernel layout (what
+    :meth:`..devcache.DeviceCache.pallas_arrays` returns for this exact
+    snapshot) — the per-request pad + host→device upload is skipped; the
+    positional node arrays are still consulted for ``n``.  Returns
     ``(totals[S], schedulable[S])`` numpy arrays.
     """
     if mode not in ("reference", "strict"):
@@ -644,13 +651,18 @@ def sweep_pallas(
     n_pad = padded_node_shape(n)
     s_pad = padded_scenario_shape(s)
 
-    args = (
-        pad_node_array(alloc_cpu, n_pad),
-        pad_node_array(alloc_mem, n_pad, kib=True),
-        pad_node_array(alloc_pods, n_pad),
-        pad_node_array(used_cpu, n_pad),
-        pad_node_array(used_mem, n_pad, kib=True),
-        pad_node_array(pods_count, n_pad),
+    if staged_nodes is not None:
+        node_args = tuple(staged_nodes)
+    else:
+        node_args = (
+            pad_node_array(alloc_cpu, n_pad),
+            pad_node_array(alloc_mem, n_pad, kib=True),
+            pad_node_array(alloc_pods, n_pad),
+            pad_node_array(used_cpu, n_pad),
+            pad_node_array(used_mem, n_pad, kib=True),
+            pad_node_array(pods_count, n_pad),
+        )
+    args = node_args + (
         pad_scenario_array(cpu_reqs, s_pad),
         pad_scenario_array(mem_reqs, s_pad, kib=True),
     )
@@ -690,6 +702,7 @@ def sweep_auto(
     node_mask=None,
     interpret: bool | None = None,
     force_exact: bool = False,
+    _snapshot=None,
 ):
     """Fast path when eligible, exact int64 path otherwise — always bit-exact.
 
@@ -705,6 +718,12 @@ def sweep_auto(
     ``xla_int64``.  ``interpret=None`` auto-selects Pallas interpret mode
     off-TPU (the real chip may register under a plugin platform name, so
     detect the one backend that NEEDS interpret mode).
+
+    ``_snapshot`` (private; :func:`sweep_snapshot_auto` threads it) names
+    the ClusterSnapshot the positional node arrays came from, unlocking
+    the device-resident cache: the fused path reuses its staged int32
+    node tiles and the exact fallback its bucket-padded int64 arrays —
+    identical numbers, minus the per-request upload.
     """
     import time as _time
 
@@ -749,12 +768,22 @@ def sweep_auto(
         use_rcp = rcp_division_eligible(
             alloc_cpu, alloc_mem, used_cpu, used_mem, cpu_reqs, mem_reqs
         )
+        staged = None
+        if _snapshot is not None and _devcache.enabled():
+            # Device-resident staged tiles for this snapshot (warm after
+            # the first sweep of a generation); a cache failure must
+            # degrade to the per-request pad path, never the request.
+            try:
+                staged = _devcache.CACHE.pallas_arrays(_snapshot)
+            except Exception:  # noqa: BLE001 - cache is an optimization
+                staged = None
         t0 = _time.perf_counter()
         try:
             totals, sched = sweep_pallas(
                 alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
                 pods_count, cpu_reqs, mem_reqs, replicas, mode=mode,
                 node_mask=kernel_mask, interpret=interpret, use_rcp=use_rcp,
+                staged_nodes=staged,
             )
         except Exception as e:  # noqa: BLE001 - availability over speed
             # The value-domain eligibility proof cannot anticipate a
@@ -800,12 +829,11 @@ def sweep_auto(
     if tel is not None:
         tel["misses"].labels(reason=fallback_reason).inc()
         t0 = _time.perf_counter()
-    totals, sched = sweep_grid(
+    totals, sched = sweep_grid_bucketed(
         alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
         healthy, cpu_reqs, mem_reqs, replicas, mode=mode,
-        node_mask=node_mask,
+        node_mask=node_mask, snapshot=_snapshot,
     )
-    totals, sched = np.asarray(totals), np.asarray(sched)
     if tel is not None:
         # np.asarray blocked on the device result above — same sync
         # policy as the fused branch.
@@ -864,4 +892,5 @@ def sweep_snapshot_auto(
         node_mask=node_mask,
         interpret=interpret,
         force_exact=(kernel == "exact"),
+        _snapshot=snapshot,
     )

@@ -1,0 +1,196 @@
+"""Server-side request micro-batching: concurrent sweeps share a kernel.
+
+The dispatch path used to launch one kernel per request even when dozens
+of concurrent sweeps targeted the *same* snapshot generation and mode —
+each paying its own dispatch overhead for a scenario axis the kernel
+would happily evaluate in one launch (the batch-bin-packing observation:
+admission queries are tiny; their per-query overhead is the product).
+
+:class:`MicroBatcher` is the continuous-batching analog for the capacity
+kernel, leader-driven so it owns no threads:
+
+* the **first** request for a key opens a batch and becomes its leader;
+* the leader waits up to ``window_s`` (default ~1–2 ms) while concurrent
+  requests for the same key append their scenario rows — a full batch
+  (``max_batch``) dispatches early;
+* the leader runs ONE combined dispatch on its own thread and scatters
+  per-request slices back; followers block on the batch's event and
+  return their own slice.
+
+Deadline semantics are preserved per request: a request whose remaining
+budget would expire inside the window bypasses batching and dispatches
+solo (counted separately), so batching can never *cause* a shed.  Trace
+IDs ride the per-request envelope untouched — the batch is invisible on
+the wire.
+
+Registry-backed metrics: ``kccap_batch_size`` (batch-size histogram —
+``sum/count`` is the mean batch size), ``kccap_batch_window_wait_seconds``
+(how long leaders actually waited), and batched/solo/bypass counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["MicroBatcher"]
+
+#: Batch-size buckets: powers of two up to the plausible max_batch range.
+_BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+#: Hard ceiling on a follower's wait for its leader's dispatch: the
+#: combined kernel may compile on first dispatch (seconds), but a wedged
+#: leader must not strand followers forever.
+_FOLLOWER_TIMEOUT_S = 120.0
+
+
+class _Batch:
+    __slots__ = ("items", "closed", "full", "done", "results", "error")
+
+    def __init__(self) -> None:
+        self.items: list = []
+        self.closed = False
+        self.full = threading.Event()
+        self.done = threading.Event()
+        self.results: list | None = None
+        self.error: str | None = None
+
+
+class MicroBatcher:
+    """Collect concurrent same-key requests into one dispatch.
+
+    ``dispatch(key, items)`` (the embedder's) must return one result per
+    item, in order.  ``key`` groups only requests whose combined dispatch
+    is semantically identical to their solo dispatches (the server keys
+    by snapshot generation + kernel choice).
+    """
+
+    def __init__(
+        self,
+        dispatch,
+        *,
+        window_s: float = 0.0015,
+        max_batch: int = 32,
+        registry=None,
+    ) -> None:
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            MetricsRegistry,
+        )
+
+        if window_s <= 0:
+            raise ValueError("window_s must be > 0 (omit the batcher to "
+                             "disable batching)")
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._dispatch = dispatch
+        self.window_s = float(window_s)
+        self.max_batch = int(max_batch)
+        self._lock = threading.Lock()
+        self._pending: dict = {}
+        self.registry = registry if registry is not None else MetricsRegistry()
+        m = self.registry
+        self._m_size = m.histogram(
+            "kccap_batch_size",
+            "Requests per dispatched micro-batch (sum/count = mean).",
+            buckets=_BATCH_SIZE_BUCKETS,
+        )
+        self._m_wait = m.histogram(
+            "kccap_batch_window_wait_seconds",
+            "How long batch leaders waited for followers before "
+            "dispatching.",
+        )
+        self._m_batched = m.counter(
+            "kccap_batched_requests_total",
+            "Requests served as part of a multi-request batch.",
+        )
+        self._m_solo = m.counter(
+            "kccap_solo_requests_total",
+            "Requests dispatched alone (batch of one).",
+        )
+        self._m_bypass = m.counter(
+            "kccap_batch_deadline_bypass_total",
+            "Requests that bypassed batching because their deadline "
+            "would expire inside the window.",
+        )
+
+    @property
+    def stats(self) -> dict:
+        """JSON-able batching counters (info op / doctor / bench)."""
+        size = self._m_size.labels()
+        dispatches = size.count
+        total = size.sum
+        return {
+            "window_ms": self.window_s * 1e3,
+            "max_batch": self.max_batch,
+            "dispatches": dispatches,
+            "batched_requests": int(self._m_batched.value),
+            "solo_requests": int(self._m_solo.value),
+            "deadline_bypass": int(self._m_bypass.value),
+            "mean_batch_size": (total / dispatches) if dispatches else 0.0,
+        }
+
+    def submit(self, key, item, *, deadline=None):
+        """Run ``item`` through a (possibly shared) dispatch; returns its
+        own result.  Blocking — callers are the server's per-connection
+        threads, each already holding a compute slot."""
+        if deadline is not None and deadline.remaining() <= self.window_s:
+            # The window would eat the caller's whole budget: dispatch
+            # alone, now.  (An already-expired deadline was shed upstream.)
+            self._m_bypass.inc()
+            self._m_solo.inc()
+            self._m_size.observe(1)
+            return self._dispatch(key, [item])[0]
+
+        with self._lock:
+            batch = self._pending.get(key)
+            leader = False
+            if (
+                batch is None
+                or batch.closed
+                or len(batch.items) >= self.max_batch
+            ):
+                batch = _Batch()
+                self._pending[key] = batch
+                leader = True
+            idx = len(batch.items)
+            batch.items.append(item)
+            if len(batch.items) >= self.max_batch:
+                batch.full.set()
+
+        if leader:
+            t0 = time.perf_counter()
+            batch.full.wait(self.window_s)
+            with self._lock:
+                # Close under the same lock appends take: every item is
+                # either in this snapshot or in a successor batch.
+                batch.closed = True
+                if self._pending.get(key) is batch:
+                    del self._pending[key]
+                items = list(batch.items)
+            self._m_wait.observe(time.perf_counter() - t0)
+            try:
+                results = self._dispatch(key, items)
+                if len(results) != len(items):
+                    raise RuntimeError(
+                        f"batch dispatch returned {len(results)} results "
+                        f"for {len(items)} requests"
+                    )
+                batch.results = results
+            except Exception as e:  # noqa: BLE001 - relayed per member
+                batch.error = f"{type(e).__name__}: {e}"
+                raise
+            finally:
+                self._m_size.observe(len(items))
+                if len(items) > 1:
+                    self._m_batched.inc(len(items))
+                else:
+                    self._m_solo.inc()
+                batch.done.set()
+        else:
+            if not batch.done.wait(_FOLLOWER_TIMEOUT_S):
+                raise RuntimeError(
+                    "micro-batch dispatch timed out waiting for its leader"
+                )
+        if batch.error is not None:
+            raise RuntimeError(f"batched dispatch failed: {batch.error}")
+        return batch.results[idx]

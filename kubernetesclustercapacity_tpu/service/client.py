@@ -285,6 +285,12 @@ class CapacityClient:
         return self.call("fit", **flags)
 
     def sweep(self, **params) -> dict:
+        """Grid sweep.  Scenario arrays may be numpy (coerced to JSON
+        lists here, so ScenarioGrid columns pass straight through)."""
+        for key in ("cpu_request_milli", "mem_request_bytes", "replicas"):
+            v = params.get(key)
+            if v is not None and hasattr(v, "tolist"):
+                params[key] = v.tolist()
         return self.call("sweep", **params)
 
     def sweep_multi(self, resources, requests, **params) -> dict:

@@ -21,6 +21,13 @@ store upserts, already O(1)) from snapshot publication (O(N) repack):
 * **no lost finale** — :meth:`stop` drains: the last pending state is
   always flushed before the worker exits.
 
+Because ``flush`` runs on the coalescer's own worker thread, the serve
+wiring also uses it to PRE-WARM the device cache for the just-published
+snapshot (``server.main`` passes ``warm=True`` to ``replace_snapshot``
+inside the flush callback): the O(N) host→device upload for the next
+generation is paid here, off the request path, so a relist never stalls
+a reader on a cold cache.
+
 So a churn storm of E events costs ``min(E, 2 + duration/min_interval_s
 + E/max_pending)`` repacks instead of E, while staleness stays bounded by
 ``min_interval_s``.

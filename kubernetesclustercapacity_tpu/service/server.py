@@ -100,6 +100,8 @@ class CapacityServer:
         trace_log=None,
         flight_records: int = 256,
         flight_dump_path: str | None = None,
+        batch_window_ms: float = 1.0,
+        batch_max: int = 32,
     ) -> None:
         """``stats_source`` is an optional zero-arg callable returning a
         JSON-able dict of upstream-feed health (e.g.
@@ -119,7 +121,14 @@ class CapacityServer:
         of the last K dispatched requests served by the ``dump`` op.
         ``flight_dump_path``, when set, appends the whole ring as JSONL
         there every time a dispatch raises (the post-incident record of
-        what led up to the failure)."""
+        what led up to the failure).
+
+        ``batch_window_ms`` arms server-side micro-batching: concurrent
+        plain sweeps against the same snapshot generation collect for up
+        to this window (``batch_max`` rows of requests at most) and
+        dispatch as ONE kernel launch, each response scattered back with
+        its own trace/deadline semantics.  ``0`` disables batching (every
+        sweep dispatches solo, the pre-batching behavior)."""
         import os
 
         from kubernetesclustercapacity_tpu.telemetry.flightrec import (
@@ -168,6 +177,23 @@ class CapacityServer:
         )
         self._flight = FlightRecorder(flight_records)
         self._flight_dump_path = flight_dump_path
+        self._batcher = None
+        if batch_window_ms and batch_window_ms > 0:
+            from kubernetesclustercapacity_tpu.service.batching import (
+                MicroBatcher,
+            )
+
+            self._batcher = MicroBatcher(
+                self._dispatch_sweep_batch,
+                window_s=float(batch_window_ms) / 1e3,
+                max_batch=batch_max,
+                registry=m,
+            )
+        # Per-dispatch-thread context: the snapshot generation captured
+        # under the dispatch lock, so the flight record says which
+        # generation ANSWERED (not whichever was current when the record
+        # was written — a concurrent reload must not skew attribution).
+        self._dispatch_tls = threading.local()
         # Served-state generation: bumped on every snapshot swap
         # (reload, update, replace_snapshot) so flight-recorder entries
         # and /healthz can say WHICH snapshot answered a request.
@@ -307,11 +333,16 @@ class CapacityServer:
         fails the op it observes."""
         from kubernetesclustercapacity_tpu.telemetry import flightrec
 
+        # The generation that ANSWERED (captured under the dispatch
+        # lock); ops that never captured one (ping, shed requests) fall
+        # back to the current generation.
+        gen = getattr(self._dispatch_tls, "generation", None)
+        self._dispatch_tls.generation = None
         try:
             self._flight.record(
                 op=op_label,
                 args_digest=flightrec.args_digest(msg),
-                generation=self.generation,
+                generation=self.generation if gen is None else gen,
                 trace_id=(trace_id or "") if isinstance(trace_id, str) else "",
                 latency_ms=dur * 1e3,
                 status="error" if error else "ok",
@@ -379,6 +410,10 @@ class CapacityServer:
         # watch-event batch.
         with self._lock:
             snap = self.snapshot
+            generation = self._generation
+            # Stashed per-thread so the flight record attributes this
+            # request to the generation that actually answered it.
+            self._dispatch_tls.generation = generation
             needs_fixture = (
                 op == "drain"  # always reads per-pod requests
                 # A sweep reads the fixture only on the priorities path
@@ -448,6 +483,22 @@ class CapacityServer:
             # clients that diff it (the chaos suite among them).
             if msg.get("metrics"):
                 out["metrics"] = self.registry.snapshot()
+            # Opt-in (``info {hot_path: true}``): device-cache hit rates
+            # and micro-batching stats.  Opt-in for the same reason
+            # metrics is — live counters would churn the pinned default
+            # shape clients diff.
+            if msg.get("hot_path"):
+                from kubernetesclustercapacity_tpu import devcache
+
+                out["hot_path"] = {
+                    "devcache": devcache.CACHE.stats(),
+                    "node_bucket_floor": devcache.node_bucket_floor(),
+                    "batching": (
+                        self._batcher.stats
+                        if self._batcher is not None
+                        else None
+                    ),
+                }
             return out
         if op == "fit":
             return self._op_fit(msg, snap, fixture, implicit_mask)
@@ -956,10 +1007,14 @@ class CapacityServer:
         implicit_mask=None,
         fixture: dict | None = None,
     ) -> dict:
-        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
-            sweep_snapshot_auto,
-        )
-
+        # The generation _dispatch_inner captured WITH this snapshot
+        # (stashed per thread): the micro-batch key, so only requests
+        # answering from the same generation ever share a launch.  A
+        # direct caller that bypassed dispatch keys by snapshot identity
+        # instead — never by a mixable None.
+        generation = getattr(self._dispatch_tls, "generation", None)
+        if generation is None:
+            generation = ("snap-id", id(snap))
         if "random" in msg:
             grid = random_scenario_grid(
                 int(msg["random"]["n"]), seed=int(msg["random"].get("seed", 0))
@@ -974,26 +1029,46 @@ class CapacityServer:
             return self._sweep_with_priorities(
                 msg, snap, grid, implicit_mask, fixture
             )
-        # The same implicit taint mask the fit op applies: a strict sweep
-        # over a tainted snapshot must not report higher totals than fit
-        # does for the identical spec.
-        totals, sched, kernel = sweep_snapshot_auto(
-            snap,
-            grid,
-            mode=snap.semantics,
-            kernel=msg.get("kernel", "auto"),
-            node_mask=implicit_mask,
-        )
-        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
-            last_dispatch_fast_path,
-        )
+        kernel_req = msg.get("kernel", "auto")
+        if self._batcher is not None:
+            # Validate BEFORE joining a batch: a bad grid must fail its
+            # own request, never a batch it rode into.  Keyed by the
+            # captured generation + kernel choice, so only requests whose
+            # combined dispatch is semantically identical ever share a
+            # launch (snap and implicit_mask are generation-determined).
+            grid.validate()
+            totals, sched, kernel, attempted, attempt_error = (
+                self._batcher.submit(
+                    (generation, kernel_req),
+                    (snap, implicit_mask, grid),
+                    deadline=self._check_deadline(msg),
+                )
+            )
+        else:
+            from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+                last_dispatch_fast_path,
+                sweep_snapshot_auto,
+            )
+
+            # The same implicit taint mask the fit op applies: a strict
+            # sweep over a tainted snapshot must not report higher totals
+            # than fit does for the identical spec.
+            totals, sched, kernel = sweep_snapshot_auto(
+                snap,
+                grid,
+                mode=snap.semantics,
+                kernel=kernel_req,
+                node_mask=implicit_mask,
+            )
+            attempted, attempt_error = last_dispatch_fast_path()
 
         # Attach the fused-path failure ONLY when THIS request's dispatch
-        # attempted the fused kernel and it failed (thread-local, so a
-        # concurrent request's failure can't be misattributed).  A stale
-        # breaker error must never ride an exact-kernel response — the
-        # breaker's standing state lives in the info op instead.
-        attempted, attempt_error = last_dispatch_fast_path()
+        # attempted the fused kernel and it failed (captured on the
+        # dispatching thread, so a concurrent request's failure can't be
+        # misattributed; for a batch, the batch WAS this request's
+        # dispatch).  A stale breaker error must never ride an
+        # exact-kernel response — the breaker's standing state lives in
+        # the info op instead.
         return {
             "totals": totals.tolist(),
             "schedulable": sched.tolist(),
@@ -1005,6 +1080,55 @@ class CapacityServer:
                 else {}
             ),
         }
+
+    def _dispatch_sweep_batch(self, key, items) -> list:
+        """One kernel launch for a micro-batch of plain sweeps.
+
+        ``items`` are ``(snap, implicit_mask, grid)`` tuples sharing one
+        snapshot generation and kernel choice; their scenario rows
+        concatenate along the existing scenario axis, dispatch once, and
+        scatter back per request.  A batch of one takes EXACTLY the solo
+        path, so batching a single request is bit-identical (and
+        observably identical) to no batching at all.
+        """
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            last_dispatch_fast_path,
+            sweep_snapshot_auto,
+        )
+
+        _generation, kernel_req = key
+        snap, mask, _ = items[0]
+        if len(items) == 1:
+            grid = items[0][2]
+            totals, sched, kernel = sweep_snapshot_auto(
+                snap, grid, mode=snap.semantics, kernel=kernel_req,
+                node_mask=mask,
+            )
+            attempted, err = last_dispatch_fast_path()
+            return [(totals, sched, kernel, attempted, err)]
+        grids = [item[2] for item in items]
+        combined = ScenarioGrid(
+            cpu_request_milli=np.concatenate(
+                [g.cpu_request_milli for g in grids]
+            ),
+            mem_request_bytes=np.concatenate(
+                [g.mem_request_bytes for g in grids]
+            ),
+            replicas=np.concatenate([g.replicas for g in grids]),
+        )
+        totals, sched, kernel = sweep_snapshot_auto(
+            snap, combined, mode=snap.semantics, kernel=kernel_req,
+            node_mask=mask,
+        )
+        attempted, err = last_dispatch_fast_path()
+        out, offset = [], 0
+        for g in grids:
+            end = offset + g.size
+            out.append(
+                (totals[offset:end], sched[offset:end], kernel, attempted, err)
+            )
+            offset = end
+        return out
 
     def _sweep_with_priorities(
         self, msg, snap, grid, implicit_mask, fixture: dict | None
@@ -1089,6 +1213,7 @@ class CapacityServer:
         fixture: dict | None = None,
         *,
         fixture_source=None,
+        warm: bool = False,
     ) -> None:
         """Atomically swap the served snapshot (e.g. from a live follower).
 
@@ -1107,9 +1232,19 @@ class CapacityServer:
         since those same events schedule the next snapshot swap.  The
         store-fed ``update`` path keeps its exact pairing (fixture
         rebuilt from the same store state the snapshot came from).
+
+        ``warm=True`` pre-stages the new snapshot's device arrays in the
+        device cache AFTER the swap (the coalescer publish path passes
+        it, so warming runs on the coalescer's worker thread — a relist
+        never stalls a reader on a cold upload).  The retired snapshot's
+        cache entries are invalidated either way, so swapped-out device
+        buffers free promptly.
         """
+        from kubernetesclustercapacity_tpu import devcache
+
         mask = _implicit_taint_mask(snapshot)
         with self._lock:
+            old = self.snapshot
             self.snapshot = snapshot
             self.fixture = fixture
             self._fixture_source = fixture_source
@@ -1117,6 +1252,10 @@ class CapacityServer:
             self._fixture_dirty = False
             self._implicit_mask = mask
             self._generation += 1
+        if old is not snapshot:
+            devcache.CACHE.invalidate(old)
+        if warm:
+            devcache.CACHE.warm(snapshot)
 
     def _op_reload(self, msg: dict, snap: ClusterSnapshot) -> dict:
         """``snap`` is the dispatch's lock-captured snapshot — reading
@@ -1202,6 +1341,7 @@ class CapacityServer:
                     semantics=self.snapshot.semantics,
                     extended_resources=tuple(sorted(self.snapshot.extended)),
                 )
+            old = self.snapshot
             try:
                 self._store.apply(events)
             finally:
@@ -1209,6 +1349,10 @@ class CapacityServer:
                 self._fixture_dirty = True  # rebuilt on demand (cpu fit)
                 self._implicit_mask = _implicit_taint_mask(snap)
                 self._generation += 1
+        if old is not snap:
+            from kubernetesclustercapacity_tpu import devcache
+
+            devcache.CACHE.invalidate(old)
         return {
             "nodes": snap.n_nodes,
             "healthy_nodes": int(np.sum(snap.healthy)),
@@ -1272,6 +1416,21 @@ def main(argv=None) -> int:
                    metavar="PATH",
                    help="append the flight recorder as JSONL to PATH "
                         "whenever a dispatch raises")
+    p.add_argument("-batch-window-ms", type=float, default=1.0,
+                   dest="batch_window_ms", metavar="MS",
+                   help="micro-batch concurrent sweeps of one snapshot "
+                        "generation for up to MS milliseconds into one "
+                        "kernel launch (0 = dispatch every sweep solo)")
+    p.add_argument("-batch-max", type=int, default=32, dest="batch_max",
+                   metavar="N",
+                   help="max requests per micro-batch (a full batch "
+                        "dispatches before the window closes)")
+    p.add_argument("-node-bucket-floor", type=int, default=0,
+                   dest="node_bucket_floor", metavar="N",
+                   help="floor of the node-axis shape-bucket ladder "
+                        "(node counts pad to the next power of two >= "
+                        "the floor, so ±1-node churn reuses compiled "
+                        "kernels; 0 = keep the default/env setting)")
     args = p.parse_args(argv)
 
     import os as _os
@@ -1335,6 +1494,10 @@ def main(argv=None) -> int:
         trace_log = TraceLog(
             args.trace_log, max_bytes=max(args.trace_log_max_bytes, 0)
         )
+    if args.node_bucket_floor > 0:
+        from kubernetesclustercapacity_tpu import devcache
+
+        devcache.set_node_bucket_floor(args.node_bucket_floor)
     server = CapacityServer(
         snap, host=args.host, port=args.port, fixture=fixture,
         auth_token=auth_token, max_inflight=args.max_inflight,
@@ -1346,6 +1509,8 @@ def main(argv=None) -> int:
         trace_log=trace_log,
         flight_records=max(args.flight_records, 1),
         flight_dump_path=args.flight_dump,
+        batch_window_ms=max(args.batch_window_ms, 0.0),
+        batch_max=max(args.batch_max, 1),
     )
     metrics_server = None
     if args.metrics_port:
@@ -1420,6 +1585,10 @@ def main(argv=None) -> int:
                 # Raw objects on demand only (drain/anti-affinity/
                 # priority): the publish itself stays O(arrays).
                 fixture_source=follower.fixture_view,
+                # Pre-warm the new generation's device arrays on THIS
+                # (coalescer-worker) thread: a relist never stalls a
+                # reader on a cold host→device upload.
+                warm=True,
             ),
             min_interval_s=max(args.coalesce_ms, 0) / 1e3,
             on_error=_publish_failed,

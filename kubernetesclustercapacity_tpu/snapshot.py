@@ -136,7 +136,18 @@ class ClusterSnapshot:
 
         Row order follows ``resources``; ``"cpu"`` and ``"memory"`` name the
         core columns, anything else must be a key of :attr:`extended`.
+
+        Memoized per ``resources`` tuple on the (immutable) snapshot, so
+        repeated sweeps stop re-stacking O(R*N) host arrays per request;
+        the cached matrices are read-only to keep the memo honest.  A
+        concurrent first call may build twice — both results are equal
+        and either may win the cache slot.
         """
+        resources = tuple(resources)
+        cache = self.__dict__.setdefault("_matrix_cache", {})
+        hit = cache.get(resources)
+        if hit is not None:
+            return hit
         alloc_rows, used_rows = [], []
         for r in resources:
             if r == "cpu":
@@ -149,7 +160,11 @@ class ClusterSnapshot:
                 alloc, used = self.extended[r]
                 alloc_rows.append(alloc)
                 used_rows.append(used)
-        return np.stack(alloc_rows), np.stack(used_rows)
+        alloc_rn, used_rn = np.stack(alloc_rows), np.stack(used_rows)
+        alloc_rn.setflags(write=False)
+        used_rn.setflags(write=False)
+        cache[resources] = (alloc_rn, used_rn)
+        return cache[resources]
 
     def save(self, path: str) -> None:
         """Checkpoint to ``.npz`` (arrays + JSON metadata), reproducibly."""

@@ -205,6 +205,26 @@ def doctor_report(
 
     check("telemetry", _telemetry)
 
+    def _hot_path():
+        # The process device cache + bucket ladder: hit rates say whether
+        # repeat sweeps are actually reusing device-resident arrays, and
+        # the floor says which shape bucket small clusters share.
+        from kubernetesclustercapacity_tpu import devcache
+
+        if not devcache.enabled():
+            return (
+                "disabled (KCCAP_DEVCACHE=0) — per-request device "
+                "uploads, no shape bucketing"
+            )
+        st = devcache.CACHE.stats()
+        return (
+            f"ok: {st['entries']} entries, hits={st['hits']} "
+            f"misses={st['misses']} hit_rate={st['hit_rate']:.2f}, "
+            f"node bucket floor {devcache.node_bucket_floor()}"
+        )
+
+    check("device snapshot cache", _hot_path)
+
     if service_addr is not None:
         # A LIVE service's resilience counters (deadline sheds, breaker
         # state, follower retry/backoff) — the doctor probes the same
@@ -223,7 +243,7 @@ def doctor_report(
                 retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
                 deadline_s=5.0,
             ) as c:
-                info = c.info(metrics=True)
+                info = c.info(metrics=True, hot_path=True)
             r = info.get("resilience", {})
             fp = r.get("fast_path_breaker", {})
             parts = [
@@ -231,6 +251,17 @@ def doctor_report(
                 f"deadline_shed={r.get('deadline_shed')}",
                 f"fast_path={fp.get('state')}",
             ]
+            hp = info.get("hot_path") or {}
+            dc = hp.get("devcache")
+            if dc:
+                parts.append(
+                    f"devcache_hit_rate={dc.get('hit_rate', 0):.2f}"
+                )
+            bt = hp.get("batching")
+            if bt:
+                parts.append(
+                    f"mean_batch={bt.get('mean_batch_size', 0):.2f}"
+                )
             reqs = (
                 info.get("metrics", {})
                 .get("kccap_requests_total", {})

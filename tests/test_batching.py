@@ -1,0 +1,257 @@
+"""MicroBatcher mechanics + micro-batched sweep bit-exactness.
+
+The batcher's contract: a batch of one IS the solo path; concurrent
+same-key submits share exactly one dispatch; deadline-starved requests
+bypass; a failing dispatch fails every member; the metrics add up.  The
+bit-exactness half drives the server-style concatenate-and-scatter
+dispatch over random grids in both semantics modes and compares every
+scattered slice against its solo sweep and the sequential oracle.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+from kubernetesclustercapacity_tpu.resilience import Deadline
+from kubernetesclustercapacity_tpu.scenario import (
+    ScenarioGrid,
+    random_scenario_grid,
+)
+from kubernetesclustercapacity_tpu.service.batching import MicroBatcher
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+
+def _echo_dispatch(calls):
+    def dispatch(key, items):
+        calls.append((key, list(items)))
+        return [(key, item, len(items)) for item in items]
+
+    return dispatch
+
+
+class TestMechanics:
+    def test_single_submit_is_batch_of_one(self):
+        calls = []
+        b = MicroBatcher(_echo_dispatch(calls), window_s=0.005)
+        out = b.submit("k", "item")
+        assert out == ("k", "item", 1)
+        assert len(calls) == 1
+        st = b.stats
+        assert st["dispatches"] == 1
+        assert st["solo_requests"] == 1
+        assert st["batched_requests"] == 0
+        assert st["mean_batch_size"] == 1.0
+
+    def test_concurrent_submits_share_one_dispatch(self):
+        calls = []
+        release = threading.Event()
+
+        def slow_dispatch(key, items):
+            calls.append(list(items))
+            return [len(items)] * len(items)
+
+        b = MicroBatcher(slow_dispatch, window_s=0.25, max_batch=8)
+        results = [None] * 6
+        barrier = threading.Barrier(6)
+
+        def worker(i):
+            barrier.wait()
+            results[i] = b.submit("k", i)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        release.set()
+        # All six rode one dispatch (the barrier puts them well inside
+        # the 250 ms window) and each got the shared batch size back.
+        assert len(calls) == 1 and len(calls[0]) == 6
+        assert results == [6] * 6
+        st = b.stats
+        assert st["dispatches"] == 1
+        assert st["batched_requests"] == 6
+        assert st["mean_batch_size"] == 6.0
+
+    def test_full_batch_dispatches_before_window(self):
+        calls = []
+        b = MicroBatcher(_echo_dispatch(calls), window_s=5.0, max_batch=2)
+        t0 = time.perf_counter()
+        results = [None, None]
+
+        def worker(i):
+            results[i] = b.submit("k", i)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        # max_batch=2 reached -> the leader dispatched long before the
+        # 5 s window expired.
+        assert time.perf_counter() - t0 < 2.0
+        assert sorted(r[1] for r in results) == [0, 1]
+
+    def test_deadline_inside_window_bypasses(self):
+        calls = []
+        b = MicroBatcher(_echo_dispatch(calls), window_s=0.2)
+        out = b.submit("k", "hurried", deadline=Deadline.after(0.05))
+        assert out == ("k", "hurried", 1)
+        st = b.stats
+        assert st["deadline_bypass"] == 1
+        assert st["dispatches"] == 1
+
+    def test_roomy_deadline_still_batches(self):
+        calls = []
+        b = MicroBatcher(_echo_dispatch(calls), window_s=0.01)
+        b.submit("k", "calm", deadline=Deadline.after(30.0))
+        assert b.stats["deadline_bypass"] == 0
+
+    def test_dispatch_error_fails_every_member(self):
+        def boom(key, items):
+            raise RuntimeError("kernel exploded")
+
+        b = MicroBatcher(boom, window_s=0.1, max_batch=4)
+        errors = []
+        barrier = threading.Barrier(3)
+
+        def worker():
+            barrier.wait()
+            try:
+                b.submit("k", "x")
+            except RuntimeError as e:
+                errors.append(str(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert len(errors) == 3
+        assert all("kernel exploded" in e for e in errors)
+
+    def test_result_count_mismatch_is_an_error(self):
+        b = MicroBatcher(lambda k, items: [], window_s=0.005)
+        with pytest.raises(RuntimeError, match="0 results"):
+            b.submit("k", "x")
+
+    def test_distinct_keys_never_share(self):
+        calls = []
+        b = MicroBatcher(_echo_dispatch(calls), window_s=0.2, max_batch=8)
+        results = {}
+        barrier = threading.Barrier(4)
+
+        def worker(key, i):
+            barrier.wait()
+            results[(key, i)] = b.submit(key, i)
+
+        threads = [
+            threading.Thread(target=worker, args=(key, i))
+            for i, key in enumerate(["a", "a", "b", "b"])
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert len(calls) == 2
+        assert all(key == k for (key, _), (k, _, _) in results.items())
+
+    def test_invalid_construction(self):
+        with pytest.raises(ValueError):
+            MicroBatcher(lambda k, i: [], window_s=0.0)
+        with pytest.raises(ValueError):
+            MicroBatcher(lambda k, i: [], window_s=0.01, max_batch=0)
+
+
+def _sweep_dispatch(snap, mode):
+    """The server-style combined dispatch: concatenate scenario rows,
+    one sweep, scatter slices."""
+    from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+
+    def dispatch(_key, grids):
+        combined = ScenarioGrid(
+            cpu_request_milli=np.concatenate(
+                [g.cpu_request_milli for g in grids]
+            ),
+            mem_request_bytes=np.concatenate(
+                [g.mem_request_bytes for g in grids]
+            ),
+            replicas=np.concatenate([g.replicas for g in grids]),
+        )
+        totals, sched = sweep_snapshot(snap, combined, mode=mode)
+        out, off = [], 0
+        for g in grids:
+            out.append((totals[off:off + g.size], sched[off:off + g.size]))
+            off += g.size
+        return out
+
+    return dispatch
+
+
+class TestBatchedBitExactness:
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    def test_batched_equals_solo_and_oracle(self, mode):
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+
+        snap = synthetic_snapshot(90, seed=1, alloc_pods=5)
+        snap.pods_count[::4] = 9  # Q1 overwrite -> negative fits
+        snap.healthy[::3] = False
+        grids = [random_scenario_grid(1 + i % 7, seed=i) for i in range(12)]
+        b = MicroBatcher(
+            _sweep_dispatch(snap, mode), window_s=0.1, max_batch=16
+        )
+        results = [None] * len(grids)
+        barrier = threading.Barrier(len(grids))
+
+        def worker(i):
+            barrier.wait()
+            results[i] = b.submit("gen-1", grids[i])
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(len(grids))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert b.stats["batched_requests"] > 0  # it really batched
+        for i, g in enumerate(grids):
+            totals, sched = results[i]
+            solo_t, solo_s = sweep_snapshot(snap, g, mode=mode)
+            np.testing.assert_array_equal(totals, solo_t)
+            np.testing.assert_array_equal(sched, solo_s)
+            # And element-for-element against the sequential oracle.
+            for j in range(g.size):
+                fits = fit_arrays_python(
+                    snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+                    snap.alloc_pods, snap.used_cpu_req_milli,
+                    snap.used_mem_req_bytes, snap.pods_count,
+                    int(g.cpu_request_milli[j]),
+                    int(g.mem_request_bytes[j]),
+                    mode=mode, healthy=snap.healthy,
+                )
+                assert int(totals[j]) == int(
+                    np.asarray(fits, dtype=np.int64).sum()
+                )
+
+    def test_batching_single_request_equals_solo_path(self):
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+
+        snap = synthetic_snapshot(50, seed=2)
+        grid = random_scenario_grid(8, seed=3)
+        b = MicroBatcher(
+            _sweep_dispatch(snap, "reference"), window_s=0.002
+        )
+        totals, sched = b.submit("gen-1", grid)
+        solo_t, solo_s = sweep_snapshot(snap, grid)
+        np.testing.assert_array_equal(totals, solo_t)
+        np.testing.assert_array_equal(sched, solo_s)
+        assert b.stats["solo_requests"] == 1

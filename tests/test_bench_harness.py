@@ -79,6 +79,19 @@ class TestProbeChild:
         assert float(env[bench._SPAWN_T_ENV]) > 0
 
 
+class TestProbeGate:
+    def test_gate_on_by_default(self, bench_mod):
+        # A failed probe must skip the TPU init ladder (BENCH_r05 burned
+        # >600 s re-proving what the probe already knew) …
+        bench = bench_mod()
+        assert bench._PROBE_GATE is True
+
+    def test_gate_env_escape_hatch(self, bench_mod):
+        # … unless the operator explicitly asks for the old re-dial.
+        bench = bench_mod(KCC_BENCH_PROBE_GATE="0")
+        assert bench._PROBE_GATE is False
+
+
 class TestChildIO:
     def test_stdout_queue_and_stderr_tail(self, bench_mod):
         bench = bench_mod()

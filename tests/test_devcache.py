@@ -1,0 +1,391 @@
+"""Device-resident snapshot cache + shape-bucket ladder (hot path PR).
+
+Three contracts pinned here:
+
+* cache mechanics — per-snapshot keying, exact hit/miss accounting under
+  a 16-thread hammer, LRU bound, invalidation, the ``KCCAP_DEVCACHE=0``
+  escape hatch;
+* bit-exactness — bucketed (node- and scenario-padded) sweeps equal the
+  sequential array oracle element-for-element in both semantics modes,
+  Q1 overwrite, unhealthy and masked nodes included;
+* compile visibility — a ±1 node change inside a bucket adds no
+  per-bucket compile label; crossing a bucket edge adds exactly one.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu import devcache
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+from kubernetesclustercapacity_tpu.ops.fit import (
+    sweep_grid,
+    sweep_grid_bucketed,
+    sweep_snapshot,
+)
+from kubernetesclustercapacity_tpu.ops.pallas_fit import sweep_snapshot_auto
+from kubernetesclustercapacity_tpu.scenario import (
+    ScenarioGrid,
+    random_scenario_grid,
+)
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+MIB = 1024 * 1024
+
+
+def _snapshot_args(snap):
+    return (
+        snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+        snap.used_cpu_req_milli, snap.used_mem_req_bytes, snap.pods_count,
+        snap.healthy,
+    )
+
+
+class TestBucketLadder:
+    def test_node_bucket_is_pow2_with_floor(self):
+        floor = devcache.node_bucket_floor()
+        assert devcache.node_bucket(1) == floor
+        assert devcache.node_bucket(floor) == floor
+        assert devcache.node_bucket(floor + 1) == floor * 2
+        assert devcache.node_bucket(1000, floor=256) == 1024
+        assert devcache.node_bucket(1001, floor=256) == 1024
+        assert devcache.node_bucket(1025, floor=256) == 2048
+
+    def test_scenario_bucket(self):
+        assert devcache.scenario_bucket(1) == devcache.SCENARIO_BUCKET_FLOOR
+        assert devcache.scenario_bucket(17) == 32
+        assert devcache.scenario_bucket(256) == 256
+
+    def test_set_floor_roundtrip(self):
+        old = devcache.node_bucket_floor()
+        try:
+            devcache.set_node_bucket_floor(64)
+            assert devcache.node_bucket_floor() == 64
+            assert devcache.node_bucket(65) == 128
+            with pytest.raises(ValueError):
+                devcache.set_node_bucket_floor(0)
+        finally:
+            devcache.set_node_bucket_floor(old)
+
+
+class TestDeviceCache:
+    def test_hit_returns_identical_object(self):
+        cache = devcache.DeviceCache()
+        snap = synthetic_snapshot(50, seed=1)
+        first = cache.exact_arrays(snap)
+        second = cache.exact_arrays(snap)
+        assert first is second
+        st = cache.stats()
+        assert (st["hits"], st["misses"], st["entries"]) == (1, 1, 1)
+        assert st["hit_rate"] == 0.5
+
+    def test_exact_arrays_padded_to_bucket(self):
+        cache = devcache.DeviceCache()
+        snap = synthetic_snapshot(300, seed=2)
+        arrays = cache.exact_arrays(snap)
+        bucket = devcache.node_bucket(300)
+        assert all(a.shape == (bucket,) for a in arrays)
+        # Real rows intact, padding rows zero / unhealthy.
+        np.testing.assert_array_equal(
+            np.asarray(arrays[0])[:300], snap.alloc_cpu_milli
+        )
+        assert not np.asarray(arrays[6])[300:].any()
+        assert np.asarray(arrays[0])[300:].sum() == 0
+
+    def test_distinct_snapshots_distinct_entries(self):
+        cache = devcache.DeviceCache()
+        a = synthetic_snapshot(20, seed=1)
+        b = synthetic_snapshot(20, seed=2)
+        ea, eb = cache.exact_arrays(a), cache.exact_arrays(b)
+        assert ea is not eb
+        assert cache.stats()["entries"] == 2
+
+    def test_invalidate_snapshot_drops_only_its_entries(self):
+        cache = devcache.DeviceCache()
+        a = synthetic_snapshot(20, seed=1)
+        b = synthetic_snapshot(20, seed=2)
+        cache.exact_arrays(a)
+        kept = cache.exact_arrays(b)
+        cache.invalidate(a)
+        assert cache.stats()["entries"] == 1
+        assert cache.exact_arrays(b) is kept  # b's entry survived
+        cache.invalidate()
+        assert cache.stats()["entries"] == 0
+
+    def test_lru_bound_evicts_oldest(self):
+        cache = devcache.DeviceCache(max_entries=2)
+        snaps = [synthetic_snapshot(10, seed=s) for s in range(3)]
+        entries = [cache.exact_arrays(s) for s in snaps]
+        assert cache.stats()["entries"] == 2
+        # snaps[0] was evicted: re-staging is a miss with a new object.
+        assert cache.exact_arrays(snaps[0]) is not entries[0]
+        # snaps[2] is still resident.
+        assert cache.exact_arrays(snaps[2]) is entries[2]
+
+    def test_escape_hatch_disables_caching(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_DEVCACHE", "0")
+        cache = devcache.DeviceCache()
+        snap = synthetic_snapshot(20, seed=3)
+        first = cache.exact_arrays(snap)
+        assert cache.exact_arrays(snap) is not first
+        st = cache.stats()
+        assert st["entries"] == 0 and not st["enabled"]
+
+    def test_sixteen_thread_hammer_exact_counters(self):
+        """16 threads × 8 gets after one warm entry: every get is a hit,
+        counters add up exactly, and every thread saw the same object."""
+        cache = devcache.DeviceCache()
+        snap = synthetic_snapshot(100, seed=4)
+        warm = cache.exact_arrays(snap)
+        results: list = []
+        lock = threading.Lock()
+
+        def worker():
+            mine = [cache.exact_arrays(snap) for _ in range(8)]
+            with lock:
+                results.extend(mine)
+
+        threads = [threading.Thread(target=worker) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len(results) == 16 * 8
+        assert all(r is warm for r in results)
+        st = cache.stats()
+        assert st["misses"] == 1
+        assert st["hits"] == 16 * 8
+        assert st["entries"] == 1
+
+    def test_pallas_arrays_match_fresh_padding(self):
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            pad_node_array,
+            padded_node_shape,
+        )
+
+        cache = devcache.DeviceCache()
+        snap = synthetic_snapshot(70, seed=5)
+        staged = cache.pallas_arrays(snap)
+        n_pad = padded_node_shape(70)
+        fresh = (
+            pad_node_array(snap.alloc_cpu_milli, n_pad),
+            pad_node_array(snap.alloc_mem_bytes, n_pad, kib=True),
+            pad_node_array(snap.alloc_pods, n_pad),
+            pad_node_array(snap.used_cpu_req_milli, n_pad),
+            pad_node_array(snap.used_mem_req_bytes, n_pad, kib=True),
+            pad_node_array(snap.pods_count, n_pad),
+        )
+        for s, f in zip(staged, fresh):
+            np.testing.assert_array_equal(np.asarray(s), f)
+        assert cache.pallas_arrays(snap) is staged
+
+    def test_warm_prestages_both_forms(self):
+        cache = devcache.DeviceCache()
+        snap = synthetic_snapshot(30, seed=6)
+        cache.warm(snap)
+        st = cache.stats()
+        assert st["entries"] == 2 and st["misses"] == 2
+        cache.exact_arrays(snap)
+        cache.pallas_arrays(snap)
+        assert cache.stats()["hits"] == 2
+
+
+class TestResourceMatrixMemo:
+    def test_cached_is_identical_object_and_equal(self):
+        snap = synthetic_snapshot(40, seed=7)
+        a1, u1 = snap.resource_matrix(("cpu", "memory"))
+        a2, u2 = snap.resource_matrix(("cpu", "memory"))
+        assert a1 is a2 and u1 is u2
+        np.testing.assert_array_equal(
+            a1, np.stack([snap.alloc_cpu_milli, snap.alloc_mem_bytes])
+        )
+        np.testing.assert_array_equal(
+            u1,
+            np.stack([snap.used_cpu_req_milli, snap.used_mem_req_bytes]),
+        )
+
+    def test_distinct_resource_tuples_distinct_entries(self):
+        snap = synthetic_snapshot(10, seed=8)
+        a_cpu_mem, _ = snap.resource_matrix(("cpu", "memory"))
+        a_mem_cpu, _ = snap.resource_matrix(("memory", "cpu"))
+        assert a_cpu_mem is not a_mem_cpu
+        np.testing.assert_array_equal(a_cpu_mem[0], a_mem_cpu[1])
+
+    def test_cached_matrices_are_read_only(self):
+        snap = synthetic_snapshot(10, seed=9)
+        alloc, used = snap.resource_matrix()
+        with pytest.raises(ValueError):
+            alloc[0, 0] = 1
+        with pytest.raises(ValueError):
+            used[0, 0] = 1
+
+    def test_list_argument_hits_tuple_cache(self):
+        snap = synthetic_snapshot(10, seed=10)
+        a1, _ = snap.resource_matrix(("cpu", "memory"))
+        a2, _ = snap.resource_matrix(["cpu", "memory"])
+        assert a1 is a2
+
+
+def _oracle_fits(snap, grid, mode, node_mask=None):
+    """Sequential ground truth: per-scenario fit_arrays_python, with the
+    kernel's post-epilogue mask zeroing applied on top."""
+    out = []
+    for j in range(grid.size):
+        fits = np.asarray(
+            fit_arrays_python(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+                snap.alloc_pods, snap.used_cpu_req_milli,
+                snap.used_mem_req_bytes, snap.pods_count,
+                int(grid.cpu_request_milli[j]),
+                int(grid.mem_request_bytes[j]),
+                mode=mode, healthy=snap.healthy,
+            ),
+            dtype=np.int64,
+        )
+        if node_mask is not None:
+            fits = np.where(np.asarray(node_mask, bool), fits, 0)
+        out.append(fits)
+    return np.stack(out)
+
+
+class TestBucketedBitExactness:
+    """Bucketed + cached sweeps equal the sequential oracle exactly."""
+
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_random_snapshot_property(self, mode, seed):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(3, 200))
+        snap = synthetic_snapshot(n, seed=seed, alloc_pods=7)
+        # Q1 overwrite territory: some nodes with exhausted pod budgets
+        # (negative reference-mode fits), some unhealthy.
+        snap.pods_count[::3] = 11
+        snap.healthy[::4] = False
+        grid = random_scenario_grid(int(rng.integers(1, 40)), seed=seed + 5)
+        mask = rng.random(n) < 0.8
+        expected = _oracle_fits(snap, grid, mode, node_mask=mask)
+        totals, sched, fits = sweep_snapshot(
+            snap, grid, mode=mode, node_mask=mask, return_per_node=True
+        )
+        np.testing.assert_array_equal(fits, expected)
+        np.testing.assert_array_equal(totals, expected.sum(axis=1))
+        np.testing.assert_array_equal(
+            sched, expected.sum(axis=1) >= grid.replicas
+        )
+
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    def test_bucketed_equals_unbucketed_dispatch(self, mode):
+        snap = synthetic_snapshot(333, seed=11)
+        snap.healthy[::5] = False
+        grid = random_scenario_grid(23, seed=12)
+        args = _snapshot_args(snap)
+        raw = sweep_grid(
+            *args, grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, mode=mode,
+        )
+        bucketed = sweep_grid_bucketed(
+            *args, grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, mode=mode,
+        )
+        np.testing.assert_array_equal(bucketed[0], np.asarray(raw[0]))
+        np.testing.assert_array_equal(bucketed[1], np.asarray(raw[1]))
+
+    def test_wrapped_negative_values_survive_padding(self):
+        # Reference semantics carries Go uint64 wrap bit patterns
+        # (negative int64); zero-padding must not disturb them.
+        snap = synthetic_snapshot(10, seed=13)
+        snap.used_mem_req_bytes[3] = -(1 << 40)  # wrapped headroom
+        snap.alloc_cpu_milli[4] = -5  # huge uint64 view
+        grid = random_scenario_grid(5, seed=14)
+        expected = _oracle_fits(snap, grid, "reference")
+        _, _, fits = sweep_snapshot(snap, grid, return_per_node=True)
+        np.testing.assert_array_equal(fits, expected)
+
+    def test_auto_dispatch_with_cache_matches_exact(self):
+        snap = synthetic_snapshot(500, seed=15)
+        grid = random_scenario_grid(24, seed=16)
+        # Twice: the second dispatch rides the warm pallas cache entry.
+        first = sweep_snapshot_auto(snap, grid)
+        second = sweep_snapshot_auto(snap, grid)
+        exact, _ = sweep_snapshot(snap, grid)
+        np.testing.assert_array_equal(first[0], exact)
+        np.testing.assert_array_equal(second[0], exact)
+
+    def test_escape_hatch_same_numbers(self, monkeypatch):
+        snap = synthetic_snapshot(77, seed=17)
+        grid = random_scenario_grid(9, seed=18)
+        on = sweep_snapshot(snap, grid)
+        monkeypatch.setenv("KCCAP_DEVCACHE", "0")
+        off = sweep_snapshot(snap, grid)
+        np.testing.assert_array_equal(on[0], off[0])
+        np.testing.assert_array_equal(on[1], off[1])
+
+
+class TestCompileVisibility:
+    def test_plus_one_node_inside_bucket_adds_no_compile_label(self):
+        from kubernetesclustercapacity_tpu.telemetry import compilewatch
+
+        grid = random_scenario_grid(8, seed=19)
+        sweep_snapshot(synthetic_snapshot(1000, seed=20), grid)
+        seen_before = {
+            k for k in compilewatch.seen_kernels()
+            if k.startswith("xla_int64@n")
+        }
+        assert "xla_int64@n1024" in seen_before
+        sweep_snapshot(synthetic_snapshot(1001, seed=20), grid)
+        seen_after = {
+            k for k in compilewatch.seen_kernels()
+            if k.startswith("xla_int64@n")
+        }
+        assert seen_after == seen_before  # same bucket, no new label
+
+    def test_crossing_bucket_edge_adds_exactly_one_label(self):
+        from kubernetesclustercapacity_tpu.telemetry import compilewatch
+
+        # A distinctive floor makes the bucket labels unique to this
+        # test, so suite ordering can never have pre-seen them.
+        old = devcache.node_bucket_floor()
+        try:
+            devcache.set_node_bucket_floor(1536)
+            grid = random_scenario_grid(8, seed=21)
+            sweep_snapshot(synthetic_snapshot(1500, seed=22), grid)
+            seen_before = set(compilewatch.seen_kernels())
+            assert "xla_int64@n1536" in seen_before
+            sweep_snapshot(synthetic_snapshot(1537, seed=22), grid)
+            new = {
+                k for k in set(compilewatch.seen_kernels()) - seen_before
+                if k.startswith("xla_int64@n")
+            }
+            assert new == {"xla_int64@n3072"}
+        finally:
+            devcache.set_node_bucket_floor(old)
+
+
+class TestGspmdStaging:
+    def test_staged_sharded_sweep_matches_and_caches(self):
+        from kubernetesclustercapacity_tpu.parallel import (
+            make_mesh,
+            sweep_gspmd,
+        )
+        from kubernetesclustercapacity_tpu.parallel.sweep import (
+            stage_gspmd_arrays,
+        )
+
+        plan = make_mesh()
+        snap = synthetic_snapshot(100, seed=23)
+        grid = random_scenario_grid(16, seed=24)
+        args = _snapshot_args(snap)
+        plain = sweep_gspmd(
+            plan, args, grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas,
+        )
+        cached = sweep_gspmd(
+            plan, args, grid.cpu_request_milli, grid.mem_request_bytes,
+            grid.replicas, snapshot=snap,
+        )
+        np.testing.assert_array_equal(plain[0], cached[0])
+        np.testing.assert_array_equal(plain[1], cached[1])
+        assert stage_gspmd_arrays(plan, snap) is stage_gspmd_arrays(
+            plan, snap
+        )

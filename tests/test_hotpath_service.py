@@ -1,0 +1,296 @@
+"""Hot-path service behavior: cache invalidation under snapshot churn,
+micro-batching over the wire, and the throughput smoke test.
+
+The load-bearing guarantee: ``replace_snapshot``/``reload``/``update``
+under concurrent sweeps never serves a stale generation — every response
+must equal the totals of the generation the flight recorder says
+answered it (the dump op's per-record snapshot-generation field is the
+witness).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu import devcache
+from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
+from kubernetesclustercapacity_tpu.service import CapacityClient, CapacityServer
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+GRID_N, GRID_SEED = 6, 77
+
+
+def _expected_totals(snap):
+    grid = random_scenario_grid(GRID_N, seed=GRID_SEED)
+    totals, _ = sweep_snapshot(snap, grid)
+    return totals.tolist()
+
+
+class TestGenerationConsistency:
+    def test_replace_under_concurrent_sweeps_never_tears(self):
+        """Hammer sweeps from 8 threads while the snapshot flips A→B→A…;
+        every response's totals must equal the totals of the generation
+        its flight record carries — a torn read (new snapshot, old mask,
+        or half-swapped state) would produce totals matching neither."""
+        snap_a = synthetic_snapshot(64, seed=1)
+        snap_b = synthetic_snapshot(64, seed=2, mean_utilization=0.7)
+        expected = {1: _expected_totals(snap_a)}
+        assert expected[1] != _expected_totals(snap_b)  # distinguishable
+
+        srv = CapacityServer(
+            snap_a, port=0, flight_records=4096, batch_window_ms=0.5
+        )
+        srv.start()
+        try:
+            responses: dict[str, list] = {}
+            resp_lock = threading.Lock()
+            stop = threading.Event()
+
+            def sweeper():
+                with CapacityClient(*srv.address, trace=True) as c:
+                    while not stop.is_set():
+                        r = c.sweep(random={"n": GRID_N, "seed": GRID_SEED})
+                        with resp_lock:
+                            responses[c.last_trace_id] = r["totals"]
+
+            threads = [
+                threading.Thread(target=sweeper) for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for i in range(6):
+                new = snap_b if i % 2 == 0 else snap_a
+                srv.replace_snapshot(new)
+                expected[srv.generation] = _expected_totals(new)
+            stop.set()
+            for t in threads:
+                t.join(30)
+
+            with CapacityClient(*srv.address) as c:
+                dump = c.dump()
+            gen_by_trace = {
+                r["trace_id"]: r["generation"]
+                for r in dump["records"]
+                if r["op"] == "sweep" and r["trace_id"]
+            }
+            assert responses  # the hammer actually ran
+            checked = 0
+            for trace_id, totals in responses.items():
+                gen = gen_by_trace.get(trace_id)
+                if gen is None:
+                    continue  # fell off the (generous) ring
+                assert totals == expected[gen], (
+                    f"trace {trace_id}: totals do not match the "
+                    f"generation ({gen}) that answered"
+                )
+                checked += 1
+            assert checked >= len(responses) // 2
+        finally:
+            srv.shutdown()
+
+    def test_replace_invalidates_devcache_entries(self):
+        snap_a = synthetic_snapshot(32, seed=3)
+        snap_b = synthetic_snapshot(32, seed=4)
+        srv = CapacityServer(snap_a, port=0)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                c.sweep(random={"n": 4, "seed": 1}, kernel="exact")
+                entries_before = devcache.CACHE.stats()["entries"]
+                srv.replace_snapshot(snap_b)
+                c.sweep(random={"n": 4, "seed": 1}, kernel="exact")
+            # A's entries were dropped on swap; B's took their place —
+            # the cache never grows per reload.
+            assert devcache.CACHE.stats()["entries"] <= entries_before + 1
+        finally:
+            srv.shutdown()
+
+    def test_warm_prestages_new_generation(self):
+        snap_a = synthetic_snapshot(48, seed=5)
+        snap_b = synthetic_snapshot(48, seed=6)
+        srv = CapacityServer(snap_a, port=0)
+        srv.start()
+        try:
+            st0 = devcache.CACHE.stats()
+            srv.replace_snapshot(snap_b, warm=True)
+            st1 = devcache.CACHE.stats()
+            # The publish itself staged B (misses moved), so the first
+            # reader hits a warm cache.
+            assert st1["misses"] > st0["misses"]
+            with CapacityClient(*srv.address) as c:
+                before_hits = devcache.CACHE.stats()["hits"]
+                c.sweep(random={"n": 4, "seed": 2}, kernel="exact")
+                assert devcache.CACHE.stats()["hits"] > before_hits
+        finally:
+            srv.shutdown()
+
+
+class TestServerBatching:
+    def test_info_hot_path_opt_in(self):
+        snap = synthetic_snapshot(16, seed=7)
+        srv = CapacityServer(snap, port=0, batch_window_ms=1.0)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                assert "hot_path" not in c.info()  # default shape pinned
+                hp = c.info(hot_path=True)["hot_path"]
+            assert set(hp) == {"devcache", "node_bucket_floor", "batching"}
+            assert hp["batching"]["window_ms"] == 1.0
+            assert hp["batching"]["max_batch"] == 32
+        finally:
+            srv.shutdown()
+
+    def test_batching_disabled_reports_none(self):
+        snap = synthetic_snapshot(16, seed=7)
+        srv = CapacityServer(snap, port=0, batch_window_ms=0)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                r = c.sweep(random={"n": 4, "seed": 1})
+                hp = c.info(hot_path=True)["hot_path"]
+            assert hp["batching"] is None
+            assert len(r["totals"]) == 4
+        finally:
+            srv.shutdown()
+
+    def test_concurrent_sweeps_batch_and_match_solo(self):
+        """N concurrent client sweeps against a live batching server:
+        the batch-size histogram must move, and every response must be
+        bit-identical to its solo (batching-off) answer."""
+        snap = synthetic_snapshot(128, seed=8)
+        srv = CapacityServer(
+            snap, port=0, batch_window_ms=25.0, batch_max=16,
+            max_inflight=16,
+        )
+        srv.start()
+        try:
+            seeds = list(range(10))
+            solo = {
+                s: sweep_snapshot(
+                    snap, random_scenario_grid(5, seed=s)
+                )[0].tolist()
+                for s in seeds
+            }
+            results: dict[int, list] = {}
+            barrier = threading.Barrier(len(seeds))
+
+            def worker(seed):
+                with CapacityClient(*srv.address) as c:
+                    barrier.wait()
+                    results[seed] = c.sweep(
+                        random={"n": 5, "seed": seed}
+                    )["totals"]
+
+            threads = [
+                threading.Thread(target=worker, args=(s,)) for s in seeds
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            for s in seeds:
+                assert results[s] == solo[s]
+            st = srv._batcher.stats
+            assert st["dispatches"] >= 1
+            assert st["batched_requests"] > 0  # at least one real batch
+            assert st["dispatches"] < len(seeds)  # it actually coalesced
+        finally:
+            srv.shutdown()
+
+    def test_expired_deadline_sheds_alone_inside_burst(self):
+        """A shed request in a concurrent burst sheds by itself: the
+        other requests answer normally (acceptance: 'a shed request
+        inside a batch sheds alone')."""
+        from kubernetesclustercapacity_tpu.resilience import Deadline
+
+        snap = synthetic_snapshot(64, seed=9)
+        srv = CapacityServer(snap, port=0, batch_window_ms=20.0)
+        srv.start()
+        try:
+            outcomes: dict[int, object] = {}
+            barrier = threading.Barrier(4)
+
+            def worker(i):
+                with CapacityClient(*srv.address) as c:
+                    barrier.wait()
+                    try:
+                        if i == 0:
+                            # Pre-expired absolute deadline on the wire.
+                            outcomes[i] = c.call(
+                                "sweep", random={"n": 3, "seed": 1},
+                                deadline=Deadline.after(-1.0).to_wire(),
+                            )
+                        else:
+                            outcomes[i] = c.sweep(
+                                random={"n": 3, "seed": 1}
+                            )
+                    except Exception as e:  # noqa: BLE001
+                        outcomes[i] = e
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            assert isinstance(outcomes[0], Exception)
+            assert "deadline" in str(outcomes[0]).lower()
+            for i in (1, 2, 3):
+                assert isinstance(outcomes[i], dict)
+                assert len(outcomes[i]["totals"]) == 3
+        finally:
+            srv.shutdown()
+
+
+@pytest.mark.slow
+class TestThroughputSmoke:
+    def test_concurrent_sweep_throughput_zero_diffs(self):
+        """The CI throughput smoke: 64 sweeps from 16 concurrent clients
+        against a live batching server — batch-size histogram count > 0
+        and zero correctness diffs against the solo path."""
+        snap = synthetic_snapshot(1000, seed=10)
+        srv = CapacityServer(
+            snap, port=0, batch_window_ms=5.0, batch_max=32,
+            max_inflight=32,
+        )
+        srv.start()
+        try:
+            per_client = 4
+            n_clients = 16
+            diffs: list = []
+            solo_cache: dict = {}
+            solo_lock = threading.Lock()
+
+            def solo(seed):
+                with solo_lock:
+                    if seed not in solo_cache:
+                        solo_cache[seed] = sweep_snapshot(
+                            snap, random_scenario_grid(8, seed=seed)
+                        )[0].tolist()
+                    return solo_cache[seed]
+
+            def worker(base):
+                with CapacityClient(*srv.address) as c:
+                    for k in range(per_client):
+                        seed = (base * per_client + k) % 10
+                        got = c.sweep(random={"n": 8, "seed": seed})
+                        if got["totals"] != solo(seed):
+                            diffs.append((seed, got["totals"]))
+
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert not diffs
+            size_hist = srv._batcher._m_size.labels()
+            assert size_hist.count > 0  # histogram moved
+            assert srv._batcher.stats["batched_requests"] > 0
+        finally:
+            srv.shutdown()
