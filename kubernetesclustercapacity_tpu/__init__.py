@@ -85,3 +85,7 @@ from kubernetesclustercapacity_tpu.explain import (  # noqa: E402,F401
     ExplainResult,
     explain_snapshot,
 )
+from kubernetesclustercapacity_tpu.timeline import (  # noqa: E402,F401
+    CapacityTimeline,
+    load_watchlist,
+)

@@ -136,6 +136,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "the exact sweep kernels (node counts pad to the "
                         "next power of two >= the floor; 0 = keep the "
                         "default/KCCAP_NODE_BUCKET_FLOOR setting)")
+    p.add_argument("-timeline", default=None, metavar="HOST:PORT",
+                   help="render a running capacity service's timeline "
+                        "(per-generation watchlist capacities, attributed "
+                        "deltas, alert states) and exit; -output json "
+                        "selects the structured form")
+    p.add_argument("-timeline-since", type=int, default=None,
+                   dest="timeline_since", metavar="GEN",
+                   help="with -timeline: only records/deltas strictly "
+                        "after generation GEN")
+    p.add_argument("-timeline-watch", default=None, dest="timeline_watch",
+                   metavar="NAME",
+                   help="with -timeline: narrow records/deltas/alerts to "
+                        "one watch")
     return p
 
 
@@ -173,6 +186,9 @@ def main(argv: list[str] | None = None) -> int:
         )
         print(report)
         return code
+
+    if args.timeline:
+        return _run_timeline(args)
 
     # Telemetry surfaces (both opt-in, zero cost otherwise): a scrape
     # endpoint over the process registry — the fused-path counters and
@@ -292,6 +308,56 @@ def _run_command(args) -> int:
     if args.grid > 0:
         return _run_grid(args, snapshot)
     return _run_single(args, fixture, snapshot, scenario)
+
+
+def _run_timeline(args) -> int:
+    """-timeline HOST:PORT: fetch and render a service's capacity
+    timeline (the drift view no offline snapshot can answer — it lives
+    with the server that watched the generations go by)."""
+    from kubernetesclustercapacity_tpu.report import (
+        timeline_json_report,
+        timeline_table_report,
+    )
+    from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+    from kubernetesclustercapacity_tpu.service.client import CapacityClient
+
+    host, _, port = args.timeline.rpartition(":")
+    try:
+        addr = (host or "127.0.0.1", int(port))
+    except ValueError:
+        print(f"ERROR : bad -timeline {args.timeline!r} (want HOST:PORT)",
+              file=sys.stderr)
+        return 1
+    try:
+        with CapacityClient(
+            *addr,
+            connect_timeout_s=5.0,
+            timeout_s=10.0,
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+            deadline_s=10.0,
+        ) as c:
+            result = c.timeline(
+                since_generation=args.timeline_since,
+                watch=args.timeline_watch,
+            )
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot fetch timeline from "
+              f"{addr[0]}:{addr[1]}: {e}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(timeline_json_report(result))
+    else:
+        print(timeline_table_report(result))
+    if not result.get("enabled", False):
+        return 1
+    breached = [
+        name
+        for name, a in result.get("alerts", {}).items()
+        if a.get("state") == "breached"
+    ]
+    # Exit by the verdict, like -drain does: a breached watchlist is a
+    # scriptable signal, not just prose.
+    return 1 if breached else 0
 
 
 def _run_explain(args, snapshot, scenario) -> int:

@@ -62,6 +62,7 @@ __all__ = [
     "BINDING_UNHEALTHY",
     "BINDING_MASKED",
     "ExplainResult",
+    "binding_shift",
     "explain_per_node",
     "explain_grid",
     "explain_snapshot",
@@ -464,6 +465,25 @@ class ExplainResult:
             healthy=[bool(snap.healthy[i])],
         )[0]
         return after > before
+
+
+def binding_shift(
+    old_counts: dict[str, int], new_counts: dict[str, int]
+) -> dict[str, int]:
+    """How a binding histogram MOVED between two explanations.
+
+    ``{constraint: node-count delta}`` with zero-delta constraints
+    omitted — the timeline's drift-attribution vocabulary ("binding
+    constraint shifted memory→pods on 12 nodes" is ``{"memory": -12,
+    "pods": +12}``).  Lives here because this module owns the binding
+    taxonomy; the inputs are :meth:`ExplainResult.binding_counts` dicts
+    from any two generations.
+    """
+    return {
+        name: new_counts.get(name, 0) - old_counts.get(name, 0)
+        for name in BINDING_NAMES
+        if new_counts.get(name, 0) != old_counts.get(name, 0)
+    }
 
 
 def explain_snapshot(

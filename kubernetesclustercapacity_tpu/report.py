@@ -27,6 +27,8 @@ __all__ = [
     "table_report",
     "explain_table_report",
     "explain_json_report",
+    "timeline_table_report",
+    "timeline_json_report",
 ]
 
 _RULE = "=" * 110  # the reference prints 110 '=' (ClusterCapacity.go:142,149)
@@ -318,6 +320,80 @@ def explain_json_report(result, s: int = 0) -> str:
         },
         indent=2,
     )
+
+
+def timeline_table_report(timeline: dict) -> str:
+    """The ``timeline`` op's response as operator-readable text.
+
+    Three blocks: per-generation watch capacities (one row per
+    generation, one column per watch — the drift at a glance), the
+    attributed deltas (the "what changed and why" one-liners the diff
+    engine + binding-shift analysis produce), and current alert states.
+    """
+    if not timeline.get("enabled", False):
+        return "timeline: not enabled on this server (-watch/-timeline-depth)"
+    watches = [w["name"] for w in timeline.get("watchlist", [])]
+    lines = [
+        f"capacity timeline: {timeline['count']} generation(s) held "
+        f"(depth {timeline['depth']}), serving generation "
+        f"{timeline['generation']}"
+    ]
+    records = timeline.get("records", [])
+    if records:
+        header = f"{'GEN':>5} {'NODES':>7} {'HEALTHY':>8} {'DIGEST':<18}"
+        for w in watches:
+            header += f" {w[:14]:>14}"
+        lines += ["", header, "-" * len(header)]
+        for rec in records:
+            row = (
+                f"{rec['generation']:>5} {rec['nodes']:>7} "
+                f"{rec['healthy_nodes']:>8} {rec['digest']:<18}"
+            )
+            for w in watches:
+                wr = rec["watches"].get(w)
+                cell = "-" if wr is None else (
+                    f"{wr['total']}{'!' if wr['breached'] else ''}"
+                )
+                row += f" {cell:>14}"
+            lines.append(row)
+        lines.append("-" * len(header))
+        if any(
+            r["watches"].get(w, {}).get("breached")
+            for r in records
+            for w in watches
+        ):
+            lines.append("('!' = below the watch's min_replicas)")
+    deltas = timeline.get("deltas", [])
+    if deltas:
+        lines += ["", "deltas:"]
+        for d in deltas:
+            lines.append(
+                f"  gen {d['from_generation']}→{d['to_generation']}: "
+                f"+{len(d['nodes_added'])} node(s), "
+                f"-{len(d['nodes_removed'])}, "
+                f"{d['nodes_changed']} changed"
+            )
+            for w in sorted(d.get("watches", {})):
+                lines.append(f"    {d['watches'][w]['summary']}")
+    alerts = timeline.get("alerts", {})
+    if alerts:
+        lines += ["", "alerts:"]
+        for name in sorted(alerts):
+            a = alerts[name]
+            line = f"  {name:<24} {a['state']}"
+            if a["min_replicas"] is not None:
+                line += (
+                    f"  (min_replicas={a['min_replicas']}, "
+                    f"last={a['last_total']}, breaches={a['breaches']})"
+                )
+            lines.append(line)
+    return "\n".join(lines)
+
+
+def timeline_json_report(timeline: dict) -> str:
+    """The ``timeline`` op's response, pretty-printed (machine surface —
+    the wire shape verbatim, so scripts parse one schema)."""
+    return json.dumps(timeline, indent=2)
 
 
 def table_report(

@@ -16,9 +16,9 @@ sweep_multi,    duplicate execution returns the identical result)
 place, drain,
 topology_spread,
 plan, explain
-dump            yes (read-only view of the flight recorder; a retry
-                re-reads the ring, which may have advanced — acceptable
-                for a diagnostic surface)
+dump,           yes (read-only views of the flight recorder / capacity
+timeline        timeline; a retry re-reads the ring, which may have
+                advanced — acceptable for a diagnostic surface)
 update, reload  NO (state mutations; at-most-once from this client)
 ==============  =======================================================
 """
@@ -45,7 +45,7 @@ __all__ = ["CapacityClient", "IDEMPOTENT_OPS"]
 IDEMPOTENT_OPS = frozenset(
     {
         "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
-        "topology_spread", "plan", "explain", "dump",
+        "topology_spread", "plan", "explain", "dump", "timeline",
     }
 )
 
@@ -331,6 +331,33 @@ class CapacityClient:
         binding histogram, saturation summary, marginal (+1) analysis."""
         return self.call("explain", **flags)
 
-    def dump(self, **kw) -> dict:
-        """The server's flight recorder: its last K dispatched requests."""
+    def dump(self, op: str | None = None, status: str | None = None,
+             limit: int | None = None, **kw) -> dict:
+        """The server's flight recorder: its last K dispatched requests.
+
+        Filters apply SERVER-side: ``op`` keeps records of one op (sent
+        as ``filter_op`` — the envelope's own ``op`` field names this
+        request), ``status`` keeps ``"ok"``/``"error"`` records, and
+        ``limit`` returns only the N most recent matches.
+        """
+        if op is not None:
+            kw["filter_op"] = op
+        if status is not None:
+            kw["status"] = status
+        if limit is not None:
+            kw["limit"] = limit
         return self.call("dump", **kw)
+
+    def timeline(self, since_generation: int | None = None,
+                 watch: str | None = None, **kw) -> dict:
+        """The server's capacity timeline: per-generation watchlist
+        capacities, attributed deltas (node-set diff + binding-constraint
+        shift), and alert states.  ``since_generation`` returns only
+        records/deltas strictly after that generation; ``watch`` narrows
+        the per-watch sections to one name.  ``{"enabled": false}`` when
+        the server runs without a timeline."""
+        if since_generation is not None:
+            kw["since_generation"] = since_generation
+        if watch is not None:
+            kw["watch"] = watch
+        return self.call("timeline", **kw)

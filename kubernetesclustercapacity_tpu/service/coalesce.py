@@ -77,8 +77,30 @@ class SnapshotCoalescer:
         self.events = 0  # total notify() calls
         self.flushes = 0  # total flush() completions
         self.last_error: str | None = None
+        # Publish freshness evidence (read by /healthz via stats()):
+        # when the last flush finished and how long it took — the
+        # coalescer-side witness that publishes (and whatever rides
+        # them: cache warming, timeline observation) are still flowing.
+        self.last_flush_ts: float | None = None
+        self.last_flush_s: float | None = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    def stats(self) -> dict:
+        """JSON-able counters + freshness (no lock: single-writer fields
+        read for display only)."""
+        return {
+            "events": self.events,
+            "flushes": self.flushes,
+            "pending": self._pending,
+            "last_error": self.last_error,
+            "last_flush_s": self.last_flush_s,
+            "last_flush_age_s": (
+                None
+                if self.last_flush_ts is None
+                else round(time.monotonic() - self.last_flush_ts, 3)
+            ),
+        }
 
     def notify(self, *_args, **_kw) -> None:
         """Signal one applied event.  Signature-compatible with the
@@ -143,6 +165,7 @@ class SnapshotCoalescer:
                     self._cv.wait(remaining)
 
     def _do_flush(self) -> None:
+        t0 = time.monotonic()
         try:
             self._flush()
         except Exception as e:  # noqa: BLE001 - embedder decides fatality
@@ -154,3 +177,5 @@ class SnapshotCoalescer:
                     pass
         else:
             self.flushes += 1
+            self.last_flush_ts = time.monotonic()
+            self.last_flush_s = round(self.last_flush_ts - t0, 6)

@@ -40,10 +40,23 @@ explain    the fit flag fields — per-node bottleneck attribution for
            (smallest single-node capacity increment yielding +1
            replica); optional ``output`` (``table`` | ``json``) adds a
            rendered ``report``
-dump       — ; the server's flight recorder (ring buffer of the last K
+dump       the server's flight recorder (ring buffer of the last K
            dispatched requests: op, args digest, snapshot generation,
            trace_id, latency, status, result digest) as
-           ``{records, count, capacity, dropped, generation}``
+           ``{records, count, matched, capacity, dropped, generation}``;
+           optional server-side filters: ``filter_op`` (exact op name —
+           the envelope's own ``op`` field is taken), ``status``
+           (``ok`` | ``error``), ``limit`` (the N most recent matches)
+timeline   the server's capacity timeline: per-generation watchlist
+           capacities + binding histograms, attributed
+           generation-to-generation deltas (nodes added/removed/mutated
+           with per-resource deltas, per-watch capacity movement,
+           binding-constraint shift, per-node fit contributions), and
+           per-watch alert state (ok | breached | recovered) as
+           ``{enabled, depth, count, generation, watchlist, records,
+           deltas, alerts}``; optional ``since_generation`` (strictly
+           after) and ``watch`` (one name) filters; ``{enabled: false}``
+           when the server runs without ``-watch``/``-timeline-depth``
 reload     ``path`` — swap the served snapshot (fixture .json or .npz);
            optional ``semantics``
 update     ``events`` — watch-style node/pod event list applied

@@ -102,6 +102,8 @@ class CapacityServer:
         flight_dump_path: str | None = None,
         batch_window_ms: float = 1.0,
         batch_max: int = 32,
+        timeline=None,
+        request_log=None,
     ) -> None:
         """``stats_source`` is an optional zero-arg callable returning a
         JSON-able dict of upstream-feed health (e.g.
@@ -128,7 +130,22 @@ class CapacityServer:
         to this window (``batch_max`` rows of requests at most) and
         dispatch as ONE kernel launch, each response scattered back with
         its own trace/deadline semantics.  ``0`` disables batching (every
-        sweep dispatches solo, the pre-batching behavior)."""
+        sweep dispatches solo, the pre-batching behavior).
+
+        ``timeline`` (a :class:`~..timeline.CapacityTimeline`) turns the
+        generation counter into a first-class capacity history: every
+        snapshot swap — construction, ``replace_snapshot`` (the
+        coalescer's publish thread under ``-follow``), ``reload``,
+        ``update`` — is observed (watchlist re-evaluated, node-set diff
+        recorded, alerts advanced) and served back through the
+        ``timeline`` op.  Observation runs on the PUBLISHER'S thread,
+        never a query dispatcher's.
+
+        ``request_log`` (a path or :class:`~..telemetry.TraceLog`) emits
+        one structured JSON line per dispatched request — op, trace_id,
+        span_id, snapshot generation, latency, status — the log half of
+        a logs↔traces join: the same ``span_id`` lands in the
+        ``trace_log`` span record when both are wired."""
         import os
 
         from kubernetesclustercapacity_tpu.telemetry.flightrec import (
@@ -146,6 +163,12 @@ class CapacityServer:
         self._trace_log = (
             TraceLog(trace_log) if isinstance(trace_log, str) else trace_log
         )
+        self._request_log = (
+            TraceLog(request_log)
+            if isinstance(request_log, str)
+            else request_log
+        )
+        self._timeline = timeline
         m = self.registry
         self._m_requests = m.counter(
             "kccap_requests_total", "Requests dispatched, by op.", ("op",)
@@ -214,6 +237,10 @@ class CapacityServer:
         self._tcp = _ThreadingServer((host, port), _Handler)
         self._tcp.capacity_server = self  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
+        # Generation 1 is a generation too: the timeline's baseline
+        # record, so the very first publish already has something to
+        # diff against.
+        self._observe_timeline(snapshot, self._generation)
 
     @property
     def address(self) -> tuple[str, int]:
@@ -229,6 +256,25 @@ class CapacityServer:
     def flight_recorder(self):
         """The server's request flight recorder (read-mostly surface)."""
         return self._flight
+
+    @property
+    def timeline(self):
+        """The capacity timeline this server feeds (``None`` unless
+        configured)."""
+        return self._timeline
+
+    def _observe_timeline(self, snapshot, generation: int) -> None:
+        """Record one published generation in the timeline.  Best-effort
+        by the same rule as every observability hook: a failed watchlist
+        evaluation must never fail the publish it observes (the
+        coalescer would treat that as a fatal publish error and kill a
+        supervised serve over a diagnostic)."""
+        if self._timeline is None:
+            return
+        try:
+            self._timeline.observe(snapshot, generation)
+        except Exception:  # noqa: BLE001 - observability never fails a swap
+            pass
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -274,7 +320,7 @@ class CapacityServer:
         {
             "ping", "info", "fit", "sweep", "sweep_multi", "place",
             "drain", "topology_spread", "plan", "explain", "dump",
-            "reload", "update",
+            "timeline", "reload", "update",
         }
     )
 
@@ -308,11 +354,28 @@ class CapacityServer:
             dur = _time.perf_counter() - t0
             self._m_inflight.dec()
             self._m_latency.labels(op=op_label).observe(dur)
+            # The generation that ANSWERED (captured under the dispatch
+            # lock), shared by the flight record and the request log;
+            # ops that never captured one (ping, shed requests) fall
+            # back to the current generation.
+            gen = getattr(self._dispatch_tls, "generation", None)
+            self._dispatch_tls.generation = None
+            gen = self.generation if gen is None else gen
+            # One span ID correlates the trace-log span with the JSON
+            # request-log line — minted only when something records it.
+            span_id = None
+            if self._trace_log is not None or self._request_log is not None:
+                from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                    new_span_id,
+                )
+
+                span_id = new_span_id()
             if self._trace_log is not None:
                 try:
                     self._trace_log.record(
                         ts=_time.time(),
                         trace_id=trace_id or "",
+                        span_id=span_id,
                         op=op_label,
                         duration_ms=round(dur * 1e3, 3),
                         status="error" if error else "ok",
@@ -320,12 +383,26 @@ class CapacityServer:
                     )
                 except Exception:  # noqa: BLE001 - tracing must not fail ops
                     pass
+            if self._request_log is not None:
+                try:
+                    self._request_log.record(
+                        ts=_time.time(),
+                        op=op_label,
+                        trace_id=trace_id or "",
+                        span_id=span_id,
+                        generation=gen,
+                        latency_ms=round(dur * 1e3, 3),
+                        status="error" if error else "ok",
+                        **({"error": error} if error else {}),
+                    )
+                except Exception:  # noqa: BLE001 - logging must not fail ops
+                    pass
             self._flight_record(
-                msg, op_label, trace_id, dur, error, result
+                msg, op_label, trace_id, dur, error, result, gen
             )
 
     def _flight_record(
-        self, msg, op_label, trace_id, dur, error, result
+        self, msg, op_label, trace_id, dur, error, result, gen
     ) -> None:
         """One flight-recorder entry per dispatch (the failing request
         included), then — on error, when configured — the whole ring
@@ -333,16 +410,11 @@ class CapacityServer:
         fails the op it observes."""
         from kubernetesclustercapacity_tpu.telemetry import flightrec
 
-        # The generation that ANSWERED (captured under the dispatch
-        # lock); ops that never captured one (ping, shed requests) fall
-        # back to the current generation.
-        gen = getattr(self._dispatch_tls, "generation", None)
-        self._dispatch_tls.generation = None
         try:
             self._flight.record(
                 op=op_label,
                 args_digest=flightrec.args_digest(msg),
-                generation=self.generation if gen is None else gen,
+                generation=gen,
                 trace_id=(trace_id or "") if isinstance(trace_id, str) else "",
                 latency_ms=dur * 1e3,
                 status="error" if error else "ok",
@@ -517,7 +589,9 @@ class CapacityServer:
         if op == "explain":
             return self._op_explain(msg, snap, implicit_mask)
         if op == "dump":
-            return self._op_dump()
+            return self._op_dump(msg)
+        if op == "timeline":
+            return self._op_timeline(msg)
         if op == "reload":
             return self._op_reload(msg, snap)
         if op == "update":
@@ -986,19 +1060,70 @@ class CapacityServer:
             out["report"] = explain_json_report(result)
         return out
 
-    def _op_dump(self) -> dict:
+    def _op_dump(self, msg: dict) -> dict:
         """The flight recorder over the wire: the last K dispatched
         requests (this ``dump`` itself lands in the ring only after its
         own dispatch finishes, so the returned records end at the
-        request before it)."""
+        request before it).
+
+        Server-side filters — ``op`` (exact op name), ``status``
+        (``"ok"``/``"error"``), ``limit`` (the N MOST RECENT matches) —
+        so a triage client chasing "the last 5 errors" pulls 5 records,
+        not the whole ring.  ``count`` is the post-filter record count;
+        ``matched`` the pre-``limit`` match count, so a reader knows
+        how much history the filter found beyond what it was handed.
+        """
+        # ``op`` names THIS request's op on the envelope, so the filter
+        # rides as ``filter_op`` (the client's ``dump(op=...)`` maps it).
+        op_f = msg.get("filter_op")
+        if op_f is not None and not isinstance(op_f, str):
+            raise ValueError(f"filter_op must be a string, got {op_f!r}")
+        status = msg.get("status")
+        if status is not None and status not in ("ok", "error"):
+            raise ValueError(
+                f"status filter must be 'ok' or 'error', got {status!r}"
+            )
+        limit = msg.get("limit")
+        if limit is not None:
+            if isinstance(limit, bool) or not isinstance(limit, int):
+                raise ValueError(f"limit must be an integer, got {limit!r}")
+            if limit < 1:
+                raise ValueError(f"limit must be >= 1, got {limit}")
         records = self._flight.records()
+        if op_f is not None:
+            records = [r for r in records if r.get("op") == op_f]
+        if status is not None:
+            records = [r for r in records if r.get("status") == status]
+        matched = len(records)
+        if limit is not None:
+            records = records[-limit:]
         return {
             "records": records,
             "count": len(records),
+            "matched": matched,
             "capacity": self._flight.capacity,
             "dropped": self._flight.dropped,
             "generation": self.generation,
         }
+
+    def _op_timeline(self, msg: dict) -> dict:
+        """The capacity timeline over the wire: per-generation records,
+        attributed deltas, and alert states — filtered server-side by
+        ``since_generation`` (strictly-after) and ``watch`` (one name),
+        so a follower polling for news pulls only the transitions it has
+        not seen."""
+        if self._timeline is None:
+            return {"enabled": False}
+        since = msg.get("since_generation")
+        if since is not None:
+            if isinstance(since, bool) or not isinstance(since, int):
+                raise ValueError(
+                    f"since_generation must be an integer, got {since!r}"
+                )
+        watch = msg.get("watch")
+        if watch is not None and not isinstance(watch, str):
+            raise ValueError(f"watch must be a string, got {watch!r}")
+        return self._timeline.wire(since_generation=since, watch=watch)
 
     def _op_sweep(
         self,
@@ -1252,10 +1377,16 @@ class CapacityServer:
             self._fixture_dirty = False
             self._implicit_mask = mask
             self._generation += 1
+            generation = self._generation
         if old is not snapshot:
             devcache.CACHE.invalidate(old)
         if warm:
             devcache.CACHE.warm(snapshot)
+        # Timeline observation rides the SAME publisher thread as the
+        # warm pre-stage (the coalescer's worker under -follow), AFTER
+        # warming — the watchlist evaluation hits a warm device cache,
+        # and a query dispatcher never pays for either.
+        self._observe_timeline(snapshot, generation)
 
     def _op_reload(self, msg: dict, snap: ClusterSnapshot) -> dict:
         """``snap`` is the dispatch's lock-captured snapshot — reading
@@ -1349,10 +1480,15 @@ class CapacityServer:
                 self._fixture_dirty = True  # rebuilt on demand (cpu fit)
                 self._implicit_mask = _implicit_taint_mask(snap)
                 self._generation += 1
+                generation = self._generation
         if old is not snap:
             from kubernetesclustercapacity_tpu import devcache
 
             devcache.CACHE.invalidate(old)
+        # update is a mutation op (never the query hot path): observing
+        # on its dispatch thread keeps the record synchronous with the
+        # event batch that produced the generation.
+        self._observe_timeline(snap, generation)
         return {
             "nodes": snap.n_nodes,
             "healthy_nodes": int(np.sum(snap.healthy)),
@@ -1431,6 +1567,29 @@ def main(argv=None) -> int:
                         "(node counts pad to the next power of two >= "
                         "the floor, so ±1-node churn reuses compiled "
                         "kernels; 0 = keep the default/env setting)")
+    p.add_argument("-watch", default=None, metavar="FILE",
+                   help="watchlist (YAML/JSON) of named scenarios the "
+                        "capacity timeline re-evaluates on every snapshot "
+                        "publish; entries with min_replicas arm the "
+                        "ok/breached/recovered alert machine (enables the "
+                        "timeline op and kccap_watch_* gauges)")
+    p.add_argument("-timeline-depth", type=int, default=0,
+                   dest="timeline_depth", metavar="K",
+                   help="keep a capacity timeline of the last K snapshot "
+                        "generations (served by the timeline op; 0 = "
+                        "disabled unless -watch is given, which implies 64)")
+    p.add_argument("-timeline-log", default=None, dest="timeline_log",
+                   metavar="PATH",
+                   help="append one JSONL line per observed generation "
+                        "and per watch alert transition to PATH (the "
+                        "flight-recorder-style durable capacity history)")
+    p.add_argument("-log-json", default=None, dest="log_json",
+                   metavar="PATH",
+                   help="structured request logging: append one JSON "
+                        "line per dispatched request (op, trace_id, "
+                        "span_id, generation, latency_ms, status) to "
+                        "PATH; span_id joins these lines to -trace-log "
+                        "spans")
     args = p.parse_args(argv)
 
     import os as _os
@@ -1498,6 +1657,29 @@ def main(argv=None) -> int:
         from kubernetesclustercapacity_tpu import devcache
 
         devcache.set_node_bucket_floor(args.node_bucket_floor)
+    timeline = None
+    if args.watch or args.timeline_depth > 0 or args.timeline_log:
+        from kubernetesclustercapacity_tpu.timeline import (
+            CapacityTimeline,
+            WatchError,
+            load_watchlist,
+        )
+
+        watches = ()
+        if args.watch:
+            try:
+                watches = load_watchlist(args.watch)
+            except (OSError, WatchError) as e:
+                print(f"ERROR : bad watchlist: {e}", file=sys.stderr)
+                if follower is not None:
+                    follower.stop()
+                return 1
+        timeline = CapacityTimeline(
+            watches,
+            depth=args.timeline_depth if args.timeline_depth > 0 else 64,
+            registry=REGISTRY,
+            log=args.timeline_log,
+        )
     server = CapacityServer(
         snap, host=args.host, port=args.port, fixture=fixture,
         auth_token=auth_token, max_inflight=args.max_inflight,
@@ -1511,8 +1693,11 @@ def main(argv=None) -> int:
         flight_dump_path=args.flight_dump,
         batch_window_ms=max(args.batch_window_ms, 0.0),
         batch_max=max(args.batch_max, 1),
+        timeline=timeline,
+        request_log=args.log_json,
     )
     metrics_server = None
+    coalescer_ref: list = []  # filled below; healthz closes over it
     if args.metrics_port:
         from kubernetesclustercapacity_tpu.telemetry.exposition import (
             start_metrics_server,
@@ -1530,6 +1715,13 @@ def main(argv=None) -> int:
                     "last_relist_age_s": follower.last_relist_age_s(),
                     "fatal": follower.fatal,
                 }
+            if coalescer_ref:
+                out["coalescer"] = coalescer_ref[0].stats()
+            if timeline is not None:
+                # The capacity story behind the liveness answer: which
+                # watches are breached RIGHT NOW, visible to the same
+                # scraper that reads the gauges.
+                out["timeline"] = timeline.stats()
             return out
 
         try:
@@ -1593,6 +1785,7 @@ def main(argv=None) -> int:
             min_interval_s=max(args.coalesce_ms, 0) / 1e3,
             on_error=_publish_failed,
         )
+        coalescer_ref.append(coalescer)
         follower.on_event = coalescer.notify
         follower.start_watches()  # after wiring: no event can be missed
     print(
@@ -1632,6 +1825,8 @@ def main(argv=None) -> int:
             coalescer.stop()
         if metrics_server is not None:
             metrics_server.shutdown()
+        if timeline is not None:
+            timeline.close()  # flush the -timeline-log JSONL
         server.shutdown()
     return 0
 

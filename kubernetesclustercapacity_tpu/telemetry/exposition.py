@@ -9,11 +9,19 @@ order, histogram ``_bucket{le=...}`` series cumulative with the
 :class:`MetricsServer` serves that rendering over HTTP from a
 background thread (stdlib ``http.server`` — no new dependencies):
 
-* ``GET /metrics``  — the scrape, ``text/plain; version=0.0.4``;
+* ``GET /metrics``  — the scrape, ``text/plain; version=0.0.4`` with an
+  explicit charset; the endpoint self-reports
+  ``kccap_scrape_duration_seconds`` (how long each rendering took), so
+  a scrape config's timeout budget is tunable from the scrapes
+  themselves;
 * ``GET /healthz``  — liveness JSON; an embedder-supplied ``healthy``
   callable flips it to 503 (e.g. a dead follower behind a serving
   snapshot must be *visible* to the load balancer, the same
   never-silently-stale rule the follower itself enforces).
+
+``HEAD`` is answered on every path with the GET status/headers and no
+body — uptime probes and load balancers preflight with HEAD, and an
+observability endpoint that 501s them reads as down.
 
 The endpoint is observability-only and carries no auth: bind it to
 localhost (the default) or scrape-net, never the request port.
@@ -110,18 +118,49 @@ class MetricsServer:
         status=None,
     ) -> None:
         import http.server
+        import time
+
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            enabled as _telemetry_enabled,
+        )
 
         self.registry = registry
         self._healthy = healthy
         self._status = status
+        # Scrape self-report: the time each exposition render takes,
+        # visible in the very scrape it measures (the previous render's
+        # sample — a scrape cannot carry its own final timing).  Skipped
+        # under KCCAP_TELEMETRY=0: a disabled process must not have its
+        # metrics endpoint re-populate the registry it silenced.
+        self._scrape_hist = (
+            registry.histogram(
+                "kccap_scrape_duration_seconds",
+                "Time spent rendering the /metrics exposition.",
+            )
+            if _telemetry_enabled()
+            else None
+        )
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 - stdlib contract
+                self._serve(head=False)
+
+            def do_HEAD(self) -> None:  # noqa: N802 - stdlib contract
+                # Identical routing/status/headers, body withheld: the
+                # cheap liveness preflight probes and LBs issue.
+                self._serve(head=True)
+
+            def _serve(self, *, head: bool) -> None:
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
+                    t0 = time.perf_counter()
                     body = render_text(outer.registry).encode()
-                    self._reply(200, CONTENT_TYPE, body)
+                    if outer._scrape_hist is not None:
+                        outer._scrape_hist.observe(
+                            time.perf_counter() - t0
+                        )
+                    self._reply(200, CONTENT_TYPE, body, head)
                 elif path == "/healthz":
                     ok = True
                     if outer._healthy is not None:
@@ -140,16 +179,28 @@ class MetricsServer:
                                 f"{type(e).__name__}: {e}"
                             )
                     body = json.dumps(payload).encode()
-                    self._reply(200 if ok else 503, "application/json", body)
+                    self._reply(
+                        200 if ok else 503,
+                        "application/json; charset=utf-8",
+                        body,
+                        head,
+                    )
                 else:
-                    self._reply(404, "text/plain", b"not found\n")
+                    self._reply(
+                        404, "text/plain; charset=utf-8", b"not found\n",
+                        head,
+                    )
 
-            def _reply(self, code: int, ctype: str, body: bytes) -> None:
+            def _reply(
+                self, code: int, ctype: str, body: bytes,
+                head: bool = False,
+            ) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                self.wfile.write(body)
+                if not head:
+                    self.wfile.write(body)
 
             def log_message(self, *args) -> None:  # scrapes are not news
                 pass

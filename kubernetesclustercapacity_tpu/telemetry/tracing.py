@@ -47,6 +47,13 @@ class TraceLog:
     long-lived server cannot fill the disk, and the most recent ~2x
     ``max_bytes`` of spans always survive.  ``0`` (the default) keeps
     the historical unbounded behavior.
+
+    The first lazy open registers an ``atexit`` close for this log, so
+    a short-lived ``kccap`` run that never reaches an explicit
+    ``close()`` (early ``sys.exit``, an embedder that forgot the
+    context manager) still flushes and closes its final spans at
+    interpreter shutdown — the last span of a one-shot CLI invocation
+    is precisely the one a trace pipeline must not lose.
     """
 
     def __init__(self, path: str, *, max_bytes: int = 0) -> None:
@@ -57,6 +64,7 @@ class TraceLog:
         self._lock = threading.Lock()
         self._fh = None
         self._closed = False
+        self._atexit_registered = False
 
     def record(self, **fields) -> None:
         line = json.dumps(fields, sort_keys=True)
@@ -65,6 +73,11 @@ class TraceLog:
                 return
             if self._fh is None:
                 self._fh = open(self.path, "a", encoding="utf-8")
+                if not self._atexit_registered:
+                    import atexit
+
+                    atexit.register(self.close)
+                    self._atexit_registered = True
             self._fh.write(line + "\n")
             self._fh.flush()
             if self.max_bytes and self._fh.tell() > self.max_bytes:

@@ -1,0 +1,58 @@
+"""Capacity timeline: per-generation history, drift attribution, alerting.
+
+The service layers before this one observe the *service* (request
+counters, traces, the flight recorder); nothing observes the *domain*.
+Snapshot generations arrive through the coalescer, capacity silently
+jumps, and nobody can say which nodes or which binding constraint moved
+it — exactly the drift problem that motivates chance-constrained
+capacity planning (arXiv:2207.11122, arXiv:2511.08373): the question an
+operator asks a live `-follow` server is never "how many replicas fit
+right now" but "what changed, when, and why did my headroom move".
+
+Four pieces, each independently usable:
+
+* :mod:`.watchlist` — named what-if scenarios (``-watch FILE``,
+  YAML/JSON) the timeline re-evaluates on every snapshot publish, each
+  with an optional ``min_replicas`` alert threshold;
+* :mod:`.diff`      — the generation-to-generation node-set diff engine
+  (added/removed/mutated nodes with per-resource allocatable deltas),
+  invertible by construction: ``apply(old, diff) == new`` is a pinned
+  property, so a recorded diff IS the generation transition;
+* :mod:`.alerts`    — the per-watch ok → breached → recovered state
+  machine behind the ``kccap_watch_*`` gauges, ``/healthz`` and doctor;
+* :mod:`.history`   — :class:`~.history.CapacityTimeline`, the bounded
+  thread-safe ring of :class:`~.history.GenerationRecord` entries the
+  server feeds from the coalescer publish thread (off the request path,
+  riding the same warm pre-stage the device cache uses), and the delta
+  attribution that joins the diff with the explain pass's binding
+  histograms ("capacity 41→37: node pool-b-7 drained, binding
+  constraint shifted memory→pods on 12 nodes").
+
+Watch capacities are evaluated through :func:`~..explain.explain_snapshot`,
+whose fit column is pinned bit-identical to :func:`~..ops.fit.fit_per_node`
+— so a timeline entry's capacity equals a cold ``fit`` of the same
+generation by construction, in both semantics modes.
+"""
+
+from kubernetesclustercapacity_tpu.timeline.alerts import (  # noqa: F401
+    ALERT_BREACHED,
+    ALERT_OK,
+    ALERT_RECOVERED,
+    WatchAlert,
+)
+from kubernetesclustercapacity_tpu.timeline.diff import (  # noqa: F401
+    NODE_FIELDS,
+    SnapshotDiff,
+    diff_summaries,
+    node_summary,
+    snapshot_digest,
+)
+from kubernetesclustercapacity_tpu.timeline.history import (  # noqa: F401
+    CapacityTimeline,
+    GenerationRecord,
+)
+from kubernetesclustercapacity_tpu.timeline.watchlist import (  # noqa: F401
+    WatchError,
+    WatchSpec,
+    load_watchlist,
+)

@@ -1,0 +1,538 @@
+"""The capacity timeline: a bounded ring of per-generation records.
+
+:class:`CapacityTimeline` is fed one call per snapshot publish —
+``observe(snapshot, generation)`` — by the server's swap paths, which
+for a live ``-follow`` deployment means the COALESCER'S worker thread
+(the same off-request-path thread that pre-warms the device cache, so a
+watchlist evaluation rides a warm cache and never adds latency to a
+dispatched query).  Each observation captures:
+
+* the snapshot digest and per-node summary (:mod:`.diff`'s vocabulary);
+* the evaluated capacity of every watchlist scenario, through
+  :func:`~..explain.explain_snapshot` — whose fit column is pinned
+  bit-identical to :func:`~..ops.fit.fit_per_node`, so a timeline
+  capacity IS a cold ``fit`` of that generation — plus the binding
+  histogram the drift attribution consumes;
+* alert transitions (:mod:`.alerts`), appended to the ``-timeline-log``
+  JSONL alongside one line per generation.
+
+``deltas()`` joins consecutive records into attributed transitions: the
+node-set diff, per-watch capacity movement, the binding-constraint shift
+(:func:`~..explain.binding_shift`), and the per-node fit contributions
+that say WHICH nodes moved the total.
+
+Telemetry honors the process switch exactly like every other layer:
+with ``KCCAP_TELEMETRY=0`` (or no registry) an observation makes zero
+registry calls.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubernetesclustercapacity_tpu.explain import (
+    binding_shift,
+    explain_snapshot,
+)
+from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+from kubernetesclustercapacity_tpu.telemetry.metrics import (
+    enabled as _telemetry_enabled,
+)
+from kubernetesclustercapacity_tpu.timeline.alerts import WatchAlert
+from kubernetesclustercapacity_tpu.timeline.diff import (
+    diff_summaries,
+    node_summary,
+    snapshot_digest,
+)
+from kubernetesclustercapacity_tpu.timeline.watchlist import WatchSpec
+
+__all__ = ["CapacityTimeline", "GenerationRecord", "WatchResult"]
+
+#: Per-watch node contributions reported per delta (the full diff rides
+#: alongside; the contributor list is the "which nodes moved it" headline
+#: and stays readable at 10k-node scale).
+_MAX_CONTRIBUTORS = 8
+
+
+def _shift_phrase(shift: dict[str, int]) -> str:
+    """Human rendering of a binding shift.  The common drift — nodes
+    moving from one binding constraint to exactly one other — reads as
+    ``memory→pods on 12 nodes``; anything messier falls back to signed
+    per-constraint counts."""
+    losers = {k: -v for k, v in shift.items() if v < 0}
+    gainers = {k: v for k, v in shift.items() if v > 0}
+    if len(losers) == 1 and len(gainers) == 1:
+        (src, n_src), (dst, n_dst) = losers.popitem(), gainers.popitem()
+        if n_src == n_dst:
+            return f"binding constraint shifted {src}→{dst} on {n_src} node(s)"
+    parts = ", ".join(f"{k}{v:+d}" for k, v in sorted(shift.items()))
+    return f"binding counts moved: {parts}"
+
+
+def _delta_summary(
+    name: str, before: int, after: int, diff, shift, contributions
+) -> str:
+    """The one-line attribution an operator reads first, e.g.
+    ``capacity 41→37: node pool-b-7 removed (-4); binding constraint
+    shifted memory→pods on 12 node(s)``."""
+    head = f"{name}: capacity {before}→{after}"
+    if before == after and diff.empty:
+        return head + " (no change)"
+    clauses: list[str] = []
+    kind_verb = {"added": "added", "removed": "removed", "mutated": "changed"}
+    for key, c, kind in contributions[:3]:
+        clauses.append(
+            f"node {key or '<phantom>'} {kind_verb[kind]} ({c:+d})"
+        )
+    extra = len(contributions) - 3
+    if extra > 0:
+        clauses.append(f"{extra} more node(s)")
+    if shift:
+        clauses.append(_shift_phrase(shift))
+    if not clauses:
+        clauses.append(
+            f"{len(diff.added)} node(s) added, "
+            f"{len(diff.removed)} removed, {len(diff.changed)} changed"
+        )
+    return head + ": " + "; ".join(clauses)
+
+
+@dataclass
+class WatchResult:
+    """One watch evaluated against one generation."""
+
+    name: str
+    mode: str
+    total: int
+    schedulable: bool
+    breached: bool
+    min_replicas: int | None
+    binding_counts: dict[str, int]
+    fits: np.ndarray  # [N] per-node, aligned with the record's node keys
+
+    def to_wire(self) -> dict:
+        return {
+            "total": self.total,
+            "schedulable": self.schedulable,
+            "breached": self.breached,
+            "mode": self.mode,
+            "min_replicas": self.min_replicas,
+            "binding_counts": dict(self.binding_counts),
+        }
+
+
+@dataclass
+class GenerationRecord:
+    """Everything the timeline remembers about one published generation."""
+
+    generation: int
+    ts: float
+    digest: str
+    semantics: str
+    n_nodes: int
+    healthy_nodes: int
+    summary: dict[str, tuple[int, ...]]
+    watches: dict[str, WatchResult] = field(default_factory=dict)
+    eval_ms: float = 0.0
+
+    @property
+    def keys(self) -> list[str]:
+        """Node keys in snapshot row order (summary insertion order)."""
+        return list(self.summary)
+
+    def to_wire(self, watch: str | None = None) -> dict:
+        """JSON-able record (no per-node payloads — those feed ``deltas``)."""
+        return {
+            "generation": self.generation,
+            "ts": self.ts,
+            "digest": self.digest,
+            "semantics": self.semantics,
+            "nodes": self.n_nodes,
+            "healthy_nodes": self.healthy_nodes,
+            "eval_ms": round(self.eval_ms, 3),
+            "watches": {
+                name: r.to_wire()
+                for name, r in self.watches.items()
+                if watch is None or name == watch
+            },
+        }
+
+
+class CapacityTimeline:
+    """Thread-safe bounded capacity history + watchlist alerting.
+
+    ``observe`` is serialized by an internal lock (snapshot publishes are
+    already serialized upstream; the lock makes direct embedding safe
+    too) and never raises into its caller's publish path by CONTRACT of
+    the caller — the server wraps it best-effort, same as every other
+    observability hook.
+
+    ``registry`` wires the ``kccap_generation`` / ``kccap_watch_*``
+    metric families; ``None`` (or ``KCCAP_TELEMETRY=0`` at construction)
+    keeps the timeline registry-silent.  ``log`` is an optional JSONL
+    appender — a path or a :class:`~..telemetry.tracing.TraceLog` — that
+    receives one line per observed generation and one per alert
+    transition (the flight-recorder-style durable record).
+    """
+
+    def __init__(
+        self,
+        watches: tuple[WatchSpec, ...] = (),
+        *,
+        depth: int = 64,
+        registry=None,
+        log=None,
+    ) -> None:
+        from kubernetesclustercapacity_tpu.telemetry.tracing import TraceLog
+
+        if depth < 2:
+            # One record cannot diff against anything; the whole point
+            # of a timeline is the transition.
+            raise ValueError(f"timeline depth must be >= 2, got {depth}")
+        names = [w.name for w in watches]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate watch names: {names}")
+        self.watches: tuple[WatchSpec, ...] = tuple(watches)
+        self.depth = int(depth)
+        self._lock = threading.Lock()
+        self._ring: deque[GenerationRecord] = deque(maxlen=self.depth)
+        self._alerts = {
+            w.name: WatchAlert(w.name, w.min_replicas) for w in self.watches
+        }
+        self._log = TraceLog(log) if isinstance(log, str) else log
+        self._m = None
+        if registry is not None and _telemetry_enabled():
+            self._m = {
+                "generation": registry.gauge(
+                    "kccap_generation",
+                    "Served snapshot generation last observed.",
+                ),
+                "records": registry.gauge(
+                    "kccap_timeline_records",
+                    "Generation records currently held in the timeline.",
+                ),
+                "replicas": registry.gauge(
+                    "kccap_watch_replicas",
+                    "Evaluated capacity of a watchlist scenario.",
+                    ("watch",),
+                ),
+                "headroom": registry.gauge(
+                    "kccap_watch_headroom_pct",
+                    "Capacity headroom above the watch threshold "
+                    "(min_replicas, else the spec's replicas), percent.",
+                    ("watch",),
+                ),
+                "alert_state": registry.gauge(
+                    "kccap_watch_alert_state",
+                    "Watch alert state (0=ok, 1=recovered, 2=breached).",
+                    ("watch",),
+                ),
+                "breaches": registry.counter(
+                    "kccap_watch_breaches_total",
+                    "min_replicas breaches entered, by watch.",
+                    ("watch",),
+                ),
+                "changes": registry.counter(
+                    "kccap_watch_capacity_changes_total",
+                    "Generation-to-generation capacity moves, by watch "
+                    "and direction.",
+                    ("watch", "direction"),
+                ),
+                "eval": registry.histogram(
+                    "kccap_timeline_eval_seconds",
+                    "Wall time of one whole-watchlist evaluation "
+                    "(coalescer thread, off the request path).",
+                ),
+            }
+
+    # -- observation -------------------------------------------------------
+    def observe(
+        self, snapshot: ClusterSnapshot, generation: int, *, ts=None
+    ) -> GenerationRecord:
+        """Evaluate the watchlist against one published generation and
+        append the record.  Runs on the PUBLISHER'S thread (for a live
+        server, the coalescer worker — never a request dispatcher)."""
+        with self._lock:
+            t0 = time.perf_counter()
+            prev = self._ring[-1] if self._ring else None
+            record = GenerationRecord(
+                generation=int(generation),
+                ts=time.time() if ts is None else float(ts),
+                digest=snapshot_digest(snapshot),
+                semantics=snapshot.semantics,
+                n_nodes=snapshot.n_nodes,
+                healthy_nodes=int(np.sum(snapshot.healthy)),
+                summary=node_summary(snapshot),
+            )
+            transitions: list[tuple[str, WatchAlert]] = []
+            for mode, specs in self._mode_groups(snapshot):
+                grid = ScenarioGrid.from_scenarios(
+                    [s.scenario for s in specs]
+                )
+                # The same implicit hard-taint mask every strict fit
+                # surface applies (None unless the snapshot itself is
+                # strict-packed) — so a timeline capacity equals the fit
+                # op's answer for the identical spec, bit for bit.
+                mask = (
+                    implicit_taint_mask(snapshot)
+                    if mode == "strict"
+                    else None
+                )
+                result = explain_snapshot(
+                    snapshot, grid, mode=mode, node_mask=mask
+                )
+                for s_i, spec in enumerate(specs):
+                    total = int(result.totals[s_i])
+                    alert = self._alerts[spec.name]
+                    transition = alert.update(total, record.generation)
+                    if transition is not None:
+                        transitions.append((transition, alert))
+                    record.watches[spec.name] = WatchResult(
+                        name=spec.name,
+                        mode=mode,
+                        total=total,
+                        schedulable=total >= spec.scenario.replicas,
+                        breached=total < (spec.min_replicas or 0),
+                        min_replicas=spec.min_replicas,
+                        binding_counts=result.binding_counts(s_i),
+                        fits=np.asarray(result.fits[s_i], dtype=np.int64),
+                    )
+            record.eval_ms = (time.perf_counter() - t0) * 1e3
+            self._ring.append(record)
+            self._publish_metrics(record, prev)
+            self._append_log(record, transitions)
+            return record
+
+    def _mode_groups(self, snapshot: ClusterSnapshot):
+        """Watches grouped by effective kernel mode (one explain pass per
+        mode, whole watchlist vectorized along the scenario axis)."""
+        groups: dict[str, list[WatchSpec]] = {}
+        for spec in self.watches:
+            groups.setdefault(spec.mode or snapshot.semantics, []).append(
+                spec
+            )
+        return groups.items()
+
+    def _publish_metrics(self, record, prev) -> None:
+        if self._m is None or not _telemetry_enabled():
+            return
+        m = self._m
+        m["generation"].labels().set(record.generation)
+        m["records"].labels().set(len(self._ring))
+        m["eval"].observe(record.eval_ms / 1e3)
+        for spec in self.watches:
+            r = record.watches.get(spec.name)
+            if r is None:
+                continue
+            m["replicas"].labels(watch=spec.name).set(r.total)
+            threshold = spec.min_replicas or spec.scenario.replicas
+            if threshold > 0:
+                m["headroom"].labels(watch=spec.name).set(
+                    round(100.0 * (r.total - threshold) / threshold, 4)
+                )
+            m["alert_state"].labels(watch=spec.name).set(
+                self._alerts[spec.name].state_code
+            )
+            before = (
+                prev.watches[spec.name].total
+                if prev is not None and spec.name in prev.watches
+                else None
+            )
+            if before is not None and r.total != before:
+                m["changes"].labels(
+                    watch=spec.name,
+                    direction="up" if r.total > before else "down",
+                ).inc()
+        # Breach counters track the alert machine exactly (one source).
+        for name, alert in self._alerts.items():
+            if alert.breaches:
+                c = m["breaches"].labels(watch=name)
+                c.inc(alert.breaches - c.value)
+
+    def _append_log(self, record, transitions) -> None:
+        if self._log is None:
+            return
+        try:
+            self._log.record(
+                kind="generation",
+                generation=record.generation,
+                ts=record.ts,
+                digest=record.digest,
+                nodes=record.n_nodes,
+                healthy_nodes=record.healthy_nodes,
+                watches={
+                    name: r.total for name, r in record.watches.items()
+                },
+                eval_ms=round(record.eval_ms, 3),
+            )
+            for transition, alert in transitions:
+                self._log.record(
+                    kind="alert",
+                    ts=record.ts,
+                    watch=alert.name,
+                    transition=transition,
+                    generation=record.generation,
+                    total=alert.last_total,
+                    min_replicas=alert.min_replicas,
+                    breaches=alert.breaches,
+                )
+        except Exception:  # noqa: BLE001 - logging must not fail a publish
+            pass
+
+    # -- read surfaces -----------------------------------------------------
+    def records(
+        self, *, since_generation: int | None = None
+    ) -> list[GenerationRecord]:
+        """Oldest-to-newest copy of the ring (optionally only generations
+        strictly after ``since_generation``)."""
+        with self._lock:
+            recs = list(self._ring)
+        if since_generation is not None:
+            recs = [r for r in recs if r.generation > since_generation]
+        return recs
+
+    def alerts(self) -> dict[str, dict]:
+        """Current alert state per watch (wire shape)."""
+        with self._lock:
+            return {n: a.to_wire() for n, a in self._alerts.items()}
+
+    def deltas(
+        self,
+        *,
+        since_generation: int | None = None,
+        watch: str | None = None,
+    ) -> list[dict]:
+        """Attributed generation transitions, oldest to newest.
+
+        Each entry joins the node-set diff with per-watch capacity
+        movement: binding-constraint shift plus the per-node fit
+        contributions (added nodes contribute their new fit, removed
+        nodes their lost fit, mutated nodes the difference).
+        ``since_generation`` keeps transitions ENDING after it; ``watch``
+        filters the per-watch sections.
+        """
+        with self._lock:
+            recs = list(self._ring)
+        out = []
+        for prev, cur in zip(recs, recs[1:]):
+            if (
+                since_generation is not None
+                and cur.generation <= since_generation
+            ):
+                continue
+            out.append(self._delta(prev, cur, watch))
+        return out
+
+    def _delta(self, prev, cur, watch: str | None) -> dict:
+        diff = diff_summaries(prev.summary, cur.summary)
+        prev_idx = {k: i for i, k in enumerate(prev.summary)}
+        cur_idx = {k: i for i, k in enumerate(cur.summary)}
+        watches: dict[str, dict] = {}
+        for name, r in cur.watches.items():
+            if watch is not None and name != watch:
+                continue
+            old = prev.watches.get(name)
+            if old is None:
+                continue
+            contributions: list[tuple[str, int, str]] = []
+            for key in diff.removed:
+                c = -int(old.fits[prev_idx[key]])
+                if c:
+                    contributions.append((key, c, "removed"))
+            for key in diff.added:
+                c = int(r.fits[cur_idx[key]])
+                if c:
+                    contributions.append((key, c, "added"))
+            for key in diff.changed:
+                c = int(r.fits[cur_idx[key]]) - int(old.fits[prev_idx[key]])
+                if c:
+                    contributions.append((key, c, "mutated"))
+            contributions.sort(key=lambda t: (-abs(t[1]), t[0]))
+            shift = binding_shift(old.binding_counts, r.binding_counts)
+            watches[name] = {
+                "before": old.total,
+                "after": r.total,
+                "delta": r.total - old.total,
+                "binding_shift": shift,
+                "contributors": [
+                    {"node": k, "delta": c, "change": kind}
+                    for k, c, kind in contributions[:_MAX_CONTRIBUTORS]
+                ],
+                "summary": _delta_summary(
+                    name, old.total, r.total, diff, shift, contributions
+                ),
+            }
+        return {
+            "from_generation": prev.generation,
+            "to_generation": cur.generation,
+            "ts": cur.ts,
+            "nodes_added": sorted(diff.added),
+            "nodes_removed": sorted(diff.removed),
+            "nodes_changed": len(diff.changed),
+            "diff": diff.to_wire(),
+            "watches": watches,
+        }
+
+    # -- aggregate surfaces ------------------------------------------------
+    def wire(
+        self,
+        *,
+        since_generation: int | None = None,
+        watch: str | None = None,
+    ) -> dict:
+        """The whole timeline as the ``timeline`` op's response body."""
+        if watch is not None and watch not in self._alerts:
+            raise ValueError(
+                f"unknown watch {watch!r} "
+                f"(have {sorted(self._alerts) or 'none'})"
+            )
+        records = self.records(since_generation=since_generation)
+        with self._lock:
+            count, last = len(self._ring), (
+                self._ring[-1].generation if self._ring else 0
+            )
+        return {
+            "enabled": True,
+            "depth": self.depth,
+            "count": count,
+            "generation": last,
+            "watchlist": [w.to_wire() for w in self.watches],
+            "records": [r.to_wire(watch) for r in records],
+            "deltas": self.deltas(
+                since_generation=since_generation, watch=watch
+            ),
+            "alerts": (
+                self.alerts()
+                if watch is None
+                else {watch: self.alerts()[watch]}
+            ),
+        }
+
+    def stats(self) -> dict:
+        """Compact health view (doctor / ``/healthz``)."""
+        with self._lock:
+            count = len(self._ring)
+            last = self._ring[-1] if self._ring else None
+            alerts = {n: a.state for n, a in self._alerts.items()}
+        return {
+            "records": count,
+            "depth": self.depth,
+            "generation": last.generation if last else 0,
+            "watches": [w.name for w in self.watches],
+            "alerts": alerts,
+            "breached": sorted(
+                n for n, s in alerts.items() if s == "breached"
+            ),
+            "last_eval_ms": round(last.eval_ms, 3) if last else None,
+        }
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()

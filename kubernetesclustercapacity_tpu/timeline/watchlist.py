@@ -1,0 +1,164 @@
+"""Watchlists: the named scenarios a timeline re-evaluates every generation.
+
+A watchlist file (``kccap-server -watch FILE``, YAML or JSON — YAML is a
+superset, so one loader serves both) names the what-if specs an operator
+actually cares about, in the reference CLI's own flag grammar::
+
+    watches:
+      - name: web-tier
+        pod:
+          cpuRequests: 500m
+          memRequests: 1gb
+          replicas: "40"
+        min_replicas: 30        # optional alert threshold
+      - name: batch-strict
+        pod: {cpuRequests: "2", memRequests: 4gb}
+        semantics: strict       # optional kernel-mode override
+
+``pod`` fields parse through :func:`~..scenario.scenario_from_flags` —
+the exact reference codecs, so a watch capacity is bit-identical to the
+``kccap`` fit of the same flags.  ``semantics`` overrides the evaluation
+mode for that watch (default: the served snapshot's own packing mode);
+``min_replicas`` arms the ok → breached → recovered alert machine
+(absent = the watch is observed but never alerts).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from kubernetesclustercapacity_tpu.scenario import (
+    Scenario,
+    ScenarioError,
+    scenario_from_flags,
+)
+
+__all__ = ["WatchError", "WatchSpec", "load_watchlist", "parse_watchlist"]
+
+# The reference's five flag spellings, the only keys a pod block accepts —
+# an unknown key is a typo'd watch that would silently evaluate defaults.
+_POD_KEYS = frozenset(
+    {"cpuRequests", "cpuLimits", "memRequests", "memLimits", "replicas"}
+)
+
+_MODES = ("reference", "strict")
+
+
+class WatchError(ValueError):
+    """Malformed watchlist file/entry (bad YAML/JSON, bad flags, dupes)."""
+
+
+@dataclass(frozen=True)
+class WatchSpec:
+    """One named scenario: what to evaluate, how, and when to alert."""
+
+    name: str
+    scenario: Scenario
+    mode: str | None = None  # None = the served snapshot's semantics
+    min_replicas: int | None = None
+
+    def to_wire(self) -> dict:
+        """JSON-able description (rides the ``timeline`` op)."""
+        return {
+            "name": self.name,
+            "cpu_request_milli": self.scenario.cpu_request_milli,
+            "mem_request_bytes": self.scenario.mem_request_bytes,
+            "replicas": self.scenario.replicas,
+            "mode": self.mode,
+            "min_replicas": self.min_replicas,
+        }
+
+
+def _parse_entry(i: int, entry) -> WatchSpec:
+    if not isinstance(entry, dict):
+        raise WatchError(f"watch #{i}: expected a mapping, got {entry!r}")
+    name = entry.get("name")
+    if not isinstance(name, str) or not name:
+        raise WatchError(f"watch #{i}: 'name' must be a non-empty string")
+    pod = entry.get("pod") or {}
+    if not isinstance(pod, dict):
+        raise WatchError(f"watch {name!r}: 'pod' must be a mapping")
+    unknown = set(pod) - _POD_KEYS
+    if unknown:
+        raise WatchError(
+            f"watch {name!r}: unknown pod field(s) {sorted(unknown)} "
+            f"(want {sorted(_POD_KEYS)})"
+        )
+    try:
+        # YAML scalars may arrive as ints (replicas: 40) — the reference
+        # grammar is string flags, so stringify before the codec.
+        scenario = scenario_from_flags(
+            **{k: str(v) for k, v in pod.items()}
+        )
+        scenario.validate()
+    except ScenarioError as e:
+        raise WatchError(f"watch {name!r}: bad pod spec: {e}") from e
+    mode = entry.get("semantics")
+    if mode is not None and mode not in _MODES:
+        raise WatchError(
+            f"watch {name!r}: semantics must be one of {_MODES}, got {mode!r}"
+        )
+    min_replicas = entry.get("min_replicas")
+    if min_replicas is not None:
+        if not isinstance(min_replicas, int) or isinstance(min_replicas, bool):
+            raise WatchError(
+                f"watch {name!r}: min_replicas must be an integer"
+            )
+        if min_replicas < 0:
+            raise WatchError(
+                f"watch {name!r}: min_replicas must be >= 0"
+            )
+    extra = set(entry) - {"name", "pod", "semantics", "min_replicas"}
+    if extra:
+        raise WatchError(
+            f"watch {name!r}: unknown field(s) {sorted(extra)}"
+        )
+    return WatchSpec(
+        name=name, scenario=scenario, mode=mode, min_replicas=min_replicas
+    )
+
+
+def parse_watchlist(data) -> tuple[WatchSpec, ...]:
+    """Parsed document (``{"watches": [...]}`` or a bare list) → specs."""
+    if isinstance(data, dict):
+        entries = data.get("watches")
+        extra = set(data) - {"watches"}
+        if extra:
+            raise WatchError(f"unknown top-level field(s) {sorted(extra)}")
+    else:
+        entries = data
+    if not isinstance(entries, list) or not entries:
+        raise WatchError(
+            "watchlist wants a non-empty 'watches' list (or a bare list)"
+        )
+    specs = tuple(_parse_entry(i, e) for i, e in enumerate(entries))
+    names = [s.name for s in specs]
+    if len(set(names)) != len(names):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise WatchError(f"duplicate watch name(s): {dupes}")
+    return specs
+
+
+def load_watchlist(path: str) -> tuple[WatchSpec, ...]:
+    """Load ``path`` (YAML when PyYAML is present, else strict JSON).
+
+    YAML is a superset of JSON, so a ``.json`` watchlist parses either
+    way; without PyYAML only JSON files load (gated, not required).
+    """
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        import yaml  # type: ignore[import-untyped]
+
+        data = yaml.safe_load(text)
+    except ImportError:
+        try:
+            data = json.loads(text)
+        except ValueError as e:
+            raise WatchError(
+                f"{path}: not valid JSON (and PyYAML is unavailable): {e}"
+            ) from e
+    except Exception as e:  # yaml.YAMLError — malformed document
+        raise WatchError(f"{path}: cannot parse: {e}") from e
+    return parse_watchlist(data)

@@ -283,6 +283,45 @@ def doctor_report(
 
         check("capacity service", _service)
 
+        # The service's capacity timeline: generation history + watch
+        # alert states — the "did capacity drift while nobody looked"
+        # line.  Same short budgets; separate connection so a timeline
+        # failure cannot contaminate the lines above.
+        def _timeline():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                t = c.timeline()
+            if not t.get("enabled", False):
+                return "not configured (-watch / -timeline-depth off)"
+            parts = [
+                f"ok: {t.get('count')}/{t.get('depth')} generations",
+                f"generation={t.get('generation')}",
+                f"watches={len(t.get('watchlist', []))}",
+            ]
+            alerts = t.get("alerts", {})
+            flagged = [
+                f"{name}={a['state']}(breaches={a['breaches']})"
+                for name, a in sorted(alerts.items())
+                if a.get("state") != "ok"
+            ]
+            if flagged:
+                parts.append("alerts: " + " ".join(flagged))
+            elif alerts:
+                parts.append("alerts: all ok")
+            return " ".join(parts)
+
+        check("capacity timeline", _timeline)
+
         # The service's flight recorder: its last-K request history over
         # the dump op — one line of "what was this server just doing"
         # before anyone attaches a debugger.  Same short budgets as the

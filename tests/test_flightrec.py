@@ -238,3 +238,56 @@ class TestServerWiring:
             dump = c.dump()
         assert dump["count"] == 8
         assert dump["dropped"] >= 12
+
+
+class TestDumpFilters:
+    """Satellite (PR 5): dump answers filtered server-side — a triage
+    client chasing 'the last N errors of op X' pulls exactly those."""
+
+    def test_op_and_status_filters(self, server):
+        with CapacityClient(*server.address) as c:
+            c.ping()
+            c.fit(cpuRequests="200m", memRequests="250mb")
+            with pytest.raises(RuntimeError):
+                c.fit(cpuRequests="0")  # a recorded error
+            d = c.dump(op="fit")
+            assert d["count"] == d["matched"] == 2
+            assert {r["op"] for r in d["records"]} == {"fit"}
+            d = c.dump(op="fit", status="error")
+            assert d["count"] == 1
+            assert d["records"][0]["status"] == "error"
+            d = c.dump(status="ok")
+            assert all(r["status"] == "ok" for r in d["records"])
+            assert c.dump(op="sweep")["count"] == 0
+
+    def test_limit_keeps_most_recent(self, server):
+        with CapacityClient(*server.address) as c:
+            for _ in range(5):
+                c.ping()
+            d = c.dump(op="ping", limit=2)
+        assert d["count"] == 2
+        assert d["matched"] >= 5
+        seqs = [r["seq"] for r in d["records"]]
+        assert seqs == sorted(seqs)  # the TAIL of the ring, in order
+        assert d["records"][-1]["seq"] >= 5
+
+    def test_unfiltered_dump_shape_still_pinned(self, server):
+        with CapacityClient(*server.address) as c:
+            c.ping()
+            d = c.dump()
+        assert set(d) == {
+            "records", "count", "matched", "capacity", "dropped",
+            "generation",
+        }
+        assert d["matched"] == d["count"]
+
+    def test_bad_filters_are_service_errors(self, server):
+        with CapacityClient(*server.address) as c:
+            with pytest.raises(RuntimeError, match="status filter"):
+                c.dump(status="meh")
+            with pytest.raises(RuntimeError, match="limit"):
+                c.dump(limit=0)
+            with pytest.raises(RuntimeError, match="limit"):
+                c.call("dump", limit="three")
+            with pytest.raises(RuntimeError, match="filter_op"):
+                c.call("dump", filter_op=7)

@@ -779,3 +779,224 @@ class TestCompileWatch:
         assert 'kernel="xla_int64"' in (
             snapshot["kccap_kernel_first_call_seconds"]["values"]
         )
+
+
+class TestExpositionHardening:
+    """Satellite (PR 5): HEAD support, charsets, scrape self-report."""
+
+    def test_head_answers_every_path_with_get_headers_no_body(self):
+        r = MetricsRegistry()
+        r.counter("up_total").inc()
+        srv = start_metrics_server(r)
+        try:
+            for path, want in (
+                ("/metrics", 200), ("/healthz", 200), ("/nope", 404),
+            ):
+                req = urllib.request.Request(
+                    srv.url + path, method="HEAD"
+                )
+                try:
+                    resp = urllib.request.urlopen(req)
+                    code = resp.status
+                except urllib.error.HTTPError as e:
+                    resp, code = e, e.code
+                assert code == want, path
+                assert resp.read() == b""  # headers only
+                assert int(resp.headers["Content-Length"]) > 0
+                if path == "/metrics":
+                    # live registry: the body can grow between probes
+                    # (the HEAD itself records a scrape sample), so
+                    # only the header's self-consistency is asserted.
+                    continue
+                # ...and the advertised length matches the GET body.
+                try:
+                    got = urllib.request.urlopen(srv.url + path)
+                except urllib.error.HTTPError as e:
+                    got = e
+                assert len(got.read()) == int(
+                    resp.headers["Content-Length"]
+                )
+        finally:
+            srv.shutdown()
+
+    def test_content_types_carry_charset(self):
+        srv = start_metrics_server(MetricsRegistry())
+        try:
+            m = urllib.request.urlopen(srv.url + "/metrics")
+            assert "charset=utf-8" in m.headers["Content-Type"]
+            h = urllib.request.urlopen(srv.url + "/healthz")
+            assert h.headers["Content-Type"] == (
+                "application/json; charset=utf-8"
+            )
+        finally:
+            srv.shutdown()
+
+    def test_scrape_duration_self_reported(self):
+        r = MetricsRegistry()
+        srv = start_metrics_server(r)
+        try:
+            urllib.request.urlopen(srv.url + "/metrics").read()
+            # The SECOND scrape exposes the first's timing sample.
+            body = (
+                urllib.request.urlopen(srv.url + "/metrics")
+                .read()
+                .decode()
+            )
+            samples = parse_exposition(body)
+            assert samples["kccap_scrape_duration_seconds_count"] >= 1
+            assert samples["kccap_scrape_duration_seconds_sum"] >= 0
+        finally:
+            srv.shutdown()
+
+    def test_scrape_duration_skipped_when_disabled(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        r = MetricsRegistry()
+        srv = start_metrics_server(r)
+        try:
+            urllib.request.urlopen(srv.url + "/metrics").read()
+            body = (
+                urllib.request.urlopen(srv.url + "/metrics")
+                .read()
+                .decode()
+            )
+            assert "kccap_scrape_duration_seconds" not in body
+            assert r.snapshot() == {}
+        finally:
+            srv.shutdown()
+
+
+class TestTraceLogAtexit:
+    """Satellite (PR 5): the final spans of a short-lived run survive."""
+
+    def test_first_open_registers_atexit_close(self, tmp_path, monkeypatch):
+        import atexit
+
+        registered = []
+        monkeypatch.setattr(
+            atexit, "register", lambda fn: registered.append(fn)
+        )
+        log = TraceLog(str(tmp_path / "t.jsonl"))
+        assert registered == []  # lazy: no open, no hook
+        log.record(op="x")
+        assert registered == [log.close]
+        log.record(op="y")
+        assert registered == [log.close]  # once, not per record
+        registered[0]()  # the atexit hook closes cleanly
+        assert log._fh is None
+
+    def test_short_lived_subprocess_keeps_final_span(self, tmp_path):
+        import subprocess
+        import sys
+
+        path = tmp_path / "spans.jsonl"
+        code = (
+            "from kubernetesclustercapacity_tpu.telemetry.tracing "
+            "import Span, TraceLog\n"
+            "import sys\n"
+            f"log = TraceLog({str(path)!r})\n"
+            "with Span('final-op', trace_log=log):\n"
+            "    pass\n"
+            "sys.exit(0)\n"  # no close(): atexit must flush it
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.returncode == 0, out.stderr
+        records = [
+            json.loads(ln) for ln in path.read_text().splitlines()
+        ]
+        assert [r["op"] for r in records] == ["final-op"]
+        assert records[0]["status"] == "ok"
+
+
+class TestRequestLog:
+    """Satellite (PR 5): -log-json structured request logging, joined to
+    trace spans by a shared span_id."""
+
+    def _stack(self, tmp_path):
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+        from kubernetesclustercapacity_tpu.snapshot import (
+            synthetic_snapshot,
+        )
+
+        req_path = str(tmp_path / "requests.jsonl")
+        trace_path = str(tmp_path / "trace.jsonl")
+        srv = CapacityServer(
+            synthetic_snapshot(8, seed=1), port=0,
+            request_log=req_path, trace_log=trace_path,
+        )
+        srv.start()
+        return srv, CapacityClient(*srv.address, trace=True), req_path, \
+            trace_path
+
+    def test_one_line_per_dispatch_with_generation(self, tmp_path):
+        srv, client, req_path, trace_path = self._stack(tmp_path)
+        try:
+            client.ping()
+            client.sweep(random={"n": 2, "seed": 0})
+            from kubernetesclustercapacity_tpu.snapshot import (
+                synthetic_snapshot,
+            )
+
+            srv.replace_snapshot(synthetic_snapshot(8, seed=2))
+            client.sweep(random={"n": 2, "seed": 0})
+            with pytest.raises(RuntimeError):
+                client.call("fit", cpuRequests="0")
+        finally:
+            client.close()
+            srv.shutdown()
+        recs = [
+            json.loads(ln)
+            for ln in open(req_path, encoding="utf-8")
+        ]
+        assert [r["op"] for r in recs] == ["ping", "sweep", "sweep", "fit"]
+        for r in recs:
+            assert set(r) >= {
+                "ts", "op", "trace_id", "span_id", "generation",
+                "latency_ms", "status",
+            }
+        # The generation each request ANSWERED from, not dispatch time.
+        assert [r["generation"] for r in recs[:3]] == [1, 1, 2]
+        assert recs[3]["status"] == "error"
+        assert "ScenarioError" in recs[3]["error"] or recs[3]["error"]
+        # trace IDs came from the client (trace=True)
+        assert all(len(r["trace_id"]) == 32 for r in recs)
+        # logs↔traces join: identical span_id sets, pairwise matched
+        spans = [
+            json.loads(ln)
+            for ln in open(trace_path, encoding="utf-8")
+        ]
+        by_span = {s["span_id"]: s for s in spans}
+        for r in recs:
+            assert by_span[r["span_id"]]["op"] == r["op"]
+            assert by_span[r["span_id"]]["trace_id"] == r["trace_id"]
+
+    def test_request_log_alone_needs_no_trace_log(self, tmp_path):
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+        from kubernetesclustercapacity_tpu.snapshot import (
+            synthetic_snapshot,
+        )
+
+        req_path = str(tmp_path / "requests.jsonl")
+        srv = CapacityServer(
+            synthetic_snapshot(4, seed=1), port=0, request_log=req_path
+        )
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                c.ping()
+        finally:
+            srv.shutdown()
+        (rec,) = [
+            json.loads(ln) for ln in open(req_path, encoding="utf-8")
+        ]
+        assert rec["op"] == "ping" and rec["span_id"]
+        assert rec["trace_id"] == ""  # untraced call: logged regardless

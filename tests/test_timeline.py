@@ -1,0 +1,825 @@
+"""Capacity timeline: watchlist parsing, diff round-trips, fit parity,
+alerting, and the service wiring (timeline op, gauges, healthz, doctor).
+
+The two load-bearing properties, each pinned by a randomized test:
+
+* the diff engine is lossless — ``diff(old, new).apply(old) == new`` on
+  arbitrary generation pairs (node add/remove/mutate churn included);
+* a timeline capacity IS a cold fit — every watch total recorded for a
+  generation equals ``fit_per_node`` (and the service ``fit`` op) run
+  cold against that same generation, bit for bit, in both semantics
+  modes.
+"""
+
+import dataclasses
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+from kubernetesclustercapacity_tpu.scenario import scenario_from_flags
+from kubernetesclustercapacity_tpu.service import (
+    CapacityClient,
+    CapacityServer,
+)
+from kubernetesclustercapacity_tpu.snapshot import (
+    snapshot_from_fixture,
+    synthetic_snapshot,
+)
+from kubernetesclustercapacity_tpu.telemetry.metrics import MetricsRegistry
+from kubernetesclustercapacity_tpu.timeline import (
+    CapacityTimeline,
+    WatchError,
+    WatchSpec,
+    diff_summaries,
+    load_watchlist,
+    node_summary,
+    snapshot_digest,
+)
+from kubernetesclustercapacity_tpu.timeline.alerts import WatchAlert
+from kubernetesclustercapacity_tpu.timeline.watchlist import parse_watchlist
+from kubernetesclustercapacity_tpu.utils.quantity import int64_bits
+
+# One watchlist used across the service tests: flags sized so synthetic
+# 24-node clusters land in the hundreds of replicas, min_replicas set so
+# the "allocatable shrink" generation breaches it.
+WATCHLIST = {
+    "watches": [
+        {
+            "name": "web-tier",
+            "pod": {
+                "cpuRequests": "500m",
+                "memRequests": "1gb",
+                "replicas": "10",
+            },
+            "min_replicas": 120,
+        },
+        {
+            "name": "batch",
+            "pod": {"cpuRequests": "2", "memRequests": "4gb"},
+        },
+    ]
+}
+
+
+def _watch_specs():
+    return parse_watchlist(WATCHLIST)
+
+
+def _cold_fit_total(snap, scenario, mode):
+    """The fit surface's answer, cold: same kernel, same implicit-mask
+    rule the service fit op and the timeline both follow."""
+    mask = implicit_taint_mask(snap) if mode == "strict" else None
+    fits = np.asarray(
+        fit_per_node(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.used_cpu_req_milli,
+            snap.used_mem_req_bytes,
+            snap.pods_count,
+            snap.healthy,
+            int64_bits(scenario.cpu_request_milli),
+            scenario.mem_request_bytes,
+            mode=mode,
+            node_mask=mask,
+        )
+    )
+    return int(fits.sum()), fits
+
+
+def _replace_arrays(snap, keep):
+    """A new snapshot keeping only row indices ``keep`` (order given)."""
+    keep = list(keep)
+    sel = np.asarray(keep, dtype=np.int64)
+
+    def take(arr):
+        return np.asarray(arr)[sel]
+
+    return dataclasses.replace(
+        snap,
+        names=[snap.names[i] for i in keep],
+        alloc_cpu_milli=take(snap.alloc_cpu_milli),
+        alloc_mem_bytes=take(snap.alloc_mem_bytes),
+        alloc_pods=take(snap.alloc_pods),
+        used_cpu_req_milli=take(snap.used_cpu_req_milli),
+        used_cpu_lim_milli=take(snap.used_cpu_lim_milli),
+        used_mem_req_bytes=take(snap.used_mem_req_bytes),
+        used_mem_lim_bytes=take(snap.used_mem_lim_bytes),
+        pods_count=take(snap.pods_count),
+        healthy=take(snap.healthy),
+        labels=[snap.labels[i] for i in keep] if snap.labels else [],
+        taints=[snap.taints[i] for i in keep] if snap.taints else [],
+        node_log=[],
+        pod_cpu_errs=[[] for _ in keep],
+    )
+
+
+def _shrink_node(snap, i, cpu_factor=0.25):
+    """Shrink node ``i``'s allocatable CPU (the 'allocatable shrink'
+    churn kind — a kubelet reporting less than it used to)."""
+    cpu = np.asarray(snap.alloc_cpu_milli).copy()
+    cpu[i] = int(cpu[i] * cpu_factor)
+    return dataclasses.replace(snap, alloc_cpu_milli=cpu)
+
+
+class TestWatchlist:
+    def test_yaml_file_round_trip(self, tmp_path):
+        yaml = tmp_path / "watch.yaml"
+        yaml.write_text(
+            "watches:\n"
+            "  - name: web\n"
+            "    pod: {cpuRequests: 500m, memRequests: 1gb, replicas: 7}\n"
+            "    min_replicas: 3\n"
+            "  - name: strict-batch\n"
+            "    pod: {cpuRequests: '2', memRequests: 4gb}\n"
+            "    semantics: strict\n"
+        )
+        specs = load_watchlist(str(yaml))
+        assert [s.name for s in specs] == ["web", "strict-batch"]
+        web = specs[0]
+        assert web.scenario.cpu_request_milli == 500
+        assert web.scenario.replicas == 7
+        assert web.min_replicas == 3 and web.mode is None
+        assert specs[1].mode == "strict"
+        assert specs[1].min_replicas is None
+
+    def test_json_file_parses_too(self, tmp_path):
+        p = tmp_path / "watch.json"
+        p.write_text(json.dumps(WATCHLIST))
+        specs = load_watchlist(str(p))
+        assert [s.name for s in specs] == ["web-tier", "batch"]
+
+    def test_bare_list_accepted(self):
+        specs = parse_watchlist(
+            [{"name": "w", "pod": {"cpuRequests": "1"}}]
+        )
+        assert specs[0].name == "w"
+
+    @pytest.mark.parametrize(
+        "doc, fragment",
+        [
+            ({}, "non-empty"),
+            ({"watches": []}, "non-empty"),
+            ({"watches": [{"pod": {}}]}, "name"),
+            (
+                {"watches": [{"name": "a", "pod": {"cpuLimit": "1"}}]},
+                "unknown pod field",
+            ),
+            (
+                {"watches": [{"name": "a", "pod": {"cpuRequests": "0"}}]},
+                "bad pod spec",
+            ),
+            (
+                {"watches": [{"name": "a", "min_replicas": -1}]},
+                "min_replicas",
+            ),
+            (
+                {"watches": [{"name": "a", "min_replicas": True}]},
+                "min_replicas",
+            ),
+            (
+                {"watches": [{"name": "a", "semantics": "fast"}]},
+                "semantics",
+            ),
+            (
+                {"watches": [{"name": "a"}, {"name": "a"}]},
+                "duplicate",
+            ),
+            (
+                {"watches": [{"name": "a", "alert": 1}]},
+                "unknown field",
+            ),
+            ({"watchlist": []}, "unknown top-level"),
+        ],
+    )
+    def test_malformed_rejected(self, doc, fragment):
+        with pytest.raises(WatchError, match=fragment):
+            parse_watchlist(doc)
+
+
+class TestDiffEngine:
+    def test_identical_snapshots_empty_diff_same_digest(self):
+        a = synthetic_snapshot(12, seed=5)
+        b = synthetic_snapshot(12, seed=5)
+        assert snapshot_digest(a) == snapshot_digest(b)
+        assert diff_summaries(node_summary(a), node_summary(b)).empty
+
+    def test_digest_moves_with_any_column(self):
+        a = synthetic_snapshot(12, seed=5)
+        b = _shrink_node(a, 3)
+        assert snapshot_digest(a) != snapshot_digest(b)
+
+    def test_duplicate_names_keep_per_row_keys(self):
+        a = synthetic_snapshot(4, seed=1)
+        names = list(a.names)
+        names[2] = names[1]  # duplicate
+        a = dataclasses.replace(a, names=names)
+        keys = list(node_summary(a))
+        assert len(set(keys)) == 4
+        assert keys[2] == f"{names[1]}#1"
+
+    def test_diff_classifies_add_remove_mutate(self):
+        old = synthetic_snapshot(8, seed=2)
+        new = _shrink_node(_replace_arrays(old, range(1, 8)), 0)
+        d = diff_summaries(node_summary(old), node_summary(new))
+        assert set(d.removed) == {old.names[0]}
+        assert not d.added
+        assert set(d.changed) == {old.names[1]}
+        assert "alloc_cpu_milli" in d.changed[old.names[1]]
+        # removed rows carry the OLD values (the diff is invertible)
+        assert d.removed[old.names[0]][0] == int(old.alloc_cpu_milli[0])
+
+    def test_roundtrip_property_randomized_pairs(self):
+        """old ⊕ diff == new on randomized generation pairs: random node
+        drops, additions (from a disjoint pool), and per-column
+        mutations, 40 trials."""
+        rng = np.random.default_rng(1234)
+        pool = synthetic_snapshot(96, seed=99)
+        for trial in range(40):
+            n = int(rng.integers(4, 40))
+            base = synthetic_snapshot(n, seed=int(rng.integers(1 << 30)))
+            # mutate: random column tweaks on a random subset
+            cur = base
+            for i in rng.choice(n, size=int(rng.integers(0, n // 2 + 1)),
+                                replace=False):
+                which = int(rng.integers(3))
+                if which == 0:
+                    cur = _shrink_node(cur, int(i))
+                elif which == 1:
+                    pods = np.asarray(cur.pods_count).copy()
+                    pods[i] += int(rng.integers(1, 5))
+                    cur = dataclasses.replace(cur, pods_count=pods)
+                else:
+                    healthy = np.asarray(cur.healthy).copy()
+                    healthy[i] = ~healthy[i]
+                    cur = dataclasses.replace(cur, healthy=healthy)
+            # drop a random subset of rows
+            keep = sorted(
+                rng.choice(
+                    n, size=int(rng.integers(1, n + 1)), replace=False
+                )
+            )
+            cur = _replace_arrays(cur, keep)
+            # graft in rows from the disjoint pool ("nodes added")
+            extra = int(rng.integers(0, 4))
+            if extra:
+                rows = list(range(len(cur.names)))
+                grafted = _replace_arrays(pool, range(extra))
+                cur = dataclasses.replace(
+                    _replace_arrays(cur, rows),
+                    names=cur.names + grafted.names,
+                    **{
+                        f: np.concatenate(
+                            [np.asarray(getattr(cur, f)),
+                             np.asarray(getattr(grafted, f))]
+                        )
+                        for f in (
+                            "alloc_cpu_milli", "alloc_mem_bytes",
+                            "alloc_pods", "used_cpu_req_milli",
+                            "used_cpu_lim_milli", "used_mem_req_bytes",
+                            "used_mem_lim_bytes", "pods_count", "healthy",
+                        )
+                    },
+                    labels=[], taints=[], node_log=[],
+                    pod_cpu_errs=[],
+                )
+            s_old, s_new = node_summary(base), node_summary(cur)
+            d = diff_summaries(s_old, s_new)
+            assert d.apply(s_old) == s_new, f"trial {trial} lost data"
+            # and the reverse direction round-trips too
+            rd = diff_summaries(s_new, s_old)
+            assert rd.apply(s_new) == s_old
+
+    def test_wire_shape(self):
+        old = synthetic_snapshot(4, seed=7)
+        new = _shrink_node(_replace_arrays(old, range(1, 4)), 1)
+        w = diff_summaries(node_summary(old), node_summary(new)).to_wire()
+        assert [e["node"] for e in w["nodes_removed"]] == [old.names[0]]
+        assert w["nodes_added"] == []
+        (chg,) = w["nodes_changed"]
+        assert set(chg["deltas"]) == {"alloc_cpu_milli"}
+        assert chg["deltas"]["alloc_cpu_milli"] < 0
+
+
+class TestAlertMachine:
+    def test_full_cycle_and_counters(self):
+        a = WatchAlert("w", min_replicas=10)
+        assert a.update(12, 1) is None and a.state == "ok"
+        assert a.update(9, 2) == "breached"
+        assert a.update(8, 3) is None  # still breached: no re-fire
+        assert a.update(11, 4) == "recovered"
+        assert a.update(11, 5) is None
+        assert a.update(3, 6) == "breached"
+        assert (a.breaches, a.recoveries) == (2, 1)
+        assert a.since_generation == 6
+        assert a.state_code == 2
+
+    def test_threshold_is_strictly_below(self):
+        a = WatchAlert("w", min_replicas=10)
+        assert a.update(10, 1) is None and a.state == "ok"
+
+    def test_no_threshold_never_transitions(self):
+        a = WatchAlert("w", min_replicas=None)
+        assert a.update(0, 1) is None
+        assert a.state == "ok" and a.breaches == 0
+        assert a.to_wire()["last_total"] == 0
+
+
+class TestTimelineCore:
+    def test_depth_bounds_ring_and_validation(self):
+        tl = CapacityTimeline(_watch_specs(), depth=3)
+        snaps = [synthetic_snapshot(8, seed=s) for s in range(5)]
+        for g, s in enumerate(snaps, start=1):
+            tl.observe(s, g)
+        gens = [r.generation for r in tl.records()]
+        assert gens == [3, 4, 5]
+        with pytest.raises(ValueError):
+            CapacityTimeline((), depth=1)
+        with pytest.raises(ValueError):
+            CapacityTimeline(_watch_specs() * 2, depth=4)
+
+    def test_capacities_bit_identical_to_cold_fit_both_modes(self):
+        """The acceptance property: every recorded watch total equals a
+        cold fit of the same generation, in BOTH semantics modes, on a
+        tainted fixture (so the strict implicit mask is exercised)."""
+        fixture = synthetic_fixture(24, seed=31, taint_frac=0.3)
+        specs = tuple(
+            WatchSpec(
+                name=f"{mode}-{flags['cpuRequests']}",
+                scenario=scenario_from_flags(**flags),
+                mode=mode,
+            )
+            for mode in ("reference", "strict")
+            for flags in (
+                {"cpuRequests": "250m", "memRequests": "200mb"},
+                {"cpuRequests": "1", "memRequests": "2gb"},
+            )
+        )
+        for packing in ("reference", "strict"):
+            snap = snapshot_from_fixture(fixture, semantics=packing)
+            tl = CapacityTimeline(specs, depth=4)
+            rec = tl.observe(snap, 1)
+            for spec in specs:
+                mode = spec.mode or packing
+                want_total, want_fits = _cold_fit_total(
+                    snap, spec.scenario, mode
+                )
+                got = rec.watches[spec.name]
+                assert got.total == want_total, (packing, spec.name)
+                np.testing.assert_array_equal(got.fits, want_fits)
+
+    def test_attribution_names_node_and_binding_shift(self):
+        """Drain a node: the delta names it, its lost fit, and the
+        total moves by exactly the attributed contributions."""
+        specs = _watch_specs()
+        tl = CapacityTimeline(specs, depth=8)
+        a = synthetic_snapshot(16, seed=3)
+        b = _replace_arrays(a, [i for i in range(16) if i != 5])
+        tl.observe(a, 1)
+        tl.observe(b, 2)
+        (delta,) = tl.deltas()
+        assert delta["nodes_removed"] == [a.names[5]]
+        for name in ("web-tier", "batch"):
+            w = delta["watches"][name]
+            assert w["before"] - w["after"] == -sum(
+                c["delta"] for c in w["contributors"]
+            )
+            (contrib,) = [
+                c for c in w["contributors"] if c["node"] == a.names[5]
+            ]
+            assert contrib["change"] == "removed"
+            assert a.names[5] in w["summary"]
+        # filters
+        assert tl.deltas(since_generation=2) == []
+        only = tl.deltas(watch="batch")
+        assert set(only[0]["watches"]) == {"batch"}
+        with pytest.raises(ValueError):
+            tl.wire(watch="nope")
+
+    def test_metrics_gauges_and_counters(self):
+        reg = MetricsRegistry()
+        tl = CapacityTimeline(_watch_specs(), depth=8, registry=reg)
+        a = synthetic_snapshot(24, seed=11)
+        tl.observe(a, 1)
+        snap1 = reg.snapshot()
+        assert snap1["kccap_generation"]["values"][""] == 1
+        web1 = snap1["kccap_watch_replicas"]["values"]['watch="web-tier"']
+        # shrink everything → capacity drops → down-counter + breach
+        starved = dataclasses.replace(
+            a,
+            alloc_cpu_milli=(
+                np.asarray(a.alloc_cpu_milli) // 50
+            ).astype(np.int64),
+        )
+        tl.observe(starved, 2)
+        s = reg.snapshot()
+        assert s["kccap_generation"]["values"][""] == 2
+        web2 = s["kccap_watch_replicas"]["values"]['watch="web-tier"']
+        assert web2 < web1
+        assert (
+            s["kccap_watch_capacity_changes_total"]["values"][
+                'watch="web-tier",direction="down"'
+            ]
+            == 1
+        )
+        assert (
+            s["kccap_watch_alert_state"]["values"]['watch="web-tier"'] == 2
+        )
+        assert (
+            s["kccap_watch_breaches_total"]["values"]['watch="web-tier"']
+            == 1
+        )
+        assert (
+            s["kccap_watch_headroom_pct"]["values"]['watch="web-tier"'] < 0
+        )
+        # recovery flips the state gauge to 1 (recovered != ok)
+        tl.observe(a, 3)
+        s = reg.snapshot()
+        assert (
+            s["kccap_watch_alert_state"]["values"]['watch="web-tier"'] == 1
+        )
+
+    def test_disabled_telemetry_makes_zero_registry_calls(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        reg = MetricsRegistry()
+        tl = CapacityTimeline(_watch_specs(), depth=4, registry=reg)
+        tl.observe(synthetic_snapshot(8, seed=1), 1)
+        tl.observe(synthetic_snapshot(8, seed=2), 2)
+        assert reg.snapshot() == {}  # not even family registration
+
+    def test_timeline_log_jsonl(self, tmp_path):
+        log = tmp_path / "timeline.jsonl"
+        tl = CapacityTimeline(
+            _watch_specs(), depth=8, log=str(log)
+        )
+        a = synthetic_snapshot(24, seed=11)
+        starved = dataclasses.replace(
+            a,
+            alloc_cpu_milli=(
+                np.asarray(a.alloc_cpu_milli) // 50
+            ).astype(np.int64),
+        )
+        tl.observe(a, 1)
+        tl.observe(starved, 2)
+        tl.observe(a, 3)
+        tl.close()
+        lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+        kinds = [ln["kind"] for ln in lines]
+        assert kinds == [
+            "generation", "generation", "alert", "generation", "alert",
+        ]
+        breach = lines[2]
+        assert breach["watch"] == "web-tier"
+        assert breach["transition"] == "breached"
+        assert breach["generation"] == 2
+        recover = lines[4]
+        assert recover["transition"] == "recovered"
+        gen_line = lines[0]
+        assert set(gen_line) >= {
+            "generation", "digest", "nodes", "watches", "eval_ms",
+        }
+        assert gen_line["watches"].keys() == {"web-tier", "batch"}
+
+
+class TestTimelineService:
+    """The acceptance scenario: a follower-style publisher replays 3+
+    synthetic generations (node add, node drain, allocatable shrink)
+    into a served timeline."""
+
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        reg = MetricsRegistry()
+        tl = CapacityTimeline(
+            _watch_specs(), depth=16, registry=reg,
+            log=str(tmp_path / "tl.jsonl"),
+        )
+        base = synthetic_snapshot(24, seed=42)
+        srv = CapacityServer(base, port=0, timeline=tl, registry=reg)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as client:
+                yield srv, client, base, reg
+        finally:
+            srv.shutdown()
+            tl.close()
+
+    @staticmethod
+    def _expected(client, name):
+        """A COLD fit of the currently-served generation via the fit op
+        (the very surface the timeline claims to mirror), issued with
+        the watch's ORIGINAL flag strings."""
+        (entry,) = [
+            w for w in WATCHLIST["watches"] if w["name"] == name
+        ]
+        flags = {
+            k: v for k, v in entry["pod"].items() if k != "replicas"
+        }
+        return client.fit(**flags)["total"]
+
+    def test_generations_match_cold_fits_and_attribute(self, stack):
+        srv, client, base, _ = stack
+        specs = _watch_specs()
+        # gen 2: node added; gen 3: node drained; gen 4: allocatable
+        # shrink on one node
+        grown = _replace_arrays(
+            base, list(range(24)) + [23]
+        )  # duplicate last row = a new row (unique key via #1)
+        grown = dataclasses.replace(
+            grown, names=base.names + ["node-added-1"]
+        )
+        drained = _replace_arrays(grown, [i for i in range(25) if i != 7])
+        shrunk = _shrink_node(drained, 3, cpu_factor=0.1)
+        expected = {}
+        for gen, snap in ((2, grown), (3, drained), (4, shrunk)):
+            srv.replace_snapshot(snap, warm=True)
+            assert srv.generation == gen
+            expected[gen] = {
+                s.name: self._expected(client, s.name) for s in specs
+            }
+        t = client.timeline()
+        assert t["enabled"] is True
+        gens = [r["generation"] for r in t["records"]]
+        assert gens == [1, 2, 3, 4]
+        for rec in t["records"]:
+            if rec["generation"] == 1:
+                continue
+            for name, want in expected[rec["generation"]].items():
+                assert rec["watches"][name]["total"] == want, (
+                    rec["generation"], name,
+                )
+        # attribution: gen2→3 names the drained node, gen3→4 the shrink
+        by_gen = {
+            (d["from_generation"], d["to_generation"]): d
+            for d in t["deltas"]
+        }
+        assert by_gen[(1, 2)]["nodes_added"] == ["node-added-1"]
+        assert by_gen[(2, 3)]["nodes_removed"] == [base.names[7]]
+        assert base.names[7] in (
+            by_gen[(2, 3)]["watches"]["web-tier"]["summary"]
+        )
+        shrink_delta = by_gen[(3, 4)]
+        assert shrink_delta["nodes_changed"] == 1
+        (chg,) = shrink_delta["diff"]["nodes_changed"]
+        assert chg["node"] == base.names[3]
+        assert chg["deltas"]["alloc_cpu_milli"] < 0
+        # every capacity move is fully attributed
+        for d in t["deltas"]:
+            w = d["watches"]["web-tier"]
+            assert w["after"] - w["before"] == sum(
+                c["delta"] for c in w["contributors"]
+            )
+
+    def test_since_and_watch_filters_over_wire(self, stack):
+        srv, client, base, _ = stack
+        srv.replace_snapshot(_shrink_node(base, 0), warm=True)
+        srv.replace_snapshot(_shrink_node(base, 1), warm=True)
+        t = client.timeline(since_generation=2)
+        assert [r["generation"] for r in t["records"]] == [3]
+        assert [
+            (d["from_generation"], d["to_generation"])
+            for d in t["deltas"]
+        ] == [(2, 3)]
+        t = client.timeline(watch="batch")
+        assert set(t["alerts"]) == {"batch"}
+        for rec in t["records"]:
+            assert set(rec["watches"]) <= {"batch"}
+        with pytest.raises(RuntimeError, match="unknown watch"):
+            client.timeline(watch="nope")
+        with pytest.raises(RuntimeError, match="since_generation"):
+            client.call("timeline", since_generation="x")
+
+    def test_breach_flips_gauge_healthz_and_doctor(self, stack, tmp_path):
+        from kubernetesclustercapacity_tpu.telemetry.exposition import (
+            start_metrics_server,
+        )
+        from kubernetesclustercapacity_tpu.utils.doctor import doctor_report
+
+        srv, client, base, reg = stack
+        starved = dataclasses.replace(
+            base,
+            alloc_cpu_milli=(
+                np.asarray(base.alloc_cpu_milli) // 50
+            ).astype(np.int64),
+        )
+        srv.replace_snapshot(starved, warm=True)
+        # gauge
+        s = reg.snapshot()
+        assert (
+            s["kccap_watch_alert_state"]["values"]['watch="web-tier"'] == 2
+        )
+        # /healthz (the same status wiring server.main installs)
+        tl = srv.timeline
+        ms = start_metrics_server(
+            reg, status=lambda: {"timeline": tl.stats()}
+        )
+        try:
+            health = json.loads(
+                urllib.request.urlopen(ms.url + "/healthz").read()
+            )
+        finally:
+            ms.shutdown()
+        assert health["ok"] is True
+        assert health["timeline"]["breached"] == ["web-tier"]
+        assert health["timeline"]["alerts"]["web-tier"] == "breached"
+        # doctor line
+        checks = dict(
+            doctor_report(
+                backend_timeout_s=30.0,
+                probe_code="print('DEVICES 0.0s cpu x1')",
+                service_addr=srv.address,
+            )
+        )
+        line = checks["capacity timeline"]
+        assert line.startswith("ok:")
+        assert "web-tier=breached(breaches=1)" in line
+        # recovery is visible as a distinct state everywhere
+        srv.replace_snapshot(base, warm=True)
+        assert srv.timeline.alerts()["web-tier"]["state"] == "recovered"
+
+    def test_timeline_disabled_server_answers_enabled_false(self):
+        srv = CapacityServer(synthetic_snapshot(4, seed=1), port=0)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                assert c.timeline() == {"enabled": False}
+        finally:
+            srv.shutdown()
+
+    def test_update_op_lands_in_timeline(self, tmp_path):
+        """The store-fed mutation path publishes generations too."""
+        fixture = synthetic_fixture(6, seed=9)
+        snap = snapshot_from_fixture(fixture)
+        tl = CapacityTimeline(_watch_specs(), depth=8)
+        srv = CapacityServer(snap, port=0, fixture=fixture, timeline=tl)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                c.update(
+                    [{"type": "DELETED", "kind": "Node",
+                      "object": {"name": fixture["nodes"][0]["name"]}}]
+                )
+                t = c.timeline()
+        finally:
+            srv.shutdown()
+        assert [r["generation"] for r in t["records"]] == [1, 2]
+        assert t["deltas"][0]["nodes_removed"] == [
+            fixture["nodes"][0]["name"]
+        ]
+
+    def test_observation_never_runs_on_request_threads(self, stack):
+        """Off the request path: watchlist evaluation happens on the
+        PUBLISHER'S thread (here: this test thread calling
+        replace_snapshot — in production the coalescer worker), never on
+        a TCP dispatch thread serving queries."""
+        srv, client, base, _ = stack
+        observe_threads = set()
+        orig = srv.timeline.observe
+
+        def spy(snapshot, generation, **kw):
+            observe_threads.add(threading.current_thread().name)
+            return orig(snapshot, generation, **kw)
+
+        srv._timeline.observe = spy
+        try:
+            stop = threading.Event()
+            errors = []
+
+            def hammer():
+                try:
+                    with CapacityClient(*srv.address) as c:
+                        while not stop.is_set():
+                            c.sweep(random={"n": 2, "seed": 1})
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=hammer) for _ in range(4)]
+            for th in threads:
+                th.start()
+            publisher = threading.Thread(
+                name="publisher-thread",
+                target=lambda: srv.replace_snapshot(
+                    _shrink_node(base, 2), warm=True
+                ),
+            )
+            publisher.start()
+            publisher.join(30)
+            stop.set()
+            for th in threads:
+                th.join(30)
+            assert not errors
+            assert observe_threads == {"publisher-thread"}
+        finally:
+            srv._timeline.observe = orig
+
+
+class TestTimelineRender:
+    def _wire(self):
+        tl = CapacityTimeline(_watch_specs(), depth=8)
+        a = synthetic_snapshot(24, seed=11)
+        starved = dataclasses.replace(
+            a,
+            alloc_cpu_milli=(
+                np.asarray(a.alloc_cpu_milli) // 50
+            ).astype(np.int64),
+        )
+        tl.observe(a, 1)
+        tl.observe(starved, 2)
+        return tl.wire()
+
+    def test_table_report(self):
+        from kubernetesclustercapacity_tpu.report import (
+            timeline_table_report,
+        )
+
+        text = timeline_table_report(self._wire())
+        assert "capacity timeline: 2 generation(s)" in text
+        assert "web-tier" in text and "batch" in text
+        assert "!" in text  # breach marker
+        assert "deltas:" in text and "alerts:" in text
+        assert "breached" in text
+
+    def test_table_report_disabled(self):
+        from kubernetesclustercapacity_tpu.report import (
+            timeline_table_report,
+        )
+
+        assert "not enabled" in timeline_table_report({"enabled": False})
+
+    def test_json_report_is_wire_verbatim(self):
+        from kubernetesclustercapacity_tpu.report import (
+            timeline_json_report,
+        )
+
+        wire = self._wire()
+        assert json.loads(timeline_json_report(wire)) == json.loads(
+            json.dumps(wire)
+        )
+
+
+class TestTimelineCLI:
+    def test_cli_renders_and_exits_by_verdict(self, capsys):
+        from kubernetesclustercapacity_tpu.cli import main
+
+        tl = CapacityTimeline(_watch_specs(), depth=8)
+        base = synthetic_snapshot(24, seed=42)
+        srv = CapacityServer(base, port=0, timeline=tl)
+        srv.start()
+        try:
+            host, port = srv.address
+            rc = main(["-timeline", f"{host}:{port}"])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "capacity timeline" in out
+            rc = main(["-timeline", f"{host}:{port}", "-output", "json"])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert json.loads(out)["enabled"] is True
+            # breach → exit 1 (scriptable verdict)
+            starved = dataclasses.replace(
+                base,
+                alloc_cpu_milli=(
+                    np.asarray(base.alloc_cpu_milli) // 50
+                ).astype(np.int64),
+            )
+            srv.replace_snapshot(starved)
+            assert main(["-timeline", f"{host}:{port}"]) == 1
+            capsys.readouterr()
+        finally:
+            srv.shutdown()
+
+    def test_cli_bad_address_and_no_timeline(self, capsys):
+        from kubernetesclustercapacity_tpu.cli import main
+
+        assert main(["-timeline", "nonsense"]) == 1
+        srv = CapacityServer(synthetic_snapshot(4, seed=1), port=0)
+        srv.start()
+        try:
+            host, port = srv.address
+            assert main(["-timeline", f"{host}:{port}"]) == 1
+            out = capsys.readouterr().out
+            assert "not enabled" in out
+        finally:
+            srv.shutdown()
+
+
+class TestServerMainFlags:
+    def test_watchlist_flag_parses_and_bad_file_fails_fast(self, tmp_path):
+        from kubernetesclustercapacity_tpu.service.server import main
+
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("watches: [{name: '', pod: {}}]")
+        fixture_path = tmp_path / "f.json"
+        fixture_path.write_text(
+            json.dumps(synthetic_fixture(3, seed=1))
+        )
+        rc = main(
+            ["-snapshot", str(fixture_path), "-watch", str(bad),
+             "-port", "0"]
+        )
+        assert rc == 1
