@@ -626,6 +626,7 @@ def main() -> None:
         try:
             _host_side_metrics(metrics)
             _hot_path_metrics(metrics)
+            _shadow_overhead_metrics(metrics)
         except Exception as e:  # noqa: BLE001 - partial capture survives
             print(traceback.format_exc(), file=sys.stderr)
             metrics["host_aux_error"] = f"{type(e).__name__}: {e}"
@@ -886,6 +887,57 @@ def _hot_path_metrics(out: dict | None = None) -> dict:
     out["mean_batch_size"] = round(stats["mean_batch_size"], 2)
     out["batch_dispatches"] = stats["dispatches"]
     out["batch_correctness_diffs"] = batch_diffs
+    return out
+
+
+def _shadow_overhead_metrics(out: dict | None = None) -> dict:
+    """Shadow-oracle sampler request-path cost: sweep p50 at 0% / 1% /
+    10% sample rates.
+
+    The sampler's contract is that the request path pays only the
+    sampling decision plus a queue append (the oracle walk runs on the
+    worker thread) — these fields keep that claim in the BENCH
+    trajectory so a regression that drags oracle work onto the dispatch
+    path is caught as a number, not an assertion.  Measured on a fixed
+    1k-node × 64-scenario shape; the sampler is drained (off the timed
+    window) before its counters are read so every sampled sweep was
+    actually checked.
+    """
+    import statistics
+
+    if out is None:
+        out = {}
+    import kubernetesclustercapacity_tpu as kcc
+    from kubernetesclustercapacity_tpu.audit.shadow import ShadowSampler
+    from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+
+    snap = kcc.synthetic_snapshot(1000, seed=43)
+    grid = kcc.random_scenario_grid(64, seed=7)
+    sweep_snapshot(snap, grid)  # compile + device-cache warm-up
+
+    for rate, key in (
+        (0.0, "shadow_overhead_p50_ms_r0"),
+        (0.01, "shadow_overhead_p50_ms_r1"),
+        (0.10, "shadow_overhead_p50_ms_r10"),
+    ):
+        sampler = ShadowSampler(rate) if rate > 0 else None
+        times = []
+        for gen in range(21):
+            t0 = time.perf_counter()
+            totals, sched = sweep_snapshot(snap, grid)
+            if sampler is not None:
+                sampler.maybe_submit(snap, gen, grid, totals, sched)
+            times.append((time.perf_counter() - t0) * 1e3)
+        out[key] = round(statistics.median(times), 3)
+        if sampler is not None:
+            drained = sampler.drain(30.0)
+            if rate == 0.10:
+                st = sampler.stats()
+                out["shadow_overhead_checked_r10"] = st["checked"]
+                out["shadow_overhead_divergences"] = (
+                    st["divergences"] if drained else None
+                )
+            sampler.close()
     return out
 
 
@@ -1942,6 +1994,9 @@ def _run() -> None:
         # Hot-path subsystem metrics (devcache hit rate, bucket-recompile
         # proof, micro-batch mean size) — the PR-4 acceptance numbers.
         _hot_path_metrics(ladder)
+        # Shadow-sampler request-path cost (PR-6): sweep p50 at
+        # 0%/1%/10% sample rates must stay indistinguishable.
+        _shadow_overhead_metrics(ladder)
 
     except Exception as e:  # noqa: BLE001 - aux must never kill the bench
         # MERGE the error: entries measured before the failing section

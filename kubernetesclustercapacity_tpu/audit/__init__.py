@@ -1,0 +1,40 @@
+"""Audit subsystem: durable request/state history, deterministic replay,
+and shadow-oracle parity monitoring.
+
+Three layers, one goal — make bit-exactness a continuously *observed*
+production invariant rather than a test-time claim:
+
+* :mod:`.log` — an append-only JSONL audit log (rotating segments)
+  recording every snapshot mutation as the invertible
+  :class:`~..timeline.diff.SnapshotDiff` (with periodic full-snapshot
+  checkpoints and a ``snapshot_digest`` chain pinning integrity) and
+  every answering/mutating request with full arguments plus a result
+  digest;
+* :mod:`.replay` — offline reconstruction of any recorded generation
+  from the nearest checkpoint (``apply(old, diff)``) and bit-exact
+  re-answering of recorded requests (``kccap -replay``);
+* :mod:`.shadow` — an off-request-path sampler re-checking a fraction
+  of live sweep responses against the pure-Python oracle
+  (:func:`~..oracle.fit_arrays_python`), alarming on divergence with a
+  self-contained repro bundle.
+"""
+
+from kubernetesclustercapacity_tpu.audit.log import (
+    AuditError,
+    AuditLog,
+    AuditReader,
+)
+from kubernetesclustercapacity_tpu.audit.replay import (
+    Replayer,
+    replay_shadow_bundle,
+)
+from kubernetesclustercapacity_tpu.audit.shadow import ShadowSampler
+
+__all__ = [
+    "AuditError",
+    "AuditLog",
+    "AuditReader",
+    "Replayer",
+    "ShadowSampler",
+    "replay_shadow_bundle",
+]

@@ -149,6 +149,24 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="NAME",
                    help="with -timeline: narrow records/deltas/alerts to "
                         "one watch")
+    p.add_argument("-replay", default="", metavar="DIR",
+                   help="replay a kccap-server audit log: verify the "
+                        "generation digest chain, reconstruct every "
+                        "recorded generation from the nearest "
+                        "checkpoint, and re-answer every recorded "
+                        "sweep/explain/fit bit-for-bit against its "
+                        "recorded result digest; -output json selects "
+                        "the structured form; exit 1 on any mismatch")
+    p.add_argument("-replay-ref", default=None, dest="replay_ref",
+                   metavar="SEGMENT:OFFSET",
+                   help="with -replay: replay only the request at this "
+                        "audit ref (the audit_ref field flight-recorder "
+                        "dump records carry)")
+    p.add_argument("-replay-generation", type=int, default=None,
+                   dest="replay_generation", metavar="GEN",
+                   help="with -replay: reconstruct generation GEN and "
+                        "verify its digest instead of replaying "
+                        "requests")
     return p
 
 
@@ -189,6 +207,9 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.timeline:
         return _run_timeline(args)
+
+    if args.replay:
+        return _run_replay(args)
 
     # Telemetry surfaces (both opt-in, zero cost otherwise): a scrape
     # endpoint over the process registry — the fused-path counters and
@@ -358,6 +379,77 @@ def _run_timeline(args) -> int:
     # Exit by the verdict, like -drain does: a breached watchlist is a
     # scriptable signal, not just prose.
     return 1 if breached else 0
+
+
+def _run_replay(args) -> int:
+    """-replay DIR: the offline half of the audit subsystem — turn a
+    recorded production history into a verified repro.  Exits by the
+    verdict: 0 only when the digest chain holds and every replayed
+    request re-answered identically."""
+    from kubernetesclustercapacity_tpu.audit import (
+        AuditError,
+        AuditReader,
+        Replayer,
+    )
+    from kubernetesclustercapacity_tpu.report import (
+        replay_json_report,
+        replay_table_report,
+    )
+    from kubernetesclustercapacity_tpu.timeline.diff import snapshot_digest
+
+    try:
+        reader = AuditReader.load(args.replay)
+    except AuditError as e:
+        print(f"ERROR : cannot load audit log: {e}", file=sys.stderr)
+        return 1
+    if args.replay_generation is not None:
+        try:
+            snap = reader.snapshot_at(args.replay_generation)
+        except AuditError as e:
+            print(f"ERROR : {e}", file=sys.stderr)
+            return 1
+        out = {
+            "generation": args.replay_generation,
+            "nodes": snap.n_nodes,
+            "semantics": snap.semantics,
+            "digest": snapshot_digest(snap),
+            "verified": True,
+        }
+        if args.output == "json":
+            print(json.dumps(out, sort_keys=True))
+        else:
+            print(
+                f"generation {out['generation']}: {out['nodes']} node(s) "
+                f"({out['semantics']}), digest {out['digest']} — "
+                "reconstruction verified"
+            )
+        return 0
+    with Replayer(reader) as replayer:
+        if args.replay_ref:
+            try:
+                rec = reader.record_at(args.replay_ref)
+            except AuditError as e:
+                print(f"ERROR : {e}", file=sys.stderr)
+                return 1
+            outcome = replayer.replay_record(rec)
+            counts = {outcome["status"]: 1}
+            result = {
+                "directory": reader.directory,
+                "generations_verified": [],
+                "chain_error": None,
+                "recovered_tail_records": reader.recovered_tail,
+                "requests": 1,
+                "counts": counts,
+                "outcomes": [outcome],
+                "clean": outcome["status"] in ("ok", "skipped"),
+            }
+        else:
+            result = replayer.replay_all()
+    if args.output == "json":
+        print(replay_json_report(result))
+    else:
+        print(replay_table_report(result))
+    return 0 if result["clean"] else 1
 
 
 def _run_explain(args, snapshot, scenario) -> int:

@@ -396,6 +396,58 @@ def timeline_json_report(timeline: dict) -> str:
     return json.dumps(timeline, indent=2)
 
 
+def replay_table_report(result: dict) -> str:
+    """``kccap -replay`` as operator-readable text: the chain verdict,
+    the request tallies, and one line per non-ok outcome (a clean
+    replay stays terse — the verdict IS the product)."""
+    lines = [
+        f"audit replay: {result['directory']}",
+        f"  generations verified: {len(result['generations_verified'])}"
+        + (
+            f" (chain BROKEN: {result['chain_error']})"
+            if result.get("chain_error")
+            else ""
+        ),
+    ]
+    if result.get("recovered_tail_records"):
+        lines.append(
+            f"  recovered: {result['recovered_tail_records']} torn tail "
+            "record(s) dropped (crash-consistent load)"
+        )
+    c = result["counts"]
+    lines.append(
+        f"  requests replayed: {result['requests']}  "
+        f"ok={c.get('ok', 0)} mismatch={c.get('mismatch', 0)} "
+        f"skipped={c.get('skipped', 0)} error={c.get('error', 0)}"
+    )
+    for o in result["outcomes"]:
+        if o["status"] == "ok":
+            continue
+        line = (
+            f"  {o['status'].upper():<8} {o.get('op')} "
+            f"gen={o.get('generation')} ref={o.get('ref')}"
+        )
+        if o["status"] == "mismatch":
+            line += (
+                f"  recorded={o.get('recorded_digest')} "
+                f"replayed={o.get('replayed_digest', o.get('replayed_error'))}"
+            )
+        elif o.get("reason"):
+            line += f"  ({o['reason']})"
+        lines.append(line)
+    lines.append(
+        "verdict: "
+        + ("CLEAN — every replay re-answered identically"
+           if result["clean"] else "MISMATCH — see lines above")
+    )
+    return "\n".join(lines)
+
+
+def replay_json_report(result: dict) -> str:
+    """``kccap -replay -output json``: the replay summary verbatim."""
+    return json.dumps(result, indent=2, sort_keys=True)
+
+
 def table_report(
     snapshot: ClusterSnapshot, fits: np.ndarray, scenario: Scenario
 ) -> str:

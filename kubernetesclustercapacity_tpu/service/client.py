@@ -348,6 +348,16 @@ class CapacityClient:
             kw["limit"] = limit
         return self.call("dump", **kw)
 
+    def audit_status(self, **kw) -> dict:
+        """The server's audit-log and shadow-oracle status (the
+        ``info {audit: true}`` section): segment/record counts, last
+        recorded generation, shadow checked/divergence counters and
+        alert state.  ``{"enabled": false, ...}``-shaped when the
+        server runs without ``-audit-dir``/``-shadow-sample-rate``."""
+        return self.call("info", audit=True, **kw).get(
+            "audit", {"enabled": False, "log": None, "shadow": None}
+        )
+
     def timeline(self, since_generation: int | None = None,
                  watch: str | None = None, **kw) -> dict:
         """The server's capacity timeline: per-generation watchlist

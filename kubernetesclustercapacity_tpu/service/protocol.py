@@ -7,7 +7,13 @@ op         params
 =========  ==========================================================
 ping       —
 info       optional ``metrics`` (bool, default false) — include the
-           server's telemetry-registry snapshot under ``metrics``
+           server's telemetry-registry snapshot under ``metrics``;
+           optional ``audit`` (bool, default false) — include the
+           audit-log and shadow-oracle status under ``audit``
+           (``{enabled, log: {segments, records, by_kind,
+           last_generation, ...}, shadow: {sample_rate, checked,
+           divergences, alert, ...}}``) — the replay/audit visibility
+           surface ``kccap -doctor -doctor-service`` reads
 fit        ``cpuRequests``/``cpuLimits``/``memRequests``/``memLimits``/
            ``replicas`` (flag STRINGS, parsed server-side with exact
            reference semantics), optional ``output`` (``reference`` |

@@ -104,6 +104,8 @@ class CapacityServer:
         batch_max: int = 32,
         timeline=None,
         request_log=None,
+        audit_log=None,
+        shadow=None,
     ) -> None:
         """``stats_source`` is an optional zero-arg callable returning a
         JSON-able dict of upstream-feed health (e.g.
@@ -145,7 +147,17 @@ class CapacityServer:
         one structured JSON line per dispatched request — op, trace_id,
         span_id, snapshot generation, latency, status — the log half of
         a logs↔traces join: the same ``span_id`` lands in the
-        ``trace_log`` span record when both are wired."""
+        ``trace_log`` span record when both are wired.
+
+        ``audit_log`` (an :class:`~..audit.AuditLog`) makes served
+        state and answers durable: every snapshot swap is recorded as
+        an invertible diff (periodic checkpoints bound replay cost) and
+        every answering/mutating request with full args + a result
+        digest, replayable offline via ``kccap -replay``.  Flight
+        records gain an ``audit_ref`` pointing at the request's audit
+        record.  ``shadow`` (a :class:`~..audit.ShadowSampler`)
+        re-checks a sampled fraction of sweep responses against the
+        pure-Python oracle off the request path."""
         import os
 
         from kubernetesclustercapacity_tpu.telemetry.flightrec import (
@@ -169,6 +181,8 @@ class CapacityServer:
             else request_log
         )
         self._timeline = timeline
+        self._audit = audit_log
+        self._shadow = shadow
         m = self.registry
         self._m_requests = m.counter(
             "kccap_requests_total", "Requests dispatched, by op.", ("op",)
@@ -239,8 +253,9 @@ class CapacityServer:
         self._thread: threading.Thread | None = None
         # Generation 1 is a generation too: the timeline's baseline
         # record, so the very first publish already has something to
-        # diff against.
+        # diff against (and the audit log's first checkpoint).
         self._observe_timeline(snapshot, self._generation)
+        self._audit_generation(snapshot, self._generation)
 
     @property
     def address(self) -> tuple[str, int]:
@@ -276,17 +291,65 @@ class CapacityServer:
         except Exception:  # noqa: BLE001 - observability never fails a swap
             pass
 
+    def _audit_generation(self, snapshot, generation: int) -> None:
+        """Record one published generation in the audit log.  Same
+        best-effort contract as the timeline hook: auditing must never
+        fail the publish it records."""
+        if self._audit is None:
+            return
+        try:
+            self._audit.record_generation(snapshot, generation)
+        except Exception:  # noqa: BLE001 - auditing never fails a swap
+            pass
+
+    # Ops worth a durable audit record: everything that answers from or
+    # mutates served state.  Pure diagnostics (ping/info/dump/timeline)
+    # would only bury the forensic record under its own readers.
+    _AUDITED_OPS = frozenset(
+        {
+            "fit", "sweep", "sweep_multi", "place", "drain",
+            "topology_spread", "plan", "explain", "update", "reload",
+        }
+    )
+
+    def _audit_request(self, msg, op_label, gen, error, result):
+        """One audit-log request record; returns its audit ref (or
+        ``None``).  Best-effort: the audit trail observes dispatch, it
+        never fails it."""
+        if self._audit is None or op_label not in self._AUDITED_OPS:
+            return None
+        from kubernetesclustercapacity_tpu.audit.log import strip_args
+
+        try:
+            return self._audit.record_request(
+                op=op_label,
+                args=strip_args(msg),
+                generation=gen,
+                status="error" if error else "ok",
+                result=result,
+                error=error,
+            )
+        except Exception:  # noqa: BLE001 - auditing never fails an op
+            return None
+
     def start(self) -> None:
+        self._serving = True
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, daemon=True
         )
         self._thread.start()
 
     def serve_forever(self) -> None:
+        self._serving = True
         self._tcp.serve_forever()
 
     def shutdown(self) -> None:
-        self._tcp.shutdown()
+        # socketserver.shutdown() handshakes with a running
+        # serve_forever loop and would block forever without one — an
+        # embedder that only ever called dispatch() directly (the audit
+        # replayer does) still deserves a working shutdown.
+        if getattr(self, "_serving", False):
+            self._tcp.shutdown()
         self._tcp.server_close()
 
     # -- dispatch ----------------------------------------------------------
@@ -397,12 +460,14 @@ class CapacityServer:
                     )
                 except Exception:  # noqa: BLE001 - logging must not fail ops
                     pass
+            audit_ref = self._audit_request(msg, op_label, gen, error, result)
             self._flight_record(
-                msg, op_label, trace_id, dur, error, result, gen
+                msg, op_label, trace_id, dur, error, result, gen, audit_ref
             )
 
     def _flight_record(
-        self, msg, op_label, trace_id, dur, error, result, gen
+        self, msg, op_label, trace_id, dur, error, result, gen,
+        audit_ref=None,
     ) -> None:
         """One flight-recorder entry per dispatch (the failing request
         included), then — on error, when configured — the whole ring
@@ -422,6 +487,7 @@ class CapacityServer:
                     "" if result is None else flightrec.result_digest(result)
                 ),
                 error=error,
+                audit_ref=audit_ref,
             )
             if error and self._flight_dump_path:
                 self._flight.dump_jsonl(self._flight_dump_path)
@@ -568,6 +634,26 @@ class CapacityServer:
                     "batching": (
                         self._batcher.stats
                         if self._batcher is not None
+                        else None
+                    ),
+                }
+            # Opt-in (``info {audit: true}``): audit-log and
+            # shadow-oracle status — replay/audit visibility without a
+            # side channel.  Opt-in for the pinned-default-shape reason
+            # metrics/hot_path are.
+            if msg.get("audit"):
+                out["audit"] = {
+                    "enabled": (
+                        self._audit is not None or self._shadow is not None
+                    ),
+                    "log": (
+                        self._audit.stats()
+                        if self._audit is not None
+                        else None
+                    ),
+                    "shadow": (
+                        self._shadow.stats()
+                        if self._shadow is not None
                         else None
                     ),
                 }
@@ -1187,6 +1273,18 @@ class CapacityServer:
             )
             attempted, attempt_error = last_dispatch_fast_path()
 
+        # Shadow-oracle sampling: decision + queue append only (the
+        # oracle walk runs on the sampler's worker thread, never this
+        # dispatcher's).  Best-effort by the observability contract.
+        if self._shadow is not None:
+            try:
+                self._shadow.maybe_submit(
+                    snap, generation, grid, totals, sched,
+                    node_mask=implicit_mask,
+                )
+            except Exception:  # noqa: BLE001 - monitoring never fails ops
+                pass
+
         # Attach the fused-path failure ONLY when THIS request's dispatch
         # attempted the fused kernel and it failed (captured on the
         # dispatching thread, so a concurrent request's failure can't be
@@ -1385,8 +1483,11 @@ class CapacityServer:
         # Timeline observation rides the SAME publisher thread as the
         # warm pre-stage (the coalescer's worker under -follow), AFTER
         # warming — the watchlist evaluation hits a warm device cache,
-        # and a query dispatcher never pays for either.
+        # and a query dispatcher never pays for either.  The audit
+        # record follows for the same reason (the diff walk is O(N)
+        # host work).
         self._observe_timeline(snapshot, generation)
+        self._audit_generation(snapshot, generation)
 
     def _op_reload(self, msg: dict, snap: ClusterSnapshot) -> dict:
         """``snap`` is the dispatch's lock-captured snapshot — reading
@@ -1489,6 +1590,7 @@ class CapacityServer:
         # on its dispatch thread keeps the record synchronous with the
         # event batch that produced the generation.
         self._observe_timeline(snap, generation)
+        self._audit_generation(snap, generation)
         return {
             "nodes": snap.n_nodes,
             "healthy_nodes": int(np.sum(snap.healthy)),
@@ -1590,6 +1692,40 @@ def main(argv=None) -> int:
                         "span_id, generation, latency_ms, status) to "
                         "PATH; span_id joins these lines to -trace-log "
                         "spans")
+    p.add_argument("-log-json-max-bytes", type=int, default=0,
+                   dest="log_json_max_bytes", metavar="N",
+                   help="rotate the -log-json file to PATH.1 once it "
+                        "exceeds N bytes (0 = unbounded) — same "
+                        "one-deep rotation as -trace-log-max-bytes")
+    p.add_argument("-audit-dir", default=None, dest="audit_dir",
+                   metavar="DIR",
+                   help="durable audit log: append JSONL segments to "
+                        "DIR recording every snapshot generation "
+                        "(invertible diffs + periodic checkpoints, "
+                        "digest-chained) and every answering/mutating "
+                        "request (full args + result digest) — replay "
+                        "offline with kccap -replay DIR")
+    p.add_argument("-audit-max-bytes", type=int, default=8 << 20,
+                   dest="audit_max_bytes", metavar="N",
+                   help="rotate audit segments once they exceed N "
+                        "bytes (default 8 MiB)")
+    p.add_argument("-audit-checkpoint-every", type=int, default=16,
+                   dest="audit_checkpoint_every", metavar="K",
+                   help="write a full-snapshot checkpoint every K "
+                        "generations (bounds replay cost; default 16)")
+    p.add_argument("-shadow-sample-rate", type=float, default=0.0,
+                   dest="shadow_sample_rate", metavar="FRACTION",
+                   help="re-check this fraction of live sweep "
+                        "responses against the pure-Python oracle, off "
+                        "the request path (0 = off); a divergence "
+                        "flips /healthz, trips the shadow alert, and "
+                        "writes a repro bundle")
+    p.add_argument("-shadow-bundle", default=None, dest="shadow_bundle",
+                   metavar="PATH",
+                   help="append shadow-divergence repro bundles as "
+                        "JSONL to PATH (default: "
+                        "<audit-dir>/shadow-divergence.jsonl when "
+                        "-audit-dir is set)")
     args = p.parse_args(argv)
 
     import os as _os
@@ -1680,6 +1816,48 @@ def main(argv=None) -> int:
             registry=REGISTRY,
             log=args.timeline_log,
         )
+    request_log = None
+    if args.log_json:
+        request_log = TraceLog(
+            args.log_json, max_bytes=max(args.log_json_max_bytes, 0)
+        )
+    audit_log = None
+    if args.audit_dir:
+        from kubernetesclustercapacity_tpu.audit import AuditLog
+
+        try:
+            audit_log = AuditLog(
+                args.audit_dir,
+                segment_max_bytes=max(args.audit_max_bytes, 1),
+                checkpoint_every=max(args.audit_checkpoint_every, 1),
+                registry=REGISTRY,
+            )
+        except OSError as e:
+            print(f"ERROR : cannot open audit dir: {e}", file=sys.stderr)
+            if follower is not None:
+                follower.stop()
+            return 1
+    shadow = None
+    if args.shadow_sample_rate > 0:
+        from kubernetesclustercapacity_tpu.audit import ShadowSampler
+
+        bundle = args.shadow_bundle
+        if bundle is None and args.audit_dir:
+            bundle = _os.path.join(
+                args.audit_dir, "shadow-divergence.jsonl"
+            )
+        try:
+            shadow = ShadowSampler(
+                args.shadow_sample_rate,
+                registry=REGISTRY,
+                bundle_path=bundle,
+                audit_log=audit_log,
+            )
+        except ValueError as e:
+            print(f"ERROR : {e}", file=sys.stderr)
+            if follower is not None:
+                follower.stop()
+            return 1
     server = CapacityServer(
         snap, host=args.host, port=args.port, fixture=fixture,
         auth_token=auth_token, max_inflight=args.max_inflight,
@@ -1694,7 +1872,9 @@ def main(argv=None) -> int:
         batch_window_ms=max(args.batch_window_ms, 0.0),
         batch_max=max(args.batch_max, 1),
         timeline=timeline,
-        request_log=args.log_json,
+        request_log=request_log,
+        audit_log=audit_log,
+        shadow=shadow,
     )
     metrics_server = None
     coalescer_ref: list = []  # filled below; healthz closes over it
@@ -1722,18 +1902,33 @@ def main(argv=None) -> int:
                 # watches are breached RIGHT NOW, visible to the same
                 # scraper that reads the gauges.
                 out["timeline"] = timeline.stats()
+            if audit_log is not None:
+                out["audit"] = audit_log.stats()
+            if shadow is not None:
+                # The parity story: a diverged shadow oracle is a
+                # correctness incident, and the scraper must see it.
+                out["shadow"] = shadow.stats()
             return out
+
+        def _overall_healthy() -> bool:
+            # /healthz goes 503 the moment the feed is known-dead OR
+            # the shadow oracle caught the kernels lying: a frozen
+            # snapshot and a wrong answer are equally unacceptable to
+            # keep serving silently.
+            if follower is not None and follower.fatal is not None:
+                return False
+            if shadow is not None and shadow.diverged:
+                return False
+            return True
 
         try:
             metrics_server = start_metrics_server(
                 REGISTRY,
                 host=args.host,
                 port=args.metrics_port,
-                # /healthz goes 503 the moment the feed is known-dead:
-                # a frozen snapshot must be visible to the scraper too.
                 healthy=(
-                    (lambda: follower.fatal is None)
-                    if follower is not None
+                    _overall_healthy
+                    if (follower is not None or shadow is not None)
                     else None
                 ),
                 status=_healthz_status,
@@ -1827,6 +2022,10 @@ def main(argv=None) -> int:
             metrics_server.shutdown()
         if timeline is not None:
             timeline.close()  # flush the -timeline-log JSONL
+        if shadow is not None:
+            shadow.close()
+        if audit_log is not None:
+            audit_log.close()
         server.shutdown()
     return 0
 

@@ -84,7 +84,12 @@ class FlightRecorder:
         result_digest: str = "",
         error: str | None = None,
         ts: float | None = None,
+        audit_ref: str | None = None,
     ) -> None:
+        """``audit_ref`` — the ``segment:offset`` pointer into the
+        server's audit log for this same request (when auditing is on),
+        so a ``dump`` record pastes straight into ``kccap -replay
+        DIR -replay-ref REF``."""
         rec = {
             "seq": 0,  # assigned under the lock
             "ts": time.time() if ts is None else ts,
@@ -98,6 +103,8 @@ class FlightRecorder:
         }
         if error:
             rec["error"] = error
+        if audit_ref:
+            rec["audit_ref"] = audit_ref
         with self._lock:
             self._seq += 1
             rec["seq"] = self._seq

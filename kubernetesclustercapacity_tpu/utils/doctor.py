@@ -322,6 +322,55 @@ def doctor_report(
 
         check("capacity timeline", _timeline)
 
+        # The service's audit log + shadow oracle: is correctness being
+        # continuously observed, and has it ever been caught lying?  A
+        # recorded divergence is a hard FAILED line — it means a served
+        # answer disagreed with the sequential oracle in production,
+        # which is exactly the incident this check exists to surface.
+        def _audit_shadow():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                a = c.audit_status()
+            if not a.get("enabled", False):
+                return (
+                    "not configured (-audit-dir / -shadow-sample-rate off)"
+                )
+            parts = []
+            log = a.get("log")
+            if log:
+                parts.append(
+                    f"audit: {log['records']} record(s) in "
+                    f"{log['segments']} segment(s), "
+                    f"generation={log['last_generation']}"
+                )
+            sh = a.get("shadow")
+            if sh:
+                parts.append(
+                    f"shadow: rate={sh['sample_rate']} "
+                    f"checked={sh['checked']} "
+                    f"divergences={sh['divergences']} "
+                    f"state={sh['alert']['state']}"
+                )
+                if sh["divergences"]:
+                    return (
+                        "FAILED: shadow-oracle divergence — served "
+                        "answers disagreed with the oracle; "
+                        + " ".join(parts)
+                    )
+            return "ok: " + " ".join(parts)
+
+        check("audit & shadow", _audit_shadow)
+
         # The service's flight recorder: its last-K request history over
         # the dump op — one line of "what was this server just doing"
         # before anyone attaches a debugger.  Same short budgets as the

@@ -1,0 +1,584 @@
+"""Audit log + deterministic replay: segment rotation, crash-consistent
+loading, the digest chain, and bit-exact re-answering of recorded
+requests.
+
+The two load-bearing properties, each pinned by a randomized test:
+
+* **crash consistency** — truncating the final segment at ANY byte
+  offset loads to the last complete record and keeps replaying (a
+  kill-mid-write can cost at most the record being written);
+* **replay bit-exactness** — record randomized generations with
+  interleaved sweep/explain/fit requests, reload the log fresh, and
+  every recorded request re-answers to its recorded canonical digest —
+  both semantics modes, including Q1-overwrite, unhealthy/phantom and
+  taint-masked rows.
+"""
+
+import dataclasses
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.audit import (
+    AuditError,
+    AuditLog,
+    AuditReader,
+    Replayer,
+)
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.service import (
+    CapacityClient,
+    CapacityServer,
+)
+from kubernetesclustercapacity_tpu.snapshot import (
+    snapshot_from_fixture,
+    synthetic_snapshot,
+)
+from kubernetesclustercapacity_tpu.timeline.diff import snapshot_digest
+
+_ARRAY_FIELDS = (
+    "alloc_cpu_milli", "alloc_mem_bytes", "alloc_pods",
+    "used_cpu_req_milli", "used_cpu_lim_milli", "used_mem_req_bytes",
+    "used_mem_lim_bytes", "pods_count", "healthy",
+)
+
+
+def _drop_rows(snap, drop):
+    keep = [i for i in range(snap.n_nodes) if i not in set(drop)]
+    sel = np.asarray(keep, dtype=np.int64)
+    return dataclasses.replace(
+        snap,
+        names=[snap.names[i] for i in keep],
+        **{f: np.asarray(getattr(snap, f))[sel] for f in _ARRAY_FIELDS},
+        labels=[snap.labels[i] for i in keep] if snap.labels else [],
+        taints=[snap.taints[i] for i in keep] if snap.taints else [],
+        node_log=[],
+        pod_cpu_errs=[[] for _ in keep],
+    )
+
+
+def _append_row(snap, name, *, cpu=4000, mem=8 << 30, pods=110):
+    def cat(f, v):
+        return np.concatenate(
+            [np.asarray(getattr(snap, f)), np.asarray([v])]
+        ).astype(np.asarray(getattr(snap, f)).dtype)
+
+    vals = {
+        "alloc_cpu_milli": cpu, "alloc_mem_bytes": mem, "alloc_pods": pods,
+        "used_cpu_req_milli": cpu // 4, "used_cpu_lim_milli": cpu // 2,
+        "used_mem_req_bytes": mem // 4, "used_mem_lim_bytes": mem // 2,
+        "pods_count": 3, "healthy": True,
+    }
+    return dataclasses.replace(
+        snap,
+        names=snap.names + [name],
+        **{f: cat(f, vals[f]) for f in _ARRAY_FIELDS},
+        labels=(snap.labels + [{}]) if snap.labels else [],
+        taints=(snap.taints + [[]]) if snap.taints else [],
+        node_log=[],
+        pod_cpu_errs=[],
+    )
+
+
+def _perturb(snap, rng):
+    """One randomized generation step: mutate a column, and sometimes
+    drop or add rows (drop can hit phantom/duplicate-key rows)."""
+    out = snap
+    move = rng.integers(0, 4)
+    if move == 0 and out.n_nodes > 4:
+        out = _drop_rows(out, [int(rng.integers(0, out.n_nodes))])
+    elif move == 1:
+        out = _append_row(out, f"grown-{int(rng.integers(0, 1 << 16))}")
+    arr = np.asarray(out.alloc_cpu_milli).copy()
+    i = int(rng.integers(0, out.n_nodes))
+    arr[i] = max(int(arr[i] * 0.8), 1)
+    out = dataclasses.replace(out, alloc_cpu_milli=arr)
+    if rng.integers(0, 3) == 0:
+        h = np.asarray(out.healthy).copy()
+        j = int(rng.integers(0, out.n_nodes))
+        h[j] = not h[j]
+        out = dataclasses.replace(out, healthy=h)
+    return out
+
+
+def _fixture_snapshot(mode, seed=5):
+    """A fixture-derived snapshot with the awkward rows: unhealthy →
+    phantom/duplicate "" keys (reference) or masked-but-real rows
+    (strict), plus NoSchedule taints the strict implicit mask zeroes."""
+    fx = synthetic_fixture(
+        24, seed=seed, unhealthy_frac=0.2, taint_frac=0.3,
+        unscheduled_running_pods=3,
+    )
+    return snapshot_from_fixture(fx, semantics=mode)
+
+
+class TestAuditLogMechanics:
+    def test_checkpoint_and_diff_cadence(self, tmp_path):
+        log = AuditLog(str(tmp_path / "a"), checkpoint_every=2)
+        snap = synthetic_snapshot(8, seed=1)
+        for gen in range(1, 6):
+            log.record_generation(
+                dataclasses.replace(
+                    snap,
+                    pods_count=np.asarray(snap.pods_count) + gen,
+                ),
+                gen,
+            )
+        log.close()
+        reader = AuditReader.load(str(tmp_path / "a"))
+        kinds = [r["kind"] for r in reader.generations()]
+        # first is always a checkpoint, then every 2nd generation.
+        assert kinds == ["checkpoint", "diff", "diff", "checkpoint", "diff"]
+        # the chain verifies end to end
+        assert reader.verify_chain() == [1, 2, 3, 4, 5]
+
+    def test_segment_rotation_and_cross_segment_refs(self, tmp_path):
+        d = str(tmp_path / "a")
+        log = AuditLog(d, segment_max_bytes=600, checkpoint_every=4)
+        snap = synthetic_snapshot(6, seed=2)
+        refs = []
+        for gen in range(1, 5):
+            log.record_generation(snap, gen)
+            refs.append(
+                log.record_request(
+                    op="sweep",
+                    args={"random": {"n": 2, "seed": gen}},
+                    generation=gen,
+                    status="ok",
+                    result={"totals": [gen], "schedulable": [True]},
+                )
+            )
+        log.close()
+        segments = sorted(f for f in os.listdir(d) if f.endswith(".jsonl"))
+        assert len(segments) > 1  # the cap actually rotated
+        reader = AuditReader.load(d)
+        assert len(reader.requests()) == 4
+        # every ref resolves to its own record, across segment files
+        ref_segments = {r.rpartition(":")[0] for r in refs}
+        assert len(ref_segments) > 1 and ref_segments <= set(segments)
+        for gen, ref in enumerate(refs, start=1):
+            rec = reader.record_at(ref)
+            assert rec["op"] == "sweep"
+            assert rec["args"]["random"]["seed"] == gen
+
+    def test_reopen_never_appends_to_an_old_segment(self, tmp_path):
+        d = str(tmp_path / "a")
+        snap = synthetic_snapshot(4, seed=3)
+        with AuditLog(d) as log:
+            log.record_generation(snap, 1)
+        with AuditLog(d) as log:
+            log.record_generation(snap, 2)
+        segments = sorted(f for f in os.listdir(d) if f.endswith(".jsonl"))
+        assert segments == ["audit-000001.jsonl", "audit-000002.jsonl"]
+        # the second session had no prior summary → a fresh checkpoint,
+        # so the reader can reconstruct both generations
+        reader = AuditReader.load(d)
+        assert [r["kind"] for r in reader.generations()] == [
+            "checkpoint", "checkpoint",
+        ]
+        assert reader.verify_chain() == [1, 2]
+
+    def test_stats_and_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            AuditLog(str(tmp_path / "x"), checkpoint_every=0)
+        with pytest.raises(ValueError):
+            AuditLog(str(tmp_path / "x"), segment_max_bytes=0)
+        log = AuditLog(str(tmp_path / "a"))
+        snap = synthetic_snapshot(4, seed=4)
+        log.record_generation(snap, 1)
+        ref = log.generation_ref(1)
+        assert ref and ref.startswith("audit-000001.jsonl:")
+        st = log.stats()
+        assert st["records"] == 2  # header + checkpoint
+        assert st["by_kind"] == {"segment_header": 1, "checkpoint": 1}
+        assert st["last_generation"] == 1
+        log.close()
+        with pytest.raises(AuditError):
+            log.record_request(
+                op="sweep", args={}, generation=1, status="ok"
+            )
+
+    def test_load_errors(self, tmp_path):
+        with pytest.raises(AuditError):
+            AuditReader.load(str(tmp_path / "nope"))
+        os.makedirs(str(tmp_path / "empty"))
+        with pytest.raises(AuditError):
+            AuditReader.load(str(tmp_path / "empty"))
+
+
+class TestCrashConsistency:
+    """Satellite: kill-mid-write simulation — truncating the last
+    segment at arbitrary byte offsets must load to the last complete
+    record and keep replaying."""
+
+    def _build(self, tmp_path):
+        d = str(tmp_path / "log")
+        log = AuditLog(d, checkpoint_every=3)
+        snap = synthetic_snapshot(6, seed=9)
+        rng = np.random.default_rng(9)
+        for gen in range(1, 5):
+            log.record_generation(snap, gen)
+            log.record_request(
+                op="sweep",
+                args={"random": {"n": 2, "seed": gen}},
+                generation=gen,
+                status="ok",
+                result={"totals": [1, 2], "schedulable": [True, False]},
+            )
+            snap = _perturb(snap, rng)
+        log.close()
+        return d
+
+    def test_truncate_tail_at_arbitrary_offsets(self, tmp_path):
+        d = self._build(tmp_path)
+        (seg,) = [
+            f
+            for f in sorted(os.listdir(d))
+            if f.endswith(".jsonl")
+        ][-1:]
+        full_bytes = open(os.path.join(d, seg), "rb").read()
+        full = AuditReader.load(d)
+        full_count = len(full.records)
+        # Complete-line boundaries in the final segment, for the
+        # expected-prefix oracle.
+        boundaries = [
+            i + 1 for i, b in enumerate(full_bytes) if b == ord("\n")
+        ]
+        rng = np.random.default_rng(17)
+        cuts = sorted(
+            {int(c) for c in rng.integers(1, len(full_bytes), size=25)}
+        )
+        for cut in cuts:
+            case = str(tmp_path / f"cut-{cut}")
+            shutil.copytree(d, case)
+            with open(os.path.join(case, seg), "r+b") as fh:
+                fh.truncate(cut)
+            reader = AuditReader.load(case)  # must never raise
+            complete = sum(1 for b in boundaries if b <= cut)
+            expected = [
+                r for r in full.records
+                if r["_ref"].rpartition(":")[0] != seg
+            ]
+            tail = [
+                r for r in full.records
+                if r["_ref"].rpartition(":")[0] == seg
+            ]
+            expected += tail[:complete]
+            assert [r["_ref"] for r in reader.records] == [
+                r["_ref"] for r in expected
+            ]
+            assert reader.recovered_tail == (
+                1 if len(reader.records) < full_count and cut not in
+                boundaries else reader.recovered_tail
+            )
+            # ...and the surviving history still replays: reconstruct
+            # the newest generation the truncated log still holds.
+            gens = reader.generations()
+            if gens:
+                snap = reader.snapshot_at(gens[-1]["generation"])
+                assert snapshot_digest(snap) == gens[-1]["digest"]
+
+    def test_corruption_before_the_tail_is_fatal(self, tmp_path):
+        d = self._build(tmp_path)
+        (seg,) = [
+            f for f in sorted(os.listdir(d)) if f.endswith(".jsonl")
+        ][-1:]
+        path = os.path.join(d, seg)
+        data = open(path, "rb").read()
+        first_nl = data.index(b"\n")
+        # Flip a byte inside the FIRST record: mid-file damage is a
+        # corruption diagnosis, never silently skipped history.
+        patched = b"\x00" + data[1:]
+        with open(path, "wb") as fh:
+            fh.write(patched)
+        assert first_nl < len(data) - 1  # not the tail
+        with pytest.raises(AuditError, match="corrupt"):
+            AuditReader.load(d)
+
+
+class TestReplayBitExact:
+    """Acceptance: record N randomized generations + interleaved
+    sweep/explain (and plain fit) requests, reload fresh, re-answer
+    every one identically — both semantics modes, Q1/unhealthy/masked
+    fixtures included."""
+
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    def test_randomized_generations_replay_clean(self, tmp_path, mode):
+        d = str(tmp_path / f"audit-{mode}")
+        audit = AuditLog(d, checkpoint_every=2, segment_max_bytes=4096)
+        snap = _fixture_snapshot(mode)
+        server = CapacityServer(
+            snap, port=0, batch_window_ms=0.0, audit_log=audit
+        )
+        rng = np.random.default_rng(42)
+        requests = 0
+        try:
+            for gen in range(5):
+                # Tiny requests force fit >= alloc_pods → the Q1
+                # overwrite (reference) / the slots clamp (strict).
+                server.dispatch(
+                    {
+                        "op": "sweep",
+                        "cpu_request_milli": [1, 50, 100000],
+                        "mem_request_bytes": [1, 10**6, 10**12],
+                        "replicas": [1, 5, 2],
+                    }
+                )
+                server.dispatch(
+                    {"op": "sweep", "random": {"n": 4, "seed": gen}}
+                )
+                server.dispatch(
+                    {
+                        "op": "explain",
+                        "cpuRequests": f"{int(rng.integers(1, 8))}00m",
+                        "memRequests": "512mb",
+                    }
+                )
+                server.dispatch(
+                    {"op": "fit", "cpuRequests": "250m", "output": "json"}
+                )
+                requests += 4
+                server.replace_snapshot(
+                    _perturb(server.snapshot, rng)
+                )
+        finally:
+            server.shutdown()
+            audit.close()
+        reader = AuditReader.load(d)
+        assert reader.recovered_tail == 0
+        with Replayer(reader) as replayer:
+            result = replayer.replay_all()
+        assert result["chain_error"] is None
+        assert result["generations_verified"] == list(range(1, 7))
+        assert result["counts"] == {
+            "ok": requests, "mismatch": 0, "skipped": 0, "error": 0,
+        }
+        assert result["clean"]
+
+    def test_error_requests_replay_to_the_same_error(self, tmp_path):
+        d = str(tmp_path / "audit")
+        audit = AuditLog(d)
+        server = CapacityServer(
+            synthetic_snapshot(6, seed=1), port=0, batch_window_ms=0.0,
+            audit_log=audit,
+        )
+        try:
+            with pytest.raises(ValueError):
+                server.dispatch({"op": "fit", "cpuRequests": "0"})
+        finally:
+            server.shutdown()
+            audit.close()
+        reader = AuditReader.load(d)
+        (rec,) = reader.requests()
+        assert rec["status"] == "error"
+        with Replayer(reader) as replayer:
+            outcome = replayer.replay_record(rec)
+        assert outcome["status"] == "ok"
+        assert "nonzero" in outcome["replayed_error"]
+
+    def test_tampered_result_digest_is_a_mismatch(self, tmp_path):
+        d = str(tmp_path / "audit")
+        audit = AuditLog(d)
+        server = CapacityServer(
+            synthetic_snapshot(6, seed=1), port=0, batch_window_ms=0.0,
+            audit_log=audit,
+        )
+        try:
+            server.dispatch({"op": "sweep", "random": {"n": 2, "seed": 0}})
+        finally:
+            server.shutdown()
+            audit.close()
+        (seg,) = [f for f in os.listdir(d) if f.endswith(".jsonl")]
+        path = os.path.join(d, seg)
+        lines = open(path, encoding="utf-8").read().splitlines()
+        out = []
+        for ln in lines:
+            rec = json.loads(ln)
+            if rec.get("kind") == "request":
+                rec["result_digest"] = "0" * 16
+            out.append(json.dumps(rec, sort_keys=True))
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(out) + "\n")
+        reader = AuditReader.load(d)
+        with Replayer(reader) as replayer:
+            result = replayer.replay_all()
+        assert result["counts"]["mismatch"] == 1
+        assert not result["clean"]
+
+    def test_tampered_state_breaks_the_digest_chain(self, tmp_path):
+        d = str(tmp_path / "audit")
+        audit = AuditLog(d)
+        snap = synthetic_snapshot(6, seed=1)
+        audit.record_generation(snap, 1)
+        audit.record_generation(
+            dataclasses.replace(
+                snap, pods_count=np.asarray(snap.pods_count) + 1
+            ),
+            2,
+        )
+        audit.close()
+        (seg,) = [f for f in os.listdir(d) if f.endswith(".jsonl")]
+        path = os.path.join(d, seg)
+        lines = open(path, encoding="utf-8").read().splitlines()
+        out = []
+        for ln in lines:
+            rec = json.loads(ln)
+            if rec.get("kind") == "checkpoint":
+                rec["rows"][0][0] += 1  # silent state edit
+            out.append(json.dumps(rec, sort_keys=True))
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(out) + "\n")
+        reader = AuditReader.load(d)
+        with pytest.raises(AuditError, match="digest"):
+            reader.verify_chain()
+
+    def test_fixture_dependent_requests_are_skipped_not_wrong(
+        self, tmp_path
+    ):
+        d = str(tmp_path / "audit")
+        audit = AuditLog(d)
+        fx = synthetic_fixture(8, seed=3)
+        snap = snapshot_from_fixture(fx, semantics="strict")
+        server = CapacityServer(
+            snap, port=0, batch_window_ms=0.0, fixture=fx,
+            audit_log=audit,
+        )
+        try:
+            server.dispatch(
+                {
+                    "op": "fit",
+                    "cpuRequests": "250m",
+                    "tolerations": [{"operator": "Exists"}],
+                }
+            )
+            server.dispatch(
+                {"op": "place", "cpuRequests": "250m", "replicas": "3"}
+            )
+        finally:
+            server.shutdown()
+            audit.close()
+        reader = AuditReader.load(d)
+        with Replayer(reader) as replayer:
+            result = replayer.replay_all()
+        assert result["counts"]["skipped"] == 2
+        assert result["counts"]["mismatch"] == 0
+        assert result["clean"]
+
+
+class TestAuditService:
+    """Wire-level round trip: dump → audit_ref → kccap -replay."""
+
+    def _serve(self, tmp_path):
+        d = str(tmp_path / "audit")
+        audit = AuditLog(d)
+        server = CapacityServer(
+            synthetic_snapshot(10, seed=6), port=0, audit_log=audit
+        )
+        server.start()
+        return d, audit, server
+
+    def test_flight_records_carry_audit_refs_that_resolve(self, tmp_path):
+        d, audit, server = self._serve(tmp_path)
+        try:
+            with CapacityClient(*server.address) as c:
+                c.sweep(random={"n": 2, "seed": 1})
+                c.ping()  # diagnostics are not audited
+                dump = c.dump()
+                status = c.audit_status()
+        finally:
+            server.shutdown()
+            audit.close()
+        by_op = {r["op"]: r for r in dump["records"]}
+        ref = by_op["sweep"]["audit_ref"]
+        assert ":" in ref
+        assert "audit_ref" not in by_op["ping"]
+        assert status["enabled"] and status["log"]["records"] >= 2
+        reader = AuditReader.load(d)
+        rec = reader.record_at(ref)
+        assert rec["op"] == "sweep"
+        assert rec["args"] == {"random": {"n": 2, "seed": 1}}
+        # …and the ref pastes into the CLI (exit 0 = replay verified).
+        from kubernetesclustercapacity_tpu.cli import main as cli_main
+
+        assert cli_main(["-replay", d, "-replay-ref", ref]) == 0
+
+    def test_cli_replay_all_and_generation(self, tmp_path, capsys):
+        d, audit, server = self._serve(tmp_path)
+        try:
+            with CapacityClient(*server.address) as c:
+                c.sweep(random={"n": 2, "seed": 1})
+                c.explain(cpuRequests="500m")
+        finally:
+            server.shutdown()
+            audit.close()
+        from kubernetesclustercapacity_tpu.cli import main as cli_main
+
+        assert cli_main(["-replay", d]) == 0
+        out = capsys.readouterr().out
+        assert "CLEAN" in out
+        assert cli_main(["-replay", d, "-replay-generation", "1"]) == 0
+        assert "verified" in capsys.readouterr().out
+        assert cli_main(["-replay", d, "-output", "json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["clean"] is True
+        assert cli_main(["-replay", str(tmp_path / "missing")]) == 1
+
+    def test_auth_token_never_lands_in_the_audit_log(self, tmp_path):
+        d = str(tmp_path / "audit")
+        audit = AuditLog(d)
+        server = CapacityServer(
+            synthetic_snapshot(6, seed=6), port=0, audit_log=audit,
+            auth_token="sekrit-token",
+        )
+        server.start()
+        try:
+            with CapacityClient(
+                *server.address, token="sekrit-token"
+            ) as c:
+                c.sweep(random={"n": 2, "seed": 1})
+        finally:
+            server.shutdown()
+            audit.close()
+        (seg,) = [f for f in os.listdir(d) if f.endswith(".jsonl")]
+        raw = open(os.path.join(d, seg), encoding="utf-8").read()
+        assert "sekrit-token" not in raw
+
+
+def test_replay_in_a_fresh_process(tmp_path):
+    """Acceptance: the audit log reloads in a FRESH interpreter and
+    re-answers every recorded request identically (kccap -replay's
+    real deployment shape)."""
+    d = str(tmp_path / "audit")
+    audit = AuditLog(d, checkpoint_every=2)
+    snap = _fixture_snapshot("reference")
+    server = CapacityServer(
+        snap, port=0, batch_window_ms=0.0, audit_log=audit
+    )
+    rng = np.random.default_rng(7)
+    try:
+        for gen in range(3):
+            server.dispatch({"op": "sweep", "random": {"n": 3, "seed": gen}})
+            server.dispatch({"op": "explain", "cpuRequests": "750m"})
+            server.replace_snapshot(_perturb(server.snapshot, rng))
+    finally:
+        server.shutdown()
+        audit.close()
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "from kubernetesclustercapacity_tpu.cli import main; "
+            f"raise SystemExit(main(['-replay', {d!r}]))",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CLEAN" in proc.stdout

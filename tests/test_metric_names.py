@@ -1,0 +1,106 @@
+"""Metric-name conformance: every metric registered anywhere in the
+package is ``kccap_``-prefixed snake_case AND documented in the README.
+
+The scan is textual (every ``"kccap_..."`` string literal in the
+package sources) so a metric cannot dodge the check by being registered
+from a module no test imports.  README documentation accepts the
+table's glob/alternation shorthand (``kccap_client_*_total``,
+``kccap_fused_path_{hits,misses,failures}_total``) — the point is that
+an operator grepping the README finds every name a scrape can emit.
+"""
+
+import os
+import re
+
+import pytest
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+_PKG = os.path.join(_REPO, "kubernetesclustercapacity_tpu")
+_README = os.path.join(_REPO, "README.md")
+
+_NAME_RE = re.compile(r"""["'](kccap_[A-Za-z0-9_]+)["']""")
+_SNAKE_RE = re.compile(r"kccap_[a-z0-9]+(_[a-z0-9]+)*")
+_DOC_TOKEN_RE = re.compile(r"kccap_[A-Za-z0-9_*{},|]+")
+
+
+def _source_metric_names() -> set[str]:
+    names: set[str] = set()
+    for root, dirs, files in os.walk(_PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            with open(os.path.join(root, f), encoding="utf-8") as fh:
+                text = fh.read()
+            for m in _NAME_RE.finditer(text):
+                names.add(m.group(1))
+    return names
+
+
+def _doc_patterns() -> list[re.Pattern]:
+    """README tokens → matchers.  A token's name part is everything
+    before a label-reference brace (``name{label=..}``); a brace group
+    that closes and is followed by more name text (or holds a comma
+    list mid-name) is the table's alternation shorthand."""
+    with open(_README, encoding="utf-8") as fh:
+        text = fh.read()
+    patterns: list[re.Pattern] = []
+    for tok in set(_DOC_TOKEN_RE.findall(text)):
+        # Plain-name reading: cut at the first brace (label reference).
+        plain = tok.split("{", 1)[0].rstrip("_*")
+        if plain:
+            patterns.append(re.compile(re.escape(plain)))
+        # Glob/alternation reading of the full token.
+        out, i, ok = "", 0, True
+        while i < len(tok):
+            c = tok[i]
+            if c == "*":
+                out += "[a-z0-9_]*"
+            elif c == "{":
+                j = tok.find("}", i)
+                if j == -1 or "," not in tok[i:j]:
+                    ok = False
+                    break
+                alts = tok[i + 1 : j].split(",")
+                out += "(" + "|".join(re.escape(a) for a in alts) + ")"
+                i = j
+            elif c in "},|":
+                ok = False
+                break
+            else:
+                out += re.escape(c)
+            i += 1
+        if ok:
+            patterns.append(re.compile(out))
+    return patterns
+
+
+def test_scan_finds_the_registry_families():
+    names = _source_metric_names()
+    # Sanity: a broken scan must fail loudly, not vacuously pass.
+    assert "kccap_requests_total" in names
+    assert len(names) > 20
+
+
+def test_metric_names_are_prefixed_snake_case():
+    bad = sorted(
+        n for n in _source_metric_names() if not _SNAKE_RE.fullmatch(n)
+    )
+    assert not bad, (
+        "metric names must be kccap_-prefixed snake_case; "
+        f"offenders: {bad}"
+    )
+
+
+def test_every_metric_is_documented_in_readme():
+    patterns = _doc_patterns()
+    undocumented = sorted(
+        n
+        for n in _source_metric_names()
+        if not any(p.fullmatch(n) for p in patterns)
+    )
+    if undocumented:
+        pytest.fail(
+            "metrics registered in the package but missing from the "
+            "README observability table: " + ", ".join(undocumented)
+        )

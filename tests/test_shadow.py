@@ -1,0 +1,342 @@
+"""Shadow-oracle sampler: live parity monitoring off the request path.
+
+The acceptance scenario: an injected kernel fault (a monkeypatched
+sweep kernel corrupting served totals) is detected within the sample
+window — the divergence counter increments, ``/healthz`` flips,
+``doctor`` prints a hard FAILED line, and the written repro bundle
+replays offline to a confirmed mismatch while the fault is present
+(and to a refutation on a healthy build).  ``KCCAP_TELEMETRY=0``
+keeps the sampler registry-silent end to end.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.audit import (
+    AuditLog,
+    AuditReader,
+    ShadowSampler,
+)
+from kubernetesclustercapacity_tpu.audit.replay import replay_shadow_bundle
+from kubernetesclustercapacity_tpu.audit.shadow import oracle_totals
+from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+from kubernetesclustercapacity_tpu.service import (
+    CapacityClient,
+    CapacityServer,
+)
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+from kubernetesclustercapacity_tpu.telemetry.metrics import MetricsRegistry
+
+
+def _grid(n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return ScenarioGrid(
+        cpu_request_milli=rng.integers(100, 2000, size=n),
+        mem_request_bytes=rng.integers(1 << 20, 4 << 30, size=n),
+        replicas=rng.integers(1, 8, size=n),
+    )
+
+
+def _served(snap, grid):
+    """The correct answer, as (totals, schedulable) host arrays."""
+    totals = oracle_totals(snap, grid)
+    sched = [
+        t >= int(r) for t, r in zip(totals, np.asarray(grid.replicas))
+    ]
+    return np.asarray(totals, dtype=np.int64), np.asarray(sched, dtype=bool)
+
+
+class TestSamplerMechanics:
+    def test_rate_validation(self):
+        for bad in (-0.1, 1.5):
+            with pytest.raises(ValueError, match="sample_rate"):
+                ShadowSampler(bad)
+
+    def test_error_diffusion_is_deterministic_not_random(self):
+        # At rate r exactly every 1/r-th eligible sweep is sampled —
+        # the "detected within one sample window" guarantee.
+        sampler = ShadowSampler(0.25)
+        snap = synthetic_snapshot(6, seed=1)
+        grid = _grid()
+        totals, sched = _served(snap, grid)
+        picks = [
+            sampler.maybe_submit(snap, 1, grid, totals, sched)
+            for _ in range(12)
+        ]
+        try:
+            assert picks == [False, False, False, True] * 3
+        finally:
+            sampler.close()
+
+    def test_rate_zero_is_fully_off(self):
+        sampler = ShadowSampler(0.0)
+        snap = synthetic_snapshot(6, seed=1)
+        grid = _grid()
+        totals, sched = _served(snap, grid)
+        assert not sampler.maybe_submit(snap, 1, grid, totals, sched)
+        # no worker thread was ever started
+        assert sampler._worker is None
+        assert sampler.stats()["sampled"] == 0
+        sampler.close()
+
+    def test_clean_checks_never_alarm(self):
+        reg = MetricsRegistry()
+        sampler = ShadowSampler(1.0, registry=reg)
+        snap = synthetic_snapshot(10, seed=2)
+        try:
+            for seed in range(3):
+                grid = _grid(seed=seed)
+                totals, sched = _served(snap, grid)
+                sampler.maybe_submit(snap, 1, grid, totals, sched)
+            assert sampler.drain()
+            st = sampler.stats()
+            assert st["checked"] == 3 and st["divergences"] == 0
+            assert not sampler.diverged
+            s = reg.snapshot()
+            assert s["kccap_shadow_checked_total"]["values"][""] == 3
+            assert s["kccap_shadow_divergence_total"]["values"] == {}
+        finally:
+            sampler.close()
+
+    def test_full_queue_sheds_samples_never_blocks(self):
+        gate = threading.Event()
+
+        def slow_oracle(snap, grid, node_mask):
+            gate.wait(10.0)
+            return oracle_totals(snap, grid, node_mask=node_mask)
+
+        sampler = ShadowSampler(1.0, oracle=slow_oracle, max_queue=1)
+        snap = synthetic_snapshot(6, seed=3)
+        grid = _grid()
+        totals, sched = _served(snap, grid)
+        try:
+            t0 = time.monotonic()
+            for _ in range(4):
+                sampler.maybe_submit(snap, 1, grid, totals, sched)
+            # All four decisions returned immediately despite the wedged
+            # oracle: sampling cost is the queue append, nothing more.
+            assert time.monotonic() - t0 < 1.0
+            gate.set()
+            assert sampler.drain()
+            st = sampler.stats()
+            assert st["sampled"] == 4
+            assert st["dropped"] >= 1
+            assert st["checked"] + st["dropped"] == 4
+        finally:
+            gate.set()
+            sampler.close()
+
+    def test_oracle_crash_is_counted_not_fatal(self):
+        def broken(snap, grid, node_mask):
+            raise RuntimeError("oracle exploded")
+
+        sampler = ShadowSampler(1.0, oracle=broken)
+        snap = synthetic_snapshot(6, seed=4)
+        grid = _grid()
+        totals, sched = _served(snap, grid)
+        try:
+            sampler.maybe_submit(snap, 1, grid, totals, sched)
+            assert sampler.drain()
+            st = sampler.stats()
+            assert st["oracle_errors"] == 1
+            # monitoring breakage is not a capacity divergence
+            assert st["divergences"] == 0 and not sampler.diverged
+        finally:
+            sampler.close()
+
+    def test_disabled_telemetry_makes_zero_registry_calls(
+        self, monkeypatch, tmp_path
+    ):
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        reg = MetricsRegistry()
+        sampler = ShadowSampler(
+            1.0, registry=reg, bundle_path=str(tmp_path / "b.jsonl")
+        )
+        snap = synthetic_snapshot(6, seed=5)
+        grid = _grid()
+        totals, sched = _served(snap, grid)
+        try:
+            # one clean check AND one divergent check: neither path may
+            # touch the registry when telemetry is off
+            sampler.maybe_submit(snap, 1, grid, totals, sched)
+            sampler.maybe_submit(snap, 1, grid, totals + 1, sched)
+            assert sampler.drain()
+            assert sampler.stats()["divergences"] == 1
+            assert reg.snapshot() == {}  # not even family registration
+        finally:
+            sampler.close()
+
+
+class FaultyKernel:
+    """The injected production fault: the real sweep kernel, totals
+    corrupted by +1 — exactly the class of devcache/bucketing/batching
+    bug the shadow oracle exists to catch."""
+
+    def __init__(self):
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            sweep_snapshot_auto,
+        )
+
+        self._real = sweep_snapshot_auto
+
+    def __call__(self, snap, grid, **kw):
+        totals, sched, kernel = self._real(snap, grid, **kw)
+        return np.asarray(totals) + 1, sched, kernel
+
+
+class TestDivergenceEndToEnd:
+    """Acceptance: fault injected → detected within the sample window →
+    alarmed on every surface → bundle replays to a confirmed mismatch."""
+
+    def test_injected_fault_is_detected_and_reproducible(
+        self, tmp_path, monkeypatch
+    ):
+        from kubernetesclustercapacity_tpu.ops import pallas_fit
+        from kubernetesclustercapacity_tpu.telemetry.exposition import (
+            start_metrics_server,
+        )
+        from kubernetesclustercapacity_tpu.utils.doctor import doctor_report
+
+        d = str(tmp_path / "audit")
+        bundle_path = str(tmp_path / "shadow-divergence.jsonl")
+        reg = MetricsRegistry()
+        audit = AuditLog(d)
+        shadow = ShadowSampler(
+            1.0, registry=reg, bundle_path=bundle_path, audit_log=audit
+        )
+        srv = CapacityServer(
+            synthetic_snapshot(12, seed=6), port=0,
+            batch_window_ms=0.0, registry=reg,
+            audit_log=audit, shadow=shadow,
+        )
+        srv.start()
+        # the same /healthz wiring kccap-server installs for -shadow-*
+        ms = start_metrics_server(
+            reg,
+            healthy=lambda: not shadow.diverged,
+            status=lambda: {"shadow": shadow.stats()},
+        )
+        try:
+            with monkeypatch.context() as mp:
+                mp.setattr(
+                    pallas_fit, "sweep_snapshot_auto", FaultyKernel()
+                )
+                with CapacityClient(*srv.address) as c:
+                    c.sweep(random={"n": 3, "seed": 1})
+                assert shadow.drain()
+
+                # rate 1.0 = a one-request sample window: detected now.
+                st = shadow.stats()
+                assert st["checked"] == 1 and st["divergences"] == 1
+                assert shadow.diverged
+                s = reg.snapshot()
+                assert (
+                    s["kccap_shadow_divergence_total"]["values"][""] == 1
+                )
+                assert s["kccap_shadow_divergence"]["values"][""] == 1
+
+                # /healthz flips to 503 and carries the shadow story
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(ms.url + "/healthz")
+                assert ei.value.code == 503
+                body = json.loads(ei.value.read())
+                assert body["ok"] is False
+                assert body["shadow"]["divergences"] == 1
+
+                # doctor prints it as a hard failure
+                checks = dict(
+                    doctor_report(
+                        backend_timeout_s=30.0,
+                        probe_code="print('DEVICES 0.0s cpu x1')",
+                        service_addr=srv.address,
+                    )
+                )
+                line = checks["audit & shadow"]
+                assert line.startswith("FAILED")
+                assert "divergence" in line
+
+                # the bundle is self-contained and carries the audit ref
+                (bundle,) = [
+                    json.loads(ln)
+                    for ln in open(bundle_path, encoding="utf-8")
+                ]
+                assert bundle["kind"] == "shadow_divergence"
+                assert bundle["divergent_scenarios"] >= 1
+                assert bundle["audit_ref"].startswith("audit-")
+                for row in bundle["rows"]:
+                    assert row["served_total"] == row["oracle_total"] + 1
+
+                # ...and replays offline to a CONFIRMED mismatch while
+                # the fault is live (the bundle rode the audit log too)
+                srv.shutdown()
+                audit.close()
+                reader = AuditReader.load(d)
+                assert any(
+                    r.get("kind") == "shadow_divergence"
+                    for r in reader.records
+                )
+                verdict = replay_shadow_bundle(reader, bundle)
+                assert verdict["diverged"]
+                assert verdict["served_matches_bundle"]
+                assert verdict["rows"][0]["served_total"] == (
+                    verdict["rows"][0]["oracle_total"] + 1
+                )
+            # fault unpatched: the same bundle now REFUTES — a healthy
+            # build does not reproduce the divergence
+            verdict = replay_shadow_bundle(reader, bundle)
+            assert not verdict["diverged"]
+            assert verdict["rows"] == []
+        finally:
+            ms.shutdown()
+            srv.shutdown()
+            shadow.close()
+            audit.close()
+
+    def test_recovery_is_sticky_visible_not_silent(self, tmp_path):
+        # A divergence then a clean check: health restores (recovered,
+        # not breached) but the history stays in stats/alert wire.
+        sampler = ShadowSampler(
+            1.0, bundle_path=str(tmp_path / "b.jsonl")
+        )
+        snap = synthetic_snapshot(8, seed=7)
+        grid = _grid()
+        totals, sched = _served(snap, grid)
+        try:
+            sampler.maybe_submit(snap, 1, grid, totals + 1, sched)
+            assert sampler.drain()
+            assert sampler.diverged
+            sampler.maybe_submit(snap, 2, grid, totals, sched)
+            assert sampler.drain()
+            assert not sampler.diverged  # /healthz is green again
+            st = sampler.stats()
+            assert st["alert"]["state"] == "recovered"
+            assert st["divergences"] == 1
+            assert st["last_divergence"]["generation"] == 1
+        finally:
+            sampler.close()
+
+    def test_server_wires_shadow_stats_into_info_audit(self, tmp_path):
+        shadow = ShadowSampler(1.0)
+        srv = CapacityServer(
+            synthetic_snapshot(8, seed=8), port=0,
+            batch_window_ms=0.0, shadow=shadow,
+        )
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                c.sweep(random={"n": 2, "seed": 2})
+                assert shadow.drain()
+                status = c.audit_status()
+            assert status["enabled"]
+            assert status["shadow"]["checked"] == 1
+            assert status["shadow"]["divergences"] == 0
+        finally:
+            srv.shutdown()
+            shadow.close()

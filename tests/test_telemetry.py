@@ -1000,3 +1000,42 @@ class TestRequestLog:
         ]
         assert rec["op"] == "ping" and rec["span_id"]
         assert rec["trace_id"] == ""  # untraced call: logged regardless
+
+    def test_request_log_rotates_like_the_trace_log(self, tmp_path):
+        # Satellite (PR 6): -log-json-max-bytes — the request log gets
+        # TraceLog's one-deep rotation, so a long-lived server cannot
+        # grow it without bound.
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+        from kubernetesclustercapacity_tpu.snapshot import (
+            synthetic_snapshot,
+        )
+        from kubernetesclustercapacity_tpu.telemetry.tracing import TraceLog
+
+        req_path = str(tmp_path / "requests.jsonl")
+        srv = CapacityServer(
+            synthetic_snapshot(4, seed=1), port=0,
+            request_log=TraceLog(req_path, max_bytes=600),
+        )
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                for _ in range(24):
+                    c.ping()
+        finally:
+            srv.shutdown()
+        rotated = req_path + ".1"
+        assert os.path.exists(rotated)
+        # One-deep rotation, exactly like -trace-log-max-bytes: PATH
+        # and PATH.1 only, every surviving line a complete record.
+        assert not os.path.exists(req_path + ".2")
+        assert os.path.getsize(req_path) <= 600
+        recs = []
+        for p in (rotated, req_path):
+            recs += [
+                json.loads(ln) for ln in open(p, encoding="utf-8")
+            ]
+        assert recs and all(r["op"] == "ping" for r in recs)
+        assert all("latency_ms" in r and "generation" in r for r in recs)
