@@ -890,6 +890,85 @@ def _hot_path_metrics(out: dict | None = None) -> dict:
     return out
 
 
+def _measure_dispatch_breakdown(snap, grid, reps: int = 10) -> dict:
+    """Per-phase p50 decomposition of one instrumented dispatch.
+
+    Runs the serving stack's instrumented entry point
+    (``sweep_snapshot`` → kernel → numpy materialization, plus the wire
+    ``tolist`` as the serialize phase) with a :class:`~kubernetesclusterc
+    apacity_tpu.telemetry.phases.PhaseClock` active, and reports the
+    per-phase p50s next to the loop's own end-to-end p50.  This is
+    ROADMAP item 5's instrument panel: ``dispatch_floor_ms`` ≈ 65 of the
+    72.6 ms exact single-dispatch p50 was one opaque number — the future
+    PR that attacks the floor gets a measured before/after per phase.
+
+    The decomposition must reconcile with the longstanding
+    ``exact_single_dispatch_p50_ms`` headline (the emitted
+    ``vs_exact_single_dispatch`` ratio), so it dispatches the SAME
+    computation: the ``KCCAP_DEVCACHE=0`` escape hatch disables bucket
+    padding for the timed reps (at the default 10k-node shape the pow2
+    ladder pads 10 000 → 16 384 rows — ~1.6× the device work of the
+    headline, which would make the two numbers incomparable).  The
+    bucketed production path's padding cost is already tracked by the
+    ``*_per_sweep_ms`` slope metrics, where the scan amortizes it.
+
+    The warm-up dispatch pays compile up front, so the timed reps
+    decompose the steady state (``compile`` or ``devcache`` appearing
+    here would themselves be findings).  Sum of per-phase p50s
+    reconciles with the end-to-end p50 by construction (each phase is a
+    sub-interval of the same timed region).
+    """
+    import statistics
+
+    import kubernetesclustercapacity_tpu as kcc
+    from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+
+    prev_devcache = os.environ.get("KCCAP_DEVCACHE")
+    os.environ["KCCAP_DEVCACHE"] = "0"
+    samples: dict[str, list] = {}
+    e2e = []
+    try:
+        kcc.sweep_snapshot(snap, grid)  # warm: unbucketed-shape compile
+        for _ in range(reps):
+            clk = _phases.PhaseClock()
+            prev = _phases.activate(clk)
+            try:
+                t0 = time.perf_counter()
+                totals, sched = kcc.sweep_snapshot(snap, grid)
+                with clk.phase("serialize"):
+                    # The wire response's list conversion — the same
+                    # host work CapacityServer._op_sweep times as
+                    # serialize.
+                    _payload = (
+                        np.asarray(totals).tolist(),
+                        np.asarray(sched).tolist(),
+                    )
+                e2e.append((time.perf_counter() - t0) * 1e3)
+            finally:
+                _phases.restore(prev)
+            for ph, s in clk.items():
+                samples.setdefault(ph, []).append(s * 1e3)
+    finally:
+        if prev_devcache is None:
+            os.environ.pop("KCCAP_DEVCACHE", None)
+        else:
+            os.environ["KCCAP_DEVCACHE"] = prev_devcache
+    phases_p50 = {
+        ph: round(statistics.median(v), 3) for ph, v in samples.items()
+    }
+    # Vocabulary order, measured phases only.
+    phases_p50 = {
+        ph: phases_p50[ph] for ph in _phases.PHASES if ph in phases_p50
+    }
+    total = round(sum(phases_p50.values()), 3)
+    return {
+        "phases_p50_ms": phases_p50,
+        "sum_of_phases_ms": total,
+        "e2e_p50_ms": round(statistics.median(e2e), 3),
+        "reps": reps,
+    }
+
+
 def _shadow_overhead_metrics(out: dict | None = None) -> dict:
     """Shadow-oracle sampler request-path cost: sweep p50 at 0% / 1% /
     10% sample rates.
@@ -1243,6 +1322,24 @@ def _run() -> None:
         ),
         reps=10,
     ).p50
+
+    # --- WHERE the single-dispatch time goes: per-phase p50s of the
+    # production-path dispatch (ROADMAP item 5's instrument panel).
+    # Best-effort by the aux-ladder policy: a decomposition failure must
+    # never void the headline measurement it decomposes.
+    try:
+        dispatch_floor_breakdown = _measure_dispatch_breakdown(snap, g0)
+        dispatch_floor_breakdown["vs_exact_single_dispatch"] = (
+            round(
+                dispatch_floor_breakdown["sum_of_phases_ms"]
+                / single_dispatch_p50,
+                3,
+            )
+            if single_dispatch_p50 > 0
+            else None
+        )
+    except Exception as e:  # noqa: BLE001 - decomposition is aux
+        dispatch_floor_breakdown = {"error": f"{type(e).__name__}: {e}"}
 
     # --- Pallas int32 fast path (eligibility-checked; exactness
     # cross-checked against the int64 kernel on the full workload).
@@ -2107,6 +2204,7 @@ def _run() -> None:
                 "exact_int64_per_sweep_ms": round(exact_per_sweep, 3),
                 "exact_single_dispatch_p50_ms": round(single_dispatch_p50, 3),
                 "dispatch_floor_ms": round(dispatch_floor_ms, 3),
+                "dispatch_floor_breakdown": dispatch_floor_breakdown,
                 "slope_scan_lengths": (
                     [K_SMALL, K_BIG_FUSED]
                     if fast_per_sweep is not None

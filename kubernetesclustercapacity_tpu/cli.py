@@ -167,6 +167,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with -replay: reconstruct generation GEN and "
                         "verify its digest instead of replaying "
                         "requests")
+    p.add_argument("-slo-status", default=None, dest="slo_status",
+                   metavar="HOST:PORT",
+                   help="render a running capacity service's SLO "
+                        "burn-rate status (objectives, short/long-"
+                        "window burn rates, alert states) and exit; "
+                        "-output json selects the structured form; "
+                        "exit 1 while any SLO is breached (or the "
+                        "server runs without -slo)")
+    p.add_argument("-dump", default=None, metavar="HOST:PORT",
+                   help="render a running capacity service's flight "
+                        "recorder (its last K dispatched requests, "
+                        "each with the per-phase latency breakdown) "
+                        "and exit; -output json selects the "
+                        "structured form")
+    p.add_argument("-dump-limit", type=int, default=None,
+                   dest="dump_limit", metavar="N",
+                   help="with -dump: only the N most recent records")
     return p
 
 
@@ -207,6 +224,12 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.timeline:
         return _run_timeline(args)
+
+    if args.slo_status:
+        return _run_slo_status(args)
+
+    if args.dump:
+        return _run_dump(args)
 
     if args.replay:
         return _run_replay(args)
@@ -339,24 +362,12 @@ def _run_timeline(args) -> int:
         timeline_json_report,
         timeline_table_report,
     )
-    from kubernetesclustercapacity_tpu.resilience import RetryPolicy
-    from kubernetesclustercapacity_tpu.service.client import CapacityClient
 
-    host, _, port = args.timeline.rpartition(":")
-    try:
-        addr = (host or "127.0.0.1", int(port))
-    except ValueError:
-        print(f"ERROR : bad -timeline {args.timeline!r} (want HOST:PORT)",
-              file=sys.stderr)
+    addr = _parse_addr("-timeline", args.timeline)
+    if addr is None:
         return 1
     try:
-        with CapacityClient(
-            *addr,
-            connect_timeout_s=5.0,
-            timeout_s=10.0,
-            retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
-            deadline_s=10.0,
-        ) as c:
+        with _diag_client(addr) as c:
             result = c.timeline(
                 since_generation=args.timeline_since,
                 watch=args.timeline_watch,
@@ -379,6 +390,90 @@ def _run_timeline(args) -> int:
     # Exit by the verdict, like -drain does: a breached watchlist is a
     # scriptable signal, not just prose.
     return 1 if breached else 0
+
+
+def _parse_addr(flag_name: str, value: str):
+    """``HOST:PORT`` → ``(host, port)`` or ``None`` (error printed)."""
+    host, _, port = value.rpartition(":")
+    try:
+        return (host or "127.0.0.1", int(port))
+    except ValueError:
+        print(f"ERROR : bad {flag_name} {value!r} (want HOST:PORT)",
+              file=sys.stderr)
+        return None
+
+
+def _diag_client(addr):
+    """The short-budget client every one-shot diagnostic flag uses."""
+    from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+    from kubernetesclustercapacity_tpu.service.client import CapacityClient
+
+    return CapacityClient(
+        *addr,
+        connect_timeout_s=5.0,
+        timeout_s=10.0,
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+        deadline_s=10.0,
+    )
+
+
+def _run_slo_status(args) -> int:
+    """-slo-status HOST:PORT: fetch and render a service's SLO burn-rate
+    status.  Exits by the verdict, like -timeline: a breached objective
+    (or a server with no -slo at all) is a scriptable failure."""
+    from kubernetesclustercapacity_tpu.report import (
+        slo_json_report,
+        slo_table_report,
+    )
+
+    addr = _parse_addr("-slo-status", args.slo_status)
+    if addr is None:
+        return 1
+    try:
+        with _diag_client(addr) as c:
+            result = c.slo_status()
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot fetch SLO status from "
+              f"{addr[0]}:{addr[1]}: {e}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(slo_json_report(result))
+    else:
+        print(slo_table_report(result))
+    if not result.get("enabled", False):
+        return 1
+    breached = [
+        name
+        for name, s in result.get("status", {}).items()
+        if s.get("state") == "breached"
+    ]
+    return 1 if breached else 0
+
+
+def _run_dump(args) -> int:
+    """-dump HOST:PORT: fetch and render a service's flight recorder —
+    the last K dispatched requests, each carrying its per-phase latency
+    breakdown, so a slow request is self-explaining from the paste."""
+    from kubernetesclustercapacity_tpu.report import (
+        dump_json_report,
+        dump_table_report,
+    )
+
+    addr = _parse_addr("-dump", args.dump)
+    if addr is None:
+        return 1
+    try:
+        with _diag_client(addr) as c:
+            result = c.dump(limit=args.dump_limit)
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot fetch flight records from "
+              f"{addr[0]}:{addr[1]}: {e}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(dump_json_report(result))
+    else:
+        print(dump_table_report(result))
+    return 0
 
 
 def _run_replay(args) -> int:

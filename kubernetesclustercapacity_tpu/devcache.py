@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
@@ -192,6 +193,19 @@ class DeviceCache:
         both values are equal by construction.
         """
         if not enabled():
+            from kubernetesclustercapacity_tpu.telemetry import (
+                phases as _phases,
+            )
+
+            clk = _phases.current()
+            if clk:
+                # Cache disabled: every request re-stages — still the
+                # devcache phase (the decomposition must show what the
+                # escape hatch costs).
+                t0 = time.perf_counter()
+                value = build()
+                clk.record("devcache", time.perf_counter() - t0)
+                return value
             return build()
         form = str(key[0]) if key else "unknown"
         full = (self._token(snapshot), *key)
@@ -204,7 +218,19 @@ class DeviceCache:
             if _telemetry_enabled():
                 _metrics()["hits"].labels(form=form).inc()
             return hit
-        value = build()
+        from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+
+        clk = _phases.current()
+        if clk:
+            # A miss stages host padding + a host→device upload — the
+            # request-visible cost the cache exists to remove.  Recorded
+            # as the answering request's ``devcache`` phase (a hit
+            # records nothing: that IS the cache working).
+            t0 = time.perf_counter()
+            value = build()
+            clk.record("devcache", time.perf_counter() - t0)
+        else:
+            value = build()
         with self._lock:
             self._entries[full] = value
             self._entries.move_to_end(full)

@@ -396,16 +396,25 @@ def sweep_grid_bucketed(
     import time as _time
 
     from kubernetesclustercapacity_tpu import devcache as _devcache
+    from kubernetesclustercapacity_tpu.telemetry import phases as _phases
     from kubernetesclustercapacity_tpu.telemetry.metrics import (
         enabled as _telemetry_enabled,
     )
 
+    clk = _phases.current()
     if not _devcache.enabled():
+        t0 = _time.perf_counter() if clk else 0.0
         out = sweep_grid(
             alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
             pods_count, healthy, cpu_reqs, mem_reqs, replicas,
             mode=mode, node_mask=node_mask, return_per_node=return_per_node,
         )
+        if clk:
+            t1 = _time.perf_counter()
+            clk.record("device_exec", t1 - t0)
+            out = tuple(np.asarray(o) for o in out)
+            clk.record("fetch", _time.perf_counter() - t1)
+            return out
         return tuple(np.asarray(o) for o in out)
 
     n = int(np.asarray(alloc_cpu).shape[0])
@@ -436,7 +445,14 @@ def sweep_grid_bucketed(
         *arrays, cpu_p, mem_p, rep_p,
         mode=mode, node_mask=mask, return_per_node=return_per_node,
     )
+    # The jitted call returns asynchronously-dispatched device arrays;
+    # the numpy materialization below is the block_until_ready sync.
+    # Timed apart so the phase clock can split launch (device_exec)
+    # from the device→host wait+transfer (fetch).
+    t_launch = _time.perf_counter()
     out = tuple(np.asarray(o) for o in out)
+    t_done = _time.perf_counter()
+    kind = None
     if _telemetry_enabled():
         # Per-bucket compile visibility: "first observation per label"
         # now means "first per padded shape", so a ±1 node change inside
@@ -445,9 +461,17 @@ def sweep_grid_bucketed(
             observe_dispatch,
         )
 
-        observe_dispatch(
-            f"xla_int64@n{bucket}", _time.perf_counter() - t0
-        )
+        kind = observe_dispatch(f"xla_int64@n{bucket}", t_done - t0)
+    if clk:
+        if kind == "compile":
+            # First dispatch of this padded shape: the wall time is
+            # dominated by trace + XLA compile, not kernel runtime —
+            # attribute the whole interval to the compile phase so a
+            # cold start never reads as a device_exec regression.
+            clk.record("compile", t_done - t0)
+        else:
+            clk.record("device_exec", t_launch - t0)
+            clk.record("fetch", t_done - t_launch)
     result = (out[0][:s], out[1][:s])
     if return_per_node:
         result += (out[2][:s, :n],)

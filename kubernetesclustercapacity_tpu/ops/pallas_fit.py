@@ -45,6 +45,10 @@ from kubernetesclustercapacity_tpu.resilience import (
 from kubernetesclustercapacity_tpu.telemetry import (
     compilewatch as _compilewatch,
 )
+from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+from kubernetesclustercapacity_tpu.telemetry.metrics import (
+    SUB_MS_LATENCY_BUCKETS_S as _SUB_MS_BUCKETS,
+)
 from kubernetesclustercapacity_tpu.telemetry.metrics import (
     enabled as _telemetry_enabled,
 )
@@ -107,6 +111,9 @@ def _metrics() -> dict:
                 "dispatch; the numpy materialization is the "
                 "block_until_ready sync point).",
                 ("kernel",),
+                # Sub-ms ladder: the fused path's ~0.7 ms p50 needs
+                # finer bins than the default 0.5 ms floor resolves.
+                buckets=_SUB_MS_BUCKETS,
             ),
             "transitions": REGISTRY.counter(
                 "kccap_breaker_transitions_total",
@@ -672,6 +679,10 @@ def sweep_pallas(
             np.asarray(node_mask).astype(np.int64), n_pad
         )
     strict = mode == "strict"
+    import time as _time
+
+    clk = _phases.current()
+    t0 = _time.perf_counter() if clk else 0.0
     if use_rcp:
         recips = tuple(scenario_reciprocals(args[i]) for i in (6, 7))
         totals = _sweep_pallas_padded_rcp(
@@ -681,7 +692,18 @@ def sweep_pallas(
         totals = _sweep_pallas_padded(
             *args, mk, strict=strict, interpret=interpret
         )
-    totals = np.asarray(totals)[:s]
+    if clk:
+        # Launch vs device→host sync, timed apart (same split as the
+        # exact wrapper): the jitted call dispatches asynchronously and
+        # np.asarray is the block_until_ready point.  sweep_auto moves
+        # both into the compile phase when compilewatch classifies this
+        # dispatch as a first call.
+        t_launch = _time.perf_counter()
+        clk.record("device_exec", t_launch - t0)
+        totals = np.asarray(totals)[:s]
+        clk.record("fetch", _time.perf_counter() - t_launch)
+    else:
+        totals = np.asarray(totals)[:s]
     schedulable = totals >= np.asarray(replicas, dtype=np.int64)
     return totals, schedulable
 
@@ -824,7 +846,16 @@ def sweep_auto(
                 dt = _time.perf_counter() - t0
                 tel["latency"].labels(kernel=name).observe(dt)
                 tel["hits"].inc()
-                _compilewatch.observe_dispatch(name, dt)
+                kind = _compilewatch.observe_dispatch(name, dt)
+                if kind == "compile":
+                    # The phase clock recorded this dispatch as
+                    # device_exec + fetch before compilewatch could
+                    # classify it; a first call is trace + Mosaic
+                    # compile — reattribute so cold starts decompose as
+                    # compile, not as a runtime spike.
+                    clk = _phases.current()
+                    clk.move("device_exec", "compile")
+                    clk.move("fetch", "compile")
             return totals, sched, name
     if tel is not None:
         tel["misses"].labels(reason=fallback_reason).inc()

@@ -29,6 +29,10 @@ __all__ = [
     "explain_json_report",
     "timeline_table_report",
     "timeline_json_report",
+    "slo_table_report",
+    "slo_json_report",
+    "dump_table_report",
+    "dump_json_report",
 ]
 
 _RULE = "=" * 110  # the reference prints 110 '=' (ClusterCapacity.go:142,149)
@@ -394,6 +398,93 @@ def timeline_json_report(timeline: dict) -> str:
     """The ``timeline`` op's response, pretty-printed (machine surface —
     the wire shape verbatim, so scripts parse one schema)."""
     return json.dumps(timeline, indent=2)
+
+
+def _burn_cell(v) -> str:
+    """One burn-rate cell: '-' before two samples exist, else 'N.NNx'."""
+    return "-" if v is None else f"{v:.2f}x"
+
+
+def slo_table_report(status: dict) -> str:
+    """The ``slo`` op's response as operator-readable text: one row per
+    objective (state, short/long-window burn vs the fast-burn
+    threshold), then the one-line verdict a pager would carry."""
+    if not status.get("enabled", False):
+        return "slo: not enabled on this server (-slo FILE)"
+    header = (
+        f"{'SLO':<20} {'OBJECTIVE':<26} {'OP':<8} {'STATE':<10} "
+        f"{'BURN(short)':>12} {'BURN(long)':>11} {'THRESH':>7}"
+    )
+    lines = [header, "-" * len(header)]
+    for name in sorted(status.get("status", {})):
+        s = status["status"][name]
+        lines.append(
+            f"{name:<20} {s['objective']:<26} {s['op'] or '*':<8} "
+            f"{s['state']:<10} "
+            f"{_burn_cell(s['short_burn']):>12} "
+            f"{_burn_cell(s['long_burn']):>11} "
+            f"{s['fast_burn']:>6.1f}x"
+        )
+    lines.append("-" * len(header))
+    breached = [
+        n for n, s in status.get("status", {}).items()
+        if s.get("state") == "breached"
+    ]
+    if breached:
+        lines.append(
+            "verdict: FAST BURN — error budget burning on "
+            + ", ".join(sorted(breached))
+        )
+    else:
+        lines.append(
+            "verdict: ok — every objective within its error budget "
+            f"({status.get('evaluations', 0)} evaluation(s))"
+        )
+    return "\n".join(lines)
+
+
+def slo_json_report(status: dict) -> str:
+    """``kccap -slo-status -output json``: the wire shape verbatim."""
+    return json.dumps(status, indent=2, sort_keys=True)
+
+
+def _phases_cell(phases: dict | None) -> str:
+    """A record's per-phase breakdown as ``phase=ms`` pairs, largest
+    first — the part that makes a pasted slow request self-explaining."""
+    if not phases:
+        return ""
+    parts = sorted(phases.items(), key=lambda kv: (-kv[1], kv[0]))
+    return " ".join(f"{k}={v:g}ms" for k, v in parts)
+
+
+def dump_table_report(dump: dict) -> str:
+    """The ``dump`` op's response as operator-readable text: one line
+    per flight record (latency + status), each followed by its phase
+    decomposition when the record carries one."""
+    records = dump.get("records", [])
+    lines = [
+        f"flight recorder: {dump.get('count', len(records))} record(s) "
+        f"(capacity {dump.get('capacity')}, dropped {dump.get('dropped')}), "
+        f"serving generation {dump.get('generation')}"
+    ]
+    for r in records:
+        line = (
+            f"  #{r.get('seq'):<6} {r.get('op'):<16} "
+            f"gen={r.get('generation'):<5} "
+            f"{r.get('latency_ms'):>9}ms  {r.get('status')}"
+        )
+        if r.get("error"):
+            line += f"  [{r['error']}]"
+        lines.append(line)
+        phases = _phases_cell(r.get("phases"))
+        if phases:
+            lines.append(f"          phases: {phases}")
+    return "\n".join(lines)
+
+
+def dump_json_report(dump: dict) -> str:
+    """``kccap -dump -output json``: the wire shape verbatim."""
+    return json.dumps(dump, indent=2, sort_keys=True)
 
 
 def replay_table_report(result: dict) -> str:

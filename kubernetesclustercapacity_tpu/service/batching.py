@@ -157,6 +157,9 @@ class MicroBatcher:
             if len(batch.items) >= self.max_batch:
                 batch.full.set()
 
+        from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+
+        clk = _phases.current()
         if leader:
             t0 = time.perf_counter()
             batch.full.wait(self.window_s)
@@ -167,7 +170,12 @@ class MicroBatcher:
                 if self._pending.get(key) is batch:
                     del self._pending[key]
                 items = list(batch.items)
-            self._m_wait.observe(time.perf_counter() - t0)
+            waited = time.perf_counter() - t0
+            self._m_wait.observe(waited)
+            # The leader's batch_wait is the window it held the door
+            # open; its combined dispatch below records device phases on
+            # this same (request) thread's clock.
+            clk.record("batch_wait", waited)
             try:
                 results = self._dispatch(key, items)
                 if len(results) != len(items):
@@ -187,7 +195,16 @@ class MicroBatcher:
                     self._m_solo.inc()
                 batch.done.set()
         else:
-            if not batch.done.wait(_FOLLOWER_TIMEOUT_S):
+            t0 = time.perf_counter() if clk else 0.0
+            done = batch.done.wait(_FOLLOWER_TIMEOUT_S)
+            # A follower's whole batching story is this wait: the
+            # remainder of the leader's window plus the combined kernel
+            # dispatch it rode.  Its own clock never sees device phases
+            # — the leader's does — so batch_wait is the honest
+            # per-request attribution.
+            if clk:
+                clk.record("batch_wait", time.perf_counter() - t0)
+            if not done:
                 raise RuntimeError(
                     "micro-batch dispatch timed out waiting for its leader"
                 )

@@ -17,8 +17,9 @@ place, drain,
 topology_spread,
 plan, explain
 dump,           yes (read-only views of the flight recorder / capacity
-timeline        timeline; a retry re-reads the ring, which may have
-                advanced — acceptable for a diagnostic surface)
+timeline, slo   timeline / SLO burn rates; a retry re-reads the ring,
+                which may have advanced — acceptable for a diagnostic
+                surface)
 update, reload  NO (state mutations; at-most-once from this client)
 ==============  =======================================================
 """
@@ -45,7 +46,7 @@ __all__ = ["CapacityClient", "IDEMPOTENT_OPS"]
 IDEMPOTENT_OPS = frozenset(
     {
         "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
-        "topology_spread", "plan", "explain", "dump", "timeline",
+        "topology_spread", "plan", "explain", "dump", "timeline", "slo",
     }
 )
 
@@ -67,6 +68,13 @@ class CapacityClient:
     fresh ``trace_id`` to every call (kept on :attr:`last_trace_id`) so
     client attempts correlate with server-side trace-log spans; an
     explicit ``trace_id=...`` per call always wins.
+
+    ``trace_log`` (a path or :class:`~..telemetry.TraceLog`) records
+    the client's side of every call as JSONL spans: one span per CALL
+    plus one child span per transport ATTEMPT (attempt index, the
+    backoff delay slept before it, status) — a retry storm is visible
+    as a fan of attempt spans under one call, where a single call-level
+    span would hide it entirely.
     """
 
     #: stats() keys → (metric name, help) — one table so the dict view
@@ -96,10 +104,12 @@ class CapacityClient:
         breaker: CircuitBreaker | None = None,
         registry=None,
         trace: bool = False,
+        trace_log=None,
     ) -> None:
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             MetricsRegistry,
         )
+        from kubernetesclustercapacity_tpu.telemetry.tracing import TraceLog
 
         self._addr = (host, port)
         self._token = token
@@ -127,6 +137,9 @@ class CapacityClient:
                 )
             )
         self._trace = bool(trace)
+        self._trace_log = (
+            TraceLog(trace_log) if isinstance(trace_log, str) else trace_log
+        )
         self.last_trace_id: str | None = None
         self._connect()  # fail fast, like the original one-shot client
 
@@ -224,53 +237,143 @@ class CapacityClient:
             msg["deadline"] = deadline.to_wire()
         retryable_op = op in IDEMPOTENT_OPS
         self._m["calls"].inc()
+        call_span_id = None
+        if self._trace_log is not None:
+            from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                new_span_id,
+            )
+
+            call_span_id = new_span_id()
+        trace_id = params.get("trace_id") or ""
+        t_call0 = time.perf_counter()
+        call_error: str | None = None
         prev_delay: float | None = None
         attempt = 0
-        while True:
-            attempt += 1
-            if self._breaker is not None and not self._breaker.allow():
-                self._m["breaker_rejected"].inc()
-                raise CircuitOpenError(
-                    f"circuit breaker open for {self._addr[0]}:"
-                    f"{self._addr[1]}"
-                    + (
-                        f" (last error: {self._breaker.last_error})"
-                        if self._breaker.last_error
-                        else ""
+        backoff_before = 0.0  # seconds slept before the CURRENT attempt
+        try:
+            while True:
+                attempt += 1
+                if self._breaker is not None and not self._breaker.allow():
+                    self._m["breaker_rejected"].inc()
+                    raise CircuitOpenError(
+                        f"circuit breaker open for {self._addr[0]}:"
+                        f"{self._addr[1]}"
+                        + (
+                            f" (last error: {self._breaker.last_error})"
+                            if self._breaker.last_error
+                            else ""
+                        )
                     )
+                t_attempt0 = time.perf_counter()
+                try:
+                    result = self._attempt(msg, deadline)
+                except Exception as e:
+                    self._record_attempt_span(
+                        op, trace_id, call_span_id, attempt,
+                        backoff_before,
+                        time.perf_counter() - t_attempt0,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    transport = RetryPolicy.is_transport_error(e)
+                    if transport and self._breaker is not None:
+                        self._breaker.record_failure(
+                            f"{type(e).__name__}: {e}"
+                        )
+                    if (
+                        deadline is not None
+                        and deadline.expired()
+                        and transport
+                    ):
+                        # The budget, not the transport, is what gave
+                        # out: surface that (retrying cannot un-spend
+                        # it).
+                        self._m["deadline_expired"].inc()
+                        raise DeadlineExpired(
+                            f"deadline expired after {attempt} attempt(s) "
+                            f"of {op!r}; last transport error: "
+                            f"{type(e).__name__}: {e}"
+                        ) from e
+                    if (
+                        not transport  # app error/deadline: deterministic
+                        or not retryable_op  # update/reload: at-most-once
+                        or attempt >= self._retry.max_attempts
+                    ):
+                        raise
+                    prev_delay = self._retry.next_delay(prev_delay)
+                    if deadline is not None:
+                        prev_delay = min(
+                            prev_delay, max(deadline.remaining(), 0.0)
+                        )
+                    time.sleep(prev_delay)
+                    backoff_before = prev_delay
+                    self._m["retries"].inc()
+                    continue
+                self._record_attempt_span(
+                    op, trace_id, call_span_id, attempt, backoff_before,
+                    time.perf_counter() - t_attempt0, error=None,
                 )
-            try:
-                result = self._attempt(msg, deadline)
-            except Exception as e:
-                transport = RetryPolicy.is_transport_error(e)
-                if transport and self._breaker is not None:
-                    self._breaker.record_failure(f"{type(e).__name__}: {e}")
-                if deadline is not None and deadline.expired() and transport:
-                    # The budget, not the transport, is what gave out:
-                    # surface that (retrying cannot un-spend it).
-                    self._m["deadline_expired"].inc()
-                    raise DeadlineExpired(
-                        f"deadline expired after {attempt} attempt(s) of "
-                        f"{op!r}; last transport error: "
-                        f"{type(e).__name__}: {e}"
-                    ) from e
-                if (
-                    not transport  # app error / deadline: deterministic
-                    or not retryable_op  # update/reload: at-most-once
-                    or attempt >= self._retry.max_attempts
-                ):
-                    raise
-                prev_delay = self._retry.next_delay(prev_delay)
-                if deadline is not None:
-                    prev_delay = min(
-                        prev_delay, max(deadline.remaining(), 0.0)
-                    )
-                time.sleep(prev_delay)
-                self._m["retries"].inc()
-                continue
-            if self._breaker is not None:
-                self._breaker.record_success()
-            return result
+                if self._breaker is not None:
+                    self._breaker.record_success()
+                return result
+        except Exception as e:
+            call_error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._record_call_span(
+                op, trace_id, call_span_id, attempt,
+                time.perf_counter() - t_call0, call_error,
+            )
+
+    def _record_attempt_span(
+        self, op, trace_id, call_span_id, attempt, backoff_s, duration_s,
+        *, error,
+    ) -> None:
+        """One child span per transport attempt (parent: the call span)
+        — the satellite that makes retry storms visible: attempt index,
+        the backoff slept before this attempt, and what failed.  Spans
+        are observability: they never fail the call they observe."""
+        if self._trace_log is None:
+            return
+        from kubernetesclustercapacity_tpu.telemetry.tracing import (
+            new_span_id,
+        )
+
+        try:
+            self._trace_log.record(
+                ts=time.time(),
+                trace_id=trace_id,
+                span_id=new_span_id(),
+                parent_span_id=call_span_id,
+                op=f"{op}:attempt",
+                attempt=attempt,
+                backoff_ms=round(backoff_s * 1e3, 3),
+                duration_ms=round(duration_s * 1e3, 3),
+                status="error" if error else "ok",
+                **({"error": error} if error else {}),
+            )
+        except Exception:  # noqa: BLE001 - tracing must not fail calls
+            pass
+
+    def _record_call_span(
+        self, op, trace_id, call_span_id, attempts, duration_s, error
+    ) -> None:
+        """The call-level span the attempt spans parent to (its
+        ``attempts`` field is the retry count at a glance)."""
+        if self._trace_log is None:
+            return
+        try:
+            self._trace_log.record(
+                ts=time.time(),
+                trace_id=trace_id,
+                span_id=call_span_id,
+                op=f"client:{op}",
+                attempts=attempts,
+                duration_ms=round(duration_s * 1e3, 3),
+                status="error" if error else "ok",
+                **({"error": error} if error else {}),
+            )
+        except Exception:  # noqa: BLE001 - tracing must not fail calls
+            pass
 
     # Convenience wrappers -------------------------------------------------
     # (each forwards **kwargs through ``call``, so every wrapper accepts
@@ -357,6 +460,14 @@ class CapacityClient:
         return self.call("info", audit=True, **kw).get(
             "audit", {"enabled": False, "log": None, "shadow": None}
         )
+
+    def slo_status(self, **kw) -> dict:
+        """The server's SLO burn-rate status: every objective's
+        short/long-window burn rate, alert state
+        (ok/breached/recovered), and the fast-burning verdict.
+        ``{"enabled": false}``-shaped when the server runs without
+        ``-slo``."""
+        return self.call("slo", **kw)
 
     def timeline(self, since_generation: int | None = None,
                  watch: str | None = None, **kw) -> dict:

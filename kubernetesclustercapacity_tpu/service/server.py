@@ -106,6 +106,7 @@ class CapacityServer:
         request_log=None,
         audit_log=None,
         shadow=None,
+        slo=None,
     ) -> None:
         """``stats_source`` is an optional zero-arg callable returning a
         JSON-able dict of upstream-feed health (e.g.
@@ -157,7 +158,12 @@ class CapacityServer:
         records gain an ``audit_ref`` pointing at the request's audit
         record.  ``shadow`` (a :class:`~..audit.ShadowSampler`)
         re-checks a sampled fraction of sweep responses against the
-        pure-Python oracle off the request path."""
+        pure-Python oracle off the request path.
+
+        ``slo`` (a :class:`~..telemetry.slo.SLOMonitor`) evaluates
+        latency/availability objectives as multi-window error-budget
+        burn rates over this server's own request metrics, served by
+        the ``slo`` op (and, in ``main``, wired into ``/healthz``)."""
         import os
 
         from kubernetesclustercapacity_tpu.telemetry.flightrec import (
@@ -183,6 +189,7 @@ class CapacityServer:
         self._timeline = timeline
         self._audit = audit_log
         self._shadow = shadow
+        self._slo = slo
         m = self.registry
         self._m_requests = m.counter(
             "kccap_requests_total", "Requests dispatched, by op.", ("op",)
@@ -211,6 +218,20 @@ class CapacityServer:
         self._m_shed = m.counter(
             "kccap_deadline_shed_total",
             "Requests shed because their deadline had already expired.",
+        )
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            SUB_MS_LATENCY_BUCKETS_S,
+        )
+
+        # Per-phase latency decomposition of every dispatched request
+        # (telemetry/phases.py); sub-millisecond buckets — the default
+        # ladder's 0.5 ms floor would flatten every phase of a ~0.7 ms
+        # fused sweep into one bucket.
+        self._m_phase = m.histogram(
+            "kccap_phase_seconds",
+            "Per-request phase latency decomposition, by op and phase.",
+            ("op", "phase"),
+            buckets=SUB_MS_LATENCY_BUCKETS_S,
         )
         self._flight = FlightRecorder(flight_records)
         self._flight_dump_path = flight_dump_path
@@ -383,7 +404,7 @@ class CapacityServer:
         {
             "ping", "info", "fit", "sweep", "sweep_multi", "place",
             "drain", "topology_spread", "plan", "explain", "dump",
-            "timeline", "reload", "update",
+            "timeline", "slo", "reload", "update",
         }
     )
 
@@ -391,8 +412,22 @@ class CapacityServer:
         """Instrumented entry: count/time every request (by op), record
         a trace span when a log is wired, then route.  The caller's
         ``trace_id`` (an optional string riding the envelope like
-        ``deadline`` does) lands in the span record verbatim."""
+        ``deadline`` does) lands in the span record verbatim.
+
+        Every dispatch also activates a per-request
+        :class:`~..telemetry.phases.PhaseClock` (thread-local, so the
+        deep layers — slot wait, micro-batcher, device cache, kernel
+        wrappers — attribute their sub-intervals to THIS request); the
+        decomposition lands in ``kccap_phase_seconds{op,phase}``, as
+        child spans of the request's trace span, and as the flight
+        record's ``phases`` field.  ``KCCAP_TELEMETRY=0`` makes the
+        clock the no-op null singleton: zero allocations, zero phase
+        registry calls."""
         import time as _time
+
+        from kubernetesclustercapacity_tpu.telemetry import (
+            phases as _phases,
+        )
 
         op = msg.get("op")
         op_label = op if op in self._KNOWN_OPS else "unknown"
@@ -403,6 +438,8 @@ class CapacityServer:
             )
         self._m_requests.labels(op=op_label).inc()
         self._m_inflight.inc()
+        clk = _phases.new_clock()
+        prev_clk = _phases.activate(clk)
         t0 = _time.perf_counter()
         error: str | None = None
         result = None
@@ -414,9 +451,13 @@ class CapacityServer:
             error = f"{type(e).__name__}: {e}"
             raise
         finally:
+            _phases.restore(prev_clk)
             dur = _time.perf_counter() - t0
             self._m_inflight.dec()
             self._m_latency.labels(op=op_label).observe(dur)
+            phase_items = clk.items() if clk else ()
+            for ph, secs in phase_items:
+                self._m_phase.labels(op=op_label, phase=ph).observe(secs)
             # The generation that ANSWERED (captured under the dispatch
             # lock), shared by the flight record and the request log;
             # ops that never captured one (ping, shed requests) fall
@@ -444,6 +485,25 @@ class CapacityServer:
                         status="error" if error else "ok",
                         **({"error": error} if error else {}),
                     )
+                    # One child span per recorded phase, parented to the
+                    # request span — the decomposition in trace form, so
+                    # a trace viewer shows WHERE inside the dispatch the
+                    # time went (span_id still joins the request log).
+                    from kubernetesclustercapacity_tpu.telemetry.tracing import (  # noqa: E501
+                        new_span_id as _new_span_id,
+                    )
+
+                    for ph, secs in phase_items:
+                        self._trace_log.record(
+                            ts=_time.time(),
+                            trace_id=trace_id or "",
+                            span_id=_new_span_id(),
+                            parent_span_id=span_id,
+                            op=f"phase:{ph}",
+                            phase=ph,
+                            duration_ms=round(secs * 1e3, 3),
+                            status="ok",
+                        )
                 except Exception:  # noqa: BLE001 - tracing must not fail ops
                     pass
             if self._request_log is not None:
@@ -462,12 +522,13 @@ class CapacityServer:
                     pass
             audit_ref = self._audit_request(msg, op_label, gen, error, result)
             self._flight_record(
-                msg, op_label, trace_id, dur, error, result, gen, audit_ref
+                msg, op_label, trace_id, dur, error, result, gen, audit_ref,
+                phases=(clk.to_ms() if clk else None),
             )
 
     def _flight_record(
         self, msg, op_label, trace_id, dur, error, result, gen,
-        audit_ref=None,
+        audit_ref=None, phases=None,
     ) -> None:
         """One flight-recorder entry per dispatch (the failing request
         included), then — on error, when configured — the whole ring
@@ -488,6 +549,7 @@ class CapacityServer:
                 ),
                 error=error,
                 audit_ref=audit_ref,
+                phases=phases,
             )
             if error and self._flight_dump_path:
                 self._flight.dump_jsonl(self._flight_dump_path)
@@ -521,10 +583,20 @@ class CapacityServer:
             if deadline is not None:
                 wait_s = max(0.0, min(wait_s, deadline.remaining()))
             self._m_slot_wait.inc()
+            import time as _time
+
+            from kubernetesclustercapacity_tpu.telemetry import (
+                phases as _phases,
+            )
+
+            clk = _phases.current()
+            t0 = _time.perf_counter() if clk else 0.0
             try:
                 acquired = self._inflight.acquire(timeout=wait_s)
             finally:
                 self._m_slot_wait.dec()
+                if clk:
+                    clk.record("queue_wait", _time.perf_counter() - t0)
             if not acquired:
                 raise RuntimeError(
                     f"server busy: {self._max_inflight} compute requests "
@@ -678,6 +750,8 @@ class CapacityServer:
             return self._op_dump(msg)
         if op == "timeline":
             return self._op_timeline(msg)
+        if op == "slo":
+            return self._op_slo(msg)
         if op == "reload":
             return self._op_reload(msg, snap)
         if op == "update":
@@ -891,14 +965,26 @@ class CapacityServer:
                 )
             )
 
+        # Report rendering + list conversion is the fit op's serialize
+        # phase (host string/JSON work, no device involvement).
+        from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+
+        clk = _phases.current()
+        if clk:
+            import time as _time
+
+            t0 = _time.perf_counter()
         report = self._render_report(msg, snap, fits, scenario)
         total = int(fits.sum())
-        return {
+        out = {
             "total": total,
             "schedulable": total >= scenario.replicas,
             "fits": fits.tolist(),
             "report": report,
         }
+        if clk:
+            clk.record("serialize", _time.perf_counter() - t0)
+        return out
 
     @staticmethod
     def _render_report(msg: dict, snap: ClusterSnapshot, fits, scenario):
@@ -1192,6 +1278,17 @@ class CapacityServer:
             "generation": self.generation,
         }
 
+    def _op_slo(self, msg: dict) -> dict:
+        """SLO burn-rate status over the wire: every objective's current
+        short/long-window burn, alert state, and the fast-burning
+        verdict.  Evaluated ON READ (one fresh counter sample per
+        query), so a poller always sees current burn — the background
+        evaluator only exists for scrape-only deployments."""
+        if self._slo is None:
+            return {"enabled": False}
+        self._slo.evaluate()
+        return self._slo.wire()
+
     def _op_timeline(self, msg: dict) -> dict:
         """The capacity timeline over the wire: per-generation records,
         attributed deltas, and alert states — filtered server-side by
@@ -1292,6 +1389,26 @@ class CapacityServer:
         # dispatch).  A stale breaker error must never ride an
         # exact-kernel response — the breaker's standing state lives in
         # the info op instead.
+        from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+
+        clk = _phases.current()
+        if clk:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            out = {
+                "totals": totals.tolist(),
+                "schedulable": sched.tolist(),
+                "scenarios": grid.size,
+                "kernel": kernel,
+                **(
+                    {"fast_path_error": attempt_error}
+                    if attempted and attempt_error
+                    else {}
+                ),
+            }
+            clk.record("serialize", _time.perf_counter() - t0)
+            return out
         return {
             "totals": totals.tolist(),
             "schedulable": sched.tolist(),
@@ -1726,6 +1843,25 @@ def main(argv=None) -> int:
                         "JSONL to PATH (default: "
                         "<audit-dir>/shadow-divergence.jsonl when "
                         "-audit-dir is set)")
+    p.add_argument("-slo", default=None, metavar="FILE",
+                   help="SLO file (YAML/JSON): latency objectives "
+                        "('p99 < 80ms', per op or all ops) and "
+                        "availability objectives ('99.9%%') evaluated "
+                        "as multi-window error-budget burn rates over "
+                        "the server's own request metrics; a fast burn "
+                        "flips /healthz to 503 and the kccap_slo_* "
+                        "gauges (enables the slo op / kccap "
+                        "-slo-status)")
+    p.add_argument("-slo-log", default=None, dest="slo_log",
+                   metavar="PATH",
+                   help="append one JSONL line per SLO alert "
+                        "transition (ok→breached→recovered) to PATH")
+    p.add_argument("-slo-eval-s", type=float, default=5.0,
+                   dest="slo_eval_s", metavar="SECONDS",
+                   help="background SLO evaluation cadence (keeps the "
+                        "burn-rate gauges fresh for scrapers that "
+                        "never issue the slo op; the slo op and "
+                        "/healthz also evaluate on read)")
     args = p.parse_args(argv)
 
     import os as _os
@@ -1858,6 +1994,25 @@ def main(argv=None) -> int:
             if follower is not None:
                 follower.stop()
             return 1
+    slo_monitor = None
+    if args.slo:
+        from kubernetesclustercapacity_tpu.telemetry.slo import (
+            SLOError,
+            SLOMonitor,
+            load_slos,
+        )
+
+        try:
+            slo_monitor = SLOMonitor(
+                load_slos(args.slo),
+                registry=REGISTRY,
+                log=args.slo_log,
+            ).start(max(args.slo_eval_s, 0.5))
+        except (OSError, SLOError) as e:
+            print(f"ERROR : bad SLO file: {e}", file=sys.stderr)
+            if follower is not None:
+                follower.stop()
+            return 1
     server = CapacityServer(
         snap, host=args.host, port=args.port, fixture=fixture,
         auth_token=auth_token, max_inflight=args.max_inflight,
@@ -1875,6 +2030,7 @@ def main(argv=None) -> int:
         request_log=request_log,
         audit_log=audit_log,
         shadow=shadow,
+        slo=slo_monitor,
     )
     metrics_server = None
     coalescer_ref: list = []  # filled below; healthz closes over it
@@ -1908,16 +2064,25 @@ def main(argv=None) -> int:
                 # The parity story: a diverged shadow oracle is a
                 # correctness incident, and the scraper must see it.
                 out["shadow"] = shadow.stats()
+            if slo_monitor is not None:
+                # The latency/availability story: which objectives are
+                # burning budget right now — evaluated on read so the
+                # probe never reports a stale verdict.
+                slo_monitor.evaluate()
+                out["slo"] = slo_monitor.stats()
             return out
 
         def _overall_healthy() -> bool:
             # /healthz goes 503 the moment the feed is known-dead OR
-            # the shadow oracle caught the kernels lying: a frozen
-            # snapshot and a wrong answer are equally unacceptable to
-            # keep serving silently.
+            # the shadow oracle caught the kernels lying OR an SLO is
+            # fast-burning: a frozen snapshot, a wrong answer, and a
+            # service missing its latency objective are all things a
+            # load balancer must route around, not discover later.
             if follower is not None and follower.fatal is not None:
                 return False
             if shadow is not None and shadow.diverged:
+                return False
+            if slo_monitor is not None and slo_monitor.fast_burning:
                 return False
             return True
 
@@ -1928,7 +2093,11 @@ def main(argv=None) -> int:
                 port=args.metrics_port,
                 healthy=(
                     _overall_healthy
-                    if (follower is not None or shadow is not None)
+                    if (
+                        follower is not None
+                        or shadow is not None
+                        or slo_monitor is not None
+                    )
                     else None
                 ),
                 status=_healthz_status,
@@ -2022,6 +2191,8 @@ def main(argv=None) -> int:
             metrics_server.shutdown()
         if timeline is not None:
             timeline.close()  # flush the -timeline-log JSONL
+        if slo_monitor is not None:
+            slo_monitor.close()  # stop the evaluator, flush -slo-log
         if shadow is not None:
             shadow.close()
         if audit_log is not None:

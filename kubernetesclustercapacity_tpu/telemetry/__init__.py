@@ -24,6 +24,7 @@ switched off entirely (``KCCAP_TELEMETRY=0``).
 from kubernetesclustercapacity_tpu.telemetry.metrics import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS_S,
     REGISTRY,
+    SUB_MS_LATENCY_BUCKETS_S,
     Counter,
     Gauge,
     Histogram,
@@ -50,3 +51,14 @@ from kubernetesclustercapacity_tpu.telemetry.compilewatch import (  # noqa: F401
     observe_dispatch,
     seen_kernels,
 )
+from kubernetesclustercapacity_tpu.telemetry.phases import (  # noqa: F401
+    NULL_CLOCK,
+    PHASES,
+    PhaseClock,
+    new_clock,
+)
+
+# NOTE: .slo is a deliberate non-export — it rides the timeline/explain
+# stack (alert machine, kernels) and must not load on every telemetry
+# import; consumers import kubernetesclustercapacity_tpu.telemetry.slo
+# directly.

@@ -27,7 +27,10 @@ from __future__ import annotations
 
 import threading
 
-from kubernetesclustercapacity_tpu.telemetry.metrics import enabled
+from kubernetesclustercapacity_tpu.telemetry.metrics import (
+    SUB_MS_LATENCY_BUCKETS_S,
+    enabled,
+)
 
 __all__ = ["observe_dispatch", "seen_kernels", "reset"]
 
@@ -58,6 +61,10 @@ def _metrics() -> dict:
                 "Host-timed steady-state (post-compile) dispatch "
                 "latency, by kernel.",
                 ("kernel",),
+                # Sub-ms ladder (metrics.SUB_MS_LATENCY_BUCKETS_S): the
+                # fixed default buckets flatten a ~0.7 ms fused dispatch
+                # into one bin, making steady-state p50/p99 useless.
+                buckets=SUB_MS_LATENCY_BUCKETS_S,
             ),
         }
     return _MET

@@ -85,11 +85,15 @@ class FlightRecorder:
         error: str | None = None,
         ts: float | None = None,
         audit_ref: str | None = None,
+        phases: dict | None = None,
     ) -> None:
         """``audit_ref`` — the ``segment:offset`` pointer into the
         server's audit log for this same request (when auditing is on),
         so a ``dump`` record pastes straight into ``kccap -replay
-        DIR -replay-ref REF``."""
+        DIR -replay-ref REF``.  ``phases`` — the request's per-phase
+        latency decomposition (``{phase: ms}``, the
+        :class:`~.phases.PhaseClock`'s compact form), so a slow request
+        pasted from a dump is self-explaining."""
         rec = {
             "seq": 0,  # assigned under the lock
             "ts": time.time() if ts is None else ts,
@@ -105,6 +109,10 @@ class FlightRecorder:
             rec["error"] = error
         if audit_ref:
             rec["audit_ref"] = audit_ref
+        if phases:
+            rec["phases"] = {
+                str(k): round(float(v), 3) for k, v in phases.items()
+            }
         with self._lock:
             self._seq += 1
             rec["seq"] = self._seq

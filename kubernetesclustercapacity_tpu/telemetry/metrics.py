@@ -39,6 +39,7 @@ __all__ = [
     "MetricsRegistry",
     "MetricsError",
     "DEFAULT_LATENCY_BUCKETS_S",
+    "SUB_MS_LATENCY_BUCKETS_S",
     "REGISTRY",
     "enabled",
 ]
@@ -49,6 +50,17 @@ __all__ = [
 #: dispatch is still binned, then +Inf (implicit).
 DEFAULT_LATENCY_BUCKETS_S = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+#: Fine-grained buckets for kernel/phase histograms.  The default
+#: ladder's first boundary is 0.5 ms, which flattens the ~0.7 ms
+#: fused-path p50 (and every sub-phase of it) into one bucket — useless
+#: for phase p50/p99 estimation.  This ladder resolves 10 µs – 1 ms in
+#: sub-bucket steps and still reaches 10 s so a wedged phase bins.
+SUB_MS_LATENCY_BUCKETS_S = (
+    0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005, 0.00075,
+    0.001, 0.0015, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
@@ -269,6 +281,20 @@ class _HistogramChild:
             return self._sum
 
 
+def _normalize_buckets(buckets) -> tuple:
+    """Canonical bucket tuple: sorted floats, dupes rejected, the
+    implicit ``+Inf`` stripped (rendered from count; storing it would
+    double-book every observation)."""
+    buckets = tuple(sorted(float(b) for b in buckets))
+    if not buckets:
+        raise MetricsError("histogram needs at least one bucket")
+    if buckets != tuple(dict.fromkeys(buckets)):
+        raise MetricsError(f"duplicate buckets in {buckets}")
+    if buckets[-1] == float("inf"):
+        buckets = buckets[:-1]
+    return buckets
+
+
 class Histogram(_Family):
     type = "histogram"
 
@@ -280,18 +306,9 @@ class Histogram(_Family):
         buckets=DEFAULT_LATENCY_BUCKETS_S,
     ) -> None:
         super().__init__(name, help, labelnames)
-        buckets = tuple(sorted(float(b) for b in buckets))
-        if not buckets:
-            raise MetricsError("histogram needs at least one bucket")
-        if buckets != tuple(dict.fromkeys(buckets)):
-            raise MetricsError(f"duplicate buckets in {buckets}")
-        # +Inf is implicit (rendered from count); storing it would just
-        # double-book every observation.
-        if buckets and buckets[-1] == float("inf"):
-            buckets = buckets[:-1]
         if "le" in self.labelnames:
             raise MetricsError("'le' is reserved for histogram buckets")
-        self.buckets = buckets
+        self.buckets = _normalize_buckets(buckets)
 
     def _make_child(self) -> _HistogramChild:
         return _HistogramChild(self._lock, self.buckets)
@@ -324,6 +341,18 @@ class MetricsRegistry:
                         f"{existing.type}{existing.labelnames}, cannot "
                         f"re-register as {cls.type}{labelnames}"
                     )
+                if isinstance(existing, Histogram) and "buckets" in kw:
+                    # Custom bucket boundaries are part of the metric's
+                    # meaning: two subsystems silently sharing a name
+                    # with different ladders would make every p50/p99
+                    # estimate a lie about one of them.
+                    wanted = _normalize_buckets(kw["buckets"])
+                    if wanted != existing.buckets:
+                        raise MetricsError(
+                            f"histogram {name!r} already registered with "
+                            f"buckets {existing.buckets}, cannot "
+                            f"re-register with {wanted}"
+                        )
                 return existing
             fam = cls(name, help, labelnames, **kw)
             self._families[name] = fam

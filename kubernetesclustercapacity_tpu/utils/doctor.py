@@ -371,6 +371,74 @@ def doctor_report(
 
         check("audit & shadow", _audit_shadow)
 
+        # The service's own latency + SLO burn-rate state: p50/p99 of
+        # its request-latency histogram (estimated from the scrape's
+        # buckets) and every -slo objective's alert state.  A breached
+        # objective is a hard FAILED line — the service is burning its
+        # error budget faster than the page threshold RIGHT NOW.
+        def _latency_slo():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+            from kubernetesclustercapacity_tpu.telemetry.slo import (
+                estimate_quantile,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                slo = c.slo_status()
+                info = c.info(metrics=True)
+            parts = []
+            lat = (
+                info.get("metrics", {})
+                .get("kccap_request_latency_seconds", {})
+                .get("values", {})
+            )
+            # Pool every op's buckets into one overall latency estimate
+            # (cumulative dicts share boundaries by construction).
+            pooled: dict[str, int] = {}
+            count = 0
+            for hist in lat.values():
+                count += hist.get("count", 0)
+                for le, cum in hist.get("buckets", {}).items():
+                    pooled[le] = pooled.get(le, 0) + cum
+            if count:
+                p50 = estimate_quantile(pooled, count, 0.50)
+                p99 = estimate_quantile(pooled, count, 0.99)
+                parts.append(
+                    f"latency p50={p50 * 1e3:.1f}ms "
+                    f"p99={p99 * 1e3:.1f}ms over {count} request(s)"
+                )
+            if not slo.get("enabled", False):
+                parts.append("slo: not configured (-slo off)")
+                return "ok: " + " ".join(parts)
+            states = []
+            breached = []
+            for name in sorted(slo.get("status", {})):
+                s = slo["status"][name]
+                states.append(f"{name}={s['state']}")
+                if s["state"] == "breached":
+                    breached.append(
+                        f"{name} ({s['objective']}, "
+                        f"short={s['short_burn']:.1f}x "
+                        f"long={s['long_burn']:.1f}x)"
+                    )
+            parts.append("slo: " + " ".join(states))
+            if breached:
+                return (
+                    "FAILED: error budget fast-burning — "
+                    + "; ".join(breached) + "; " + " ".join(parts)
+                )
+            return "ok: " + " ".join(parts)
+
+        check("latency & SLO", _latency_slo)
+
         # The service's flight recorder: its last-K request history over
         # the dump op — one line of "what was this server just doing"
         # before anyone attaches a debugger.  Same short budgets as the

@@ -1,10 +1,13 @@
 """Metric-name conformance: every metric registered anywhere in the
-package is ``kccap_``-prefixed snake_case AND documented in the README.
+package is ``kccap_``-prefixed snake_case AND documented in the README;
+every PHASE name recorded anywhere is in the fixed vocabulary AND in
+the README's phase table.
 
-The scan is textual (every ``"kccap_..."`` string literal in the
-package sources) so a metric cannot dodge the check by being registered
-from a module no test imports.  README documentation accepts the
-table's glob/alternation shorthand (``kccap_client_*_total``,
+The scan is textual (every ``"kccap_..."`` string literal / every
+``.record("...")`` / ``.phase("...")`` call in the package sources) so
+a metric or phase cannot dodge the check by being registered from a
+module no test imports.  README documentation accepts the table's
+glob/alternation shorthand (``kccap_client_*_total``,
 ``kccap_fused_path_{hits,misses,failures}_total``) — the point is that
 an operator grepping the README finds every name a scrape can emit.
 """
@@ -21,6 +24,18 @@ _README = os.path.join(_REPO, "README.md")
 _NAME_RE = re.compile(r"""["'](kccap_[A-Za-z0-9_]+)["']""")
 _SNAKE_RE = re.compile(r"kccap_[a-z0-9]+(_[a-z0-9]+)*")
 _DOC_TOKEN_RE = re.compile(r"kccap_[A-Za-z0-9_*{},|]+")
+
+# Phase-clock call sites: clk.record("name", dt) / clk.phase("name") /
+# clk.move("a", "b").  The string-literal-first-positional shape is
+# unique to the phase clock in this package (TraceLog/FlightRecorder/
+# audit records are keyword-only), so the textual walk finds every
+# emitted phase name without importing anything.
+_PHASE_CALL_RE = re.compile(
+    r"""\.(?:record|phase)\(\s*["']([A-Za-z0-9_]+)["']"""
+)
+_PHASE_MOVE_RE = re.compile(
+    r"""\.move\(\s*["']([A-Za-z0-9_]+)["']\s*,\s*["']([A-Za-z0-9_]+)["']"""
+)
 
 
 def _source_metric_names() -> set[str]:
@@ -104,3 +119,60 @@ def test_every_metric_is_documented_in_readme():
             "metrics registered in the package but missing from the "
             "README observability table: " + ", ".join(undocumented)
         )
+
+
+def _source_phase_names() -> set[str]:
+    """Every phase name emitted anywhere in the package sources."""
+    names: set[str] = set()
+    for root, dirs, files in os.walk(_PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in files:
+            if not f.endswith(".py") or f in ("phases.py", "timing.py"):
+                # phases.py defines the vocabulary (its docstrings are
+                # not emission sites); utils/timing.py's PhaseTimer is
+                # the generic bench stopwatch, a different namespace.
+                continue
+            with open(os.path.join(root, f), encoding="utf-8") as fh:
+                text = fh.read()
+            for m in _PHASE_CALL_RE.finditer(text):
+                names.add(m.group(1))
+            for m in _PHASE_MOVE_RE.finditer(text):
+                names.add(m.group(1))
+                names.add(m.group(2))
+    return names
+
+
+def test_phase_scan_finds_the_dispatch_sites():
+    # Sanity: a broken scan must fail loudly, not vacuously pass — the
+    # server records queue_wait/serialize, the batcher batch_wait, the
+    # kernel wrappers device_exec/fetch.
+    names = _source_phase_names()
+    assert {"queue_wait", "batch_wait", "device_exec", "fetch"} <= names
+
+
+def test_every_emitted_phase_is_in_the_vocabulary():
+    from kubernetesclustercapacity_tpu.telemetry.phases import PHASES
+
+    rogue = sorted(_source_phase_names() - set(PHASES))
+    assert not rogue, (
+        "phase names emitted outside the fixed vocabulary "
+        f"(telemetry/phases.PHASES): {rogue}"
+    )
+
+
+def test_phase_vocabulary_is_snake_case_and_in_readme():
+    from kubernetesclustercapacity_tpu.telemetry.phases import PHASES
+
+    snake = re.compile(r"^[a-z0-9]+(_[a-z0-9]+)*$")
+    bad = [p for p in PHASES if not snake.fullmatch(p)]
+    assert not bad, f"phase names must be snake_case: {bad}"
+    with open(_README, encoding="utf-8") as fh:
+        readme = fh.read()
+    missing = [
+        p for p in PHASES
+        if not re.search(rf"`{re.escape(p)}`", readme)
+    ]
+    assert not missing, (
+        "phases missing from the README's phase table: "
+        + ", ".join(missing)
+    )

@@ -1,0 +1,587 @@
+"""SLO burn-rate engine: spec grammar, the pure window math pinned
+against a numpy oracle, the registry counter source, monitor alert
+transitions + gauges + JSONL, the KCCAP_TELEMETRY=0 pin, and the
+end-to-end acceptance scenario — a fault-proxy-stalled service burns
+its availability budget and transitions ok→breached→recovered through
+gauges, /healthz, doctor, and the kccap -slo-status exit code."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import kubernetesclustercapacity_tpu as kcc
+from kubernetesclustercapacity_tpu.service.server import CapacityServer
+from kubernetesclustercapacity_tpu.telemetry.metrics import MetricsRegistry
+from kubernetesclustercapacity_tpu.telemetry.slo import (
+    SLOError,
+    SLOMonitor,
+    burn_rate,
+    estimate_quantile,
+    load_slos,
+    parse_slos,
+    registry_source,
+)
+
+
+def _spec(**over):
+    entry = {"name": "avail", "availability": 0.9}
+    entry.update(over)
+    return parse_slos([entry])[0]
+
+
+class TestGrammar:
+    def test_latency_objective_parses(self):
+        s = parse_slos(
+            {"slos": [{"name": "lat", "op": "sweep",
+                       "latency": "p99 < 80ms"}]}
+        )[0]
+        assert s.kind == "latency" and s.op == "sweep"
+        assert s.quantile == pytest.approx(0.99)
+        assert s.threshold_s == pytest.approx(0.08)
+        assert s.budget == pytest.approx(0.01)
+        assert s.objective == "p99 < 80ms"
+
+    def test_latency_seconds_unit_and_fractional_quantile(self):
+        s = parse_slos([{"name": "l", "latency": "p99.9 < 2s"}])[0]
+        assert s.threshold_s == pytest.approx(2.0)
+        assert s.budget == pytest.approx(0.001)
+
+    def test_availability_percent_and_fraction(self):
+        assert _spec(availability="99.9%").target == pytest.approx(0.999)
+        assert _spec(availability=0.95).target == pytest.approx(0.95)
+
+    def test_window_overrides_and_defaults(self):
+        s = _spec(short_window_s=5, long_window_s=50, fast_burn=3)
+        assert (s.short_window_s, s.long_window_s, s.fast_burn) == (
+            5.0, 50.0, 3.0,
+        )
+        d = _spec()
+        assert d.short_window_s == 60.0 and d.long_window_s == 600.0
+        assert d.fast_burn == 14.0
+
+    @pytest.mark.parametrize(
+        "entry,needle",
+        [
+            ({"availability": 0.9}, "'name'"),
+            ({"name": "x"}, "exactly one"),
+            ({"name": "x", "latency": "p99 < 80ms",
+              "availability": 0.9}, "exactly one"),
+            ({"name": "x", "latency": "99 < 80ms"}, "cannot parse"),
+            ({"name": "x", "latency": "p99 < -80ms"}, "cannot parse"),
+            ({"name": "x", "latency": "p0 < 80ms"}, "quantile"),
+            ({"name": "x", "availability": 1.5}, "between 0 and 1"),
+            ({"name": "x", "availability": "nope%"}, "bad availability"),
+            ({"name": "x", "availability": 0.9, "bogus": 1}, "unknown"),
+            ({"name": "x", "availability": 0.9,
+              "short_window_s": -1}, "positive"),
+            ({"name": "x", "availability": 0.9, "short_window_s": 600,
+              "long_window_s": 60}, "short_window_s must be <"),
+            ({"name": "x", "availability": 0.9, "op": ""}, "'op'"),
+        ],
+    )
+    def test_bad_entries_rejected(self, entry, needle):
+        with pytest.raises(SLOError, match=None) as ei:
+            parse_slos([entry])
+        assert needle in str(ei.value)
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(SLOError, match="duplicate"):
+            parse_slos([
+                {"name": "x", "availability": 0.9},
+                {"name": "x", "latency": "p99 < 80ms"},
+            ])
+
+    def test_empty_and_unknown_top_level_rejected(self):
+        with pytest.raises(SLOError):
+            parse_slos({"slos": []})
+        with pytest.raises(SLOError, match="unknown top-level"):
+            parse_slos({"slos": [{"name": "x", "availability": 0.9}],
+                        "extra": 1})
+
+    def test_load_slos_json_file(self, tmp_path):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps(
+            {"slos": [{"name": "a", "availability": "99%"}]}
+        ))
+        specs = load_slos(str(p))
+        assert [s.name for s in specs] == ["a"]
+
+
+def _oracle_burn(ts, tot, bad, *, now, window_s, budget):
+    """Independent numpy implementation of the burn-rate definition:
+    head = newest sample at/before now; baseline = newest sample
+    at/before the window start, else the oldest in-history sample."""
+    ts = np.asarray(ts, dtype=float)
+    tot = np.asarray(tot, dtype=float)
+    bad = np.asarray(bad, dtype=float)
+    in_hist = np.flatnonzero(ts <= now)
+    if in_hist.size == 0:
+        return None
+    head = in_hist.max()
+    at_or_before_start = np.flatnonzero(ts <= now - window_s)
+    base = (
+        at_or_before_start.max()
+        if at_or_before_start.size
+        else in_hist.min()
+    )
+    if head == base:
+        return None
+    d_total = tot[head] - tot[base]
+    d_bad = bad[head] - bad[base]
+    if d_total <= 0:
+        return 0.0
+    return (d_bad / d_total) / budget
+
+
+class TestBurnRateOracle:
+    def test_simple_window(self):
+        samples = [(0, 0, 0), (30, 100, 50), (60, 200, 100)]
+        assert burn_rate(
+            samples, now=60, window_s=60, budget=0.01
+        ) == pytest.approx(50.0)
+
+    def test_partial_history_uses_oldest(self):
+        samples = [(100, 10, 0), (110, 20, 5)]
+        # window start 110-600 < first ts: partial-window fallback.
+        assert burn_rate(
+            samples, now=110, window_s=600, budget=0.1
+        ) == pytest.approx((5 / 10) / 0.1)
+
+    def test_no_traffic_is_zero_not_none(self):
+        samples = [(0, 10, 1), (30, 10, 1)]
+        assert burn_rate(samples, now=30, window_s=60, budget=0.1) == 0.0
+
+    def test_single_sample_is_none(self):
+        assert burn_rate([(0, 1, 0)], now=10, window_s=5,
+                         budget=0.1) is None
+        assert burn_rate([], now=10, window_s=5, budget=0.1) is None
+
+    def test_future_samples_ignored(self):
+        samples = [(0, 0, 0), (10, 100, 0), (999, 10**6, 10**6)]
+        assert burn_rate(
+            samples, now=10, window_s=20, budget=0.5
+        ) == 0.0
+
+    def test_bad_budget_rejected(self):
+        with pytest.raises(SLOError):
+            burn_rate([(0, 0, 0)], now=1, window_s=1, budget=0.0)
+
+    def test_property_random_series_match_numpy_oracle(self):
+        # 200 random synthetic cumulative counter series × random
+        # windows: the pure-python window math must agree with the
+        # independent numpy implementation exactly.
+        rng = np.random.default_rng(4242)
+        for trial in range(200):
+            n = int(rng.integers(1, 40))
+            ts = np.sort(rng.uniform(0, 1000, size=n))
+            d_tot = rng.integers(0, 50, size=n)
+            frac = rng.uniform(0, 1, size=n)
+            d_bad = np.floor(d_tot * frac).astype(int)
+            tot = np.cumsum(d_tot)
+            bad = np.cumsum(d_bad)
+            samples = list(zip(ts.tolist(), tot.tolist(), bad.tolist()))
+            now = float(rng.uniform(-50, 1100))
+            window_s = float(rng.uniform(1, 800))
+            budget = float(rng.uniform(0.001, 0.5))
+            got = burn_rate(
+                samples, now=now, window_s=window_s, budget=budget
+            )
+            want = _oracle_burn(
+                ts, tot, bad, now=now, window_s=window_s, budget=budget
+            )
+            if want is None:
+                assert got is None, (trial, got)
+            else:
+                assert got == pytest.approx(want), (trial, got, want)
+
+
+class TestEstimateQuantile:
+    def test_interpolates_inside_the_bucket(self):
+        buckets = {"0.1": 50, "0.2": 100, "+Inf": 100}
+        assert estimate_quantile(buckets, 100, 0.5) == pytest.approx(0.1)
+        assert estimate_quantile(buckets, 100, 0.75) == pytest.approx(
+            0.15
+        )
+
+    def test_empty_histogram_is_none(self):
+        assert estimate_quantile({}, 0, 0.5) is None
+
+    def test_inf_tail_clamps_to_last_finite(self):
+        buckets = {"0.1": 0, "+Inf": 10}
+        assert estimate_quantile(buckets, 10, 0.5) == pytest.approx(0.1)
+
+
+class TestRegistrySource:
+    def test_latency_violations_from_buckets(self):
+        reg = MetricsRegistry()
+        read = registry_source(reg)
+        lat = reg.histogram(
+            "kccap_request_latency_seconds",
+            "End-to-end dispatch latency, by op.",
+            ("op",),
+        )
+        for v in (0.01, 0.05, 0.2, 0.3, 0.05):
+            lat.observe(v, op="sweep")
+        lat.observe(5.0, op="fit")
+        spec = parse_slos(
+            [{"name": "l", "op": "sweep", "latency": "p90 < 100ms"}]
+        )[0]
+        total, bad = read(spec)
+        assert (total, bad) == (5, 2)  # 0.2 and 0.3 are above 0.1
+        all_ops = parse_slos([{"name": "l2", "latency": "p90 < 100ms"}])[0]
+        total, bad = read(all_ops)
+        assert (total, bad) == (6, 3)
+
+    def test_availability_counts_errors_and_sheds(self):
+        reg = MetricsRegistry()
+        read = registry_source(reg)
+        req = reg.counter("kccap_requests_total", "", ("op",))
+        err = reg.counter(
+            "kccap_request_errors_total", "", ("op", "error")
+        )
+        shed = reg.counter("kccap_deadline_shed_total", "")
+        req.inc(10, op="sweep")
+        req.inc(5, op="fit")
+        err.inc(2, op="sweep", error="ValueError")
+        err.inc(1, op="fit", error="RuntimeError")
+        shed.inc(3)
+        spec = _spec()
+        assert read(spec) == (15, 6)
+        sweep_only = _spec(name="s", op="sweep")
+        assert read(sweep_only) == (10, 5)  # 2 errors + 3 sheds
+
+
+def _mono_series(values):
+    """An injected source yielding successive (total, bad) samples."""
+    it = iter(values)
+    last = {"v": (0, 0)}
+
+    def read(_spec):
+        try:
+            last["v"] = next(it)
+        except StopIteration:
+            pass
+        return last["v"]
+
+    return read
+
+
+class TestMonitor:
+    def test_transitions_ok_breached_recovered(self, tmp_path):
+        spec = _spec(short_window_s=10, long_window_s=100, fast_burn=2)
+        clock = {"t": 0.0}
+        # totals advance 100/step; bad: none, then a storm, then clean.
+        series = [
+            (100, 0), (200, 0),
+            (300, 80), (400, 160),
+            (500, 160), (600, 160), (700, 160),
+        ]
+        log = tmp_path / "slo.jsonl"
+        mon = SLOMonitor(
+            [spec], source=_mono_series(series),
+            registry=MetricsRegistry(), log=str(log),
+            time_fn=lambda: clock["t"],
+        )
+        states = []
+        for _ in series:
+            out = mon.evaluate()
+            states.append(out["avail"]["state"])
+            clock["t"] += 5.0
+        # budget 0.1, storm bad fraction 0.8 → burn 8 > 2 on both
+        # windows → breached; clean traffic drains the short window →
+        # recovered (distinguishable from ok on purpose).
+        assert states[0] == "ok" and states[1] == "ok"
+        assert "breached" in states
+        assert states[-1] == "recovered"
+        assert mon.fast_burning is False
+        st = mon.status()["avail"]
+        assert st["breaches"] == 1 and st["recoveries"] == 1
+        mon.close()
+        lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+        assert [ln["transition"] for ln in lines] == [
+            "breached", "recovered",
+        ]
+        assert all(ln["kind"] == "slo_alert" for ln in lines)
+
+    def test_gauges_and_breach_counter(self):
+        spec = _spec(short_window_s=10, long_window_s=100, fast_burn=2)
+        reg = MetricsRegistry()
+        clock = {"t": 0.0}
+        mon = SLOMonitor(
+            [spec], source=_mono_series([(100, 0), (200, 100)]),
+            registry=reg, time_fn=lambda: clock["t"],
+        )
+        mon.evaluate()
+        clock["t"] = 5.0
+        mon.evaluate()
+        s = reg.snapshot()
+        assert s["kccap_slo_alert_state"]["values"]['slo="avail"'] == 2
+        assert (
+            s["kccap_slo_burn_rate"]["values"]['slo="avail",window="short"']
+            == pytest.approx(10.0)
+        )
+        assert s["kccap_slo_breaches_total"]["values"]['slo="avail"'] == 1
+        assert mon.fast_burning
+        assert mon.wire()["fast_burning"] is True
+        assert mon.stats()["breached"] == ["avail"]
+        mon.close()
+
+    def test_burn_on_only_one_window_does_not_breach(self):
+        # Long window healthy (a deep good-traffic history), short
+        # window spiking: no page — the multi-window AND is the
+        # false-positive filter.
+        spec = _spec(short_window_s=10, long_window_s=1000, fast_burn=2)
+        clock = {"t": 0.0}
+        series = [(10_000, 0), (10_200, 0), (10_400, 0), (10_500, 90)]
+        mon = SLOMonitor(
+            [spec], source=_mono_series(series),
+            registry=MetricsRegistry(), time_fn=lambda: clock["t"],
+        )
+        out = None
+        for _ in series:
+            out = mon.evaluate()
+            clock["t"] += 5.0
+        # At the last eval: short-window baseline is the t=5 sample
+        # (300 requests, 90 bad → burn 3); the long window reaches back
+        # to t=0 (500 requests, 90 bad → burn 1.8 < 2): state holds ok.
+        assert out["avail"]["short_burn"] > 2
+        assert out["avail"]["long_burn"] < 2
+        assert out["avail"]["state"] == "ok"
+        mon.close()
+
+    def test_disabled_telemetry_makes_zero_registry_calls(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        reg = MetricsRegistry()
+        mon = SLOMonitor(
+            [_spec(short_window_s=1, long_window_s=10, fast_burn=1)],
+            source=_mono_series([(10, 0), (20, 10)]),
+            registry=reg,
+        )
+        mon.evaluate()
+        mon.evaluate()
+        assert reg.snapshot() == {}  # not even family registration
+        mon.close()
+
+    def test_monitor_needs_specs_and_a_source(self):
+        with pytest.raises(SLOError):
+            SLOMonitor([], registry=MetricsRegistry())
+        with pytest.raises(SLOError):
+            SLOMonitor([_spec()])
+
+
+def _mib(n):
+    return n * 1024 * 1024
+
+
+class TestEndToEnd:
+    """The acceptance scenario: a latency/availability objective
+    violated by a stalled (fault-proxy) service transitions
+    ok→breached→recovered through gauges, /healthz, doctor, and the
+    kccap -slo-status exit code."""
+
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        from kubernetesclustercapacity_tpu.telemetry.exposition import (
+            start_metrics_server,
+        )
+
+        snap = kcc.synthetic_snapshot(24, seed=31)
+        reg = MetricsRegistry()
+        specs = parse_slos([
+            {
+                "name": "availability",
+                "availability": 0.9,
+                "short_window_s": 0.4,
+                "long_window_s": 30,
+                "fast_burn": 1.5,
+            }
+        ])
+        mon = SLOMonitor(specs, registry=reg)
+        srv = CapacityServer(snap, port=0, registry=reg, slo=mon)
+        srv.start()
+
+        # The same /healthz wiring server.main() builds.
+        def _status():
+            mon.evaluate()
+            return {"slo": mon.stats()}
+
+        metrics = start_metrics_server(
+            reg, port=0,
+            healthy=lambda: not mon.fast_burning,
+            status=_status,
+        )
+        try:
+            yield srv, mon, reg, metrics
+        finally:
+            metrics.shutdown()
+            mon.close()
+            srv.shutdown()
+
+    def _healthz(self, metrics):
+        url = f"http://{metrics.address[0]}:{metrics.address[1]}/healthz"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_breach_and_recovery_on_every_surface(self, stack, capsys):
+        from kubernetesclustercapacity_tpu.cli import main as cli_main
+        from kubernetesclustercapacity_tpu.resilience import Deadline
+        from kubernetesclustercapacity_tpu.service.client import (
+            CapacityClient,
+        )
+        from kubernetesclustercapacity_tpu.testing_faults import (
+            FaultPlan,
+            FaultProxy,
+        )
+        from kubernetesclustercapacity_tpu.utils.doctor import doctor_report
+
+        srv, mon, reg, metrics = stack
+        host, port = srv.address
+        addr = f"{host}:{port}"
+
+        # --- phase 1: healthy traffic → ok everywhere.
+        with CapacityClient(host, port) as c:
+            for _ in range(8):
+                c.ping()
+        mon.evaluate()
+        time.sleep(0.05)
+        mon.evaluate()
+        assert not mon.fast_burning
+        code, body = self._healthz(metrics)
+        assert code == 200 and body["slo"]["breached"] == []
+        assert cli_main(["-slo-status", addr]) == 0
+        out = capsys.readouterr().out
+        assert "verdict: ok" in out
+
+        # --- phase 2: the stalled path — a fault proxy stalls every
+        # frame past the caller's deadline, so the server sheds each
+        # request on arrival (kccap_deadline_shed_total) — the
+        # availability objective's budget burns.
+        n_bad = 6
+        proxy = FaultProxy(
+            srv.address, FaultPlan(["stall"] * n_bad), stall_s=0.25
+        )
+        proxy.start()
+        try:
+            from kubernetesclustercapacity_tpu.resilience import (
+                RetryPolicy,
+            )
+
+            with CapacityClient(
+                *proxy.address,
+                retry=RetryPolicy(max_attempts=1, base_delay_s=0.01),
+                deadline_s=0.1,
+                timeout_s=2.0,
+            ) as c:
+                for _ in range(n_bad):
+                    with pytest.raises(Exception):
+                        c.ping()
+                    time.sleep(0.02)
+        finally:
+            time.sleep(0.4)  # let the stalled frames reach the server
+            proxy.stop()
+        mon.evaluate()
+        time.sleep(0.05)
+        mon.evaluate()
+        assert mon.fast_burning, mon.status()
+        s = reg.snapshot()
+        assert (
+            s["kccap_slo_alert_state"]["values"]['slo="availability"'] == 2
+        )
+        code, body = self._healthz(metrics)
+        assert code == 503
+        assert body["slo"]["breached"] == ["availability"]
+        assert cli_main(["-slo-status", addr]) == 1
+        out = capsys.readouterr().out
+        assert "FAST BURN" in out and "breached" in out
+        # Doctor: the "latency & SLO" line is a hard FAILED.
+        checks = doctor_report(
+            backend_timeout_s=10.0,
+            probe_code="print('DEVICES 0s D x1')",
+            service_addr=(host, port),
+        )
+        by_name = dict(checks)
+        assert "latency & SLO" in by_name
+        assert by_name["latency & SLO"].startswith("FAILED"), by_name
+        assert "fast-burning" in by_name["latency & SLO"]
+
+        # --- phase 3: recovery — clean traffic, the short window
+        # drains, the machine recovers (NOT ok: "it dipped" is the
+        # point of the state), /healthz flips back, exit code clears.
+        deadline_clear = time.time() + 10
+        with CapacityClient(host, port) as c:
+            while time.time() < deadline_clear:
+                for _ in range(4):
+                    c.ping()
+                mon.evaluate()
+                if not mon.fast_burning:
+                    break
+                time.sleep(0.1)
+        assert not mon.fast_burning, mon.status()
+        st = mon.status()["availability"]
+        assert st["state"] == "recovered" and st["breaches"] == 1
+        code, body = self._healthz(metrics)
+        assert code == 200
+        assert cli_main(["-slo-status", addr]) == 0
+        out = capsys.readouterr().out
+        assert "recovered" in out
+        checks = doctor_report(
+            backend_timeout_s=10.0,
+            probe_code="print('DEVICES 0s D x1')",
+            service_addr=(host, port),
+        )
+        line = dict(checks)["latency & SLO"]
+        assert line.startswith("ok:") and "availability=recovered" in line
+        assert "latency p50=" in line
+
+    def test_slo_op_disabled_shape(self):
+        snap = kcc.synthetic_snapshot(8, seed=32)
+        srv = CapacityServer(snap, port=0, registry=MetricsRegistry())
+        try:
+            assert srv.dispatch({"op": "slo"}) == {"enabled": False}
+        finally:
+            srv.shutdown()
+
+    def test_cli_slo_status_against_unconfigured_server(self, capsys):
+        from kubernetesclustercapacity_tpu.cli import main as cli_main
+
+        snap = kcc.synthetic_snapshot(8, seed=33)
+        srv = CapacityServer(snap, port=0, registry=MetricsRegistry())
+        srv.start()
+        try:
+            host, port = srv.address
+            assert cli_main(["-slo-status", f"{host}:{port}"]) == 1
+            assert "not enabled" in capsys.readouterr().out
+        finally:
+            srv.shutdown()
+
+    def test_server_main_rejects_bad_slo_file(self, tmp_path):
+        from kubernetesclustercapacity_tpu.service.server import (
+            main as server_main,
+        )
+
+        import os
+        import shutil
+
+        fixture = tmp_path / "f.json"
+        snap_path = tmp_path / "slo.json"
+        snap_path.write_text(json.dumps({"slos": [{"name": "x"}]}))
+        shutil.copy(
+            os.path.join(
+                os.path.dirname(__file__), "fixtures", "kind-3node.json"
+            ),
+            fixture,
+        )
+        rc = server_main(
+            ["-snapshot", str(fixture), "-slo", str(snap_path),
+             "-port", "0"]
+        )
+        assert rc == 1
