@@ -1,0 +1,49 @@
+"""``kccap-lint``: project-native static analysis.
+
+The invariants this package proves were previously only *dynamically*
+pinned — "``KCCAP_TELEMETRY=0`` means zero registry calls in jitted
+code" was a sampled property (a few tests import a few kernels), the
+thread-safety of the registry/cache/batcher/timeline classes was a
+convention, and the metric-name walk in ``tests/test_metric_names.py``
+was the lone *textual* conformance check.  Here the same invariants are
+theorems over the AST, checked on every tier-1 run:
+
+* **jit-purity** (:mod:`.rules_jit`) — an intra-package call graph
+  rooted at every ``jax.jit``/``pjit``/``pallas_call`` function proves
+  no telemetry-registry call, lock acquisition, I/O, ``time.*``/
+  ``random.*`` use, ``print``, bare-numpy-on-traced-array op or
+  ``float()/int()/bool()`` coercion of a traced value is reachable
+  from inside a jitted region.
+* **lock-discipline** (:mod:`.rules_locks`) — the guarded-field set of
+  each threaded class is inferred from its ``with self._lock:`` blocks,
+  and every read/write of a guarded field outside the lock is flagged.
+* **surface conformance** (:mod:`.rules_surface`) — every ``kccap_``
+  metric literal, ``KCCAP_*`` env var, server op and CLI flag must be
+  README-documented (and ops client-reachable): the generalized,
+  engine-native form of the metric-name walk.
+* **hygiene** (:mod:`.rules_hygiene`) — a pyflakes-lite unused-import
+  walk so the tree stays clean even where ``ruff`` is not installed.
+
+Everything is AST-based: the analyzer never imports the code under
+analysis, so a broken module cannot crash the lint and lint findings
+cannot depend on the host's backends.  Findings carry severity +
+``file:line``; ``# kccap: lint-ok[rule]`` suppresses inline, and a
+checked-in baseline (``LINT_BASELINE.json``) makes adoption
+incremental.  ``kccap-lint --json`` emits the machine-readable form.
+"""
+
+from kubernetesclustercapacity_tpu.analysis.engine import (
+    Analyzer,
+    AnalysisResult,
+    Baseline,
+    Finding,
+    Project,
+)
+
+__all__ = [
+    "Analyzer",
+    "AnalysisResult",
+    "Baseline",
+    "Finding",
+    "Project",
+]

@@ -1,0 +1,514 @@
+"""Intra-package call graph + jit-root discovery (AST only, no imports).
+
+The graph is deliberately *conservative in reachability* and *precise in
+resolution*: an edge exists only when a call or bare function reference
+resolves through the module's real import/def bindings (so ``time.time``
+shadowed by a local never edges to stdlib ``time``), but every resolved
+reference counts — including functions passed as values (``jax.vmap(f)``,
+``pl.pallas_call(make_kernel(...))``) — because inside a traced region a
+referenced function is as good as a called one.
+
+Jit roots are found three ways:
+
+* decorators — ``@jax.jit``, ``@partial(jax.jit, static_argnames=...)``,
+  ``@pjit``, with ``static_argnames`` captured so purity rules know which
+  parameters hold *concrete* (non-traced) values;
+* wrap calls — any ``jax.jit(...)`` / ``pjit(...)`` / ``pallas_call(...)``
+  call anywhere (module level included): every function referenced in its
+  arguments becomes a root (this catches ``jax.jit(checkify.checkify(f))``
+  and ``pl.pallas_call(make_kernel(...), ...)``);
+* nested defs of a root are reachable unconditionally (a def statement
+  executes at trace time, and closures like pallas kernel factories are
+  exactly the case that matters).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from kubernetesclustercapacity_tpu.analysis.engine import Project, SourceFile
+
+__all__ = ["CallGraph", "FunctionInfo", "Edge", "dotted"]
+
+#: Canonical dotted names that mean "this wraps its argument in jit".
+_JIT_WRAPPERS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+_JIT_WRAPPER_SUFFIXES = (".pallas_call",)
+
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute chain -> ``"a.b.c"``, else ``None``."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class Edge:
+    """A resolved intra-package reference from one function to another."""
+
+    target: str  # canonical qname
+    line: int
+    col: int
+    kind: str  # "call" | "ref" | "nested"
+
+
+@dataclass
+class FunctionInfo:
+    qname: str  # canonical dotted: module path + [Class.]name chain
+    module: str
+    src: SourceFile
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    cls: str | None = None
+    static_args: frozenset = frozenset()
+    jit_reasons: list[str] = field(default_factory=list)
+
+    @property
+    def is_jit_root(self) -> bool:
+        return bool(self.jit_reasons)
+
+    @property
+    def name(self) -> str:
+        return self.qname.rsplit(".", 1)[-1]
+
+
+class _ModuleIndex:
+    """Per-module bindings: imports, top-level defs, classes."""
+
+    def __init__(self, name: str, src: SourceFile, is_pkg: bool) -> None:
+        self.name = name
+        self.src = src
+        # The package relative imports resolve against.
+        self.package = name if is_pkg else name.rsplit(".", 1)[0]
+        self.imports: dict[str, str] = {}  # local alias -> dotted target
+        self.toplevel: dict[str, str] = {}  # local name -> canonical qname
+        self.class_methods: dict[str, dict[str, str]] = {}
+        self.class_bases: dict[str, list[str]] = {}
+
+    def add_imports(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.imports[alias.asname] = alias.name
+                    else:
+                        top = alias.name.split(".", 1)[0]
+                        self.imports[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    up = self.package.split(".")
+                    # level 1 = current package; each extra level pops one.
+                    up = up[: len(up) - (node.level - 1)]
+                    base = ".".join(up + ([node.module] if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports[local] = f"{base}.{alias.name}"
+
+    def resolve(self, name_path: str) -> str | None:
+        """Local dotted reference -> canonical dotted name (or None)."""
+        head, _, rest = name_path.partition(".")
+        if head in self.toplevel:
+            base = self.toplevel[head]
+        elif head in self.imports:
+            base = self.imports[head]
+        else:
+            return None
+        return f"{base}.{rest}" if rest else base
+
+
+class CallGraph:
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.functions: dict[str, FunctionInfo] = {}
+        self.edges: dict[str, list[Edge]] = {}
+        self.modules: dict[str, _ModuleIndex] = {}
+        self._class_inits: dict[str, str] = {}  # class qname -> __init__ qname
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def build(cls, project: Project) -> "CallGraph":
+        g = cls(project)
+        indexed: list[tuple[_ModuleIndex, SourceFile]] = []
+        for src in project.files:
+            mod_name = g._module_name(src)
+            idx = _ModuleIndex(
+                mod_name, src, is_pkg=src.rel_path.endswith("__init__.py")
+            )
+            idx.add_imports(src.tree)
+            g.modules[mod_name] = idx
+            g._collect_defs(idx, src.tree, prefix=mod_name, cls=None)
+            indexed.append((idx, src))
+        # Second pass: edges + jit roots need every module's defs known.
+        for idx, src in indexed:
+            g._scan_module(idx, src)
+        return g
+
+    def _module_name(self, src: SourceFile) -> str:
+        rel = src.rel_path
+        # rel is repo-root relative; strip down to package-relative.
+        pkg = self.project.package_name
+        parts = rel[: -len(".py")].split("/")
+        if pkg in parts:
+            parts = parts[parts.index(pkg) :]
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _own_defs(scope_node):
+        """Function/class defs belonging directly to this scope — defs
+        under if/try/with/loops included, defs inside nested defs or
+        classes excluded (those are their own scopes)."""
+        compound = (
+            ast.If, ast.For, ast.While, ast.With, ast.Try,
+            ast.AsyncFor, ast.AsyncWith,
+        )
+        stack = [scope_node]
+        while stack:
+            item = stack.pop()
+            for child in ast.iter_child_nodes(item):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    yield child
+                elif isinstance(child, compound):
+                    stack.append(child)
+
+    def _collect_defs(self, idx, scope_node, prefix: str, cls) -> None:
+        for node in self._own_defs(scope_node):
+            qname = f"{prefix}.{node.name}"
+            if isinstance(node, ast.ClassDef):
+                if prefix == idx.name:
+                    idx.toplevel[node.name] = qname
+                    idx.class_bases[node.name] = [
+                        d for d in (dotted(b) for b in node.bases) if d
+                    ]
+                self._collect_defs(
+                    idx, node, qname,
+                    cls=node.name if prefix == idx.name else cls,
+                )
+                continue
+            if qname in self.functions:
+                # Same-named sibling (e.g. two `def _():` under pl.when):
+                # uniquify so both bodies stay analyzable.
+                qname = f"{qname}@{node.lineno}"
+            info = FunctionInfo(
+                qname=qname, module=idx.name, src=idx.src, node=node, cls=cls
+            )
+            self.functions[qname] = info
+            if cls is None and prefix == idx.name:
+                idx.toplevel[node.name] = qname
+            if cls is not None and prefix == f"{idx.name}.{cls}":
+                idx.class_methods.setdefault(cls, {})[node.name] = qname
+                if node.name == "__init__":
+                    self._class_inits[f"{idx.name}.{cls}"] = qname
+            self._collect_defs(idx, node, qname, cls)
+
+    # ------------------------------------------------------------------
+    def _scan_module(self, idx: _ModuleIndex, src: SourceFile) -> None:
+        # Module-level statements: jit-wrap detection only (module bodies
+        # execute at import, outside any traced region).
+        self._find_jit_wraps(idx, src.tree, scope_prefix=idx.name, cls=None)
+        for qname, info in list(self.functions.items()):
+            if info.module != idx.name:
+                continue
+            self._scan_function(idx, info)
+
+    @staticmethod
+    def _params(args: ast.arguments) -> list[str]:
+        out = [
+            a.arg
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+            )
+        ]
+        if args.vararg:
+            out.append(args.vararg.arg)
+        if args.kwarg:
+            out.append(args.kwarg.arg)
+        return out
+
+    def _local_bindings(self, node) -> set[str]:
+        """Names bound inside this function's scope (params, assignments,
+        imports, nested def/class names, lambda params) — used to keep
+        shadowed imports/globals from resolving."""
+        bound: set[str] = set()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.update(self._params(node.args))
+        for sub in self._walk_scope(node):
+            if isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, (ast.Store, ast.Del)
+            ):
+                bound.add(sub.id)
+            elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                for alias in sub.names:
+                    bound.add((alias.asname or alias.name).split(".", 1)[0])
+            elif isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                bound.add(sub.name)
+            elif isinstance(sub, ast.Lambda):
+                # Lambda bodies are scanned inline (vmap callbacks);
+                # their params must still shadow.
+                bound.update(self._params(sub.args))
+        return bound
+
+    def _walk_scope(self, node):
+        """Every AST node in ``node``'s own runtime scope.
+
+        Annotations are skipped (never executed under ``from __future__
+        import annotations``); a nested def is yielded *shallowly* (its
+        def statement — name binding, decorators, defaults — executes
+        here) but its body is a separate scope.  Lambda bodies stay
+        inline: in this codebase they are vmap/callback bodies whose
+        expressions trace with the enclosing function.
+        """
+        stack = [(node, True, True)]  # (node, expand, is_top)
+        while stack:
+            item, expand, is_top = stack.pop()
+            if not is_top:
+                yield item
+            if not expand:
+                # Shallow nested def: decorators + defaults run here.
+                for dec in item.decorator_list:
+                    stack.append((dec, True, False))
+                for d in item.args.defaults:
+                    stack.append((d, True, False))
+                for kd in item.args.kw_defaults:
+                    if kd is not None:
+                        stack.append((kd, True, False))
+                continue
+            for name, value in ast.iter_fields(item):
+                if name in ("annotation", "returns"):
+                    continue
+                if is_top and name == "decorator_list":
+                    # The top node's own decorators execute in the
+                    # ENCLOSING scope, not this one.
+                    continue
+                for child in value if isinstance(value, list) else [value]:
+                    if not isinstance(child, ast.AST):
+                        continue
+                    if isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        stack.append((child, False, False))
+                    else:
+                        stack.append((child, True, False))
+
+    # ------------------------------------------------------------------
+    def _resolve_in(self, idx, info: FunctionInfo | None, name_path: str,
+                    local_bound: set[str]):
+        """Resolve a dotted reference in a function/module scope to a
+        canonical dotted name, or None."""
+        head = name_path.split(".", 1)[0]
+        if head in ("self", "cls") and info is not None and info.cls is not None:
+            rest = name_path.split(".", 1)[1] if "." in name_path else ""
+            if rest and "." not in rest:
+                return self._resolve_method(idx, info.cls, rest)
+            return None
+        if head in local_bound:
+            # Shadowed by a parameter/local — except locally nested defs,
+            # which resolve to their canonical nested qname.
+            if info is not None:
+                nested = f"{info.qname}.{head}"
+                if nested in self.functions and "." not in name_path:
+                    return nested
+            return None
+        return idx.resolve(name_path)
+
+    def _resolve_method(self, idx, cls: str, meth: str) -> str | None:
+        seen: set[str] = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            hit = idx.class_methods.get(c, {}).get(meth)
+            if hit:
+                return hit
+            for base in idx.class_bases.get(c, ()):
+                if "." not in base:
+                    stack.append(base)
+        return None
+
+    # ------------------------------------------------------------------
+    def _is_jit_wrapper(self, canon: str | None) -> bool:
+        if canon is None:
+            return False
+        return canon in _JIT_WRAPPERS or canon.endswith(_JIT_WRAPPER_SUFFIXES)
+
+    def _find_jit_wraps(self, idx, scope_node, scope_prefix: str, cls) -> None:
+        """Mark roots from ``jit(...)`` wrap calls in a scope (module
+        bodies and function bodies both funnel here)."""
+        info = self.functions.get(scope_prefix)
+        local_bound = (
+            self._local_bindings(info.node) if info is not None else set()
+        )
+        for node in self._walk_scope(scope_node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = self._call_canon(idx, info, node, local_bound)
+            if not self._is_jit_wrapper(canon):
+                continue
+            for ref in self._function_refs_in_args(idx, info, node, local_bound):
+                self._mark_root(ref, f"wrapped by {canon}")
+
+    def _call_canon(self, idx, info, call: ast.Call, local_bound):
+        path = dotted(call.func)
+        if path is None:
+            return None
+        return self._resolve_in(idx, info, path, local_bound)
+
+    def _function_refs_in_args(self, idx, info, call: ast.Call, local_bound):
+        """Every known function referenced anywhere in a call's
+        arguments (descending into nested calls)."""
+        out = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for node in [arg, *ast.walk(arg)]:
+                if isinstance(node, (ast.Name, ast.Attribute)):
+                    path = dotted(node)
+                    if path is None:
+                        continue
+                    canon = self._resolve_in(idx, info, path, local_bound)
+                    if canon in self.functions:
+                        out.append(canon)
+        return out
+
+    def _mark_root(self, qname: str, reason: str) -> None:
+        info = self.functions.get(qname)
+        if info is not None and reason not in info.jit_reasons:
+            info.jit_reasons.append(reason)
+
+    @staticmethod
+    def _static_argnames_from_call(call: ast.Call) -> frozenset:
+        for kw in call.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                names = []
+                val = kw.value
+                elts = val.elts if isinstance(val, (ast.Tuple, ast.List)) else [val]
+                for e in elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        names.append(e.value)
+                return frozenset(names)
+        return frozenset()
+
+    def _scan_decorators(self, idx, info: FunctionInfo) -> None:
+        for dec in info.node.decorator_list:
+            canon = None
+            static: frozenset = frozenset()
+            path = dotted(dec)
+            if path is not None:
+                canon = idx.resolve(path)
+            elif isinstance(dec, ast.Call):
+                fn_canon = idx.resolve(dotted(dec.func) or "")
+                if fn_canon in _PARTIAL_NAMES or fn_canon == "functools.partial":
+                    if dec.args:
+                        inner = dotted(dec.args[0])
+                        canon = idx.resolve(inner) if inner else None
+                        static = self._static_argnames_from_call(dec)
+                elif self._is_jit_wrapper(fn_canon):
+                    # @jax.jit(static_argnames=...) factory form.
+                    canon = fn_canon
+                    static = self._static_argnames_from_call(dec)
+            if self._is_jit_wrapper(canon):
+                info.static_args = info.static_args | static
+                self._mark_root(info.qname, f"decorated with {canon}")
+
+    # ------------------------------------------------------------------
+    def _scan_function(self, idx, info: FunctionInfo) -> None:
+        self._scan_decorators(idx, info)
+        edges = self.edges.setdefault(info.qname, [])
+        seen_sites: set[tuple[str, int, int]] = set()
+
+        def add_edge(target: str, line: int, col: int, kind: str) -> None:
+            # A call's func Name is visited both as the Call and as a
+            # bare Load — one site, one edge.
+            site = (target, line, col)
+            if site not in seen_sites:
+                seen_sites.add(site)
+                edges.append(Edge(target, line, col, kind))
+
+        local_bound = self._local_bindings(info.node)
+        # Nested defs execute (their def statement) in this scope — they
+        # are reachable the moment the enclosing function runs.
+        for child in self._walk_scope(info.node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested = f"{info.qname}.{child.name}"
+                if nested not in self.functions:
+                    nested = f"{nested}@{child.lineno}"
+                if nested in self.functions:
+                    add_edge(nested, child.lineno, child.col_offset, "nested")
+        for node in self._walk_scope(info.node):
+            if isinstance(node, ast.Call):
+                canon = self._call_canon(idx, info, node, local_bound)
+                if self._is_jit_wrapper(canon):
+                    for ref in self._function_refs_in_args(
+                        idx, info, node, local_bound
+                    ):
+                        self._mark_root(ref, f"wrapped by {canon}")
+                    continue
+                if canon is not None:
+                    target = self.functions.get(canon) and canon
+                    if target is None and canon in self._class_inits:
+                        target = self._class_inits[canon]
+                    if target is not None:
+                        add_edge(target, node.lineno, node.col_offset, "call")
+                        continue
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                canon = self._resolve_in(idx, info, node.id, local_bound)
+                if canon in self.functions:
+                    add_edge(canon, node.lineno, node.col_offset, "ref")
+
+    # ------------------------------------------------------------------
+    def roots(self) -> list[FunctionInfo]:
+        return [f for f in self.functions.values() if f.is_jit_root]
+
+    def reachable(self) -> dict[str, tuple[str, Edge | None]]:
+        """BFS from every jit root.
+
+        Returns ``{qname: (predecessor_qname, entering_edge)}`` for every
+        function reachable from a root; roots map to ``("", None)``.
+        """
+        pred: dict[str, tuple[str, Edge | None]] = {}
+        queue: list[str] = []
+        for f in self.roots():
+            pred[f.qname] = ("", None)
+            queue.append(f.qname)
+        while queue:
+            cur = queue.pop(0)
+            for edge in self.edges.get(cur, ()):  # deterministic order
+                if edge.target not in pred:
+                    pred[edge.target] = (cur, edge)
+                    queue.append(edge.target)
+        return pred
+
+    def chain(self, pred: dict, qname: str) -> list[str]:
+        """Root -> ... -> qname, for finding messages."""
+        out = [qname]
+        seen = {qname}
+        while True:
+            p, _ = pred.get(out[-1], ("", None))
+            if not p or p in seen:
+                break
+            out.append(p)
+            seen.add(p)
+        return list(reversed(out))

@@ -1,0 +1,98 @@
+"""Hygiene walks that keep the tree clean without external tooling.
+
+The satellite CI story wires ``ruff`` into ``pyproject.toml``, but the
+analyzer must not *depend* on ruff existing (this environment bakes no
+linter into the image).  This module carries the highest-value pyflakes
+subset natively so the tier-1 gate enforces it everywhere:
+
+* ``hygiene-unused-import`` — an imported name never referenced in the
+  module.  ``__init__.py`` files are exempt (the re-export idiom), as
+  are ``__future__`` imports and names listed in ``__all__``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetesclustercapacity_tpu.analysis.engine import Finding, Project
+
+__all__ = ["check"]
+
+
+def _used_names(tree: ast.Module) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # `a.b` usage of `import a.b` style bindings is covered by
+            # the base Name; nothing extra needed here.
+            pass
+    return used
+
+
+def _exported_names(tree: ast.Module) -> set[str]:
+    out: set[str] = set()
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    out.add(elt.value)
+    return out
+
+
+def check(project: Project):
+    findings: list[Finding] = []
+    for src in project.files:
+        if src.rel_path.endswith("__init__.py"):
+            continue
+        used = _used_names(src.tree)
+        exported = _exported_names(src.tree)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                bindings = [
+                    (
+                        alias.asname
+                        if alias.asname
+                        else alias.name.split(".", 1)[0],
+                        alias.name,
+                    )
+                    for alias in node.names
+                ]
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                bindings = [
+                    (alias.asname or alias.name, alias.name)
+                    for alias in node.names
+                    if alias.name != "*"
+                ]
+            else:
+                continue
+            for local, original in bindings:
+                if local in used or local in exported:
+                    continue
+                findings.append(
+                    Finding(
+                        rule="hygiene-unused-import",
+                        severity="warning",
+                        path=src.rel_path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"`{original}` is imported as `{local}` but "
+                            "never used in this module"
+                        ),
+                        symbol=f"{local}",
+                    )
+                )
+    return findings

@@ -1,0 +1,235 @@
+"""Jit-purity prover: nothing host-side is reachable from a traced region.
+
+``KCCAP_TELEMETRY=0`` promises *zero registry calls in jitted code*
+(PR 2), and the whole serving stack assumes jitted functions never take
+locks, never touch wall clocks, and never coerce traced arrays to
+Python scalars (each coercion is a device sync; under ``vmap`` it is an
+error).  This rule makes those promises theorems: build the intra-
+package call graph rooted at every jit/pjit/pallas function
+(:mod:`.callgraph`), then flag — at the offending call site, with the
+root→...→callee chain in the message — anything in these categories:
+
+* ``host-subsystem`` — a call edge into a host-side subsystem
+  (telemetry, devcache, service, audit, timeline, resilience, ...);
+* ``lock`` — ``with self._lock``-style acquisition, ``.acquire()``, or
+  ``threading.*`` construction;
+* ``io`` — ``open``/``print``/``input``, ``os.environ``/``os.getenv``;
+* ``clock`` / ``random`` — stdlib ``time.*`` / ``random.*`` (NOT
+  ``jax.random``/``numpy.random``, which resolve differently);
+* ``host-callback`` — ``jax.pure_callback``/``io_callback``/
+  ``jax.debug.print`` and friends (escape hatches that must be
+  deliberate, i.e. suppressed inline, never accidental);
+* ``numpy-on-traced`` / ``traced-coercion`` — ``np.*`` or
+  ``int()/float()/bool()`` applied directly to a traced parameter of a
+  jit root (parameters named in ``static_argnames`` are concrete and
+  exempt).  Checked only where parameter tracedness is *known* (the
+  root itself) — precision over recall, so every finding is actionable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetesclustercapacity_tpu.analysis.callgraph import CallGraph, dotted
+from kubernetesclustercapacity_tpu.analysis.engine import Finding, Project
+
+__all__ = ["check", "RULE", "IMPURE_SUBSYSTEMS"]
+
+RULE = "jit-purity"
+
+#: Package-relative module heads that are host-side by construction: an
+#: edge from traced code into any of these is a finding regardless of
+#: what the callee does today.
+IMPURE_SUBSYSTEMS = frozenset(
+    {
+        "telemetry",
+        "devcache",
+        "audit",
+        "timeline",
+        "service",
+        "resilience",
+        "testing_faults",
+        "follower",
+        "kubeapi",
+        "sources",
+        "native",
+        "report",
+        "cli",
+        "analysis",
+    }
+)
+
+_IMPURE_BUILTINS = frozenset({"print", "input", "open", "breakpoint"})
+_COERCIONS = frozenset({"int", "float", "bool"})
+
+_HOST_CALLBACKS = (
+    "jax.pure_callback",
+    "jax.experimental.io_callback",
+    "jax.debug.print",
+    "jax.debug.callback",
+    "jax.debug.breakpoint",
+    "jax.experimental.host_callback",
+)
+
+
+def _subsystem(graph: CallGraph, qname: str) -> str | None:
+    """Package-relative first module segment of a function's module."""
+    info = graph.functions.get(qname)
+    if info is None:
+        return None
+    head = info.module.split(".")[1] if "." in info.module else info.module
+    return head
+
+
+def _short(qname: str) -> str:
+    """Drop the package prefix for readable messages."""
+    parts = qname.split(".")
+    return ".".join(parts[1:]) if len(parts) > 1 else qname
+
+
+def check(project: Project):
+    graph = CallGraph.build(project)
+    findings: list[Finding] = []
+
+    # --- reachability with boundary pruning: an edge into a host
+    # subsystem is a finding, and traversal stops there (flagging the
+    # subsystem's own internals would bury the one actionable site).
+    pred: dict[str, tuple[str, object]] = {}
+    queue: list[str] = []
+    for root in graph.roots():
+        pred[root.qname] = ("", None)
+        queue.append(root.qname)
+    while queue:
+        cur = queue.pop(0)
+        cur_info = graph.functions[cur]
+        for edge in graph.edges.get(cur, ()):
+            sub = _subsystem(graph, edge.target)
+            if sub in IMPURE_SUBSYSTEMS:
+                chain = " -> ".join(_short(q) for q in graph.chain(pred, cur))
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        severity="error",
+                        path=cur_info.src.rel_path,
+                        line=edge.line,
+                        col=edge.col,
+                        message=(
+                            f"host-subsystem: call into {_short(edge.target)}"
+                            f" ({sub}/) is reachable from jit root via "
+                            f"{chain}"
+                        ),
+                        symbol=f"{cur}->{edge.target}",
+                    )
+                )
+                continue
+            if edge.target not in pred:
+                pred[edge.target] = (cur, edge)
+                queue.append(edge.target)
+
+    # --- per-function purity scan of everything reachable.
+    for qname in sorted(pred):
+        info = graph.functions[qname]
+        idx = graph.modules[info.module]
+        chain = " -> ".join(_short(q) for q in graph.chain(pred, qname))
+        local_bound = graph._local_bindings(info.node)
+        traced: frozenset = frozenset()
+        if info.is_jit_root:
+            traced = frozenset(
+                p
+                for p in graph._params(info.node.args)
+                if p not in info.static_args and p != "self"
+            )
+
+        def emit(node, category: str, detail: str) -> None:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    severity="error",
+                    path=info.src.rel_path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"{category}: {detail} inside jitted region "
+                        f"({chain})"
+                    ),
+                    symbol=f"{qname}::{category}::{detail}",
+                )
+            )
+
+        for node in graph._walk_scope(info.node):
+            if isinstance(node, ast.With) or isinstance(node, ast.AsyncWith):
+                for item in node.items:
+                    path = dotted(item.context_expr)
+                    if path and "lock" in path.rsplit(".", 1)[-1].lower():
+                        emit(node, "lock", f"`with {path}:` acquisition")
+                continue
+            if isinstance(node, ast.Attribute):
+                path = dotted(node)
+                canon = (
+                    graph._resolve_in(idx, info, path, local_bound)
+                    if path
+                    else None
+                )
+                if canon == "os.environ":
+                    # Every environ use (attribute call, subscript, or
+                    # bare) contains exactly this inner attribute node,
+                    # so flagging it once here covers all forms without
+                    # double-reporting the enclosing call.
+                    emit(node, "io", "os.environ access")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fn_path = dotted(fn)
+            canon = (
+                graph._resolve_in(idx, info, fn_path, local_bound)
+                if fn_path
+                else None
+            )
+            if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+                emit(node, "lock", f"`{fn_path or '<expr>.acquire'}()` call")
+                continue
+            if canon is None:
+                if (
+                    isinstance(fn, ast.Name)
+                    and fn.id in _IMPURE_BUILTINS
+                    and fn.id not in local_bound
+                ):
+                    emit(node, "io", f"`{fn.id}()` call")
+                elif (
+                    isinstance(fn, ast.Name)
+                    and fn.id in _COERCIONS
+                    and fn.id not in local_bound
+                    and traced
+                ):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name) and arg.id in traced:
+                            emit(
+                                node,
+                                "traced-coercion",
+                                f"`{fn.id}({arg.id})` coerces a traced "
+                                "parameter to a Python scalar",
+                            )
+                            break
+                continue
+            if canon == "time" or canon.startswith("time."):
+                emit(node, "clock", f"`{canon}()` call")
+            elif canon == "random" or canon.startswith("random."):
+                emit(node, "random", f"`{canon}()` call")
+            elif canon == "os.getenv":
+                emit(node, "io", f"`{canon}()` call")
+            elif canon.startswith("threading."):
+                emit(node, "lock", f"`{canon}()` construction")
+            elif canon.startswith(_HOST_CALLBACKS):
+                emit(node, "host-callback", f"`{canon}` host callback")
+            elif canon.startswith("numpy.") and traced:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in traced:
+                        emit(
+                            node,
+                            "numpy-on-traced",
+                            f"`{canon}({arg.id})` applies host numpy to "
+                            "a traced parameter",
+                        )
+                        break
+    return findings

@@ -1,0 +1,225 @@
+"""Lock-discipline checker: guarded fields stay under their lock.
+
+The threaded classes in this codebase (MetricsRegistry, DeviceCache,
+MicroBatcher, CapacityTimeline, AuditLog, ...) are safe *by convention*:
+each holds a ``self._lock`` and touches its mutable state inside ``with
+self._lock:`` blocks.  This rule turns the convention into a check:
+
+1. a class is *threaded* iff it acquires a ``self.<attr>`` lock
+   anywhere (``with self._lock:``) or assigns ``threading.Lock/RLock/
+   Condition/Semaphore`` to an attribute in ``__init__``;
+2. its *guarded fields* are the ``self.X`` attributes **written** under
+   the lock outside ``__init__`` — a field someone mutates under the
+   lock is a field every reader must take the lock for;
+3. every read or write of a guarded field outside a with-lock block
+   (outside ``__init__``, which runs before the object is shared) is a
+   finding.
+
+Known-benign escapes use the inline marker — ``# kccap:
+lint-ok[lock-discipline] <why the race is acceptable>`` — so every
+deliberately racy read is greppable and justified at the site.  Methods
+whose bodies run with the lock already held by their caller follow the
+``*_locked`` naming convention and are treated as lock-held throughout.
+
+Closures defined inside a method are analyzed as *outside* the lock
+even when the ``def`` lexically sits in a ``with`` block: the closure
+body runs when called, which is generally after the block exits.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetesclustercapacity_tpu.analysis.callgraph import dotted
+from kubernetesclustercapacity_tpu.analysis.engine import Finding, Project
+
+__all__ = ["check", "RULE"]
+
+RULE = "lock-discipline"
+
+_LOCK_CTORS = (
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+)
+
+
+def _self_attr(node) -> str | None:
+    """``self.X`` -> ``"X"`` (one level only), else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _lock_items(node) -> set[str]:
+    """Lock attrs acquired by this ``with`` statement's items."""
+    out: set[str] = set()
+    for item in node.items:
+        attr = _self_attr(item.context_expr)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+def _is_lock_ctor(call: ast.Call, lock_aliases: set[str]) -> bool:
+    path = dotted(call.func)
+    if path is None:
+        return False
+    if path in lock_aliases:
+        return True
+    # `threading.Lock()` under any module alias for threading.
+    tail = path.rsplit(".", 1)[-1]
+    return tail in ("Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore") and "." in path
+
+
+def _methods(cls: ast.ClassDef):
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _iter_classes(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+class _MethodScanner:
+    """One pass over a method body tracking whether a self-lock is held
+    lexically; collects under-lock writes/reads and out-of-lock
+    accesses of candidate fields."""
+
+    def __init__(self, lock_attrs: set[str]) -> None:
+        self.lock_attrs = lock_attrs
+        self.under_writes: set[str] = set()
+        self.accesses: list[tuple[str, bool, bool, ast.AST]] = []
+        # (field, is_write, under_lock, node)
+
+    def scan(self, method, *, assume_held: bool) -> None:
+        for stmt in method.body:
+            self._visit(stmt, assume_held)
+
+    def _visit(self, node, held: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # Closure bodies run later, when the lock may not be held.
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for child in body:
+                self._visit(child, False)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = _lock_items(node) & self.lock_attrs
+            for item in node.items:
+                self._visit(item.context_expr, held)
+            for child in node.body:
+                self._visit(child, held or bool(acquired))
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr not in self.lock_attrs:
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            if held and is_write:
+                self.under_writes.add(attr)
+            self.accesses.append((attr, is_write, held, node))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+
+def check(project: Project):
+    findings: list[Finding] = []
+    for src in project.files:
+        # Module-level lock ctor aliases (e.g. `from threading import Lock`).
+        lock_aliases: set[str] = set(_LOCK_CTORS)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "threading":
+                for alias in node.names:
+                    if alias.name in (
+                        "Lock", "RLock", "Condition", "Semaphore",
+                        "BoundedSemaphore",
+                    ):
+                        lock_aliases.add(alias.asname or alias.name)
+
+        for cls in _iter_classes(src.tree):
+            # -- pass 1: which attrs are locks?
+            lock_attrs: set[str] = set()
+            for node in ast.walk(cls):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    lock_attrs |= _lock_items(node)
+                elif isinstance(node, ast.Assign):
+                    if isinstance(node.value, ast.Call) and _is_lock_ctor(
+                        node.value, lock_aliases
+                    ):
+                        for tgt in node.targets:
+                            attr = _self_attr(tgt)
+                            if attr is not None:
+                                lock_attrs.add(attr)
+            # `with self._x:` where _x is not lock-like (e.g. a client
+            # used as a context manager) would poison the analysis; keep
+            # only lock-looking names plus ctor-proven attrs.
+            proven = {
+                a
+                for a in lock_attrs
+                if "lock" in a.lower() or "cv" in a.lower()
+                or "cond" in a.lower() or "sem" in a.lower()
+            }
+            ctor_proven = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ) and _is_lock_ctor(node.value, lock_aliases):
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            ctor_proven.add(attr)
+            lock_attrs = proven | ctor_proven
+            if not lock_attrs:
+                continue
+
+            # -- pass 2: guarded set = fields written under lock outside
+            # __init__ (per-method scanners, then union).
+            scanners: dict[str, _MethodScanner] = {}
+            for method in _methods(cls):
+                scanner = _MethodScanner(lock_attrs)
+                scanner.scan(
+                    method,
+                    assume_held=method.name.endswith("_locked"),
+                )
+                scanners[method.name] = scanner
+            guarded: set[str] = set()
+            for name, scanner in scanners.items():
+                if name != "__init__":
+                    guarded |= scanner.under_writes
+
+            if not guarded:
+                continue
+
+            # -- pass 3: out-of-lock accesses of guarded fields.
+            for name, scanner in scanners.items():
+                if name == "__init__":
+                    continue
+                for field, is_write, held, node in scanner.accesses:
+                    if held or field not in guarded:
+                        continue
+                    verb = "write to" if is_write else "read of"
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            severity="error",
+                            path=src.rel_path,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message=(
+                                f"unguarded {verb} `self.{field}` in "
+                                f"`{cls.name}.{name}` — the field is "
+                                f"mutated under `self.{sorted(lock_attrs)[0]}`"
+                                " elsewhere, so lock-free access races"
+                            ),
+                            symbol=f"{cls.name}.{field}@{name}",
+                        )
+                    )
+    return findings

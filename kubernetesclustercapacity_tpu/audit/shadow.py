@@ -173,9 +173,14 @@ class ShadowSampler:
         cost.  Returns whether this sweep was sampled.  ``totals`` /
         ``schedulable`` are the served answers (host arrays/lists);
         ``node_mask`` is the mask the serving dispatch applied."""
-        if self.sample_rate <= 0.0 or self._closed:
+        if self.sample_rate <= 0.0:
             return False
         with self._cond:
+            # Checked under the lock: a lock-free read raced close() —
+            # a sample admitted after _closed flips would sit in the
+            # queue forever (the worker exits on close).
+            if self._closed:
+                return False
             self._acc += self.sample_rate
             if self._acc < 1.0:
                 return False
@@ -367,6 +372,9 @@ class ShadowSampler:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-        worker = self._worker
+            # Snapshot under the lock: _worker is lazily spawned under
+            # _cond, so a lock-free read could miss a thread started by
+            # a concurrent submit and skip the join below.
+            worker = self._worker
         if worker is not None:
             worker.join(timeout=5.0)

@@ -261,9 +261,14 @@ class CircuitBreaker:
         otherwise.  Exceptions from ``fn`` count as failures and
         propagate unchanged."""
         if not self.allow():
+            with self._lock:
+                # Snapshot once, under the lock: two lock-free reads
+                # could see different values (checked one error, printed
+                # another) when a probe thread races record_success.
+                last_error = self._last_error
             raise CircuitOpenError(
                 f"circuit breaker {self.name or id(self)} is open"
-                + (f" (last error: {self._last_error})" if self._last_error else "")
+                + (f" (last error: {last_error})" if last_error else "")
             )
         try:
             result = fn(*args, **kwargs)

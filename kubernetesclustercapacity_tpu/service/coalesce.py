@@ -90,8 +90,10 @@ class SnapshotCoalescer:
         """JSON-able counters + freshness (no lock: single-writer fields
         read for display only)."""
         return {
+            # kccap: lint-ok[lock-discipline] single-writer counter, torn display read is acceptable
             "events": self.events,
             "flushes": self.flushes,
+            # kccap: lint-ok[lock-discipline] single-writer gauge, display-only read
             "pending": self._pending,
             "last_error": self.last_error,
             "last_flush_s": self.last_flush_s,

@@ -629,25 +629,30 @@ class SLOMonitor:
 
     def wire(self) -> dict:
         """The ``slo`` op's response body."""
+        with self._lock:
+            # _evals is incremented under the lock by evaluate(); read
+            # it the same way so the wire view is a consistent count.
+            evals = self._evals
         return {
             "enabled": True,
             "specs": [s.to_wire() for s in self.specs],
             "status": self.status(),
             "fast_burning": self.fast_burning,
-            "evaluations": self._evals,
+            "evaluations": evals,
         }
 
     def stats(self) -> dict:
         """Compact health view (``/healthz``, doctor)."""
         with self._lock:
             states = {n: a.state for n, a in self._alerts.items()}
+            evals = self._evals
         return {
             "slos": [s.name for s in self.specs],
             "states": states,
             "breached": sorted(
                 n for n, s in states.items() if s == ALERT_BREACHED
             ),
-            "evaluations": self._evals,
+            "evaluations": evals,
         }
 
     # -- lifecycle ---------------------------------------------------------

@@ -1,0 +1,1 @@
+"""Deliberately-broken package for analyzer rule tests (never imported)."""

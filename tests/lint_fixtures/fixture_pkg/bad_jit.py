@@ -1,0 +1,46 @@
+"""Every jit-purity category, one per marked line.
+
+The ``# expect:`` markers are parsed by ``tests/test_lint_rules.py``:
+each names the rule(s) that must fire AT THAT LINE.  This module is
+analyzed, never imported.
+"""
+
+import os
+import random
+import threading
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fixture_pkg.telemetry.metrics import count
+
+_lock = threading.Lock()
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def bad_kernel(x, mode="reference"):
+    count()  # expect: jit-purity
+    t = time.perf_counter()  # expect: jit-purity
+    print("tracing", mode)  # expect: jit-purity
+    r = random.random()  # expect: jit-purity
+    flag = os.environ.get("FIXTURE_SWITCH", "0")  # expect: jit-purity
+    with _lock:  # expect: jit-purity
+        pass
+    inner = threading.Lock()  # expect: jit-purity
+    jax.debug.print("traced {x}", x=x)  # expect: jit-purity
+    y = np.asarray(x)  # expect: jit-purity
+    z = int(x)  # expect: jit-purity
+    del inner, flag
+    return jnp.sum(x) + z + y.sum() + t + r
+
+
+def _helper(x):
+    return x * time.time()  # expect: jit-purity
+
+
+@jax.jit
+def transitive_root(x):
+    return _helper(x)

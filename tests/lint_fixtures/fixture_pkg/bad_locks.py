@@ -1,0 +1,33 @@
+"""Lock-discipline fixtures: one racy read, one racy write, one inline
+suppression, one lock-held-by-convention helper."""
+
+import threading
+
+
+class Racy:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+        self._errors = 0
+        self._immutable = 42  # never written under lock -> unguarded OK
+
+    def incr(self) -> None:
+        with self._lock:
+            self._count += 1
+            self._errors += 1
+
+    def racy_read(self) -> int:
+        return self._count  # expect: lock-discipline
+
+    def racy_write(self) -> None:
+        self._count = 0  # expect: lock-discipline
+
+    def config(self) -> int:
+        return self._immutable  # init-only field: no finding
+
+    def suppressed_read(self) -> int:
+        return self._errors  # kccap: lint-ok[lock-discipline] fixture: deliberate racy display read
+
+    def _total_locked(self) -> int:
+        # *_locked convention: caller holds the lock; no finding.
+        return self._count + self._errors

@@ -1,0 +1,17 @@
+"""Surface-conformance + hygiene fixtures."""
+
+import json  # expect: hygiene-unused-import
+import os
+
+DOCUMENTED_METRIC = "kccap_fixture_documented_total"
+ROGUE_METRIC = "kccap_fixture_rogue_total"  # expect: surface-metric
+BAD_CASE_METRIC = "kccap_Fixture_BadCase_total"  # expect: surface-metric
+
+DOCUMENTED_ENV = os.environ.get("KCCAP_FIXTURE_DOCUMENTED", "")
+ROGUE_ENV = os.environ.get("KCCAP_FIXTURE_ROGUE", "")  # expect: surface-env
+
+
+def build_parser(p):
+    p.add_argument("-documented-flag", action="store_true")
+    p.add_argument("-rogue-flag", action="store_true")  # expect: surface-flag
+    return p

@@ -1,0 +1,9 @@
+"""Client fixture: reaches `ping`, never `mystery`."""
+
+
+class FixtureClient:
+    def call(self, op):
+        return op
+
+    def ping(self):
+        return self.call("ping")

@@ -1,0 +1,7 @@
+"""Op-table fixture: `ping` is documented + client-reachable, `mystery`
+is neither (two surface-op findings on the assignment line)."""
+
+
+class FixtureServer:
+    # expect: surface-op, surface-op
+    _KNOWN_OPS = frozenset({"ping", "mystery"})

@@ -1,0 +1,152 @@
+"""Engine mechanics: suppression grammar, baseline file shape, finding
+rendering, and the ``kccap-lint`` CLI contract (exit codes, --json
+artifact, --write-baseline round trip)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubernetesclustercapacity_tpu.analysis.engine import (
+    Baseline,
+    Finding,
+    parse_suppressions,
+)
+
+FIXTURE_ROOT = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+FIXTURE_PKG = os.path.join(FIXTURE_ROOT, "fixture_pkg")
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+# -- suppression grammar ---------------------------------------------------
+
+def test_trailing_suppression_applies_to_its_own_line():
+    sup = parse_suppressions("x = 1\ny = 2  # kccap: lint-ok[rule-a]\n")
+    assert sup == {2: {"rule-a"}}
+
+
+def test_standalone_suppression_applies_to_next_line():
+    sup = parse_suppressions(
+        "x = 1\n# kccap: lint-ok[rule-a] reason prose\ny = 2\n"
+    )
+    assert sup[2] == {"rule-a"} and sup[3] == {"rule-a"}
+
+
+def test_suppression_rule_list_and_star():
+    sup = parse_suppressions("z = 0  # kccap: lint-ok[a, b-c]\n")
+    assert sup == {1: {"a", "b-c"}}
+    star = parse_suppressions("z = 0  # kccap: lint-ok[*]\n")
+    assert star == {1: {"*"}}
+
+
+def test_unrelated_comments_do_not_suppress():
+    assert parse_suppressions("# kccap: something-else\nx = 1\n") == {}
+
+
+# -- baseline file ---------------------------------------------------------
+
+def _finding(**kw):
+    base = dict(
+        rule="r", severity="error", path="p.py", line=3, col=0,
+        message="m", symbol="s",
+    )
+    base.update(kw)
+    return Finding(**base)
+
+
+def test_baseline_save_shape_has_history_section(tmp_path):
+    path = os.path.join(tmp_path, "b.json")
+    Baseline.from_findings([_finding()], history=["note one"]).save(path)
+    with open(path) as fh:
+        data = json.load(fh)
+    assert data["version"] == 1
+    assert data["history"] == ["note one"]
+    assert data["findings"] == [{"rule": "r", "path": "p.py", "symbol": "s"}]
+
+
+def test_baseline_load_missing_file_is_empty(tmp_path):
+    bl = Baseline.load(os.path.join(tmp_path, "absent.json"))
+    assert bl.entries == set() and bl.history == []
+
+
+def test_baseline_load_rejects_malformed(tmp_path):
+    path = os.path.join(tmp_path, "bad.json")
+    with open(path, "w") as fh:
+        json.dump({"not": "a baseline"}, fh)
+    with pytest.raises(ValueError):
+        Baseline.load(path)
+
+
+def test_repo_baseline_parses_and_carries_history():
+    bl = Baseline.load(os.path.join(_REPO, "LINT_BASELINE.json"))
+    assert bl.history, "the checked-in baseline must narrate its fixes"
+    assert any("PR8" in h for h in bl.history)
+
+
+def test_finding_render_and_key():
+    f = _finding()
+    assert f.render() == "p.py:3:0: error [r] m"
+    assert f.key() == ("r", "p.py", "s")
+
+
+# -- CLI contract ----------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "kubernetesclustercapacity_tpu.analysis.cli"]
+        + list(args),
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        timeout=120,
+    )
+
+
+def test_cli_on_fixture_exits_1_with_findings():
+    proc = _run_cli(FIXTURE_PKG, "--no-baseline")
+    assert proc.returncode == 1
+    assert "[jit-purity]" in proc.stdout
+    assert "finding(s)" in proc.stdout
+
+
+def test_cli_json_artifact_is_machine_readable():
+    proc = _run_cli(FIXTURE_PKG, "--no-baseline", "--json")
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert data["version"] == 1 and data["clean"] is False
+    assert data["counts"]["findings"] == len(data["findings"])
+    assert data["counts"]["by_rule"]["jit-purity"] >= 8
+    sample = data["findings"][0]
+    assert {"rule", "severity", "path", "line", "col", "message", "symbol"} \
+        <= set(sample)
+
+
+def test_cli_rules_filter():
+    proc = _run_cli(
+        FIXTURE_PKG, "--no-baseline", "--rules", "surface", "--json"
+    )
+    data = json.loads(proc.stdout)
+    assert data["findings"]
+    assert all(f["rule"].startswith("surface-") for f in data["findings"])
+
+
+def test_cli_unknown_rule_is_usage_error():
+    proc = _run_cli(FIXTURE_PKG, "--rules", "bogus")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_cli_write_baseline_round_trip(tmp_path):
+    bl_path = os.path.join(tmp_path, "bl.json")
+    wrote = _run_cli(FIXTURE_PKG, "--baseline", bl_path, "--write-baseline")
+    assert wrote.returncode == 0
+    rerun = _run_cli(FIXTURE_PKG, "--baseline", bl_path)
+    assert rerun.returncode == 0, rerun.stdout
+    assert "0 finding(s)" in rerun.stdout
+
+
+def test_cli_missing_package_dir_is_usage_error(tmp_path):
+    proc = _run_cli(os.path.join(tmp_path, "nowhere"))
+    assert proc.returncode == 2

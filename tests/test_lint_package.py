@@ -1,0 +1,124 @@
+"""The tier-1 lint gate: ``kccap-lint`` over the whole package must
+report zero non-baselined findings — the static proofs (jit-purity,
+lock-discipline, surface conformance, hygiene) hold on every run.
+
+Plus the external-linter satellites: when ``ruff``/``mypy`` exist on
+PATH they run with the ``pyproject.toml`` configs and must be clean;
+where the tools are absent (this image bakes none in) the tests skip —
+the project-native analyzer is the floor that always enforces.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from kubernetesclustercapacity_tpu.analysis.callgraph import CallGraph
+from kubernetesclustercapacity_tpu.analysis.engine import (
+    Analyzer,
+    Baseline,
+    Project,
+)
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_PKG = os.path.join(_REPO, "kubernetesclustercapacity_tpu")
+
+
+@pytest.fixture(scope="module")
+def project():
+    return Project(_PKG)
+
+
+def test_package_has_zero_nonbaselined_findings(project):
+    baseline = Baseline.load(os.path.join(_REPO, "LINT_BASELINE.json"))
+    result = Analyzer(project, baseline=baseline).run()
+    assert result.clean, (
+        "kccap-lint found new violations:\n"
+        + "\n".join(f.render() for f in result.findings)
+    )
+
+
+def test_the_scan_is_not_vacuous(project):
+    """A broken walker must fail loudly, not report an empty clean tree."""
+    assert len(project.files) >= 60
+    graph = CallGraph.build(project)
+    roots = graph.roots()
+    # The known jit surface: ops/fit, ops/pallas_fit, ops/pallas_multi,
+    # ops/placement, ops/preemption, explain, parallel/sweep, guards.
+    assert len(roots) >= 15, sorted(f.qname for f in roots)
+    root_modules = {f.module.split(".", 1)[1] for f in roots}
+    assert {
+        "ops.fit", "ops.pallas_fit", "ops.pallas_multi",
+        "ops.placement", "explain", "utils.guards",
+    } <= root_modules
+    reachable = graph.reachable()
+    assert len(reachable) > len(roots)
+    # static_argnames must be captured, or the traced/concrete split in
+    # the coercion checks silently degrades.
+    fit = graph.functions["kubernetesclustercapacity_tpu.ops.fit.fit_per_node"]
+    assert "mode" in fit.static_args
+
+
+def test_known_threaded_classes_are_analyzed(project):
+    """The lock rule must actually see the registry/cache/batcher —
+    zero findings because the code is clean, not because the classes
+    were skipped."""
+    from kubernetesclustercapacity_tpu.analysis import rules_locks
+    import ast
+
+    threaded = set()
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.With, ast.AsyncWith)):
+                        if rules_locks._lock_items(sub):
+                            threaded.add(node.name)
+                            break
+    assert {
+        "DeviceCache", "MicroBatcher", "CapacityTimeline", "AuditLog",
+        "CircuitBreaker", "MetricsRegistry",
+    } <= threaded
+
+
+def test_cli_gate_exits_zero_on_the_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubernetesclustercapacity_tpu.analysis.cli"],
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- external linters (gated: skip where the tool is absent) ---------------
+
+def test_ruff_clean_when_available():
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        pytest.skip("ruff not installed in this image; kccap-lint is the floor")
+    proc = subprocess.run(
+        [ruff, "check", "."],
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_mypy_clean_when_available():
+    mypy = shutil.which("mypy")
+    if mypy is None:
+        pytest.skip("mypy not installed in this image; kccap-lint is the floor")
+    proc = subprocess.run(
+        [mypy, "--config-file", "pyproject.toml"],
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,176 @@
+"""Rule-sensitivity tests: every analyzer rule family must fire on the
+deliberately-broken fixture package — at exactly the marked file:line —
+and must fire on NOTHING else (precision is the other half of a usable
+linter).
+
+The fixtures under ``tests/lint_fixtures/fixture_pkg`` carry
+``# expect: rule[, rule]`` markers: trailing on the offending line, or
+standalone on the line above (same placement grammar as the
+``kccap: lint-ok[...]`` suppressions).  The tests derive the expected
+``(rule, path, line)`` set from those markers, so fixture edits cannot
+drift from the assertions.
+"""
+
+import os
+import re
+
+import pytest
+
+from kubernetesclustercapacity_tpu.analysis.engine import (
+    Analyzer,
+    Baseline,
+    Project,
+)
+
+FIXTURE_ROOT = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+FIXTURE_PKG = os.path.join(FIXTURE_ROOT, "fixture_pkg")
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([a-z0-9\-]+(?:\s*,\s*[a-z0-9\-]+)*)")
+
+
+@pytest.fixture(scope="module")
+def result():
+    return Analyzer(Project(FIXTURE_PKG)).run()
+
+
+def _expected() -> set[tuple[str, str, int]]:
+    out: set[tuple[str, str, int]] = set()
+    for root, dirs, files in os.walk(FIXTURE_PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, FIXTURE_ROOT).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, start=1):
+                    m = _EXPECT_RE.search(line)
+                    if not m:
+                        continue
+                    target = (
+                        lineno + 1
+                        if line.lstrip().startswith("#")
+                        else lineno
+                    )
+                    for rule in m.group(1).split(","):
+                        out.add((rule.strip(), rel, target))
+    return out
+
+
+def test_marker_scan_is_not_vacuous():
+    expected = _expected()
+    assert len(expected) >= 15
+    assert any(r == "jit-purity" for r, _, _ in expected)
+    assert any(r == "lock-discipline" for r, _, _ in expected)
+    assert any(r.startswith("surface-") for r, _, _ in expected)
+
+
+def test_every_marked_line_fires(result):
+    got = {(f.rule, f.path, f.line) for f in result.findings}
+    missing = _expected() - got
+    assert not missing, f"rules failed to fire at marked lines: {sorted(missing)}"
+
+
+def test_no_unmarked_findings(result):
+    """Precision: the analyzer reports nothing the fixtures did not
+    deliberately plant."""
+    extra = {(f.rule, f.path, f.line) for f in result.findings} - _expected()
+    assert not extra, f"unexpected findings: {sorted(extra)}"
+
+
+def test_every_rule_family_represented(result):
+    rules = {f.rule for f in result.findings}
+    assert "jit-purity" in rules
+    assert "lock-discipline" in rules
+    assert {"surface-metric", "surface-env", "surface-op", "surface-flag"} <= rules
+    assert "hygiene-unused-import" in rules
+
+
+def test_jit_purity_covers_every_category(result):
+    cats = {
+        f.message.split(":", 1)[0]
+        for f in result.findings
+        if f.rule == "jit-purity"
+    }
+    assert cats == {
+        "host-subsystem", "clock", "io", "random", "lock",
+        "host-callback", "numpy-on-traced", "traced-coercion",
+    }
+
+
+def test_transitive_reachability_names_the_chain(result):
+    [f] = [
+        f
+        for f in result.findings
+        if f.rule == "jit-purity" and "time.time" in f.message
+    ]
+    assert "transitive_root" in f.message and "_helper" in f.message
+
+
+def test_surface_op_flags_both_failure_modes(result):
+    ops = [f for f in result.findings if f.rule == "surface-op"]
+    assert len(ops) == 2
+    assert all(f.symbol.startswith("mystery") for f in ops)
+    assert {f.symbol for f in ops} == {"mystery", "mystery:client"}
+
+
+def test_documented_names_do_not_fire(result):
+    text = " ".join(f.message for f in result.findings)
+    assert "kccap_fixture_documented_total" not in text
+    assert "KCCAP_FIXTURE_DOCUMENTED" not in text
+    assert "-documented-flag" not in text
+    assert "`ping`" not in text
+
+
+def test_inline_suppression_admits_exactly_the_marked_line(result):
+    sup = [f for f in result.suppressed if f.rule == "lock-discipline"]
+    assert len(sup) == 1
+    assert sup[0].symbol == "Racy._errors@suppressed_read"
+    live = {f.symbol for f in result.findings if f.rule == "lock-discipline"}
+    assert sup[0].symbol not in live
+
+
+def test_locked_suffix_convention_is_honored(result):
+    assert not any(
+        "_total_locked" in f.symbol
+        for f in result.findings
+        if f.rule == "lock-discipline"
+    )
+
+
+def test_baseline_round_trip(tmp_path, result):
+    path = os.path.join(tmp_path, "baseline.json")
+    Baseline.from_findings(
+        result.findings, history=["test: accept everything"]
+    ).save(path)
+    reloaded = Baseline.load(path)
+    assert reloaded.history == ["test: accept everything"]
+    rerun = Analyzer(Project(FIXTURE_PKG), baseline=reloaded).run()
+    assert rerun.clean
+    assert len(rerun.baselined) == len(result.findings)
+
+
+def test_baseline_is_line_independent(result):
+    f = result.findings[0]
+    moved = type(f)(
+        rule=f.rule,
+        severity=f.severity,
+        path=f.path,
+        line=f.line + 40,
+        col=0,
+        message=f.message,
+        symbol=f.symbol,
+    )
+    bl = Baseline.from_findings([f])
+    assert bl.matches(moved)
+
+
+def test_rules_subset_runs_only_named_families():
+    result = Analyzer(Project(FIXTURE_PKG), rules=("lock-discipline",)).run()
+    assert result.findings
+    assert {f.rule for f in result.findings} == {"lock-discipline"}
+
+
+def test_unknown_rule_family_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        Analyzer(Project(FIXTURE_PKG), rules=("no-such-rule",))

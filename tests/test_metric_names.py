@@ -1,7 +1,8 @@
 """Metric-name conformance: every metric registered anywhere in the
 package is ``kccap_``-prefixed snake_case AND documented in the README;
-every PHASE name recorded anywhere is in the fixed vocabulary AND in
-the README's phase table.
+every ``KCCAP_*`` env var read anywhere is in the README's
+configuration table; every PHASE name recorded anywhere is in the
+fixed vocabulary AND in the README's phase table.
 
 The scan is textual (every ``"kccap_..."`` string literal / every
 ``.record("...")`` / ``.phase("...")`` call in the package sources) so
@@ -118,6 +119,49 @@ def test_every_metric_is_documented_in_readme():
         pytest.fail(
             "metrics registered in the package but missing from the "
             "README observability table: " + ", ".join(undocumented)
+        )
+
+
+_ENV_RE = re.compile(r"KCCAP_[A-Z][A-Z0-9_]*")
+
+
+def _source_env_names() -> set[str]:
+    """Every ``KCCAP_*`` env-var literal in the package sources (the
+    same textual walk as the metric scan, so an env switch cannot dodge
+    documentation by living in a module no test imports).  The same
+    invariant is enforced per-line by ``kccap-lint``'s ``surface-env``
+    rule; this walk keeps the conformance gate standing even if the
+    analyzer is skipped."""
+    names: set[str] = set()
+    for root, dirs, files in os.walk(_PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            with open(os.path.join(root, f), encoding="utf-8") as fh:
+                text = fh.read()
+            names.update(_ENV_RE.findall(text))
+    return names
+
+
+def test_env_scan_finds_the_known_switches():
+    # Sanity: a broken scan must fail loudly, not vacuously pass.
+    names = _source_env_names()
+    assert {"KCCAP_TELEMETRY", "KCCAP_DEVCACHE"} <= names
+
+
+def test_every_env_var_is_documented_in_readme():
+    with open(_README, encoding="utf-8") as fh:
+        readme = fh.read()
+    undocumented = sorted(
+        n
+        for n in _source_env_names()
+        if not re.search(rf"(?<![A-Z0-9_]){re.escape(n)}(?![A-Z0-9_])", readme)
+    )
+    if undocumented:
+        pytest.fail(
+            "env vars read in the package but missing from the README "
+            "configuration table: " + ", ".join(undocumented)
         )
 
 
