@@ -136,6 +136,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "the exact sweep kernels (node counts pad to the "
                         "next power of two >= the floor; 0 = keep the "
                         "default/KCCAP_NODE_BUCKET_FLOOR setting)")
+    p.add_argument("-group-min-count", type=int, default=0,
+                   dest="group_min_count", metavar="K",
+                   help="minimum mean nodes-per-group for the node-shape"
+                        "-compressed (grouped) sweep dispatch to engage "
+                        "(KCCAP_GROUPING=0 disables grouping; 0 = keep "
+                        "the default/KCCAP_GROUP_MIN_COUNT setting)")
     p.add_argument("-timeline", default=None, metavar="HOST:PORT",
                    help="render a running capacity service's timeline "
                         "(per-generation watchlist capacities, attributed "
@@ -312,6 +318,10 @@ def _run_command(args) -> int:
         from kubernetesclustercapacity_tpu import devcache
 
         devcache.set_node_bucket_floor(args.node_bucket_floor)
+    if args.group_min_count > 0:
+        from kubernetesclustercapacity_tpu import snapshot as _snapshot_mod
+
+        _snapshot_mod.set_group_min_count(args.group_min_count)
 
     try:
         scenario = scenario_from_flags(

@@ -270,6 +270,72 @@ class DeviceCache:
 
         return self.get(snapshot, ("exact", b), build)
 
+    def grouped_arrays(self, grouped, *, bucket: int | None = None) -> tuple:
+        """The 8 grouped-kernel inputs (7 shape columns + counts),
+        zero-padded to the GROUP bucket and device-resident — the pow2
+        ladder now buckets *groups*, so a degenerate million-node fleet
+        stages O(groups) device bytes, not O(nodes).  Zero-count padded
+        rows contribute nothing to the weighted sum.  Keyed on the
+        PARENT snapshot (the grouped form is memoized on it), under the
+        ``"grouped"`` form label."""
+        import jax.numpy as jnp
+
+        snapshot = grouped.snapshot
+        g = grouped.n_groups
+        b = node_bucket(g) if bucket is None else int(bucket)
+
+        def build() -> tuple:
+            pad = b - g
+            out = []
+            for a in (
+                grouped.alloc_cpu_milli,
+                grouped.alloc_mem_bytes,
+                grouped.alloc_pods,
+                grouped.used_cpu_req_milli,
+                grouped.used_mem_req_bytes,
+                grouped.pods_count,
+                grouped.healthy,
+                grouped.count,
+            ):
+                a = np.asarray(a)
+                out.append(jnp.asarray(np.pad(a, (0, pad)) if pad else a))
+            return tuple(out)
+
+        # The kernel consumes the first 7 positionally; the staged counts
+        # ride in slot 8 for unmasked sweeps (a node_mask replaces them
+        # with per-request effective counts).
+        return self.get(snapshot, ("grouped", b), build)
+
+    def grouped_pallas_arrays(self, grouped) -> tuple:
+        """The 6 fused-kernel GROUP operands in kernel layout plus the
+        int32 count tiles, padded to the Pallas tile grid and
+        device-resident (form ``"grouped"`` with the fused tile shape in
+        the key)."""
+        import jax.numpy as jnp
+
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            pad_node_array,
+            padded_node_shape,
+        )
+
+        snapshot = grouped.snapshot
+        n_pad = padded_node_shape(grouped.n_groups)
+
+        def build() -> tuple:
+            return tuple(
+                jnp.asarray(pad_node_array(a, n_pad, kib=kib))
+                for a, kib in (
+                    (grouped.alloc_cpu_milli, False),
+                    (grouped.alloc_mem_bytes, True),
+                    (grouped.alloc_pods, False),
+                    (grouped.used_cpu_req_milli, False),
+                    (grouped.used_mem_req_bytes, True),
+                    (grouped.pods_count, False),
+                )
+            )
+
+        return self.get(snapshot, ("grouped", "pallas", n_pad), build)
+
     def pallas_arrays(self, snapshot) -> tuple:
         """The 6 fused-kernel node operands in kernel layout
         (``(n_pad/LANES, LANES)`` int32, memory KiB-rescaled), padded to

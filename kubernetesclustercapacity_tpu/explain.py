@@ -52,7 +52,10 @@ import numpy as np
 from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
 from kubernetesclustercapacity_tpu.ops.fit import _trunc_div
 from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
-from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+from kubernetesclustercapacity_tpu.snapshot import (
+    ClusterSnapshot,
+    grouped_for_dispatch,
+)
 
 __all__ = [
     "BINDING_NAMES",
@@ -495,22 +498,59 @@ def explain_snapshot(
 ) -> ExplainResult:
     """Explain a whole sweep: ``ClusterSnapshot`` × ``ScenarioGrid`` →
     :class:`ExplainResult` (numpy).  ``mode`` defaults to the snapshot's
-    own packing semantics — the same rule the service applies."""
+    own packing semantics — the same rule the service applies.
+
+    Degenerate fleets run the attribution kernel over node-shape GROUPS
+    (:meth:`..snapshot.ClusterSnapshot.grouped`) and expand every
+    ``[S, G]`` output back to ``[S, N]`` through the group→node index
+    map — identical rows get identical attribution, so the expansion is
+    bit-exact and every report stays node-granular.  ``node_mask``
+    re-applies per node after expansion (the same last-wins override the
+    per-node kernel gives it).  ``KCCAP_GROUPING=0`` restores the
+    per-node kernel exactly."""
     mode = mode or snapshot.semantics
     grid.validate()
-    fits, code, cpu_fit, mem_fit, slots = explain_grid(
-        snapshot.alloc_cpu_milli,
-        snapshot.alloc_mem_bytes,
-        snapshot.alloc_pods,
-        snapshot.used_cpu_req_milli,
-        snapshot.used_mem_req_bytes,
-        snapshot.pods_count,
-        snapshot.healthy,
-        grid.cpu_request_milli,
-        grid.mem_request_bytes,
-        mode=mode,
-        node_mask=node_mask,
-    )
+    grouped = grouped_for_dispatch(snapshot)
+    if grouped is not None:
+        fits_g, code_g, cpu_fit_g, mem_fit_g, slots_g = explain_grid(
+            grouped.alloc_cpu_milli,
+            grouped.alloc_mem_bytes,
+            grouped.alloc_pods,
+            grouped.used_cpu_req_milli,
+            grouped.used_mem_req_bytes,
+            grouped.pods_count,
+            grouped.healthy,
+            grid.cpu_request_milli,
+            grid.mem_request_bytes,
+            mode=mode,
+            # No mask inside the kernel: the mask is per NODE, so it is
+            # re-applied after the group→node expansion below.
+        )
+        fits = grouped.expand(np.asarray(fits_g))
+        code = grouped.expand(np.asarray(code_g))
+        cpu_fit = grouped.expand(np.asarray(cpu_fit_g))
+        mem_fit = grouped.expand(np.asarray(mem_fit_g))
+        slots = grouped.expand(np.asarray(slots_g))
+        if node_mask is not None:
+            mask_row = np.asarray(node_mask, dtype=bool)[None, :]
+            fits = np.where(mask_row, fits, 0)
+            code = np.where(
+                mask_row, code, np.int32(BINDING_MASKED)
+            ).astype(code.dtype)
+    else:
+        fits, code, cpu_fit, mem_fit, slots = explain_grid(
+            snapshot.alloc_cpu_milli,
+            snapshot.alloc_mem_bytes,
+            snapshot.alloc_pods,
+            snapshot.used_cpu_req_milli,
+            snapshot.used_mem_req_bytes,
+            snapshot.pods_count,
+            snapshot.healthy,
+            grid.cpu_request_milli,
+            grid.mem_request_bytes,
+            mode=mode,
+            node_mask=node_mask,
+        )
     return ExplainResult(
         snapshot=snapshot,
         mode=mode,

@@ -59,26 +59,34 @@ def synthetic_fixture(
     the Running-only field-selector semantics (Q7) are exercised.
 
     .. note:: The returned fixture ALIASES mutable objects: one shared
-       container dict per distinct request shape, one shared initContainers
-       list, and one shared conditions list for all healthy nodes (a few
-       dozen objects serve ~100k containers — this is where the generator's
-       speed comes from).  Treat fixtures as immutable JSON-shaped data, as
-       every framework consumer does; to tweak one pod in place,
-       ``json.loads(json.dumps(fx))`` first (or replace whole
-       containers/conditions values rather than mutating them).  Per-node
-       dicts (``allocatable``, ``labels``, ``taints``) are NOT shared.
+       container dict per distinct request shape, one shared containers
+       LIST per distinct per-pod shape combination, one shared
+       initContainers list, and one shared conditions list for all healthy
+       nodes (a few dozen objects serve ~100k containers — this is where
+       the generator's speed comes from).  Treat fixtures as immutable
+       JSON-shaped data, as every framework consumer does; to tweak one
+       pod in place, ``json.loads(json.dumps(fx))`` first (or replace
+       whole containers/conditions values rather than mutating them).
+       Per-node dicts (``allocatable``, ``labels``, ``taints``) are NOT
+       shared.
     """
     # All randomness is pre-drawn as numpy arrays (one generator call per
-    # decision KIND, not per object) — at 10k nodes / ~115k pods the old
-    # per-object random.choice walk was ~2.4 s of pure draw overhead; the
-    # remaining cost is dict assembly.  Same schema and distributions;
-    # per-seed VALUES differ from the pre-vectorization generator (tests
-    # compare paths on the same fixture, never absolute contents).
+    # decision KIND, not per object), per-container attributes collapse to
+    # ONE integer shape code via numpy column math, every repeated
+    # sub-object (container dicts, per-pod container lists, conditions)
+    # is interned, and the per-pod columns (names, node names, phases,
+    # namespaces, container lists) are assembled as whole columns —
+    # object-array gathers and C-level repeats — so the only per-pod
+    # Python bytecode left is one dict literal in a zip comprehension.
+    # Same schema and distributions; per-seed VALUES differ from earlier
+    # generator versions (tests compare paths on the same fixture, never
+    # absolute contents).
+    import gc
+
     import numpy as np
 
     rng = np.random.default_rng(seed)
     nodes = []
-    pods = []
 
     cores_all = rng.choice(np.asarray(_CPU_CORES_CHOICES), size=n_nodes)
     mem_slack = rng.integers(0, 2**18, size=n_nodes)
@@ -89,14 +97,14 @@ def synthetic_fixture(
     pods_per = rng.integers(0, pods_per_node * 2, size=n_nodes)
 
     n_pods = int(pods_per.sum()) + unscheduled_running_pods
-    phases = rng.choice(
-        np.asarray(("Running", "Pending", "Succeeded", "Failed", "Unknown")),
+    _PHASES = ("Running", "Pending", "Succeeded", "Failed", "Unknown")
+    phase_idx = rng.choice(
+        np.arange(len(_PHASES)),
         size=n_pods,
         p=np.asarray((88, 4, 4, 2, 2)) / 100.0,
     )
-    namespaces = rng.choice(
-        np.asarray(("default", "kube-system", "batch", "web")), size=n_pods
-    )
+    _NAMESPACES = ("default", "kube-system", "batch", "web")
+    ns_idx = rng.choice(np.arange(len(_NAMESPACES)), size=n_pods)
     n_containers = rng.choice(
         np.asarray((1, 2, 3)), size=n_pods, p=np.asarray((0.7, 0.2, 0.1))
     )
@@ -104,81 +112,84 @@ def synthetic_fixture(
     n_total_containers = int(n_containers.sum())
     has_req = rng.random(n_total_containers) < 0.9
     has_lim = rng.random(n_total_containers) < 0.7
-    cpu_reqs = rng.choice(
-        np.asarray(_CONTAINER_CPU_REQ), size=n_total_containers
-    )
-    mem_reqs = rng.choice(
-        np.asarray(_CONTAINER_MEM_REQ), size=n_total_containers
-    )
+    cpu_idx = rng.integers(0, len(_CONTAINER_CPU_REQ), size=n_total_containers)
+    mem_idx = rng.integers(0, len(_CONTAINER_MEM_REQ), size=n_total_containers)
 
-    # Python lists for the per-object reads: numpy scalar extraction costs
-    # ~100 ns per index, which at ~500k reads would give back most of the
-    # vectorization win.
-    cores_all = cores_all.tolist()
-    mem_slack = mem_slack.tolist()
-    unhealthy_all = unhealthy_all.tolist()
-    unhealthy_cond = unhealthy_cond.tolist()
-    unparseable_all = unparseable_all.tolist()
-    tainted_all = tainted_all.tolist()
-    pods_per = pods_per.tolist()
-    phases = phases.tolist()
-    namespaces = namespaces.tolist()
-    n_containers = n_containers.tolist()
-    has_init = has_init.tolist()
-    has_req = has_req.tolist()
-    has_lim = has_lim.tolist()
-    cpu_reqs = cpu_reqs.tolist()
-    mem_reqs = mem_reqs.tolist()
+    # One integer code per container: (cpu, mem, has_lim) collapsed, -1
+    # for the no-requests shape — then one integer COMBO per pod (its
+    # containers' codes base-shifted into a single int), all as numpy
+    # column math.  Container dicts intern per code, containers LISTS
+    # intern per combo (a cluster has few distinct request shapes, so
+    # both LUTs stay tiny).
+    n_mem = len(_CONTAINER_MEM_REQ)
+    codes = np.where(
+        has_req, (cpu_idx * n_mem + mem_idx) * 2 + has_lim, -1
+    ).astype(np.int64)
+    container_lut: dict[int, dict] = {}
+    for code in np.unique(codes).tolist():
+        if code < 0:
+            container_lut[code] = {"resources": {}}
+            continue
+        lim = code % 2
+        cpu = _CONTAINER_CPU_REQ[code // 2 // n_mem]
+        mem = _CONTAINER_MEM_REQ[code // 2 % n_mem]
+        resources = {"requests": {"cpu": cpu, "memory": mem}}
+        if lim:
+            resources["limits"] = {"cpu": cpu, "memory": mem}
+        container_lut[code] = {"resources": resources}
 
-    pid = cid = 0
-
-    # Container dicts are INTERNED: the distinct (cpu, mem, has_lim) shapes
-    # number a few dozen, so each shape is built once and the same object is
-    # shared by every container with that shape (and likewise the one
-    # no-requests container and the one init-container list).  Fixtures are
-    # read-only JSON-shaped data everywhere downstream (packers, oracle,
-    # store — event updates build NEW dicts; the store deep-copies on
-    # ingestion), so sharing is safe and ``json.dump`` serializes it
-    # identically to the unshared equivalent.  See the docstring note.
-    _container_lut: dict = {}
-
-    def make_container(ci: int) -> dict:
-        if not has_req[ci]:  # some containers set no requests at all
-            key = None
-        else:
-            key = (cpu_reqs[ci], mem_reqs[ci], has_lim[ci])
-        c = _container_lut.get(key)
-        if c is None:
-            resources: dict = {}
-            if key is not None:
-                cpu, mem, lim = key
-                resources["requests"] = {"cpu": cpu, "memory": mem}
-                if lim:
-                    resources["limits"] = {"cpu": cpu, "memory": mem}
-            c = _container_lut[key] = {"resources": resources}
-        return c
+    starts = np.zeros(n_pods, dtype=np.int64)
+    if n_pods > 1:
+        np.cumsum(n_containers[:-1], out=starts[1:])
+    base = 2 * len(_CONTAINER_CPU_REQ) * n_mem + 2  # codes span [-1, base-3]
+    combo = codes[starts] + 2
+    if n_pods:
+        # Second/third container codes (index wraps harmlessly for pods
+        # that don't have one — the where() discards the gathered value).
+        wrap = max(n_total_containers, 1)
+        second = np.where(
+            n_containers >= 2, codes[(starts + 1) % wrap] + 2, 0
+        )
+        third = np.where(
+            n_containers >= 3, codes[(starts + 2) % wrap] + 2, 0
+        )
+        combo = combo + base * second + base * base * third
+    combo = combo.astype(np.int32)  # base**3 < 2^31: cheaper unique sort
+    clist_lut: dict[int, list] = {}
+    for cb in np.unique(combo).tolist():
+        # The combo int IS the container-code sequence (base-shifted), so
+        # each distinct list decodes straight from the key.
+        c0, rest = cb % base - 2, cb // base
+        lst = [container_lut[c0]]
+        while rest:
+            lst.append(container_lut[rest % base - 2])
+            rest //= base
+        clist_lut[cb] = lst
 
     _init_containers = [
         {"resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
     ]
 
-    def make_pod(name: str, node_name: str) -> dict:
-        nonlocal pid, cid
-        containers = []
-        for _ in range(n_containers[pid]):
-            containers.append(make_container(cid))
-            cid += 1
-        pod = {
-            "name": name,
-            "namespace": namespaces[pid],
-            "nodeName": node_name,
-            "phase": phases[pid],
-            "containers": containers,
-        }
-        if has_init[pid]:  # init containers exist but must be ignored (Q7)
-            pod["initContainers"] = _init_containers
-        pid += 1
-        return pod
+    # Python lists for the remaining per-object reads: numpy scalar
+    # extraction costs ~100 ns per index, which at ~500k reads would give
+    # back most of the vectorization win.  String columns gather through
+    # object arrays (C-level pointer copies, no per-element formatting).
+    mem_kib_col = (
+        cores_all.astype(np.int64) * (4 * 1024 * 1024) - mem_slack
+    ).tolist()
+    unhealthy_idx = np.flatnonzero(unhealthy_all).tolist()
+    cores_all = cores_all.tolist()
+    unhealthy_cond = unhealthy_cond.tolist()
+    unparseable_all = unparseable_all.tolist()
+    tainted_all = tainted_all.tolist()
+    pods_per_l = pods_per.tolist()
+    phases = np.asarray(_PHASES, dtype=object)[phase_idx].tolist()
+    namespaces = np.asarray(_NAMESPACES, dtype=object)[ns_idx].tolist()
+
+    # Pod-name suffix table: "-000", "-001", ... built once (pods_per is
+    # bounded by 2*pods_per_node), so a pod name is prefix + table slot.
+    max_per = max(pods_per_l, default=0)
+    suffixes = [f"-{j:03d}" for j in range(max_per)]
 
     # One shared conditions list serves every healthy node (same interning
     # rationale as containers); unhealthy nodes build their own copy since
@@ -186,53 +197,91 @@ def synthetic_fixture(
     _healthy_conditions = [
         {"type": t, "status": "False"} for t in _CONDITION_TYPES[:4]
     ] + [{"type": "Ready", "status": "True"}]
+    _zones = ("zone-0", "zone-1", "zone-2")
+    _cores_str = {c: str(c) for c in _CPU_CORES_CHOICES}
+    _taint = {"key": "dedicated", "value": "batch", "effect": "NoSchedule"}
 
-    for i in range(n_nodes):
-        name = f"node-{i:05d}"
-        cores = cores_all[i]
-        # Kubelet-style: a little less than the round GiB figure, in Ki.
-        mem_kib = cores * 4 * 1024 * 1024 - mem_slack[i]
-
-        if unhealthy_all[i]:
+    # The bulk-assembly phase allocates ~N + ΣP acyclic dicts; pausing the
+    # cyclic GC for it avoids ~500 young-generation scans over an
+    # ever-growing live set (the objects survive anyway — nothing here is
+    # garbage until the fixture itself is).
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        node_names = [f"node-{i:05d}" for i in range(n_nodes)]
+        # Kubelet-style memory: a little less than the round GiB figure,
+        # in Ki — except the unparseable fraction, which advertises "Gi"
+        # (bytefmt rejects it, Q5).
+        mem_strs = [
+            f"{m // 1024**2}Gi" if bad else f"{m}Ki"
+            for m, bad in zip(mem_kib_col, unparseable_all)
+        ]
+        # Shared conditions column; only the unhealthy minority builds its
+        # own copy (one entry differs).
+        conds_col = [_healthy_conditions] * n_nodes
+        for i in unhealthy_idx:
             conditions = [dict(c) for c in _healthy_conditions]
             conditions[unhealthy_cond[i]]["status"] = "True"
-        else:
-            conditions = _healthy_conditions
-
-        node = {
-            "name": name,
-            "allocatable": {
-                "cpu": str(cores),
-                "memory": (
-                    f"{mem_kib // 1024**2}Gi"
-                    if unparseable_all[i]
-                    else f"{mem_kib}Ki"
-                ),
-                "pods": "110",
-            },
-            "conditions": conditions,
-            "labels": {
-                "kubernetes.io/hostname": name,
-                "zone": f"zone-{i % 3}",
-                "pool": "default" if i % 4 else "highmem",
-            },
-            "taints": [],
-        }
-        if tainted_all[i]:
-            node["taints"].append(
-                {"key": "dedicated", "value": "batch", "effect": "NoSchedule"}
+            conds_col[i] = conditions
+        n_range = range(n_nodes)
+        nodes = [
+            {
+                "name": nm,
+                "allocatable": {
+                    "cpu": _cores_str[cores],
+                    "memory": ms,
+                    "pods": "110",
+                },
+                "conditions": cd,
+                "labels": {
+                    "kubernetes.io/hostname": nm,
+                    "zone": _zones[i % 3],
+                    "pool": "default" if i % 4 else "highmem",
+                },
+                "taints": [_taint.copy()] if tn else [],
+            }
+            for i, nm, cores, ms, cd, tn in zip(
+                n_range, node_names, cores_all, mem_strs, conds_col,
+                tainted_all,
             )
-        nodes.append(node)
+        ]
 
-        for j in range(pods_per[i]):
-            pods.append(make_pod(f"pod-{i:05d}-{j:03d}", name))
-
-    for k in range(unscheduled_running_pods):
-        orphan = make_pod(f"orphan-{k:03d}", "")
-        # Orphans must be Running (they exist to exercise the phantom-node
-        # matching), regardless of the pre-drawn phase.
-        orphan["phase"] = "Running"
-        pods.append(orphan)
+        # -- pod columns, then one zip comprehension ---------------------
+        n_scheduled = n_pods - unscheduled_running_pods
+        pod_names = [
+            pfx + sfx
+            for pfx, k in zip(node_names, pods_per_l)
+            for sfx in suffixes[:k]
+        ]
+        pod_names.extend(
+            f"orphan-{k:03d}" for k in range(unscheduled_running_pods)
+        )
+        node_of_pod = np.repeat(
+            np.asarray(node_names, dtype=object), pods_per
+        ).tolist()
+        # Orphans bind to phantom nodes through the empty nodeName (Q4)
+        # and must be Running regardless of the pre-drawn phase.
+        node_of_pod.extend([""] * unscheduled_running_pods)
+        phases[n_scheduled:] = ["Running"] * unscheduled_running_pods
+        clists = [clist_lut[cb] for cb in combo.tolist()]
+        pods = [
+            {
+                "name": nm,
+                "namespace": ns,
+                "nodeName": nn,
+                "phase": ph,
+                "containers": cl,
+            }
+            for nm, ns, nn, ph, cl in zip(
+                pod_names, namespaces, node_of_pod, phases, clists
+            )
+        ]
+        for p in np.flatnonzero(has_init).tolist():
+            # Init containers exist but must be ignored by reference (Q7).
+            pods[p]["initContainers"] = _init_containers
+    finally:
+        if gc_was_enabled:
+            gc.enable()
 
     return {"nodes": nodes, "pods": pods}
 

@@ -36,15 +36,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
-from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+from kubernetesclustercapacity_tpu.snapshot import (
+    ClusterSnapshot,
+    GroupedSnapshot,
+    grouped_for_dispatch,
+)
 
 __all__ = [
     "fit_per_node",
     "fit_totals",
     "sweep_grid",
     "sweep_grid_bucketed",
+    "sweep_grid_grouped",
+    "sweep_grouped_bucketed",
     "sweep_snapshot",
     "snapshot_device_arrays",
+    "grouped_device_arrays",
     "fit_per_node_multi",
     "sweep_grid_multi",
 ]
@@ -332,6 +339,189 @@ def sweep_grid_multi(
     return totals, schedulable
 
 
+@partial(jax.jit, static_argnames=("mode", "return_per_group"))
+def sweep_grid_grouped(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    counts,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+    return_per_group: bool = False,
+):
+    """S scenarios against G node-shape GROUPS, weighted by multiplicity.
+
+    The node-shape-compression kernel (ROADMAP item 1): inputs are the
+    grouped snapshot's ``[G]`` arrays plus ``counts[G]`` — how many
+    identical node rows each group stands for.  Per-group fits are the
+    ordinary :func:`fit_per_node` (identical inputs ⇒ identical outputs,
+    so a group's fit IS every member's fit) and the cluster total is
+    ``Σ_g count_g · fit_g``.  That weighted sum equals the per-node sum
+    *bit-exactly* even on wrapped int64 carriers: XLA's int64 multiply
+    and add are both mod-2^64, and ``n·x mod 2^64`` is ``x`` added ``n``
+    times mod 2^64.  Zero-count rows (bucket padding, masked-out groups)
+    contribute nothing by the same arithmetic.
+
+    Returns ``(totals[S], schedulable[S])`` and, with
+    ``return_per_group``, ``fits[S, G]`` for the caller to expand
+    through the group→node index map.
+    """
+    per_scenario = jax.vmap(
+        lambda c, m: fit_per_node(
+            alloc_cpu,
+            alloc_mem,
+            alloc_pods,
+            used_cpu,
+            used_mem,
+            pods_count,
+            healthy,
+            c,
+            m,
+            mode=mode,
+        )
+    )
+    fits = per_scenario(
+        jnp.asarray(cpu_reqs, jnp.int64), jnp.asarray(mem_reqs, jnp.int64)
+    )  # [S, G]
+    counts = jnp.asarray(counts, jnp.int64)
+    totals = jnp.sum(fits * counts[None, :], axis=1)
+    schedulable = totals >= jnp.asarray(replicas, jnp.int64)
+    if return_per_group:
+        return totals, schedulable, fits
+    return totals, schedulable
+
+
+def grouped_device_arrays(grouped: GroupedSnapshot) -> tuple:
+    """The 8 grouped-kernel inputs (7 columns + counts) on device once."""
+    return tuple(
+        jnp.asarray(a)
+        for a in (
+            grouped.alloc_cpu_milli,
+            grouped.alloc_mem_bytes,
+            grouped.alloc_pods,
+            grouped.used_cpu_req_milli,
+            grouped.used_mem_req_bytes,
+            grouped.pods_count,
+            grouped.healthy,
+            grouped.count,
+        )
+    )
+
+
+def sweep_grouped_bucketed(
+    grouped: GroupedSnapshot,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+    node_mask=None,
+    return_per_node: bool = False,
+):
+    """Shape-bucketed GROUPED sweep: the exact kernel over ``G`` group
+    rows instead of ``N`` node rows, results expanded back to per-node
+    where asked.
+
+    The pow2 bucket ladder now buckets *groups*: the padded device
+    arrays are ``O(G)`` (orders of magnitude below ``O(N)`` on a
+    degenerate fleet) and cache under the ``"grouped"`` devcache form.
+    ``node_mask`` folds into the per-group counts (a masked node's fit
+    is zeroed in every mode, so dropping it from its group's count is
+    the same sum) — per-group fits stay mask-independent and per-node
+    expansion re-applies the mask.  Bit-exact against the ungrouped
+    :func:`sweep_grid_bucketed` by the weighted-sum argument on
+    :func:`sweep_grid_grouped`.  Returns numpy arrays.
+    """
+    import time as _time
+
+    from kubernetesclustercapacity_tpu import devcache as _devcache
+    from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        enabled as _telemetry_enabled,
+    )
+
+    g = grouped.n_groups
+    s = int(np.asarray(cpu_reqs).shape[0])
+    counts = grouped.effective_counts(node_mask)
+    clk = _phases.current()
+
+    if not _devcache.enabled():
+        t0 = _time.perf_counter() if clk else 0.0
+        out = sweep_grid_grouped(
+            grouped.alloc_cpu_milli, grouped.alloc_mem_bytes,
+            grouped.alloc_pods, grouped.used_cpu_req_milli,
+            grouped.used_mem_req_bytes, grouped.pods_count,
+            grouped.healthy, counts, cpu_reqs, mem_reqs, replicas,
+            mode=mode, return_per_group=return_per_node,
+        )
+        if clk:
+            t1 = _time.perf_counter()
+            clk.record("device_exec", t1 - t0)
+            out = tuple(np.asarray(o) for o in out)
+            clk.record("fetch", _time.perf_counter() - t1)
+        else:
+            out = tuple(np.asarray(o) for o in out)
+        return _expand_grouped_result(
+            out, grouped, node_mask, s, return_per_node
+        )
+
+    staged = _devcache.CACHE.grouped_arrays(grouped)
+    arrays = staged[:7]
+    bucket = int(arrays[0].shape[0])
+    if node_mask is None:
+        counts_p = staged[7]  # device-resident base counts
+    else:
+        counts_p = np.pad(counts, (0, bucket - g)) if bucket > g else counts
+    cpu_p, mem_p, rep_p = _pad_scenarios_bucketed(
+        cpu_reqs, mem_reqs, replicas, _devcache.scenario_bucket(s)
+    )
+    t0 = _time.perf_counter()
+    out = sweep_grid_grouped(
+        *arrays, counts_p, cpu_p, mem_p, rep_p,
+        mode=mode, return_per_group=return_per_node,
+    )
+    t_launch = _time.perf_counter()
+    out = tuple(np.asarray(o) for o in out)
+    t_done = _time.perf_counter()
+    kind = None
+    if _telemetry_enabled():
+        from kubernetesclustercapacity_tpu.telemetry.compilewatch import (
+            observe_dispatch,
+        )
+
+        kind = observe_dispatch(f"xla_int64_grouped@g{bucket}", t_done - t0)
+    if clk:
+        if kind == "compile":
+            clk.record("compile", t_done - t0)
+        else:
+            clk.record("device_exec", t_launch - t0)
+            clk.record("fetch", t_done - t_launch)
+    out = (out[0][:s], out[1][:s]) + (
+        (out[2][:s, :g],) if return_per_node else ()
+    )
+    return _expand_grouped_result(out, grouped, node_mask, s, return_per_node)
+
+
+def _expand_grouped_result(out, grouped, node_mask, s, return_per_node):
+    """Slice/expand a grouped sweep's outputs to the caller's shapes:
+    totals/schedulable ``[S]``, plus per-node fits gathered through
+    ``group_index`` (mask re-applied) when asked."""
+    totals, sched = out[0][:s], out[1][:s]
+    if not return_per_node:
+        return totals, sched
+    fits = grouped.expand(out[2][:s])
+    if node_mask is not None:
+        fits = np.where(np.asarray(node_mask, dtype=bool)[None, :], fits, 0)
+    return totals, sched, fits
+
+
 def snapshot_device_arrays(snapshot: ClusterSnapshot) -> tuple:
     """Put a snapshot's kernel inputs on device once (reused across sweeps)."""
     return tuple(
@@ -495,6 +685,11 @@ def sweep_snapshot(
     node/scenario counts recompile only when they cross a bucket edge.
     ``node_mask`` ([N] bool, optional) zeroes constraint-infeasible
     nodes for every scenario.  Returns numpy arrays.
+
+    Degenerate fleets dispatch through the node-shape-compressed form
+    (:func:`sweep_grouped_bucketed`) when
+    :func:`..snapshot.grouped_for_dispatch` says it pays —
+    ``KCCAP_GROUPING=0`` restores the ungrouped dispatch exactly.
     """
     import time as _time
 
@@ -503,6 +698,27 @@ def sweep_snapshot(
     )
 
     grid.validate()
+    grouped = grouped_for_dispatch(snapshot)
+    if grouped is not None:
+        t0 = _time.perf_counter()
+        out = sweep_grouped_bucketed(
+            grouped,
+            grid.cpu_request_milli,
+            grid.mem_request_bytes,
+            grid.replicas,
+            mode=mode,
+            node_mask=node_mask,
+            return_per_node=return_per_node,
+        )
+        if _telemetry_enabled():
+            from kubernetesclustercapacity_tpu.telemetry.compilewatch import (
+                observe_dispatch,
+            )
+
+            observe_dispatch(
+                "xla_int64_grouped", _time.perf_counter() - t0
+            )
+        return out
     t0 = _time.perf_counter()
     out = sweep_grid_bucketed(
         snapshot.alloc_cpu_milli,

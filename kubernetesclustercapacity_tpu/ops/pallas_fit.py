@@ -38,7 +38,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from kubernetesclustercapacity_tpu import devcache as _devcache
-from kubernetesclustercapacity_tpu.ops.fit import sweep_grid_bucketed
+from kubernetesclustercapacity_tpu.ops.fit import (
+    sweep_grid_bucketed,
+    sweep_grouped_bucketed,
+)
+from kubernetesclustercapacity_tpu.snapshot import grouped_for_dispatch
 from kubernetesclustercapacity_tpu.resilience import (
     CircuitBreaker as _CircuitBreaker,
 )
@@ -227,6 +231,8 @@ def fast_sweep_eligible(
     pods_count,
     cpu_reqs,
     mem_reqs,
+    *,
+    counts=None,
 ) -> bool:
     """True iff the int32 KiB-rescaled kernel is bit-exact for these inputs.
 
@@ -242,10 +248,19 @@ def fast_sweep_eligible(
        (resource bound, the Q1 cap value, and its negative magnitude), and
        the kernel accumulates totals in int32 lanes — so the sum of those
        bounds must stay under 2^31.
+
+    ``counts`` (grouped dispatch) weights condition 3: the rows are node
+    GROUPS and each contributes ``count_g`` times, so the int32
+    accumulator bound is ``Σ count_g · bound_g``; the counts themselves
+    must also be non-negative int32 (they multiply inside the kernel).
     """
     for a in (alloc_cpu, used_cpu, cpu_reqs, alloc_pods, pods_count):
         a = np.asarray(a)
         if a.size and (a.min() < 0 or a.max() > _I32_MAX):
+            return False
+    if counts is not None:
+        c = np.asarray(counts)
+        if c.size and (c.min() < 0 or c.max() > _I32_MAX):
             return False
     for a in (alloc_mem, used_mem, mem_reqs):
         a = np.asarray(a)
@@ -266,6 +281,8 @@ def fast_sweep_eligible(
             np.asarray(pods_count, dtype=np.int64),
         ),
     )
+    if counts is not None:
+        per_node_bound = per_node_bound * np.asarray(counts, dtype=np.int64)
     return int(per_node_bound.sum()) <= _I32_MAX
 
 
@@ -429,13 +446,19 @@ def _fit_row_rcp(ac, am, ap, uc, um, pc, mk, cr, mr, crr, mrr, strict):
     return _epilogue(fit, ap, pc, mk, strict)
 
 
-def _make_sweep_kernel(use_rcp: bool, strict: bool, use_mask: bool):
+def _make_sweep_kernel(
+    use_rcp: bool, strict: bool, use_mask: bool, use_counts: bool = False
+):
     def kernel(*refs):
         ac, am, ap, uc, um, pc = refs[:6]
         i = 6
         mk = None
         if use_mask:
             mk = refs[i]
+            i += 1
+        ct = None
+        if use_counts:
+            ct = refs[i]
             i += 1
         cr, mr = refs[i], refs[i + 1]
         i += 2
@@ -462,15 +485,22 @@ def _make_sweep_kernel(use_rcp: bool, strict: bool, use_mask: bool):
             row = slice(r, r + 1)
             mk_row = mk[row] if use_mask else None
             if use_rcp:
-                acc += _fit_row_rcp(
+                fit = _fit_row_rcp(
                     ac[row], am[row], ap[row], uc[row], um[row], pc[row],
                     mk_row, cr, mr, crr, mrr, strict,
                 )
             else:
-                acc += _fit_row(
+                fit = _fit_row(
                     ac[row], am[row], ap[row], uc[row], um[row], pc[row],
                     mk_row, cr, mr, strict,
                 )
+            if use_counts:
+                # Grouped form: each lane is a node-shape GROUP standing
+                # for count identical rows — weight before accumulating
+                # (eligibility bounds Σ count·|fit| inside int32, and
+                # zero-count padded lanes vanish here).
+                fit = fit * ct[row]
+            acc += fit
         out[...] += acc
 
     return kernel
@@ -478,24 +508,27 @@ def _make_sweep_kernel(use_rcp: bool, strict: bool, use_mask: bool):
 
 @partial(jax.jit, static_argnames=("strict", "interpret"))
 def _sweep_pallas_padded(
-    ac, am, ap, uc, um, pc, cr, mr, mk=None, *, strict=False, interpret=False
+    ac, am, ap, uc, um, pc, cr, mr, mk=None, ct=None,
+    *, strict=False, interpret=False,
 ):
     """Inner jitted pallas sweep on padded arrays (int32 ``//`` kernel).
 
     ``ac..pc``: ``(N/128, 128)`` int32 node arrays; ``cr``/``mr``: ``(S, 1)``
     int32 requests; ``mk``: optional ``(N/128, 128)`` int32 0/1 constraint
-    mask (for strict mode this carries healthy∧constraints); returns int64
+    mask (for strict mode this carries healthy∧constraints); ``ct``:
+    optional ``(N/128, 128)`` int32 group counts (grouped form — each
+    lane's fit is weighted before the reduction); returns int64
     ``totals[S]``.
     """
     return _pallas_dispatch(
-        ac, am, ap, uc, um, pc, mk, cr, mr, None, None,
+        ac, am, ap, uc, um, pc, mk, ct, cr, mr, None, None,
         use_rcp=False, strict=strict, interpret=interpret,
     )
 
 
 @partial(jax.jit, static_argnames=("strict", "interpret"))
 def _sweep_pallas_padded_rcp(
-    ac, am, ap, uc, um, pc, cr, mr, crr, mrr, mk=None,
+    ac, am, ap, uc, um, pc, cr, mr, crr, mrr, mk=None, ct=None,
     *, strict=False, interpret=False,
 ):
     """Reciprocal-division variant: ``crr``/``mrr`` are f32 ``(S, 1)``
@@ -504,13 +537,13 @@ def _sweep_pallas_padded_rcp(
     rounded; the single-fixup proof depends on it).  Only valid on
     :func:`rcp_division_eligible` inputs."""
     return _pallas_dispatch(
-        ac, am, ap, uc, um, pc, mk, cr, mr, crr, mrr,
+        ac, am, ap, uc, um, pc, mk, ct, cr, mr, crr, mrr,
         use_rcp=True, strict=strict, interpret=interpret,
     )
 
 
 def _pallas_dispatch(
-    ac, am, ap, uc, um, pc, mk, cr, mr, crr, mrr,
+    ac, am, ap, uc, um, pc, mk, ct, cr, mr, crr, mrr,
     *, use_rcp, strict, interpret,
 ):
     n_rows = ac.shape[0]
@@ -530,10 +563,14 @@ def _pallas_dispatch(
     )
 
     use_mask = mk is not None
+    use_counts = ct is not None
     operands = (ac, am, ap, uc, um, pc)
     in_specs = [node_spec] * 6
     if use_mask:
         operands += (mk,)
+        in_specs += [node_spec]
+    if use_counts:
+        operands += (ct,)
         in_specs += [node_spec]
     operands += (cr, mr)
     in_specs += [scen_spec] * 2
@@ -548,7 +585,7 @@ def _pallas_dispatch(
     # way; only the trace-time index/promotion semantics change.
     with jax.enable_x64(False):
         partial_sums = pl.pallas_call(
-            _make_sweep_kernel(use_rcp, strict, use_mask),
+            _make_sweep_kernel(use_rcp, strict, use_mask, use_counts),
             out_shape=jax.ShapeDtypeStruct((s, LANES), jnp.int32),
             grid=grid,
             in_specs=in_specs,
@@ -621,6 +658,7 @@ def sweep_pallas(
     *,
     mode: str = "reference",
     node_mask=None,
+    counts=None,
     interpret: bool = False,
     use_rcp: bool | None = None,
     staged_nodes=None,
@@ -644,8 +682,12 @@ def sweep_pallas(
     device-resident 6-tuple of node operands in kernel layout (what
     :meth:`..devcache.DeviceCache.pallas_arrays` returns for this exact
     snapshot) — the per-request pad + host→device upload is skipped; the
-    positional node arrays are still consulted for ``n``.  Returns
-    ``(totals[S], schedulable[S])`` numpy arrays.
+    positional node arrays are still consulted for ``n``.  ``counts``
+    (``[N]`` int, optional — the grouped form) weights each row's fit by
+    its node-shape multiplicity inside the kernel; pass eligibility the
+    same counts (the int32 accumulator bound becomes count-weighted).
+    Padded count lanes fill 0, so they vanish from the reduction.
+    Returns ``(totals[S], schedulable[S])`` numpy arrays.
     """
     if mode not in ("reference", "strict"):
         raise ValueError(f"unknown mode {mode!r}")
@@ -678,6 +720,9 @@ def sweep_pallas(
         mk = pad_node_array(
             np.asarray(node_mask).astype(np.int64), n_pad
         )
+    ct = None
+    if counts is not None:
+        ct = pad_node_array(np.asarray(counts, dtype=np.int64), n_pad)
     strict = mode == "strict"
     import time as _time
 
@@ -686,11 +731,11 @@ def sweep_pallas(
     if use_rcp:
         recips = tuple(scenario_reciprocals(args[i]) for i in (6, 7))
         totals = _sweep_pallas_padded_rcp(
-            *args, *recips, mk, strict=strict, interpret=interpret
+            *args, *recips, mk, ct, strict=strict, interpret=interpret
         )
     else:
         totals = _sweep_pallas_padded(
-            *args, mk, strict=strict, interpret=interpret
+            *args, mk, ct, strict=strict, interpret=interpret
         )
     if clk:
         # Launch vs device→host sync, timed apart (same split as the
@@ -874,6 +919,123 @@ def sweep_auto(
     return totals, sched, "xla_int64"
 
 
+def _sweep_auto_grouped(
+    grouped,
+    grid,
+    *,
+    mode: str = "reference",
+    node_mask=None,
+    interpret: bool | None = None,
+    force_exact: bool = False,
+):
+    """:func:`sweep_auto`'s node-shape-compressed twin: the same
+    eligible→fused / ineligible→exact ladder over ``G`` group rows with
+    count weighting (ROADMAP item 1).
+
+    ``node_mask`` folds into the per-group effective counts (a masked
+    node's fit is zero in every mode, so removing it from its group's
+    multiplicity is the identical sum); strict mode's ``healthy`` rides
+    as the kernel lane mask exactly like the ungrouped fused path.
+    Shares the fused-path circuit breaker, counters and thread-local
+    attempt attribution with :func:`sweep_auto`.  Returns numpy
+    ``(totals[S], schedulable[S], kernel_name)`` with the grouped kernel
+    names ``pallas_i32{_rcp,}_fused_grouped`` / ``xla_int64_grouped``.
+    """
+    import time as _time
+
+    global last_fast_path_error
+    _dispatch_tls.attempted = False
+    _dispatch_tls.error = None
+    tel = _metrics() if _telemetry_enabled() else None
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    counts = grouped.effective_counts(node_mask)
+    kernel_mask = (
+        np.asarray(grouped.healthy, dtype=bool) if mode == "strict" else None
+    )
+    cpu_reqs = grid.cpu_request_milli
+    mem_reqs = grid.mem_request_bytes
+    fallback_reason = None
+    if force_exact:
+        fallback_reason = "forced_exact"
+    elif not fast_sweep_eligible(
+        grouped.alloc_cpu_milli, grouped.alloc_mem_bytes,
+        grouped.alloc_pods, grouped.used_cpu_req_milli,
+        grouped.used_mem_req_bytes, grouped.pods_count,
+        cpu_reqs, mem_reqs, counts=counts,
+    ):
+        fallback_reason = "ineligible"
+    elif not _breaker.allow():
+        fallback_reason = "breaker_open"
+    if fallback_reason is None:
+        _dispatch_tls.attempted = True
+        use_rcp = rcp_division_eligible(
+            grouped.alloc_cpu_milli, grouped.alloc_mem_bytes,
+            grouped.used_cpu_req_milli, grouped.used_mem_req_bytes,
+            cpu_reqs, mem_reqs,
+        )
+        staged = None
+        if _devcache.enabled():
+            try:
+                staged = _devcache.CACHE.grouped_pallas_arrays(grouped)
+            except Exception:  # noqa: BLE001 - cache is an optimization
+                staged = None
+        t0 = _time.perf_counter()
+        try:
+            totals, sched = sweep_pallas(
+                grouped.alloc_cpu_milli, grouped.alloc_mem_bytes,
+                grouped.alloc_pods, grouped.used_cpu_req_milli,
+                grouped.used_mem_req_bytes, grouped.pods_count,
+                cpu_reqs, mem_reqs, grid.replicas, mode=mode,
+                node_mask=kernel_mask, counts=counts,
+                interpret=interpret, use_rcp=use_rcp, staged_nodes=staged,
+            )
+        except Exception as e:  # noqa: BLE001 - availability over speed
+            # Same disposition policy as sweep_auto: transient failures
+            # degrade this request only, anything else trips the shared
+            # breaker (see sweep_auto's rationale).
+            last_fast_path_error = f"{type(e).__name__}: {e}"
+            _dispatch_tls.error = last_fast_path_error
+            transient = _is_transient_failure(e)
+            if not transient:
+                _breaker.record_failure(last_fast_path_error)
+            if tel is not None:
+                tel["failures"].labels(
+                    disposition="transient" if transient else "breaker_trip"
+                ).inc()
+            fallback_reason = "kernel_error"
+        else:
+            last_fast_path_error = None
+            _breaker.record_success()
+            name = (
+                "pallas_i32_rcp_fused_grouped"
+                if use_rcp
+                else "pallas_i32_fused_grouped"
+            )
+            if tel is not None:
+                dt = _time.perf_counter() - t0
+                tel["latency"].labels(kernel=name).observe(dt)
+                tel["hits"].inc()
+                kind = _compilewatch.observe_dispatch(name, dt)
+                if kind == "compile":
+                    clk = _phases.current()
+                    clk.move("device_exec", "compile")
+                    clk.move("fetch", "compile")
+            return totals, sched, name
+    if tel is not None:
+        tel["misses"].labels(reason=fallback_reason).inc()
+        t0 = _time.perf_counter()
+    totals, sched = sweep_grouped_bucketed(
+        grouped, cpu_reqs, mem_reqs, grid.replicas,
+        mode=mode, node_mask=node_mask,
+    )
+    if tel is not None:
+        dt = _time.perf_counter() - t0
+        tel["latency"].labels(kernel="xla_int64_grouped").observe(dt)
+        _compilewatch.observe_dispatch("xla_int64_grouped", dt)
+    return totals, sched, "xla_int64_grouped"
+
+
 def sweep_snapshot_auto(
     snapshot,
     grid,
@@ -908,6 +1070,20 @@ def sweep_snapshot_auto(
     if mode not in ("reference", "strict"):
         raise ValueError(f"unknown mode {mode!r}")
     grid.validate()
+    grouped = grouped_for_dispatch(snapshot)
+    if grouped is not None:
+        # Degenerate fleet: dispatch over node-shape groups with count
+        # weighting (bit-exact; KCCAP_GROUPING=0 restores this exact
+        # ungrouped path).  kernel="exact" forces the exact grouped
+        # kernel, same contract as the ungrouped escape hatch.
+        return _sweep_auto_grouped(
+            grouped,
+            grid,
+            mode=mode,
+            node_mask=node_mask,
+            interpret=interpret,
+            force_exact=(kernel == "exact"),
+        )
     return sweep_auto(
         snapshot.alloc_cpu_milli,
         snapshot.alloc_mem_bytes,

@@ -31,14 +31,24 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except AttributeError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
 
-from kubernetesclustercapacity_tpu.ops.fit import fit_per_node, sweep_grid
+from kubernetesclustercapacity_tpu.ops.fit import (
+    fit_per_node,
+    sweep_grid,
+    sweep_grid_grouped,
+)
 from kubernetesclustercapacity_tpu.parallel.mesh import (
     MeshPlan,
     NODE_AXIS,
     SCENARIO_AXIS,
 )
 
-__all__ = ["sweep_gspmd", "sweep_shard_map", "stage_gspmd_arrays"]
+__all__ = [
+    "sweep_gspmd",
+    "sweep_gspmd_grouped",
+    "sweep_shard_map",
+    "stage_gspmd_arrays",
+    "stage_gspmd_grouped_arrays",
+]
 
 
 def _pad_node_arrays(arrays: tuple, n_padded: int) -> tuple:
@@ -92,6 +102,86 @@ def stage_gspmd_arrays(plan: MeshPlan, snapshot) -> tuple:
         return tuple(jax.device_put(a, sharding) for a in arrays)
 
     return devcache.CACHE.get(snapshot, ("gspmd", mesh, n_padded), build)
+
+
+def stage_gspmd_grouped_arrays(plan: MeshPlan, grouped) -> tuple:
+    """A grouped snapshot's 7 shape columns + counts, padded to the plan
+    and ``device_put`` with the node-axis ``NamedSharding`` — the
+    heterogeneous-tail answer to ROADMAP item 1: once shape compression
+    has collapsed the degenerate bulk, the remaining truly-distinct rows
+    shard across the GSPMD mesh.  Cached per ``(snapshot, mesh,
+    padded-G)`` under the ``"gspmd_grouped"`` form (zero-count padded
+    rows contribute nothing to the weighted sum)."""
+    from kubernetesclustercapacity_tpu import devcache
+
+    g = grouped.n_groups
+    g_padded = plan.pad_nodes(g)
+    mesh = plan.mesh
+
+    def build() -> tuple:
+        arrays = _pad_node_arrays(
+            (
+                grouped.alloc_cpu_milli,
+                grouped.alloc_mem_bytes,
+                grouped.alloc_pods,
+                grouped.used_cpu_req_milli,
+                grouped.used_mem_req_bytes,
+                grouped.pods_count,
+                grouped.healthy,
+                grouped.count,
+            ),
+            g_padded,
+        )
+        sharding = NamedSharding(mesh, P(NODE_AXIS))
+        return tuple(jax.device_put(a, sharding) for a in arrays)
+
+    return devcache.CACHE.get(
+        grouped.snapshot, ("gspmd_grouped", mesh, g_padded), build
+    )
+
+
+def sweep_gspmd_grouped(
+    plan: MeshPlan,
+    grouped,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+    node_mask=None,
+):
+    """GSPMD sweep over node-shape groups: the ``[G]`` shape columns
+    shard over the mesh's node axis, scenarios over the scenario axis,
+    and the count-weighted reduction runs under GSPMD — XLA inserts the
+    cross-device sum exactly as it does for the ungrouped
+    :func:`sweep_gspmd`.  ``node_mask`` (``[N]`` bool over the PARENT
+    snapshot's nodes) folds into per-group effective counts, which then
+    replace the staged base counts for this call.  Bit-exact against the
+    unsharded grouped kernel (zero-padded rows carry count 0).
+    """
+    s = np.asarray(cpu_reqs).shape[0]
+    mesh = plan.mesh
+    staged = stage_gspmd_grouped_arrays(plan, grouped)
+    node_dev, counts_dev = staged[:7], staged[7]
+    if node_mask is not None:
+        counts = grouped.effective_counts(node_mask)
+        pad = int(np.asarray(staged[0]).shape[0]) - grouped.n_groups
+        counts = np.pad(counts, (0, pad)) if pad else counts
+        counts_dev = jax.device_put(
+            counts, NamedSharding(mesh, P(NODE_AXIS))
+        )
+    scen_sharding = NamedSharding(mesh, P(SCENARIO_AXIS))
+    cpu_p, mem_p, rep_p = _pad_scenarios(
+        cpu_reqs, mem_reqs, replicas, plan.pad_scenarios(s)
+    )
+    cpu_d = jax.device_put(cpu_p, scen_sharding)
+    mem_d = jax.device_put(mem_p, scen_sharding)
+    rep_d = jax.device_put(rep_p, scen_sharding)
+
+    totals, sched = sweep_grid_grouped(
+        *node_dev, counts_dev, cpu_d, mem_d, rep_d, mode=mode
+    )
+    return np.asarray(totals)[:s], np.asarray(sched)[:s]
 
 
 def sweep_gspmd(

@@ -28,7 +28,10 @@ from kubernetesclustercapacity_tpu.scenario import (
     scenario_from_flags,
 )
 from kubernetesclustercapacity_tpu.service import protocol
-from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
+from kubernetesclustercapacity_tpu.snapshot import (
+    ClusterSnapshot,
+    publish_group_metrics as _snapshot_publish_group_metrics,
+)
 from kubernetesclustercapacity_tpu.sources import resolve_source
 
 __all__ = ["CapacityServer"]
@@ -305,6 +308,11 @@ class CapacityServer:
         evaluation must never fail the publish it observes (the
         coalescer would treat that as a fatal publish error and kill a
         supervised serve over a diagnostic)."""
+        # Every publish path funnels here, so the node-shape-compression
+        # gauges (kccap_group_count / kccap_compression_ratio) update on
+        # the same publisher thread — itself best-effort and registry-
+        # silent under KCCAP_TELEMETRY=0 or KCCAP_GROUPING=0.
+        _snapshot_publish_group_metrics(snapshot)
         if self._timeline is None:
             return
         try:
@@ -699,7 +707,11 @@ class CapacityServer:
             # shape clients diff.
             if msg.get("hot_path"):
                 from kubernetesclustercapacity_tpu import devcache
+                from kubernetesclustercapacity_tpu import (
+                    snapshot as _snapshot_mod,
+                )
 
+                grouped = _snapshot_mod.grouped_for_dispatch(snap)
                 out["hot_path"] = {
                     "devcache": devcache.CACHE.stats(),
                     "node_bucket_floor": devcache.node_bucket_floor(),
@@ -708,6 +720,21 @@ class CapacityServer:
                         if self._batcher is not None
                         else None
                     ),
+                    "grouping": {
+                        "enabled": _snapshot_mod.grouping_enabled(),
+                        "engaged": grouped is not None,
+                        "group_min_count": _snapshot_mod.group_min_count(),
+                        **(
+                            {
+                                "groups": grouped.n_groups,
+                                "compression_ratio": round(
+                                    grouped.compression_ratio, 4
+                                ),
+                            }
+                            if grouped is not None
+                            else {}
+                        ),
+                    },
                 }
             # Opt-in (``info {audit: true}``): audit-log and
             # shadow-oracle status — replay/audit visibility without a
@@ -1786,6 +1813,13 @@ def main(argv=None) -> int:
                         "(node counts pad to the next power of two >= "
                         "the floor, so ±1-node churn reuses compiled "
                         "kernels; 0 = keep the default/env setting)")
+    p.add_argument("-group-min-count", type=int, default=0,
+                   dest="group_min_count", metavar="K",
+                   help="minimum mean nodes-per-group for the node-shape"
+                        "-compressed (grouped) dispatch to engage "
+                        "(KCCAP_GROUPING=0 disables grouping entirely; "
+                        "0 = keep the default/KCCAP_GROUP_MIN_COUNT "
+                        "setting)")
     p.add_argument("-watch", default=None, metavar="FILE",
                    help="watchlist (YAML/JSON) of named scenarios the "
                         "capacity timeline re-evaluates on every snapshot "
@@ -1929,6 +1963,10 @@ def main(argv=None) -> int:
         from kubernetesclustercapacity_tpu import devcache
 
         devcache.set_node_bucket_floor(args.node_bucket_floor)
+    if args.group_min_count > 0:
+        from kubernetesclustercapacity_tpu import snapshot as _snapshot_mod
+
+        _snapshot_mod.set_group_min_count(args.group_min_count)
     timeline = None
     if args.watch or args.timeline_depth > 0 or args.timeline_log:
         from kubernetesclustercapacity_tpu.timeline import (

@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import functools
 import json
+import os
+import threading
 from dataclasses import dataclass, field, fields
 
 import numpy as np
@@ -42,10 +44,17 @@ from kubernetesclustercapacity_tpu.utils import quantity as _q
 
 __all__ = [
     "ClusterSnapshot",
+    "GroupedSnapshot",
     "snapshot_from_fixture",
     "synthetic_snapshot",
     "load_snapshot",
     "snapshot_from_live_cluster",
+    "grouping_enabled",
+    "group_min_count",
+    "set_group_min_count",
+    "grouped_for_dispatch",
+    "publish_group_metrics",
+    "GROUPING_NODE_FLOOR",
 ]
 
 # Phases that never consume node capacity in strict mode (terminated pods).
@@ -166,6 +175,110 @@ class ClusterSnapshot:
         cache[resources] = (alloc_rn, used_rn)
         return cache[resources]
 
+    def grouped(self) -> "GroupedSnapshot":
+        """The node-shape-compressed form: identical rows deduplicated
+        into ``(shape, count)`` groups (ROADMAP item 1).
+
+        The grouping key is every column the kernels consume —
+        allocatable, usage (requests AND limits), pod counts, health, and
+        all extended-resource columns — so two rows land in one group iff
+        *every* fit-relevant value matches (duplicate shapes that differ
+        only in health do NOT merge).  Capacity is a sum over nodes, so
+        evaluating the ~100s of distinct shapes and weighting by count is
+        *exact*, not approximate; the :attr:`GroupedSnapshot.group_index`
+        map makes the compression invertible (any per-group array expands
+        back to per-node by a gather).
+
+        Memoized on the (immutable) snapshot: the ``np.unique`` row sort
+        runs once per snapshot, shared by every dispatch and the publish
+        gauges.  A concurrent first call may build twice; both results
+        are equal and either may win the cache slot.
+        """
+        hit = self.__dict__.get("_grouped_cache")
+        if hit is not None:
+            return hit
+        rows = self._group_rows()
+        ext_names = sorted(self.extended)
+        # Row-dedup via lexsort + boundary scan — semantically
+        # ``np.unique(rows, axis=0, return_inverse, return_counts)``
+        # (same lexicographic group order, column 0 most significant)
+        # but ~5x faster at 1M rows: axis-0 unique sorts void-typed row
+        # blobs with per-comparison overhead, while lexsort runs one
+        # typed argsort per column.
+        n = rows.shape[0]
+        if n:
+            order = np.lexsort(rows.T[::-1])
+            sorted_rows = rows[order]
+            boundary = np.empty(n, dtype=bool)
+            boundary[0] = True
+            np.any(
+                sorted_rows[1:] != sorted_rows[:-1], axis=1,
+                out=boundary[1:],
+            )
+            gid_sorted = np.cumsum(boundary) - 1
+            inverse = np.empty(n, dtype=np.int64)
+            inverse[order] = gid_sorted
+            uniq = sorted_rows[boundary]
+            counts = np.bincount(gid_sorted).astype(np.int64)
+        else:
+            uniq = rows
+            inverse = np.zeros(0, dtype=np.int64)
+            counts = np.zeros(0, dtype=np.int64)
+        g = uniq.shape[0]
+        # First-occurrence representative per group (stable: the lowest
+        # node row index carrying the shape).
+        representative = np.full(g, self.n_nodes, dtype=np.int64)
+        if self.n_nodes:
+            np.minimum.at(representative, inverse, np.arange(self.n_nodes))
+        ext = {
+            r: (
+                uniq[:, 9 + 2 * e].copy(),
+                uniq[:, 9 + 2 * e + 1].copy(),
+            )
+            for e, r in enumerate(ext_names)
+        }
+        grouped = GroupedSnapshot(
+            snapshot=self,
+            alloc_cpu_milli=uniq[:, 0].copy(),
+            alloc_mem_bytes=uniq[:, 1].copy(),
+            alloc_pods=uniq[:, 2].copy(),
+            used_cpu_req_milli=uniq[:, 3].copy(),
+            used_cpu_lim_milli=uniq[:, 4].copy(),
+            used_mem_req_bytes=uniq[:, 5].copy(),
+            used_mem_lim_bytes=uniq[:, 6].copy(),
+            pods_count=uniq[:, 7].copy(),
+            healthy=uniq[:, 8].astype(np.bool_),
+            count=counts,
+            group_index=inverse,
+            representative=representative,
+            extended=ext,
+        )
+        return self.__dict__.setdefault("_grouped_cache", grouped)
+
+    def _group_rows(self) -> np.ndarray:
+        """The ``[N, C]`` int64 grouping-key matrix: every fit-relevant
+        column (allocatable, usage req+lim, pods, health, extended) in a
+        fixed order — shared by :meth:`grouped` and the dispatch gate's
+        hash pre-check so the two can never disagree on the key."""
+        cols = [
+            self.alloc_cpu_milli,
+            self.alloc_mem_bytes,
+            self.alloc_pods,
+            self.used_cpu_req_milli,
+            self.used_cpu_lim_milli,
+            self.used_mem_req_bytes,
+            self.used_mem_lim_bytes,
+            self.pods_count,
+            self.healthy.astype(np.int64),
+        ]
+        for r in sorted(self.extended):
+            alloc, used = self.extended[r]
+            cols.append(np.asarray(alloc, dtype=np.int64))
+            cols.append(np.asarray(used, dtype=np.int64))
+        if not self.n_nodes:
+            return np.zeros((0, len(cols)), dtype=np.int64)
+        return np.stack(cols, axis=1)
+
     def save(self, path: str) -> None:
         """Checkpoint to ``.npz`` (arrays + JSON metadata), reproducibly."""
         meta = {
@@ -191,6 +304,244 @@ class ClusterSnapshot:
             arrays[f"ext_alloc::{r_name}"] = alloc
             arrays[f"ext_used::{r_name}"] = used
         np.savez_compressed(path, __meta__=json.dumps(meta), **arrays)
+
+
+@dataclass
+class GroupedSnapshot:
+    """Node-shape-compressed view of a :class:`ClusterSnapshot`.
+
+    ``G`` groups of identical node rows: every per-group array is ``[G]``
+    in the same column vocabulary as the parent snapshot, ``count[g]`` is
+    how many node rows share shape ``g``, and the two index maps make the
+    compression invertible:
+
+    * :attr:`group_index` — ``[N]`` node row → its group (the gather
+      ``per_group[group_index]`` expands any grouped result back to
+      per-node, bit-exactly, because identical inputs produce identical
+      kernel outputs);
+    * :attr:`representative` — ``[G]`` group → the lowest node row index
+      carrying the shape (so reports can name a real node per group).
+
+    Built exclusively by :meth:`ClusterSnapshot.grouped`; treat as
+    immutable, like the snapshot itself.
+    """
+
+    snapshot: ClusterSnapshot
+    alloc_cpu_milli: np.ndarray
+    alloc_mem_bytes: np.ndarray
+    alloc_pods: np.ndarray
+    used_cpu_req_milli: np.ndarray
+    used_cpu_lim_milli: np.ndarray
+    used_mem_req_bytes: np.ndarray
+    used_mem_lim_bytes: np.ndarray
+    pods_count: np.ndarray
+    healthy: np.ndarray
+    count: np.ndarray
+    group_index: np.ndarray
+    representative: np.ndarray
+    extended: dict[str, tuple[np.ndarray, np.ndarray]] = field(
+        default_factory=dict
+    )
+
+    @property
+    def n_groups(self) -> int:
+        return int(self.count.shape[0])
+
+    @property
+    def n_nodes(self) -> int:
+        return self.snapshot.n_nodes
+
+    @property
+    def semantics(self) -> str:
+        return self.snapshot.semantics
+
+    @property
+    def compression_ratio(self) -> float:
+        """Nodes per group (1.0 = nothing merged)."""
+        g = self.n_groups
+        return (self.n_nodes / g) if g else 1.0
+
+    def representative_names(self) -> list[str]:
+        """One real node name per group (the first row with the shape)."""
+        names = self.snapshot.names
+        return [names[int(i)] for i in self.representative]
+
+    def members(self, g: int) -> np.ndarray:
+        """Node row indices belonging to group ``g`` (ascending)."""
+        return np.flatnonzero(self.group_index == int(g))
+
+    def effective_counts(self, node_mask=None) -> np.ndarray:
+        """Per-group node multiplicity, optionally restricted to a
+        ``[N]`` bool ``node_mask`` — the count-weighting the grouped
+        kernels consume.  A masked-out node contributes fit 0 in every
+        mode, so summing ``count_g(mask) * fit_g`` over groups equals the
+        per-node masked sum exactly."""
+        if node_mask is None:
+            return self.count
+        mask = np.asarray(node_mask, dtype=bool)
+        if mask.shape != (self.n_nodes,):
+            raise ValueError(
+                f"node_mask: expected shape ({self.n_nodes},), "
+                f"got {mask.shape}"
+            )
+        return np.bincount(
+            self.group_index[mask], minlength=self.n_groups
+        ).astype(np.int64)
+
+    def expand(self, per_group: np.ndarray) -> np.ndarray:
+        """Gather a per-group array (last axis ``[G]``) back to per-node
+        (last axis ``[N]``) through :attr:`group_index`."""
+        return np.asarray(per_group)[..., self.group_index]
+
+
+# --- grouping dispatch gates -------------------------------------------
+# KCCAP_GROUPING=0 is the escape hatch: every dispatch checks it, so the
+# exact pre-grouping code path is restorable without a restart.  The
+# grouped path only engages when it pays: clusters below the node floor
+# fit comfortably in one kernel launch anyway, and a mean group
+# occupancy below -group-min-count means the fleet is too heterogeneous
+# for compression to shrink the kernel meaningfully.
+
+#: Minimum cluster size for the grouped dispatch to engage — below this
+#: the ungrouped kernel is already cheap and grouping only adds a gather.
+GROUPING_NODE_FLOOR = 1024
+
+#: Default minimum mean nodes-per-group (compression ratio) gate.
+DEFAULT_GROUP_MIN_COUNT = 2
+
+_group_lock = threading.Lock()
+_group_min_count: int | None = None
+
+
+def grouping_enabled() -> bool:
+    """Process-wide grouping switch (``KCCAP_GROUPING=0`` disables).
+
+    Checked per dispatch so the escape hatch works without a restart;
+    off restores the exact pre-grouping dispatch byte-for-byte.
+    """
+    return os.environ.get("KCCAP_GROUPING", "1") != "0"
+
+
+def group_min_count() -> int:
+    """The active mean-occupancy gate (flag/env-configurable)."""
+    global _group_min_count
+    with _group_lock:
+        if _group_min_count is None:
+            try:
+                env = int(os.environ.get("KCCAP_GROUP_MIN_COUNT", "0"))
+            except ValueError:
+                env = 0
+            _group_min_count = (
+                env if env > 0 else DEFAULT_GROUP_MIN_COUNT
+            )
+        return _group_min_count
+
+
+def set_group_min_count(value: int) -> None:
+    """Set the mean-occupancy gate (``-group-min-count`` flag)."""
+    global _group_min_count
+    if value < 1:
+        raise ValueError("group min count must be >= 1")
+    with _group_lock:
+        _group_min_count = int(value)
+
+
+def grouped_for_dispatch(snapshot: ClusterSnapshot) -> GroupedSnapshot | None:
+    """The grouped form IFF the grouped kernels should serve this
+    snapshot: grouping enabled, cluster at/above the node floor, and the
+    compression ratio clears ``group_min_count()``.  ``None`` means
+    "dispatch ungrouped" — the exact pre-grouping path.
+
+    The decision memoizes per (snapshot, gate), and a heterogeneous
+    fleet is rejected by a row-HASH pre-check before the full group sort
+    is ever paid: distinct hash values never exceed the true group count
+    (a collision can only merge groups), so ``N / distinct_hashes``
+    UPPER-bounds the true compression ratio — when even that bound
+    misses the gate, grouping provably would too.
+    """
+    if not grouping_enabled():
+        return None
+    n = snapshot.n_nodes
+    if n < GROUPING_NODE_FLOOR:
+        return None
+    mc = group_min_count()
+    hit = snapshot.__dict__.get("_grouping_decision")
+    if hit is not None and hit[0] == mc:
+        return hit[1]
+    if "_grouped_cache" not in snapshot.__dict__:
+        rows = snapshot._group_rows()
+        # Odd multipliers keep the mod-2^64 mix bijective per column
+        # (the golden-ratio constant, wrapped onto the int64 carrier).
+        phi = np.uint64(0x9E3779B97F4A7C15).astype(np.int64)
+        mult = np.arange(1, 2 * rows.shape[1], 2, dtype=np.int64) * phi
+        h = rows @ mult  # wraps mod 2^64 — a hash, not a value
+        if n < mc * np.unique(h).size:
+            snapshot.__dict__["_grouping_decision"] = (mc, None)
+            return None
+    grouped = snapshot.grouped()
+    result = grouped if n >= mc * grouped.n_groups else None
+    snapshot.__dict__["_grouping_decision"] = (mc, result)
+    return result
+
+
+# Lazily-built gauges on the process registry (importing this module
+# must register nothing; KCCAP_TELEMETRY=0 means zero registry calls —
+# same policy as devcache).
+_GROUP_MET: dict | None = None
+_group_met_lock = threading.Lock()
+
+
+def _group_metrics() -> dict:
+    global _GROUP_MET
+    if _GROUP_MET is None:
+        with _group_met_lock:
+            if _GROUP_MET is None:
+                from kubernetesclustercapacity_tpu.telemetry.metrics import (
+                    REGISTRY,
+                )
+
+                _GROUP_MET = {
+                    "groups": REGISTRY.gauge(
+                        "kccap_group_count",
+                        "Distinct (shape, count) node groups in the "
+                        "published snapshot.",
+                    ),
+                    "ratio": REGISTRY.gauge(
+                        "kccap_compression_ratio",
+                        "Nodes per group of the published snapshot "
+                        "(1.0 = nothing merged).",
+                    ),
+                }
+    return _GROUP_MET
+
+
+def publish_group_metrics(snapshot: ClusterSnapshot) -> None:
+    """Update the grouping gauges for a freshly published snapshot.
+
+    Called on the publish path (server construction / snapshot swap),
+    never per request.  No-op when telemetry or grouping is off; best
+    effort — gauge publication must never fail a publish.
+    """
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        enabled as _telemetry_enabled,
+    )
+
+    if not _telemetry_enabled() or not grouping_enabled():
+        return
+    try:
+        grouped = grouped_for_dispatch(snapshot)
+        met = _group_metrics()
+        if grouped is None:
+            # Not engaged (small cluster / heterogeneous fleet): report
+            # the sentinel rather than paying the full group sort just
+            # for a gauge — 0 groups means "ungrouped dispatch".
+            met["groups"].set(0)
+            met["ratio"].set(1.0)
+        else:
+            met["groups"].set(grouped.n_groups)
+            met["ratio"].set(round(grouped.compression_ratio, 4))
+    except Exception:  # noqa: BLE001 - observability never fails publish
+        pass
 
 
 def load_snapshot(path: str) -> ClusterSnapshot:
@@ -822,6 +1173,7 @@ def synthetic_snapshot(
     mean_utilization: float = 0.4,
     alloc_pods: int = 110,
     kib_quantized: bool = True,
+    shapes: int | None = None,
 ) -> ClusterSnapshot:
     """Array-level synthetic cluster — fast path for 1k/10k-node benches.
 
@@ -829,25 +1181,42 @@ def synthetic_snapshot(
     (no fixture objects), in O(N).  With ``kib_quantized=True`` all memory
     values are multiples of 1024 so the int32 KiB-rescaled fast kernel stays
     eligible; the values match what kubelets report (they publish ``Ki``).
+
+    ``shapes=K`` draws only K distinct ``(allocatable, usage)`` rows and
+    assigns every node one of them — the degenerate-fleet profile real
+    clusters exhibit (a handful of machine shapes × thousands of
+    replicas), which is what :meth:`ClusterSnapshot.grouped` compresses.
+    ``None`` keeps the fully heterogeneous per-node draw.
     """
     rng = np.random.default_rng(seed)
-    cores = rng.choice(np.array([2, 4, 8, 16, 32, 64]), size=n_nodes)
+    n_draw = n_nodes if shapes is None else int(shapes)
+    cores = rng.choice(np.array([2, 4, 8, 16, 32, 64]), size=n_draw)
     alloc_cpu = cores.astype(np.int64) * 1000
     mem_kib = cores.astype(np.int64) * 4 * 1024 * 1024 - rng.integers(
-        0, 2**18, size=n_nodes
+        0, 2**18, size=n_draw
     )
     alloc_mem = mem_kib * 1024
     if not kib_quantized:
-        alloc_mem += rng.integers(0, 1024, size=n_nodes)
+        alloc_mem += rng.integers(0, 1024, size=n_draw)
 
-    util_cpu = rng.beta(2, 3, size=n_nodes) * 2 * mean_utilization
-    util_mem = rng.beta(2, 3, size=n_nodes) * 2 * mean_utilization
+    util_cpu = rng.beta(2, 3, size=n_draw) * 2 * mean_utilization
+    util_mem = rng.beta(2, 3, size=n_draw) * 2 * mean_utilization
     used_cpu = (alloc_cpu * util_cpu).astype(np.int64)
     used_mem_kib = (mem_kib * util_mem).astype(np.int64)
     used_mem = used_mem_kib * 1024
     if not kib_quantized:
-        used_mem += rng.integers(0, 1024, size=n_nodes)
-    pods = rng.integers(0, 60, size=n_nodes).astype(np.int64)
+        used_mem += rng.integers(0, 1024, size=n_draw)
+    pods = rng.integers(0, 60, size=n_draw).astype(np.int64)
+
+    if shapes is not None:
+        # Degenerate fleet: gather each node's row from the K-shape LUT
+        # (numpy column builds — no per-node Python).
+        assign = rng.integers(0, n_draw, size=n_nodes)
+        alloc_cpu = alloc_cpu[assign]
+        alloc_mem = alloc_mem[assign]
+        used_cpu = used_cpu[assign]
+        used_mem = used_mem[assign]
+        pods = pods[assign]
 
     return ClusterSnapshot(
         names=[f"node-{i:05d}" for i in range(n_nodes)],

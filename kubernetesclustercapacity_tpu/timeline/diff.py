@@ -33,6 +33,7 @@ __all__ = [
     "SnapshotDiff",
     "diff_summaries",
     "node_summary",
+    "shape_key",
     "snapshot_digest",
 ]
 
@@ -83,6 +84,16 @@ def snapshot_digest(snap: ClusterSnapshot) -> str:
         arr = np.ascontiguousarray(np.asarray(getattr(snap, f)).astype(np.int64))
         h.update(arr.tobytes())
     return h.hexdigest()[:_DIGEST_HEX]
+
+
+def shape_key(row: tuple[int, ...]) -> str:
+    """Stable short identifier of a node SHAPE (a summary row's field
+    tuple): two rows share a key iff every fit-relevant column matches —
+    the same equivalence the grouped snapshot compresses on
+    (:meth:`..snapshot.ClusterSnapshot.grouped`), so drift attribution
+    can say *which* group a churned node joined or left."""
+    h = hashlib.sha256("|".join(str(int(v)) for v in row).encode())
+    return h.hexdigest()[:8]
 
 
 @dataclass

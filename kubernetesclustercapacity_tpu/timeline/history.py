@@ -49,6 +49,7 @@ from kubernetesclustercapacity_tpu.timeline.alerts import WatchAlert
 from kubernetesclustercapacity_tpu.timeline.diff import (
     diff_summaries,
     node_summary,
+    shape_key,
     snapshot_digest,
 )
 from kubernetesclustercapacity_tpu.timeline.watchlist import WatchSpec
@@ -77,23 +78,47 @@ def _shift_phrase(shift: dict[str, int]) -> str:
 
 
 def _delta_summary(
-    name: str, before: int, after: int, diff, shift, contributions
+    name: str, before: int, after: int, diff, shift, contributions,
+    shape_joins: dict[str, str] | None = None,
 ) -> str:
     """The one-line attribution an operator reads first, e.g.
     ``capacity 41→37: node pool-b-7 removed (-4); binding constraint
-    shifted memory→pods on 12 node(s)``."""
+    shifted memory→pods on 12 node(s)``.
+
+    ``shape_joins`` maps added node keys to the :func:`..diff.shape_key`
+    of an EXISTING shape group they joined — those render as
+    ``(+1 shape <key>)`` drift lines even when the node's capacity
+    contribution is zero, so a replica landing in an existing group is
+    never a silent no-op.
+    """
     head = f"{name}: capacity {before}→{after}"
     if before == after and diff.empty:
         return head + " (no change)"
+    shape_joins = shape_joins or {}
     clauses: list[str] = []
+    seen_added: set[str] = set()
     kind_verb = {"added": "added", "removed": "removed", "mutated": "changed"}
     for key, c, kind in contributions[:3]:
-        clauses.append(
-            f"node {key or '<phantom>'} {kind_verb[kind]} ({c:+d})"
-        )
+        sk = shape_joins.get(key) if kind == "added" else None
+        if sk is not None:
+            seen_added.add(key)
+            clauses.append(
+                f"node {key or '<phantom>'} added ({c:+d}, +1 shape {sk})"
+            )
+        else:
+            clauses.append(
+                f"node {key or '<phantom>'} {kind_verb[kind]} ({c:+d})"
+            )
     extra = len(contributions) - 3
     if extra > 0:
         clauses.append(f"{extra} more node(s)")
+    # Shape joins whose capacity contribution was zero still drift the
+    # group census — name them (bounded, like the contributor list).
+    silent = [k for k in shape_joins if k not in seen_added][:3]
+    for key in silent:
+        clauses.append(
+            f"node {key or '<phantom>'} added (+1 shape {shape_joins[key]})"
+        )
     if shift:
         clauses.append(_shift_phrase(shift))
     if not clauses:
@@ -434,6 +459,16 @@ class CapacityTimeline:
         diff = diff_summaries(prev.summary, cur.summary)
         prev_idx = {k: i for i, k in enumerate(prev.summary)}
         cur_idx = {k: i for i, k in enumerate(cur.summary)}
+        # Added nodes whose row matches an EXISTING shape: they joined a
+        # (shape, count) group rather than introducing a new one — the
+        # grouped-dispatch census moved, which the attribution must say
+        # even when the node's own fit contribution is zero.
+        prev_shapes = set(prev.summary.values())
+        shape_joins = {
+            key: shape_key(row)
+            for key, row in diff.added.items()
+            if row in prev_shapes
+        }
         watches: dict[str, dict] = {}
         for name, r in cur.watches.items():
             if watch is not None and name != watch:
@@ -466,7 +501,8 @@ class CapacityTimeline:
                     for k, c, kind in contributions[:_MAX_CONTRIBUTORS]
                 ],
                 "summary": _delta_summary(
-                    name, old.total, r.total, diff, shift, contributions
+                    name, old.total, r.total, diff, shift, contributions,
+                    shape_joins,
                 ),
             }
         return {
@@ -476,6 +512,10 @@ class CapacityTimeline:
             "nodes_added": sorted(diff.added),
             "nodes_removed": sorted(diff.removed),
             "nodes_changed": len(diff.changed),
+            "shape_joins": [
+                {"node": k, "shape": sk}
+                for k, sk in sorted(shape_joins.items())
+            ],
             "diff": diff.to_wire(),
             "watches": watches,
         }

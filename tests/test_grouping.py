@@ -1,0 +1,618 @@
+"""Node-shape compression: grouped sweeps proven bit-exact vs the
+ungrouped sequential oracle, in both semantics modes.
+
+The grouped path's contract is the same as every hot-path PR before it:
+``(shape, count)`` compression is an *optimization*, never a semantics
+change — every test here pins the grouped dispatch element-for-element
+against ``fit_arrays_python`` (the bug-compatible sequential walk) or
+against the exact ungrouped kernel with ``KCCAP_GROUPING=0``.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu import devcache
+from kubernetesclustercapacity_tpu import snapshot as snapshot_mod
+from kubernetesclustercapacity_tpu.explain import explain_snapshot
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+from kubernetesclustercapacity_tpu.ops.fit import (
+    sweep_grid_multi,
+    sweep_grouped_bucketed,
+    sweep_snapshot,
+)
+from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+    _sweep_auto_grouped,
+    reset_fast_path,
+    sweep_snapshot_auto,
+)
+from kubernetesclustercapacity_tpu.scenario import (
+    ScenarioGrid,
+    random_scenario_grid,
+)
+from kubernetesclustercapacity_tpu.snapshot import (
+    GROUPING_NODE_FLOOR,
+    ClusterSnapshot,
+    grouped_for_dispatch,
+    synthetic_snapshot,
+)
+
+N_DEGENERATE = 2048  # >= GROUPING_NODE_FLOOR, cheap to oracle-walk
+
+
+@pytest.fixture(autouse=True)
+def _restore_group_min_count():
+    before = snapshot_mod.group_min_count()
+    yield
+    snapshot_mod.set_group_min_count(before)
+
+
+def _degenerate_snapshot(seed=3, n=N_DEGENERATE, shapes=23):
+    return synthetic_snapshot(n, seed=seed, shapes=shapes)
+
+
+def _oracle_fits(snap, grid, mode, node_mask=None):
+    """Sequential ground truth: per-scenario fit_arrays_python with the
+    kernel's post-epilogue mask zeroing applied on top."""
+    out = []
+    for j in range(grid.size):
+        fits = np.asarray(
+            fit_arrays_python(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+                snap.alloc_pods, snap.used_cpu_req_milli,
+                snap.used_mem_req_bytes, snap.pods_count,
+                int(grid.cpu_request_milli[j]),
+                int(grid.mem_request_bytes[j]),
+                mode=mode, healthy=snap.healthy,
+            ),
+            dtype=np.int64,
+        )
+        if node_mask is not None:
+            fits = np.where(np.asarray(node_mask, dtype=bool), fits, 0)
+        out.append(fits)
+    return np.stack(out)
+
+
+class TestGroupedForm:
+    def test_counts_and_index_invert_the_compression(self):
+        snap = _degenerate_snapshot()
+        g = snap.grouped()
+        assert g.n_groups < snap.n_nodes
+        assert int(g.count.sum()) == snap.n_nodes
+        assert g.group_index.shape == (snap.n_nodes,)
+        # expand(gather) reconstructs every per-node column exactly
+        for f in ("alloc_cpu_milli", "used_mem_req_bytes", "pods_count"):
+            np.testing.assert_array_equal(
+                g.expand(getattr(g, f)), np.asarray(getattr(snap, f))
+            )
+        np.testing.assert_array_equal(
+            g.expand(g.healthy), np.asarray(snap.healthy)
+        )
+        # representative = first node row carrying the shape
+        for gi in range(g.n_groups):
+            members = g.members(gi)
+            assert members.size == int(g.count[gi])
+            assert int(g.representative[gi]) == int(members[0])
+
+    def test_memoized_per_snapshot(self):
+        snap = _degenerate_snapshot()
+        assert snap.grouped() is snap.grouped()
+
+    def test_different_health_never_merges(self):
+        # Two rows identical in EVERY resource column, health differs —
+        # they must land in distinct groups (and sweep correctly).
+        n = 4
+        snap = ClusterSnapshot(
+            names=[f"n{i}" for i in range(n)],
+            alloc_cpu_milli=np.full(n, 4000),
+            alloc_mem_bytes=np.full(n, 8 << 30),
+            alloc_pods=np.full(n, 110),
+            used_cpu_req_milli=np.full(n, 500),
+            used_mem_req_bytes=np.full(n, 1 << 30),
+            used_cpu_lim_milli=np.zeros(n),
+            used_mem_lim_bytes=np.zeros(n),
+            pods_count=np.full(n, 3),
+            healthy=np.array([True, False, True, False]),
+            semantics="strict",
+        )
+        g = snap.grouped()
+        assert g.n_groups == 2
+        assert sorted(g.count.tolist()) == [2, 2]
+
+    def test_different_extended_never_merges(self):
+        n = 4
+        gpu_alloc = np.array([0, 8, 0, 8], dtype=np.int64)
+        snap = ClusterSnapshot(
+            names=[f"n{i}" for i in range(n)],
+            alloc_cpu_milli=np.full(n, 4000),
+            alloc_mem_bytes=np.full(n, 8 << 30),
+            alloc_pods=np.full(n, 110),
+            used_cpu_req_milli=np.full(n, 500),
+            used_mem_req_bytes=np.full(n, 1 << 30),
+            used_cpu_lim_milli=np.zeros(n),
+            used_mem_lim_bytes=np.zeros(n),
+            pods_count=np.full(n, 3),
+            healthy=np.ones(n, dtype=bool),
+            semantics="strict",
+            extended={"nvidia.com/gpu": (gpu_alloc, np.zeros(n, np.int64))},
+        )
+        g = snap.grouped()
+        assert g.n_groups == 2
+        np.testing.assert_array_equal(
+            g.expand(g.extended["nvidia.com/gpu"][0]), gpu_alloc
+        )
+
+    def test_dispatch_gates(self, monkeypatch):
+        # Small clusters never group; heterogeneous big ones don't pay.
+        assert grouped_for_dispatch(synthetic_snapshot(500, seed=1)) is None
+        hetero = synthetic_snapshot(GROUPING_NODE_FLOOR + 5, seed=2)
+        assert hetero.grouped().compression_ratio < 2
+        assert grouped_for_dispatch(hetero) is None
+        snap = _degenerate_snapshot()
+        assert grouped_for_dispatch(snap) is not None
+        # escape hatch
+        monkeypatch.setenv("KCCAP_GROUPING", "0")
+        assert grouped_for_dispatch(snap) is None
+        monkeypatch.delenv("KCCAP_GROUPING")
+        # occupancy gate is flag-settable
+        snapshot_mod.set_group_min_count(10 ** 6)
+        assert grouped_for_dispatch(snap) is None
+
+    def test_effective_counts_fold_the_mask(self):
+        snap = _degenerate_snapshot()
+        g = snap.grouped()
+        mask = np.random.default_rng(5).random(snap.n_nodes) < 0.4
+        eff = g.effective_counts(mask)
+        assert int(eff.sum()) == int(mask.sum())
+        np.testing.assert_array_equal(
+            eff, np.bincount(g.group_index[mask], minlength=g.n_groups)
+        )
+        with pytest.raises(ValueError):
+            g.effective_counts(np.ones(3, dtype=bool))
+
+
+class TestGroupedSweepOracleParity:
+    @pytest.mark.parametrize("mode", ("reference", "strict"))
+    @pytest.mark.parametrize("seed", (0, 7, 23))
+    def test_grouped_equals_sequential_oracle(self, mode, seed):
+        snap = _degenerate_snapshot(seed=seed, shapes=17 + seed)
+        if mode == "strict":
+            # flip some health so the strict zeroing is exercised
+            snap.healthy[::11] = False
+        grid = random_scenario_grid(12, seed=seed + 1)
+        assert grouped_for_dispatch(snap) is not None
+        totals, sched, fits = sweep_snapshot(
+            snap, grid, mode=mode, return_per_node=True
+        )
+        expected = _oracle_fits(snap, grid, mode)
+        np.testing.assert_array_equal(fits, expected)
+        np.testing.assert_array_equal(totals, expected.sum(axis=1))
+
+    def test_q1_overwrite_with_negative_fits(self):
+        # Q1: fit >= alloc_pods overwrites with alloc_pods - pods_count,
+        # which can be NEGATIVE — count weighting must carry that sign.
+        snap = _degenerate_snapshot(seed=9)
+        snap.alloc_pods[:] = 3
+        snap.pods_count[:] = 7  # overwrite value = -4 on saturated nodes
+        grid = ScenarioGrid(
+            cpu_request_milli=np.array([1, 100]),
+            mem_request_bytes=np.array([1, 1 << 20]),
+            replicas=np.array([1, 1]),
+        )
+        totals, _, fits = sweep_snapshot(
+            snap, grid, mode="reference", return_per_node=True
+        )
+        expected = _oracle_fits(snap, grid, "reference")
+        assert (expected < 0).any()  # the adversarial case actually fired
+        np.testing.assert_array_equal(fits, expected)
+        np.testing.assert_array_equal(totals, expected.sum(axis=1))
+
+    def test_wrapped_negative_carriers(self):
+        snap = _degenerate_snapshot(seed=11)
+        snap.used_mem_req_bytes[: snap.n_nodes // 2] = -(1 << 40)
+        snap.alloc_cpu_milli[::3] = -5  # huge uint64 view
+        grid = random_scenario_grid(6, seed=12)
+        totals, _, fits = sweep_snapshot(
+            snap, grid, mode="reference", return_per_node=True
+        )
+        expected = _oracle_fits(snap, grid, "reference")
+        np.testing.assert_array_equal(fits, expected)
+        np.testing.assert_array_equal(totals, expected.sum(axis=1))
+
+    @pytest.mark.parametrize("mode", ("reference", "strict"))
+    def test_masked_sweep_matches_oracle(self, mode):
+        snap = _degenerate_snapshot(seed=13)
+        snap.healthy[::9] = False
+        mask = np.random.default_rng(14).random(snap.n_nodes) < 0.6
+        grid = random_scenario_grid(8, seed=15)
+        totals, _, fits = sweep_snapshot(
+            snap, grid, mode=mode, node_mask=mask, return_per_node=True
+        )
+        expected = _oracle_fits(snap, grid, mode, node_mask=mask)
+        np.testing.assert_array_equal(fits, expected)
+        np.testing.assert_array_equal(totals, expected.sum(axis=1))
+
+    def test_escape_hatch_restores_ungrouped_path(self, monkeypatch):
+        snap = _degenerate_snapshot(seed=17)
+        grid = random_scenario_grid(9, seed=18)
+        on = sweep_snapshot(snap, grid, return_per_node=True)
+        monkeypatch.setenv("KCCAP_GROUPING", "0")
+        off = sweep_snapshot(snap, grid, return_per_node=True)
+        for a, b in zip(on, off):
+            np.testing.assert_array_equal(a, b)
+
+    def test_devcache_off_still_exact(self, monkeypatch):
+        snap = _degenerate_snapshot(seed=19)
+        grid = random_scenario_grid(7, seed=20)
+        on = sweep_snapshot(snap, grid)
+        monkeypatch.setenv("KCCAP_DEVCACHE", "0")
+        off = sweep_snapshot(snap, grid)
+        np.testing.assert_array_equal(on[0], off[0])
+        np.testing.assert_array_equal(on[1], off[1])
+
+    def test_extended_resources_group_weighted_multi(self):
+        # R-dim kernel over grouped rows + count weighting == per-node.
+        snap = _degenerate_snapshot(seed=21, shapes=11)
+        n = snap.n_nodes
+        rng = np.random.default_rng(22)
+        gpu = rng.integers(0, 3, 11)[snap.grouped().group_index]
+        snap2 = dataclasses.replace(
+            snap,
+            semantics="strict",
+            extended={
+                "nvidia.com/gpu": (gpu, np.zeros(n, dtype=np.int64))
+            },
+        )
+        g = snap2.grouped()
+        reqs_sr = np.stack(
+            [
+                rng.integers(100, 2000, 5),
+                rng.integers(1 << 20, 1 << 30, 5),
+                rng.integers(0, 2, 5),
+            ],
+            axis=1,
+        ).astype(np.int64)
+        replicas = np.ones(5, dtype=np.int64)
+        alloc_rn, used_rn = (
+            np.stack([snap2.alloc_cpu_milli, snap2.alloc_mem_bytes, gpu]),
+            np.stack(
+                [
+                    snap2.used_cpu_req_milli,
+                    snap2.used_mem_req_bytes,
+                    np.zeros(n, dtype=np.int64),
+                ]
+            ),
+        )
+        per_node = np.asarray(
+            sweep_grid_multi(
+                alloc_rn, used_rn, snap2.alloc_pods, snap2.pods_count,
+                snap2.healthy, reqs_sr, replicas, mode="strict",
+            )[0]
+        )
+        galloc = np.stack(
+            [g.alloc_cpu_milli, g.alloc_mem_bytes,
+             g.extended["nvidia.com/gpu"][0]]
+        )
+        gused = np.stack(
+            [g.used_cpu_req_milli, g.used_mem_req_bytes,
+             g.extended["nvidia.com/gpu"][1]]
+        )
+        _, _, gfits = sweep_grid_multi(
+            galloc, gused, g.alloc_pods, g.pods_count, g.healthy,
+            reqs_sr, replicas, mode="strict", return_per_node=True,
+        )
+        grouped_totals = (np.asarray(gfits) * g.count[None, :]).sum(axis=1)
+        np.testing.assert_array_equal(grouped_totals, per_node)
+
+
+class TestGroupedAutoDispatch:
+    def test_auto_path_equals_oracle_and_names_grouped_kernel(self):
+        reset_fast_path()
+        try:
+            snap = _degenerate_snapshot(seed=25)
+            grid = random_scenario_grid(10, seed=26)
+            totals, sched, kernel = sweep_snapshot_auto(snap, grid)
+            assert kernel.endswith("_grouped")
+            expected = _oracle_fits(snap, grid, "reference").sum(axis=1)
+            np.testing.assert_array_equal(totals, expected)
+        finally:
+            reset_fast_path()
+
+    @pytest.mark.parametrize("mode", ("reference", "strict"))
+    def test_fused_grouped_attempt_stays_exact(self, mode):
+        # Whether the fused grouped kernel runs or the breaker degrades
+        # it to the exact grouped path (this host's Pallas interpret
+        # path is known-broken), the ANSWER must be the oracle's.
+        reset_fast_path()
+        try:
+            snap = _degenerate_snapshot(seed=27)
+            snap.healthy[::13] = False
+            rng = np.random.default_rng(28)
+            grid = ScenarioGrid(
+                cpu_request_milli=rng.integers(100, 2000, 9),
+                mem_request_bytes=rng.integers(64, 2048, 9) * (1 << 20),
+                replicas=rng.integers(1, 500, 9),
+            )
+            g = grouped_for_dispatch(snap)
+            assert g is not None
+            totals, sched, kernel = _sweep_auto_grouped(g, grid, mode=mode)
+            assert kernel in (
+                "pallas_i32_rcp_fused_grouped",
+                "pallas_i32_fused_grouped",
+                "xla_int64_grouped",
+            )
+            expected = _oracle_fits(snap, grid, mode).sum(axis=1)
+            np.testing.assert_array_equal(totals, expected)
+        finally:
+            reset_fast_path()
+
+    def test_masked_auto_matches_unmasked_minus_masked_nodes(self):
+        reset_fast_path()
+        try:
+            snap = _degenerate_snapshot(seed=29)
+            mask = np.random.default_rng(30).random(snap.n_nodes) < 0.5
+            grid = random_scenario_grid(6, seed=31)
+            totals, _, _ = sweep_snapshot_auto(
+                snap, grid, mode="strict", node_mask=mask
+            )
+            expected = _oracle_fits(
+                snap, grid, "strict", node_mask=mask
+            ).sum(axis=1)
+            np.testing.assert_array_equal(totals, expected)
+        finally:
+            reset_fast_path()
+
+
+class TestGroupedDevcache:
+    def test_grouped_form_caches_and_invalidates(self):
+        cache = devcache.DeviceCache()
+        snap = _degenerate_snapshot(seed=33)
+        g = snap.grouped()
+        first = cache.grouped_arrays(g)
+        again = cache.grouped_arrays(g)
+        assert first is again
+        stats = cache.stats()
+        assert stats["hits"] == 1 and stats["misses"] == 1
+        # padded to the GROUP bucket, not the node bucket
+        assert first[0].shape[0] == devcache.node_bucket(g.n_groups)
+        assert first[0].shape[0] < snap.n_nodes
+        # counts ride in slot 8; padding is zero-count
+        counts = np.asarray(first[7])
+        assert int(counts.sum()) == snap.n_nodes
+        cache.invalidate(snap)
+        assert cache.stats()["entries"] == 0
+
+    def test_grouped_sweep_populates_grouped_form(self):
+        before = devcache.CACHE.stats()["misses"]
+        snap = _degenerate_snapshot(seed=34)
+        grid = random_scenario_grid(5, seed=35)
+        sweep_grouped_bucketed(
+            snap.grouped(), grid.cpu_request_milli,
+            grid.mem_request_bytes, grid.replicas,
+        )
+        assert devcache.CACHE.stats()["misses"] > before
+
+
+class TestGroupedExplain:
+    @pytest.mark.parametrize("mode", ("reference", "strict"))
+    def test_grouped_explain_matches_per_node(self, mode, monkeypatch):
+        snap = _degenerate_snapshot(seed=37)
+        snap.healthy[::7] = False
+        grid = random_scenario_grid(6, seed=38)
+        assert grouped_for_dispatch(snap) is not None
+        got = explain_snapshot(snap, grid, mode=mode)
+        monkeypatch.setenv("KCCAP_GROUPING", "0")
+        want = explain_snapshot(snap, grid, mode=mode)
+        for f in ("fits", "binding", "cpu_fit", "mem_fit", "slots"):
+            np.testing.assert_array_equal(
+                getattr(got, f), getattr(want, f), err_msg=f
+            )
+
+    def test_grouped_explain_masked_matches_per_node(self, monkeypatch):
+        snap = _degenerate_snapshot(seed=39)
+        mask = np.random.default_rng(40).random(snap.n_nodes) < 0.7
+        grid = random_scenario_grid(4, seed=41)
+        got = explain_snapshot(snap, grid, node_mask=mask)
+        monkeypatch.setenv("KCCAP_GROUPING", "0")
+        want = explain_snapshot(snap, grid, node_mask=mask)
+        for f in ("fits", "binding"):
+            np.testing.assert_array_equal(
+                getattr(got, f), getattr(want, f), err_msg=f
+            )
+        # expansion preserved node granularity: every node has a code
+        assert got.binding.shape == (grid.size, snap.n_nodes)
+
+
+class TestGroupedGspmd:
+    def test_gspmd_grouped_matches_unsharded(self, monkeypatch):
+        from kubernetesclustercapacity_tpu.parallel import make_mesh
+        from kubernetesclustercapacity_tpu.parallel.sweep import (
+            sweep_gspmd_grouped,
+        )
+
+        snap = _degenerate_snapshot(seed=43, n=4099)  # forces padding
+        grid = random_scenario_grid(13, seed=44)
+        g = snap.grouped()
+        monkeypatch.setenv("KCCAP_GROUPING", "0")
+        base = sweep_snapshot(snap, grid)
+        monkeypatch.delenv("KCCAP_GROUPING")
+        for sp, np_ in ((2, 4), (1, 8)):
+            plan = make_mesh(sp, np_)
+            totals, sched = sweep_gspmd_grouped(
+                plan, g, grid.cpu_request_milli, grid.mem_request_bytes,
+                grid.replicas,
+            )
+            np.testing.assert_array_equal(totals, base[0])
+            np.testing.assert_array_equal(sched, base[1])
+
+    def test_gspmd_grouped_masked(self, monkeypatch):
+        from kubernetesclustercapacity_tpu.parallel import make_mesh
+        from kubernetesclustercapacity_tpu.parallel.sweep import (
+            sweep_gspmd_grouped,
+        )
+
+        snap = _degenerate_snapshot(seed=45)
+        mask = np.random.default_rng(46).random(snap.n_nodes) < 0.5
+        grid = random_scenario_grid(9, seed=47)
+        monkeypatch.setenv("KCCAP_GROUPING", "0")
+        base = sweep_snapshot(snap, grid, mode="strict", node_mask=mask)
+        monkeypatch.delenv("KCCAP_GROUPING")
+        plan = make_mesh(4, 2)
+        totals, _ = sweep_gspmd_grouped(
+            plan, snap.grouped(), grid.cpu_request_milli,
+            grid.mem_request_bytes, grid.replicas, mode="strict",
+            node_mask=mask,
+        )
+        np.testing.assert_array_equal(totals, base[0])
+
+
+class TestGroupMetricsPublish:
+    def test_gauges_update_on_publish(self):
+        from kubernetesclustercapacity_tpu.snapshot import (
+            publish_group_metrics,
+        )
+        from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+
+        snap = _degenerate_snapshot(seed=49)
+        publish_group_metrics(snap)
+        snap_reg = REGISTRY.snapshot()
+        g = snap.grouped()
+        assert snap_reg["kccap_group_count"]["values"][""] == g.n_groups
+        ratio = snap_reg["kccap_compression_ratio"]["values"][""]
+        assert ratio == round(g.compression_ratio, 4)
+
+    def test_grouping_off_means_no_update(self, monkeypatch):
+        from kubernetesclustercapacity_tpu.snapshot import (
+            publish_group_metrics,
+        )
+        from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+
+        a = _degenerate_snapshot(seed=50)
+        b = _degenerate_snapshot(seed=51, shapes=7)
+        publish_group_metrics(a)
+        before = REGISTRY.snapshot()["kccap_group_count"]["values"]
+        monkeypatch.setenv("KCCAP_GROUPING", "0")
+        publish_group_metrics(b)
+        after = REGISTRY.snapshot()["kccap_group_count"]["values"]
+        assert before == after
+
+
+class TestTimelineShapeJoins:
+    def _timeline(self):
+        from kubernetesclustercapacity_tpu.timeline import CapacityTimeline
+        from kubernetesclustercapacity_tpu.timeline.watchlist import (
+            parse_watchlist,
+        )
+
+        specs = parse_watchlist(
+            {
+                "watches": [
+                    {
+                        "name": "web",
+                        "pod": {
+                            "cpuRequests": "500m",
+                            "memRequests": "1gb",
+                        },
+                    }
+                ]
+            }
+        )
+        return CapacityTimeline(specs, depth=4)
+
+    @staticmethod
+    def _with_rows(snap, idx, names):
+        kw = {
+            f: np.asarray(getattr(snap, f))[idx]
+            for f in (
+                "alloc_cpu_milli", "alloc_mem_bytes", "alloc_pods",
+                "used_cpu_req_milli", "used_cpu_lim_milli",
+                "used_mem_req_bytes", "used_mem_lim_bytes",
+                "pods_count", "healthy",
+            )
+        }
+        return dataclasses.replace(snap, names=names, **kw)
+
+    def test_node_joining_existing_group_is_attributed(self):
+        tl = self._timeline()
+        base = synthetic_snapshot(24, seed=42)
+        tl.observe(base, 1)
+        twin = self._with_rows(
+            base, list(range(24)) + [0], base.names + ["node-twin"]
+        )
+        tl.observe(twin, 2)
+        (delta,) = tl.deltas()
+        assert delta["nodes_added"] == ["node-twin"]
+        (join,) = delta["shape_joins"]
+        assert join["node"] == "node-twin"
+        assert len(join["shape"]) == 8
+        summary = delta["watches"]["web"]["summary"]
+        assert f"+1 shape {join['shape']}" in summary
+
+    def test_zero_contribution_join_is_not_silent(self):
+        # The joined shape fits ZERO replicas of the watch — without the
+        # shape clause this transition would read as a no-op.
+        tl = self._timeline()
+        base = synthetic_snapshot(24, seed=42)
+        base.alloc_cpu_milli[0] = 1  # 500m never fits: cpu_fit = 0
+        base.used_cpu_req_milli[0] = 0
+        tl.observe(base, 1)
+        twin = self._with_rows(
+            base, list(range(24)) + [0], base.names + ["node-twin"]
+        )
+        tl.observe(twin, 2)
+        (delta,) = tl.deltas()
+        w = delta["watches"]["web"]
+        assert w["after"] == w["before"]  # capacity did not move...
+        assert "+1 shape " in w["summary"]  # ...but the census did
+        assert "node-twin" in w["summary"]
+
+    def test_new_shape_is_not_a_join(self):
+        tl = self._timeline()
+        base = synthetic_snapshot(24, seed=42)
+        tl.observe(base, 1)
+        grown = self._with_rows(
+            base, list(range(24)) + [0], base.names + ["node-new"]
+        )
+        grown.alloc_cpu_milli[-1] = 123_456  # a shape nobody had
+        tl.observe(grown, 2)
+        (delta,) = tl.deltas()
+        assert delta["shape_joins"] == []
+        assert "+1 shape" not in delta["watches"]["web"]["summary"]
+
+
+class TestSyntheticShapes:
+    def test_shapes_param_bounds_distinct_rows(self):
+        snap = synthetic_snapshot(5000, seed=1, shapes=13)
+        assert snap.grouped().n_groups <= 13
+        assert snap.n_nodes == 5000
+        assert len(set(snap.names)) == 5000  # names stay unique
+
+    def test_default_remains_heterogeneous(self):
+        snap = synthetic_snapshot(300, seed=1)
+        assert snap.grouped().n_groups > 250
+
+    def test_fast_kernel_eligibility_preserved(self):
+        from kubernetesclustercapacity_tpu.ops.pallas_fit import (
+            fast_sweep_eligible,
+        )
+
+        snap = synthetic_snapshot(2000, seed=2, shapes=19)
+        g = snap.grouped()
+        grid = ScenarioGrid(
+            cpu_request_milli=np.array([250]),
+            mem_request_bytes=np.array([512 << 20]),
+            replicas=np.array([1]),
+        )
+        assert fast_sweep_eligible(
+            g.alloc_cpu_milli, g.alloc_mem_bytes, g.alloc_pods,
+            g.used_cpu_req_milli, g.used_mem_req_bytes, g.pods_count,
+            grid.cpu_request_milli, grid.mem_request_bytes,
+            counts=g.count,
+        )
+
+
+def test_grouping_env_default_is_enabled():
+    assert os.environ.get("KCCAP_GROUPING") is None
+    assert snapshot_mod.grouping_enabled()

@@ -136,9 +136,15 @@ class TestServerBatching:
             with CapacityClient(*srv.address) as c:
                 assert "hot_path" not in c.info()  # default shape pinned
                 hp = c.info(hot_path=True)["hot_path"]
-            assert set(hp) == {"devcache", "node_bucket_floor", "batching"}
+            assert set(hp) == {
+                "devcache", "node_bucket_floor", "batching", "grouping",
+            }
             assert hp["batching"]["window_ms"] == 1.0
             assert hp["batching"]["max_batch"] == 32
+            # 16 nodes is under the grouping floor: reported, not engaged
+            assert hp["grouping"]["enabled"] is True
+            assert hp["grouping"]["engaged"] is False
+            assert hp["grouping"]["group_min_count"] >= 1
         finally:
             srv.shutdown()
 
