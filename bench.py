@@ -627,6 +627,7 @@ def main() -> None:
             _host_side_metrics(metrics)
             _hot_path_metrics(metrics)
             _shadow_overhead_metrics(metrics)
+            _serving_slo_metrics(metrics)
         except Exception as e:  # noqa: BLE001 - partial capture survives
             print(traceback.format_exc(), file=sys.stderr)
             metrics["host_aux_error"] = f"{type(e).__name__}: {e}"
@@ -967,6 +968,181 @@ def _measure_dispatch_breakdown(snap, grid, reps: int = 10) -> dict:
         "e2e_p50_ms": round(statistics.median(e2e), 3),
         "reps": reps,
     }
+
+
+def _serving_slo_metrics(out: dict | None = None) -> dict:
+    """Sustained-load serving SLO row (ROADMAP item 5b's artifact): a
+    replicated plane (leader + 2 replicas, admission-controlled) under a
+    fixed-rps OPEN loop, with a replica KILLED mid-run.
+
+    Three equal windows tell the story: ``pre`` (steady state), ``kill``
+    (one replica of two vanishes — transport errors while the breaker
+    learns), ``post`` (recovery).  Per window: p50/p99 latency and the
+    shed rate (refusals + set-level failures over offered requests).
+    ``serving_recovered`` is the headline verdict — the post-kill shed
+    rate returned to (near) the pre-kill baseline rather than
+    collapsing.  Every successful answer is checked bit-exact against
+    the sequential oracle at its stamped generation
+    (``serving_parity_diffs`` must be 0: a wrong answer under chaos is
+    a failed bench, not a slow one).  Host/service-layer only — no
+    device dependency beyond the normal sweep path.  ``KCC_BENCH_SERVING=0``
+    skips it.
+    """
+    import statistics
+    import threading as _threading
+
+    if out is None:
+        out = {}
+    if os.environ.get("KCC_BENCH_SERVING", "1") == "0":
+        return out
+    from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+    from kubernetesclustercapacity_tpu.service.plane import (
+        AdmissionController,
+        PlanePublisher,
+        PlaneSubscriber,
+    )
+    from kubernetesclustercapacity_tpu.service.replicaset import ReplicaSet
+    from kubernetesclustercapacity_tpu.service.server import CapacityServer
+    from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+    rps = float(os.environ.get("KCC_BENCH_SERVING_RPS", "40"))
+    duration_s = float(os.environ.get("KCC_BENCH_SERVING_DURATION_S", "4.5"))
+    snap = synthetic_snapshot(512, seed=17)
+    cpu, mem, reps_ = [100, 250, 900], [10 ** 8, 3 * 10 ** 8, 10 ** 9], [1, 4, 16]
+    oracle_by_gen = {}
+
+    def oracle_totals(s):
+        totals = []
+        for c, m in zip(cpu, mem):
+            fits = fit_arrays_python(
+                s.alloc_cpu_milli, s.alloc_mem_bytes, s.alloc_pods,
+                s.used_cpu_req_milli, s.used_mem_req_bytes, s.pods_count,
+                int(c), int(m), mode=s.semantics, healthy=s.healthy,
+            )
+            totals.append(int(sum(fits)))
+        return totals
+
+    pub = PlanePublisher(heartbeat_s=0.5)
+    leader = CapacityServer(snap, port=0, plane=pub, batch_window_ms=0.0)
+    leader.start()
+    oracle_by_gen[leader.generation] = oracle_totals(snap)
+    replicas, subs = [], []
+    for _i in range(2):
+        r = CapacityServer(
+            snap, port=0, batch_window_ms=0.0,
+            admission=AdmissionController(
+                max_concurrent=8, rps=max(rps * 1.5, 8.0),
+            ),
+        )
+        r.start()
+        subs.append(PlaneSubscriber(pub.address, r, stale_after_s=30.0))
+        replicas.append(r)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and any(
+        s.applied_generation < leader.generation for s in subs
+    ):
+        time.sleep(0.01)
+    rs = ReplicaSet(
+        [r.address for r in replicas],
+        connect_timeout_s=1.0, timeout_s=5.0, deadline_s=5.0, rounds=4,
+    )
+    results = []  # (t_offset, latency_s|None, kind, gen, totals|None)
+    lock = _threading.Lock()
+
+    def issue(t_offset):
+        t0 = time.perf_counter()
+        try:
+            r = rs.sweep(
+                cpu_request_milli=cpu, mem_request_bytes=mem,
+                replicas=reps_,
+            )
+            row = (t_offset, time.perf_counter() - t0, "ok",
+                   rs.last_generation, r["totals"])
+        except Exception as e:  # noqa: BLE001 - tallied as shed/error
+            kind = (
+                "shed"
+                if type(e).__name__ in ("OverloadedError", "DrainingError",
+                                        "ReplicaSetError")
+                else "error"
+            )
+            row = (t_offset, None, kind, None, None)
+        with lock:
+            results.append(row)
+
+    try:
+        n = int(rps * duration_s)
+        kill_at = duration_s / 3
+        killed = False
+        t_start = time.monotonic()
+        for i in range(n):
+            t_offset = i / rps
+            now = time.monotonic() - t_start
+            if t_offset > now:
+                time.sleep(t_offset - now)
+            if not killed and t_offset >= kill_at:
+                subs[0].stop()
+                replicas[0].shutdown()
+                killed = True
+            _threading.Thread(
+                target=issue, args=(t_offset,), daemon=True
+            ).start()
+        drain_deadline = time.monotonic() + 20
+        while time.monotonic() < drain_deadline:
+            with lock:
+                if len(results) >= n:
+                    break
+            time.sleep(0.05)
+
+        def window(lo, hi):
+            rows = [r for r in results if lo <= r[0] < hi]
+            oks = [r[1] for r in rows if r[2] == "ok"]
+            sheds = sum(1 for r in rows if r[2] in ("shed", "error"))
+            offered = max(len(rows), 1)
+            return {
+                "offered": len(rows),
+                "p50_ms": (
+                    round(statistics.median(oks) * 1e3, 3) if oks else None
+                ),
+                "p99_ms": (
+                    round(float(np.percentile(oks, 99)) * 1e3, 3)
+                    if oks else None
+                ),
+                "shed_rate": round(sheds / offered, 4),
+            }
+
+        pre = window(0, duration_s / 3)
+        kill = window(duration_s / 3, 2 * duration_s / 3)
+        post = window(2 * duration_s / 3, duration_s + 1)
+        parity_diffs = sum(
+            1
+            for r in results
+            if r[2] == "ok" and r[4] != oracle_by_gen.get(r[3])
+        )
+        out["serving_rps"] = rps
+        out["serving_requests"] = len(results)
+        out["serving_pre_p99_ms"] = pre["p99_ms"]
+        out["serving_pre_shed_rate"] = pre["shed_rate"]
+        out["serving_kill_p99_ms"] = kill["p99_ms"]
+        out["serving_kill_shed_rate"] = kill["shed_rate"]
+        out["serving_post_p99_ms"] = post["p99_ms"]
+        out["serving_post_shed_rate"] = post["shed_rate"]
+        out["serving_parity_diffs"] = parity_diffs
+        # Recovery, not collapse: the post-kill window serves again at
+        # (near) baseline shed rate — one surviving replica absorbs the
+        # whole offered load.
+        out["serving_recovered"] = bool(
+            post["shed_rate"] <= pre["shed_rate"] + 0.05
+            and post["p99_ms"] is not None
+        )
+    finally:
+        rs.close()
+        for s in subs:
+            s.stop()
+        for r in replicas:
+            r.shutdown()
+        pub.close()
+        leader.shutdown()
+    return out
 
 
 def _shadow_overhead_metrics(out: dict | None = None) -> dict:

@@ -53,6 +53,7 @@ __all__ = [
     "AuditLog",
     "AuditReader",
     "canonical_result_digest",
+    "snapshot_from_summary",
     "strip_args",
 ]
 
@@ -580,31 +581,43 @@ class AuditReader:
         taints_of: dict[str, list],
         semantics: str,
     ) -> ClusterSnapshot:
-        """Summary vocabulary → a servable snapshot.  Columns outside
-        the fit vocabulary (usage limits, extended resources, labels)
-        reconstruct empty — no replayable op consumes them."""
-        keys = list(rows)
-        n = len(keys)
-        cols = {
-            f: np.array([rows[k][i] for k in keys], dtype=np.int64)
-            for i, f in enumerate(NODE_FIELDS[:-1])
-        }
-        healthy = np.array(
-            [bool(rows[k][len(NODE_FIELDS) - 1]) for k in keys],
-            dtype=np.bool_,
-        )
-        taints = [list(taints_of.get(k) or []) for k in keys]
-        return ClusterSnapshot(
-            names=[name_of.get(k, k) for k in keys],
-            alloc_cpu_milli=cols["alloc_cpu_milli"],
-            alloc_mem_bytes=cols["alloc_mem_bytes"],
-            alloc_pods=cols["alloc_pods"],
-            used_cpu_req_milli=cols["used_cpu_req_milli"],
-            used_cpu_lim_milli=np.zeros(n, dtype=np.int64),
-            used_mem_req_bytes=cols["used_mem_req_bytes"],
-            used_mem_lim_bytes=np.zeros(n, dtype=np.int64),
-            pods_count=cols["pods_count"],
-            healthy=healthy,
-            semantics=semantics,
-            taints=taints if any(taints) else [],
-        )
+        return snapshot_from_summary(rows, name_of, taints_of, semantics)
+
+
+def snapshot_from_summary(
+    rows: dict[str, tuple[int, ...]],
+    name_of: dict[str, str],
+    taints_of: dict[str, list],
+    semantics: str,
+) -> ClusterSnapshot:
+    """Summary vocabulary → a servable snapshot.  Columns outside the
+    fit vocabulary (usage limits, extended resources, labels)
+    reconstruct empty — no replayable op consumes them.  Shared by the
+    audit replayer and the serving plane's replica subscriber
+    (:mod:`..service.plane`), which reconstruct snapshots from exactly
+    the same checkpoint+diff record shapes."""
+    keys = list(rows)
+    n = len(keys)
+    cols = {
+        f: np.array([rows[k][i] for k in keys], dtype=np.int64)
+        for i, f in enumerate(NODE_FIELDS[:-1])
+    }
+    healthy = np.array(
+        [bool(rows[k][len(NODE_FIELDS) - 1]) for k in keys],
+        dtype=np.bool_,
+    )
+    taints = [list(taints_of.get(k) or []) for k in keys]
+    return ClusterSnapshot(
+        names=[name_of.get(k, k) for k in keys],
+        alloc_cpu_milli=cols["alloc_cpu_milli"],
+        alloc_mem_bytes=cols["alloc_mem_bytes"],
+        alloc_pods=cols["alloc_pods"],
+        used_cpu_req_milli=cols["used_cpu_req_milli"],
+        used_cpu_lim_milli=np.zeros(n, dtype=np.int64),
+        used_mem_req_bytes=cols["used_mem_req_bytes"],
+        used_mem_lim_bytes=np.zeros(n, dtype=np.int64),
+        pods_count=cols["pods_count"],
+        healthy=healthy,
+        semantics=semantics,
+        taints=taints if any(taints) else [],
+    )

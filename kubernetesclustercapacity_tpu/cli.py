@@ -190,6 +190,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-dump-limit", type=int, default=None,
                    dest="dump_limit", metavar="N",
                    help="with -dump: only the N most recent records")
+    p.add_argument("-drain-server", default=None, dest="drain_server",
+                   metavar="HOST:PORT",
+                   help="gracefully drain a running capacity server: it "
+                        "stops accepting compute/mutation ops, finishes "
+                        "in-flight work, emits its final drain record, "
+                        "and deregisters from the replication plane; "
+                        "prints the drain record and exits 1 if in-"
+                        "flight work outlived the timeout")
+    p.add_argument("-drain-timeout-s", type=float, default=None,
+                   dest="drain_timeout_s", metavar="SECONDS",
+                   help="with -drain-server: how long the server may "
+                        "wait for in-flight work (default: the "
+                        "server's own -drain-timeout-s)")
+    p.add_argument("-plane-status", default=None, dest="plane_status",
+                   metavar="HOST:PORT",
+                   help="print a running server's serving-plane status "
+                        "(leader fan-out stats or replica sync/"
+                        "staleness state, plus capabilities) and exit; "
+                        "exit 1 when the replica is stale or the "
+                        "server is draining")
     return p
 
 
@@ -236,6 +256,12 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.dump:
         return _run_dump(args)
+
+    if args.drain_server:
+        return _run_drain_server(args)
+
+    if args.plane_status:
+        return _run_plane_status(args)
 
     if args.replay:
         return _run_replay(args)
@@ -484,6 +510,87 @@ def _run_dump(args) -> int:
     else:
         print(dump_table_report(result))
     return 0
+
+
+def _run_drain_server(args) -> int:
+    """-drain-server HOST:PORT: trigger a graceful drain over the wire
+    and print the server's drain record.  Exits by the verdict: 0 only
+    when every in-flight request finished inside the timeout."""
+    import json as _json
+
+    addr = _parse_addr("-drain-server", args.drain_server)
+    if addr is None:
+        return 1
+    # The drain op waits for in-flight work server-side: the client
+    # budget must comfortably outlive the server's wait.
+    wait = args.drain_timeout_s if args.drain_timeout_s is not None else 30.0
+    try:
+        with _diag_client(addr) as c:
+            record = c.drain_server(
+                timeout_s=args.drain_timeout_s,
+                deadline_s=wait + 10.0,
+            )
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot drain {addr[0]}:{addr[1]}: {e}",
+              file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(_json.dumps(record, sort_keys=True))
+    else:
+        print(
+            f"drain {'complete' if record.get('drained') else 'TIMED OUT'}"
+            f" : inflight_at_start={record.get('inflight_at_start')}"
+            f" remaining={record.get('inflight_remaining')}"
+            f" waited_s={record.get('waited_s')}"
+            + (" (already draining)" if record.get("already") else "")
+        )
+    return 0 if record.get("drained") else 1
+
+
+def _run_plane_status(args) -> int:
+    """-plane-status HOST:PORT: one look at an endpoint's place in the
+    replicated serving plane — role, generation, fan-out or sync
+    health, capabilities.  Exit 1 when the endpoint should be routed
+    around (stale replica / draining server)."""
+    import json as _json
+
+    addr = _parse_addr("-plane-status", args.plane_status)
+    if addr is None:
+        return 1
+    try:
+        with _diag_client(addr) as c:
+            info = c.info(plane=True)
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot reach {addr[0]}:{addr[1]}: {e}",
+              file=sys.stderr)
+        return 1
+    plane = info.get("plane")
+    caps = info.get("capabilities") or {}
+    draining = bool(info.get("draining"))
+    if args.output == "json":
+        print(_json.dumps(
+            {"plane": plane, "capabilities": caps, "draining": draining},
+            sort_keys=True,
+        ))
+    else:
+        if plane is None:
+            print("plane     : not a plane member")
+        else:
+            print(f"plane     : role={plane.get('role')} "
+                  f"generation={plane.get('generation')}")
+            if plane.get("role") == "replica":
+                print(f"sync      : age_s={plane.get('sync_age_s')} "
+                      f"stale={plane.get('stale')} "
+                      f"applied={plane.get('applied')} "
+                      f"resyncs={plane.get('resyncs')}")
+            else:
+                print(f"fan-out   : subscribers={plane.get('subscribers')} "
+                      f"published={plane.get('published')} "
+                      f"ejected={plane.get('ejected')}")
+        print(f"caps      : {caps or '(pre-plane server)'}")
+        print(f"draining  : {draining}")
+    stale = bool(plane and plane.get("role") == "replica" and plane.get("stale"))
+    return 1 if (stale or draining) else 0
 
 
 def _run_replay(args) -> int:

@@ -30,6 +30,12 @@ __all__ = [
     "DeadlineExpired",
     "CircuitBreaker",
     "CircuitOpenError",
+    "RetryableElsewhere",
+    "OverloadedError",
+    "DrainingError",
+    "NotLeaderError",
+    "TokenBucket",
+    "WIRE_CODES",
     "decorrelated_jitter",
 ]
 
@@ -41,6 +47,59 @@ class DeadlineExpired(TimeoutError):
 class CircuitOpenError(ConnectionError):
     """Fail-fast refusal: the breaker is open and the cooldown has not
     elapsed — the protected operation was not attempted at all."""
+
+
+class RetryableElsewhere(RuntimeError):
+    """The server REFUSED this request before doing any work on it.
+
+    The defining property: the operation provably did not execute, so a
+    retry — even of a mutation — cannot double-apply it.  A multi-
+    endpoint client (:class:`~.service.replicaset.ReplicaSet`) treats
+    every subclass as "try the next replica"; a single-endpoint client
+    surfaces it unchanged (retrying the same refusing server would just
+    add load to whatever made it refuse).  Deliberately NOT an
+    ``OSError``/``ConnectionError`` subclass: the transport worked fine,
+    so :meth:`RetryPolicy.is_transport_error` must not classify it as a
+    broken socket and re-send on the same connection.
+
+    ``wire_code`` is the machine-readable refusal class the server
+    stamps into the error envelope (``{"ok": false, "code": ...}``) so
+    clients dispatch on a stable token, never on error prose.
+    """
+
+    wire_code = "refused"
+
+
+class OverloadedError(RetryableElsewhere):
+    """503-style admission refusal: the server's admission controller
+    (concurrency limit or rps token bucket) shed the request before any
+    dispatch work."""
+
+    wire_code = "overloaded"
+
+
+class DrainingError(RetryableElsewhere):
+    """The server is draining (SIGTERM / ``drain_server`` op): it is
+    finishing in-flight work but accepting no new compute or mutation
+    requests.  Route to another replica."""
+
+    wire_code = "draining"
+
+
+class NotLeaderError(RetryableElsewhere):
+    """A mutation (``update``/``reload``) reached a plane REPLICA, which
+    serves a read-only view of the leader's snapshot stream.  Route the
+    mutation to the leader."""
+
+    wire_code = "not_leader"
+
+
+#: wire code → exception class, for the client side of the envelope.
+WIRE_CODES = {
+    cls.wire_code: cls
+    for cls in (RetryableElsewhere, OverloadedError, DrainingError,
+                NotLeaderError)
+}
 
 
 def decorrelated_jitter(
@@ -383,3 +442,65 @@ class CircuitBreaker:
                 "rejected": self._rejected,
                 "last_error": self._last_error,
             }
+
+
+class TokenBucket:
+    """Thread-safe token bucket: ``rate_per_s`` tokens/second of refill
+    up to ``capacity`` (the burst bound), starting full.
+
+    The rps half of server admission control: one :meth:`try_acquire`
+    per request; a request that finds the bucket empty is shed with
+    :class:`OverloadedError` instead of queued (the concurrency limiter
+    owns the queue; stacking a second queue here would just hide the
+    overload behind latency).  Non-blocking by design — the refill is
+    computed lazily from the injectable monotonic ``clock``, so there is
+    no filler thread to leak and the arithmetic is exactly testable
+    against an offline oracle (``tests/test_plane.py`` pins it against
+    a numpy recurrence).
+    """
+
+    def __init__(
+        self,
+        rate_per_s: float,
+        capacity: float | None = None,
+        *,
+        clock=time.monotonic,
+    ) -> None:
+        if rate_per_s <= 0:
+            raise ValueError(f"rate_per_s must be > 0, got {rate_per_s}")
+        if capacity is None:
+            capacity = max(float(rate_per_s), 1.0)
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.rate_per_s = float(rate_per_s)
+        self.capacity = float(capacity)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = self.capacity
+        self._last = clock()
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        elapsed = now - self._last
+        self._last = now
+        if elapsed > 0:
+            self._tokens = min(
+                self.capacity, self._tokens + elapsed * self.rate_per_s
+            )
+
+    def try_acquire(self, tokens: float = 1.0) -> bool:
+        """Take ``tokens`` if available right now; never blocks."""
+        if tokens <= 0:
+            raise ValueError(f"tokens must be > 0, got {tokens}")
+        with self._lock:
+            self._refill_locked()
+            if self._tokens >= tokens:
+                self._tokens -= tokens
+                return True
+            return False
+
+    def available(self) -> float:
+        """Current token count after refill (observability/tests)."""
+        with self._lock:
+            self._refill_locked()
+            return self._tokens

@@ -9,6 +9,10 @@ length-prefixed-JSON protocol over TCP:
 * :mod:`.protocol` — framing + request/response schema;
 * :mod:`.server`   — threaded TCP server dispatching to the kernels;
 * :mod:`.client`   — Python client;
+* :mod:`.plane`    — the replicated serving plane: leader→replica
+  snapshot pub-sub fan-out, admission control, graceful drain;
+* :mod:`.replicaset` — multi-endpoint client: failover, hedged reads,
+  read-your-generation monotonicity across replicas;
 * ``native/kccap_client.cc`` — the compiled front-end CLI (C++; the
   environment has no Go toolchain or grpcio, so the "Go → gRPC" leg of the
   north-star is realized as "C++ → framed JSON" with identical shape: flag
@@ -17,4 +21,5 @@ length-prefixed-JSON protocol over TCP:
 
 from kubernetesclustercapacity_tpu.service.client import CapacityClient  # noqa: F401
 from kubernetesclustercapacity_tpu.service.coalesce import SnapshotCoalescer  # noqa: F401
+from kubernetesclustercapacity_tpu.service.replicaset import ReplicaSet  # noqa: F401
 from kubernetesclustercapacity_tpu.service.server import CapacityServer  # noqa: F401

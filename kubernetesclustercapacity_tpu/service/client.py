@@ -20,16 +20,28 @@ dump,           yes (read-only views of the flight recorder / capacity
 timeline, slo   timeline / SLO burn rates; a retry re-reads the ring,
                 which may have advanced — acceptable for a diagnostic
                 surface)
+drain_server    yes (graceful drain is idempotent BY CONTRACT: the
+                second call returns the first drain's record)
 update, reload  NO (state mutations; at-most-once from this client)
 ==============  =======================================================
+
+Reply envelopes additionally carry ``generation`` (the snapshot
+generation that answered — kept on :attr:`CapacityClient.last_generation`
+for the plane's read-your-generation monotonicity) and, on refusals, a
+``code`` (``overloaded`` / ``draining`` / ``not_leader``) that maps to
+the typed :class:`~..resilience.RetryableElsewhere` exceptions — the
+server provably did no work, so a multi-endpoint client retries
+elsewhere; this client surfaces them unchanged.
 """
 
 from __future__ import annotations
 
 import socket
+import threading
 import time
 
 from kubernetesclustercapacity_tpu.resilience import (
+    WIRE_CODES,
     CircuitBreaker,
     CircuitOpenError,
     Deadline,
@@ -41,12 +53,14 @@ from kubernetesclustercapacity_tpu.service import protocol
 __all__ = ["CapacityClient", "IDEMPOTENT_OPS"]
 
 #: Ops safe to re-send after a transport failure: they never mutate
-#: served state, so duplicate execution is invisible.  Anything not in
-#: this set (update/reload, future unknown ops) is at-most-once.
+#: served state (or, for drain_server, are idempotent by contract), so
+#: duplicate execution is invisible.  Anything not in this set
+#: (update/reload, future unknown ops) is at-most-once.
 IDEMPOTENT_OPS = frozenset(
     {
         "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
         "topology_spread", "plan", "explain", "dump", "timeline", "slo",
+        "drain_server",
     }
 )
 
@@ -118,7 +132,14 @@ class CapacityClient:
         self._retry = retry if retry is not None else RetryPolicy()
         self._deadline_s = deadline_s
         self._breaker = breaker
+        # Guards the socket FIELD (swap in/out), not socket I/O: close()
+        # must be idempotent and safe against a concurrent in-flight
+        # call, which owns whatever socket object it already read.
+        self._sock_lock = threading.Lock()
         self._sock: socket.socket | None = None
+        #: Generation watermark from the last reply envelope (None until
+        #: a reply carries one — pre-plane servers never stamp it).
+        self.last_generation: int | None = None
         self.registry = registry if registry is not None else MetricsRegistry()
         self._m = {
             key: self.registry.counter(name, help_)
@@ -156,11 +177,17 @@ class CapacityClient:
         self.close()
 
     def close(self) -> None:
-        if self._sock is not None:
+        """Idempotent and thread-safe: the socket is swapped out under
+        the lock exactly once, so concurrent closers (or a close racing
+        an in-flight call's teardown) each see a consistent field and
+        ``socket.close`` is never double-invoked on a replaced socket."""
+        with self._sock_lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
             try:
-                self._sock.close()
-            finally:
-                self._sock = None
+                sock.close()
+            except OSError:  # already torn down by the peer: closed is closed
+                pass
 
     # -- transport ---------------------------------------------------------
     def _connect(self) -> socket.socket:
@@ -168,14 +195,17 @@ class CapacityClient:
             self._addr, timeout=self._connect_timeout
         )
         sock.settimeout(self._timeout)
-        self._sock = sock
+        with self._sock_lock:
+            self._sock = sock
         return sock
 
     def _ensure_connected(self) -> socket.socket:
-        if self._sock is None:
+        with self._sock_lock:
+            sock = self._sock
+        if sock is None:
             self._m["reconnects"].inc()
             return self._connect()
-        return self._sock
+        return sock
 
     def _attempt(self, msg: dict, deadline: Deadline | None):
         """One send/recv round trip.  Transport failures tear the socket
@@ -203,13 +233,28 @@ class CapacityClient:
             self.close()
             raise
         finally:
-            if deadline is not None and self._sock is not None:
-                self._sock.settimeout(self._timeout)
+            if deadline is not None:
+                try:
+                    sock.settimeout(self._timeout)
+                except OSError:
+                    pass  # socket already torn down by close()
         if resp is None:
             self.close()
             raise protocol.ProtocolError("server closed connection")
+        gen = resp.get("generation")
+        if isinstance(gen, int) and not isinstance(gen, bool):
+            # The reply's generation watermark (success or refusal) —
+            # the plane client compares it across endpoints to enforce
+            # read-your-generation monotonicity.
+            self.last_generation = gen
         if not resp.get("ok"):
-            raise RuntimeError(resp.get("error", "unknown server error"))
+            err = resp.get("error", "unknown server error")
+            cls = WIRE_CODES.get(resp.get("code"))
+            if cls is not None:
+                # Typed refusal (overloaded/draining/not_leader): the
+                # server provably did no work — retryable elsewhere.
+                raise cls(err)
+            raise RuntimeError(err)
         return resp["result"]
 
     # -- the call loop -----------------------------------------------------
@@ -460,6 +505,31 @@ class CapacityClient:
         return self.call("info", audit=True, **kw).get(
             "audit", {"enabled": False, "log": None, "shadow": None}
         )
+
+    def drain_server(self, timeout_s: float | None = None, **kw) -> dict:
+        """Gracefully drain the server: it stops accepting compute and
+        mutation ops (refusing them with the retryable-elsewhere
+        ``draining`` code), finishes in-flight work (bounded by
+        ``timeout_s``), emits its final drain record, and deregisters
+        from the plane.  Returns the drain record; idempotent — a
+        repeat call returns the first record with ``already: true``."""
+        if timeout_s is not None:
+            kw["timeout_s"] = timeout_s
+        return self.call("drain_server", **kw)
+
+    def plane_status(self, **kw) -> dict | None:
+        """The server's serving-plane section (``info {plane: true}``):
+        leader fan-out stats or replica sync/staleness state; ``None``
+        when the server is not part of a plane."""
+        return self.call("info", plane=True, **kw).get("plane")
+
+    def capabilities(self, **kw) -> dict:
+        """The server's protocol feature handshake (``info`` →
+        ``capabilities``).  Pre-plane servers advertise nothing — an
+        empty dict, which feature gates treat as "assume not supported"
+        (degrade, don't error)."""
+        caps = self.call("info", **kw).get("capabilities")
+        return caps if isinstance(caps, dict) else {}
 
     def slo_status(self, **kw) -> dict:
         """The server's SLO burn-rate status: every objective's

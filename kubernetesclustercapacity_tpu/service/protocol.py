@@ -64,10 +64,25 @@ timeline   the server's capacity timeline: per-generation watchlist
            after) and ``watch`` (one name) filters; ``{enabled: false}``
            when the server runs without ``-watch``/``-timeline-depth``
 reload     ``path`` — swap the served snapshot (fixture .json or .npz);
-           optional ``semantics``
+           optional ``semantics``; refused with code ``not_leader`` on
+           a plane replica
 update     ``events`` — watch-style node/pod event list applied
-           incrementally to the served snapshot (fixture-backed only)
+           incrementally to the served snapshot (fixture-backed only);
+           refused with code ``not_leader`` on a plane replica
+drain_server  graceful drain: stop accepting compute/mutation ops
+           (refused with code ``draining``), finish in-flight work
+           (optional ``timeout_s`` bounds the wait, optional ``reason``
+           is recorded), emit the final drain record, deregister from
+           the plane; the reply IS the drain record; idempotent (a
+           repeat returns the first record with ``already: true``)
 =========  ==========================================================
+
+``info`` additionally reports the protocol feature handshake under
+``capabilities`` (``{protocol, plane, admission, drain}``) and a
+top-level ``draining`` flag, and accepts optional ``plane`` (bool) to
+include the serving-plane section (leader fan-out stats or replica
+sync/staleness state) — clients built for the replicated plane
+feature-gate on ``capabilities`` so old↔new pairings degrade cleanly.
 
 Any request may additionally carry:
 
@@ -89,6 +104,12 @@ Any request may additionally carry:
     the request in the server's trace log; it never changes the reply.
 
 Responses: ``{"ok": true, "result": ...}`` or ``{"ok": false, "error": "..."}``.
+Every response envelope also carries ``generation`` — the snapshot
+generation that answered (a plane replica stamps the LEADER's numbering),
+the watermark clients use for read-your-generation monotonicity — and a
+refusal additionally carries ``code`` (``overloaded`` | ``draining`` |
+``not_leader``): the server provably did no work, so the request is
+safe to retry on another replica, mutations included.
 Maximum frame size 64 MiB (a 10k-node JSON report is ~3 MB).
 """
 

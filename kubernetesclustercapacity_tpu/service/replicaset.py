@@ -1,0 +1,604 @@
+"""Multi-endpoint capacity client: failover, hedging, monotonic reads.
+
+A :class:`~.client.CapacityClient` talks to ONE server; this module
+talks to the replicated serving plane (:mod:`.plane`): N endpoints —
+typically one leader plus its replicas — behind one call surface.
+
+* **Failover** — each endpoint has its own
+  :class:`~..resilience.CircuitBreaker` and health state.  A transport
+  failure, an open breaker, or a refuse-before-work error
+  (:class:`~..resilience.RetryableElsewhere`: overloaded / draining /
+  not-leader) moves the call to the next endpoint.  Refusals are safe
+  to retry ANYWHERE — the server provably did no work — so even
+  mutations fail over across refusals; a mutation whose transport died
+  *mid-call* is never resent (at-most-once, same rule as the
+  single-endpoint client).
+* **Read-your-generation monotonicity** — every server reply envelope
+  carries the generation that answered.  The set keeps a high-water
+  mark per client session; an answer stamped OLDER than the watermark
+  is discarded (the endpoint is marked stale and the call fails over)
+  — a client that has seen generation G never regresses to a replica
+  still serving G-1, no matter how routing lands.
+* **Hedged reads** — optional, idempotent ops only (mutations are
+  NEVER hedged).  If the primary attempt has not answered within the
+  hedge delay — adaptive: the p95 of recent call latencies, clamped to
+  ``[hedge_min_delay_s, hedge_max_delay_s]`` — a second attempt fires
+  on the next healthy endpoint and the first verified answer wins.
+  Tail latency becomes min(two samples) at the cost of bounded extra
+  load.
+* **Capability handshake** — :meth:`probe` reads each endpoint's
+  ``info.capabilities``; plane-era features degrade cleanly against
+  pre-plane servers (no generation watermark → monotonicity not
+  enforced there; :meth:`drain_server` refuses locally instead of
+  sending an op the server would not recognize).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+
+from kubernetesclustercapacity_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExpired,
+    RetryableElsewhere,
+    RetryPolicy,
+)
+from kubernetesclustercapacity_tpu.service.client import (
+    IDEMPOTENT_OPS,
+    CapacityClient,
+)
+
+__all__ = ["ReplicaSet", "ReplicaSetError", "StaleReadError", "parse_endpoints"]
+
+
+class ReplicaSetError(ConnectionError):
+    """Every endpoint was tried and none produced a valid answer."""
+
+
+class StaleReadError(RuntimeError):
+    """Every reachable endpoint answered with a generation older than
+    the session watermark — the set as a whole has regressed (e.g. the
+    only fresh replica died).  Retrying later is reasonable; returning
+    the stale answer would violate read-your-generation monotonicity,
+    so it is never done."""
+
+
+def parse_endpoints(spec) -> list[tuple[str, int]]:
+    """``"h1:p1,h2:p2"`` / iterable of ``"h:p"`` / ``(h, p)`` pairs →
+    endpoint list (the ``kccap -server`` flag grammar)."""
+    if isinstance(spec, str):
+        spec = [s for s in spec.split(",") if s.strip()]
+    out: list[tuple[str, int]] = []
+    for item in spec:
+        if isinstance(item, str):
+            host, _, port_s = item.strip().rpartition(":")
+            if not host or not port_s.isdigit():
+                raise ValueError(
+                    f"bad endpoint {item!r} (want HOST:PORT)"
+                )
+            out.append((host, int(port_s)))
+        else:
+            host, port = item
+            out.append((str(host), int(port)))
+    if not out:
+        raise ValueError("ReplicaSet needs at least one endpoint")
+    return out
+
+
+class _Endpoint:
+    """One replica: its lazy client, breaker, and health bookkeeping.
+    ``lock`` serializes use of the underlying single-connection client
+    (concurrent ReplicaSet calls hedge across DIFFERENT endpoints, never
+    share one socket)."""
+
+    def __init__(self, addr: tuple[str, int], breaker: CircuitBreaker) -> None:
+        self.addr = addr
+        self.breaker = breaker
+        self.lock = threading.Lock()
+        self.client: CapacityClient | None = None
+        self.stale = False
+        self.draining = False
+        self.role: str | None = None
+        self.capabilities: dict = {}
+        self.last_generation: int | None = None
+
+    @property
+    def name(self) -> str:
+        return f"{self.addr[0]}:{self.addr[1]}"
+
+
+class ReplicaSet:
+    """Call the replicated serving plane as if it were one server.
+
+    ``endpoints`` accepts the :func:`parse_endpoints` grammar.  Each
+    call walks the healthy endpoints (sticky: the last endpoint that
+    answered goes first) under an overall ``deadline_s`` budget;
+    ``rounds`` bounds how many full passes over the set a call may make
+    before giving up.  ``hedge=True`` arms hedged reads for idempotent
+    ops.  Thread-safe: concurrent calls are serialized per endpoint,
+    not per set.
+    """
+
+    def __init__(
+        self,
+        endpoints,
+        *,
+        token: str | None = None,
+        deadline_s: float | None = None,
+        connect_timeout_s: float = 5.0,
+        timeout_s: float | None = 120.0,
+        rounds: int = 3,
+        retry_backoff: RetryPolicy | None = None,
+        breaker_factory=None,
+        hedge: bool = False,
+        hedge_min_delay_s: float = 0.01,
+        hedge_max_delay_s: float = 1.0,
+        registry=None,
+        trace: bool = False,
+    ) -> None:
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            MetricsRegistry,
+        )
+
+        addrs = parse_endpoints(endpoints)
+        if breaker_factory is None:
+            def breaker_factory(addr):
+                return CircuitBreaker(
+                    failure_threshold=3,
+                    recovery_timeout_s=1.0,
+                    name=f"{addr[0]}:{addr[1]}",
+                )
+        self._endpoints = [_Endpoint(a, breaker_factory(a)) for a in addrs]
+        self._token = token
+        self._deadline_s = deadline_s
+        self._connect_timeout = connect_timeout_s
+        self._timeout = timeout_s
+        self._rounds = max(1, int(rounds))
+        self._backoff = (
+            retry_backoff
+            if retry_backoff is not None
+            else RetryPolicy(max_attempts=1, base_delay_s=0.01,
+                             max_delay_s=0.25)
+        )
+        self._hedge = bool(hedge)
+        self._hedge_min = float(hedge_min_delay_s)
+        self._hedge_max = float(hedge_max_delay_s)
+        self._trace = bool(trace)
+        self._lock = threading.Lock()
+        self._watermark = 0
+        #: Generation stamped on the last successful answer (None until
+        #: one arrives) — the chaos suite joins answers to their oracle
+        #: snapshot through it.
+        self.last_generation: int | None = None
+        self._preferred = 0
+        self._latencies: list[float] = []  # bounded sample window
+        self._closed = False
+        self.registry = registry if registry is not None else MetricsRegistry()
+        m = self.registry
+        self._m_calls = m.counter(
+            "kccap_replicaset_calls_total",
+            "ReplicaSet calls issued, by op.",
+            ("op",),
+        )
+        self._m_failover = m.counter(
+            "kccap_replicaset_failovers_total",
+            "Endpoint-to-endpoint failovers, by cause.",
+            ("cause",),
+        )
+        self._m_hedges = m.counter(
+            "kccap_replicaset_hedges_total",
+            "Hedged (secondary) attempts launched.",
+        )
+        self._m_hedge_wins = m.counter(
+            "kccap_replicaset_hedge_wins_total",
+            "Calls won by the hedged attempt.",
+        )
+        self._m_stale = m.counter(
+            "kccap_replicaset_stale_rejected_total",
+            "Answers discarded for regressing the generation watermark.",
+        )
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def watermark(self) -> int:
+        """The highest generation this session has observed."""
+        with self._lock:
+            return self._watermark
+
+    @property
+    def endpoints(self) -> list[str]:
+        return [ep.name for ep in self._endpoints]
+
+    def stats(self) -> dict:
+        with self._lock:
+            watermark = self._watermark
+        return {
+            "watermark": watermark,
+            "endpoints": [
+                {
+                    "endpoint": ep.name,
+                    "breaker": ep.breaker.state,
+                    "stale": ep.stale,
+                    "draining": ep.draining,
+                    "role": ep.role,
+                    "last_generation": ep.last_generation,
+                }
+                for ep in self._endpoints
+            ],
+            "hedge_delay_s": round(self._hedge_delay(), 6),
+        }
+
+    def probe(self, *, deadline_s: float = 2.0) -> list[dict]:
+        """One ``info`` round over every endpoint: refresh role,
+        draining, capability, and plane-staleness state (used by the
+        rotation order and by feature gating).  Never raises — an
+        unreachable endpoint is reported, not fatal."""
+        out = []
+        for ep in self._endpoints:
+            entry: dict = {"endpoint": ep.name}
+            try:
+                info = self._call_endpoint(
+                    ep, "info", {"plane": True},
+                    Deadline.after(deadline_s),
+                )
+            except Exception as e:  # noqa: BLE001 - probe summarizes, never raises
+                entry["error"] = f"{type(e).__name__}: {e}"
+                out.append(entry)
+                continue
+            caps = info.get("capabilities") or {}
+            plane = info.get("plane") or {}
+            ep.capabilities = caps if isinstance(caps, dict) else {}
+            ep.role = plane.get("role") if isinstance(plane, dict) else None
+            ep.draining = bool(info.get("draining"))
+            if isinstance(plane, dict) and plane.get("stale"):
+                ep.stale = True
+            entry.update(
+                capabilities=ep.capabilities,
+                role=ep.role,
+                draining=ep.draining,
+                generation=ep.last_generation,
+            )
+            out.append(entry)
+        return out
+
+    def capability(self, name: str) -> bool:
+        """True when ANY probed endpoint advertises the capability
+        (``probe()`` refreshes; unknown until then)."""
+        return any(
+            bool(ep.capabilities.get(name)) for ep in self._endpoints
+        )
+
+    # -- the call loop -----------------------------------------------------
+    def call(self, op: str, deadline_s: float | None = None, **params):
+        """Issue one op against the healthiest endpoint, failing over /
+        hedging as configured.  Raises :class:`ReplicaSetError` when
+        every endpoint fails, :class:`StaleReadError` when only
+        watermark-regressing answers exist."""
+        with self._lock:
+            if self._closed:
+                raise ReplicaSetError("ReplicaSet is closed")
+        budget = self._deadline_s if deadline_s is None else deadline_s
+        deadline = Deadline.after(budget) if budget is not None else None
+        self._m_calls.labels(op=op).inc()
+        hedgeable = self._hedge and op in IDEMPOTENT_OPS
+        errors: list[str] = []
+        stale_seen = 0
+        prev_delay: float | None = None
+        for round_i in range(self._rounds):
+            for ep in self._rotation():
+                if deadline is not None and deadline.expired():
+                    raise DeadlineExpired(
+                        f"deadline expired after {len(errors)} endpoint "
+                        f"attempt(s) of {op!r}"
+                        + (f"; last: {errors[-1]}" if errors else "")
+                    )
+                if not ep.breaker.allow():
+                    errors.append(f"{ep.name}: breaker open")
+                    self._m_failover.labels(cause="breaker_open").inc()
+                    continue
+                try:
+                    if hedgeable:
+                        result, gen, won_by_hedge = self._attempt_hedged(
+                            ep, op, params, deadline
+                        )
+                        if won_by_hedge:
+                            self._m_hedge_wins.inc()
+                    else:
+                        t0 = time.perf_counter()
+                        result = self._call_endpoint(
+                            ep, op, params, deadline
+                        )
+                        self._note_latency(time.perf_counter() - t0)
+                        gen = ep.last_generation
+                except DeadlineExpired:
+                    raise
+                except RetryableElsewhere as e:
+                    # The server refused before doing work: safe to try
+                    # the next replica, mutations included.
+                    errors.append(f"{ep.name}: {e}")
+                    ep.draining = e.wire_code == "draining"
+                    self._m_failover.labels(cause=e.wire_code).inc()
+                    continue
+                except CircuitOpenError as e:
+                    errors.append(f"{ep.name}: {e}")
+                    self._m_failover.labels(cause="breaker_open").inc()
+                    continue
+                except Exception as e:
+                    transport = RetryPolicy.is_transport_error(e)
+                    if not transport:
+                        raise  # deterministic app error: the answer
+                    ep.breaker.record_failure(f"{type(e).__name__}: {e}")
+                    errors.append(f"{ep.name}: {type(e).__name__}: {e}")
+                    self._m_failover.labels(cause="transport").inc()
+                    if op not in IDEMPOTENT_OPS:
+                        # The mutation may have executed before the
+                        # transport died: at-most-once forbids resending
+                        # it anywhere.
+                        raise
+                    continue
+                ep.breaker.record_success()
+                ok, verdict = self._advance_watermark(ep, gen)
+                if ok:
+                    with self._lock:
+                        self._preferred = self._endpoints.index(ep)
+                        if gen is not None:
+                            self.last_generation = int(gen)
+                    return result
+                # Stale answer: discard, mark, move on.
+                stale_seen += 1
+                errors.append(f"{ep.name}: {verdict}")
+                self._m_stale.inc()
+                self._m_failover.labels(cause="stale").inc()
+            if round_i + 1 < self._rounds:
+                prev_delay = self._backoff.next_delay(prev_delay)
+                if deadline is not None:
+                    prev_delay = min(
+                        prev_delay, max(deadline.remaining(), 0.0)
+                    )
+                time.sleep(prev_delay)
+        if stale_seen:
+            # Data WAS available — but only below the session watermark.
+            # Refusing it is the monotonicity contract; say so instead
+            # of a generic all-endpoints-failed error.
+            raise StaleReadError(
+                f"every reachable endpoint answered below watermark "
+                f"{self.watermark} for {op!r}: {'; '.join(errors)}"
+            )
+        raise ReplicaSetError(
+            f"all {len(self._endpoints)} endpoint(s) failed for {op!r} "
+            f"after {len(errors)} attempt(s): {'; '.join(errors[-4:])}"
+        )
+
+    def _rotation(self) -> list[_Endpoint]:
+        """Endpoints in try order: sticky-preferred first, then the
+        rest; known-stale/draining endpoints demoted to the back (still
+        tried — they may have recovered, and a lone endpoint is better
+        than none)."""
+        with self._lock:
+            start = self._preferred
+        eps = self._endpoints
+        ordered = [eps[(start + i) % len(eps)] for i in range(len(eps))]
+        healthy = [ep for ep in ordered if not (ep.stale or ep.draining)]
+        demoted = [ep for ep in ordered if ep.stale or ep.draining]
+        return healthy + demoted
+
+    def _client_for(self, ep: _Endpoint) -> CapacityClient:
+        """The endpoint's lazy client (caller holds ``ep.lock``)."""
+        if ep.client is None:
+            ep.client = CapacityClient(
+                ep.addr[0],
+                ep.addr[1],
+                token=self._token,
+                connect_timeout_s=self._connect_timeout,
+                timeout_s=self._timeout,
+                # The set owns cross-endpoint retry; the per-endpoint
+                # client must surface the FIRST transport failure so
+                # failover is immediate, not after a local retry storm.
+                retry=RetryPolicy(max_attempts=1),
+                trace=self._trace,
+            )
+        return ep.client
+
+    def _call_endpoint(self, ep: _Endpoint, op, params, deadline):
+        """One op on one endpoint (its lock serializes the socket).
+        Records the endpoint's reply generation on success."""
+        with ep.lock:
+            client = self._client_for(ep)
+            result = client.call(
+                op,
+                deadline_s=(
+                    max(deadline.remaining(), 0.001)
+                    if deadline is not None
+                    else None
+                ),
+                **params,
+            )
+            gen = client.last_generation
+        if gen is not None:
+            ep.last_generation = gen
+        return result
+
+    # -- hedging -----------------------------------------------------------
+    def _hedge_delay(self) -> float:
+        """p95 of the recent successful-call latencies, clamped — the
+        'this attempt is taking suspiciously long' threshold."""
+        with self._lock:
+            samples = sorted(self._latencies)
+        if len(samples) < 8:
+            return self._hedge_max / 4
+        idx = min(len(samples) - 1, int(0.95 * len(samples)))
+        return min(self._hedge_max, max(self._hedge_min, samples[idx]))
+
+    def _note_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+            if len(self._latencies) > 64:
+                del self._latencies[0]
+
+    def _attempt_hedged(self, primary: _Endpoint, op, params, deadline):
+        """Primary attempt plus (after the hedge delay) one secondary on
+        the next healthy endpoint; first answer wins.  Returns
+        ``(result, generation, won_by_hedge)``; raises the primary's
+        error when both fail."""
+        results: _queue.Queue = _queue.Queue()
+
+        def attempt(ep: _Endpoint, tag: str) -> None:
+            t0 = time.perf_counter()
+            try:
+                r = self._call_endpoint(ep, op, params, deadline)
+            except Exception as e:  # noqa: BLE001 - reported via the queue
+                results.put((tag, ep, None, e))
+                return
+            self._note_latency(time.perf_counter() - t0)
+            results.put((tag, ep, r, None))
+
+        t_primary = threading.Thread(
+            target=attempt, args=(primary, "primary"), daemon=True
+        )
+        t_primary.start()
+        delay = self._hedge_delay()
+        if deadline is not None:
+            delay = min(delay, max(deadline.remaining(), 0.0))
+        try:
+            tag, ep, result, err = results.get(timeout=delay)
+        except _queue.Empty:
+            secondary = self._hedge_candidate(primary)
+            if secondary is None:
+                tag, ep, result, err = results.get()
+            else:
+                self._m_hedges.inc()
+                threading.Thread(
+                    target=attempt, args=(secondary, "hedge"), daemon=True
+                ).start()
+                tag, ep, result, err = results.get()
+                if err is not None:
+                    # First finisher failed; give the other leg its
+                    # chance before surfacing anything.
+                    tag, ep, result, err = results.get()
+        if err is not None:
+            if isinstance(err, Exception):
+                raise err
+            raise ReplicaSetError(str(err))
+        if ep is not primary:
+            ep.breaker.record_success()
+        return result, ep.last_generation, tag == "hedge"
+
+    def _hedge_candidate(self, primary: _Endpoint) -> _Endpoint | None:
+        for ep in self._rotation():
+            if ep is primary:
+                continue
+            if ep.breaker.allow():
+                return ep
+        return None
+
+    # -- monotonicity ------------------------------------------------------
+    def _advance_watermark(self, ep: _Endpoint, gen) -> tuple[bool, str]:
+        """Enforce read-your-generation: an answer older than the
+        watermark is rejected (never returned).  Servers that stamp no
+        generation (pre-plane) cannot be checked — degrade to
+        best-effort, documented in the handshake contract."""
+        if gen is None:
+            return True, ""
+        gen = int(gen)
+        with self._lock:
+            if gen < self._watermark:
+                ep.stale = True
+                return False, (
+                    f"stale answer: generation {gen} < session "
+                    f"watermark {self._watermark}"
+                )
+            self._watermark = gen
+        ep.stale = False
+        return True, ""
+
+    # -- convenience wrappers (the single-client surface) ------------------
+    def ping(self, **kw) -> str:
+        return self.call("ping", **kw)
+
+    def info(self, **kw) -> dict:
+        return self.call("info", **kw)
+
+    def fit(self, **flags) -> dict:
+        return self.call("fit", **flags)
+
+    def sweep(self, **params) -> dict:
+        for key in ("cpu_request_milli", "mem_request_bytes", "replicas"):
+            v = params.get(key)
+            if v is not None and hasattr(v, "tolist"):
+                params[key] = v.tolist()
+        return self.call("sweep", **params)
+
+    def explain(self, **flags) -> dict:
+        return self.call("explain", **flags)
+
+    def dump(self, **kw) -> dict:
+        return self.call("dump", **kw)
+
+    def update(self, events: list[dict], **kw) -> dict:
+        """Mutation: routed with failover ONLY across refuse-before-work
+        errors (draining / not-leader / overloaded); never hedged,
+        never resent after a mid-call transport failure."""
+        return self.call("update", events=events, **kw)
+
+    def reload(self, path: str, **kw) -> dict:
+        return self.call("reload", path=path, **kw)
+
+    def drain_server(self, endpoint: str | None = None, **kw) -> dict:
+        """Gracefully drain ONE endpoint (default: the first).  Checks
+        the capability handshake first so a pre-plane server gets a
+        clean local refusal instead of an unknown-op error."""
+        targets = (
+            [ep for ep in self._endpoints if ep.name == endpoint]
+            if endpoint is not None
+            else self._endpoints[:1]
+        )
+        if not targets:
+            raise ValueError(f"unknown endpoint {endpoint!r}")
+        ep = targets[0]
+        if not ep.capabilities:
+            # Capabilities unknown (never probed, or a pre-plane server
+            # that advertises none): one info round settles it before we
+            # risk an op the server may not recognize.
+            try:
+                info = self._call_endpoint(
+                    ep, "info", {}, Deadline.after(5.0)
+                )
+                caps = info.get("capabilities")
+                ep.capabilities = caps if isinstance(caps, dict) else {}
+            except Exception:  # noqa: BLE001 - unreachable = not capable
+                ep.capabilities = {}
+        if not ep.capabilities.get("drain"):
+            raise ReplicaSetError(
+                f"{ep.name} does not advertise the drain capability "
+                "(pre-plane server?)"
+            )
+        deadline = Deadline.after(
+            kw.pop("deadline_s", None) or 30.0
+        )
+        result = self._call_endpoint(ep, "drain_server", kw, deadline)
+        ep.draining = True
+        return result
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        """Idempotent, thread-safe (same contract as the single
+        client's close — pinned by test)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for ep in self._endpoints:
+            with ep.lock:
+                client, ep.client = ep.client, None
+            if client is not None:
+                client.close()
+
+    def __enter__(self) -> "ReplicaSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

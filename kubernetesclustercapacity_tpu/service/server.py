@@ -58,6 +58,20 @@ class _Handler(socketserver.BaseRequestHandler):
                 reply = {"ok": True, "result": server.dispatch(msg)}
             except Exception as e:  # noqa: BLE001 - service boundary
                 reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                # Machine-readable refusal class (overloaded/draining/
+                # not_leader): multi-endpoint clients dispatch on this
+                # token — never on error prose — to decide
+                # retryable-elsewhere.
+                code = getattr(e, "wire_code", None)
+                if isinstance(code, str):
+                    reply["code"] = code
+            # The generation watermark: every reply says WHICH snapshot
+            # generation answered (the plane's read-your-generation
+            # monotonicity contract rides on it).  Same thread as the
+            # dispatch, so the thread-local read is race-free.
+            gen = server.last_dispatch_generation()
+            if gen is not None:
+                reply["generation"] = gen
             try:
                 protocol.send_msg(self.request, reply)
             except OSError:
@@ -67,6 +81,35 @@ class _Handler(socketserver.BaseRequestHandler):
 class _ThreadingServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+
+    # Track live per-connection sockets so shutdown can SEVER them: a
+    # stopped server must look dead to connected clients (the failover
+    # signal), not keep answering on old connections like a ghost.
+    def __init__(self, *args, **kwargs) -> None:
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        with self._conns_lock:
+            self._conns.add(sock)
+        return sock, addr
+
+    def shutdown_request(self, request) -> None:
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class CapacityServer:
@@ -110,6 +153,9 @@ class CapacityServer:
         audit_log=None,
         shadow=None,
         slo=None,
+        admission=None,
+        plane=None,
+        drain_timeout_s: float = 10.0,
     ) -> None:
         """``stats_source`` is an optional zero-arg callable returning a
         JSON-able dict of upstream-feed health (e.g.
@@ -166,7 +212,19 @@ class CapacityServer:
         ``slo`` (a :class:`~..telemetry.slo.SLOMonitor`) evaluates
         latency/availability objectives as multi-window error-budget
         burn rates over this server's own request metrics, served by
-        the ``slo`` op (and, in ``main``, wired into ``/healthz``)."""
+        the ``slo`` op (and, in ``main``, wired into ``/healthz``).
+
+        ``admission`` (a :class:`~.plane.AdmissionController`) gates
+        every compute op BEFORE any work: deadline-slack shedding, an
+        rps token bucket, and a bounded concurrency queue — refusals
+        surface as the 503-style ``overloaded`` wire code that
+        multi-endpoint clients treat as retryable-elsewhere.
+
+        ``plane`` (a :class:`~.plane.PlanePublisher`) makes this server
+        the LEADER of a replicated serving plane: every published
+        generation (the same funnel the timeline and audit log observe)
+        fans out to subscribed replica servers.  ``drain_timeout_s``
+        bounds :meth:`begin_drain`'s wait for in-flight work."""
         import os
 
         from kubernetesclustercapacity_tpu.telemetry.flightrec import (
@@ -193,6 +251,25 @@ class CapacityServer:
         self._audit = audit_log
         self._shadow = shadow
         self._slo = slo
+        self._admission = admission
+        self._plane = plane
+        self._plane_role = "leader" if plane is not None else None
+        self._plane_stats_source = (
+            plane.stats if plane is not None else None
+        )
+        # Graceful-drain state: _draining flips once and never back;
+        # _active_gated counts in-flight drain-gated ops (compute +
+        # mutations) so begin_drain can wait for quiesce.
+        self._drain_timeout_s = float(drain_timeout_s)
+        self._drain_cv = threading.Condition()
+        self._draining = False
+        self._active_gated = 0
+        self._drain_lock = threading.Lock()
+        self._drain_result: dict | None = None
+        self._drain_hooks: list = []
+        #: Optional observer fired (with the drain record) after a
+        #: completed drain — ``main`` uses it to stop the serve loop.
+        self.on_drained = None
         m = self.registry
         self._m_requests = m.counter(
             "kccap_requests_total", "Requests dispatched, by op.", ("op",)
@@ -221,6 +298,10 @@ class CapacityServer:
         self._m_shed = m.counter(
             "kccap_deadline_shed_total",
             "Requests shed because their deadline had already expired.",
+        )
+        self._m_draining = m.gauge(
+            "kccap_server_draining",
+            "1 while the server is draining (graceful shutdown), else 0.",
         )
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             SUB_MS_LATENCY_BUCKETS_S,
@@ -302,6 +383,129 @@ class CapacityServer:
         configured)."""
         return self._timeline
 
+    @property
+    def draining(self) -> bool:
+        """True once a graceful drain has begun (it never un-begins)."""
+        with self._drain_cv:
+            return self._draining
+
+    def last_dispatch_generation(self) -> int | None:
+        """The generation that answered the CURRENT thread's most recent
+        dispatch (thread-local; the reply-envelope watermark)."""
+        return getattr(self._dispatch_tls, "last_generation", None)
+
+    def set_plane_role(self, role: str, stats_source=None) -> None:
+        """Declare this server's plane membership (``"leader"`` /
+        ``"replica"``).  A replica serves a read-only view — mutations
+        are refused with the ``not_leader`` wire code.  ``stats_source``
+        (zero-arg, JSON-able) feeds the ``info {plane: true}`` section."""
+        if role not in ("leader", "replica"):
+            raise ValueError(f"plane role must be leader/replica, got {role!r}")
+        self._plane_role = role
+        if stats_source is not None:
+            self._plane_stats_source = stats_source
+
+    def add_drain_hook(self, hook) -> None:
+        """Register a zero-arg callable run at the START of a graceful
+        drain (plane deregistration: the replica's subscriber stop, a
+        follower stop).  Best-effort, run in registration order."""
+        self._drain_hooks.append(hook)
+
+    def begin_drain(self, *, timeout_s=None, reason: str = "") -> dict:
+        """Gracefully drain this server: stop accepting compute/mutation
+        ops (refused with the ``draining`` wire code — retryable
+        elsewhere), deregister from the plane (drain hooks + leader
+        drain announcement), wait up to ``timeout_s`` for in-flight
+        gated ops to finish, then emit ONE durable drain record (audit
+        log + request log) and fire :attr:`on_drained`.
+
+        Idempotent and thread-safe: concurrent callers serialize; the
+        second and later callers get the first drain's record back with
+        ``"already": true``.  Diagnostics (ping/info/dump/...) keep
+        answering throughout, so operators and load balancers can watch
+        the drain happen.
+        """
+        import time as _time
+
+        timeout_s = (
+            self._drain_timeout_s if timeout_s is None else float(timeout_s)
+        )
+        with self._drain_cv:
+            inflight0 = self._active_gated
+            self._draining = True
+        self._m_draining.set(1)
+        with self._drain_lock:
+            if self._drain_result is not None:
+                return {**self._drain_result, "already": True}
+            for hook in list(self._drain_hooks):
+                try:
+                    hook()
+                except Exception:  # noqa: BLE001 - hooks never block a drain
+                    pass
+            if self._plane is not None:
+                try:
+                    self._plane.announce_drain()
+                except Exception:  # noqa: BLE001 - fan-out never blocks a drain
+                    pass
+            t0 = _time.monotonic()
+            with self._drain_cv:
+                while self._active_gated > 0:
+                    left = timeout_s - (_time.monotonic() - t0)
+                    if left <= 0:
+                        break
+                    self._drain_cv.wait(min(left, 0.1))
+                remaining = self._active_gated
+            waited = _time.monotonic() - t0
+            record = {
+                "kind": "drain",
+                "ts": _time.time(),
+                "reason": reason,
+                "generation": self.generation,
+                "inflight_at_start": inflight0,
+                "inflight_remaining": remaining,
+                "waited_s": round(waited, 3),
+                "drained": remaining == 0,
+            }
+            # The final drain record: durable in the audit log and the
+            # structured request log — the forensic "this exit was
+            # intentional and here is what it waited for".
+            if self._audit is not None:
+                try:
+                    self._audit.append_raw(record)
+                except Exception:  # noqa: BLE001 - best-effort by contract
+                    pass
+            if self._request_log is not None:
+                try:
+                    self._request_log.record(**record)
+                except Exception:  # noqa: BLE001 - best-effort by contract
+                    pass
+            self._drain_result = record
+        if self.on_drained is not None:
+            try:
+                self.on_drained(record)
+            except Exception:  # noqa: BLE001 - observers never fail a drain
+                pass
+        return dict(record)
+
+    def _op_drain_server(self, msg: dict) -> dict:
+        """Graceful drain over the wire (auth-gated like every mutation).
+        ``timeout_s`` overrides the server's ``drain_timeout_s``; the
+        reply is the drain record, sent after in-flight work finished
+        (or the timeout lapsed)."""
+        timeout = msg.get("timeout_s")
+        if timeout is not None and (
+            isinstance(timeout, bool) or not isinstance(timeout, (int, float))
+        ):
+            raise ValueError(
+                f"timeout_s must be a number, got {timeout!r}"
+            )
+        reason = msg.get("reason")
+        if reason is not None and not isinstance(reason, str):
+            raise ValueError(f"reason must be a string, got {reason!r}")
+        return self.begin_drain(
+            timeout_s=timeout, reason=reason or "drain_server op"
+        )
+
     def _observe_timeline(self, snapshot, generation: int) -> None:
         """Record one published generation in the timeline.  Best-effort
         by the same rule as every observability hook: a failed watchlist
@@ -313,6 +517,15 @@ class CapacityServer:
         # the same publisher thread — itself best-effort and registry-
         # silent under KCCAP_TELEMETRY=0 or KCCAP_GROUPING=0.
         _snapshot_publish_group_metrics(snapshot)
+        # Plane fan-out rides the same publisher thread, BEFORE the
+        # timeline's O(N) watchlist evaluation: replicas should hear
+        # about a generation as early as possible (bounded staleness),
+        # and a failed fan-out must never fail the swap it observes.
+        if self._plane is not None:
+            try:
+                self._plane.publish(snapshot, generation)
+            except Exception:  # noqa: BLE001 - fan-out never fails a swap
+                pass
         if self._timeline is None:
             return
         try:
@@ -380,6 +593,12 @@ class CapacityServer:
         if getattr(self, "_serving", False):
             self._tcp.shutdown()
         self._tcp.server_close()
+        # Sever live connections too: a shut-down server must be DEAD
+        # to its connected clients (transport error → failover), not a
+        # ghost that keeps answering on pre-shutdown sockets.  The
+        # graceful path orders this after begin_drain's in-flight wait,
+        # so drained replies are long flushed.
+        self._tcp.close_all_connections()
 
     # -- dispatch ----------------------------------------------------------
     def _check_deadline(self, msg: dict, *, shed: bool = True):
@@ -412,9 +631,26 @@ class CapacityServer:
         {
             "ping", "info", "fit", "sweep", "sweep_multi", "place",
             "drain", "topology_spread", "plan", "explain", "dump",
-            "timeline", "slo", "reload", "update",
+            "timeline", "slo", "reload", "update", "drain_server",
         }
     )
+
+    # The ops admission control governs: everything that dispatches
+    # device/compute work.  Diagnostics (ping/info/dump/...) always pass
+    # — an overloaded replica must still answer health probes, or the
+    # failover that would RELIEVE the overload can never see it.
+    _ADMISSION_OPS = frozenset(
+        {
+            "fit", "sweep", "sweep_multi", "place", "drain",
+            "topology_spread", "plan", "explain",
+        }
+    )
+
+    # The ops a graceful drain refuses and waits out: compute work plus
+    # mutations.  ping/info/dump/timeline/slo stay answerable so load
+    # balancers and operators can watch the drain; drain_server itself
+    # must pass or a second drain request could never be acknowledged.
+    _DRAIN_GATED_OPS = _ADMISSION_OPS | {"update", "reload"}
 
     def dispatch(self, msg: dict) -> dict | str:
         """Instrumented entry: count/time every request (by op), record
@@ -451,7 +687,39 @@ class CapacityServer:
         t0 = _time.perf_counter()
         error: str | None = None
         result = None
+        release = None
+        gated = False
         try:
+            if op_label in self._DRAIN_GATED_OPS:
+                from kubernetesclustercapacity_tpu.resilience import (
+                    DrainingError,
+                )
+
+                with self._drain_cv:
+                    if self._draining:
+                        draining = True
+                    else:
+                        draining = False
+                        self._active_gated += 1
+                        gated = True
+                if draining:
+                    # Refused BEFORE any work: safe to retry elsewhere
+                    # (the wire code says so), mutations included.
+                    if self._admission is not None:
+                        self._admission.count_shed(op_label, "draining")
+                    raise DrainingError(
+                        "server is draining; retry another replica"
+                    )
+            if (
+                self._admission is not None
+                and op_label in self._ADMISSION_OPS
+            ):
+                # Admission gates BEFORE routing: a shed request never
+                # parses a grid, never waits for a compute slot, never
+                # touches the device.
+                release = self._admission.admit(
+                    op_label, self._check_deadline(msg, shed=False)
+                )
             result = self._dispatch_routed(msg)
             return result
         except Exception as e:
@@ -459,6 +727,12 @@ class CapacityServer:
             error = f"{type(e).__name__}: {e}"
             raise
         finally:
+            if release is not None:
+                release()
+            if gated:
+                with self._drain_cv:
+                    self._active_gated -= 1
+                    self._drain_cv.notify_all()
             _phases.restore(prev_clk)
             dur = _time.perf_counter() - t0
             self._m_inflight.dec()
@@ -473,6 +747,9 @@ class CapacityServer:
             gen = getattr(self._dispatch_tls, "generation", None)
             self._dispatch_tls.generation = None
             gen = self.generation if gen is None else gen
+            # Persisted (not cleared) for the reply envelope: the
+            # handler thread reads it right after dispatch returns.
+            self._dispatch_tls.last_generation = gen
             # One span ID correlates the trace-log span with the JSON
             # request-log line — minted only when something records it.
             span_id = None
@@ -579,6 +856,8 @@ class CapacityServer:
                 token.encode(), self._auth_token.encode()
             ):
                 raise PermissionError("missing or invalid auth token")
+        if op == "drain_server":
+            return self._op_drain_server(msg)
         if op in (
             "fit", "sweep", "sweep_multi", "place", "drain",
             "topology_spread", "plan", "explain",
@@ -692,7 +971,32 @@ class CapacityServer:
                 "healthy_nodes": int(np.sum(snap.healthy)),
                 "extended_resources": sorted(snap.extended),
                 "resilience": self._resilience_info(),
+                # The protocol feature handshake: what THIS server
+                # speaks, so new clients feature-gate plane-era ops
+                # instead of erroring on unknown ops against old
+                # servers (and old clients simply ignore the key).
+                "capabilities": {
+                    "protocol": 2,
+                    "plane": self._plane_role is not None,
+                    "admission": self._admission is not None,
+                    "drain": True,
+                },
+                "draining": self.draining,
             }
+            # Opt-in (``info {plane: true}``): the serving-plane section
+            # — leader fan-out stats or replica sync/staleness state.
+            # Opt-in for the pinned-default-shape reason the other
+            # sections are.
+            if msg.get("plane"):
+                if self._plane_stats_source is None:
+                    out["plane"] = None
+                else:
+                    try:
+                        out["plane"] = self._plane_stats_source()
+                    except Exception as e:  # noqa: BLE001 - info must not fail
+                        out["plane"] = {
+                            "error": f"{type(e).__name__}: {e}"
+                        }
             # Opt-in (``info {metrics: true}``): the registry snapshot
             # rides the info op so clients see the server's counters
             # without scraping the (possibly un-exposed) metrics port.
@@ -1581,6 +1885,7 @@ class CapacityServer:
         *,
         fixture_source=None,
         warm: bool = False,
+        generation: int | None = None,
     ) -> None:
         """Atomically swap the served snapshot (e.g. from a live follower).
 
@@ -1606,11 +1911,27 @@ class CapacityServer:
         never stalls a reader on a cold upload).  The retired snapshot's
         cache entries are invalidated either way, so swapped-out device
         buffers free promptly.
+
+        ``generation`` (plane replicas only) ADOPTS the given generation
+        number instead of incrementing the local counter, so a replica
+        stamps its responses with the LEADER's generation — the number
+        the client-side monotonicity watermark compares across
+        endpoints.  A regressing generation is refused: the plane
+        stream is ordered, so a lower number here means a confused
+        publisher, and serving it would let watermarked clients observe
+        time running backwards.
         """
         from kubernetesclustercapacity_tpu import devcache
 
         mask = _implicit_taint_mask(snapshot)
         with self._lock:
+            if generation is not None:
+                generation = int(generation)
+                if generation < self._generation:
+                    raise ValueError(
+                        f"generation must not regress: {generation} < "
+                        f"served {self._generation}"
+                    )
             old = self.snapshot
             self.snapshot = snapshot
             self.fixture = fixture
@@ -1618,8 +1939,11 @@ class CapacityServer:
             self._store = None  # stale after a wholesale replace
             self._fixture_dirty = False
             self._implicit_mask = mask
-            self._generation += 1
-            generation = self._generation
+            if generation is None:
+                self._generation += 1
+                generation = self._generation
+            else:
+                self._generation = generation
         if old is not snapshot:
             devcache.CACHE.invalidate(old)
         if warm:
@@ -1633,9 +1957,26 @@ class CapacityServer:
         self._observe_timeline(snapshot, generation)
         self._audit_generation(snapshot, generation)
 
+    def _require_leader(self) -> None:
+        """Mutations against a plane REPLICA are refused before any
+        work: the replica's state is the leader's stream, and a local
+        mutation would silently fork it (and be clobbered by the next
+        frame).  The ``not_leader`` wire code tells multi-endpoint
+        clients to re-route, not to fail."""
+        if self._plane_role == "replica":
+            from kubernetesclustercapacity_tpu.resilience import (
+                NotLeaderError,
+            )
+
+            raise NotLeaderError(
+                "this server is a plane replica (read-only view of the "
+                "leader's snapshot stream); send mutations to the leader"
+            )
+
     def _op_reload(self, msg: dict, snap: ClusterSnapshot) -> dict:
         """``snap`` is the dispatch's lock-captured snapshot — reading
         ``self.snapshot`` here could tear against a concurrent reload."""
+        self._require_leader()
         with self._lock:
             if self._fixture_source is not None:
                 # Same rule as update: the next coalesced publish would
@@ -1693,6 +2034,7 @@ class CapacityServer:
         """
         from kubernetesclustercapacity_tpu.store import ClusterStore
 
+        self._require_leader()
         events = msg.get("events")
         if not isinstance(events, list):
             raise ValueError("update needs an 'events' list")
@@ -1896,6 +2238,48 @@ def main(argv=None) -> int:
                         "burn-rate gauges fresh for scrapers that "
                         "never issue the slo op; the slo op and "
                         "/healthz also evaluate on read)")
+    p.add_argument("-plane-port", type=int, default=0, dest="plane_port",
+                   metavar="PORT",
+                   help="serve the replication plane on this port "
+                        "(LEADER mode): every published snapshot "
+                        "generation fans out to subscribed replica "
+                        "servers as digest-chained checkpoint/diff "
+                        "frames (0 = no plane)")
+    p.add_argument("-plane-leader", default=None, dest="plane_leader",
+                   metavar="HOST:PORT",
+                   help="follow another server's replication plane "
+                        "(REPLICA mode): stage each digest-verified "
+                        "generation from the leader's stream and serve "
+                        "it read-only, stamped with the leader's "
+                        "generation numbers")
+    p.add_argument("-plane-stale-after-s", type=float, default=10.0,
+                   dest="plane_stale_after_s", metavar="SECONDS",
+                   help="replica staleness bound: with no plane frame "
+                        "(heartbeats included) for this long, the "
+                        "replica reports itself stale via info/healthz "
+                        "so clients route around it")
+    p.add_argument("-admission-max-concurrent", type=int, default=0,
+                   dest="admission_max_concurrent", metavar="N",
+                   help="admission control: at most N compute requests "
+                        "admitted at once; excess queues briefly then "
+                        "sheds with the retryable-elsewhere "
+                        "'overloaded' error (0 = no concurrency gate)")
+    p.add_argument("-admission-rps", type=float, default=0.0,
+                   dest="admission_rps", metavar="RPS",
+                   help="admission control: token-bucket cap on "
+                        "admitted compute requests per second "
+                        "(0 = no rps cap)")
+    p.add_argument("-admission-burst", type=float, default=0.0,
+                   dest="admission_burst", metavar="N",
+                   help="token-bucket burst capacity for -admission-rps "
+                        "(0 = max(rps, 1))")
+    p.add_argument("-drain-timeout-s", type=float, default=10.0,
+                   dest="drain_timeout_s", metavar="SECONDS",
+                   help="graceful drain bound (SIGTERM/SIGINT or the "
+                        "drain_server op): stop accepting compute/"
+                        "mutation ops, wait up to this long for "
+                        "in-flight work, emit the final drain record, "
+                        "then exit")
     args = p.parse_args(argv)
 
     import os as _os
@@ -2051,6 +2435,43 @@ def main(argv=None) -> int:
             if follower is not None:
                 follower.stop()
             return 1
+    admission = None
+    if args.admission_max_concurrent > 0 or args.admission_rps > 0:
+        from kubernetesclustercapacity_tpu.service.plane import (
+            AdmissionController,
+        )
+
+        admission = AdmissionController(
+            max_concurrent=max(args.admission_max_concurrent, 0),
+            rps=max(args.admission_rps, 0.0),
+            burst=args.admission_burst if args.admission_burst > 0 else None,
+            registry=REGISTRY,
+        )
+    plane_pub = None
+    if args.plane_port:
+        if args.plane_leader:
+            print(
+                "ERROR : -plane-port (leader) and -plane-leader "
+                "(replica) are mutually exclusive",
+                file=sys.stderr,
+            )
+            if follower is not None:
+                follower.stop()
+            return 1
+        from kubernetesclustercapacity_tpu.service.plane import (
+            PlanePublisher,
+        )
+
+        try:
+            plane_pub = PlanePublisher(
+                host=args.host, port=args.plane_port,
+                token=auth_token, registry=REGISTRY,
+            )
+        except OSError as e:
+            print(f"ERROR : cannot bind plane port: {e}", file=sys.stderr)
+            if follower is not None:
+                follower.stop()
+            return 1
     server = CapacityServer(
         snap, host=args.host, port=args.port, fixture=fixture,
         auth_token=auth_token, max_inflight=args.max_inflight,
@@ -2069,7 +2490,40 @@ def main(argv=None) -> int:
         audit_log=audit_log,
         shadow=shadow,
         slo=slo_monitor,
+        admission=admission,
+        plane=plane_pub,
+        drain_timeout_s=max(args.drain_timeout_s, 0.0),
     )
+    subscriber = None
+    if args.plane_leader:
+        if args.follow:
+            print(
+                "ERROR : a plane replica (-plane-leader) cannot also "
+                "-follow a cluster (its state IS the leader's stream)",
+                file=sys.stderr,
+            )
+            server.shutdown()
+            return 1
+        from kubernetesclustercapacity_tpu.service.plane import (
+            PlaneSubscriber,
+        )
+
+        host_s, _, port_s = args.plane_leader.rpartition(":")
+        if not host_s or not port_s.isdigit():
+            print(
+                f"ERROR : bad -plane-leader {args.plane_leader!r} "
+                "(want HOST:PORT)",
+                file=sys.stderr,
+            )
+            server.shutdown()
+            return 1
+        subscriber = PlaneSubscriber(
+            (host_s, int(port_s)),
+            server,
+            token=auth_token,
+            stale_after_s=max(args.plane_stale_after_s, 0.1),
+            registry=REGISTRY,
+        )
     metrics_server = None
     coalescer_ref: list = []  # filled below; healthz closes over it
     if args.metrics_port:
@@ -2108,19 +2562,31 @@ def main(argv=None) -> int:
                 # probe never reports a stale verdict.
                 slo_monitor.evaluate()
                 out["slo"] = slo_monitor.stats()
+            if plane_pub is not None:
+                out["plane"] = plane_pub.stats()
+            elif subscriber is not None:
+                out["plane"] = subscriber.stats()
+            if server.draining:
+                out["draining"] = True
             return out
 
         def _overall_healthy() -> bool:
             # /healthz goes 503 the moment the feed is known-dead OR
             # the shadow oracle caught the kernels lying OR an SLO is
-            # fast-burning: a frozen snapshot, a wrong answer, and a
-            # service missing its latency objective are all things a
+            # fast-burning OR the plane replica went stale OR a drain
+            # began: a frozen snapshot, a wrong answer, a service
+            # missing its latency objective, a bounded-staleness
+            # violation, and a deliberate departure are all things a
             # load balancer must route around, not discover later.
             if follower is not None and follower.fatal is not None:
                 return False
             if shadow is not None and shadow.diverged:
                 return False
             if slo_monitor is not None and slo_monitor.fast_burning:
+                return False
+            if subscriber is not None and subscriber.stale:
+                return False
+            if server.draining:
                 return False
             return True
 
@@ -2129,15 +2595,7 @@ def main(argv=None) -> int:
                 REGISTRY,
                 host=args.host,
                 port=args.metrics_port,
-                healthy=(
-                    _overall_healthy
-                    if (
-                        follower is not None
-                        or shadow is not None
-                        or slo_monitor is not None
-                    )
-                    else None
-                ),
+                healthy=_overall_healthy,
                 status=_healthz_status,
             )
         except OSError as e:
@@ -2190,6 +2648,46 @@ def main(argv=None) -> int:
         coalescer_ref.append(coalescer)
         follower.on_event = coalescer.notify
         follower.start_watches()  # after wiring: no event can be missed
+    # Graceful shutdown: SIGTERM/SIGINT and the drain_server op all
+    # route through begin_drain — stop accepting compute/mutation ops,
+    # finish in-flight work, emit the drain record, then stop the serve
+    # loop.  The stop runs on its own thread after a short grace so the
+    # drain op's reply (and any in-flight replies) flush first.
+    import signal as _signal
+    import threading as _threading
+    import time as _time
+
+    def _stop_serving(record: dict) -> None:
+        def _stop() -> None:
+            _time.sleep(0.25)  # let replies flush before teardown
+            if follower is not None:
+                follower.stop()
+            server.shutdown()
+
+        print(
+            f"drain complete: inflight_at_start="
+            f"{record.get('inflight_at_start')} "
+            f"drained={record.get('drained')} "
+            f"waited_s={record.get('waited_s')}",
+            file=sys.stderr,
+        )
+        _threading.Thread(target=_stop, daemon=True).start()
+
+    server.on_drained = _stop_serving
+
+    def _graceful_exit(signum, frame) -> None:
+        print(f"draining on signal {signum} ...", file=sys.stderr)
+        _threading.Thread(
+            target=server.begin_drain,
+            kwargs={"reason": f"signal {signum}"},
+            daemon=True,
+        ).start()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _graceful_exit)
+        _signal.signal(_signal.SIGINT, _graceful_exit)
+    except ValueError:
+        pass  # not the main thread (embedded/test use): signals stay default
     print(
         f"serving {snap.n_nodes} nodes ({snap.semantics}) on "
         f"{server.address[0]}:{server.address[1]}",
@@ -2221,6 +2719,10 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if subscriber is not None:
+            subscriber.stop()
+        if plane_pub is not None:
+            plane_pub.close()
         if follower is not None:
             follower.stop()
         if coalescer is not None:

@@ -7,6 +7,10 @@ decomposition substrate: every answering request is split into a FIXED
 phase vocabulary (:data:`PHASES`), each phase a named sub-interval of
 the dispatch:
 
+``admission``
+    waiting in the admission controller's bounded concurrency queue
+    (``service/plane.py``; a request shed at admission records nothing —
+    it never became work);
 ``queue_wait``
     waiting for a compute-inflight slot (``CapacityServer``'s semaphore);
 ``batch_wait``
@@ -69,6 +73,7 @@ __all__ = [
 #: pinned by ``tests/test_metric_names.py``'s conformance walk, so the
 #: ``kccap_phase_seconds{phase=...}`` label set cannot grow by typo.
 PHASES = (
+    "admission",
     "queue_wait",
     "batch_wait",
     "devcache",

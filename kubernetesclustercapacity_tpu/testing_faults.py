@@ -32,6 +32,16 @@ Fault vocabulary (``FAULTS``):
 ``stall``
     Sleep ``stall_s`` before forwarding — long enough for the client's
     read timeout or deadline to fire first.
+
+Stream mode (``FaultProxy(..., stream=True)``) adapts the proxy to
+one-request-many-replies protocols — the serving plane's pub-sub stream
+(:mod:`.service.plane`), where a subscriber sends one hello frame and
+then receives an unbounded frame stream.  The client's first frame is
+always forwarded intact; the plan then consumes one decision per
+SERVER frame, in arrival order: ``drop_pre`` silently swallows the
+frame (the subscriber sees a gap — its digest chain breaks and it must
+resync), ``garbage``/``partial`` corrupt it, ``stall`` delays it, and
+``drop_post`` cuts the connection after delivering it.
 """
 
 from __future__ import annotations
@@ -157,10 +167,12 @@ class FaultProxy:
         *,
         host: str = "127.0.0.1",
         stall_s: float = 1.0,
+        stream: bool = False,
     ) -> None:
         self._upstream = upstream
         self.plan = plan
         self._stall_s = float(stall_s)
+        self._stream = bool(stream)
         self._stop = threading.Event()
         self._listener = socket.create_server((host, 0))
         self._listener.settimeout(0.2)
@@ -227,11 +239,23 @@ class FaultProxy:
         with self._conns_lock:
             self._conns.discard(sock)
         try:
+            # shutdown BEFORE close: another proxy thread may be blocked
+            # in recv on this socket, and CPython defers the real fd
+            # close until that recv returns — without the shutdown no
+            # FIN ever reaches the peer and a half-delivered fault
+            # becomes an accidental stall instead of a cut link.
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             sock.close()
         except OSError:
             pass
 
     def _handle(self, client: socket.socket) -> None:
+        if self._stream:
+            self._handle_stream(client)
+            return
         self._track(client)
         up: socket.socket | None = None
         try:
@@ -250,7 +274,10 @@ class FaultProxy:
                     # Fall through: forward late (the client has usually
                     # timed out and gone; send errors are swallowed).
                 if up is None:
-                    up = socket.create_connection(self._upstream)
+                    try:
+                        up = socket.create_connection(self._upstream)
+                    except OSError:
+                        return  # upstream dead (killed server): drop client
                     self._track(up)
                 try:
                     up.sendall(frame)
@@ -288,6 +315,86 @@ class FaultProxy:
                     # Stalled but the client was still there: it got a
                     # late (correct) reply; nothing more to do.
                     continue
+        finally:
+            self._untrack(client)
+            if up is not None:
+                self._untrack(up)
+
+    def _handle_stream(self, client: socket.socket) -> None:
+        """Stream mode: forward the client's hello intact, then pump
+        SERVER frames client-ward with one plan decision each.  Client→
+        server frames after the hello (there are none in the plane
+        protocol, but EOF matters) are pumped transparently on a side
+        thread so a vanished subscriber is noticed upstream."""
+        self._track(client)
+        up: socket.socket | None = None
+        try:
+            hello = _read_frame(client)
+            if hello is None:
+                return
+            up = socket.create_connection(self._upstream)
+            self._track(up)
+            up.sendall(hello)
+            self.plan.count_forwarded()
+
+            upstream = up  # for the closure below
+
+            def _pump_client_to_up() -> None:
+                while not self._stop.is_set():
+                    frame = _read_frame(client)
+                    if frame is None:
+                        # Subscriber went away: propagate the EOF so the
+                        # publisher deregisters it.
+                        try:
+                            upstream.shutdown(socket.SHUT_WR)
+                        except OSError:
+                            pass
+                        return
+                    try:
+                        upstream.sendall(frame)
+                    except OSError:
+                        return
+
+            side = threading.Thread(target=_pump_client_to_up, daemon=True)
+            side.start()
+            while not self._stop.is_set():
+                frame = _read_frame(up)
+                if frame is None:
+                    return  # upstream closed; drop the client too
+                fault = self.plan.next_fault()
+                if fault == "drop_pre":
+                    self.plan.count(fault)
+                    continue  # swallow this frame: the stream gaps
+                if fault == "stall":
+                    self.plan.count(fault)
+                    self._stop.wait(self._stall_s)
+                if fault == "garbage":
+                    self.plan.count(fault)
+                    try:
+                        client.sendall(
+                            struct.pack(">I", len(_GARBAGE_BODY))
+                            + _GARBAGE_BODY
+                        )
+                    except OSError:
+                        return
+                    continue
+                if fault == "partial":
+                    self.plan.count(fault)
+                    try:
+                        client.sendall(frame[: max(5, len(frame) // 2)])
+                    except OSError:
+                        pass
+                    return  # a torn frame desyncs the stream: cut it
+                try:
+                    client.sendall(frame)
+                except OSError:
+                    return
+                self.plan.count_forwarded()
+                if fault == "drop_post":
+                    self.plan.count(fault)
+                    return  # delivered, then cut
+        except OSError:
+            return
         finally:
             self._untrack(client)
             if up is not None:

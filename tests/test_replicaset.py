@@ -1,0 +1,736 @@
+"""ReplicaSet client + chaos suite for the replicated serving plane.
+
+The acceptance bar (ISSUE 10): under replica kill, plane-stream stall,
+and garbled-link faults, every client-observed answer is bit-identical
+to the sequential oracle at its STAMPED generation (both semantics
+modes), and the generation watermark never regresses within a client
+session — asserted on every response, across the whole suite.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+from kubernetesclustercapacity_tpu.resilience import (
+    CircuitBreaker,
+    OverloadedError,
+    RetryPolicy,
+)
+from kubernetesclustercapacity_tpu.service.client import CapacityClient
+from kubernetesclustercapacity_tpu.service.plane import (
+    AdmissionController,
+    PlanePublisher,
+    PlaneSubscriber,
+)
+from kubernetesclustercapacity_tpu.service.replicaset import (
+    ReplicaSet,
+    ReplicaSetError,
+    StaleReadError,
+    parse_endpoints,
+)
+from kubernetesclustercapacity_tpu.service.server import CapacityServer
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+from kubernetesclustercapacity_tpu.testing_faults import FaultPlan, FaultProxy
+
+
+def _wait_for(predicate, timeout_s=10.0, interval_s=0.01, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _base_snapshot(semantics, n=24, seed=0):
+    snap = synthetic_snapshot(n, seed=seed)
+    healthy = snap.healthy.copy()
+    if semantics == "strict":
+        healthy[::5] = False  # exercise the health mask in strict mode
+    return dataclasses.replace(snap, semantics=semantics, healthy=healthy)
+
+
+def _next_generation(snap, seed):
+    """Deterministic churn: usage moves, one node's pod count moves, and
+    (in strict mode) one health flip — all diff-visible fields."""
+    rng = np.random.default_rng(seed)
+    used_cpu = snap.used_cpu_req_milli + rng.integers(
+        0, 300, size=snap.n_nodes, dtype=np.int64
+    )
+    used_mem = snap.used_mem_req_bytes + (
+        rng.integers(0, 64, size=snap.n_nodes, dtype=np.int64) * 1024
+    )
+    pods = snap.pods_count.copy()
+    pods[int(rng.integers(0, snap.n_nodes))] += 1
+    healthy = snap.healthy.copy()
+    if snap.semantics == "strict":
+        flip = int(rng.integers(0, snap.n_nodes))
+        healthy[flip] = ~healthy[flip]
+    return dataclasses.replace(
+        snap,
+        used_cpu_req_milli=used_cpu,
+        used_mem_req_bytes=used_mem,
+        pods_count=pods,
+        healthy=healthy,
+    )
+
+
+def _oracle_totals(snap, cpu, mem, replicas):
+    """The sequential python oracle: totals/schedulable per scenario,
+    exactly as the fit kernels must answer."""
+    totals, sched = [], []
+    for c, m, r in zip(cpu, mem, replicas):
+        fits = fit_arrays_python(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.used_cpu_req_milli,
+            snap.used_mem_req_bytes,
+            snap.pods_count,
+            int(c),
+            int(m),
+            mode=snap.semantics,
+            healthy=snap.healthy,
+        )
+        total = int(sum(fits))
+        totals.append(total)
+        sched.append(total >= int(r))
+    return totals, sched
+
+
+# ---------------------------------------------------------------------------
+# Unit behavior
+# ---------------------------------------------------------------------------
+class TestParseEndpoints:
+    def test_grammar(self):
+        assert parse_endpoints("a:1,b:2") == [("a", 1), ("b", 2)]
+        assert parse_endpoints([("h", 9), "x:3"]) == [("h", 9), ("x", 3)]
+        with pytest.raises(ValueError):
+            parse_endpoints("")
+        with pytest.raises(ValueError):
+            parse_endpoints("nocolon")
+
+
+class TestFailover:
+    def test_failover_past_dead_endpoint(self):
+        snap = _base_snapshot("reference")
+        srv = CapacityServer(snap, port=0)
+        srv.start()
+        try:
+            rs = ReplicaSet(
+                [("127.0.0.1", 1), srv.address],  # first endpoint: dead port
+                connect_timeout_s=0.5,
+            )
+            try:
+                assert rs.ping() == "pong"
+                # Sticky preference moved to the live endpoint.
+                assert rs.ping() == "pong"
+                assert rs.stats()["endpoints"][0]["breaker"] in (
+                    "open", "half_open", "closed",
+                )
+            finally:
+                rs.close()
+        finally:
+            srv.shutdown()
+
+    def test_all_dead_raises_replicaset_error(self):
+        rs = ReplicaSet(
+            [("127.0.0.1", 1), ("127.0.0.1", 2)],
+            connect_timeout_s=0.2, rounds=1,
+        )
+        try:
+            with pytest.raises(ReplicaSetError):
+                rs.ping()
+        finally:
+            rs.close()
+
+    def test_overloaded_fails_over_to_sibling(self):
+        """An admission-shed (rps bucket empty) is retryable-elsewhere:
+        the call lands on the sibling, not on the caller's lap."""
+        snap = _base_snapshot("reference")
+        capped = CapacityServer(
+            snap, port=0,
+            admission=AdmissionController(rps=0.0001, burst=1.0),
+        )
+        open_srv = CapacityServer(snap, port=0)
+        capped.start()
+        open_srv.start()
+        try:
+            rs = ReplicaSet([capped.address, open_srv.address])
+            try:
+                # Drain the capped endpoint's single burst token.
+                ok1 = rs.sweep(
+                    cpu_request_milli=[100], mem_request_bytes=[10 ** 8],
+                    replicas=[1],
+                )
+                ok2 = rs.sweep(
+                    cpu_request_milli=[100], mem_request_bytes=[10 ** 8],
+                    replicas=[1],
+                )
+                assert ok1["totals"] == ok2["totals"]
+                failovers = rs.registry.counter(
+                    "kccap_replicaset_failovers_total", "", ("cause",)
+                )
+                assert failovers.labels(cause="overloaded").value >= 1
+            finally:
+                rs.close()
+        finally:
+            capped.shutdown()
+            open_srv.shutdown()
+
+    def test_single_endpoint_surfaces_overloaded(self):
+        """A single-endpoint client has no 'elsewhere': the typed
+        refusal surfaces unchanged (and is NOT auto-retried as a
+        transport error)."""
+        snap = _base_snapshot("reference")
+        srv = CapacityServer(
+            snap, port=0,
+            admission=AdmissionController(rps=0.0001, burst=1.0),
+        )
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                c.sweep(cpu_request_milli=[100],
+                        mem_request_bytes=[10 ** 8], replicas=[1])
+                with pytest.raises(OverloadedError):
+                    c.sweep(cpu_request_milli=[100],
+                            mem_request_bytes=[10 ** 8], replicas=[1])
+        finally:
+            srv.shutdown()
+
+    def test_mutation_transport_failure_is_at_most_once(self):
+        """A mutation whose transport dies MID-CALL must not be resent
+        to a sibling (it may have executed)."""
+        snap = _base_snapshot("reference")
+        srv = CapacityServer(snap, port=0)
+        srv.start()
+        sibling = CapacityServer(snap, port=0)
+        sibling.start()
+        plan = FaultPlan(["drop_post"])  # executed, reply withheld
+        proxy = FaultProxy(srv.address, plan).start()
+        try:
+            rs = ReplicaSet([proxy.address, sibling.address])
+            try:
+                with pytest.raises(Exception) as exc:
+                    rs.update([])
+                assert not isinstance(exc.value, ReplicaSetError)
+                # The sibling never saw the mutation.
+                assert plan.forwarded == 1
+            finally:
+                rs.close()
+        finally:
+            proxy.stop()
+            srv.shutdown()
+            sibling.shutdown()
+
+
+class TestMonotonicity:
+    def test_stale_answer_discarded_never_returned(self):
+        """Endpoints at different generations: once the session has seen
+        generation G, an endpoint still serving G-1 is rejected (stale),
+        and with no fresh endpoint left the call raises StaleReadError
+        rather than regress."""
+        snap = _base_snapshot("reference")
+        fresh = CapacityServer(snap, port=0)
+        frozen = CapacityServer(snap, port=0)
+        fresh.start()
+        frozen.start()
+        # fresh advances to generation 3; frozen stays at 1.
+        g = snap
+        for i in range(2):
+            g = _next_generation(g, i)
+            fresh.replace_snapshot(g)
+        assert fresh.generation == 3 and frozen.generation == 1
+        try:
+            rs = ReplicaSet([fresh.address, frozen.address], rounds=1)
+            try:
+                rs.ping()
+                assert rs.watermark == 3  # answered by fresh
+                fresh.shutdown()  # only the stale endpoint remains
+                with pytest.raises(StaleReadError):
+                    rs.ping()
+                stale = rs.registry.counter(
+                    "kccap_replicaset_stale_rejected_total", ""
+                )
+                assert stale.value >= 1
+            finally:
+                rs.close()
+        finally:
+            frozen.shutdown()
+
+    def test_watermark_monotone_across_failover(self):
+        snap = _base_snapshot("reference")
+        a = CapacityServer(snap, port=0)
+        b = CapacityServer(snap, port=0)
+        a.start()
+        b.start()
+        g2 = _next_generation(snap, 1)
+        a.replace_snapshot(g2)
+        b.replace_snapshot(g2)
+        try:
+            rs = ReplicaSet([a.address, b.address])
+            try:
+                seen = []
+                for _ in range(6):
+                    rs.ping()
+                    seen.append(rs.watermark)
+                a.shutdown()
+                for _ in range(6):
+                    rs.ping()
+                    seen.append(rs.watermark)
+                assert seen == sorted(seen)  # never regresses
+            finally:
+                rs.close()
+        finally:
+            b.shutdown()
+
+
+class TestHedging:
+    def test_hedge_wins_past_stalled_primary(self):
+        """Primary stalled past its deadline by the proxy: the hedged
+        attempt on the sibling answers inside the budget."""
+        snap = _base_snapshot("reference")
+        slow = CapacityServer(snap, port=0)
+        fast = CapacityServer(snap, port=0)
+        slow.start()
+        fast.start()
+        plan = FaultPlan(["stall"] * 50)
+        proxy = FaultProxy(slow.address, plan, stall_s=3.0).start()
+        try:
+            rs = ReplicaSet(
+                [proxy.address, fast.address],
+                hedge=True,
+                hedge_max_delay_s=0.1,
+                timeout_s=5.0,
+            )
+            try:
+                t0 = time.monotonic()
+                r = rs.sweep(
+                    cpu_request_milli=[100], mem_request_bytes=[10 ** 8],
+                    replicas=[1], deadline_s=4.0,
+                )
+                elapsed = time.monotonic() - t0
+                want, _ = _oracle_totals(snap, [100], [10 ** 8], [1])
+                assert r["totals"] == want
+                assert elapsed < 2.5  # did not ride out the 3 s stall
+                hedges = rs.registry.counter(
+                    "kccap_replicaset_hedges_total", ""
+                )
+                wins = rs.registry.counter(
+                    "kccap_replicaset_hedge_wins_total", ""
+                )
+                assert hedges.value >= 1
+                assert wins.value >= 1
+            finally:
+                rs.close()
+        finally:
+            proxy.stop()
+            slow.shutdown()
+            fast.shutdown()
+
+    def test_mutations_never_hedged(self):
+        snap = _base_snapshot("reference")
+        a = CapacityServer(snap, port=0)
+        b = CapacityServer(snap, port=0)
+        a.start()
+        b.start()
+        try:
+            rs = ReplicaSet(
+                [a.address, b.address], hedge=True, hedge_max_delay_s=0.001
+            )
+            try:
+                with pytest.raises(Exception):
+                    rs.update([])  # .npz-less server refuses; that's fine
+                hedges = rs.registry.counter(
+                    "kccap_replicaset_hedges_total", ""
+                )
+                assert hedges.value == 0  # the mutation never hedged
+            finally:
+                rs.close()
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The chaos suite
+# ---------------------------------------------------------------------------
+class _Plane:
+    """One leader + two replicas, every link through a seeded fault
+    proxy: the chaos harness."""
+
+    def __init__(self, semantics, *, seed=0, n_nodes=24):
+        self.snapshots = {}  # generation -> snapshot (the oracle's view)
+        self.base = _base_snapshot(semantics, n=n_nodes, seed=seed)
+        self.pub = PlanePublisher(heartbeat_s=0.2)
+        self.leader = CapacityServer(
+            self.base, port=0, plane=self.pub, batch_window_ms=0.0
+        )
+        self.leader.start()
+        self.snapshots[self.leader.generation] = self.base
+        self.replicas = []
+        self.subs = []
+        self.plane_proxies = []
+        self.req_proxies = []
+        for i in range(2):
+            replica = CapacityServer(self.base, port=0, batch_window_ms=0.0)
+            replica.start()
+            # Garble the plane link deterministically (one plan per
+            # replica, different phases).
+            plan = FaultPlan.seeded(
+                seed * 101 + i, 64, fault_rate=0.25,
+                faults=("garbage", "drop_pre", "stall"),
+            )
+            pproxy = FaultProxy(
+                self.pub.address, plan, stream=True, stall_s=0.1
+            ).start()
+            sub = PlaneSubscriber(
+                pproxy.address, replica,
+                stale_after_s=30.0, seed=i,
+                reconnect_base_s=0.01, reconnect_max_s=0.05,
+            )
+            # And fault the request link too.
+            rplan = FaultPlan.seeded(
+                seed * 211 + i, 48, fault_rate=0.2,
+                faults=("drop_pre", "partial", "garbage"),
+            )
+            rproxy = FaultProxy(replica.address, rplan).start()
+            self.replicas.append(replica)
+            self.subs.append(sub)
+            self.plane_proxies.append(pproxy)
+            self.req_proxies.append(rproxy)
+
+    def publish(self, seed):
+        snap = _next_generation(
+            self.snapshots[self.leader.generation], seed
+        )
+        self.leader.replace_snapshot(snap)
+        self.snapshots[self.leader.generation] = snap
+        return self.leader.generation
+
+    def wait_converged(self, generation, timeout_s=15.0):
+        _wait_for(
+            lambda: all(
+                s.applied_generation >= generation for s in self.subs
+            ),
+            timeout_s=timeout_s,
+            what=f"replicas at generation {generation}",
+        )
+
+    def endpoints(self):
+        return [p.address for p in self.req_proxies]
+
+    def close(self):
+        for sub in self.subs:
+            sub.stop()
+        for p in self.plane_proxies + self.req_proxies:
+            p.stop()
+        for r in self.replicas:
+            r.shutdown()
+        self.pub.close()
+        self.leader.shutdown()
+
+
+SCENARIOS = dict(
+    cpu=[100, 250, 900], mem=[10 ** 8, 3 * 10 ** 8, 10 ** 9],
+    replicas=[1, 4, 16],
+)
+
+
+def _assert_answer_correct(plane, rs, result):
+    """THE invariant: the answer must be bit-identical to the sequential
+    oracle at its stamped generation — asserted for every response."""
+    gen = rs.last_generation
+    assert gen in plane.snapshots, f"unstamped/unknown generation {gen}"
+    want_totals, want_sched = _oracle_totals(
+        plane.snapshots[gen], SCENARIOS["cpu"], SCENARIOS["mem"],
+        SCENARIOS["replicas"],
+    )
+    assert result["totals"] == want_totals
+    assert result["schedulable"] == want_sched
+
+
+@pytest.mark.parametrize("semantics", ["reference", "strict"])
+class TestChaos:
+    def _client(self, plane, **kw):
+        kw.setdefault("connect_timeout_s", 1.0)
+        kw.setdefault("timeout_s", 5.0)
+        kw.setdefault("deadline_s", 8.0)
+        kw.setdefault("rounds", 4)
+        kw.setdefault(
+            "retry_backoff",
+            RetryPolicy(max_attempts=1, base_delay_s=0.01,
+                        max_delay_s=0.05, seed=0),
+        )
+        kw.setdefault(
+            "breaker_factory",
+            lambda addr: CircuitBreaker(
+                failure_threshold=3, recovery_timeout_s=0.1,
+                name=f"{addr[0]}:{addr[1]}",
+            ),
+        )
+        return ReplicaSet(plane.endpoints(), **kw)
+
+    def test_zero_wrong_answers_under_garbled_links(self, semantics):
+        """Faulted plane links AND faulted request links, generations
+        churning between calls: every answer bit-exact at its stamped
+        generation, watermark monotone throughout."""
+        plane = _Plane(semantics, seed=3)
+        rs = self._client(plane)
+        try:
+            watermarks = []
+            for step in range(10):
+                if step % 2 == 0 and step > 0:
+                    gen = plane.publish(seed=1000 + step)
+                    plane.wait_converged(gen)
+                r = rs.sweep(
+                    cpu_request_milli=SCENARIOS["cpu"],
+                    mem_request_bytes=SCENARIOS["mem"],
+                    replicas=SCENARIOS["replicas"],
+                )
+                _assert_answer_correct(plane, rs, r)
+                watermarks.append(rs.watermark)
+            assert watermarks == sorted(watermarks)
+            # The chaos was real: at least one fault fired per link kind.
+            assert any(
+                sum(p.plan.injected.values()) > 0
+                for p in plane.plane_proxies
+            )
+            assert any(
+                sum(p.plan.injected.values()) > 0
+                for p in plane.req_proxies
+            )
+        finally:
+            rs.close()
+            plane.close()
+
+    def test_replica_kill_mid_sweep(self, semantics):
+        """A replica dies while sweeps are in flight from 4 threads:
+        every completed answer is still oracle-exact at its stamped
+        generation; no stamped generation regresses per thread."""
+        plane = _Plane(semantics, seed=5)
+        rs = self._client(plane)
+        errors = []
+        answers = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    r = rs.sweep(
+                        cpu_request_milli=SCENARIOS["cpu"],
+                        mem_request_bytes=SCENARIOS["mem"],
+                        replicas=SCENARIOS["replicas"],
+                    )
+                    with lock:
+                        answers.append((rs.last_generation, r))
+                except Exception as e:  # noqa: BLE001 - tallied below
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+
+        try:
+            gen = plane.publish(seed=77)
+            plane.wait_converged(gen)
+            threads = [threading.Thread(target=worker) for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            # The kill: replica 0 vanishes mid-run (its request proxy
+            # keeps refusing connects afterwards).
+            plane.subs[0].stop()
+            plane.replicas[0].shutdown()
+            time.sleep(0.6)
+            stop.set()
+            for t in threads:
+                t.join(10.0)
+            assert answers, "no sweep completed at all"
+            # ZERO wrong answers: every completed response bit-exact at
+            # its stamped generation.
+            for gen_stamp, r in answers:
+                want_totals, want_sched = _oracle_totals(
+                    plane.snapshots[gen_stamp], SCENARIOS["cpu"],
+                    SCENARIOS["mem"], SCENARIOS["replicas"],
+                )
+                assert r["totals"] == want_totals
+                assert r["schedulable"] == want_sched
+            # A few calls may fail while the breaker learns — but the
+            # set must keep answering overall (the surviving replica).
+            assert len(errors) < len(answers)
+        finally:
+            stop.set()
+            rs.close()
+            plane.close()
+
+    def test_plane_stall_bounded_staleness(self, semantics):
+        """One replica's plane stream stalls: it freezes at an old
+        generation and — past ``stale_after_s`` on its (injected) clock
+        — reports itself stale.  A probing client demotes it, observes
+        the new generation from the healthy replica, and from then on
+        the frozen replica's old answers are REJECTED by the watermark:
+        the session never travels back in time.  Deterministic — the
+        staleness bound runs on a fake clock, not real sleeps."""
+        base = _base_snapshot(semantics, n=24, seed=9)
+        snapshots = {}
+        pub = PlanePublisher(heartbeat_s=3600.0)  # silence = the stall
+        leader = CapacityServer(base, port=0, plane=pub, batch_window_ms=0.0)
+        leader.start()
+        snapshots[leader.generation] = base
+        clocks = [[0.0], [0.0]]  # one injectable clock per replica
+        replicas, subs = [], []
+        for i in range(2):
+            r = CapacityServer(base, port=0, batch_window_ms=0.0)
+            r.start()
+            subs.append(
+                PlaneSubscriber(
+                    pub.address, r, stale_after_s=5.0, seed=i,
+                    clock=lambda i=i: clocks[i][0],
+                )
+            )
+            replicas.append(r)
+        rs = ReplicaSet([r.address for r in replicas], rounds=2)
+        try:
+            _wait_for(
+                lambda: all(s.applied_generation >= 1 for s in subs),
+                what="initial checkpoints",
+            )
+            r0 = rs.sweep(
+                cpu_request_milli=SCENARIOS["cpu"],
+                mem_request_bytes=SCENARIOS["mem"],
+                replicas=SCENARIOS["replicas"],
+            )
+            gen_stamp = rs.last_generation
+            want, _ = _oracle_totals(
+                snapshots[gen_stamp], SCENARIOS["cpu"], SCENARIOS["mem"],
+                SCENARIOS["replicas"],
+            )
+            assert r0["totals"] == want
+            # THE STALL: sever replica 0's plane link; publish a new
+            # generation only replica 1 receives.
+            subs[0].stop()
+            frozen_at = subs[0].applied_generation
+            snap2 = _next_generation(base, 12)
+            leader.replace_snapshot(snap2)
+            gen2 = leader.generation
+            snapshots[gen2] = snap2
+            _wait_for(
+                lambda: subs[1].applied_generation >= gen2,
+                what="healthy replica converges",
+            )
+            assert subs[0].applied_generation == frozen_at < gen2
+            # Bounded staleness detection: past stale_after_s of silence
+            # the frozen replica SAYS SO (no real sleep — fake clock).
+            clocks[0][0] += 5.1
+            assert subs[0].stale and not subs[1].stale
+            probe = {e["endpoint"]: e for e in rs.probe()}
+            assert rs.stats()["endpoints"][0]["stale"] is True
+            assert probe  # probe reached the endpoints
+            # The demoted rotation now answers from the healthy replica:
+            # the session observes gen2...
+            r1 = rs.sweep(
+                cpu_request_milli=SCENARIOS["cpu"],
+                mem_request_bytes=SCENARIOS["mem"],
+                replicas=SCENARIOS["replicas"],
+            )
+            assert rs.last_generation == gen2
+            want2, _ = _oracle_totals(
+                snap2, SCENARIOS["cpu"], SCENARIOS["mem"],
+                SCENARIOS["replicas"],
+            )
+            assert r1["totals"] == want2
+            # ...and can never regress below it: every further answer is
+            # gen2-stamped (the frozen replica's gen-1 answers are
+            # watermark-rejected whenever routing lands on it).
+            for _ in range(6):
+                r = rs.sweep(
+                    cpu_request_milli=SCENARIOS["cpu"],
+                    mem_request_bytes=SCENARIOS["mem"],
+                    replicas=SCENARIOS["replicas"],
+                )
+                assert rs.last_generation == gen2
+                assert r["totals"] == want2
+            assert rs.watermark == gen2
+        finally:
+            rs.close()
+            for s in subs:
+                s.stop()
+            for r in replicas:
+                r.shutdown()
+            pub.close()
+            leader.shutdown()
+
+
+@pytest.mark.slow
+class TestSustainedLoad:
+    def test_fixed_rps_with_replica_kill_recovers(self):
+        """Open-loop fixed-rps smoke (the bench row's little sibling):
+        mid-run replica kill; the set keeps answering, every answer
+        oracle-exact, and the post-kill error rate returns to zero
+        (recovery, not collapse)."""
+        plane = _Plane("reference", seed=21)
+        rs = ReplicaSet(
+            plane.endpoints(),
+            connect_timeout_s=1.0, timeout_s=5.0, deadline_s=5.0,
+            rounds=4,
+        )
+        rps, duration_s = 40.0, 3.0
+        outcomes = []  # (t_offset, ok, gen, result|err)
+        lock = threading.Lock()
+
+        def issue(t_offset):
+            try:
+                r = rs.sweep(
+                    cpu_request_milli=SCENARIOS["cpu"],
+                    mem_request_bytes=SCENARIOS["mem"],
+                    replicas=SCENARIOS["replicas"],
+                )
+                with lock:
+                    outcomes.append((t_offset, True, rs.last_generation, r))
+            except Exception as e:  # noqa: BLE001 - tallied below
+                with lock:
+                    outcomes.append((t_offset, False, None, str(e)))
+
+        try:
+            gen = plane.publish(seed=31)
+            plane.wait_converged(gen)
+            t0 = time.monotonic()
+            killed = False
+            i = 0
+            while True:
+                t_offset = i / rps
+                if t_offset > duration_s:
+                    break
+                now = time.monotonic() - t0
+                if t_offset > now:
+                    time.sleep(t_offset - now)
+                if not killed and t_offset >= duration_s / 3:
+                    plane.subs[0].stop()
+                    plane.replicas[0].shutdown()
+                    killed = True
+                threading.Thread(
+                    target=issue, args=(t_offset,), daemon=True
+                ).start()
+                i += 1
+            deadline = time.monotonic() + 15
+            while len(outcomes) < i and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert len(outcomes) == i, "requests lost without outcome"
+            for t_offset, ok, gen_stamp, payload in outcomes:
+                if ok:
+                    want_totals, _ = _oracle_totals(
+                        plane.snapshots[gen_stamp], SCENARIOS["cpu"],
+                        SCENARIOS["mem"], SCENARIOS["replicas"],
+                    )
+                    assert payload["totals"] == want_totals
+            oks = sum(1 for o in outcomes if o[1])
+            assert oks > 0.8 * i  # the set kept serving through the kill
+            # Recovery: the final third is error-free (breaker learned).
+            tail = [o for o in outcomes if o[0] > 2 * duration_s / 3]
+            assert tail and all(o[1] for o in tail)
+        finally:
+            rs.close()
+            plane.close()
