@@ -2096,6 +2096,105 @@ def _run() -> None:
                     )
                 # mismatch != slow: a nonzero diff voids the timing (the
                 # metric must never report a wrong kernel's speed).
+
+                # --- capacity-at-risk on the grouped 1M-node fixture
+                # (ROADMAP item 2): the Monte Carlo sample axis IS the
+                # scenario axis, so the whole stochastic evaluation is
+                # one grouped kernel launch.  Sample-axis scaling
+                # (S=1/16/64) shows the marginal cost of confidence;
+                # every timing is gated on car_parity_diffs == 0 vs the
+                # numpy seed-replay oracle over the FULL ungrouped 1M
+                # rows (totals element-for-element AND every quantile
+                # under the shared selection rule).  Own try: a CaR
+                # failure must not void the 1M sweep numbers above.
+                if diffs == 0:
+                    try:
+                        from kubernetesclustercapacity_tpu.stochastic.car import (  # noqa: E501
+                            capacity_at_risk as _car_eval,
+                            fit_totals_numpy as _car_oracle_totals,
+                            quantile_index as _car_q_index,
+                        )
+                        from kubernetesclustercapacity_tpu.stochastic.distributions import (  # noqa: E501
+                            StochasticSpec as _CarSpec,
+                            UsageDistribution as _CarDist,
+                        )
+
+                        def _car_spec_1m(s_count):
+                            return _CarSpec(
+                                cpu=_CarDist(
+                                    kind="normal", mean=500.0, std=150.0
+                                ),
+                                memory=_CarDist(
+                                    kind="lognormal",
+                                    mean=float(1 << 30),
+                                    sigma=0.4,
+                                ),
+                                replicas=n1m,
+                                samples=s_count,
+                                seed=13,
+                            )
+
+                        r64 = _car_eval(
+                            snap1m, _car_spec_1m(64), mode="reference",
+                            bindings=False,
+                        )
+                        want = _car_oracle_totals(
+                            snap1m.alloc_cpu_milli,
+                            snap1m.alloc_mem_bytes,
+                            snap1m.alloc_pods,
+                            snap1m.used_cpu_req_milli,
+                            snap1m.used_mem_req_bytes,
+                            snap1m.pods_count,
+                            snap1m.healthy,
+                            r64.samples_cpu,
+                            r64.samples_mem,
+                            mode="reference",
+                            chunk=8,
+                        )
+                        car_diffs = int((r64.totals != want).sum())
+                        st = np.sort(want, kind="stable")
+                        for q, v in r64.quantiles.items():
+                            if int(st[_car_q_index(64, q)]) != v:
+                                car_diffs += 1
+                        ladder["car_parity_diffs"] = car_diffs
+                        if car_diffs == 0:
+                            for s_count, name in (
+                                (1, "car_1m_s1_ms"),
+                                (16, "car_1m_s16_ms"),
+                                (64, "car_1m_s64_ms"),
+                            ):
+                                spec_s = _car_spec_1m(s_count)
+                                _car_eval(  # warm: compile + devcache
+                                    snap1m, spec_s, mode="reference",
+                                    bindings=False,
+                                )
+                                best_car = None
+                                for _ in range(3):
+                                    t0 = time.perf_counter()
+                                    _car_eval(
+                                        snap1m, spec_s,
+                                        mode="reference",
+                                        bindings=False,
+                                    )
+                                    dt = time.perf_counter() - t0
+                                    best_car = (
+                                        dt
+                                        if best_car is None
+                                        else min(best_car, dt)
+                                    )
+                                ladder[name] = round(best_car * 1e3, 3)
+                            # The headline: a full 64-sample quantile
+                            # ladder over 1,000,000 nodes, end to end
+                            # (sampling + grouped sweep + reduction).
+                            ladder["car_1m_quantile_ms"] = ladder[
+                                "car_1m_s64_ms"
+                            ]
+                        # a nonzero diff voids the timings, never the
+                        # parity field itself.
+                    except Exception as e:  # noqa: BLE001 - best-effort row
+                        ladder["car_1m_error"] = (
+                            f"{type(e).__name__}: {e}"
+                        )
             del snap1m
         except Exception as e:  # noqa: BLE001 - scale entry is best-effort
             ladder["nodes_1m_error"] = f"{type(e).__name__}: {e}"

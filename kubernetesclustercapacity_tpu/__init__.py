@@ -89,3 +89,11 @@ from kubernetesclustercapacity_tpu.timeline import (  # noqa: E402,F401
     CapacityTimeline,
     load_watchlist,
 )
+from kubernetesclustercapacity_tpu.stochastic import (  # noqa: E402,F401
+    CaRResult,
+    StochasticSpec,
+    UsageDistribution,
+    capacity_at_risk,
+    extract_usage_history,
+    load_stochastic_spec,
+)

@@ -155,6 +155,29 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="NAME",
                    help="with -timeline: narrow records/deltas/alerts to "
                         "one watch")
+    p.add_argument("-car", default=None, metavar="HOST:PORT",
+                   help="render a running capacity service's "
+                        "capacity-at-risk status (per quantile watch: "
+                        "capacity at its confidence, probability-of-fit, "
+                        "alert state) and exit; -output json selects the "
+                        "structured form; exit 1 while any quantile "
+                        "watch is breached (or none are configured)")
+    p.add_argument("-car-spec", default="", dest="car_spec", metavar="FILE",
+                   help="offline capacity-at-risk: load a stochastic "
+                        "usage spec (YAML/JSON: per-pod cpu/memory "
+                        "distributions, replicas, samples, seed) and "
+                        "report capacity quantiles for the -snapshot "
+                        "source; deterministic in the seed; exit 1 when "
+                        "the spec's replicas miss its confidence bar")
+    p.add_argument("-car-samples", type=int, default=0, dest="car_samples",
+                   metavar="S",
+                   help="with -car-spec: override the spec's Monte "
+                        "Carlo sample count (0 = keep the spec's / the "
+                        "KCCAP_CAR_SAMPLES default)")
+    p.add_argument("-car-seed", type=int, default=None, dest="car_seed",
+                   metavar="N",
+                   help="with -car-spec: override the spec's sampling "
+                        "seed (explicit seeds make every run replayable)")
     p.add_argument("-replay", default="", metavar="DIR",
                    help="replay a kccap-server audit log: verify the "
                         "generation digest chain, reconstruct every "
@@ -251,6 +274,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.timeline:
         return _run_timeline(args)
 
+    if args.car:
+        return _run_car_status(args)
+
     if args.slo_status:
         return _run_slo_status(args)
 
@@ -317,6 +343,7 @@ def main(argv: list[str] | None = None) -> int:
         if trace_log is not None:
             mode = (
                 "drain" if args.drain else
+                "car" if args.car_spec else
                 "explain" if args.explain else
                 "grid" if args.grid > 0 else "fit"
             )
@@ -381,6 +408,8 @@ def _run_command(args) -> int:
         snapshot.save(args.save_snapshot)
         print(f"snapshot checkpointed to {args.save_snapshot}", file=sys.stderr)
 
+    if args.car_spec:
+        return _run_car_spec(args, snapshot)
     if args.drain:
         return _run_drain(args, fixture, snapshot)
     if args.explain:
@@ -451,6 +480,86 @@ def _diag_client(addr):
         retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
         deadline_s=10.0,
     )
+
+
+def _run_car_status(args) -> int:
+    """-car HOST:PORT: fetch and render a service's capacity-at-risk
+    watch status (the quantile-watch slice of the timeline).  Exits by
+    the verdict, like -timeline: a breached quantile watch — "with 95%
+    confidence fewer than N replicas fit" — is a scriptable failure,
+    and so is a server with no quantile watches at all."""
+    from kubernetesclustercapacity_tpu.report import (
+        car_status_json_report,
+        car_status_table_report,
+    )
+
+    addr = _parse_addr("-car", args.car)
+    if addr is None:
+        return 1
+    try:
+        with _diag_client(addr) as c:
+            result = c.car()
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot fetch capacity-at-risk status from "
+              f"{addr[0]}:{addr[1]}: {e}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(car_status_json_report(result))
+    else:
+        print(car_status_table_report(result))
+    if not result.get("enabled", False):
+        return 1
+    return 1 if result.get("breached") else 0
+
+
+def _run_car_spec(args, snapshot) -> int:
+    """-car-spec FILE: offline capacity-at-risk against the -snapshot
+    source.  Applies the same implicit strict-mode taint mask as every
+    other surface, prints the quantile ladder (table or JSON), and
+    exits by the spec's own confidence bar: 1 when
+    ``P(fit replicas) < confidence``."""
+    import dataclasses as _dc
+
+    from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+    from kubernetesclustercapacity_tpu.report import (
+        car_json_report,
+        car_table_report,
+    )
+    from kubernetesclustercapacity_tpu.stochastic import (
+        DistributionError,
+        capacity_at_risk,
+        load_stochastic_spec,
+    )
+
+    if args.backend != "tpu":
+        print("ERROR : -car-spec runs on the JAX kernels (-backend tpu); "
+              "cpu/native backends are fit-only cross-checks ...exiting")
+        return 1
+    try:
+        spec = load_stochastic_spec(args.car_spec)
+    except (OSError, DistributionError) as e:
+        print(f"ERROR : bad -car-spec: {e}")
+        return 1
+    if args.car_samples:
+        if args.car_samples < 2:
+            print("ERROR : -car-samples must be >= 2 ...exiting")
+            return 1
+        spec = _dc.replace(spec, samples=args.car_samples)
+    if args.car_seed is not None:
+        spec = _dc.replace(spec, seed=args.car_seed)
+    try:
+        result = capacity_at_risk(
+            snapshot, spec, mode=args.semantics,
+            node_mask=implicit_taint_mask(snapshot),
+        )
+    except (DistributionError, ValueError) as e:
+        print(f"ERROR : {e}")
+        return 1
+    if args.output == "json":
+        print(car_json_report(result.to_wire()))
+    else:
+        print(car_table_report(result.to_wire()))
+    return 0 if result.schedulable else 1
 
 
 def _run_slo_status(args) -> int:

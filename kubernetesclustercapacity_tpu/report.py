@@ -33,6 +33,10 @@ __all__ = [
     "slo_json_report",
     "dump_table_report",
     "dump_json_report",
+    "car_table_report",
+    "car_json_report",
+    "car_status_table_report",
+    "car_status_json_report",
 ]
 
 _RULE = "=" * 110  # the reference prints 110 '=' (ClusterCapacity.go:142,149)
@@ -485,6 +489,103 @@ def dump_table_report(dump: dict) -> str:
 def dump_json_report(dump: dict) -> str:
     """``kccap -dump -output json``: the wire shape verbatim."""
     return json.dumps(dump, indent=2, sort_keys=True)
+
+
+def car_table_report(car: dict) -> str:
+    """One capacity-at-risk evaluation (the ``car`` op's wire shape /
+    ``CaRResult.to_wire()``) as operator-readable text: the quantile
+    ladder with per-quantile binding attribution, then the
+    probability-of-fit verdict a deployment gate would script on."""
+    lines = [
+        f"capacity at risk ({car.get('mode')} semantics, "
+        f"{car.get('samples')} samples, seed {car.get('seed')})"
+    ]
+    binding = car.get("binding", {})
+    header = f"{'QUANTILE':<10} {'CAPACITY':>10}  BINDING"
+    lines += [header, "-" * len(header)]
+    for label in sorted(
+        car.get("quantiles", {}),
+        key=lambda p: float(p[1:]),
+    ):
+        counts = binding.get(label, {})
+        bind = "  ".join(
+            f"{k}={v}" for k, v in counts.items() if v
+        )
+        lines.append(
+            f"{label:<10} {car['quantiles'][label]:>10}  {bind}"
+        )
+    lines.append("-" * len(header))
+    lines.append(
+        f"mean capacity: {car.get('mean')}   sample range: "
+        f"[{car.get('min_total')}, {car.get('max_total')}]"
+    )
+    replicas = car.get("replicas")
+    prob = car.get("prob_fit")
+    confidence = car.get("confidence")
+    verdict = (
+        "SCHEDULABLE" if car.get("schedulable") else "NOT SCHEDULABLE"
+    )
+    lines.append(
+        f"P(fit {replicas} replicas) = {prob}   required confidence: "
+        f"{confidence}   verdict: {verdict}"
+    )
+    return "\n".join(lines)
+
+
+def car_json_report(car: dict) -> str:
+    """``kccap -car-spec -output json``: the wire shape verbatim."""
+    return json.dumps(car, indent=2, sort_keys=True)
+
+
+def car_status_table_report(status: dict) -> str:
+    """The ``car`` op's watch-status form as operator-readable text:
+    one row per quantile watch (capacity at its confidence, the
+    probability-of-fit, the alert state)."""
+    if not status.get("enabled", False):
+        return (
+            "capacity at risk: no quantile watches on this server "
+            "(-watch entries need a quantile: field)"
+        )
+    header = (
+        f"{'WATCH':<24} {'QUANTILE':>9} {'CAPACITY':>9} {'MIN':>6} "
+        f"{'P(FIT)':>8} {'SAMPLES':>8}  STATE"
+    )
+    lines = [
+        f"capacity at risk: serving generation {status.get('generation')}",
+        header,
+        "-" * len(header),
+    ]
+    def _cell(v):
+        return "-" if v is None else v
+
+    for name in sorted(status.get("watches", {})):
+        w = status["watches"][name]
+        alert = w.get("alert", {})
+        qlabel = f"p{w['quantile'] * 100:g}"
+        lines.append(
+            f"{name:<24} "
+            f"{qlabel:>9} "
+            f"{_cell(w.get('last_total')):>9} "
+            f"{_cell(w.get('min_replicas')):>6} "
+            f"{_cell(w.get('prob_fit')):>8} "
+            f"{w.get('samples'):>8}  {alert.get('state')}"
+        )
+    lines.append("-" * len(header))
+    breached = status.get("breached", [])
+    lines.append(
+        "verdict: "
+        + (
+            "BREACHED — " + ", ".join(breached)
+            if breached
+            else "ok — every quantile watch above its threshold"
+        )
+    )
+    return "\n".join(lines)
+
+
+def car_status_json_report(status: dict) -> str:
+    """``kccap -car -output json``: the wire shape verbatim."""
+    return json.dumps(status, indent=2, sort_keys=True)
 
 
 def replay_table_report(result: dict) -> str:

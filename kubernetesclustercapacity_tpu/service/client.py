@@ -12,10 +12,11 @@ op              auto-retry on transport failure?
 ==============  =======================================================
 ping, info      yes (read-only)
 fit, sweep,     yes (pure queries against an immutable snapshot — a
-sweep_multi,    duplicate execution returns the identical result)
-place, drain,
-topology_spread,
-plan, explain
+sweep_multi,    duplicate execution returns the identical result;
+place, drain,   ``car`` included: its Monte Carlo draw is seeded, so a
+topology_spread, retry re-draws the identical samples)
+plan, explain,
+car
 dump,           yes (read-only views of the flight recorder / capacity
 timeline, slo   timeline / SLO burn rates; a retry re-reads the ring,
                 which may have advanced — acceptable for a diagnostic
@@ -59,8 +60,8 @@ __all__ = ["CapacityClient", "IDEMPOTENT_OPS"]
 IDEMPOTENT_OPS = frozenset(
     {
         "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
-        "topology_spread", "plan", "explain", "dump", "timeline", "slo",
-        "drain_server",
+        "topology_spread", "plan", "explain", "car", "dump", "timeline",
+        "slo", "drain_server",
     }
 )
 
@@ -478,6 +479,20 @@ class CapacityClient:
         """Why the fit stops where it does: binding constraint per node,
         binding histogram, saturation summary, marginal (+1) analysis."""
         return self.call("explain", **flags)
+
+    def car(self, usage: dict | None = None, **params) -> dict:
+        """Capacity-at-risk.  With ``usage`` (per-pod distribution
+        block ``{"cpu": {...}, "memory": {...}}`` plus optional
+        ``replicas``/``samples``/``seed``/``quantiles``), evaluates the
+        stochastic spec against the served snapshot and returns the
+        capacity quantiles, mean, probability-of-fit, and per-quantile
+        binding attribution — seed-deterministic, so a transport retry
+        re-draws the identical samples.  Without ``usage``, returns the
+        server's quantile-watch status (last quantile capacities and
+        alert states)."""
+        if usage is not None:
+            params["usage"] = usage
+        return self.call("car", **params)
 
     def dump(self, op: str | None = None, status: str | None = None,
              limit: int | None = None, **kw) -> dict:

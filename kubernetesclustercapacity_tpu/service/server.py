@@ -550,7 +550,8 @@ class CapacityServer:
     _AUDITED_OPS = frozenset(
         {
             "fit", "sweep", "sweep_multi", "place", "drain",
-            "topology_spread", "plan", "explain", "update", "reload",
+            "topology_spread", "plan", "explain", "car", "update",
+            "reload",
         }
     )
 
@@ -630,8 +631,9 @@ class CapacityServer:
     _KNOWN_OPS = frozenset(
         {
             "ping", "info", "fit", "sweep", "sweep_multi", "place",
-            "drain", "topology_spread", "plan", "explain", "dump",
-            "timeline", "slo", "reload", "update", "drain_server",
+            "drain", "topology_spread", "plan", "explain", "car",
+            "dump", "timeline", "slo", "reload", "update",
+            "drain_server",
         }
     )
 
@@ -642,7 +644,7 @@ class CapacityServer:
     _ADMISSION_OPS = frozenset(
         {
             "fit", "sweep", "sweep_multi", "place", "drain",
-            "topology_spread", "plan", "explain",
+            "topology_spread", "plan", "explain", "car",
         }
     )
 
@@ -860,7 +862,7 @@ class CapacityServer:
             return self._op_drain_server(msg)
         if op in (
             "fit", "sweep", "sweep_multi", "place", "drain",
-            "topology_spread", "plan", "explain",
+            "topology_spread", "plan", "explain", "car",
         ):
             # Bounded concurrency for the compute ops: each holds device
             # dispatch + host packing; unbounded fan-in from one noisy
@@ -1077,6 +1079,8 @@ class CapacityServer:
             return self._op_plan(msg, snap, fixture)
         if op == "explain":
             return self._op_explain(msg, snap, implicit_mask)
+        if op == "car":
+            return self._op_car(msg, snap, implicit_mask)
         if op == "dump":
             return self._op_dump(msg)
         if op == "timeline":
@@ -1561,6 +1565,98 @@ class CapacityServer:
             out["report"] = explain_table_report(result)
         elif output == "json":
             out["report"] = explain_json_report(result)
+        return out
+
+    def _op_car(
+        self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
+    ) -> dict:
+        """Capacity-at-risk over the wire, two forms:
+
+        * **evaluate** (``usage`` present): parse the stochastic spec
+          (``usage``/``replicas``/``samples``/``seed``/``confidence``,
+          optional ``quantiles`` list), draw the seed-deterministic
+          Monte Carlo samples, sweep them through the production kernel
+          path (same semantics and implicit taint mask as fit/sweep),
+          and return capacity quantiles + mean + probability-of-fit +
+          per-quantile binding attribution;
+        * **watch status** (no ``usage``): the capacity-at-risk slice
+          of the timeline — per quantile watch the last quantile
+          capacity, probability-of-fit, and alert state (what
+          ``kccap -car HOST:PORT`` renders and exits by).
+        """
+        from kubernetesclustercapacity_tpu.stochastic.car import (
+            DEFAULT_QUANTILES,
+            capacity_at_risk,
+        )
+        from kubernetesclustercapacity_tpu.stochastic.distributions import (
+            DistributionError,
+            parse_stochastic_spec,
+        )
+
+        if "usage" not in msg:
+            tl = self._timeline
+            watches = tl.car_status() if tl is not None else {}
+            if not watches:
+                return {"enabled": False, "watches": {}, "breached": []}
+            return {
+                "enabled": True,
+                "generation": self.generation,
+                "watches": watches,
+                "breached": tl.car_breached(),
+            }
+        data = {"usage": msg["usage"]}
+        for field in ("replicas", "samples", "seed", "confidence"):
+            if field in msg:
+                data[field] = msg[field]
+        try:
+            spec = parse_stochastic_spec(data)
+        except DistributionError as e:
+            raise ValueError(str(e)) from e
+        quantiles = msg.get("quantiles")
+        if quantiles is not None:
+            if not isinstance(quantiles, list) or not quantiles:
+                raise ValueError("quantiles must be a non-empty list")
+            for q in quantiles:
+                if (
+                    isinstance(q, bool)
+                    or not isinstance(q, (int, float))
+                    or not 0.0 < float(q) < 1.0
+                ):
+                    raise ValueError(
+                        f"quantiles must lie strictly inside (0, 1), "
+                        f"got {q!r}"
+                    )
+            quantiles = tuple(float(q) for q in quantiles)
+        result = capacity_at_risk(
+            snap,
+            spec,
+            mode=snap.semantics,
+            node_mask=implicit_mask,
+            quantiles=quantiles or DEFAULT_QUANTILES,
+        )
+
+        from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+
+        clk = _phases.current()
+        if clk:
+            import time as _time
+
+            t0 = _time.perf_counter()
+        out = result.to_wire()
+        output = msg.get("output")
+        if output in ("table", "json"):
+            from kubernetesclustercapacity_tpu.report import (
+                car_json_report,
+                car_table_report,
+            )
+
+            out["report"] = (
+                car_table_report(out)
+                if output == "table"
+                else car_json_report(out)
+            )
+        if clk:
+            clk.record("serialize", _time.perf_counter() - t0)
         return out
 
     def _op_dump(self, msg: dict) -> dict:
@@ -2573,16 +2669,22 @@ def main(argv=None) -> int:
         def _overall_healthy() -> bool:
             # /healthz goes 503 the moment the feed is known-dead OR
             # the shadow oracle caught the kernels lying OR an SLO is
-            # fast-burning OR the plane replica went stale OR a drain
-            # began: a frozen snapshot, a wrong answer, a service
-            # missing its latency objective, a bounded-staleness
-            # violation, and a deliberate departure are all things a
-            # load balancer must route around, not discover later.
+            # fast-burning OR a capacity-at-risk watch is breached OR
+            # the plane replica went stale OR a drain began: a frozen
+            # snapshot, a wrong answer, a missed latency objective, a
+            # confidence statement that capacity no longer fits, a
+            # bounded-staleness violation, and a deliberate departure
+            # are all things a load balancer must route around, not
+            # discover later.  (Plain watch breaches stay advisory —
+            # they describe the CLUSTER; a CaR breach says the serving
+            # tier's own promise "N replicas fit at P95" is broken.)
             if follower is not None and follower.fatal is not None:
                 return False
             if shadow is not None and shadow.diverged:
                 return False
             if slo_monitor is not None and slo_monitor.fast_burning:
+                return False
+            if timeline is not None and timeline.car_breached():
                 return False
             if subscriber is not None and subscriber.stale:
                 return False

@@ -1,0 +1,46 @@
+"""Stochastic capacity (ROADMAP item 2): capacity-at-risk under usage
+uncertainty.
+
+Point requests are fiction in production; this package models per-pod
+usage as distributions and answers "how many replicas fit with 95%
+confidence" via a Monte Carlo sample axis over the existing fit
+kernels:
+
+* :mod:`.distributions` — the point/normal/lognormal/empirical
+  vocabulary, the watchlist-grammar loader, and the deterministic
+  counter-based sampler (``jax.random`` with explicit seeds — every
+  run replayable);
+* :mod:`.car` — the capacity-at-risk engine: samples → one
+  ``[S]``-scenario sweep through the production kernel path
+  (devcache/bucketing/grouping apply unchanged) → host-side quantile
+  reduction, pinned bit-exact against a numpy seed-replay oracle;
+* :mod:`.history` — the empirical feed: observed per-pod usage
+  extracted from the audit log's digest-verified generations, so
+  forecasts derive from replayable history.
+"""
+
+from kubernetesclustercapacity_tpu.stochastic.car import (  # noqa: F401
+    DEFAULT_QUANTILES,
+    CaRResult,
+    capacity_at_risk,
+    car_oracle,
+    fit_totals_numpy,
+    quantile_index,
+    quantile_label,
+)
+from kubernetesclustercapacity_tpu.stochastic.distributions import (  # noqa: F401
+    DistributionError,
+    StochasticSpec,
+    UsageDistribution,
+    default_samples,
+    load_stochastic_spec,
+    parse_distribution,
+    parse_stochastic_spec,
+    sample_key,
+    sample_usage,
+)
+from kubernetesclustercapacity_tpu.stochastic.history import (  # noqa: F401
+    InsufficientHistoryError,
+    UsageHistory,
+    extract_usage_history,
+)

@@ -1,0 +1,445 @@
+"""Per-pod usage distributions: the vocabulary behind capacity-at-risk.
+
+Point requests are fiction in production — a pod's *request* is a
+planning number, its *usage* a random variable.  This module gives that
+variable a small, validated vocabulary (the chance-constrained framing
+of "Solving the Batch Stochastic Bin Packing Problem in Cloud",
+PAPERS.md):
+
+* ``point``     — the degenerate distribution (the classic fixed request);
+* ``normal``    — ``round(mean + std·Z)``, clamped to the sane usage
+  domain ``[1, 2^62]`` (a usage sample must be a valid kernel divisor);
+* ``lognormal`` — ``round(exp(ln(mean) + sigma·Z))``, the heavy-tailed
+  shape real CPU usage exhibits, same clamp;
+* ``empirical`` — an explicit value/weight histogram, e.g. extracted
+  from the audit log's recorded generations (:mod:`.history`).
+
+Specs load through the same watchlist-style YAML/JSON grammar as every
+other operator file, with quantity strings parsed by the reference
+codecs (``500m`` CPU, ``1gb`` memory) so a distribution's mean is the
+same number the flag surface would produce.
+
+Sampling is **deterministic and counter-based**: every draw comes from
+``jax.random`` (threefry — a counter-based PRNG) keyed by an explicit
+integer seed, never wall-clock state, so a run is replayable bit-for-bit
+— the numpy oracle re-draws the identical samples from the identical
+seed.  The draw kernels are jit-pure (array math only; no registry, no
+locks, no I/O — enforced by kccap-lint's jit-purity prover); everything
+host-side (validation, parsing, the quantile reduction in :mod:`.car`)
+stays out of traced code.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetesclustercapacity_tpu.utils.quantity import (
+    QuantityParseError,
+    cpu_parse_error_payload,
+    cpu_to_milli_reference,
+    to_bytes_reference,
+)
+
+__all__ = [
+    "DIST_KINDS",
+    "DistributionError",
+    "MAX_USAGE",
+    "StochasticSpec",
+    "UsageDistribution",
+    "default_samples",
+    "load_stochastic_spec",
+    "parse_distribution",
+    "parse_stochastic_spec",
+    "sample_key",
+    "sample_usage",
+]
+
+DIST_KINDS = ("point", "normal", "lognormal", "empirical")
+
+#: Usage samples live in ``[1, MAX_USAGE]``: 0 would divide-by-zero the
+#: reference kernel (SURVEY.md §2.4 Q8) and anything past 2^62 pushes
+#: the int64 carrier into wrap territory — not a usage observation.
+MAX_USAGE = 1 << 62
+
+#: Default Monte Carlo sample count when a spec does not pin one
+#: (``KCCAP_CAR_SAMPLES`` overrides process-wide).
+DEFAULT_SAMPLES = 64
+
+_MAX_SAMPLES = 1 << 16
+
+
+class DistributionError(ValueError):
+    """Malformed usage-distribution spec (bad kind, bad quantity, bad
+    weights) — the watchlist-grammar analog of ``WatchError``."""
+
+
+def default_samples() -> int:
+    """The process default sample count (``KCCAP_CAR_SAMPLES``, else 64).
+
+    Read per evaluation (host-side only — never inside jitted code) so
+    the escape hatch works without a restart; junk values fall back to
+    the built-in default rather than failing an evaluation.
+    """
+    try:
+        env = int(os.environ.get("KCCAP_CAR_SAMPLES", "0"))
+    except ValueError:
+        env = 0
+    return env if 2 <= env <= _MAX_SAMPLES else DEFAULT_SAMPLES
+
+
+@dataclass(frozen=True)
+class UsageDistribution:
+    """One resource's per-pod usage distribution (validated, immutable).
+
+    Only the fields of the active ``kind`` are meaningful; units are
+    the kernel's native integers (millicores / bytes).
+    """
+
+    kind: str
+    value: int = 0  # point
+    mean: float = 0.0  # normal / lognormal (native units)
+    std: float = 0.0  # normal
+    sigma: float = 0.0  # lognormal (log-space std)
+    values: tuple[int, ...] = ()  # empirical
+    weights: tuple[float, ...] = ()  # empirical (same length as values)
+
+    @property
+    def degenerate(self) -> bool:
+        """True when every sample is the same value — a point request in
+        disguise, for which every capacity quantile equals the plain fit."""
+        if self.kind == "point":
+            return True
+        if self.kind == "normal":
+            return self.std == 0.0
+        if self.kind == "lognormal":
+            return self.sigma == 0.0
+        return len(set(self.values)) <= 1
+
+    def to_wire(self) -> dict:
+        """JSON-able description (rides watch/op wire shapes)."""
+        out: dict = {"dist": self.kind}
+        if self.kind == "point":
+            out["value"] = self.value
+        elif self.kind == "normal":
+            out.update(mean=self.mean, std=self.std)
+        elif self.kind == "lognormal":
+            out.update(mean=self.mean, sigma=self.sigma)
+        else:
+            out.update(values=list(self.values), weights=list(self.weights))
+        return out
+
+
+@dataclass(frozen=True)
+class StochasticSpec:
+    """A full capacity-at-risk question: usage distributions + target.
+
+    ``samples=0`` means "the process default" (:func:`default_samples`),
+    resolved at evaluation time; ``confidence`` is the schedulability
+    bar ``kccap -car-spec`` exits by (``P(fit) >= confidence``).
+    """
+
+    cpu: UsageDistribution
+    memory: UsageDistribution
+    replicas: int = 1
+    samples: int = 0
+    seed: int = 0
+    confidence: float = 0.95
+
+    def n_samples(self) -> int:
+        return self.samples if self.samples else default_samples()
+
+    def to_wire(self) -> dict:
+        return {
+            "usage": {"cpu": self.cpu.to_wire(), "memory": self.memory.to_wire()},
+            "replicas": self.replicas,
+            "samples": self.n_samples(),
+            "seed": self.seed,
+            "confidence": self.confidence,
+        }
+
+
+# -- grammar ---------------------------------------------------------------
+
+def _quantity(resource: str, v, *, field: str) -> int:
+    """One quantity: a string through the reference codecs (``500m`` /
+    ``1gb``) or a plain number in native units (millicores / bytes)."""
+    if isinstance(v, bool):
+        raise DistributionError(f"{field}: expected a quantity, got {v!r}")
+    if isinstance(v, (int, float)):
+        if isinstance(v, float) and not v.is_integer():
+            raise DistributionError(
+                f"{field}: native-unit quantities must be integers, got {v!r}"
+            )
+        return int(v)
+    if not isinstance(v, str):
+        raise DistributionError(f"{field}: expected a quantity, got {v!r}")
+    if resource == "cpu":
+        # The reference codec zeroes unparseable values (printing a
+        # payload); a distribution parameter must fail loudly instead.
+        if cpu_parse_error_payload(v) is not None:
+            raise DistributionError(f"{field}: bad cpu quantity {v!r}")
+        return cpu_to_milli_reference(v)
+    try:
+        return to_bytes_reference(v)
+    except QuantityParseError as e:
+        raise DistributionError(f"{field}: bad memory quantity {v!r}: {e}") from e
+
+
+def _usage_value(resource: str, v, *, field: str) -> int:
+    q = _quantity(resource, v, field=field)
+    if not 1 <= q <= MAX_USAGE:
+        raise DistributionError(
+            f"{field}: usage must be in [1, 2^62], got {q}"
+        )
+    return q
+
+
+def _number(v, *, field: str, minimum: float | None = None) -> float:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise DistributionError(f"{field}: expected a number, got {v!r}")
+    f = float(v)
+    if not math.isfinite(f):
+        raise DistributionError(f"{field}: must be finite, got {v!r}")
+    if minimum is not None and f < minimum:
+        raise DistributionError(f"{field}: must be >= {minimum:g}, got {v!r}")
+    return f
+
+
+def parse_distribution(resource: str, data) -> UsageDistribution:
+    """One ``{dist: ..., ...}`` block → a validated distribution.
+
+    ``resource`` (``"cpu"``/``"memory"``) selects the quantity codec.
+    A bare quantity (string or int) is shorthand for a point
+    distribution at that value.
+    """
+    field = f"usage.{resource}"
+    if isinstance(data, (str, int)) and not isinstance(data, bool):
+        return UsageDistribution(
+            kind="point", value=_usage_value(resource, data, field=field)
+        )
+    if not isinstance(data, dict):
+        raise DistributionError(
+            f"{field}: expected a distribution mapping, got {data!r}"
+        )
+    kind = data.get("dist")
+    if kind not in DIST_KINDS:
+        raise DistributionError(
+            f"{field}: dist must be one of {DIST_KINDS}, got {kind!r}"
+        )
+    known = {"point": {"dist", "value"},
+             "normal": {"dist", "mean", "std"},
+             "lognormal": {"dist", "mean", "sigma"},
+             "empirical": {"dist", "values", "weights"}}[kind]
+    extra = set(data) - known
+    if extra:
+        raise DistributionError(
+            f"{field}: unknown field(s) {sorted(extra)} for dist "
+            f"{kind!r} (want {sorted(known - {'dist'})})"
+        )
+    if kind == "point":
+        if "value" not in data:
+            raise DistributionError(f"{field}: point needs 'value'")
+        return UsageDistribution(
+            kind="point",
+            value=_usage_value(resource, data["value"], field=f"{field}.value"),
+        )
+    if kind == "normal":
+        if "mean" not in data:
+            raise DistributionError(f"{field}: normal needs 'mean'")
+        mean = float(
+            _usage_value(resource, data["mean"], field=f"{field}.mean")
+        )
+        std = (
+            float(_quantity(resource, data["std"], field=f"{field}.std"))
+            if isinstance(data.get("std"), str)
+            else _number(data.get("std", 0), field=f"{field}.std", minimum=0.0)
+        )
+        return UsageDistribution(kind="normal", mean=mean, std=std)
+    if kind == "lognormal":
+        if "mean" not in data:
+            raise DistributionError(f"{field}: lognormal needs 'mean'")
+        mean = float(
+            _usage_value(resource, data["mean"], field=f"{field}.mean")
+        )
+        sigma = _number(
+            data.get("sigma", 0), field=f"{field}.sigma", minimum=0.0
+        )
+        if sigma > 4.0:
+            raise DistributionError(
+                f"{field}.sigma: must be <= 4 (exp(4σ) already exceeds "
+                f"any sane usage spread), got {sigma:g}"
+            )
+        return UsageDistribution(kind="lognormal", mean=mean, sigma=sigma)
+    # empirical
+    raw_values = data.get("values")
+    if not isinstance(raw_values, list) or not raw_values:
+        raise DistributionError(
+            f"{field}: empirical needs a non-empty 'values' list"
+        )
+    values = tuple(
+        _usage_value(resource, v, field=f"{field}.values[{i}]")
+        for i, v in enumerate(raw_values)
+    )
+    raw_weights = data.get("weights")
+    if raw_weights is None:
+        weights = tuple(1.0 for _ in values)
+    else:
+        if not isinstance(raw_weights, list) or len(raw_weights) != len(values):
+            raise DistributionError(
+                f"{field}: weights must be a list the length of values"
+            )
+        weights = tuple(
+            _number(w, field=f"{field}.weights[{i}]")
+            for i, w in enumerate(raw_weights)
+        )
+        if any(w <= 0 for w in weights):
+            raise DistributionError(f"{field}: weights must be > 0")
+    return UsageDistribution(kind="empirical", values=values, weights=weights)
+
+
+def parse_stochastic_spec(data) -> StochasticSpec:
+    """A spec document/wire body → :class:`StochasticSpec`.
+
+    Shape::
+
+        usage:
+          cpu:    {dist: normal, mean: 500m, std: 150m}
+          memory: {dist: lognormal, mean: 1gb, sigma: 0.4}
+        replicas: "40"        # reference grammar (or a plain int)
+        samples: 256          # optional; default KCCAP_CAR_SAMPLES/64
+        seed: 7               # optional; explicit, never wall-clock
+        confidence: 0.95      # optional; the -car-spec exit bar
+    """
+    if not isinstance(data, dict):
+        raise DistributionError(f"spec: expected a mapping, got {data!r}")
+    extra = set(data) - {"usage", "replicas", "samples", "seed", "confidence"}
+    if extra:
+        raise DistributionError(f"spec: unknown field(s) {sorted(extra)}")
+    usage = data.get("usage")
+    if not isinstance(usage, dict):
+        raise DistributionError("spec: needs a 'usage' mapping")
+    extra = set(usage) - {"cpu", "memory"}
+    if extra:
+        raise DistributionError(
+            f"usage: unknown resource(s) {sorted(extra)} (want cpu/memory)"
+        )
+    if "cpu" not in usage or "memory" not in usage:
+        raise DistributionError("usage: needs both 'cpu' and 'memory'")
+    cpu = parse_distribution("cpu", usage["cpu"])
+    memory = parse_distribution("memory", usage["memory"])
+    replicas = data.get("replicas", 1)
+    if isinstance(replicas, str):
+        try:
+            replicas = int(replicas)
+        except ValueError:
+            raise DistributionError(f"spec: bad replicas {data['replicas']!r}")
+    if isinstance(replicas, bool) or not isinstance(replicas, int):
+        raise DistributionError(f"spec: bad replicas {data['replicas']!r}")
+    samples = data.get("samples", 0)
+    if isinstance(samples, bool) or not isinstance(samples, int):
+        raise DistributionError("spec: samples must be an integer")
+    if samples and not 2 <= samples <= _MAX_SAMPLES:
+        raise DistributionError(
+            f"spec: samples must be in [2, {_MAX_SAMPLES}], got {samples}"
+        )
+    seed = data.get("seed", 0)
+    if isinstance(seed, bool) or not isinstance(seed, int):
+        raise DistributionError("spec: seed must be an integer")
+    confidence = _number(
+        data.get("confidence", 0.95), field="spec.confidence"
+    )
+    if not 0.0 < confidence < 1.0:
+        raise DistributionError(
+            f"spec: confidence must be in (0, 1), got {confidence:g}"
+        )
+    return StochasticSpec(
+        cpu=cpu,
+        memory=memory,
+        replicas=replicas,
+        samples=samples,
+        seed=seed,
+        confidence=confidence,
+    )
+
+
+def load_stochastic_spec(path: str) -> StochasticSpec:
+    """Load ``path`` (YAML when PyYAML is present, else strict JSON) —
+    the same loader split as the watchlist's."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        import yaml  # type: ignore[import-untyped]
+
+        data = yaml.safe_load(text)
+    except ImportError:
+        try:
+            data = json.loads(text)
+        except ValueError as e:
+            raise DistributionError(
+                f"{path}: not valid JSON (and PyYAML is unavailable): {e}"
+            ) from e
+    except Exception as e:  # yaml.YAMLError — malformed document
+        raise DistributionError(f"{path}: cannot parse: {e}") from e
+    return parse_stochastic_spec(data)
+
+
+# -- the deterministic sampler ---------------------------------------------
+
+def sample_key(seed: int, stream: int) -> jax.Array:
+    """The counter-based key for one (seed, stream) draw: an explicit
+    integer seed folded with the stream index (cpu=0, memory=1), so two
+    resources of one spec never share a sample sequence and every run
+    with the same seed replays the identical draws."""
+    return jax.random.fold_in(jax.random.PRNGKey(int(seed)), int(stream))
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _normal_samples(key, mean, std, n):
+    z = jax.random.normal(key, (n,), dtype=jnp.float64)
+    v = jnp.round(mean + std * z)
+    return jnp.clip(v, 1.0, float(MAX_USAGE)).astype(jnp.int64)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _lognormal_samples(key, mu, sigma, n):
+    z = jax.random.normal(key, (n,), dtype=jnp.float64)
+    v = jnp.round(jnp.exp(mu + sigma * z))
+    return jnp.clip(v, 1.0, float(MAX_USAGE)).astype(jnp.int64)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _empirical_samples(key, cdf, values, n):
+    u = jax.random.uniform(key, (n,), dtype=jnp.float64)
+    idx = jnp.searchsorted(cdf, u, side="right")
+    return values[jnp.clip(idx, 0, values.shape[0] - 1)]
+
+
+def sample_usage(dist: UsageDistribution, n: int, key) -> np.ndarray:
+    """Draw ``n`` usage samples — ``[n]`` int64 in ``[1, 2^62]``.
+
+    Host wrapper over the jit-pure draw kernels: the transformation
+    (affine / exp / inverse-CDF) runs traced, the materialization is the
+    single host sync.  Deterministic in ``(dist, n, key)``.
+    """
+    if n < 1:
+        raise ValueError(f"need at least 1 sample, got {n}")
+    if dist.kind == "point":
+        return np.full(n, dist.value, dtype=np.int64)
+    if dist.kind == "normal":
+        return np.asarray(_normal_samples(key, dist.mean, dist.std, n))
+    if dist.kind == "lognormal":
+        return np.asarray(
+            _lognormal_samples(key, math.log(dist.mean), dist.sigma, n)
+        )
+    weights = np.asarray(dist.weights, dtype=np.float64)
+    cdf = np.cumsum(weights) / weights.sum()
+    values = np.asarray(dist.values, dtype=np.int64)
+    return np.asarray(_empirical_samples(key, cdf, values, n))

@@ -131,7 +131,14 @@ def _delta_summary(
 
 @dataclass
 class WatchResult:
-    """One watch evaluated against one generation."""
+    """One watch evaluated against one generation.
+
+    For a capacity-at-risk watch (``quantile`` set) ``total`` is the
+    Monte Carlo capacity quantile — the fit of the quantile-realizing
+    usage sample, so ``fits``/``binding_counts`` stay node-granular and
+    the delta attribution works unchanged; ``prob_fit`` is the fraction
+    of samples that fit the spec's replicas.
+    """
 
     name: str
     mode: str
@@ -141,9 +148,13 @@ class WatchResult:
     min_replicas: int | None
     binding_counts: dict[str, int]
     fits: np.ndarray  # [N] per-node, aligned with the record's node keys
+    quantile: float | None = None
+    prob_fit: float | None = None
+    samples: int = 0
+    car_eval_ms: float = 0.0
 
     def to_wire(self) -> dict:
-        return {
+        out = {
             "total": self.total,
             "schedulable": self.schedulable,
             "breached": self.breached,
@@ -151,6 +162,11 @@ class WatchResult:
             "min_replicas": self.min_replicas,
             "binding_counts": dict(self.binding_counts),
         }
+        if self.quantile is not None:
+            out["quantile"] = self.quantile
+            out["prob_fit"] = self.prob_fit
+            out["samples"] = self.samples
+        return out
 
 
 @dataclass
@@ -231,6 +247,12 @@ class CapacityTimeline:
         self._alerts = {
             w.name: WatchAlert(w.name, w.min_replicas) for w in self.watches
         }
+        #: Names of the capacity-at-risk (quantile) watches — the slice
+        #: whose breaches additionally flip ``/healthz`` and the
+        #: ``kccap_car_*`` gauges.
+        self._car_names = frozenset(
+            w.name for w in self.watches if w.quantile is not None
+        )
         self._log = TraceLog(log) if isinstance(log, str) else log
         self._m = None
         if registry is not None and _telemetry_enabled():
@@ -276,6 +298,38 @@ class CapacityTimeline:
                     "(coalescer thread, off the request path).",
                 ),
             }
+            if self._car_names:
+                # The capacity-at-risk family, registered only when a
+                # quantile watch exists (a plain timeline's registry
+                # shape stays byte-identical to the pre-CaR one).
+                self._m.update(
+                    {
+                        "car_replicas": registry.gauge(
+                            "kccap_car_replicas",
+                            "Capacity at the watch's confidence "
+                            "quantile (Monte Carlo, seed-deterministic).",
+                            ("watch",),
+                        ),
+                        "car_prob_fit": registry.gauge(
+                            "kccap_car_prob_fit",
+                            "Fraction of usage samples whose capacity "
+                            "fits the watch's replicas.",
+                            ("watch",),
+                        ),
+                        "car_alert_state": registry.gauge(
+                            "kccap_car_alert_state",
+                            "Capacity-at-risk watch alert state "
+                            "(0=ok, 1=recovered, 2=breached).",
+                            ("watch",),
+                        ),
+                        "car_eval": registry.histogram(
+                            "kccap_car_eval_seconds",
+                            "Wall time of one capacity-at-risk watch "
+                            "evaluation (sampling + sweep + reduction).",
+                            ("watch",),
+                        ),
+                    }
+                )
 
     # -- observation -------------------------------------------------------
     def observe(
@@ -298,9 +352,7 @@ class CapacityTimeline:
             )
             transitions: list[tuple[str, WatchAlert]] = []
             for mode, specs in self._mode_groups(snapshot):
-                grid = ScenarioGrid.from_scenarios(
-                    [s.scenario for s in specs]
-                )
+                plain = [s for s in specs if s.quantile is None]
                 # The same implicit hard-taint mask every strict fit
                 # surface applies (None unless the snapshot itself is
                 # strict-packed) — so a timeline capacity equals the fit
@@ -310,30 +362,100 @@ class CapacityTimeline:
                     if mode == "strict"
                     else None
                 )
-                result = explain_snapshot(
-                    snapshot, grid, mode=mode, node_mask=mask
-                )
-                for s_i, spec in enumerate(specs):
-                    total = int(result.totals[s_i])
+                if plain:
+                    grid = ScenarioGrid.from_scenarios(
+                        [s.scenario for s in plain]
+                    )
+                    result = explain_snapshot(
+                        snapshot, grid, mode=mode, node_mask=mask
+                    )
+                    for s_i, spec in enumerate(plain):
+                        total = int(result.totals[s_i])
+                        alert = self._alerts[spec.name]
+                        transition = alert.update(total, record.generation)
+                        if transition is not None:
+                            transitions.append((transition, alert))
+                        record.watches[spec.name] = WatchResult(
+                            name=spec.name,
+                            mode=mode,
+                            total=total,
+                            schedulable=total >= spec.scenario.replicas,
+                            breached=total < (spec.min_replicas or 0),
+                            min_replicas=spec.min_replicas,
+                            binding_counts=result.binding_counts(s_i),
+                            fits=np.asarray(result.fits[s_i], dtype=np.int64),
+                        )
+                for spec in specs:
+                    if spec.quantile is None:
+                        continue
+                    r = self._evaluate_car(snapshot, spec, mode, mask)
                     alert = self._alerts[spec.name]
-                    transition = alert.update(total, record.generation)
+                    transition = alert.update(r.total, record.generation)
                     if transition is not None:
                         transitions.append((transition, alert))
-                    record.watches[spec.name] = WatchResult(
-                        name=spec.name,
-                        mode=mode,
-                        total=total,
-                        schedulable=total >= spec.scenario.replicas,
-                        breached=total < (spec.min_replicas or 0),
-                        min_replicas=spec.min_replicas,
-                        binding_counts=result.binding_counts(s_i),
-                        fits=np.asarray(result.fits[s_i], dtype=np.int64),
-                    )
+                    record.watches[spec.name] = r
             record.eval_ms = (time.perf_counter() - t0) * 1e3
             self._ring.append(record)
             self._publish_metrics(record, prev)
             self._append_log(record, transitions)
             return record
+
+    def _evaluate_car(
+        self, snapshot: ClusterSnapshot, spec: WatchSpec, mode: str, mask
+    ) -> WatchResult:
+        """One capacity-at-risk watch against one generation.
+
+        The Monte Carlo pass rides the production sweep path (grouped /
+        bucketed / cached — seed-deterministic across all of them); the
+        watch's "capacity" is the quantile, and the per-node fits /
+        binding histogram come from explaining the quantile-realizing
+        usage sample, so drift attribution stays node-granular and the
+        quantile total equals that explain's fit sum by construction.
+        """
+        from kubernetesclustercapacity_tpu.stochastic.car import (
+            capacity_at_risk,
+        )
+        from kubernetesclustercapacity_tpu.stochastic.distributions import (
+            StochasticSpec,
+        )
+
+        s_spec = StochasticSpec(
+            cpu=spec.usage_cpu,
+            memory=spec.usage_mem,
+            replicas=spec.scenario.replicas,
+            samples=spec.samples,
+            seed=spec.seed,
+        )
+        res = capacity_at_risk(
+            snapshot,
+            s_spec,
+            mode=mode,
+            node_mask=mask,
+            quantiles=(spec.quantile,),
+            bindings=False,
+        )
+        total = res.quantiles[spec.quantile]
+        q_i = res.quantile_samples[spec.quantile]
+        qgrid = ScenarioGrid(
+            cpu_request_milli=res.samples_cpu[[q_i]],
+            mem_request_bytes=res.samples_mem[[q_i]],
+            replicas=np.array([spec.scenario.replicas], dtype=np.int64),
+        )
+        ex = explain_snapshot(snapshot, qgrid, mode=mode, node_mask=mask)
+        return WatchResult(
+            name=spec.name,
+            mode=mode,
+            total=total,
+            schedulable=total >= spec.scenario.replicas,
+            breached=total < (spec.min_replicas or 0),
+            min_replicas=spec.min_replicas,
+            binding_counts=ex.binding_counts(0),
+            fits=np.asarray(ex.fits[0], dtype=np.int64),
+            quantile=spec.quantile,
+            prob_fit=res.prob_fit,
+            samples=res.n_samples,
+            car_eval_ms=res.eval_ms,
+        )
 
     def _mode_groups(self, snapshot: ClusterSnapshot):
         """Watches grouped by effective kernel mode (one explain pass per
@@ -365,6 +487,18 @@ class CapacityTimeline:
             m["alert_state"].labels(watch=spec.name).set(
                 self._alerts[spec.name].state_code
             )
+            if spec.quantile is not None and "car_replicas" in m:
+                m["car_replicas"].labels(watch=spec.name).set(r.total)
+                if r.prob_fit is not None:
+                    m["car_prob_fit"].labels(watch=spec.name).set(
+                        round(r.prob_fit, 6)
+                    )
+                m["car_alert_state"].labels(watch=spec.name).set(
+                    self._alerts[spec.name].state_code
+                )
+                m["car_eval"].labels(watch=spec.name).observe(
+                    r.car_eval_ms / 1e3
+                )
             before = (
                 prev.watches[spec.name].total
                 if prev is not None and spec.name in prev.watches
@@ -555,13 +689,53 @@ class CapacityTimeline:
             ),
         }
 
+    def car_breached(self) -> list[str]:
+        """Capacity-at-risk watches currently breached — the slice of
+        alert state that flips ``/healthz`` to 503 (a quantile watch
+        breach is a confidence statement: "with 95% confidence fewer
+        than N replicas fit", which a load balancer must see)."""
+        if not self._car_names:
+            return []
+        with self._lock:
+            return sorted(
+                n
+                for n, a in self._alerts.items()
+                if n in self._car_names and a.state == "breached"
+            )
+
+    def car_status(self) -> dict:
+        """Per-CaR-watch status (the ``car`` op's watch view / the
+        doctor's "capacity at risk" line): last quantile capacity,
+        probability-of-fit, sample count, alert state."""
+        with self._lock:
+            last = self._ring[-1] if self._ring else None
+            out: dict[str, dict] = {}
+            for spec in self.watches:
+                if spec.quantile is None:
+                    continue
+                r = last.watches.get(spec.name) if last else None
+                out[spec.name] = {
+                    "quantile": spec.quantile,
+                    "min_replicas": spec.min_replicas,
+                    "last_total": r.total if r else None,
+                    "prob_fit": (
+                        round(r.prob_fit, 6)
+                        if r and r.prob_fit is not None
+                        else None
+                    ),
+                    "samples": r.samples if r else 0,
+                    "seed": spec.seed,
+                    "alert": self._alerts[spec.name].to_wire(),
+                }
+            return out
+
     def stats(self) -> dict:
         """Compact health view (doctor / ``/healthz``)."""
         with self._lock:
             count = len(self._ring)
             last = self._ring[-1] if self._ring else None
             alerts = {n: a.state for n, a in self._alerts.items()}
-        return {
+        out = {
             "records": count,
             "depth": self.depth,
             "generation": last.generation if last else 0,
@@ -572,6 +746,15 @@ class CapacityTimeline:
             ),
             "last_eval_ms": round(last.eval_ms, 3) if last else None,
         }
+        if self._car_names:
+            # Present only when quantile watches exist, so a plain
+            # timeline's stats shape stays byte-identical to pre-CaR.
+            out["car_breached"] = sorted(
+                n
+                for n, s in alerts.items()
+                if n in self._car_names and s == "breached"
+            )
+        return out
 
     def close(self) -> None:
         if self._log is not None:

@@ -21,6 +21,27 @@ the exact reference codecs, so a watch capacity is bit-identical to the
 mode for that watch (default: the served snapshot's own packing mode);
 ``min_replicas`` arms the ok → breached → recovered alert machine
 (absent = the watch is observed but never alerts).
+
+**Capacity-at-risk watches**: a ``quantile`` field turns a watch
+stochastic — "alert when P95 capacity < N"::
+
+    watches:
+      - name: web-p95
+        pod: {cpuRequests: 500m, memRequests: 1gb, replicas: "40"}
+        quantile: 0.95          # capacity at 95% confidence
+        usage:                  # per-pod usage distributions
+          cpu: {dist: normal, mean: 500m, std: 150m}
+          # memory defaults to a point at the pod's memRequests
+        samples: 128            # optional Monte Carlo draw count
+        seed: 7                 # optional; explicit, never wall-clock
+        min_replicas: 30
+
+``quantile`` must lie strictly inside ``(0, 1)`` and REQUIRES a
+``usage`` block with at least one non-degenerate distribution — a
+point-distribution watch has no usage uncertainty, so every quantile
+would silently equal the plain fit (rejected with a clear error rather
+than reported as a lie).  A resource omitted from ``usage`` defaults
+to a point distribution at the pod's own request.
 """
 
 from __future__ import annotations
@@ -33,8 +54,15 @@ from kubernetesclustercapacity_tpu.scenario import (
     ScenarioError,
     scenario_from_flags,
 )
+from kubernetesclustercapacity_tpu.stochastic.distributions import (
+    DistributionError,
+    UsageDistribution,
+    parse_distribution,
+)
 
 __all__ = ["WatchError", "WatchSpec", "load_watchlist", "parse_watchlist"]
+
+_MAX_WATCH_SAMPLES = 1 << 14
 
 # The reference's five flag spellings, the only keys a pod block accepts —
 # an unknown key is a typo'd watch that would silently evaluate defaults.
@@ -51,16 +79,27 @@ class WatchError(ValueError):
 
 @dataclass(frozen=True)
 class WatchSpec:
-    """One named scenario: what to evaluate, how, and when to alert."""
+    """One named scenario: what to evaluate, how, and when to alert.
+
+    ``quantile`` (with its ``usage`` distributions) makes the watch a
+    capacity-at-risk watch: its evaluated "capacity" is the Monte Carlo
+    capacity quantile, and ``min_replicas`` breaches against THAT
+    ("alert when P95 capacity < N").
+    """
 
     name: str
     scenario: Scenario
     mode: str | None = None  # None = the served snapshot's semantics
     min_replicas: int | None = None
+    quantile: float | None = None
+    usage_cpu: UsageDistribution | None = None
+    usage_mem: UsageDistribution | None = None
+    samples: int = 0  # 0 = the process default (KCCAP_CAR_SAMPLES/64)
+    seed: int = 0
 
     def to_wire(self) -> dict:
         """JSON-able description (rides the ``timeline`` op)."""
-        return {
+        out = {
             "name": self.name,
             "cpu_request_milli": self.scenario.cpu_request_milli,
             "mem_request_bytes": self.scenario.mem_request_bytes,
@@ -68,6 +107,15 @@ class WatchSpec:
             "mode": self.mode,
             "min_replicas": self.min_replicas,
         }
+        if self.quantile is not None:
+            out["quantile"] = self.quantile
+            out["samples"] = self.samples
+            out["seed"] = self.seed
+            out["usage"] = {
+                "cpu": self.usage_cpu.to_wire(),
+                "memory": self.usage_mem.to_wire(),
+            }
+        return out
 
 
 def _parse_entry(i: int, entry) -> WatchSpec:
@@ -109,14 +157,107 @@ def _parse_entry(i: int, entry) -> WatchSpec:
             raise WatchError(
                 f"watch {name!r}: min_replicas must be >= 0"
             )
-    extra = set(entry) - {"name", "pod", "semantics", "min_replicas"}
+    extra = set(entry) - {
+        "name", "pod", "semantics", "min_replicas",
+        "quantile", "usage", "samples", "seed",
+    }
     if extra:
         raise WatchError(
             f"watch {name!r}: unknown field(s) {sorted(extra)}"
         )
-    return WatchSpec(
-        name=name, scenario=scenario, mode=mode, min_replicas=min_replicas
+    quantile, usage_cpu, usage_mem, samples, seed = _parse_stochastic_fields(
+        name, entry, scenario
     )
+    return WatchSpec(
+        name=name, scenario=scenario, mode=mode, min_replicas=min_replicas,
+        quantile=quantile, usage_cpu=usage_cpu, usage_mem=usage_mem,
+        samples=samples, seed=seed,
+    )
+
+
+def _parse_stochastic_fields(name: str, entry: dict, scenario: Scenario):
+    """The capacity-at-risk grammar of one watch entry: ``quantile``
+    (strictly inside (0, 1)), ``usage`` distributions (missing
+    resources default to a point at the pod's own request), ``samples``
+    and ``seed``.  Hard rejections — quantile without usage, usage
+    without quantile, out-of-range quantiles, all-point usage — each
+    with an error naming the watch, so a typo'd watch never silently
+    evaluates as something else."""
+    quantile = entry.get("quantile")
+    usage = entry.get("usage")
+    if quantile is None:
+        for field in ("usage", "samples", "seed"):
+            if field in entry:
+                raise WatchError(
+                    f"watch {name!r}: '{field}' requires a 'quantile' "
+                    "(only capacity-at-risk watches sample usage)"
+                )
+        return None, None, None, 0, 0
+    if isinstance(quantile, bool) or not isinstance(quantile, (int, float)):
+        raise WatchError(
+            f"watch {name!r}: quantile must be a number in (0, 1), "
+            f"got {quantile!r}"
+        )
+    quantile = float(quantile)
+    if not 0.0 < quantile < 1.0:
+        raise WatchError(
+            f"watch {name!r}: quantile must be strictly inside (0, 1), "
+            f"got {quantile:g}"
+        )
+    if usage is None:
+        raise WatchError(
+            f"watch {name!r}: quantile needs a 'usage' distribution "
+            "block — a point-request watch has no usage uncertainty, so "
+            "every quantile would equal the plain fit"
+        )
+    if not isinstance(usage, dict):
+        raise WatchError(f"watch {name!r}: 'usage' must be a mapping")
+    extra = set(usage) - {"cpu", "memory"}
+    if extra:
+        raise WatchError(
+            f"watch {name!r}: unknown usage resource(s) {sorted(extra)} "
+            "(want cpu/memory)"
+        )
+    from kubernetesclustercapacity_tpu.utils.quantity import int64_bits
+
+    try:
+        # Defaults are a point at the pod's own request, on the kernel's
+        # int64 carrier (wrapped uint64 cpu requests keep the reference
+        # meaning: a huge divisor that fits 0 everywhere).
+        usage_cpu = (
+            parse_distribution("cpu", usage["cpu"])
+            if "cpu" in usage
+            else UsageDistribution(
+                kind="point", value=int64_bits(scenario.cpu_request_milli)
+            )
+        )
+        usage_mem = (
+            parse_distribution("memory", usage["memory"])
+            if "memory" in usage
+            else UsageDistribution(
+                kind="point", value=scenario.mem_request_bytes
+            )
+        )
+    except DistributionError as e:
+        raise WatchError(f"watch {name!r}: {e}") from e
+    if usage_cpu.degenerate and usage_mem.degenerate:
+        raise WatchError(
+            f"watch {name!r}: every usage distribution is a point — the "
+            f"P{quantile * 100:g} capacity would always equal the plain "
+            "fit; drop 'quantile' or give cpu/memory real spread"
+        )
+    samples = entry.get("samples", 0)
+    if isinstance(samples, bool) or not isinstance(samples, int):
+        raise WatchError(f"watch {name!r}: samples must be an integer")
+    if samples and not 2 <= samples <= _MAX_WATCH_SAMPLES:
+        raise WatchError(
+            f"watch {name!r}: samples must be in "
+            f"[2, {_MAX_WATCH_SAMPLES}], got {samples}"
+        )
+    seed = entry.get("seed", 0)
+    if isinstance(seed, bool) or not isinstance(seed, int):
+        raise WatchError(f"watch {name!r}: seed must be an integer")
+    return quantile, usage_cpu, usage_mem, samples, seed
 
 
 def parse_watchlist(data) -> tuple[WatchSpec, ...]:

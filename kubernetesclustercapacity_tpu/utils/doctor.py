@@ -322,6 +322,50 @@ def doctor_report(
 
         check("capacity timeline", _timeline)
 
+        # The service's capacity-at-risk watches: the last quantile
+        # capacities and their alert states.  A breached quantile watch
+        # is a hard FAILED line — it is a standing confidence statement
+        # ("with 95% confidence fewer than N replicas fit") that the
+        # cluster no longer meets, the stochastic analog of a breached
+        # SLO.  Same short budgets; separate connection so a car-op
+        # failure cannot contaminate the timeline line above.
+        def _car():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                status = c.car()
+            if not status.get("enabled", False):
+                return "not configured (no quantile: watches in -watch)"
+            parts = []
+            for name in sorted(status.get("watches", {})):
+                w = status["watches"][name]
+                parts.append(
+                    f"{name}=p{w['quantile'] * 100:g}:"
+                    f"{w.get('last_total')}"
+                    f"(pfit={w.get('prob_fit')},"
+                    f"{w['alert']['state']})"
+                )
+            breached = status.get("breached", [])
+            if breached:
+                return (
+                    "FAILED: capacity-at-risk breach — "
+                    + ", ".join(breached)
+                    + " below min_replicas at their quantile; "
+                    + " ".join(parts)
+                )
+            return "ok: " + " ".join(parts)
+
+        check("capacity at risk", _car)
+
         # The service's audit log + shadow oracle: is correctness being
         # continuously observed, and has it ever been caught lying?  A
         # recorded divergence is a hard FAILED line — it means a served

@@ -1,0 +1,151 @@
+"""The audit-log empirical feed: per-pod usage extracted from recorded
+generations, and its robustness contract — zero usage records or a
+torn-tail-only log yields a typed InsufficientHistoryError (never an
+empty-array crash, never a silent point fallback)."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.audit import AuditError, AuditLog
+from kubernetesclustercapacity_tpu.audit.log import AuditReader
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+from kubernetesclustercapacity_tpu.stochastic import (
+    InsufficientHistoryError,
+    capacity_at_risk,
+    extract_usage_history,
+    parse_stochastic_spec,
+)
+
+
+def _record_generations(directory, snaps):
+    with AuditLog(directory) as log:
+        for gen, snap in enumerate(snaps, start=1):
+            log.record_generation(snap, gen)
+
+
+class TestExtraction:
+    def test_observed_usage_becomes_an_empirical_distribution(self, tmp_path):
+        snaps = [synthetic_snapshot(30, seed=s) for s in range(3)]
+        d = str(tmp_path / "audit")
+        _record_generations(d, snaps)
+        history = extract_usage_history(d, "cpu")
+        # Pod-weighted observations: every (node, generation) with pods
+        # contributes pods_count observations of used // pods.
+        want = {}
+        total = 0
+        for snap in snaps:
+            used = np.asarray(snap.used_cpu_req_milli)
+            pods = np.asarray(snap.pods_count)
+            for u, p in zip(used, pods):
+                if p > 0 and u > 0 and (u // p) >= 1:
+                    want[int(u // p)] = want.get(int(u // p), 0) + int(p)
+                    total += int(p)
+        assert history.observations == total
+        assert history.generations == 3
+        got = dict(zip(history.values.tolist(), history.weights.tolist()))
+        assert got == {k: float(v) for k, v in want.items()}
+        # The distribution is consumable by the CaR engine end to end.
+        emp = history.distribution()
+        assert not emp.degenerate
+        spec = parse_stochastic_spec({
+            "usage": {"cpu": emp.to_wire(), "memory": "1gb"},
+            "replicas": 10, "samples": 16,
+        })
+        r = capacity_at_risk(synthetic_snapshot(20, seed=9), spec)
+        assert set(np.unique(r.samples_cpu)) <= set(
+            history.values.tolist()
+        )
+
+    def test_memory_resource_and_reader_reuse(self, tmp_path):
+        d = str(tmp_path / "audit")
+        _record_generations(d, [synthetic_snapshot(20, seed=1)])
+        reader = AuditReader.load(d)
+        h = extract_usage_history(reader, "memory")
+        assert h.resource == "memory" and h.observations > 0
+        with pytest.raises(ValueError, match="resource"):
+            extract_usage_history(reader, "gpu")
+
+    def test_wrapped_and_zero_usage_rows_excluded(self, tmp_path):
+        snap = synthetic_snapshot(10, seed=4)
+        used = np.asarray(snap.used_cpu_req_milli).copy()
+        pods = np.asarray(snap.pods_count).copy()
+        used[0], pods[0] = np.int64(-5), 3  # wrapped carrier: excluded
+        used[1], pods[1] = 0, 4  # zero usage: excluded
+        used[2], pods[2] = 100, 0  # no pods: excluded
+        snap = dataclasses.replace(
+            snap, used_cpu_req_milli=used, pods_count=pods
+        )
+        d = str(tmp_path / "audit")
+        _record_generations(d, [snap])
+        h = extract_usage_history(d, "cpu", min_observations=1)
+        assert (h.values >= 1).all()
+        # None of the excluded rows' values leaked in.
+        assert int(used[2]) // 1 not in (
+            h.values.tolist() if pods[2] == 0 else []
+        )
+
+
+class TestInsufficientHistory:
+    def test_missing_and_empty_directories_are_typed(self, tmp_path):
+        with pytest.raises(InsufficientHistoryError):
+            extract_usage_history(str(tmp_path / "nope"))
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        with pytest.raises(InsufficientHistoryError) as ei:
+            extract_usage_history(str(empty))
+        assert "no audit segments" in str(ei.value)
+
+    def test_torn_tail_only_segment_is_typed(self, tmp_path):
+        d = tmp_path / "audit"
+        d.mkdir()
+        # A segment holding ONLY an unterminated (torn) record: the
+        # crash-tolerant loader recovers it to zero records, and the
+        # extractor reports that as insufficient history, not a crash.
+        (d / "audit-000001.jsonl").write_text(
+            json.dumps({"kind": "checkpoint", "generation": 1})[:20]
+        )
+        with pytest.raises(InsufficientHistoryError) as ei:
+            extract_usage_history(str(d))
+        assert ei.value.generations == 0
+        assert "torn tail" in str(ei.value) or "no generation" in str(
+            ei.value
+        )
+
+    def test_zero_usage_generations_are_typed_with_counts(self, tmp_path):
+        snap = synthetic_snapshot(6, seed=2)
+        idle = dataclasses.replace(
+            snap,
+            used_cpu_req_milli=np.zeros(6, dtype=np.int64),
+            pods_count=np.zeros(6, dtype=np.int64),
+        )
+        d = str(tmp_path / "audit")
+        _record_generations(d, [idle, idle])
+        with pytest.raises(InsufficientHistoryError) as ei:
+            extract_usage_history(d, "cpu")
+        assert ei.value.observations == 0 and ei.value.generations == 2
+        assert "0 pod-usage observation" in str(ei.value)
+
+    def test_min_observations_threshold(self, tmp_path):
+        d = str(tmp_path / "audit")
+        _record_generations(d, [synthetic_snapshot(4, seed=3)])
+        h = extract_usage_history(d, "cpu", min_observations=1)
+        with pytest.raises(InsufficientHistoryError):
+            extract_usage_history(
+                d, "cpu", min_observations=h.observations + 1
+            )
+
+    def test_mid_file_corruption_stays_a_hard_audit_error(self, tmp_path):
+        d = str(tmp_path / "audit")
+        _record_generations(
+            d, [synthetic_snapshot(8, seed=s) for s in range(2)]
+        )
+        seg = os.path.join(d, sorted(os.listdir(d))[0])
+        with open(seg, "r+", encoding="utf-8") as fh:
+            fh.seek(10)
+            fh.write("\x00\x00garbage")
+        with pytest.raises(AuditError):
+            extract_usage_history(d, "cpu")

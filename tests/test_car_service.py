@@ -1,0 +1,387 @@
+"""Capacity-at-risk service wiring: the `car` op, quantile watches, and
+the full alert funnel — WatchAlert → kccap_car_* gauges → /healthz 503
+→ doctor FAILED → `kccap -car` exit 1 (the acceptance scenario)."""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.cli import main as cli_main
+from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+from kubernetesclustercapacity_tpu.service import (
+    CapacityClient,
+    CapacityServer,
+)
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+from kubernetesclustercapacity_tpu.stochastic import capacity_at_risk
+from kubernetesclustercapacity_tpu.stochastic.distributions import (
+    parse_stochastic_spec,
+)
+from kubernetesclustercapacity_tpu.telemetry.metrics import MetricsRegistry
+from kubernetesclustercapacity_tpu.timeline import CapacityTimeline
+from kubernetesclustercapacity_tpu.timeline.watchlist import parse_watchlist
+
+USAGE = {
+    "cpu": {"dist": "normal", "mean": "500m", "std": "200m"},
+    "memory": {"dist": "lognormal", "mean": "1gb", "sigma": 0.4},
+}
+
+CAR_WATCHLIST = {
+    "watches": [
+        {
+            "name": "web-p95",
+            "pod": {
+                "cpuRequests": "500m",
+                "memRequests": "1gb",
+                "replicas": "40",
+            },
+            "quantile": 0.95,
+            "usage": {"cpu": USAGE["cpu"]},
+            "samples": 32,
+            "seed": 3,
+            "min_replicas": 150,
+        },
+        {
+            "name": "plain",
+            "pod": {"cpuRequests": "2", "memRequests": "4gb"},
+            "min_replicas": 1,
+        },
+    ]
+}
+
+
+def _starve(snap, factor=50):
+    return dataclasses.replace(
+        snap,
+        alloc_cpu_milli=(
+            np.asarray(snap.alloc_cpu_milli) // factor
+        ).astype(np.int64),
+    )
+
+
+class TestCarOp:
+    @pytest.fixture()
+    def server(self):
+        snap = synthetic_snapshot(40, seed=6)
+        srv = CapacityServer(snap, port=0)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as client:
+                yield srv, client, snap
+        finally:
+            srv.shutdown()
+
+    def test_evaluate_matches_offline_engine(self, server):
+        _, client, snap = server
+        wire = client.car(usage=USAGE, replicas=40, samples=48, seed=11)
+        offline = capacity_at_risk(
+            snap,
+            parse_stochastic_spec(
+                {"usage": USAGE, "replicas": 40, "samples": 48, "seed": 11}
+            ),
+            mode=snap.semantics,
+            node_mask=implicit_taint_mask(snap),
+        )
+        assert wire["quantiles"] == {
+            k: int(v) for k, v in offline.to_wire()["quantiles"].items()
+        }
+        assert wire["prob_fit"] == offline.to_wire()["prob_fit"]
+        assert wire["samples"] == 48 and wire["seed"] == 11
+        # Seed-deterministic over the wire: a repeat call re-draws the
+        # identical samples (the idempotent-retry contract).
+        again = client.car(usage=USAGE, replicas=40, samples=48, seed=11)
+        assert again["quantiles"] == wire["quantiles"]
+        assert again["mean"] == wire["mean"]
+
+    def test_custom_quantiles_and_binding(self, server):
+        _, client, _ = server
+        wire = client.car(
+            usage=USAGE, replicas=10, samples=32, seed=1,
+            quantiles=[0.5, 0.975],
+        )
+        assert set(wire["quantiles"]) == {"p50", "p97.5"}
+        assert set(wire["binding"]) == {"p50", "p97.5"}
+        # Attribution histograms count every node exactly once.
+        n = 40
+        for counts in wire["binding"].values():
+            assert sum(counts.values()) == n
+
+    def test_rendered_reports(self, server):
+        _, client, _ = server
+        out = client.car(usage=USAGE, samples=16, output="table")
+        assert out["report"].startswith("capacity at risk")
+        out = client.car(usage=USAGE, samples=16, output="json")
+        assert json.loads(out["report"])["samples"] == 16
+
+    @pytest.mark.parametrize(
+        "params, fragment",
+        [
+            ({"usage": {"cpu": "1"}}, "both"),
+            ({"usage": USAGE, "quantiles": []}, "non-empty"),
+            ({"usage": USAGE, "quantiles": [1.5]}, "(0, 1)"),
+            ({"usage": USAGE, "samples": 1}, "samples"),
+        ],
+    )
+    def test_bad_requests_error_cleanly(self, server, params, fragment):
+        _, client, _ = server
+        with pytest.raises(RuntimeError) as ei:
+            client.car(**params)
+        assert fragment in str(ei.value)
+
+    def test_status_form_disabled_without_quantile_watches(self, server):
+        _, client, _ = server
+        s = client.car()
+        assert s == {"enabled": False, "watches": {}, "breached": []}
+
+
+class TestCarFunnel:
+    """The acceptance chain, end to end on one stack."""
+
+    @pytest.fixture()
+    def stack(self):
+        reg = MetricsRegistry()
+        tl = CapacityTimeline(
+            parse_watchlist(CAR_WATCHLIST), depth=8, registry=reg
+        )
+        base = synthetic_snapshot(40, seed=6)
+        srv = CapacityServer(base, port=0, timeline=tl, registry=reg)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as client:
+                yield srv, client, base, reg, tl
+        finally:
+            srv.shutdown()
+            tl.close()
+
+    def test_breach_drives_every_surface(self, stack):
+        from kubernetesclustercapacity_tpu.telemetry.exposition import (
+            start_metrics_server,
+        )
+        from kubernetesclustercapacity_tpu.utils.doctor import doctor_report
+
+        srv, client, base, reg, tl = stack
+
+        # Healthy first: status ok, gauges populated, CLI exits 0.
+        status = client.car()
+        assert status["enabled"] is True
+        assert status["breached"] == []
+        w = status["watches"]["web-p95"]
+        assert w["quantile"] == 0.95 and w["samples"] == 32
+        assert w["last_total"] > 150
+        s = reg.snapshot()
+        assert (
+            s["kccap_car_replicas"]["values"]['watch="web-p95"']
+            == w["last_total"]
+        )
+        assert (
+            s["kccap_car_alert_state"]["values"]['watch="web-p95"'] == 0
+        )
+        host, port = srv.address
+        assert cli_main(["-car", f"{host}:{port}"]) == 0
+
+        # Starve the cluster: P95 capacity dips under min_replicas.
+        srv.replace_snapshot(_starve(base), warm=True)
+
+        # 1. WatchAlert machine breached (and the plain watch's alert
+        # state is irrelevant to the CaR slice).
+        assert tl.alerts()["web-p95"]["state"] == "breached"
+        assert tl.car_breached() == ["web-p95"]
+
+        # 2. kccap_car_* gauges moved.
+        s = reg.snapshot()
+        assert (
+            s["kccap_car_alert_state"]["values"]['watch="web-p95"'] == 2
+        )
+        assert (
+            s["kccap_car_replicas"]["values"]['watch="web-p95"'] < 150
+        )
+        assert s["kccap_car_prob_fit"]["values"]['watch="web-p95"'] <= 1.0
+        assert s["kccap_watch_breaches_total"]["values"][
+            'watch="web-p95"'
+        ] == 1
+
+        # 3. /healthz 503 — the same healthy/status wiring server.main
+        # installs (CaR breaches flip overall health; plain watch
+        # breaches stay advisory).
+        ms = start_metrics_server(
+            reg,
+            healthy=lambda: not tl.car_breached(),
+            status=lambda: {"timeline": tl.stats()},
+        )
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(ms.url + "/healthz")
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["ok"] is False
+            assert body["timeline"]["car_breached"] == ["web-p95"]
+        finally:
+            ms.shutdown()
+
+        # 4. doctor: hard FAILED line (exit-code relevant).
+        checks = dict(
+            doctor_report(
+                backend_timeout_s=30.0,
+                probe_code="print('DEVICES 0.0s cpu x1')",
+                service_addr=srv.address,
+            )
+        )
+        line = checks["capacity at risk"]
+        assert line.startswith("FAILED")
+        assert "web-p95" in line
+
+        # 5. `kccap -car HOST:PORT` exit 1 while breached.
+        assert cli_main(["-car", f"{host}:{port}"]) == 1
+
+        # Recovery: restore capacity; state is recovered (sticky),
+        # healthz healthy again, CLI back to 0.
+        srv.replace_snapshot(base, warm=True)
+        assert tl.alerts()["web-p95"]["state"] == "recovered"
+        assert tl.car_breached() == []
+        assert cli_main(["-car", f"{host}:{port}"]) == 0
+        checks = dict(
+            doctor_report(
+                backend_timeout_s=30.0,
+                probe_code="print('DEVICES 0.0s cpu x1')",
+                service_addr=srv.address,
+            )
+        )
+        assert checks["capacity at risk"].startswith("ok:")
+
+    def test_watch_total_is_the_quantile_fit(self, stack):
+        """A CaR watch capacity equals the fit of the quantile-realizing
+        sample — the record stays node-granular and attributable."""
+        _, client, base, _, tl = stack
+        rec = tl.records()[-1]
+        w = rec.watches["web-p95"]
+        assert w.total == int(w.fits.sum())
+        assert w.quantile == 0.95
+        assert 0.0 <= w.prob_fit <= 1.0
+        # And the wire carries the CaR fields.
+        t = client.timeline()
+        wt = t["records"][-1]["watches"]["web-p95"]
+        assert wt["quantile"] == 0.95 and wt["samples"] == 32
+
+    def test_timeline_stats_car_section_only_with_quantile_watches(self):
+        tl = CapacityTimeline(
+            parse_watchlist(
+                {"watches": [{"name": "p", "pod": {"cpuRequests": "1"}}]}
+            ),
+            depth=4,
+        )
+        assert "car_breached" not in tl.stats()
+        assert tl.car_breached() == [] and tl.car_status() == {}
+
+    def test_telemetry_off_keeps_observe_registry_silent(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        reg = MetricsRegistry()
+        tl = CapacityTimeline(
+            parse_watchlist(CAR_WATCHLIST), depth=4, registry=reg
+        )
+        tl.observe(synthetic_snapshot(12, seed=2), 1)
+        assert reg.snapshot() == {}
+
+
+class TestCarCLI:
+    def _spec_file(self, tmp_path, **overrides):
+        spec = {
+            "usage": USAGE,
+            "replicas": 40,
+            "samples": 32,
+            "seed": 5,
+            "confidence": 0.9,
+            **overrides,
+        }
+        p = tmp_path / "spec.json"
+        p.write_text(json.dumps(spec))
+        return str(p)
+
+    def _snapshot_file(self, tmp_path, n=40):
+        snap = synthetic_snapshot(n, seed=6)
+        path = tmp_path / "snap.npz"
+        snap.save(str(path))
+        return str(path), snap
+
+    def test_car_spec_offline_table_and_exit_codes(
+        self, tmp_path, capsys
+    ):
+        snap_path, snap = self._snapshot_file(tmp_path)
+        spec_path = self._spec_file(tmp_path)
+        rc = cli_main(["-snapshot", snap_path, "-car-spec", spec_path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.startswith("capacity at risk")
+        assert "p95" in out and "verdict: SCHEDULABLE" in out
+        # An unsatisfiable spec exits 1 by its own confidence bar.
+        rc = cli_main([
+            "-snapshot", snap_path,
+            "-car-spec", self._spec_file(tmp_path, replicas=10 ** 9),
+        ])
+        assert rc == 1
+        assert "NOT SCHEDULABLE" in capsys.readouterr().out
+
+    def test_car_spec_json_matches_library(self, tmp_path, capsys):
+        snap_path, snap = self._snapshot_file(tmp_path)
+        spec_path = self._spec_file(tmp_path)
+        rc = cli_main([
+            "-snapshot", snap_path, "-car-spec", spec_path,
+            "-output", "json",
+        ])
+        assert rc == 0
+        got = json.loads(capsys.readouterr().out)
+        want = capacity_at_risk(
+            snap,
+            parse_stochastic_spec(json.loads(open(spec_path).read())),
+            node_mask=implicit_taint_mask(snap),
+        ).to_wire()
+        assert got["quantiles"] == want["quantiles"]
+        assert got["prob_fit"] == want["prob_fit"]
+
+    def test_car_spec_overrides_and_errors(self, tmp_path, capsys):
+        snap_path, snap = self._snapshot_file(tmp_path)
+        spec_path = self._spec_file(tmp_path)
+        rc = cli_main([
+            "-snapshot", snap_path, "-car-spec", spec_path,
+            "-car-samples", "16", "-car-seed", "77", "-output", "json",
+        ])
+        assert rc == 0
+        got = json.loads(capsys.readouterr().out)
+        assert got["samples"] == 16 and got["seed"] == 77
+        # Bad spec file: clean ERROR, exit 1, no traceback.
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"usage": {"cpu": "1"}}))
+        rc = cli_main(["-snapshot", snap_path, "-car-spec", str(bad)])
+        assert rc == 1
+        assert "ERROR" in capsys.readouterr().out
+        rc = cli_main([
+            "-snapshot", snap_path, "-car-spec", spec_path,
+            "-car-samples", "1",
+        ])
+        assert rc == 1
+        # Non-TPU backends are fit-only cross-checks.
+        rc = cli_main([
+            "-snapshot", snap_path, "-car-spec", spec_path,
+            "-backend", "cpu",
+        ])
+        assert rc == 1
+
+    def test_car_status_cli_not_configured_and_bad_addr(self, capsys):
+        assert cli_main(["-car", "nonsense"]) == 1
+        snap = synthetic_snapshot(8, seed=0)
+        srv = CapacityServer(snap, port=0)
+        srv.start()
+        try:
+            host, port = srv.address
+            rc = cli_main(["-car", f"{host}:{port}"])
+            out = capsys.readouterr().out
+            assert rc == 1  # no quantile watches = scriptable failure
+            assert "no quantile watches" in out
+            rc = cli_main(["-car", f"{host}:{port}", "-output", "json"])
+            assert rc == 1
+            assert json.loads(capsys.readouterr().out)["enabled"] is False
+        finally:
+            srv.shutdown()

@@ -1,0 +1,487 @@
+"""Stochastic capacity: distribution grammar, the deterministic
+sampler, and the capacity-at-risk engine.
+
+The two load-bearing properties, each pinned here:
+
+* **seed-replay oracle parity** — 200+ randomized trials: the kernel
+  path's quantiles/totals are bit-identical to an independent oracle
+  that re-draws the same seeds and evaluates every sample through the
+  sequential bug-compatible ``fit_arrays_python`` walk, reducing with
+  its own implementation of the documented quantile rule — in BOTH
+  semantics modes, with unhealthy nodes, node masks, and the Q1
+  pod-cap overwrite in play;
+* **deterministic dispatch** — the same seed yields bit-identical
+  quantiles across grouped vs ungrouped (``KCCAP_GROUPING=0``) and
+  bucketed vs unbucketed (``KCCAP_DEVCACHE=0``) dispatch, in both
+  modes.
+"""
+
+import dataclasses
+import json
+import math
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+from kubernetesclustercapacity_tpu.snapshot import (
+    ClusterSnapshot,
+    synthetic_snapshot,
+)
+from kubernetesclustercapacity_tpu.stochastic import (
+    CaRResult,
+    DistributionError,
+    StochasticSpec,
+    UsageDistribution,
+    capacity_at_risk,
+    car_oracle,
+    default_samples,
+    load_stochastic_spec,
+    parse_distribution,
+    parse_stochastic_spec,
+    quantile_index,
+    quantile_label,
+    sample_key,
+    sample_usage,
+)
+from kubernetesclustercapacity_tpu.stochastic.distributions import MAX_USAGE
+from kubernetesclustercapacity_tpu.stochastic.car import fit_totals_numpy
+
+
+class TestDistributionGrammar:
+    def test_kinds_parse_and_quantity_codecs(self):
+        d = parse_distribution("cpu", {"dist": "normal", "mean": "500m",
+                                       "std": "150m"})
+        assert (d.kind, d.mean, d.std) == ("normal", 500.0, 150.0)
+        d = parse_distribution("memory", {"dist": "lognormal", "mean": "1gb",
+                                          "sigma": 0.4})
+        assert d.mean == float(1 << 30) and d.sigma == 0.4
+        d = parse_distribution("cpu", {"dist": "point", "value": 250})
+        assert d.value == 250 and d.degenerate
+        d = parse_distribution(
+            "cpu",
+            {"dist": "empirical", "values": ["100m", 300], "weights": [3, 1]},
+        )
+        assert d.values == (100, 300) and d.weights == (3.0, 1.0)
+
+    def test_bare_quantity_is_point_shorthand(self):
+        assert parse_distribution("memory", "1gb").value == 1 << 30
+        assert parse_distribution("cpu", 750).value == 750
+
+    @pytest.mark.parametrize(
+        "resource, data, fragment",
+        [
+            ("cpu", {"dist": "gauss"}, "dist must be one of"),
+            ("cpu", {"dist": "normal"}, "needs 'mean'"),
+            ("cpu", {"dist": "normal", "mean": "500m", "sigma": 1},
+             "unknown field"),
+            ("cpu", {"dist": "normal", "mean": "junk!", "std": 1},
+             "bad cpu quantity"),
+            ("memory", {"dist": "point", "value": "12wat"},
+             "bad memory quantity"),
+            ("cpu", {"dist": "point", "value": 0}, "[1, 2^62]"),
+            ("cpu", {"dist": "point", "value": -5}, "[1, 2^62]"),
+            ("cpu", {"dist": "normal", "mean": 100, "std": -1}, ">= 0"),
+            ("cpu", {"dist": "lognormal", "mean": 100, "sigma": 9}, "<= 4"),
+            ("cpu", {"dist": "empirical", "values": []}, "non-empty"),
+            ("cpu", {"dist": "empirical", "values": [1, 2],
+                     "weights": [1]}, "length"),
+            ("cpu", {"dist": "empirical", "values": [1, 2],
+                     "weights": [1, 0]}, "> 0"),
+            ("cpu", 3.5, "mapping"),
+            ("cpu", [1], "mapping"),
+        ],
+    )
+    def test_malformed_rejected(self, resource, data, fragment):
+        with pytest.raises(DistributionError) as ei:
+            parse_distribution(resource, data)
+        assert fragment in str(ei.value)
+
+    def test_degenerate_detection(self):
+        assert parse_distribution(
+            "cpu", {"dist": "normal", "mean": 100, "std": 0}
+        ).degenerate
+        assert not parse_distribution(
+            "cpu", {"dist": "normal", "mean": 100, "std": 1}
+        ).degenerate
+        assert parse_distribution(
+            "cpu", {"dist": "empirical", "values": [5, 5]}
+        ).degenerate
+        assert parse_distribution(
+            "cpu", {"dist": "lognormal", "mean": 100, "sigma": 0}
+        ).degenerate
+
+    def test_spec_parses_and_validates(self):
+        spec = parse_stochastic_spec(
+            {
+                "usage": {"cpu": "500m", "memory": "1gb"},
+                "replicas": "40",
+                "samples": 16,
+                "seed": 3,
+                "confidence": 0.9,
+            }
+        )
+        assert spec.replicas == 40 and spec.samples == 16
+        assert spec.seed == 3 and spec.confidence == 0.9
+        for doc, fragment in [
+            ({}, "usage"),
+            ({"usage": {"cpu": "1"}}, "both"),
+            ({"usage": {"cpu": "1", "memory": "1gb", "gpu": 1}},
+             "unknown resource"),
+            ({"usage": {"cpu": "1", "memory": "1gb"}, "samples": 1},
+             "samples"),
+            ({"usage": {"cpu": "1", "memory": "1gb"}, "confidence": 1.0},
+             "confidence"),
+            ({"usage": {"cpu": "1", "memory": "1gb"}, "replicas": "x"},
+             "replicas"),
+            ({"usage": {"cpu": "1", "memory": "1gb"}, "extra": 1},
+             "unknown field"),
+        ]:
+            with pytest.raises(DistributionError) as ei:
+                parse_stochastic_spec(doc)
+            assert fragment in str(ei.value)
+
+    def test_spec_file_round_trip(self, tmp_path):
+        p = tmp_path / "spec.json"
+        p.write_text(json.dumps({
+            "usage": {
+                "cpu": {"dist": "normal", "mean": "500m", "std": "100m"},
+                "memory": "1gb",
+            },
+            "replicas": 25,
+            "seed": 9,
+        }))
+        spec = load_stochastic_spec(str(p))
+        assert spec.cpu.kind == "normal" and spec.memory.value == 1 << 30
+        assert spec.replicas == 25 and spec.seed == 9
+        wire = spec.to_wire()
+        # The wire echo re-parses to the same spec (round trip).
+        again = parse_stochastic_spec(
+            {k: v for k, v in wire.items() if k != "samples"}
+        )
+        assert again.cpu == spec.cpu and again.memory == spec.memory
+
+    def test_default_samples_env(self, monkeypatch):
+        monkeypatch.delenv("KCCAP_CAR_SAMPLES", raising=False)
+        assert default_samples() == 64
+        monkeypatch.setenv("KCCAP_CAR_SAMPLES", "128")
+        assert default_samples() == 128
+        monkeypatch.setenv("KCCAP_CAR_SAMPLES", "junk")
+        assert default_samples() == 64
+        monkeypatch.setenv("KCCAP_CAR_SAMPLES", "1")  # below the floor
+        assert default_samples() == 64
+
+
+class TestSampler:
+    def test_same_seed_same_draws_different_streams_differ(self):
+        d = UsageDistribution(kind="normal", mean=500.0, std=150.0)
+        a = sample_usage(d, 64, sample_key(7, 0))
+        b = sample_usage(d, 64, sample_key(7, 0))
+        assert np.array_equal(a, b)
+        assert not np.array_equal(a, sample_usage(d, 64, sample_key(8, 0)))
+        assert not np.array_equal(a, sample_usage(d, 64, sample_key(7, 1)))
+
+    def test_domain_clamped(self):
+        # A distribution whose raw draws would go negative/huge clamps
+        # into [1, 2^62] — every sample is a valid kernel divisor.
+        d = UsageDistribution(kind="normal", mean=10.0, std=1e6)
+        s = sample_usage(d, 256, sample_key(0, 0))
+        assert s.min() >= 1 and s.max() <= MAX_USAGE
+        d = UsageDistribution(kind="lognormal", mean=1e9, sigma=4.0)
+        s = sample_usage(d, 256, sample_key(0, 1))
+        assert s.min() >= 1 and s.max() <= MAX_USAGE
+
+    def test_point_is_exact_and_empirical_stays_in_vocabulary(self):
+        d = UsageDistribution(kind="point", value=123)
+        assert np.array_equal(
+            sample_usage(d, 5, sample_key(0, 0)), np.full(5, 123)
+        )
+        d = UsageDistribution(
+            kind="empirical", values=(100, 200, 900), weights=(8.0, 1.0, 1.0)
+        )
+        s = sample_usage(d, 512, sample_key(3, 0))
+        assert set(np.unique(s)) <= {100, 200, 900}
+        # The 8x-weighted value dominates the draw.
+        assert (s == 100).mean() > 0.5
+
+
+def _random_snapshot(rng, n):
+    """Adversarial little cluster: unhealthy rows, zero-allocatable
+    rows, tight pod caps (Q1 overwrite territory), occasional huge
+    usage (wrapped-headroom territory)."""
+    alloc_cpu = rng.integers(0, 8000, size=n).astype(np.int64)
+    alloc_mem = rng.integers(0, 1 << 34, size=n).astype(np.int64)
+    used_cpu = rng.integers(0, 6000, size=n).astype(np.int64)
+    used_mem = rng.integers(0, 1 << 33, size=n).astype(np.int64)
+    if rng.random() < 0.3:  # overcommitted rows: used > alloc
+        used_mem[rng.integers(0, n)] = np.int64(1 << 35)
+    alloc_pods = rng.integers(0, 30, size=n).astype(np.int64)
+    pods = rng.integers(0, 40, size=n).astype(np.int64)
+    healthy = rng.random(n) > 0.2
+    return ClusterSnapshot(
+        names=[f"n{i}" for i in range(n)],
+        alloc_cpu_milli=alloc_cpu,
+        alloc_mem_bytes=alloc_mem,
+        alloc_pods=alloc_pods,
+        used_cpu_req_milli=used_cpu,
+        used_cpu_lim_milli=used_cpu,
+        used_mem_req_bytes=used_mem,
+        used_mem_lim_bytes=used_mem,
+        pods_count=pods,
+        healthy=np.asarray(healthy, dtype=np.bool_),
+        semantics="reference",
+    )
+
+
+def _random_spec(rng):
+    kind = rng.choice(["normal", "lognormal", "empirical"])
+    if kind == "normal":
+        cpu = UsageDistribution(
+            kind="normal",
+            mean=float(rng.integers(50, 2000)),
+            std=float(rng.integers(1, 800)),
+        )
+    elif kind == "lognormal":
+        cpu = UsageDistribution(
+            kind="lognormal",
+            mean=float(rng.integers(50, 2000)),
+            sigma=float(rng.uniform(0.05, 1.0)),
+        )
+    else:
+        k = int(rng.integers(2, 6))
+        cpu = UsageDistribution(
+            kind="empirical",
+            values=tuple(int(v) for v in rng.integers(1, 3000, size=k)),
+            weights=tuple(float(w) for w in rng.uniform(0.5, 4.0, size=k)),
+        )
+    mem = UsageDistribution(
+        kind="normal",
+        mean=float(rng.integers(1 << 20, 1 << 30)),
+        std=float(rng.integers(1, 1 << 28)),
+    )
+    return StochasticSpec(
+        cpu=cpu,
+        memory=mem,
+        replicas=int(rng.integers(0, 200)),
+        samples=int(rng.integers(2, 16)),
+        seed=int(rng.integers(0, 1 << 16)),
+    )
+
+
+def _oracle_quantile_index(n, q):
+    """The documented rule, implemented independently of car.py."""
+    k = math.ceil(round(q * n, 9))
+    return min(max(n - k, 0), n - 1)
+
+
+def _sequential_oracle(snap, spec, mode, node_mask, quantiles):
+    """Seed-replay + sequential bug-compatible walk + independent
+    quantile selection: the strongest independent comparator."""
+    n = spec.n_samples()
+    cpu = sample_usage(spec.cpu, n, sample_key(spec.seed, 0))
+    mem = sample_usage(spec.memory, n, sample_key(spec.seed, 1))
+    totals = []
+    for s in range(n):
+        fits = fit_arrays_python(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.used_cpu_req_milli,
+            snap.used_mem_req_bytes,
+            snap.pods_count,
+            int(cpu[s]),
+            int(mem[s]),
+            mode=mode,
+            healthy=snap.healthy,
+        )
+        if node_mask is not None:
+            # The kernel's node_mask zeroes after the mode epilogue —
+            # same rule, applied to the scalar walk's output.
+            fits = [
+                f if node_mask[i] else 0 for i, f in enumerate(fits)
+            ]
+        totals.append(sum(int(f) for f in fits))
+    totals = np.asarray(totals, dtype=np.int64)
+    st = np.sort(totals, kind="stable")
+    return totals, {
+        q: int(st[_oracle_quantile_index(n, q)]) for q in quantiles
+    }
+
+
+class TestOracleParity:
+    """Acceptance pin: 200+ randomized trials, both semantics modes."""
+
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    def test_randomized_seed_replay_parity(self, mode):
+        rng = np.random.default_rng(2026 if mode == "reference" else 2027)
+        quantiles = (0.5, 0.9, 0.95, 0.99)
+        for trial in range(110):
+            n_nodes = int(rng.integers(1, 14))
+            snap = _random_snapshot(rng, n_nodes)
+            spec = _random_spec(rng)
+            node_mask = None
+            if rng.random() < 0.4:
+                node_mask = rng.random(n_nodes) > 0.25
+            got = capacity_at_risk(
+                snap, spec, mode=mode, node_mask=node_mask,
+                quantiles=quantiles, bindings=False,
+            )
+            want_totals, want_q = _sequential_oracle(
+                snap, spec, mode, node_mask, quantiles
+            )
+            assert np.array_equal(got.totals, want_totals), (
+                mode, trial, got.totals, want_totals,
+            )
+            assert got.quantiles == want_q, (mode, trial)
+            # The numpy vectorized oracle (the 1M-scale comparator)
+            # agrees with the sequential walk too.
+            np_totals = fit_totals_numpy(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+                snap.alloc_pods, snap.used_cpu_req_milli,
+                snap.used_mem_req_bytes, snap.pods_count, snap.healthy,
+                got.samples_cpu, got.samples_mem,
+                mode=mode, node_mask=node_mask,
+            )
+            assert np.array_equal(np_totals, want_totals), (mode, trial)
+            # Mean / prob-of-fit derive from the same totals.
+            assert got.mean == float(
+                want_totals.astype(np.float64).mean()
+            )
+            assert got.prob_fit == float(
+                (want_totals >= spec.replicas).mean()
+            )
+
+    def test_car_oracle_helper_matches_engine(self):
+        snap = synthetic_snapshot(40, seed=1)
+        spec = parse_stochastic_spec({
+            "usage": {
+                "cpu": {"dist": "normal", "mean": "500m", "std": "200m"},
+                "memory": {"dist": "lognormal", "mean": "1gb", "sigma": 0.5},
+            },
+            "replicas": 50, "samples": 64, "seed": 11,
+        })
+        for mode in ("reference", "strict"):
+            got = capacity_at_risk(snap, spec, mode=mode, bindings=False)
+            want = car_oracle(snap, spec, mode=mode)
+            assert np.array_equal(got.totals, want.totals)
+            assert got.quantiles == want.quantiles
+            assert got.quantile_samples == want.quantile_samples
+            assert got.mean == want.mean
+
+
+class TestQuantileRule:
+    def test_index_rule(self):
+        assert quantile_index(64, 0.5) == 32
+        assert quantile_index(64, 0.95) == 3
+        assert quantile_index(64, 0.99) == 0
+        assert quantile_index(10, 0.9) == 1  # float noise must not shift
+        assert quantile_index(1, 0.99) == 0
+        with pytest.raises(ValueError):
+            quantile_index(10, 1.0)
+        with pytest.raises(ValueError):
+            quantile_index(10, 0.0)
+
+    def test_confidence_semantics(self):
+        # At least a q fraction of samples sit at/above the quantile.
+        rng = np.random.default_rng(5)
+        for _ in range(50):
+            n = int(rng.integers(1, 200))
+            q = float(rng.uniform(0.01, 0.99))
+            totals = np.sort(rng.integers(0, 1000, size=n))
+            i = quantile_index(n, q)
+            assert (totals >= totals[i]).sum() / n >= q - 1e-12
+
+    def test_labels(self):
+        assert quantile_label(0.95) == "p95"
+        assert quantile_label(0.5) == "p50"
+        assert quantile_label(0.975) == "p97.5"
+
+
+@pytest.fixture()
+def degenerate_fleet():
+    """1,280 nodes over 6 shapes: big enough for the grouped dispatch
+    gate (node floor 1024), with unhealthy rows, a tight pod cap (Q1),
+    for the cross-dispatch determinism pin."""
+    snap = synthetic_snapshot(1280, seed=17, shapes=6)
+    healthy = np.asarray(snap.healthy).copy()
+    healthy[::7] = False
+    pods = np.asarray(snap.alloc_pods).copy()
+    pods[::5] = 3  # Q1 overwrite fires on these rows
+    return dataclasses.replace(
+        snap, healthy=healthy, alloc_pods=pods
+    )
+
+
+class TestDeterministicDispatch:
+    """Satellite: same seed → bit-identical quantiles across every
+    dispatch path (grouped/ungrouped × bucketed/unbucketed), both
+    semantics modes, with unhealthy/masked rows and Q1 in play."""
+
+    SPEC = StochasticSpec(
+        cpu=UsageDistribution(kind="normal", mean=500.0, std=180.0),
+        memory=UsageDistribution(kind="lognormal", mean=float(1 << 30),
+                                 sigma=0.5),
+        replicas=100,
+        samples=24,
+        seed=99,
+    )
+
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    @pytest.mark.parametrize("masked", [False, True])
+    def test_paths_bit_identical(self, degenerate_fleet, monkeypatch,
+                                 mode, masked):
+        from kubernetesclustercapacity_tpu.snapshot import (
+            grouped_for_dispatch,
+        )
+
+        snap = degenerate_fleet
+        mask = None
+        if masked:
+            rng = np.random.default_rng(4)
+            mask = rng.random(snap.n_nodes) > 0.3
+        results = {}
+        for grouping, devcache in (
+            ("1", "1"), ("0", "1"), ("1", "0"), ("0", "0"),
+        ):
+            monkeypatch.setenv("KCCAP_GROUPING", grouping)
+            monkeypatch.setenv("KCCAP_DEVCACHE", devcache)
+            # A fresh equal snapshot per path: per-snapshot dispatch
+            # memos must not let one path reuse another's decision.
+            path_snap = dataclasses.replace(snap)
+            if grouping == "1":
+                assert grouped_for_dispatch(path_snap) is not None
+            r = capacity_at_risk(
+                path_snap, self.SPEC, mode=mode, node_mask=mask,
+                bindings=False,
+            )
+            results[(grouping, devcache)] = r
+        baseline = results[("1", "1")]
+        for key, r in results.items():
+            assert np.array_equal(r.totals, baseline.totals), key
+            assert r.quantiles == baseline.quantiles, key
+            assert r.mean == baseline.mean and r.prob_fit == baseline.prob_fit
+
+    def test_wire_shape_and_schedulable(self, degenerate_fleet):
+        r = capacity_at_risk(degenerate_fleet, self.SPEC, bindings=True)
+        wire = r.to_wire()
+        assert set(wire["quantiles"]) == {"p50", "p90", "p95", "p99"}
+        assert set(wire["binding"]) == {"p50", "p90", "p95", "p99"}
+        # Quantiles are monotone non-increasing in confidence.
+        assert (
+            wire["quantiles"]["p50"]
+            >= wire["quantiles"]["p90"]
+            >= wire["quantiles"]["p95"]
+            >= wire["quantiles"]["p99"]
+        )
+        assert isinstance(r.schedulable, bool)
+        # The quantile IS the fit of its realizing sample.
+        for q, s_i in r.quantile_samples.items():
+            assert r.quantiles[q] == int(r.totals[s_i])
+
+    def test_result_repr_fields(self, degenerate_fleet):
+        r = capacity_at_risk(
+            degenerate_fleet, self.SPEC, quantiles=(0.5,), bindings=False
+        )
+        assert isinstance(r, CaRResult)
+        assert r.n_samples == 24
+        assert r.samples_cpu.shape == (24,) and r.samples_mem.shape == (24,)

@@ -161,6 +161,119 @@ class TestWatchlist:
         )
         assert specs[0].name == "w"
 
+    def test_quantile_watch_yaml_round_trip(self, tmp_path):
+        """A capacity-at-risk watch survives the file round trip with
+        every stochastic field intact (satellite: quantile grammar)."""
+        yaml = tmp_path / "car.yaml"
+        yaml.write_text(
+            "watches:\n"
+            "  - name: web-p95\n"
+            "    pod: {cpuRequests: 500m, memRequests: 1gb, replicas: 40}\n"
+            "    quantile: 0.95\n"
+            "    usage:\n"
+            "      cpu: {dist: normal, mean: 500m, std: 150m}\n"
+            "    samples: 128\n"
+            "    seed: 7\n"
+            "    min_replicas: 30\n"
+        )
+        (spec,) = load_watchlist(str(yaml))
+        assert spec.quantile == 0.95
+        assert spec.samples == 128 and spec.seed == 7
+        assert spec.usage_cpu.kind == "normal"
+        assert spec.usage_cpu.mean == 500.0 and spec.usage_cpu.std == 150.0
+        # The omitted resource defaulted to a point at the pod request.
+        assert spec.usage_mem.kind == "point"
+        assert spec.usage_mem.value == spec.scenario.mem_request_bytes
+        # And the wire shape round-trips the stochastic fields too.
+        wire = spec.to_wire()
+        assert wire["quantile"] == 0.95 and wire["samples"] == 128
+        assert wire["usage"]["cpu"]["dist"] == "normal"
+        assert wire["usage"]["memory"]["dist"] == "point"
+        # A plain watch's wire shape is untouched (no stochastic keys).
+        plain = parse_watchlist(
+            [{"name": "p", "pod": {"cpuRequests": "1"}}]
+        )[0]
+        assert "quantile" not in plain.to_wire()
+
+    @pytest.mark.parametrize(
+        "entry, fragment",
+        [
+            # quantiles outside (0, 1) — inclusive bounds rejected too.
+            ({"quantile": 0.0}, "strictly inside"),
+            ({"quantile": 1.0}, "strictly inside"),
+            ({"quantile": -0.5}, "strictly inside"),
+            ({"quantile": 1.5}, "strictly inside"),
+            ({"quantile": "p95"}, "quantile must be a number"),
+            ({"quantile": True}, "quantile must be a number"),
+            # quantile without usage: a point-distribution watch.
+            ({"quantile": 0.95}, "usage"),
+            # usage where BOTH resources are (effectively) points.
+            (
+                {
+                    "quantile": 0.95,
+                    "usage": {"cpu": {"dist": "point", "value": "1"}},
+                },
+                "point",
+            ),
+            (
+                {
+                    "quantile": 0.95,
+                    "usage": {
+                        "cpu": {"dist": "normal", "mean": "1", "std": 0}
+                    },
+                },
+                "point",
+            ),
+            # stochastic fields without a quantile.
+            (
+                {"usage": {"cpu": {"dist": "normal", "mean": "1",
+                                   "std": "1"}}},
+                "requires a 'quantile'",
+            ),
+            ({"samples": 64}, "requires a 'quantile'"),
+            ({"seed": 3}, "requires a 'quantile'"),
+            # malformed stochastic values.
+            (
+                {"quantile": 0.9, "usage": {"gpu": 1}},
+                "unknown usage resource",
+            ),
+            (
+                {
+                    "quantile": 0.9,
+                    "usage": {"cpu": {"dist": "gauss"}},
+                },
+                "dist must be one of",
+            ),
+            (
+                {
+                    "quantile": 0.9,
+                    "usage": {"cpu": {"dist": "normal", "mean": "1",
+                                      "std": "1"}},
+                    "samples": 1,
+                },
+                "samples",
+            ),
+            (
+                {
+                    "quantile": 0.9,
+                    "usage": {"cpu": {"dist": "normal", "mean": "1",
+                                      "std": "1"}},
+                    "seed": "x",
+                },
+                "seed",
+            ),
+        ],
+    )
+    def test_quantile_grammar_rejections(self, entry, fragment):
+        doc = {
+            "watches": [
+                {"name": "w", "pod": {"cpuRequests": "1"}, **entry}
+            ]
+        }
+        with pytest.raises(WatchError) as ei:
+            parse_watchlist(doc)
+        assert fragment in str(ei.value)
+
     @pytest.mark.parametrize(
         "doc, fragment",
         [
