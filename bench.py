@@ -628,6 +628,7 @@ def main() -> None:
             _hot_path_metrics(metrics)
             _shadow_overhead_metrics(metrics)
             _serving_slo_metrics(metrics)
+            _federation_metrics(metrics)
         except Exception as e:  # noqa: BLE001 - partial capture survives
             print(traceback.format_exc(), file=sys.stderr)
             metrics["host_aux_error"] = f"{type(e).__name__}: {e}"
@@ -1142,6 +1143,134 @@ def _serving_slo_metrics(out: dict | None = None) -> dict:
             r.shutdown()
         pub.close()
         leader.shutdown()
+    return out
+
+
+def _federation_metrics(out: dict | None = None) -> dict:
+    """Federated fleet-sweep row (ROADMAP item 5's artifact): N simulated
+    clusters × grouped 1M-node snapshots behind one
+    :class:`~kubernetesclustercapacity_tpu.federation.FederationServer`,
+    queried as ONE batched kernel dispatch over the concatenated
+    (cluster, shape, count) groups.
+
+    Mid-run, one cluster partitions (its feed goes silent on the
+    injected clock while every other cluster keeps verifying): the
+    sweep must keep answering with that cluster EXPLICITLY annotated
+    ``stale`` — and, past the eviction horizon, ``lost`` and EXCLUDED
+    from totals by name — never silently summed.  Gated on
+    ``fed_parity_diffs == 0``: every per-cluster total bit-identical to
+    the pure-numpy Go-faithful oracle (:func:`fit_totals_numpy`) at
+    that cluster's stamped generation.  ``KCC_BENCH_FED=0`` skips it.
+    """
+    if out is None:
+        out = {}
+    if os.environ.get("KCC_BENCH_FED", "1") == "0":
+        return out
+    import statistics
+
+    from kubernetesclustercapacity_tpu.federation import FederationServer
+    from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+    from kubernetesclustercapacity_tpu.stochastic.car import fit_totals_numpy
+
+    n_nodes = int(os.environ.get("KCC_BENCH_FED_NODES", "1000000"))
+    n_clusters = int(os.environ.get("KCC_BENCH_FED_CLUSTERS", "4"))
+    now = [0.0]
+    fed = FederationServer(
+        stale_after_s=30.0, evict_after_s=120.0, clock=lambda: now[0]
+    )
+    cpu = [100, 250, 900]
+    mem = [10 ** 8, 3 * 10 ** 8, 10 ** 9]
+    reps = [1, 4, 16]
+    query = {
+        "op": "fed_sweep",
+        "cpu_request_milli": cpu,
+        "mem_request_bytes": mem,
+        "replicas": reps,
+    }
+    try:
+        snaps = {}
+        for i in range(n_clusters):
+            name = f"cluster-{i}"
+            # shapes=8: the degenerate-fleet profile (PR 9), so 1M nodes
+            # group to a handful of rows and grouping dedups ACROSS the
+            # concatenated clusters too.
+            snaps[name] = synthetic_snapshot(n_nodes, seed=100 + i, shapes=8)
+            fed.inject(name, snaps[name], generation=i + 1)
+        t0 = time.perf_counter()
+        r_first = fed.dispatch(query)
+        out["fed_sweep_first_ms"] = (time.perf_counter() - t0) * 1e3
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fed.dispatch(query)
+            ts.append((time.perf_counter() - t0) * 1e3)
+        out["fed_sweep_ms"] = statistics.median(ts)
+        out["fed_clusters"] = n_clusters
+        out["fed_actual_nodes"] = n_clusters * n_nodes
+
+        # --- partition cluster-0 mid-run: every OTHER feed re-verifies
+        # at the advanced clock (the leaders that kept publishing);
+        # cluster-0's feed goes silent, so its age crosses the
+        # staleness bound while its last verified snapshot keeps
+        # serving.
+        now[0] = 60.0
+        for i, (name, snap) in enumerate(snaps.items()):
+            if name != "cluster-0":
+                fed.inject(name, snap, generation=100 + i)
+        r_stale = fed.dispatch(query)
+        c0 = r_stale["clusters"]["cluster-0"]
+        out["fed_stale_annotated"] = bool(
+            c0["state"] == "stale"
+            and c0["age_s"] is not None
+            and 30.0 < c0["age_s"] <= 120.0
+            and "cluster-0" in r_stale["per_cluster"]
+        )
+
+        # --- parity gate: per-cluster totals (stale member included)
+        # vs the numpy seed-replay oracle, element for element, plus
+        # the grand total being exactly the per-cluster sum.
+        diffs = 0
+        for result in (r_first, r_stale):
+            grand = np.zeros(len(cpu), dtype=np.int64)
+            for name, snap in snaps.items():
+                want = fit_totals_numpy(
+                    snap.alloc_cpu_milli, snap.alloc_mem_bytes,
+                    snap.alloc_pods, snap.used_cpu_req_milli,
+                    snap.used_mem_req_bytes, snap.pods_count, snap.healthy,
+                    np.asarray(cpu, dtype=np.int64),
+                    np.asarray(mem, dtype=np.int64),
+                    mode=snap.semantics,
+                )
+                got = np.asarray(result["per_cluster"][name], dtype=np.int64)
+                diffs += int(np.sum(want != got))
+                grand = grand + got
+            diffs += int(
+                np.sum(grand != np.asarray(result["totals"], dtype=np.int64))
+            )
+        out["fed_parity_diffs"] = diffs
+
+        # --- past the eviction horizon: lost, excluded BY NAME, totals
+        # drop to exactly the surviving clusters' sum.
+        now[0] = 200.0
+        for i, (name, snap) in enumerate(snaps.items()):
+            if name != "cluster-0":
+                fed.inject(name, snap, generation=200 + i)
+        r_lost = fed.dispatch(query)
+        survivors = np.zeros(len(cpu), dtype=np.int64)
+        for name in snaps:
+            if name != "cluster-0":
+                survivors = survivors + np.asarray(
+                    r_lost["per_cluster"][name], dtype=np.int64
+                )
+        out["fed_lost_excluded"] = bool(
+            "cluster-0" in r_lost["excluded"]
+            and "cluster-0" not in r_lost["per_cluster"]
+            and np.array_equal(
+                survivors, np.asarray(r_lost["totals"], dtype=np.int64)
+            )
+        )
+    finally:
+        fed.close()
     return out
 
 
@@ -2354,6 +2483,10 @@ def _run() -> None:
         # Shadow-sampler request-path cost (PR-6): sweep p50 at
         # 0%/1%/10% sample rates must stay indistinguishable.
         _shadow_overhead_metrics(ladder)
+        # Federated fleet sweep (PR-12): 4 grouped 1M-node clusters, one
+        # batched dispatch, one cluster partitioned mid-run — gated on
+        # per-cluster numpy-oracle parity and explicit stale annotation.
+        _federation_metrics(ladder)
 
     except Exception as e:  # noqa: BLE001 - aux must never kill the bench
         # MERGE the error: entries measured before the failing section

@@ -233,6 +233,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "staleness state, plus capabilities) and exit; "
                         "exit 1 when the replica is stale or the "
                         "server is draining")
+    p.add_argument("-fed-status", default=None, dest="fed_status",
+                   metavar="HOST:PORT",
+                   help="print a federation endpoint's per-cluster "
+                        "degradation vector (generation, verified age, "
+                        "fresh/stale/lost) and exit; exit 1 when any "
+                        "cluster is lost (excluded from fleet totals)")
+    p.add_argument("-fed-sweep", default=None, dest="fed_sweep",
+                   metavar="HOST:PORT",
+                   help="fleet-global capacity for the six scenario "
+                        "flags against a federation endpoint: grand "
+                        "totals over non-lost clusters plus the "
+                        "per-cluster split, every reply annotated with "
+                        "the staleness vector; exit 1 when the scenario "
+                        "does not fit or any cluster is lost")
+    p.add_argument("-doctor-federation", dest="doctor_federation",
+                   default=None, metavar="HOST:PORT",
+                   help="with -doctor: also probe a federation "
+                        "endpoint (cluster states, generations) — a "
+                        "lost cluster is a hard FAILED line")
     return p
 
 
@@ -265,8 +284,19 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"ERROR : bad -doctor-service {args.doctor_service!r} "
                       "(want HOST:PORT)", file=sys.stderr)
                 return 1
+        federation_addr = None
+        if args.doctor_federation:
+            host, _, port = args.doctor_federation.rpartition(":")
+            try:
+                federation_addr = (host or "127.0.0.1", int(port))
+            except ValueError:
+                print(f"ERROR : bad -doctor-federation "
+                      f"{args.doctor_federation!r} (want HOST:PORT)",
+                      file=sys.stderr)
+                return 1
         report, code = run_doctor(
-            backend_timeout_s=args.doctor_timeout, service_addr=service_addr
+            backend_timeout_s=args.doctor_timeout, service_addr=service_addr,
+            federation_addr=federation_addr,
         )
         print(report)
         return code
@@ -288,6 +318,12 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.plane_status:
         return _run_plane_status(args)
+
+    if args.fed_status:
+        return _run_fed_status(args)
+
+    if args.fed_sweep:
+        return _run_fed_sweep(args)
 
     if args.replay:
         return _run_replay(args)
@@ -700,6 +736,69 @@ def _run_plane_status(args) -> int:
         print(f"draining  : {draining}")
     stale = bool(plane and plane.get("role") == "replica" and plane.get("stale"))
     return 1 if (stale or draining) else 0
+
+
+def _run_fed_status(args) -> int:
+    """-fed-status HOST:PORT: the federation tier's degradation vector.
+    Exit by the verdict: 1 when any cluster is LOST — a fleet answer is
+    provably incomplete then, and scripts must see that, not parse
+    prose.  Stale clusters render explicitly but stay exit 0 (they are
+    the contract working, not a failure of it)."""
+    from kubernetesclustercapacity_tpu.report import (
+        fed_status_json_report,
+        fed_status_table_report,
+    )
+
+    addr = _parse_addr("-fed-status", args.fed_status)
+    if addr is None:
+        return 1
+    try:
+        with _diag_client(addr) as c:
+            result = c.fed_status()
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot fetch federation status from "
+              f"{addr[0]}:{addr[1]}: {e}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(fed_status_json_report(result))
+    else:
+        print(fed_status_table_report(result))
+    if not result.get("enabled", False):
+        return 1
+    return 1 if result.get("excluded") else 0
+
+
+def _run_fed_sweep(args) -> int:
+    """-fed-sweep HOST:PORT: fleet capacity for the six scenario flags.
+    Exit 0 only when the scenario fits across the fleet AND no cluster
+    is lost (a lost cluster makes every total an explicit lower bound)."""
+    from kubernetesclustercapacity_tpu.report import (
+        fed_sweep_json_report,
+        fed_sweep_table_report,
+    )
+
+    addr = _parse_addr("-fed-sweep", args.fed_sweep)
+    if addr is None:
+        return 1
+    try:
+        with _diag_client(addr) as c:
+            result = c.fed_sweep(
+                cpuRequests=args.cpuRequests,
+                cpuLimits=args.cpuLimits,
+                memRequests=args.memRequests,
+                memLimits=args.memLimits,
+                replicas=args.replicas,
+            )
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot fed-sweep {addr[0]}:{addr[1]}: {e}",
+              file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(fed_sweep_json_report(result))
+    else:
+        print(fed_sweep_table_report(result))
+    schedulable = all(result.get("schedulable", []) or [False])
+    return 0 if schedulable and not result.get("excluded") else 1
 
 
 def _run_replay(args) -> int:

@@ -77,6 +77,7 @@ class ClusterFollower:
         resync_failure_deadline: float = 900.0,
         backoff_seed: int | None = None,
         registry=None,
+        clock=time.monotonic,
     ) -> None:
         """``client_factory() -> KubeClient`` builds one client per stream
         (each watch occupies a connection); defaults to clients over the
@@ -108,6 +109,12 @@ class ClusterFollower:
         :meth:`stats` is a view over.  Default: a fresh private registry
         (per-follower counts, as before); the serve path passes the
         process registry so the scrape includes them.
+
+        ``clock`` (monotonic seconds, injectable for deterministic
+        staleness tests) feeds :meth:`last_relist_age_s` and
+        :meth:`last_verified_age_s` — consumers computing freshness
+        bounds read the follower's clock, never a second wall-clock of
+        their own.
         """
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             MetricsRegistry,
@@ -139,7 +146,12 @@ class ClusterFollower:
         # any of them happens under it (two watch threads + callers race).
         self._versions: dict[str, str] = {}
         self._epoch = 0  # bumped by every relist; stale streams stop applying
+        self._clock = clock
         self._last_relist_t: float | None = None  # monotonic; /healthz age
+        # Last instant the store was verifiably synced to the apiserver:
+        # a completed relist OR an applied watch event (both prove the
+        # stream was live then).  Guarded by _lock like the relist stamp.
+        self._last_verified_t: float | None = None
         self._fatal: str | None = None
         self._pdb_unavailable = False  # policy API 403/404 at relist
         self._errors: collections.deque = collections.deque(maxlen=100)
@@ -277,7 +289,19 @@ class ClusterFollower:
         shape is pinned, so the age rides its own accessor)."""
         with self._lock:
             t = self._last_relist_t
-        return None if t is None else round(time.monotonic() - t, 3)
+        return None if t is None else round(self._clock() - t, 3)
+
+    def last_verified_age_s(self) -> float | None:
+        """Seconds (on the injectable ``clock``) since the store was last
+        verifiably synced — a completed relist or an applied watch event;
+        ``None`` before the first relist.  The freshness input federation
+        staleness math reads, so a bound like "stale after 10 s" is
+        always computed against THIS clock (the stats() dict shape is
+        pinned, so the age rides its own accessor, exactly like
+        :meth:`last_relist_age_s`)."""
+        with self._lock:
+            t = self._last_verified_t
+        return None if t is None else round(self._clock() - t, 3)
 
     def _bump(self, counter: str, n: int = 1) -> None:
         self._counters[counter].inc(n)
@@ -356,7 +380,8 @@ class ClusterFollower:
             self._store = store
             self._versions = versions
             self._epoch += 1
-            self._last_relist_t = time.monotonic()
+            self._last_relist_t = self._clock()
+            self._last_verified_t = self._last_relist_t
         self._counters["relists"].inc()
         self._synced.set()
         # The swapped-in store may hold changes that never flowed through
@@ -531,6 +556,7 @@ class ClusterFollower:
             elif etype == "DELETED" and not exists:
                 return True
             store.apply_event({"type": etype, "kind": kind, "object": obj})
+            self._last_verified_t = self._clock()
         self._counters["events_applied"].inc()
         if self.on_event is not None:
             self.on_event(kind, etype, obj)

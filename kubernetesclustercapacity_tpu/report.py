@@ -37,6 +37,10 @@ __all__ = [
     "car_json_report",
     "car_status_table_report",
     "car_status_json_report",
+    "fed_status_table_report",
+    "fed_status_json_report",
+    "fed_sweep_table_report",
+    "fed_sweep_json_report",
 ]
 
 _RULE = "=" * 110  # the reference prints 110 '=' (ClusterCapacity.go:142,149)
@@ -586,6 +590,99 @@ def car_status_table_report(status: dict) -> str:
 def car_status_json_report(status: dict) -> str:
     """``kccap -car -output json``: the wire shape verbatim."""
     return json.dumps(status, indent=2, sort_keys=True)
+
+
+def fed_status_table_report(status: dict) -> str:
+    """``kccap -fed-status`` as operator-readable text: one row per
+    cluster with its generation watermark, verified age, and
+    fresh/stale/lost state — the degradation contract at a glance."""
+    if not status.get("enabled", False):
+        return "federation: no clusters attached to this endpoint"
+    header = f"{'CLUSTER':<24} {'GENERATION':>11} {'AGE_S':>9}  STATE"
+    lines = [
+        (
+            f"federation: {status['counts']['total']} cluster(s) "
+            f"(stale>{status.get('stale_after_s'):g}s, "
+            f"lost>{status.get('evict_after_s'):g}s)"
+        ),
+        header,
+        "-" * len(header),
+    ]
+    for name in sorted(status.get("clusters", {})):
+        c = status["clusters"][name]
+        age = c.get("age_s")
+        lines.append(
+            f"{name:<24} {c.get('generation'):>11} "
+            f"{'-' if age is None else age:>9}  {c.get('state')}"
+        )
+    lines.append("-" * len(header))
+    excluded = status.get("excluded", [])
+    lines.append(
+        "verdict: "
+        + (
+            "DEGRADED — lost: " + ", ".join(excluded)
+            if excluded
+            else (
+                "ok — every cluster within the staleness bound"
+                if status["counts"].get("stale", 0) == 0
+                else "STALE — "
+                + str(status["counts"]["stale"])
+                + " cluster(s) serving explicitly-stale views"
+            )
+        )
+    )
+    return "\n".join(lines)
+
+
+def fed_status_json_report(status: dict) -> str:
+    """``kccap -fed-status -output json``: the wire shape verbatim."""
+    return json.dumps(status, indent=2, sort_keys=True)
+
+
+def fed_sweep_table_report(result: dict) -> str:
+    """``kccap -fed-sweep`` as operator-readable text: the fleet total
+    per scenario, the per-cluster split (each row carrying its stamped
+    generation and state), and the named exclusions — a lost cluster is
+    never a silent hole in a sum."""
+    header = f"{'CLUSTER':<24} {'GENERATION':>11}  {'STATE':<6}  TOTALS"
+    lines = [header, "-" * len(header)]
+    clusters = result.get("clusters", {})
+    for name in sorted(result.get("per_cluster", {})):
+        c = clusters.get(name, {})
+        totals = result["per_cluster"][name]
+        lines.append(
+            f"{name:<24} {c.get('generation'):>11}  "
+            f"{c.get('state'):<6}  {totals}"
+        )
+    for name in result.get("excluded", []):
+        c = clusters.get(name, {})
+        lines.append(
+            f"{name:<24} {c.get('generation'):>11}  "
+            f"{'lost':<6}  EXCLUDED from totals"
+        )
+    lines.append("-" * len(header))
+    lines.append(f"fleet totals      : {result.get('totals')}")
+    lines.append(f"schedulable       : {result.get('schedulable')}")
+    excluded = result.get("excluded", [])
+    lines.append(
+        "verdict: "
+        + (
+            "DEGRADED — totals exclude lost cluster(s): "
+            + ", ".join(excluded)
+            if excluded
+            else (
+                "ok (some clusters explicitly stale)"
+                if result.get("degraded")
+                else "ok — every cluster fresh"
+            )
+        )
+    )
+    return "\n".join(lines)
+
+
+def fed_sweep_json_report(result: dict) -> str:
+    """``kccap -fed-sweep -output json``: the wire shape verbatim."""
+    return json.dumps(result, indent=2, sort_keys=True)
 
 
 def replay_table_report(result: dict) -> str:

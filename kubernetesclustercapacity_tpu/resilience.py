@@ -34,6 +34,7 @@ __all__ = [
     "OverloadedError",
     "DrainingError",
     "NotLeaderError",
+    "ClusterLostError",
     "TokenBucket",
     "WIRE_CODES",
     "decorrelated_jitter",
@@ -94,11 +95,23 @@ class NotLeaderError(RetryableElsewhere):
     wire_code = "not_leader"
 
 
+class ClusterLostError(RetryableElsewhere):
+    """A federation endpoint reports the queried cluster as ``lost``:
+    its stream has been silent past the eviction horizon, so this
+    endpoint holds no servable view of it — not even an explicitly-stale
+    one.  The refusal happened before any work, so another federation
+    endpoint (which may still hold a within-horizon view) is safe to
+    try; multi-endpoint clients demote the refusing endpoint the way
+    they demote a draining one."""
+
+    wire_code = "cluster_lost"
+
+
 #: wire code → exception class, for the client side of the envelope.
 WIRE_CODES = {
     cls.wire_code: cls
     for cls in (RetryableElsewhere, OverloadedError, DrainingError,
-                NotLeaderError)
+                NotLeaderError, ClusterLostError)
 }
 
 

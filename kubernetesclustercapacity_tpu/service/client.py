@@ -62,6 +62,10 @@ IDEMPOTENT_OPS = frozenset(
         "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
         "topology_spread", "plan", "explain", "car", "dump", "timeline",
         "slo", "drain_server",
+        # Federation ops are pure reads over the federation tier's held
+        # snapshots — a retry re-reads the fleet view, which may have
+        # advanced; acceptable for the same reason dump/timeline are.
+        "fed_status", "fed_sweep", "fed_rank", "spillover",
     }
 )
 
@@ -531,6 +535,41 @@ class CapacityClient:
         if timeout_s is not None:
             kw["timeout_s"] = timeout_s
         return self.call("drain_server", **kw)
+
+    # Federation surface (a kccap-fed endpoint; see federation/) -----------
+    def fed_status(self, **kw) -> dict:
+        """The federation tier's per-cluster degradation vector: every
+        cluster's ``{generation, age_s, state: fresh|stale|lost}``,
+        state counts, the stale/evict horizons, and the named exclusion
+        list.  ``{"enabled": false, ...}``-shaped when the endpoint
+        federates no clusters."""
+        return self.call("fed_status", **kw)
+
+    def fed_sweep(self, **params) -> dict:
+        """Fleet-global sweep: grand totals over every non-lost cluster
+        plus the per-cluster split, each reply annotated with the
+        degradation vector (lost clusters are EXCLUDED from totals and
+        named in ``excluded`` — never silently summed).  Accepts the
+        sweep op's array grammar or the six reference flags."""
+        for key in ("cpu_request_milli", "mem_request_bytes", "replicas"):
+            v = params.get(key)
+            if v is not None and hasattr(v, "tolist"):
+                params[key] = v.tolist()
+        return self.call("fed_sweep", **params)
+
+    def fed_rank(self, **flags) -> dict:
+        """Placement ranking per cluster for one scenario: fitting
+        clusters first (cheapest first when a ``costs`` map is given,
+        most headroom otherwise), lost clusters never ranked."""
+        return self.call("fed_rank", **flags)
+
+    def spillover(self, cluster: str, **flags) -> dict:
+        """Drain-cluster what-if: where does cluster X's load land?
+        Demand defaults to X's current pod count (override with
+        ``demand=``); the rest of the fleet absorbs greedily, most
+        headroom first.  A LOST X refuses with the typed
+        ``cluster_lost`` code — its load is unknowable."""
+        return self.call("spillover", cluster=cluster, **flags)
 
     def plane_status(self, **kw) -> dict | None:
         """The server's serving-plane section (``info {plane: true}``):

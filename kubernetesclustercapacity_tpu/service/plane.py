@@ -575,6 +575,14 @@ class PlaneSubscriber:
         self._generation = 0
         self._digest = ""
         self._last_frame_at: float | None = None
+        # The VERIFIED clock: last instant the held generation was
+        # digest-proven current — a staged frame, an idempotent
+        # re-delivery of the held generation, a digest-match resume, or
+        # a heartbeat stamped with the held generation.  Garbled frames
+        # and heartbeats announcing a NEWER generation (frames were
+        # missed) do not advance it, so federation staleness math reads
+        # ONE clock instead of re-deriving wall-clock in two places.
+        self._last_verified_at: float | None = None
         self._applied = 0
         self._skipped = 0
         self._resyncs = 0
@@ -629,6 +637,19 @@ class PlaneSubscriber:
             if self._last_frame_at is None:
                 return None
             return self._clock() - self._last_frame_at
+
+    def last_verified_age_s(self) -> float | None:
+        """Seconds (on the injectable monotonic ``clock``) since the held
+        generation was last digest-proven current; ``None`` before the
+        first verification.  Stricter than :meth:`sync_age_s`: a frame
+        that arrives but does not verify (garbage, a heartbeat stamped
+        with a generation this replica missed) resets nothing — the
+        federation tier's fresh/stale/lost state machine reads exactly
+        this accessor, so staleness is never computed from two clocks."""
+        with self._lock:
+            if self._last_verified_at is None:
+                return None
+            return self._clock() - self._last_verified_at
 
     @property
     def stale(self) -> bool:
@@ -725,6 +746,29 @@ class PlaneSubscriber:
         if kind == "reject":
             raise PlaneError(f"leader rejected us: {frame.get('error')}")
         if kind in ("heartbeat", "resume"):
+            # A heartbeat/resume stamped with the generation we HOLD is
+            # proof the held snapshot is still the leader's current one.
+            with self._lock:
+                held = self._generation
+                if self._summary is not None and (
+                    frame.get("generation") == held
+                ):
+                    self._last_verified_at = now
+            if kind == "heartbeat":
+                gen = frame.get("generation")
+                if isinstance(gen, int) and gen > held:
+                    # The leader is ahead of us but the connection is
+                    # "live": frames were dropped on this link (e.g. a
+                    # partition that healed before our read timed out).
+                    # Waiting for the next diff to break the digest
+                    # chain could wait forever on a quiet leader — the
+                    # heartbeat itself is the gap evidence, so resync
+                    # NOW through a fresh checkpoint.
+                    raise PlaneError(
+                        f"heartbeat announces generation {gen} ahead of "
+                        f"held {held}: frames were missed on this "
+                        "stream; resyncing"
+                    )
             return
         if kind == "drain":
             with self._lock:
@@ -824,9 +868,11 @@ class PlaneSubscriber:
             )
         if generation == current and actual == current_digest:
             # Idempotent re-delivery (reconnect checkpoint of the held
-            # generation): nothing to stage.
+            # generation): nothing to stage, but the held generation was
+            # just digest-proven current again.
             with self._lock:
                 self._skipped += 1
+                self._last_verified_at = self._clock()
             return
         self._server.replace_snapshot(snap, generation=generation)
         with self._lock:
@@ -836,6 +882,7 @@ class PlaneSubscriber:
             self._generation = generation
             self._digest = actual
             self._applied += 1
+            self._last_verified_at = self._clock()
             self._leader_draining = False
         if self._m_generation is not None:
             self._m_generation.set(generation)

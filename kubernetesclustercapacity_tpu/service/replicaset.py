@@ -102,6 +102,11 @@ class _Endpoint:
         self.client: CapacityClient | None = None
         self.stale = False
         self.draining = False
+        # A federation endpoint reporting the set's queried cluster as
+        # ``lost`` — demoted like a draining endpoint (it holds no
+        # servable view of that cluster, not even a stale one) but still
+        # tried last, since it may have resynced.
+        self.lost = False
         self.role: str | None = None
         self.capabilities: dict = {}
         self.last_generation: int | None = None
@@ -139,7 +144,14 @@ class ReplicaSet:
         hedge_max_delay_s: float = 1.0,
         registry=None,
         trace: bool = False,
+        cluster: str | None = None,
     ) -> None:
+        """``cluster`` names the federation cluster this set's queries
+        concern (endpoints being ``kccap-fed`` servers): :meth:`probe`
+        then demotes any endpoint whose federation status reports that
+        cluster ``lost`` — the way it demotes a draining endpoint —
+        and a typed ``cluster_lost`` refusal mid-call marks it the same
+        way while the call retries elsewhere."""
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             MetricsRegistry,
         )
@@ -168,6 +180,7 @@ class ReplicaSet:
         self._hedge_min = float(hedge_min_delay_s)
         self._hedge_max = float(hedge_max_delay_s)
         self._trace = bool(trace)
+        self._cluster = cluster
         self._lock = threading.Lock()
         self._watermark = 0
         #: Generation stamped on the last successful answer (None until
@@ -224,6 +237,7 @@ class ReplicaSet:
                     "breaker": ep.breaker.state,
                     "stale": ep.stale,
                     "draining": ep.draining,
+                    "lost": ep.lost,
                     "role": ep.role,
                     "last_generation": ep.last_generation,
                 }
@@ -256,11 +270,27 @@ class ReplicaSet:
             ep.draining = bool(info.get("draining"))
             if isinstance(plane, dict) and plane.get("stale"):
                 ep.stale = True
+            # Federation endpoints: one reporting the set's queried
+            # cluster as ``lost`` holds NO servable view of it — demote
+            # it exactly like a draining endpoint (tried last, never
+            # first) until a later probe sees the cluster resynced.
+            fed = info.get("federation")
+            cluster_state = None
+            if self._cluster is not None and isinstance(fed, dict):
+                cl = (fed.get("clusters") or {}).get(self._cluster)
+                if isinstance(cl, dict):
+                    cluster_state = cl.get("state")
+                ep.lost = cluster_state == "lost"
             entry.update(
                 capabilities=ep.capabilities,
                 role=ep.role,
                 draining=ep.draining,
                 generation=ep.last_generation,
+                **(
+                    {"cluster_state": cluster_state}
+                    if cluster_state is not None
+                    else {}
+                ),
             )
             out.append(entry)
         return out
@@ -321,6 +351,10 @@ class ReplicaSet:
                     # the next replica, mutations included.
                     errors.append(f"{ep.name}: {e}")
                     ep.draining = e.wire_code == "draining"
+                    if e.wire_code == "cluster_lost":
+                        # A federation endpoint with no view of the
+                        # queried cluster: demote like draining.
+                        ep.lost = True
                     self._m_failover.labels(cause=e.wire_code).inc()
                     continue
                 except CircuitOpenError as e:
@@ -375,15 +409,19 @@ class ReplicaSet:
 
     def _rotation(self) -> list[_Endpoint]:
         """Endpoints in try order: sticky-preferred first, then the
-        rest; known-stale/draining endpoints demoted to the back (still
-        tried — they may have recovered, and a lone endpoint is better
-        than none)."""
+        rest; known-stale/draining/cluster-lost endpoints demoted to the
+        back (still tried — they may have recovered, and a lone endpoint
+        is better than none)."""
         with self._lock:
             start = self._preferred
         eps = self._endpoints
         ordered = [eps[(start + i) % len(eps)] for i in range(len(eps))]
-        healthy = [ep for ep in ordered if not (ep.stale or ep.draining)]
-        demoted = [ep for ep in ordered if ep.stale or ep.draining]
+        healthy = [
+            ep for ep in ordered if not (ep.stale or ep.draining or ep.lost)
+        ]
+        demoted = [
+            ep for ep in ordered if ep.stale or ep.draining or ep.lost
+        ]
         return healthy + demoted
 
     def _client_for(self, ep: _Endpoint) -> CapacityClient:

@@ -42,6 +42,17 @@ SERVER frame, in arrival order: ``drop_pre`` silently swallows the
 frame (the subscriber sees a gap — its digest chain breaks and it must
 resync), ``garbage``/``partial`` corrupt it, ``stall`` delays it, and
 ``drop_post`` cuts the connection after delivering it.
+
+Runtime partition control (:meth:`FaultProxy.partition` /
+:meth:`FaultProxy.heal`) models a network partition ORTHOGONALLY to the
+scripted plan: while partitioned, every frame crossing the cut
+direction(s) is silently swallowed — connections stay up, bytes just
+never arrive, exactly what a partition looks like from an endpoint.
+``direction`` selects symmetric (``"both"``) or asymmetric one-way
+drops (``"to_server"`` / ``"to_client"``); both methods are safe to
+call from the test thread mid-traffic without restarting the proxy, and
+partitioned frames consume NO plan decisions (a scripted fault schedule
+stays aligned to the frames that actually cross).
 """
 
 from __future__ import annotations
@@ -52,9 +63,13 @@ import struct
 import threading
 import time
 
-__all__ = ["FAULTS", "FaultPlan", "FaultProxy"]
+__all__ = ["FAULTS", "PARTITION_DIRECTIONS", "FaultPlan", "FaultProxy"]
 
 FAULTS = ("drop_pre", "drop_post", "partial", "garbage", "stall")
+
+#: Valid :meth:`FaultProxy.partition` directions: symmetric, or the two
+#: asymmetric one-way cuts (frames dropped only on the named leg).
+PARTITION_DIRECTIONS = ("both", "to_server", "to_client")
 
 _GARBAGE_BODY = b"\x00\xff\xfe{not json"
 
@@ -173,6 +188,12 @@ class FaultProxy:
         self.plan = plan
         self._stall_s = float(stall_s)
         self._stream = bool(stream)
+        # Runtime partition state (None = healed), toggled from the test
+        # thread; _partition_dropped counts swallowed frames so a test
+        # can assert the cut actually intercepted traffic.
+        self._state_lock = threading.Lock()
+        self._partition_dir: str | None = None
+        self._partition_dropped = 0
         self._stop = threading.Event()
         self._listener = socket.create_server((host, 0))
         self._listener.settimeout(0.2)
@@ -184,6 +205,50 @@ class FaultProxy:
     @property
     def address(self) -> tuple[str, int]:
         return self._listener.getsockname()
+
+    # -- runtime partition control (test-thread API) -----------------------
+    def partition(self, direction: str = "both") -> None:
+        """Cut the link mid-run (no proxy restart): frames crossing the
+        named direction(s) are silently swallowed from now until
+        :meth:`heal`.  Connections stay up — endpoints observe silence,
+        not resets — and the scripted :class:`FaultPlan` is NOT consumed
+        by swallowed frames, so a seeded fault schedule replays
+        identically around the partition window."""
+        if direction not in PARTITION_DIRECTIONS:
+            raise ValueError(
+                f"unknown partition direction {direction!r} "
+                f"(known: {PARTITION_DIRECTIONS})"
+            )
+        with self._state_lock:
+            self._partition_dir = direction
+
+    def heal(self) -> None:
+        """End the partition: traffic flows (and the plan resumes
+        deciding) from the next frame on.  Idempotent."""
+        with self._state_lock:
+            self._partition_dir = None
+
+    @property
+    def partitioned(self) -> str | None:
+        """The active partition direction, or ``None`` when healed."""
+        with self._state_lock:
+            return self._partition_dir
+
+    @property
+    def partition_dropped(self) -> int:
+        """Frames swallowed by the partition so far (both directions)."""
+        with self._state_lock:
+            return self._partition_dropped
+
+    def _cut(self, direction: str) -> bool:
+        """True (and counted) when the active partition swallows a frame
+        headed ``direction``."""
+        with self._state_lock:
+            p = self._partition_dir
+            hit = p is not None and (p == "both" or p == direction)
+            if hit:
+                self._partition_dropped += 1
+            return hit
 
     def start(self) -> "FaultProxy":
         self._accept_thread = threading.Thread(
@@ -263,6 +328,10 @@ class FaultProxy:
                 frame = _read_frame(client)
                 if frame is None:
                     return
+                # Partition check BEFORE the plan decision: swallowed
+                # frames must not shift a seeded fault schedule.
+                if self._cut("to_server"):
+                    continue  # request never crosses; client times out
                 fault = self.plan.next_fault()
                 if fault == "drop_pre":
                     self.plan.count(fault)
@@ -287,6 +356,11 @@ class FaultProxy:
                 reply = _read_frame(up)
                 if reply is None:
                     return  # upstream died; drop the client too
+                if self._cut("to_client"):
+                    # Asymmetric cut: the server executed, the reply
+                    # never arrives — the client cannot distinguish this
+                    # from drop_post except that it is runtime-driven.
+                    continue
                 if fault == "drop_post":
                     self.plan.count(fault)
                     return  # executed upstream, reply withheld
@@ -329,9 +403,14 @@ class FaultProxy:
         self._track(client)
         up: socket.socket | None = None
         try:
-            hello = _read_frame(client)
-            if hello is None:
-                return
+            while True:
+                hello = _read_frame(client)
+                if hello is None:
+                    return
+                # A partitioned hello never reaches the upstream: the
+                # subscriber observes silence and retries after heal.
+                if not self._cut("to_server"):
+                    break
             up = socket.create_connection(self._upstream)
             self._track(up)
             up.sendall(hello)
@@ -350,6 +429,8 @@ class FaultProxy:
                         except OSError:
                             pass
                         return
+                    if self._cut("to_server"):
+                        continue  # one-way cut: the frame never crosses
                     try:
                         upstream.sendall(frame)
                     except OSError:
@@ -361,6 +442,11 @@ class FaultProxy:
                 frame = _read_frame(up)
                 if frame is None:
                     return  # upstream closed; drop the client too
+                # Partition check BEFORE the plan decision (same rule as
+                # request mode): a cut must not shift the seeded
+                # schedule for the frames that flow after heal.
+                if self._cut("to_client"):
+                    continue  # stream gaps; the digest chain will say so
                 fault = self.plan.next_fault()
                 if fault == "drop_pre":
                     self.plan.count(fault)

@@ -101,6 +101,7 @@ def doctor_report(
     backend_timeout_s: float = 30.0,
     probe_code: str | None = None,
     service_addr: tuple[str, int] | None = None,
+    federation_addr: tuple[str, int] | None = None,
 ) -> list[tuple[str, str]]:
     """Collect (check, result) pairs.  Pure data; rendering is the CLI's.
 
@@ -520,6 +521,55 @@ def doctor_report(
             return " ".join(parts)
 
         check("flight recorder", _flight)
+
+    if federation_addr is not None:
+        # The federation tier's degradation vector: which clusters are
+        # fresh, which serve explicitly-stale views, and which are LOST.
+        # A lost cluster is a hard FAILED line — every fleet total is an
+        # explicit lower bound until it resyncs, and the operator
+        # running -doctor must see that verdict, not derive it.
+        def _federation():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *federation_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                status = c.fed_status()
+            if not status.get("enabled", False):
+                return "not configured (no clusters attached)"
+            counts = status.get("counts", {})
+            parts = [
+                f"{counts.get('total')} cluster(s)",
+                f"fresh={counts.get('fresh')}",
+                f"stale={counts.get('stale')}",
+                f"lost={counts.get('lost')}",
+            ]
+            gens = [
+                f"{name}@{c_.get('generation')}"
+                for name, c_ in sorted(
+                    status.get("clusters", {}).items()
+                )
+            ]
+            if gens:
+                parts.append("generations: " + " ".join(gens))
+            excluded = status.get("excluded", [])
+            if excluded:
+                return (
+                    "FAILED: cluster(s) lost — "
+                    + ", ".join(excluded)
+                    + " excluded from fleet totals; "
+                    + " ".join(parts)
+                )
+            return "ok: " + " ".join(parts)
+
+        check("federation", _federation)
     return checks
 
 
@@ -540,6 +590,7 @@ def run_doctor(
     backend_timeout_s: float = 30.0,
     probe_code: str | None = None,
     service_addr: tuple[str, int] | None = None,
+    federation_addr: tuple[str, int] | None = None,
 ) -> tuple[str, int]:
     """Render the report; returns ``(text, exit_code)``.
 
@@ -551,6 +602,7 @@ def run_doctor(
         backend_timeout_s=backend_timeout_s,
         probe_code=probe_code,
         service_addr=service_addr,
+        federation_addr=federation_addr,
     )
     width = max(len(name) for name, _ in checks)
     lines = [f"{name:<{width}}  {result}" for name, result in checks]

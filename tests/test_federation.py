@@ -1,0 +1,925 @@
+"""Federated multi-cluster capacity: the degradation-contract chaos suite.
+
+The acceptance bar (ISSUE 12): under a seeded partition of 1-of-3
+clusters, every ``fed_sweep`` reply is bit-identical to the per-cluster
+sequential oracle at each cluster's STAMPED generation for fresh
+clusters, the partitioned cluster is explicitly marked ``stale`` with a
+bounded age (injectable clock), flips to ``lost`` past the eviction
+horizon (excluded from totals AND named), and recovers to ``fresh``
+after heal — with per-cluster watermarks monotone throughout and zero
+silently-wrong totals, in both semantics modes.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.federation import (
+    ClusterFeed,
+    FederationError,
+    FederationServer,
+)
+from kubernetesclustercapacity_tpu.federation.server import concat_snapshots
+from kubernetesclustercapacity_tpu.fixtures import load_fixture
+from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+from kubernetesclustercapacity_tpu.resilience import ClusterLostError
+from kubernetesclustercapacity_tpu.service.client import CapacityClient
+from kubernetesclustercapacity_tpu.service.plane import (
+    PlanePublisher,
+    PlaneSubscriber,
+)
+from kubernetesclustercapacity_tpu.service.replicaset import ReplicaSet
+from kubernetesclustercapacity_tpu.service.server import CapacityServer
+from kubernetesclustercapacity_tpu.snapshot import (
+    snapshot_from_fixture,
+    synthetic_snapshot,
+)
+from kubernetesclustercapacity_tpu.telemetry.metrics import MetricsRegistry
+from kubernetesclustercapacity_tpu.testing_faults import FaultPlan, FaultProxy
+
+KIND = "tests/fixtures/kind-3node.json"
+
+CPU = [100, 500, 900]
+MEM = [10 ** 8, 5 * 10 ** 8, 10 ** 9]
+REPS = [1, 8, 64]
+GRID = {
+    "cpu_request_milli": CPU,
+    "mem_request_bytes": MEM,
+    "replicas": REPS,
+}
+
+
+def _wait_for(predicate, timeout_s=10.0, interval_s=0.01, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _mutate(snap, seed):
+    """A derived generation: deterministic usage churn (same shape/
+    names, different fit answers)."""
+    rng = np.random.default_rng(seed)
+    used = snap.used_cpu_req_milli + rng.integers(
+        0, 200, size=snap.n_nodes, dtype=np.int64
+    )
+    return dataclasses.replace(snap, used_cpu_req_milli=used)
+
+
+def _oracle_totals(snap, cpu=CPU, mem=MEM):
+    """Per-cluster sequential oracle: [S] totals for one snapshot, with
+    the same implicit strict-taint mask every serving surface applies."""
+    mask = implicit_taint_mask(snap)
+    healthy = snap.healthy if mask is None else snap.healthy & mask
+    return [
+        sum(
+            fit_arrays_python(
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+                snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+                snap.pods_count, int(c), int(m), mode=snap.semantics,
+                healthy=healthy,
+            )
+        )
+        for c, m in zip(cpu, mem)
+    ]
+
+
+def _cluster_snaps(semantics, n=48):
+    """Three deterministic, distinct cluster snapshots; strict mode gets
+    unhealthy rows and a taint so the mask path is non-vacuous."""
+    snaps = {}
+    for i, name in enumerate(("east", "west", "north")):
+        snap = synthetic_snapshot(n + 8 * i, seed=30 + i)
+        if semantics == "strict":
+            healthy = snap.healthy.copy()
+            healthy[i] = False
+            taints = [[] for _ in range(snap.n_nodes)]
+            taints[2 * i + 1] = [
+                {"key": "dedicated", "value": "x", "effect": "NoSchedule"}
+            ]
+            snap = dataclasses.replace(
+                snap, semantics="strict", healthy=healthy, taints=taints
+            )
+        snaps[name] = snap
+    return snaps
+
+
+# ---------------------------------------------------------------------------
+# ClusterFeed + the state machine (offline, injectable clock)
+# ---------------------------------------------------------------------------
+class TestClusterFeed:
+    def test_generation_watermark_monotone(self):
+        feed = ClusterFeed("c", clock=lambda: 0.0)
+        snap = synthetic_snapshot(8, seed=1)
+        feed.replace_snapshot(snap, generation=5)
+        assert feed.view() == (snap, 5)
+        with pytest.raises(ValueError, match="must not regress"):
+            feed.replace_snapshot(snap, generation=3)
+        # Equal re-stage is idempotent redelivery (the subscriber's
+        # digest-checked path), never a regression.
+        feed.replace_snapshot(snap, generation=5)
+        # Un-numbered stages increment locally.
+        feed.replace_snapshot(snap)
+        assert feed.view()[1] == 6
+
+    def test_verified_age_tracks_injected_clock(self):
+        now = [100.0]
+        feed = ClusterFeed("c", clock=lambda: now[0])
+        assert feed.last_verified_age_s() is None
+        feed.replace_snapshot(synthetic_snapshot(4, seed=2))
+        now[0] = 107.5
+        assert feed.last_verified_age_s() == pytest.approx(7.5)
+
+
+class TestDegradationStates:
+    def _fed(self, **kw):
+        kw.setdefault("stale_after_s", 5.0)
+        kw.setdefault("evict_after_s", 20.0)
+        return FederationServer(**kw)
+
+    def test_never_synced_is_lost(self):
+        now = [0.0]
+        with self._fed(clock=lambda: now[0]) as fed:
+            fed.attach("ghost", ("127.0.0.1", 1))  # nothing listens there
+            status = fed.status()
+            assert status["clusters"]["ghost"]["state"] == "lost"
+            assert status["excluded"] == ["ghost"]
+            assert not fed.healthy()
+
+    def test_fresh_stale_lost_transitions_at_exact_bounds(self):
+        now = [0.0]
+        with self._fed(clock=lambda: now[0]) as fed:
+            fed.inject("c", synthetic_snapshot(8, seed=3))
+
+            def state():
+                return fed.status()["clusters"]["c"]["state"]
+
+            assert state() == "fresh"
+            now[0] = 5.0  # == stale_after_s: inclusive fresh
+            assert state() == "fresh"
+            now[0] = 5.001
+            assert state() == "stale"
+            now[0] = 20.0  # == evict_after_s: inclusive stale
+            assert state() == "stale"
+            assert fed.healthy()
+            now[0] = 20.001
+            assert state() == "lost"
+            assert not fed.healthy()
+            # Heal: a new verified stage flips straight back to fresh.
+            fed.inject("c", synthetic_snapshot(8, seed=3))
+            assert state() == "fresh" and fed.healthy()
+
+    def test_horizon_validation(self):
+        with pytest.raises(ValueError, match="must exceed"):
+            FederationServer(stale_after_s=10.0, evict_after_s=10.0)
+        with pytest.raises(ValueError, match="stale_after_s"):
+            FederationServer(stale_after_s=0.0, evict_after_s=1.0)
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_FED_STALE_AFTER_S", "3.5")
+        monkeypatch.setenv("KCCAP_FED_EVICT_AFTER_S", "7.25")
+        with FederationServer() as fed:
+            assert fed.stale_after_s == 3.5
+            assert fed.evict_after_s == 7.25
+
+    def test_duplicate_cluster_refused(self):
+        with self._fed() as fed:
+            fed.inject("c", synthetic_snapshot(4, seed=4))
+            with pytest.raises(FederationError, match="duplicate"):
+                fed._register("c", ClusterFeed("c"), None)
+
+
+# ---------------------------------------------------------------------------
+# Query semantics vs the sequential oracle (offline)
+# ---------------------------------------------------------------------------
+class TestFedQueriesOracle:
+    @pytest.mark.parametrize("semantics", ["reference", "strict"])
+    def test_fed_sweep_per_cluster_bit_exact(self, semantics):
+        now = [0.0]
+        with FederationServer(
+            stale_after_s=5.0, evict_after_s=20.0, clock=lambda: now[0]
+        ) as fed:
+            snaps = _cluster_snaps(semantics)
+            for i, (name, snap) in enumerate(snaps.items()):
+                fed.inject(name, snap, generation=i + 1)
+            r = fed.dispatch({"op": "fed_sweep", **GRID})
+            grand = [0] * len(CPU)
+            for name, snap in snaps.items():
+                want = _oracle_totals(snap)
+                assert r["per_cluster"][name] == want, name
+                grand = [g + w for g, w in zip(grand, want)]
+                assert r["clusters"][name]["state"] == "fresh"
+            assert r["totals"] == grand
+            assert r["schedulable"] == [t >= k for t, k in zip(grand, REPS)]
+            assert r["excluded"] == [] and not r["degraded"]
+
+    def test_mixed_semantics_groups_stay_exact(self):
+        """A reference cluster and a strict cluster federate: one
+        dispatch per semantics group, both bit-exact."""
+        with FederationServer(stale_after_s=5.0, evict_after_s=20.0) as fed:
+            ref = synthetic_snapshot(40, seed=50)
+            strict = dataclasses.replace(
+                synthetic_snapshot(52, seed=51), semantics="strict"
+            )
+            fed.inject("ref", ref)
+            fed.inject("strict", strict)
+            r = fed.dispatch({"op": "fed_sweep", **GRID})
+            assert r["per_cluster"]["ref"] == _oracle_totals(ref)
+            assert r["per_cluster"]["strict"] == _oracle_totals(strict)
+
+    def test_stale_cluster_counted_but_annotated(self):
+        now = [0.0]
+        with FederationServer(
+            stale_after_s=5.0, evict_after_s=20.0, clock=lambda: now[0]
+        ) as fed:
+            snaps = _cluster_snaps("reference")
+            for name, snap in snaps.items():
+                fed.inject(name, snap)
+            now[0] = 8.0
+            for name, snap in snaps.items():
+                if name != "east":
+                    fed.inject(name, snap)  # the survivors re-verify
+            r = fed.dispatch({"op": "fed_sweep", **GRID})
+            assert r["clusters"]["east"]["state"] == "stale"
+            assert r["clusters"]["east"]["age_s"] == pytest.approx(8.0)
+            assert r["degraded"] is True
+            # Counted — at its last VERIFIED generation, still bit-exact.
+            assert r["per_cluster"]["east"] == _oracle_totals(snaps["east"])
+            assert r["excluded"] == []
+
+    def test_lost_cluster_excluded_and_named_never_silently_summed(self):
+        now = [0.0]
+        with FederationServer(
+            stale_after_s=5.0, evict_after_s=20.0, clock=lambda: now[0]
+        ) as fed:
+            snaps = _cluster_snaps("reference")
+            for name, snap in snaps.items():
+                fed.inject(name, snap)
+            now[0] = 30.0
+            for name, snap in snaps.items():
+                if name != "east":
+                    fed.inject(name, snap)
+            r = fed.dispatch({"op": "fed_sweep", **GRID})
+            assert r["excluded"] == ["east"]
+            assert "east" not in r["per_cluster"]
+            assert r["clusters"]["east"]["state"] == "lost"
+            survivors = [
+                sum(r["per_cluster"][n][s] for n in ("west", "north"))
+                for s in range(len(CPU))
+            ]
+            assert r["totals"] == survivors
+
+    def test_fed_rank_headroom_and_costs(self):
+        with FederationServer(stale_after_s=5.0, evict_after_s=20.0) as fed:
+            snaps = _cluster_snaps("reference")
+            for name, snap in snaps.items():
+                fed.inject(name, snap)
+            r = fed.dispatch(
+                {"op": "fed_rank", "cpuRequests": "500m",
+                 "memRequests": "500mb", "replicas": "4"}
+            )
+            totals = [row["total"] for row in r["ranking"]]
+            assert totals == sorted(totals, reverse=True)
+            assert [row["rank"] for row in r["ranking"]] == [1, 2, 3]
+            # A costs map reorders the FITTING clusters cheapest-first
+            # (an un-costed cluster ranks after every costed one).
+            by_headroom = [row["cluster"] for row in r["ranking"]]
+            costs = {by_headroom[0]: 9.0, by_headroom[2]: 0.1}
+            r2 = fed.dispatch(
+                {"op": "fed_rank", "cpuRequests": "500m",
+                 "memRequests": "500mb", "replicas": "4", "costs": costs}
+            )
+            assert [row["cluster"] for row in r2["ranking"]] == [
+                by_headroom[2], by_headroom[0], by_headroom[1]
+            ]
+
+    def test_fed_rank_rejects_multi_scenario(self):
+        with FederationServer(stale_after_s=5.0, evict_after_s=20.0) as fed:
+            fed.inject("c", synthetic_snapshot(8, seed=5))
+            with pytest.raises(ValueError, match="one scenario"):
+                fed.dispatch({"op": "fed_rank", **GRID})
+
+    def test_spillover_demand_and_greedy_fill(self):
+        with FederationServer(stale_after_s=5.0, evict_after_s=20.0) as fed:
+            snaps = _cluster_snaps("reference")
+            for name, snap in snaps.items():
+                fed.inject(name, snap)
+            r = fed.dispatch(
+                {"op": "spillover", "cluster": "east",
+                 "cpuRequests": "500m", "memRequests": "500mb"}
+            )
+            assert r["demand"] == int(snaps["east"].pods_count.sum())
+            placed = sum(p["replicas"] for p in r["placements"])
+            assert placed + r["unplaced"] == r["demand"]
+            assert r["absorbed"] == (r["unplaced"] == 0)
+            # Greedy, most headroom first; no placement exceeds headroom.
+            headrooms = [p["headroom"] for p in r["placements"]]
+            assert headrooms == sorted(headrooms, reverse=True)
+            for p in r["placements"]:
+                assert 0 <= p["replicas"] <= max(p["headroom"], 0)
+            # Explicit demand override.
+            r2 = fed.dispatch(
+                {"op": "spillover", "cluster": "east", "demand": 1,
+                 "cpuRequests": "500m", "memRequests": "500mb"}
+            )
+            assert r2["demand"] == 1 and r2["absorbed"]
+
+    def test_spillover_of_lost_cluster_is_typed_refusal(self):
+        now = [0.0]
+        with FederationServer(
+            stale_after_s=5.0, evict_after_s=20.0, clock=lambda: now[0]
+        ) as fed:
+            snaps = _cluster_snaps("reference")
+            for name, snap in snaps.items():
+                fed.inject(name, snap)
+            now[0] = 30.0
+            for name, snap in snaps.items():
+                if name != "east":
+                    fed.inject(name, snap)
+            with pytest.raises(ClusterLostError, match="east"):
+                fed.dispatch({"op": "spillover", "cluster": "east"})
+            with pytest.raises(FederationError, match="unknown"):
+                fed.dispatch({"op": "spillover", "cluster": "nowhere"})
+
+    def test_concat_matches_members_and_single_passthrough(self):
+        snaps = list(_cluster_snaps("strict").values())
+        combined = concat_snapshots(snaps)
+        assert combined.n_nodes == sum(s.n_nodes for s in snaps)
+        assert combined.semantics == "strict"
+        assert concat_snapshots([snaps[0]]) is snaps[0]
+        # Taints concatenate positionally (the implicit-mask input).
+        off = snaps[0].n_nodes
+        assert combined.taints[off + 1] == snaps[1].taints[1]
+
+    def test_all_lost_fleet_answers_zero_with_everything_named(self):
+        now = [0.0]
+        with FederationServer(
+            stale_after_s=1.0, evict_after_s=2.0, clock=lambda: now[0]
+        ) as fed:
+            fed.inject("a", synthetic_snapshot(8, seed=6))
+            fed.inject("b", synthetic_snapshot(8, seed=7))
+            now[0] = 10.0
+            r = fed.dispatch({"op": "fed_sweep", **GRID})
+            assert r["totals"] == [0] * len(CPU)
+            assert sorted(r["excluded"]) == ["a", "b"]
+            assert r["per_cluster"] == {}
+
+
+# ---------------------------------------------------------------------------
+# The wire chaos suite: 3 leaders behind seeded fault proxies
+# ---------------------------------------------------------------------------
+class _Fleet:
+    """3 cluster leaders, each behind a stream-mode fault proxy, one
+    FederationServer subscribed through the proxies on an injected
+    clock, and a wire client — torn down in reverse."""
+
+    def __init__(self, semantics, *, plans=None, stale=2.0, evict=6.0):
+        self.now = [0.0]
+        self.names = ("east", "west", "north")
+        self.snaps = _cluster_snaps(semantics)
+        self.leaders = {}
+        self.pubs = {}
+        self.proxies = {}
+        self.oracle = {}  # (cluster, generation) -> snapshot
+        for name in self.names:
+            pub = PlanePublisher(heartbeat_s=0.1)
+            server = CapacityServer(
+                self.snaps[name], port=0, plane=pub, batch_window_ms=0.0
+            )
+            server.start()
+            plan = (plans or {}).get(name) or FaultPlan([])
+            proxy = FaultProxy(pub.address, plan, stream=True).start()
+            self.leaders[name], self.pubs[name] = server, pub
+            self.proxies[name] = proxy
+            self.oracle[(name, server.generation)] = self.snaps[name]
+        self.fed = FederationServer(
+            {n: self.proxies[n].address for n in self.names},
+            stale_after_s=stale,
+            evict_after_s=evict,
+            clock=lambda: self.now[0],
+            seed=7,
+        ).start()
+        self.client = CapacityClient(*self.fed.address)
+
+    def publish(self, name, snap):
+        self.leaders[name].replace_snapshot(snap)
+        self.oracle[(name, self.leaders[name].generation)] = snap
+
+    def wait_state(self, want, timeout_s=15.0):
+        def ok():
+            states = {
+                n: c["state"]
+                for n, c in self.fed.status()["clusters"].items()
+            }
+            return states == want
+
+        _wait_for(ok, timeout_s=timeout_s, what=f"states {want}")
+
+    def wait_generation(self, name, generation, timeout_s=15.0):
+        _wait_for(
+            lambda: self.fed.status()["clusters"][name]["generation"]
+            >= generation,
+            timeout_s=timeout_s,
+            what=f"{name} at generation {generation}",
+        )
+
+    def close(self):
+        self.client.close()
+        self.fed.close()
+        for name in self.names:
+            self.proxies[name].stop()
+            self.pubs[name].close()
+            self.leaders[name].shutdown()
+
+
+def _assert_reply_exact(fleet, reply, *, exclude=()):
+    """Every per-cluster row bit-identical to the sequential oracle at
+    the STAMPED generation, grand totals exactly their sum, lost
+    clusters named — the zero-silently-wrong-totals pin."""
+    grand = [0] * len(CPU)
+    for name, totals in reply["per_cluster"].items():
+        gen = reply["clusters"][name]["generation"]
+        snap = fleet.oracle[(name, gen)]
+        want = _oracle_totals(snap)
+        assert totals == want, (name, gen)
+        grand = [g + w for g, w in zip(grand, want)]
+    assert reply["totals"] == grand
+    assert sorted(reply["excluded"]) == sorted(exclude)
+    for name in exclude:
+        assert name not in reply["per_cluster"]
+
+
+@pytest.mark.parametrize("semantics", ["reference", "strict"])
+def test_partition_stale_lost_heal_contract(semantics):
+    """THE acceptance test: seeded partition of 1-of-3 clusters mid-run;
+    fresh clusters bit-exact throughout, the partitioned one explicitly
+    stale (bounded age) → lost (excluded, named) → fresh after heal;
+    per-cluster watermarks monotone across every reply."""
+    fleet = _Fleet(semantics)
+    watermarks = {n: 0 for n in fleet.names}
+
+    def query():
+        r = fleet.client.fed_sweep(**GRID)
+        for n, entry in r["clusters"].items():
+            assert entry["generation"] >= watermarks[n], (
+                f"{n} watermark regressed: "
+                f"{entry['generation']} < {watermarks[n]}"
+            )
+            watermarks[n] = entry["generation"]
+        return r
+
+    try:
+        fleet.wait_state({n: "fresh" for n in fleet.names})
+        r = query()
+        _assert_reply_exact(fleet, r)
+
+        # Churn: every leader publishes a derived generation; the
+        # federation converges and answers stay exact.
+        for i, name in enumerate(fleet.names):
+            fleet.publish(name, _mutate(fleet.snaps[name], seed=60 + i))
+        for name in fleet.names:
+            fleet.wait_generation(name, 2)
+        r = query()
+        _assert_reply_exact(fleet, r)
+        assert all(
+            r["clusters"][n]["generation"] >= 2 for n in fleet.names
+        )
+
+        # PARTITION east mid-run (runtime control, no proxy restart).
+        fleet.proxies["east"].partition("both")
+        fleet.now[0] = 3.0  # past stale (2), inside evict (6)
+        # The survivors' heartbeats re-verify them at the advanced
+        # clock; east can only age.
+        fleet.wait_state(
+            {"east": "stale", "west": "fresh", "north": "fresh"}
+        )
+        r = query()
+        east = r["clusters"]["east"]
+        assert east["state"] == "stale"
+        assert 2.0 < east["age_s"] <= 6.0  # bounded, explicit
+        assert r["degraded"] is True
+        _assert_reply_exact(fleet, r)  # stale view still exact at its gen
+        assert fleet.proxies["east"].partition_dropped > 0
+
+        # A generation east publishes DURING the partition must not
+        # appear anywhere (nothing crossed the cut).
+        fleet.publish("east", _mutate(fleet.snaps["east"], seed=99))
+        r = query()
+        assert r["clusters"]["east"]["generation"] == watermarks["east"]
+
+        # Past the eviction horizon: lost, excluded, named.
+        fleet.now[0] = 7.0
+        fleet.wait_state(
+            {"east": "lost", "west": "fresh", "north": "fresh"}
+        )
+        assert not fleet.fed.healthy()
+        r = query()
+        _assert_reply_exact(fleet, r, exclude=["east"])
+
+        # HEAL: resubscription resumes (checkpoint: east moved on while
+        # partitioned) and east serves fresh again — with the
+        # partition-era generation finally visible, watermark advanced,
+        # never regressed.
+        fleet.proxies["east"].heal()
+        fleet.wait_state(
+            {"east": "fresh", "west": "fresh", "north": "fresh"}
+        )
+        fleet.wait_generation("east", 3)
+        r = query()
+        _assert_reply_exact(fleet, r)
+        assert r["clusters"]["east"]["generation"] >= 3
+        assert fleet.fed.healthy()
+    finally:
+        fleet.close()
+
+
+def test_garbled_streams_never_misapply():
+    """Seeded garbage/gap faults on every leader link: the digest chain
+    refuses every corrupted frame, resyncs, and every reply stays
+    bit-exact at its stamped generations."""
+    plans = {
+        name: FaultPlan.seeded(
+            1000 + i, 40, fault_rate=0.3, faults=("garbage", "drop_pre")
+        )
+        for i, name in enumerate(("east", "west", "north"))
+    }
+    fleet = _Fleet("reference", plans=plans, stale=8.0, evict=30.0)
+    try:
+        fleet.wait_state({n: "fresh" for n in fleet.names})
+        for round_i in range(4):
+            for i, name in enumerate(fleet.names):
+                fleet.publish(
+                    name,
+                    _mutate(fleet.snaps[name], seed=200 + 10 * round_i + i),
+                )
+            for name in fleet.names:
+                fleet.wait_generation(name, 2 + round_i)
+            r = fleet.client.fed_sweep(**GRID)
+            _assert_reply_exact(fleet, r)
+        injected = sum(
+            sum(p.plan.injected.values()) for p in fleet.proxies.values()
+        )
+        assert injected > 0, "the chaos plan never fired — vacuous test"
+    finally:
+        fleet.close()
+
+
+def test_asymmetric_partition_one_way_drop():
+    """to_client: the leader still hears the subscriber (hello crosses)
+    but no frame ever returns — the cluster goes stale exactly like a
+    symmetric cut, then heals."""
+    fleet = _Fleet("reference", stale=2.0, evict=30.0)
+    try:
+        fleet.wait_state({n: "fresh" for n in fleet.names})
+        fleet.proxies["west"].partition("to_client")
+        fleet.now[0] = 3.0
+        fleet.wait_state(
+            {"east": "fresh", "west": "stale", "north": "fresh"}
+        )
+        assert fleet.proxies["west"].partition_dropped > 0
+        fleet.proxies["west"].heal()
+        fleet.wait_state({n: "fresh" for n in fleet.names})
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# The verified-age accessors (satellite)
+# ---------------------------------------------------------------------------
+class TestSubscriberVerifiedAge:
+    def test_heartbeats_keep_verified_age_bounded(self):
+        now = [0.0]
+        snap = synthetic_snapshot(8, seed=8)
+        pub = PlanePublisher(heartbeat_s=0.05)
+        leader = CapacityServer(snap, port=0, plane=pub, batch_window_ms=0.0)
+        leader.start()
+        replica = CapacityServer(snap, port=0, batch_window_ms=0.0)
+        replica.start()
+        sub = PlaneSubscriber(
+            pub.address, replica, stale_after_s=30.0, clock=lambda: now[0]
+        )
+        try:
+            _wait_for(
+                lambda: sub.last_verified_age_s() is not None,
+                what="first verification",
+            )
+            now[0] = 50.0
+            # The next heartbeat (stamped with the HELD generation)
+            # re-verifies at the advanced clock.
+            _wait_for(
+                lambda: sub.last_verified_age_s() == pytest.approx(0.0),
+                what="heartbeat re-verification",
+            )
+            # Leader gone: the verified age can only grow.
+            pub.close()
+            leader.shutdown()
+            time.sleep(0.2)
+            now[0] = 60.0
+            age = sub.last_verified_age_s()
+            assert age is not None and age >= 10.0
+        finally:
+            sub.stop()
+            replica.shutdown()
+            pub.close()
+            leader.shutdown()
+
+    def test_subscriber_stats_shape_pinned(self):
+        """The stats() dict is a wire/ops surface — the verified-age
+        accessor rides separately, and this shape must not drift."""
+        snap = synthetic_snapshot(4, seed=9)
+        server = CapacityServer(snap, port=0, batch_window_ms=0.0)
+        sub = PlaneSubscriber(("127.0.0.1", 1), server, stale_after_s=1.0)
+        try:
+            assert set(sub.stats().keys()) == {
+                "role", "leader", "generation", "digest", "applied",
+                "skipped", "resyncs", "errors", "leader_draining",
+                "sync_age_s", "stale", "stale_after_s", "last_error",
+            }
+        finally:
+            sub.stop()
+            server.shutdown()
+
+
+class TestFollowerVerifiedAge:
+    def _follower(self, clock):
+        from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+        from kubernetesclustercapacity_tpu.follower import ClusterFollower
+        from kubernetesclustercapacity_tpu.kubeapi import (
+            KubeClient,
+            KubeConfig,
+        )
+
+        from test_kubeapi import MockApiserver
+
+        fixture = synthetic_fixture(4, seed=41, unhealthy_frac=0.0)
+        server = MockApiserver(fixture, require_token="tok")
+        cfg = KubeConfig(f"http://127.0.0.1:{server.port}", token="tok")
+        follower = ClusterFollower(
+            client_factory=lambda: KubeClient(cfg),
+            stop_on_idle_window=True,
+            clock=clock,
+        )
+        return follower, server
+
+    def test_last_verified_age_uses_injected_clock(self):
+        now = [10.0]
+        follower, server = self._follower(lambda: now[0])
+        try:
+            assert follower.last_verified_age_s() is None
+            follower.start(watch=False)
+            assert follower.last_verified_age_s() == pytest.approx(0.0)
+            now[0] = 17.0
+            assert follower.last_verified_age_s() == pytest.approx(7.0)
+            assert follower.last_relist_age_s() == pytest.approx(7.0)
+        finally:
+            follower.stop()
+            server.close()
+
+    def test_follower_stats_shape_pinned(self):
+        """Regression pin: the stats() dict shape is a wire surface
+        (info op / doctor); the new accessor must NOT widen it."""
+        now = [0.0]
+        follower, server = self._follower(lambda: now[0])
+        try:
+            assert set(follower.stats().keys()) == {
+                "relists", "relist_failures", "watch_failures",
+                "events_applied", "backoff_s", "recent_errors",
+                "pdb_unavailable", "fatal",
+            }
+        finally:
+            follower.stop()
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSet over federation endpoints (satellite)
+# ---------------------------------------------------------------------------
+class TestReplicaSetFederation:
+    def _two_feds(self):
+        """fed_a holds 'east' LOST (aged out); fed_b holds it fresh."""
+        now_a = [100.0]
+        fed_a = FederationServer(
+            stale_after_s=1.0, evict_after_s=2.0, clock=lambda: now_a[0]
+        )
+        fed_b = FederationServer(stale_after_s=30.0, evict_after_s=60.0)
+        snap = synthetic_snapshot(16, seed=70)
+        fed_a.inject("east", snap, generation=4)
+        now_a[0] = 110.0  # east aged past fed_a's horizon: lost
+        fed_b.inject("east", snap, generation=4)
+        fed_a.start()
+        fed_b.start()
+        return fed_a, fed_b
+
+    def test_cluster_lost_wire_code_is_typed(self):
+        fed_a, fed_b = self._two_feds()
+        try:
+            with CapacityClient(*fed_a.address) as c:
+                with pytest.raises(ClusterLostError):
+                    c.spillover("east")
+        finally:
+            fed_a.close()
+            fed_b.close()
+
+    def test_probe_demotes_lost_endpoint_and_call_fails_over(self):
+        fed_a, fed_b = self._two_feds()
+        rs = ReplicaSet(
+            [fed_a.address, fed_b.address], cluster="east", rounds=2
+        )
+        try:
+            probe = rs.probe()
+            assert probe[0]["cluster_state"] == "lost"
+            assert probe[1]["cluster_state"] == "fresh"
+            stats = rs.stats()
+            assert stats["endpoints"][0]["lost"] is True
+            assert stats["endpoints"][1]["lost"] is False
+            # Demoted like draining: the healthy endpoint rotates first.
+            assert rs._rotation()[0].name == rs.endpoints[1]
+            r = rs.call("spillover", cluster="east")
+            assert r["cluster"] == "east"  # answered by fed_b
+        finally:
+            rs.close()
+            fed_a.close()
+            fed_b.close()
+
+    def test_midcall_cluster_lost_refusal_marks_endpoint(self):
+        fed_a, fed_b = self._two_feds()
+        rs = ReplicaSet(
+            [fed_a.address, fed_b.address], cluster="east", rounds=2
+        )
+        try:
+            # No probe: the first call hits fed_a, takes the typed
+            # refusal, marks it lost, and retries elsewhere.
+            r = rs.call("spillover", cluster="east")
+            assert r["cluster"] == "east"
+            assert rs.stats()["endpoints"][0]["lost"] is True
+        finally:
+            rs.close()
+            fed_a.close()
+            fed_b.close()
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: client wrappers, CLI, reports, metrics, doctor
+# ---------------------------------------------------------------------------
+class TestFedSurfaces:
+    @pytest.fixture()
+    def fed_wire(self):
+        now = [0.0]
+        fed = FederationServer(
+            stale_after_s=5.0, evict_after_s=20.0, clock=lambda: now[0]
+        )
+        snaps = _cluster_snaps("reference")
+        for i, (name, snap) in enumerate(snaps.items()):
+            fed.inject(name, snap, generation=i + 1)
+        fed.start()
+        yield fed, snaps, now
+        fed.close()
+
+    def test_client_wrappers_round_trip(self, fed_wire):
+        fed, snaps, _now = fed_wire
+        with CapacityClient(*fed.address) as c:
+            status = c.fed_status()
+            assert status["enabled"] and status["healthy"]
+            assert status["counts"] == {
+                "fresh": 3, "stale": 0, "lost": 0, "total": 3,
+            }
+            sweep = c.fed_sweep(
+                cpu_request_milli=np.asarray(CPU),
+                mem_request_bytes=np.asarray(MEM),
+                replicas=np.asarray(REPS),
+            )
+            assert sweep["per_cluster"]["east"] == _oracle_totals(
+                snaps["east"]
+            )
+            rank = c.fed_rank(cpuRequests="500m", memRequests="500mb")
+            assert len(rank["ranking"]) == 3
+            spill = c.spillover("west", demand=2)
+            assert spill["demand"] == 2
+            info = c.info()
+            assert info["capabilities"]["federation"] is True
+
+    def test_auth_token_gates_every_op_but_ping(self):
+        fed = FederationServer(
+            stale_after_s=5.0, evict_after_s=20.0, auth_token="sesame"
+        )
+        fed.inject("c", synthetic_snapshot(8, seed=11))
+        fed.start()
+        try:
+            with CapacityClient(*fed.address) as c:
+                assert c.ping() == "pong"
+                with pytest.raises(RuntimeError, match="auth token"):
+                    c.fed_status()
+            with CapacityClient(*fed.address, token="sesame") as c:
+                assert c.fed_status()["enabled"]
+        finally:
+            fed.close()
+
+    def test_cli_fed_status_exit_codes_and_reports(self, fed_wire, capsys):
+        from kubernetesclustercapacity_tpu import cli
+
+        fed, snaps, now = fed_wire
+        addr = f"127.0.0.1:{fed.address[1]}"
+        assert cli.main(["-fed-status", addr]) == 0
+        out = capsys.readouterr().out
+        assert "fresh" in out and "verdict: ok" in out
+        # JSON form parses and carries the vector.
+        import json as _json
+
+        assert cli.main(["-fed-status", addr, "-output", "json"]) == 0
+        parsed = _json.loads(capsys.readouterr().out)
+        assert set(parsed["clusters"]) == set(snaps)
+        # A lost cluster flips the exit code (and is named).
+        now[0] = 30.0
+        for i, (name, snap) in enumerate(snaps.items()):
+            if name != "east":
+                fed.inject(name, snap, generation=10 + i)
+        assert cli.main(["-fed-status", addr]) == 1
+        out = capsys.readouterr().out
+        assert "DEGRADED" in out and "east" in out
+
+    def test_cli_fed_sweep_exit_codes(self, fed_wire, capsys):
+        from kubernetesclustercapacity_tpu import cli
+
+        fed, snaps, now = fed_wire
+        addr = f"127.0.0.1:{fed.address[1]}"
+        assert cli.main(["-fed-sweep", addr, "-cpuRequests", "100m",
+                         "-memRequests", "100mb", "-replicas", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet totals" in out
+        # An unschedulable scenario exits 1.
+        assert cli.main(["-fed-sweep", addr, "-cpuRequests", "100m",
+                         "-memRequests", "100mb",
+                         "-replicas", "99999999"]) == 1
+        capsys.readouterr()
+        # A lost cluster exits 1 even when schedulable, and is named.
+        now[0] = 30.0
+        for i, (name, snap) in enumerate(snaps.items()):
+            if name != "east":
+                fed.inject(name, snap, generation=10 + i)
+        assert cli.main(["-fed-sweep", addr, "-cpuRequests", "100m",
+                         "-memRequests", "100mb", "-replicas", "1"]) == 1
+        out = capsys.readouterr().out
+        assert "EXCLUDED" in out and "east" in out
+
+    def test_metrics_gauges_and_sweep_counter(self):
+        now = [0.0]
+        registry = MetricsRegistry()
+        fed = FederationServer(
+            stale_after_s=5.0, evict_after_s=20.0, clock=lambda: now[0],
+            registry=registry,
+        )
+        try:
+            fed.inject("east", synthetic_snapshot(8, seed=12), generation=3)
+            fed.dispatch({"op": "fed_sweep", **GRID})
+            fed.dispatch({"op": "fed_sweep", **GRID})
+            snap = registry.snapshot()
+            key = 'cluster="east"'
+            assert snap["kccap_fed_cluster_up"]["values"][key] == 1.0
+            assert snap["kccap_fed_generation"]["values"][key] == 3.0
+            assert snap["kccap_fed_staleness_seconds"]["values"][
+                key
+            ] == pytest.approx(0.0)
+            assert snap["kccap_fed_sweep_total"]["values"][""] == 2
+            now[0] = 8.0
+            snap = registry.snapshot()
+            assert snap["kccap_fed_cluster_up"]["values"][key] == 0.0
+            assert snap["kccap_fed_staleness_seconds"]["values"][
+                key
+            ] == pytest.approx(8.0)
+        finally:
+            fed.close()
+
+    def test_doctor_federation_line(self, fed_wire):
+        from kubernetesclustercapacity_tpu.utils.doctor import run_doctor
+
+        fed, snaps, now = fed_wire
+        out, code = run_doctor(
+            backend_timeout_s=10.0,
+            probe_code="print('DEVICES 0s D x1')",
+            federation_addr=fed.address,
+        )
+        line = next(
+            ln for ln in out.splitlines() if ln.startswith("federation")
+        )
+        assert "ok: 3 cluster(s)" in line and "fresh=3" in line
+        assert code == 0
+        now[0] = 30.0
+        for i, (name, snap) in enumerate(snaps.items()):
+            if name != "east":
+                fed.inject(name, snap, generation=10 + i)
+        out, code = run_doctor(
+            backend_timeout_s=10.0,
+            probe_code="print('DEVICES 0s D x1')",
+            federation_addr=fed.address,
+        )
+        line = next(
+            ln for ln in out.splitlines() if ln.startswith("federation")
+        )
+        assert "FAILED" in line and "east" in line
+        assert code == 1

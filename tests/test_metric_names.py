@@ -98,6 +98,19 @@ def test_scan_finds_the_registry_families():
     assert len(names) > 20
 
 
+def test_scan_finds_the_federation_families():
+    """Non-vacuous pin for the federation tier: the walk must see every
+    kccap_fed_* family (so the README-documentation and snake_case
+    gates below actually cover them)."""
+    names = _source_metric_names()
+    assert {
+        "kccap_fed_cluster_up",
+        "kccap_fed_staleness_seconds",
+        "kccap_fed_generation",
+        "kccap_fed_sweep_total",
+    } <= names
+
+
 def test_metric_names_are_prefixed_snake_case():
     bad = sorted(
         n for n in _source_metric_names() if not _SNAKE_RE.fullmatch(n)
@@ -148,6 +161,9 @@ def test_env_scan_finds_the_known_switches():
     # Sanity: a broken scan must fail loudly, not vacuously pass.
     names = _source_env_names()
     assert {"KCCAP_TELEMETRY", "KCCAP_DEVCACHE"} <= names
+    # The federation horizons: the walk must see them so the README
+    # configuration-table gate below covers them.
+    assert {"KCCAP_FED_STALE_AFTER_S", "KCCAP_FED_EVICT_AFTER_S"} <= names
 
 
 def test_every_env_var_is_documented_in_readme():

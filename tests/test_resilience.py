@@ -285,6 +285,115 @@ class TestChaos:
             FaultPlan(["explode"])
 
 
+class TestPartitionControl:
+    """Runtime partition()/heal() on the fault proxy: deterministic
+    mid-test network partitions and asymmetric one-way drops, driven
+    from the test thread without restarting the proxy (the federation
+    chaos suite's substrate)."""
+
+    def test_partition_then_heal_mid_sequence(self, server):
+        plan = FaultPlan([])
+        with FaultProxy(server.address, plan) as proxy:
+            client = CapacityClient(
+                *proxy.address, retry=_fast_retry(), timeout_s=0.3
+            )
+            assert client.ping() == "pong"
+            forwarded_before = plan.forwarded
+            proxy.partition("both")
+            assert proxy.partitioned == "both"
+            # The request is swallowed: the client sees pure silence
+            # (read timeout), never an answer, never a reset.
+            with pytest.raises(Exception):
+                client.ping(deadline_s=0.4)
+            assert proxy.partition_dropped > 0
+            proxy.heal()
+            assert proxy.partitioned is None
+            assert client.ping() == "pong"
+            # Swallowed frames consumed NO plan decisions: the schedule
+            # stays aligned to the frames that actually crossed.
+            assert plan.forwarded > forwarded_before
+            client.close()
+
+    def test_asymmetric_to_client_drop_executes_but_never_answers(
+        self, server
+    ):
+        """One-way cut on the reply leg: the request crosses (the server
+        executed — forwarded counted), the answer never comes back."""
+        plan = FaultPlan([])
+        with FaultProxy(server.address, plan) as proxy:
+            client = CapacityClient(
+                *proxy.address, retry=RetryPolicy(max_attempts=1),
+                timeout_s=0.3,
+            )
+            proxy.partition("to_client")
+            forwarded_before = plan.forwarded
+            with pytest.raises(Exception):
+                client.ping(deadline_s=0.4)
+            assert plan.forwarded == forwarded_before + 1  # it executed
+            assert proxy.partition_dropped == 1  # the reply was cut
+            proxy.heal()
+            client.close()
+
+    def test_partition_direction_validated(self, server):
+        with FaultProxy(server.address, FaultPlan([])) as proxy:
+            with pytest.raises(ValueError, match="unknown partition"):
+                proxy.partition("sideways")
+            proxy.heal()  # idempotent on a never-partitioned proxy
+
+    def test_stream_mode_partition_starves_subscriber_then_heals(self):
+        """Stream mode: a partitioned plane link stops staging new
+        generations; heal resumes through the digest chain (checkpoint
+        resync), with no proxy restart."""
+        import dataclasses
+
+        import numpy as np
+
+        from kubernetesclustercapacity_tpu.service.plane import (
+            PlanePublisher,
+            PlaneSubscriber,
+        )
+        from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+        def _wait(predicate, timeout_s=10.0):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if predicate():
+                    return
+                time.sleep(0.01)
+            raise AssertionError("timed out")
+
+        snap = synthetic_snapshot(16, seed=5)
+        pub = PlanePublisher(heartbeat_s=0.1)
+        leader = CapacityServer(snap, port=0, plane=pub, batch_window_ms=0.0)
+        leader.start()
+        replica = CapacityServer(snap, port=0, batch_window_ms=0.0)
+        replica.start()
+        proxy = FaultProxy(pub.address, FaultPlan([]), stream=True).start()
+        sub = PlaneSubscriber(proxy.address, replica, stale_after_s=1.0)
+        try:
+            _wait(lambda: sub.applied_generation >= 1)
+            proxy.partition("both")
+            snap2 = dataclasses.replace(
+                snap,
+                used_cpu_req_milli=snap.used_cpu_req_milli
+                + np.int64(100),
+            )
+            leader.replace_snapshot(snap2)
+            time.sleep(0.3)  # the diff is swallowed, not applied
+            assert sub.applied_generation == 1
+            assert proxy.partition_dropped > 0
+            proxy.heal()
+            # Heal: either the gap-detecting heartbeat or the read
+            # timeout forces a resync; generation 2 stages verified.
+            _wait(lambda: sub.applied_generation >= 2)
+        finally:
+            sub.stop()
+            proxy.stop()
+            replica.shutdown()
+            pub.close()
+            leader.shutdown()
+
+
 class TestNonRetry:
     """update/reload are at-most-once: a transport failure surfaces
     immediately, the request is never re-sent."""
