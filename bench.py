@@ -2157,8 +2157,16 @@ def _run() -> None:
             n1m = int(os.environ.get("KCC_BENCH_1M_NODES", 1_000_000))
             shapes1m = int(os.environ.get("KCC_BENCH_1M_SHAPES", 384))
             s1m = 64
+            # The hierarchical fleet knobs (gang rows below): topology
+            # codes attach as dense columns — zero effect on the fit
+            # sweeps, they only feed the gang segmented reductions.
+            gang_zones = int(os.environ.get("KCC_BENCH_GANG_ZONES", 4))
+            gang_racks = int(os.environ.get("KCC_BENCH_GANG_RACKS", 8))
             t_build = time.perf_counter()
-            snap1m = kcc.synthetic_snapshot(n1m, seed=21, shapes=shapes1m)
+            snap1m = kcc.synthetic_snapshot(
+                n1m, seed=21, shapes=shapes1m,
+                topology=(gang_zones, gang_racks),
+            )
             ladder["nodes_1m_snapshot_build_ms"] = round(
                 (time.perf_counter() - t_build) * 1e3, 3
             )
@@ -2322,6 +2330,90 @@ def _run() -> None:
                         # parity field itself.
                     except Exception as e:  # noqa: BLE001 - best-effort row
                         ladder["car_1m_error"] = (
+                            f"{type(e).__name__}: {e}"
+                        )
+
+                # --- gang capacity on the grouped 1M-node fixture
+                # (ROADMAP item 4): whole-gang counting over the
+                # zone/rack hierarchy as count-weighted segmented
+                # reductions — the grouped dispatch keeps its (shape,
+                # count) compression because domain membership folds
+                # into per-(group, domain) count matrices, never the
+                # group key.  Every timing is gated on
+                # gang_parity_diffs == 0 vs the pure numpy/Python
+                # oracle over the FULL ungrouped per-node fits.  Own
+                # try: a gang failure must not void the rows above.
+                # KCC_BENCH_GANG=0 skips; KCC_BENCH_GANG_RANKS sizes
+                # the gang.
+                if diffs == 0 and os.environ.get(
+                    "KCC_BENCH_GANG", "1"
+                ) != "0":
+                    try:
+                        from kubernetesclustercapacity_tpu.topology import (
+                            GangSpec as _GangSpec,
+                            gang_capacity as _gang_eval,
+                            gang_oracle as _gang_oracle,
+                            topology_from_snapshot as _topo_of,
+                        )
+
+                        gang_ranks = int(
+                            os.environ.get("KCC_BENCH_GANG_RANKS", 64)
+                        )
+                        gspec = _GangSpec(
+                            ranks=gang_ranks, colocate="rack"
+                        )
+                        ggrid = kcc.random_scenario_grid(4, seed=777)
+                        gres = _gang_eval(
+                            snap1m, ggrid, gspec, mode="reference"
+                        )
+                        ladder["gang_group_count"] = (
+                            grouped_1m.n_groups
+                        )
+                        ladder["gang_engine"] = gres.engine
+                        # Oracle: per-node fits from the exact ungrouped
+                        # kernel over the full 1M arrays, reduced by the
+                        # numpy/Python oracle.
+                        arrays_gang = snapshot_device_arrays(snap1m)
+                        fits_gang = np.asarray(
+                            sweep_grid(
+                                *arrays_gang,
+                                ggrid.cpu_request_milli,
+                                ggrid.mem_request_bytes,
+                                ggrid.replicas,
+                                mode="reference",
+                                return_per_node=True,
+                            )[2]
+                        )
+                        del arrays_gang
+                        want_gangs = _gang_oracle(
+                            fits_gang, _topo_of(snap1m), gspec
+                        )
+                        del fits_gang
+                        gang_diffs = int(
+                            (gres.gangs != np.asarray(want_gangs)).sum()
+                        )
+                        ladder["gang_parity_diffs"] = gang_diffs
+                        if gang_diffs == 0:
+                            best_gang = None
+                            for _ in range(3):
+                                t0 = time.perf_counter()
+                                _gang_eval(
+                                    snap1m, ggrid, gspec,
+                                    mode="reference",
+                                )
+                                dt = time.perf_counter() - t0
+                                best_gang = (
+                                    dt
+                                    if best_gang is None
+                                    else min(best_gang, dt)
+                                )
+                            ladder["gang_1m_ms"] = round(
+                                best_gang * 1e3, 3
+                            )
+                        # mismatch voids the timing, never the parity
+                        # field.
+                    except Exception as e:  # noqa: BLE001 - best-effort row
+                        ladder["gang_1m_error"] = (
                             f"{type(e).__name__}: {e}"
                         )
             del snap1m

@@ -71,7 +71,12 @@ _ARGS_EXCLUDED = ("op", "token", "trace_id", "deadline")
 #: report text (reference transcripts carry fixture provenance a
 #: reconstructed snapshot cannot).  Stripped before digesting so the
 #: digest pins WHAT was answered, not HOW.
-_VOLATILE_RESULT_FIELDS = frozenset({"kernel", "fast_path_error", "report"})
+#: ``engine`` joins for the gang op: which reduction served (grouped
+#: count-matrix vs per-node) is a dispatch choice like ``kernel``, and
+#: the gang counts are parity-pinned identical across both.
+_VOLATILE_RESULT_FIELDS = frozenset(
+    {"kernel", "fast_path_error", "report", "engine"}
+)
 
 _DIGEST_HEX = 16  # matches flightrec/timeline truncation
 
@@ -267,6 +272,14 @@ class AuditLog:
                 rec["rows"] = [list(v) for v in summary.values()]
                 if any(snapshot.taints or []):
                     rec["taints"] = list(snapshot.taints)
+                if any(snapshot.labels or []):
+                    # Labels ride checkpoints so gang/topology requests
+                    # replay against the hierarchy that answered them.
+                    # Like taints, labels sit OUTSIDE the digest-chained
+                    # fit vocabulary: an in-place label edit between
+                    # checkpoints is carried forward (bounded by the
+                    # checkpoint cadence), never detected as a diff.
+                    rec["labels"] = list(snapshot.labels)
                 self._since_checkpoint = 0
             else:
                 diff = diff_summaries(self._last_summary, summary)
@@ -285,6 +298,17 @@ class AuditLog:
                 }
                 if added_names:
                     rec["added_names"] = added_names
+                if diff.added and any(snapshot.labels or []):
+                    labels_by_key = dict(
+                        zip(summary.keys(), snapshot.labels)
+                    )
+                    added_labels = {
+                        k: labels_by_key[k]
+                        for k in diff.added
+                        if labels_by_key.get(k)
+                    }
+                    if added_labels:
+                        rec["added_labels"] = added_labels
                 # apply() yields old-order-minus-removed then added; when
                 # the true row order differs (a mid-list insert), record
                 # it — the digest covers row order, so replay must too.
@@ -533,6 +557,9 @@ class AuditReader:
         taints_of = {
             k: t for k, t in zip(keys, ck.get("taints") or [])
         }
+        labels_of = {
+            k: lb for k, lb in zip(keys, ck.get("labels") or [])
+        }
         semantics = ck["semantics"]
         for rec in gens[start_i + 1 : target_i + 1]:
             diff = SnapshotDiff(
@@ -554,14 +581,18 @@ class AuditReader:
             if order is not None:
                 rows = {k: rows[k] for k in order}
             added_names = rec.get("added_names", {})
+            added_labels = rec.get("added_labels", {})
             for k in diff.removed:
                 name_of.pop(k, None)
                 taints_of.pop(k, None)
+                labels_of.pop(k, None)
             for k in diff.added:
                 name_of[k] = added_names.get(k, k)
+                if k in added_labels:
+                    labels_of[k] = added_labels[k]
             semantics = rec["semantics"]
         snap = self._snapshot_from_state(
-            rows, name_of, taints_of, semantics
+            rows, name_of, taints_of, semantics, labels_of
         )
         recorded = gens[target_i]["digest"]
         actual = snapshot_digest(snap)
@@ -580,8 +611,11 @@ class AuditReader:
         name_of: dict[str, str],
         taints_of: dict[str, list],
         semantics: str,
+        labels_of: dict[str, dict] | None = None,
     ) -> ClusterSnapshot:
-        return snapshot_from_summary(rows, name_of, taints_of, semantics)
+        return snapshot_from_summary(
+            rows, name_of, taints_of, semantics, labels_of=labels_of
+        )
 
 
 def snapshot_from_summary(
@@ -589,13 +623,18 @@ def snapshot_from_summary(
     name_of: dict[str, str],
     taints_of: dict[str, list],
     semantics: str,
+    *,
+    labels_of: dict[str, dict] | None = None,
 ) -> ClusterSnapshot:
     """Summary vocabulary → a servable snapshot.  Columns outside the
-    fit vocabulary (usage limits, extended resources, labels)
-    reconstruct empty — no replayable op consumes them.  Shared by the
-    audit replayer and the serving plane's replica subscriber
-    (:mod:`..service.plane`), which reconstruct snapshots from exactly
-    the same checkpoint+diff record shapes."""
+    fit vocabulary (usage limits, extended resources) reconstruct
+    empty — no replayable op consumes them.  Labels ride checkpoint
+    records (``labels_of``) so topology/gang requests replay against
+    the hierarchy that answered them; absent, they reconstruct empty
+    and gang co-location falls to the explicit missing-label policy.
+    Shared by the audit replayer and the serving plane's replica
+    subscriber (:mod:`..service.plane`), which reconstruct snapshots
+    from exactly the same checkpoint+diff record shapes."""
     keys = list(rows)
     n = len(keys)
     cols = {
@@ -607,6 +646,7 @@ def snapshot_from_summary(
         dtype=np.bool_,
     )
     taints = [list(taints_of.get(k) or []) for k in keys]
+    labels = [dict((labels_of or {}).get(k) or {}) for k in keys]
     return ClusterSnapshot(
         names=[name_of.get(k, k) for k in keys],
         alloc_cpu_milli=cols["alloc_cpu_milli"],
@@ -620,4 +660,5 @@ def snapshot_from_summary(
         healthy=healthy,
         semantics=semantics,
         taints=taints if any(taints) else [],
+        labels=labels if any(labels) else [],
     )

@@ -34,7 +34,10 @@ from kubernetesclustercapacity_tpu.audit.log import (
 __all__ = ["Replayer", "replay_shadow_bundle"]
 
 #: Ops whose full answer is a function of the packed snapshot alone.
-_REPLAYABLE = frozenset({"sweep", "explain", "fit"})
+#: ``gang`` qualifies because node labels ride audit checkpoints (the
+#: topology hierarchy reconstructs with the fit columns), and the gang
+#: result's ``engine`` field is canonical-stripped like ``kernel``.
+_REPLAYABLE = frozenset({"sweep", "explain", "fit", "gang"})
 
 #: fit/sweep args that pull in raw fixture objects or columns outside
 #: the audit vocabulary — present means "recorded, not replayable".
@@ -96,6 +99,14 @@ class Replayer:
         args = rec.get("args") or {}
         if op not in _REPLAYABLE:
             return f"op {op!r} is recorded but not replayable"
+        if op == "gang" and "ranks" not in args:
+            # The watch-status form answers from the LIVE timeline's
+            # alert state, not the snapshot — recorded for the
+            # forensic trail, unreplayable by construction.
+            return (
+                "gang watch-status form reads the live timeline, "
+                "not the snapshot"
+            )
         blocked = sorted(_FIXTURE_ARGS & set(args))
         if blocked:
             return (

@@ -178,6 +178,23 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="with -car-spec: override the spec's sampling "
                         "seed (explicit seeds make every run replayable)")
+    p.add_argument("-gang", default=None, metavar="HOST:PORT",
+                   help="render a running capacity service's gang-watch "
+                        "status (per gang watch: last whole-gang count, "
+                        "binding topology level, alert state) and exit; "
+                        "-output json selects the structured form; exit "
+                        "1 while any gang watch is breached (or none "
+                        "are configured)")
+    p.add_argument("-gang-spec", default="", dest="gang_spec",
+                   metavar="FILE",
+                   help="offline gang capacity: load a gang spec "
+                        "(YAML/JSON: the watchlist pod-block grammar "
+                        "plus a gang block — ranks, count, colocate, "
+                        "spread_level, max_ranks_per_domain, "
+                        "anti_affinity_host) and count whole gangs "
+                        "against the -snapshot source's zone/rack/host "
+                        "hierarchy; exit code by schedulability (1 when "
+                        "fewer than 'count' gangs fit)")
     p.add_argument("-replay", default="", metavar="DIR",
                    help="replay a kccap-server audit log: verify the "
                         "generation digest chain, reconstruct every "
@@ -307,6 +324,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.car:
         return _run_car_status(args)
 
+    if args.gang:
+        return _run_gang_status(args)
+
     if args.slo_status:
         return _run_slo_status(args)
 
@@ -380,6 +400,7 @@ def main(argv: list[str] | None = None) -> int:
             mode = (
                 "drain" if args.drain else
                 "car" if args.car_spec else
+                "gang" if args.gang_spec else
                 "explain" if args.explain else
                 "grid" if args.grid > 0 else "fit"
             )
@@ -446,6 +467,8 @@ def _run_command(args) -> int:
 
     if args.car_spec:
         return _run_car_spec(args, snapshot)
+    if args.gang_spec:
+        return _run_gang_spec(args, snapshot)
     if args.drain:
         return _run_drain(args, fixture, snapshot)
     if args.explain:
@@ -596,6 +619,83 @@ def _run_car_spec(args, snapshot) -> int:
     else:
         print(car_table_report(result.to_wire()))
     return 0 if result.schedulable else 1
+
+
+def _run_gang_status(args) -> int:
+    """-gang HOST:PORT: fetch and render a service's gang-watch status
+    (the gang slice of the timeline).  Exits by the verdict, like -car:
+    a breached gang watch — fewer than N whole gangs fit — is a
+    scriptable failure, and so is a server with no gang watches."""
+    from kubernetesclustercapacity_tpu.report import (
+        gang_status_json_report,
+        gang_status_table_report,
+    )
+
+    addr = _parse_addr("-gang", args.gang)
+    if addr is None:
+        return 1
+    try:
+        with _diag_client(addr) as c:
+            result = c.gang()
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot fetch gang status from "
+              f"{addr[0]}:{addr[1]}: {e}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(gang_status_json_report(result))
+    else:
+        print(gang_status_table_report(result))
+    if not result.get("enabled", False):
+        return 1
+    return 1 if result.get("breached") else 0
+
+
+def _run_gang_spec(args, snapshot) -> int:
+    """-gang-spec FILE: offline whole-gang capacity against the
+    -snapshot source's topology hierarchy.  Applies the same implicit
+    strict-mode taint mask as every other surface, prints the gang
+    verdict with its binding-level explanation, and exits by
+    schedulability: 1 when fewer than the spec's ``count`` gangs fit."""
+    from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+    from kubernetesclustercapacity_tpu.report import (
+        gang_json_report,
+        gang_table_report,
+    )
+    from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+    from kubernetesclustercapacity_tpu.topology import (
+        GangSpecError,
+        gang_capacity,
+        gang_explain,
+        load_gang_spec,
+    )
+
+    if args.backend != "tpu":
+        print("ERROR : -gang-spec runs on the JAX kernels (-backend tpu); "
+              "cpu/native backends are fit-only cross-checks ...exiting")
+        return 1
+    try:
+        scenario, spec = load_gang_spec(args.gang_spec)
+    except (OSError, GangSpecError) as e:
+        print(f"ERROR : bad -gang-spec: {e}")
+        return 1
+    grid = ScenarioGrid.from_scenarios([scenario])
+    mask = implicit_taint_mask(snapshot)
+    try:
+        result = gang_capacity(
+            snapshot, grid, spec, mode=args.semantics, node_mask=mask
+        )
+        wire = result.to_wire()
+        wire["explain"] = gang_explain(
+            snapshot, grid, spec, mode=args.semantics, node_mask=mask
+        )
+    except (GangSpecError, ValueError) as e:
+        print(f"ERROR : {e}")
+        return 1
+    if args.output == "json":
+        print(gang_json_report(wire))
+    else:
+        print(gang_table_report(wire))
+    return 0 if bool(result.schedulable[0]) else 1
 
 
 def _run_slo_status(args) -> int:

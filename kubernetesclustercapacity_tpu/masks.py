@@ -183,8 +183,19 @@ def anti_affinity_existing_mask(
     (an ``app=db`` pod in another namespace does not repel).  ``None``
     matches cluster-wide, for what-if specs that model no namespace
     (documented divergence: kube-scheduler has no namespace-less pods).
+
+    Hostname-topology identity routes through the topology subsystem's
+    shared helper (:func:`~.topology.model.node_name_index`): a pod
+    whose ``nodeName`` resolves to no snapshot row is EXCLUDED from the
+    topology (it repels nothing), and duplicate names keep the last row
+    — both pinned by ``tests/test_topology_gang.py`` so this mask and
+    the gang model share one identity rule.
     """
-    node_index = {name: i for i, name in enumerate(snapshot.names)}
+    from kubernetesclustercapacity_tpu.topology.model import (
+        node_name_index,
+    )
+
+    node_index = node_name_index(snapshot)
     mask = np.ones(snapshot.n_nodes, dtype=np.bool_)
     for pod in fixture.get("pods", []):
         if pod.get("phase") in ("Succeeded", "Failed"):

@@ -1015,23 +1015,29 @@ class CapacityModel:
         grid paths (they must never disagree): a node belongs to a domain
         iff it is healthy, domain-mask-eligible, and carries the key.
         Returns ``(zone→index, member[N] = index+1 or 0, unkeyed_count)``
-        — ``unkeyed`` counts eligible nodes missing the key."""
+        — ``unkeyed`` counts eligible nodes missing the key.
+
+        Domain discovery delegates to the topology subsystem's shared
+        label→code helper with the EXCLUDED missing-label policy (an
+        unkeyed node joins no domain and anchors no skew minimum —
+        PodTopologySpread's default node-inclusion behavior, pinned by
+        ``tests/test_topology_gang.py`` so this call site and the gang
+        model can never drift on what "missing" means)."""
+        from kubernetesclustercapacity_tpu.topology.model import label_codes
+
         snap = self.snapshot
-        zone_ids: dict[str, int] = {}
-        member = np.zeros(snap.n_nodes, dtype=np.int64)
-        unkeyed = 0
-        for i in range(snap.n_nodes):
-            if not snap.healthy[i] or (
-                domain_mask is not None and not domain_mask[i]
-            ):
-                continue
-            labels = snap.labels[i] if i < len(snap.labels) else {}
-            zone = labels.get(topology_key)
-            if zone is None:
-                unkeyed += 1
-                continue
-            member[i] = zone_ids.setdefault(zone, len(zone_ids)) + 1
-        return zone_ids, member, unkeyed
+        eligible = np.asarray(snap.healthy, dtype=bool)
+        if domain_mask is not None:
+            eligible = eligible & np.asarray(domain_mask, dtype=bool)
+        codes, domains, unkeyed = label_codes(
+            snap.labels or [],
+            topology_key,
+            missing="exclude",
+            eligible=eligible,
+            n_nodes=snap.n_nodes,
+        )
+        zone_ids = {z: i for i, z in enumerate(domains)}
+        return zone_ids, codes + 1, unkeyed
 
     def topology_spread_grid(
         self,

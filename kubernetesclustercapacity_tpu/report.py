@@ -37,6 +37,10 @@ __all__ = [
     "car_json_report",
     "car_status_table_report",
     "car_status_json_report",
+    "gang_table_report",
+    "gang_json_report",
+    "gang_status_table_report",
+    "gang_status_json_report",
     "fed_status_table_report",
     "fed_status_json_report",
     "fed_sweep_table_report",
@@ -589,6 +593,105 @@ def car_status_table_report(status: dict) -> str:
 
 def car_status_json_report(status: dict) -> str:
     """``kccap -car -output json``: the wire shape verbatim."""
+    return json.dumps(status, indent=2, sort_keys=True)
+
+
+def gang_table_report(gang: dict) -> str:
+    """A gang evaluation (the ``gang`` op's wire shape / ``kccap
+    -gang-spec``) as operator-readable text: the whole-gang verdict,
+    the constraint vocabulary in force, and the binding-level
+    explanation when present."""
+    spread = (
+        f"{gang.get('spread_level')}<={gang.get('max_ranks_per_domain')}"
+        if gang.get("spread_level")
+        else ("host<=1" if gang.get("anti_affinity_host") else "-")
+    )
+    gangs = gang.get("gangs", [])
+    sched = gang.get("schedulable", [])
+    lines = [
+        f"gang capacity: {gang.get('ranks')} rank(s)/gang, "
+        f"{gang.get('count')} gang(s) requested  "
+        f"[colocate={gang.get('colocate') or 'cluster'} spread={spread} "
+        f"mode={gang.get('mode')} engine={gang.get('engine')}]",
+    ]
+    for s, (g, ok) in enumerate(zip(gangs, sched)):
+        pods = gang.get("pod_totals", [None] * len(gangs))[s]
+        lines.append(
+            f"  scenario {s}: {g} whole gang(s) fit "
+            f"(pod capacity {pods}) — "
+            + ("schedulable" if ok else "NOT schedulable")
+        )
+    ex = gang.get("explain")
+    if ex:
+        lines.append(f"  {ex.get('summary')}")
+        largest = ex.get("largest_domain") or {}
+        if largest.get("name") is not None:
+            lines.append(
+                f"  largest {ex.get('colocate') or 'domain'}: "
+                f"{largest.get('name')} holds {largest.get('capacity')} "
+                f"rank(s) = {largest.get('whole_gangs')} whole gang(s)"
+            )
+        if ex.get("excluded_nodes"):
+            lines.append(
+                f"  excluded nodes (missing topology labels): "
+                f"{ex['excluded_nodes']}"
+            )
+    return "\n".join(lines)
+
+
+def gang_json_report(gang: dict) -> str:
+    """``-output json``: the wire shape verbatim."""
+    return json.dumps(gang, indent=2, sort_keys=True)
+
+
+def gang_status_table_report(status: dict) -> str:
+    """The ``gang`` op's watch-status form (``kccap -gang HOST:PORT``):
+    one row per gang watch — last whole-gang count, binding level,
+    alert state — and the scriptable verdict line."""
+    if not status.get("enabled", False):
+        return (
+            "gang capacity: no gang watches on this server "
+            "(-watch entries need a gang: block)"
+        )
+    header = (
+        f"{'WATCH':<24} {'RANKS':>6} {'WANT':>5} {'GANGS':>6} "
+        f"{'MIN':>5} {'BINDS':>8}  STATE"
+    )
+    lines = [
+        f"gang capacity: serving generation {status.get('generation')}",
+        header,
+        "-" * len(header),
+    ]
+
+    def _cell(v):
+        return "-" if v is None else v
+
+    for name in sorted(status.get("watches", {})):
+        w = status["watches"][name]
+        alert = w.get("alert", {})
+        lines.append(
+            f"{name:<24} "
+            f"{w.get('ranks'):>6} "
+            f"{w.get('count'):>5} "
+            f"{_cell(w.get('last_gangs')):>6} "
+            f"{_cell(w.get('min_replicas')):>5} "
+            f"{_cell(w.get('binding')):>8}  {alert.get('state')}"
+        )
+    lines.append("-" * len(header))
+    breached = status.get("breached", [])
+    lines.append(
+        "verdict: "
+        + (
+            "BREACHED — " + ", ".join(breached)
+            if breached
+            else "ok — every gang watch above its threshold"
+        )
+    )
+    return "\n".join(lines)
+
+
+def gang_status_json_report(status: dict) -> str:
+    """``kccap -gang -output json``: the wire shape verbatim."""
     return json.dumps(status, indent=2, sort_keys=True)
 
 

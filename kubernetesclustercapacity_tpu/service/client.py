@@ -60,8 +60,8 @@ __all__ = ["CapacityClient", "IDEMPOTENT_OPS"]
 IDEMPOTENT_OPS = frozenset(
     {
         "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
-        "topology_spread", "plan", "explain", "car", "dump", "timeline",
-        "slo", "drain_server",
+        "topology_spread", "plan", "explain", "car", "gang", "dump",
+        "timeline", "slo", "drain_server",
         # Federation ops are pure reads over the federation tier's held
         # snapshots — a retry re-reads the fleet view, which may have
         # advanced; acceptable for the same reason dump/timeline are.
@@ -497,6 +497,26 @@ class CapacityClient:
         if usage is not None:
             params["usage"] = usage
         return self.call("car", **params)
+
+    def gang(self, ranks: int | None = None, **params) -> dict:
+        """Gang capacity.  With ``ranks`` (plus the six per-rank flag
+        fields or scenario arrays, and optional ``count``/``colocate``/
+        ``spread_level``/``max_ranks_per_domain``/
+        ``anti_affinity_host``), evaluates whole-gang capacity against
+        the served snapshot — all-or-nothing groups of co-scheduled
+        ranks under the topology hierarchy, with the binding-level
+        explanation on single-scenario requests.  Without ``ranks``,
+        returns the server's gang-watch status (last whole-gang counts
+        and alert states)."""
+        if ranks is not None:
+            # Passed verbatim: the server owns validation (its typed
+            # errors are the contract the tests pin).
+            params["ranks"] = ranks
+        for key in ("cpu_request_milli", "mem_request_bytes", "replicas"):
+            v = params.get(key)
+            if v is not None and hasattr(v, "tolist"):
+                params[key] = v.tolist()
+        return self.call("gang", **params)
 
     def dump(self, op: str | None = None, status: str | None = None,
              limit: int | None = None, **kw) -> dict:

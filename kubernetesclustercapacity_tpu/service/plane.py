@@ -195,6 +195,7 @@ class PlanePublisher:
         self._summary: dict[str, tuple[int, ...]] | None = None
         self._names: list[str] = []
         self._taints: list = []
+        self._labels: list = []
         self._semantics = ""
         self._generation = 0
         self._digest = ""
@@ -259,6 +260,7 @@ class PlanePublisher:
             self._summary = summary
             self._names = list(snapshot.names)
             self._taints = list(snapshot.taints or [])
+            self._labels = list(getattr(snapshot, "labels", None) or [])
             self._semantics = snapshot.semantics
             self._generation = int(generation)
             self._digest = digest
@@ -281,6 +283,11 @@ class PlanePublisher:
         }
         if any(snapshot.taints or []):
             frame["taints"] = list(snapshot.taints)
+        labels = getattr(snapshot, "labels", None) or []
+        if any(labels):
+            # Labels ride checkpoints (like taints) so replicas answer
+            # topology/gang ops against the leader's hierarchy.
+            frame["labels"] = list(labels)
         return frame
 
     def _diff_frame_locked(self, summary, snapshot, generation, digest) -> dict:
@@ -303,6 +310,16 @@ class PlanePublisher:
         }
         if added_names:
             frame["added_names"] = added_names
+        labels = getattr(snapshot, "labels", None) or []
+        if diff.added and any(labels):
+            labels_by_key = dict(zip(summary.keys(), labels))
+            added_labels = {
+                k: labels_by_key[k]
+                for k in diff.added
+                if labels_by_key.get(k)
+            }
+            if added_labels:
+                frame["added_labels"] = added_labels
         # apply() yields old-order-minus-removed then added; when the
         # true row order differs (a mid-list insert), the frame must say
         # so — the digest covers row order, so the replica must too.
@@ -390,7 +407,7 @@ class PlanePublisher:
                     self._summary,
                     _RetainedView(
                         self._names, self._taints, self._semantics,
-                        self._summary,
+                        self._summary, self._labels,
                     ),
                     self._generation,
                     self._digest,
@@ -511,9 +528,10 @@ class _RetainedView:
     publishes must get the CURRENT generation without the publisher
     holding a reference to the full snapshot object)."""
 
-    def __init__(self, names, taints, semantics, summary) -> None:
+    def __init__(self, names, taints, semantics, summary, labels=()) -> None:
         self.names = names
         self.taints = taints
+        self.labels = list(labels)
         self.semantics = semantics
         self.n_nodes = len(names)
 
@@ -572,6 +590,7 @@ class PlaneSubscriber:
         self._summary: dict[str, tuple[int, ...]] | None = None
         self._name_of: dict[str, str] = {}
         self._taints_of: dict[str, list] = {}
+        self._labels_of: dict[str, dict] = {}
         self._generation = 0
         self._digest = ""
         self._last_frame_at: float | None = None
@@ -791,8 +810,11 @@ class PlaneSubscriber:
         }
         name_of = dict(zip(keys, names))
         taints_of = {k: t for k, t in zip(keys, frame.get("taints") or [])}
+        labels_of = {
+            k: lb for k, lb in zip(keys, frame.get("labels") or [])
+        }
         self._stage(
-            rows, name_of, taints_of, frame, chain_parent=False
+            rows, name_of, taints_of, labels_of, frame, chain_parent=False
         )
 
     def _apply_diff(self, frame: dict) -> None:
@@ -807,6 +829,7 @@ class PlaneSubscriber:
             held = dict(self._summary)
             name_of = dict(self._name_of)
             taints_of = dict(self._taints_of)
+            labels_of = dict(self._labels_of)
         diff = SnapshotDiff(
             added={
                 k: tuple(int(x) for x in v)
@@ -829,14 +852,22 @@ class PlaneSubscriber:
             except KeyError as e:
                 raise PlaneError(f"order references unknown row {e}")
         added_names = frame.get("added_names", {})
+        added_labels = frame.get("added_labels", {})
         for k in diff.removed:
             name_of.pop(k, None)
             taints_of.pop(k, None)
+            labels_of.pop(k, None)
         for k in diff.added:
             name_of[k] = added_names.get(k, k)
-        self._stage(rows, name_of, taints_of, frame, chain_parent=True)
+            if k in added_labels:
+                labels_of[k] = added_labels[k]
+        self._stage(
+            rows, name_of, taints_of, labels_of, frame, chain_parent=True
+        )
 
-    def _stage(self, rows, name_of, taints_of, frame, *, chain_parent) -> None:
+    def _stage(
+        self, rows, name_of, taints_of, labels_of, frame, *, chain_parent
+    ) -> None:
         """Reconstruct, digest-verify, and stage one generation.  The
         digest check is the whole safety story: a frame that does not
         reconstruct bit-identically is a :class:`PlaneError` (→ resync),
@@ -856,7 +887,8 @@ class PlaneSubscriber:
                 self._m_applied.labels(result="skipped").inc()
             return
         snap = snapshot_from_summary(
-            rows, name_of, taints_of, frame["semantics"]
+            rows, name_of, taints_of, frame["semantics"],
+            labels_of=labels_of,
         )
         actual = snapshot_digest(snap)
         if actual != frame["digest"]:
@@ -879,6 +911,7 @@ class PlaneSubscriber:
             self._summary = rows
             self._name_of = name_of
             self._taints_of = taints_of
+            self._labels_of = labels_of
             self._generation = generation
             self._digest = actual
             self._applied += 1

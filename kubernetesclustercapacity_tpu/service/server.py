@@ -550,8 +550,8 @@ class CapacityServer:
     _AUDITED_OPS = frozenset(
         {
             "fit", "sweep", "sweep_multi", "place", "drain",
-            "topology_spread", "plan", "explain", "car", "update",
-            "reload",
+            "topology_spread", "plan", "explain", "car", "gang",
+            "update", "reload",
         }
     )
 
@@ -632,7 +632,7 @@ class CapacityServer:
         {
             "ping", "info", "fit", "sweep", "sweep_multi", "place",
             "drain", "topology_spread", "plan", "explain", "car",
-            "dump", "timeline", "slo", "reload", "update",
+            "gang", "dump", "timeline", "slo", "reload", "update",
             "drain_server",
         }
     )
@@ -644,7 +644,7 @@ class CapacityServer:
     _ADMISSION_OPS = frozenset(
         {
             "fit", "sweep", "sweep_multi", "place", "drain",
-            "topology_spread", "plan", "explain", "car",
+            "topology_spread", "plan", "explain", "car", "gang",
         }
     )
 
@@ -862,7 +862,7 @@ class CapacityServer:
             return self._op_drain_server(msg)
         if op in (
             "fit", "sweep", "sweep_multi", "place", "drain",
-            "topology_spread", "plan", "explain", "car",
+            "topology_spread", "plan", "explain", "car", "gang",
         ):
             # Bounded concurrency for the compute ops: each holds device
             # dispatch + host packing; unbounded fan-in from one noisy
@@ -1081,6 +1081,8 @@ class CapacityServer:
             return self._op_explain(msg, snap, implicit_mask)
         if op == "car":
             return self._op_car(msg, snap, implicit_mask)
+        if op == "gang":
+            return self._op_gang(msg, snap, implicit_mask)
         if op == "dump":
             return self._op_dump(msg)
         if op == "timeline":
@@ -1657,6 +1659,69 @@ class CapacityServer:
             )
         if clk:
             clk.record("serialize", _time.perf_counter() - t0)
+        return out
+
+    def _op_gang(
+        self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
+    ) -> dict:
+        """Gang capacity over the wire, two forms:
+
+        * **evaluate** (``ranks`` present): the six per-rank flag fields
+          (or the sweep op's scenario-array grammar) plus the gang
+          constraint fields (``ranks``/``count``/``colocate``/
+          ``spread_level``/``max_ranks_per_domain``/
+          ``anti_affinity_host``), answered with whole-gang counts per
+          scenario — same semantics and implicit taint mask as
+          fit/sweep.  Single-scenario requests (and any request with
+          ``explain: true``) also carry the binding-level explanation.
+        * **watch status** (no ``ranks``): the gang slice of the
+          timeline — per gang watch the last whole-gang count, binding
+          level, and alert state (what ``kccap -gang HOST:PORT``
+          renders and exits by).
+        """
+        from kubernetesclustercapacity_tpu.topology.gang import (
+            GangSpecError,
+            gang_capacity,
+            gang_explain,
+            gang_spec_from_msg,
+        )
+
+        if "ranks" not in msg:
+            tl = self._timeline
+            watches = tl.gang_status() if tl is not None else {}
+            if not watches:
+                return {"enabled": False, "watches": {}, "breached": []}
+            return {
+                "enabled": True,
+                "generation": self.generation,
+                "watches": watches,
+                "breached": tl.gang_breached(),
+            }
+        if "cpu_request_milli" in msg:
+            try:
+                grid = ScenarioGrid(
+                    cpu_request_milli=np.asarray(msg["cpu_request_milli"]),
+                    mem_request_bytes=np.asarray(msg["mem_request_bytes"]),
+                    replicas=np.asarray(msg.get("replicas", [1])),
+                )
+            except (ScenarioError, KeyError, TypeError, ValueError) as e:
+                raise ValueError(f"bad gang request: {e}") from e
+        else:
+            grid = ScenarioGrid.from_scenarios([self._scenario_from_msg(msg)])
+        try:
+            spec = gang_spec_from_msg(msg)
+            result = gang_capacity(
+                snap, grid, spec,
+                mode=snap.semantics, node_mask=implicit_mask,
+            )
+        except (GangSpecError, ScenarioError, ValueError) as e:
+            raise ValueError(f"bad gang request: {e}") from e
+        out = result.to_wire()
+        if grid.size == 1 or msg.get("explain"):
+            out["explain"] = gang_explain(
+                snap, grid, spec,
+                mode=snap.semantics, node_mask=implicit_mask,
+            )
         return out
 
     def _op_dump(self, msg: dict) -> dict:
@@ -2685,6 +2750,11 @@ def main(argv=None) -> int:
             if slo_monitor is not None and slo_monitor.fast_burning:
                 return False
             if timeline is not None and timeline.car_breached():
+                return False
+            if timeline is not None and timeline.gang_breached():
+                # A breached gang watch is the all-or-nothing analog of
+                # a CaR breach: "fewer than N whole gangs fit" is a
+                # promise the serving tier can no longer make.
                 return False
             if subscriber is not None and subscriber.stale:
                 return False

@@ -1174,6 +1174,7 @@ def synthetic_snapshot(
     alloc_pods: int = 110,
     kib_quantized: bool = True,
     shapes: int | None = None,
+    topology: tuple[int, int] | None = None,
 ) -> ClusterSnapshot:
     """Array-level synthetic cluster — fast path for 1k/10k-node benches.
 
@@ -1187,6 +1188,13 @@ def synthetic_snapshot(
     clusters exhibit (a handful of machine shapes × thousands of
     replicas), which is what :meth:`ClusterSnapshot.grouped` compresses.
     ``None`` keeps the fully heterogeneous per-node draw.
+
+    ``topology=(zones, racks_per_zone)`` attaches a zone/rack/host
+    hierarchy as dense code COLUMNS (round-robin racks, nested zones,
+    unique hosts) via :func:`~.topology.model.attach_topology` — no
+    per-node label dicts are ever built, so hierarchical 1M-node
+    fleets stay O(N) numpy; fixture-backed snapshots get the same
+    hierarchy from real labels instead.
     """
     rng = np.random.default_rng(seed)
     n_draw = n_nodes if shapes is None else int(shapes)
@@ -1218,7 +1226,7 @@ def synthetic_snapshot(
         used_mem = used_mem[assign]
         pods = pods[assign]
 
-    return ClusterSnapshot(
+    snap = ClusterSnapshot(
         names=[f"node-{i:05d}" for i in range(n_nodes)],
         alloc_cpu_milli=alloc_cpu,
         alloc_mem_bytes=alloc_mem,
@@ -1231,6 +1239,22 @@ def synthetic_snapshot(
         healthy=np.ones(n_nodes, dtype=np.bool_),
         semantics="reference",
     )
+    if topology is not None:
+        from kubernetesclustercapacity_tpu.topology.model import (
+            attach_topology,
+        )
+
+        t_zones, racks_per = topology
+        if t_zones < 1 or racks_per < 1:
+            raise ValueError(
+                f"topology wants (zones >= 1, racks_per_zone >= 1), "
+                f"got {topology!r}"
+            )
+        rack_code = np.arange(n_nodes, dtype=np.int64) % (
+            t_zones * racks_per
+        )
+        attach_topology(snap, rack_code // racks_per, rack_code)
+    return snap
 
 
 def snapshot_from_live_cluster(

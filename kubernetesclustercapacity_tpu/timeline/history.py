@@ -152,6 +152,12 @@ class WatchResult:
     prob_fit: float | None = None
     samples: int = 0
     car_eval_ms: float = 0.0
+    #: Gang watch fields (``gang_ranks > 0`` marks one): ``total`` is
+    #: then WHOLE GANGS, ``gang_binding`` the binding topology level.
+    gang_ranks: int = 0
+    gang_count: int = 0
+    gang_binding: str | None = None
+    gang_summary: str = ""
 
     def to_wire(self) -> dict:
         out = {
@@ -166,6 +172,13 @@ class WatchResult:
             out["quantile"] = self.quantile
             out["prob_fit"] = self.prob_fit
             out["samples"] = self.samples
+        if self.gang_ranks:
+            out["gang"] = {
+                "ranks": self.gang_ranks,
+                "count": self.gang_count,
+                "binding": self.gang_binding,
+                "summary": self.gang_summary,
+            }
         return out
 
 
@@ -253,6 +266,13 @@ class CapacityTimeline:
         self._car_names = frozenset(
             w.name for w in self.watches if w.quantile is not None
         )
+        #: Names of the gang watches — the slice whose breaches (like
+        #: the CaR slice's) flip ``/healthz`` and the ``kccap_gang_*``
+        #: gauges: a breached gang watch says "fewer than N whole
+        #: gangs fit", which a training-job admission plane must see.
+        self._gang_names = frozenset(
+            w.name for w in self.watches if w.gang is not None
+        )
         self._log = TraceLog(log) if isinstance(log, str) else log
         self._m = None
         if registry is not None and _telemetry_enabled():
@@ -298,6 +318,25 @@ class CapacityTimeline:
                     "(coalescer thread, off the request path).",
                 ),
             }
+            if self._gang_names:
+                # The gang family, registered only when a gang watch
+                # exists (same shape policy as the CaR family below).
+                self._m.update(
+                    {
+                        "gang_capacity": registry.gauge(
+                            "kccap_gang_capacity",
+                            "Whole gangs of the watch's gang spec "
+                            "that currently fit.",
+                            ("watch",),
+                        ),
+                        "gang_alert_state": registry.gauge(
+                            "kccap_gang_alert_state",
+                            "Gang watch alert state "
+                            "(0=ok, 1=recovered, 2=breached).",
+                            ("watch",),
+                        ),
+                    }
+                )
             if self._car_names:
                 # The capacity-at-risk family, registered only when a
                 # quantile watch exists (a plain timeline's registry
@@ -352,7 +391,10 @@ class CapacityTimeline:
             )
             transitions: list[tuple[str, WatchAlert]] = []
             for mode, specs in self._mode_groups(snapshot):
-                plain = [s for s in specs if s.quantile is None]
+                plain = [
+                    s for s in specs
+                    if s.quantile is None and s.gang is None
+                ]
                 # The same implicit hard-taint mask every strict fit
                 # surface applies (None unless the snapshot itself is
                 # strict-packed) — so a timeline capacity equals the fit
@@ -386,9 +428,12 @@ class CapacityTimeline:
                             fits=np.asarray(result.fits[s_i], dtype=np.int64),
                         )
                 for spec in specs:
-                    if spec.quantile is None:
+                    if spec.quantile is None and spec.gang is None:
                         continue
-                    r = self._evaluate_car(snapshot, spec, mode, mask)
+                    if spec.gang is not None:
+                        r = self._evaluate_gang(snapshot, spec, mode, mask)
+                    else:
+                        r = self._evaluate_car(snapshot, spec, mode, mask)
                     alert = self._alerts[spec.name]
                     transition = alert.update(r.total, record.generation)
                     if transition is not None:
@@ -457,6 +502,37 @@ class CapacityTimeline:
             car_eval_ms=res.eval_ms,
         )
 
+    def _evaluate_gang(
+        self, snapshot: ClusterSnapshot, spec: WatchSpec, mode: str, mask
+    ) -> WatchResult:
+        """One gang watch against one generation: the watch's capacity
+        IS the whole-gang count (``min_replicas`` thresholds gangs).
+        Per-node fits and the binding histogram come from the pod-level
+        explain of the same scenario so delta attribution stays
+        node-granular, exactly as CaR watches do."""
+        from kubernetesclustercapacity_tpu.topology.gang import gang_explain
+
+        grid = ScenarioGrid.from_scenarios([spec.scenario])
+        ex = explain_snapshot(snapshot, grid, mode=mode, node_mask=mask)
+        detail = gang_explain(
+            snapshot, grid, spec.gang, mode=mode, node_mask=mask
+        )
+        total = int(detail["gangs"])
+        return WatchResult(
+            name=spec.name,
+            mode=mode,
+            total=total,
+            schedulable=bool(detail["schedulable"]),
+            breached=total < (spec.min_replicas or 0),
+            min_replicas=spec.min_replicas,
+            binding_counts=ex.binding_counts(0),
+            fits=np.asarray(ex.fits[0], dtype=np.int64),
+            gang_ranks=spec.gang.ranks,
+            gang_count=spec.gang.count,
+            gang_binding=detail["binding"],
+            gang_summary=detail["summary"],
+        )
+
     def _mode_groups(self, snapshot: ClusterSnapshot):
         """Watches grouped by effective kernel mode (one explain pass per
         mode, whole watchlist vectorized along the scenario axis)."""
@@ -487,6 +563,11 @@ class CapacityTimeline:
             m["alert_state"].labels(watch=spec.name).set(
                 self._alerts[spec.name].state_code
             )
+            if spec.gang is not None and "gang_capacity" in m:
+                m["gang_capacity"].labels(watch=spec.name).set(r.total)
+                m["gang_alert_state"].labels(watch=spec.name).set(
+                    self._alerts[spec.name].state_code
+                )
             if spec.quantile is not None and "car_replicas" in m:
                 m["car_replicas"].labels(watch=spec.name).set(r.total)
                 if r.prob_fit is not None:
@@ -703,6 +784,43 @@ class CapacityTimeline:
                 if n in self._car_names and a.state == "breached"
             )
 
+    def gang_breached(self) -> list[str]:
+        """Gang watches currently breached — the slice of alert state
+        that flips ``/healthz`` to 503 (like :meth:`car_breached`: a
+        breached gang watch says fewer than N whole gangs fit, which a
+        gang-scheduling admission plane must see, not discover)."""
+        if not self._gang_names:
+            return []
+        with self._lock:
+            return sorted(
+                n
+                for n, a in self._alerts.items()
+                if n in self._gang_names and a.state == "breached"
+            )
+
+    def gang_status(self) -> dict:
+        """Per-gang-watch status (the ``gang`` op's watch view / the
+        doctor's "gang capacity" line): last whole-gang count, the
+        binding topology level, and alert state."""
+        with self._lock:
+            last = self._ring[-1] if self._ring else None
+            out: dict[str, dict] = {}
+            for spec in self.watches:
+                if spec.gang is None:
+                    continue
+                r = last.watches.get(spec.name) if last else None
+                out[spec.name] = {
+                    "ranks": spec.gang.ranks,
+                    "count": spec.gang.count,
+                    "colocate": spec.gang.colocate,
+                    "min_replicas": spec.min_replicas,
+                    "last_gangs": r.total if r else None,
+                    "binding": r.gang_binding if r else None,
+                    "summary": r.gang_summary if r else "",
+                    "alert": self._alerts[spec.name].to_wire(),
+                }
+            return out
+
     def car_status(self) -> dict:
         """Per-CaR-watch status (the ``car`` op's watch view / the
         doctor's "capacity at risk" line): last quantile capacity,
@@ -753,6 +871,14 @@ class CapacityTimeline:
                 n
                 for n, s in alerts.items()
                 if n in self._car_names and s == "breached"
+            )
+        if self._gang_names:
+            # Same shape policy: the gang slice appears only when gang
+            # watches exist.
+            out["gang_breached"] = sorted(
+                n
+                for n, s in alerts.items()
+                if n in self._gang_names and s == "breached"
             )
         return out
 

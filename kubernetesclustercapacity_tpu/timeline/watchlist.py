@@ -42,6 +42,27 @@ point-distribution watch has no usage uncertainty, so every quantile
 would silently equal the plain fit (rejected with a clear error rather
 than reported as a lie).  A resource omitted from ``usage`` defaults
 to a point distribution at the pod's own request.
+
+**Gang watches**: a ``gang`` block makes the watch count WHOLE GANGS
+of the pod spec instead of independent replicas — "alert when fewer
+than 2 rack-co-located 64-rank gangs fit"::
+
+    watches:
+      - name: train-64
+        pod: {cpuRequests: "4", memRequests: 8gb}
+        gang:
+          ranks: 64
+          count: 2              # gangs requested (schedulability)
+          colocate: rack        # optional: host|rack|zone
+          max_ranks_per_domain: 8   # optional, with spread_level
+          spread_level: host
+        min_replicas: 1         # alert threshold, in WHOLE GANGS
+
+The block parses through :func:`~..topology.gang.parse_gang_block`
+(same grammar as the ``gang`` service op and ``kccap -gang-spec``);
+``gang`` and ``quantile`` are mutually exclusive — a stochastic gang
+watch would need a semantics nobody has defined, so it is rejected,
+not guessed.
 """
 
 from __future__ import annotations
@@ -96,6 +117,10 @@ class WatchSpec:
     usage_mem: UsageDistribution | None = None
     samples: int = 0  # 0 = the process default (KCCAP_CAR_SAMPLES/64)
     seed: int = 0
+    #: Gang watch: capacity counted in whole gangs of the pod spec
+    #: (a :class:`~..topology.gang.GangSpec`); ``min_replicas`` then
+    #: thresholds GANGS, not pods.
+    gang: object | None = None
 
     def to_wire(self) -> dict:
         """JSON-able description (rides the ``timeline`` op)."""
@@ -107,6 +132,8 @@ class WatchSpec:
             "mode": self.mode,
             "min_replicas": self.min_replicas,
         }
+        if self.gang is not None:
+            out["gang"] = self.gang.to_wire()
         if self.quantile is not None:
             out["quantile"] = self.quantile
             out["samples"] = self.samples
@@ -159,19 +186,36 @@ def _parse_entry(i: int, entry) -> WatchSpec:
             )
     extra = set(entry) - {
         "name", "pod", "semantics", "min_replicas",
-        "quantile", "usage", "samples", "seed",
+        "quantile", "usage", "samples", "seed", "gang",
     }
     if extra:
         raise WatchError(
             f"watch {name!r}: unknown field(s) {sorted(extra)}"
         )
+    gang = None
+    if "gang" in entry:
+        from kubernetesclustercapacity_tpu.topology.gang import (
+            GangSpecError,
+            parse_gang_block,
+        )
+
+        if "quantile" in entry:
+            raise WatchError(
+                f"watch {name!r}: 'gang' and 'quantile' are mutually "
+                "exclusive (stochastic gang capacity is undefined — "
+                "pick one)"
+            )
+        try:
+            gang = parse_gang_block(entry["gang"])
+        except GangSpecError as e:
+            raise WatchError(f"watch {name!r}: {e}") from e
     quantile, usage_cpu, usage_mem, samples, seed = _parse_stochastic_fields(
         name, entry, scenario
     )
     return WatchSpec(
         name=name, scenario=scenario, mode=mode, min_replicas=min_replicas,
         quantile=quantile, usage_cpu=usage_cpu, usage_mem=usage_mem,
-        samples=samples, seed=seed,
+        samples=samples, seed=seed, gang=gang,
     )
 
 

@@ -367,6 +367,48 @@ def doctor_report(
 
         check("capacity at risk", _car)
 
+        # The service's gang watches: the last whole-gang counts and
+        # their alert states.  A breached gang watch is a hard FAILED
+        # line — "fewer than N whole gangs fit" is the all-or-nothing
+        # capacity statement a training-job admission plane relies on,
+        # the gang analog of a breached quantile watch.  Same short
+        # budgets; separate connection so a gang-op failure cannot
+        # contaminate the lines above.
+        def _gang():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                status = c.gang()
+            if not status.get("enabled", False):
+                return "not configured (no gang: watches in -watch)"
+            parts = []
+            for name in sorted(status.get("watches", {})):
+                w = status["watches"][name]
+                parts.append(
+                    f"{name}={w.get('last_gangs')}x{w['ranks']}rank"
+                    f"({w.get('binding')},{w['alert']['state']})"
+                )
+            breached = status.get("breached", [])
+            if breached:
+                return (
+                    "FAILED: gang capacity breach — "
+                    + ", ".join(breached)
+                    + " below min_replicas whole gangs; "
+                    + " ".join(parts)
+                )
+            return "ok: " + " ".join(parts)
+
+        check("gang capacity", _gang)
+
         # The service's audit log + shadow oracle: is correctness being
         # continuously observed, and has it ever been caught lying?  A
         # recorded divergence is a hard FAILED line — it means a served

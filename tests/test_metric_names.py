@@ -111,6 +111,24 @@ def test_scan_finds_the_federation_families():
     } <= names
 
 
+def test_scan_finds_the_gang_families():
+    """Non-vacuous pin for the gang tier: the walk must see every
+    kccap_gang_* family (so the README-documentation and snake_case
+    gates below actually cover them), and each must be matched by a
+    README token."""
+    names = _source_metric_names()
+    gang = {n for n in names if n.startswith("kccap_gang_")}
+    assert {"kccap_gang_capacity", "kccap_gang_alert_state"} <= gang
+    patterns = _doc_patterns()
+    undocumented = sorted(
+        n for n in gang if not any(p.fullmatch(n) for p in patterns)
+    )
+    assert not undocumented, (
+        "kccap_gang_* metrics missing from the README observability "
+        f"table: {undocumented}"
+    )
+
+
 def test_metric_names_are_prefixed_snake_case():
     bad = sorted(
         n for n in _source_metric_names() if not _SNAKE_RE.fullmatch(n)
@@ -164,6 +182,11 @@ def test_env_scan_finds_the_known_switches():
     # The federation horizons: the walk must see them so the README
     # configuration-table gate below covers them.
     assert {"KCCAP_FED_STALE_AFTER_S", "KCCAP_FED_EVICT_AFTER_S"} <= names
+    # The gang escape hatch: every KCCAP_GANG_* switch the package
+    # reads must be seen here (and README-gated below).
+    assert "KCCAP_GANG_GROUPED" in {
+        n for n in names if n.startswith("KCCAP_GANG")
+    }
 
 
 def test_every_env_var_is_documented_in_readme():
