@@ -78,6 +78,24 @@ _VOLATILE_RESULT_FIELDS = frozenset(
     {"kernel", "fast_path_error", "report", "engine"}
 )
 
+#: Per-op additions to the volatile set.  The optimize op's float
+#: solver artifacts (bounds, residuals, shadow prices, timings, the
+#: certificate verdict itself, and the LP-guided per-group split) are
+#: legitimately host/device-dependent — f64 iteration on a TPU replays
+#: on a CPU — while the INTEGER packing answer (rounded totals, FFD
+#: totals, schedulability, demand) is closed-form deterministic and
+#: stays in the digest.
+_VOLATILE_RESULT_FIELDS_BY_OP = {
+    "optimize": frozenset(
+        {
+            "lp_bound", "gap_pct", "status", "certified", "duality_gap",
+            "primal_residual", "dual_residual", "iterations", "tol",
+            "solve_seconds", "shadow_prices", "ffd_exceeds_bound",
+            "verified", "groups", "grouping_engaged",
+        }
+    ),
+}
+
 _DIGEST_HEX = 16  # matches flightrec/timeline truncation
 
 
@@ -101,12 +119,13 @@ def strip_args(msg: dict) -> dict:
 
 def canonical_result(op: str, result):
     """The replay-comparable view of an op result (volatile fields
-    stripped; non-dict results pass through)."""
+    stripped, globally and per op; non-dict results pass through)."""
     if not isinstance(result, dict):
         return result
-    return {
-        k: v for k, v in result.items() if k not in _VOLATILE_RESULT_FIELDS
-    }
+    volatile = _VOLATILE_RESULT_FIELDS | _VOLATILE_RESULT_FIELDS_BY_OP.get(
+        op, frozenset()
+    )
+    return {k: v for k, v in result.items() if k not in volatile}
 
 
 def canonical_result_digest(op: str, result) -> str:

@@ -37,7 +37,11 @@ __all__ = ["Replayer", "replay_shadow_bundle"]
 #: ``gang`` qualifies because node labels ride audit checkpoints (the
 #: topology hierarchy reconstructs with the fit columns), and the gang
 #: result's ``engine`` field is canonical-stripped like ``kernel``.
-_REPLAYABLE = frozenset({"sweep", "explain", "fit", "gang"})
+#: ``optimize`` qualifies because its canonical digest keeps only the
+#: closed-form integer packing answer (rounded/FFD totals, demand,
+#: schedulability) — every float solver artifact is per-op
+#: canonical-stripped, so a TPU-recorded solve verifies on a CPU.
+_REPLAYABLE = frozenset({"sweep", "explain", "fit", "gang", "optimize"})
 
 #: fit/sweep args that pull in raw fixture objects or columns outside
 #: the audit vocabulary — present means "recorded, not replayable".

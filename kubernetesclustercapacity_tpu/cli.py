@@ -195,6 +195,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "against the -snapshot source's zone/rack/host "
                         "hierarchy; exit code by schedulability (1 when "
                         "fewer than 'count' gangs fit)")
+    p.add_argument("-optimize", action="store_true",
+                   help="answer the spec (or -grid sweep) with the "
+                        "optimization backend instead of the fit "
+                        "report: certified LP upper bound, rounded "
+                        "integral packing, first-fit baseline, "
+                        "optimality gap, and per-resource shadow "
+                        "prices; every answer carries a duality "
+                        "certificate or is marked uncertified; exit 1 "
+                        "when unschedulable or any solve is "
+                        "uncertified (-backend tpu only)")
+    p.add_argument("-opt-backend", dest="opt_backend",
+                   choices=("ffd", "lp"), default="lp",
+                   help="with -optimize: the certified LP/PDHG solver "
+                        "(lp, default) or the bug-compatible first-fit "
+                        "reference walk alone (ffd)")
     p.add_argument("-replay", default="", metavar="DIR",
                    help="replay a kccap-server audit log: verify the "
                         "generation digest chain, reconstruct every "
@@ -401,6 +416,7 @@ def main(argv: list[str] | None = None) -> int:
                 "drain" if args.drain else
                 "car" if args.car_spec else
                 "gang" if args.gang_spec else
+                "optimize" if args.optimize else
                 "explain" if args.explain else
                 "grid" if args.grid > 0 else "fit"
             )
@@ -469,6 +485,8 @@ def _run_command(args) -> int:
         return _run_car_spec(args, snapshot)
     if args.gang_spec:
         return _run_gang_spec(args, snapshot)
+    if args.optimize:
+        return _run_optimize(args, snapshot, scenario)
     if args.drain:
         return _run_drain(args, fixture, snapshot)
     if args.explain:
@@ -696,6 +714,75 @@ def _run_gang_spec(args, snapshot) -> int:
     else:
         print(gang_table_report(wire))
     return 0 if bool(result.schedulable[0]) else 1
+
+
+def _run_optimize(args, snapshot, scenario) -> int:
+    """-optimize: the optimization-based packing backend, offline.
+
+    Answers the six-flag spec (or a ``-grid N`` random sweep) with the
+    chosen ``-opt-backend`` against the -snapshot source, under the
+    same implicit strict-mode taint mask as every other surface.
+    Exits 1 when the spec is unschedulable by the integral packing, or
+    when any LP solve failed to certify — an uncertified bound is a
+    scriptable failure, not a silent one.
+    """
+    from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+    from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+    from kubernetesclustercapacity_tpu.optimize import (
+        OptimizeError,
+        optimize_snapshot,
+    )
+    from kubernetesclustercapacity_tpu.report import (
+        optimize_json_report,
+        optimize_table_report,
+    )
+    from kubernetesclustercapacity_tpu.scenario import (
+        ScenarioGrid,
+        random_scenario_grid,
+    )
+
+    if args.backend != "tpu":
+        print("ERROR : -optimize runs on the JAX kernels (-backend tpu); "
+              "cpu/native backends are fit-only cross-checks ...exiting")
+        return 1
+    if args.grid > 0:
+        grid = random_scenario_grid(args.grid, seed=args.seed)
+    else:
+        grid = ScenarioGrid.from_scenarios([scenario])
+    mask = implicit_taint_mask(snapshot)
+    mode = args.semantics or snapshot.semantics
+    if args.opt_backend == "ffd":
+        totals, _ = sweep_snapshot(snapshot, grid, mode=mode,
+                                   node_mask=mask)[:2]
+        totals = np.asarray(totals, dtype=np.int64)
+        demand = np.asarray(grid.replicas, dtype=np.int64)
+        wire = {
+            "backend": "ffd",
+            "mode": mode,
+            "scenarios": grid.size,
+            "demand": demand.tolist(),
+            "ffd": np.clip(totals, 0, demand).tolist(),
+            "totals": totals.tolist(),
+            "schedulable": (totals >= demand).tolist(),
+        }
+        if args.output == "json":
+            print(optimize_json_report(wire))
+        else:
+            print(optimize_table_report(wire))
+        return 0 if all(wire["schedulable"]) else 1
+    try:
+        result = optimize_snapshot(snapshot, grid, mode=mode,
+                                   node_mask=mask)
+    except OptimizeError as e:
+        print(f"ERROR : {e}")
+        return 1
+    wire = result.to_wire()
+    if args.output == "json":
+        print(optimize_json_report(wire))
+    else:
+        print(optimize_table_report(wire))
+    ok = result.all_certified and bool(result.schedulable.all())
+    return 0 if ok else 1
 
 
 def _run_slo_status(args) -> int:

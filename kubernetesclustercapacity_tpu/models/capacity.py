@@ -456,14 +456,11 @@ class CapacityModel:
         resources = ("cpu", "memory", *sorted(spec.extended_requests))
         alloc_rn, used_rn = self.snapshot.resource_matrix(resources)
         if spec.priority is not None:
-            t = self._priority_table()
-            k = t.column_index(spec.priority)
-            used_rn = np.stack(
-                [
-                    t.used_cpu_ge[:, k],
-                    t.used_mem_ge[:, k],
-                    *(t.used_ext_ge[r][:, k] for r in resources[2:]),
-                ]
+            # The preemption table's own row assembler (the typed
+            # missing-column refusal lives there, shared with
+            # ops.preemption.fit_with_preemption).
+            used_rn, _ = self._priority_table().multi_columns(
+                spec.priority, resources
             )
         reqs = np.array(
             [

@@ -45,7 +45,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubernetesclustercapacity_tpu.ops.fit import fit_per_node
+from kubernetesclustercapacity_tpu.ops.fit import (
+    fit_per_node,
+    fit_per_node_multi,
+)
 from kubernetesclustercapacity_tpu.snapshot import (
     ClusterSnapshot,
     _STRICT_TERMINATED,
@@ -53,11 +56,19 @@ from kubernetesclustercapacity_tpu.snapshot import (
 )
 
 __all__ = [
+    "PreemptionExtendedError",
     "PriorityTable",
     "build_priority_table",
     "fit_with_preemption",
     "sweep_preemption",
 ]
+
+
+class PreemptionExtendedError(ValueError):
+    """An extended resource was requested that the priority table (or
+    snapshot) carries no columns for — the preemptive fit would
+    silently ignore the eviction gains on that resource, so it refuses
+    instead."""
 
 
 @dataclass
@@ -93,6 +104,39 @@ class PriorityTable:
         """``(used_cpu[N], used_mem[N], pods_count[N])`` for one threshold."""
         k = self.column_index(priority)
         return self.used_cpu_ge[:, k], self.used_mem_ge[:, k], self.pods_ge[:, k]
+
+    def multi_columns(
+        self, priority: int, resources: tuple[str, ...]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """``(used_rn[R, N], pods_count[N])`` for one threshold, rows in
+        ``resources`` order (``"cpu"``/``"memory"`` name the core
+        columns, anything else gathers from :attr:`used_ext_ge`).
+
+        The ONE definition of how extended-resource eviction gains
+        reach the R-dim kernel — :func:`fit_with_preemption`, the
+        extended :func:`sweep_preemption` operands, and
+        :class:`~..models.capacity.CapacityModel` all assemble through
+        it.  A resource the table carries no suffix sums for raises
+        :class:`PreemptionExtendedError` (the fit would otherwise
+        silently charge full non-evictable usage on that resource).
+        """
+        k = self.column_index(priority)
+        rows = []
+        for r in resources:
+            if r == "cpu":
+                rows.append(self.used_cpu_ge[:, k])
+            elif r == "memory":
+                rows.append(self.used_mem_ge[:, k])
+            elif r in self.used_ext_ge:
+                rows.append(self.used_ext_ge[r][:, k])
+            else:
+                raise PreemptionExtendedError(
+                    f"priority table has no extended-resource columns "
+                    f"for {r!r} (built with "
+                    f"{tuple(sorted(self.used_ext_ge))}); rebuild with "
+                    f"extended_resources including it"
+                )
+        return np.stack(rows), self.pods_ge[:, k]
 
 
 def _suffix_sum(per_level: np.ndarray) -> np.ndarray:
@@ -164,13 +208,53 @@ def fit_with_preemption(
     *,
     mode: str = "strict",
     node_mask=None,
+    extended_requests: dict[str, int] | None = None,
 ) -> np.ndarray:
     """Per-node preemptive fit for ONE spec — ``[N]`` int64.
 
     Substitutes the threshold's usage columns into the standard kernel;
     everything else (mode epilogue, mask) is :func:`..fit.fit_per_node`
-    unchanged.
+    unchanged.  With ``extended_requests`` the eviction gains on those
+    columns count too: the table's per-threshold extended suffix sums
+    ride the R-dim kernel (:func:`..fit.fit_per_node_multi` — int64
+    rows, the same kernel non-preemptive extended fits use).  A
+    resource absent from the snapshot or the table raises
+    :class:`PreemptionExtendedError` rather than pricing it as
+    non-evictable.
     """
+    if extended_requests:
+        resources = ("cpu", "memory", *sorted(extended_requests))
+        missing = [
+            r for r in resources[2:] if r not in snapshot.extended
+        ]
+        if missing:
+            raise PreemptionExtendedError(
+                f"snapshot has no extended columns for "
+                f"{', '.join(map(repr, missing))} (packed with "
+                f"{tuple(sorted(snapshot.extended))})"
+            )
+        alloc_rn, _ = snapshot.resource_matrix(resources)
+        used_rn, pods_count = table.multi_columns(priority, resources)
+        reqs = np.array(
+            [
+                int(cpu_req),
+                int(mem_req),
+                *(int(extended_requests[r]) for r in resources[2:]),
+            ],
+            dtype=np.int64,
+        )
+        return np.asarray(
+            fit_per_node_multi(
+                alloc_rn,
+                used_rn,
+                snapshot.alloc_pods,
+                pods_count,
+                snapshot.healthy,
+                reqs,
+                mode=mode,
+                node_mask=node_mask,
+            )
+        )
     used_cpu, used_mem, pods_count = table.columns(priority)
     return np.asarray(
         fit_per_node(
@@ -206,6 +290,9 @@ def sweep_preemption(
     *,
     mode: str = "strict",
     node_mask=None,
+    ext_alloc=None,
+    ext_used_ge=None,
+    ext_reqs=None,
 ):
     """S preemption scenarios in one compiled program.
 
@@ -214,6 +301,14 @@ def sweep_preemption(
     ``[N]`` usage columns and runs the standard fit — ``vmap`` over
     ``(cpu_reqs, mem_reqs, priorities)``.  Returns
     ``(totals[S], schedulable[S])``.
+
+    Extended resources ride three optional operands (all-or-nothing,
+    rows assembled through :meth:`PriorityTable.multi_columns` order):
+    ``ext_alloc[E, N]`` allocatable columns, ``ext_used_ge[E, N, K+1]``
+    the table's per-threshold suffix sums, ``ext_reqs[S, E]``
+    per-scenario requests.  Each scenario then runs the R-dim kernel
+    (int64 rows, matching the non-preemptive extended fit path) with
+    its gathered eviction-adjusted usage.
     """
     levels = jnp.asarray(levels, jnp.int64)
     used_cpu_ge = jnp.asarray(used_cpu_ge, jnp.int64)
@@ -222,6 +317,48 @@ def sweep_preemption(
     kidx = jnp.searchsorted(
         levels, jnp.asarray(priorities, jnp.int64), side="left"
     )
+
+    if ext_used_ge is not None:
+        ext_alloc_rn = jnp.asarray(ext_alloc, jnp.int64)  # [E, N]
+        ext_used = jnp.asarray(ext_used_ge, jnp.int64)  # [E, N, K+1]
+        ext_req_se = jnp.asarray(ext_reqs, jnp.int64)  # [S, E]
+        alloc_rn = jnp.concatenate(
+            [
+                jnp.asarray(alloc_cpu, jnp.int64)[None],
+                jnp.asarray(alloc_mem, jnp.int64)[None],
+                ext_alloc_rn,
+            ],
+            axis=0,
+        )
+
+        def one_ext(c, m, k, er):
+            used_rn = jnp.concatenate(
+                [
+                    used_cpu_ge[None, :, k],
+                    used_mem_ge[None, :, k],
+                    ext_used[:, :, k],
+                ],
+                axis=0,
+            )
+            return fit_per_node_multi(
+                alloc_rn,
+                used_rn,
+                alloc_pods,
+                pods_ge[:, k],
+                healthy,
+                jnp.concatenate([jnp.stack([c, m]), er]),
+                mode=mode,
+                node_mask=node_mask,
+            )
+
+        fits = jax.vmap(one_ext)(
+            jnp.asarray(cpu_reqs, jnp.int64),
+            jnp.asarray(mem_reqs, jnp.int64),
+            kidx,
+            ext_req_se,
+        )
+        totals = jnp.sum(fits, axis=1)
+        return totals, totals >= jnp.asarray(replicas, jnp.int64)
 
     def one(c, m, k):
         return fit_per_node(

@@ -644,6 +644,89 @@ def gang_json_report(gang: dict) -> str:
     return json.dumps(gang, indent=2, sort_keys=True)
 
 
+def optimize_table_report(opt: dict) -> str:
+    """An optimize evaluation (the ``optimize`` op's wire shape /
+    ``kccap -optimize``) as operator-readable text: per scenario the
+    certified LP bound vs the rounded integral packing vs the
+    first-fit baseline, the certificate verdict, and the shadow-price
+    story ("memory is the priced-out resource on 60% of capacity")."""
+    if opt.get("backend") == "ffd":
+        lines = [
+            f"packing (first-fit reference, mode={opt.get('mode')}):",
+        ]
+        for s in range(opt.get("scenarios", 0)):
+            lines.append(
+                f"  scenario {s}: placed {opt['ffd'][s]} of "
+                f"{opt['demand'][s]} requested (fit total "
+                f"{opt['totals'][s]}) — "
+                + (
+                    "schedulable"
+                    if opt["schedulable"][s]
+                    else "NOT schedulable"
+                )
+            )
+        return "\n".join(lines)
+    header = (
+        f"{'S':>3} {'DEMAND':>9} {'LP BOUND':>12} {'ROUNDED':>9} "
+        f"{'FFD':>9} {'GAP%':>7}  STATUS"
+    )
+    lines = [
+        f"optimized packing (LP/PDHG, mode={opt.get('mode')}): "
+        f"{opt.get('groups')} group(s) over {opt.get('nodes')} node(s)"
+        + (
+            " [grouped]"
+            if opt.get("grouping_engaged")
+            else " [ungrouped]"
+        ),
+        f"solver: {opt.get('iterations')} iteration(s), tol "
+        f"{opt.get('tol')}, {opt.get('solve_seconds')}s",
+        header,
+        "-" * len(header),
+    ]
+    for s in range(opt.get("scenarios", 0)):
+        flags = ""
+        if opt.get("ffd_exceeds_bound", [False] * (s + 1))[s]:
+            flags = " (ffd exceeds sane bound: reference quirk)"
+        verified = opt.get("verified")
+        if verified is not None and not verified[s]:
+            flags += " (ROUNDING UNVERIFIED)"
+        lines.append(
+            f"{s:>3} {opt['demand'][s]:>9} {opt['lp_bound'][s]:>12.2f} "
+            f"{opt['rounded'][s]:>9} {opt['ffd'][s]:>9} "
+            f"{opt['gap_pct'][s]:>7.3f}  {opt['status'][s]}" + flags
+        )
+    lines.append("-" * len(header))
+    for s, shadow in enumerate(opt.get("shadow_prices", [])):
+        priced = shadow.get("priced_out", {})
+        top = max(priced, key=priced.get) if priced else None
+        if top is not None and priced[top] > 0:
+            lines.append(
+                f"  scenario {s}: {top} is the priced-out resource on "
+                f"{priced[top] * 100:.0f}% of capacity "
+                f"(demand price {shadow.get('demand_price')})"
+            )
+        else:
+            lines.append(
+                f"  scenario {s}: demand-bound — no capacity is "
+                f"priced (demand price {shadow.get('demand_price')})"
+            )
+    lines.append(
+        "verdict: "
+        + (
+            "certified — every bound carries a duality certificate"
+            if opt.get("certified")
+            else "UNCERTIFIED — bound(s) valid but loose; raise "
+            "KCCAP_OPT_ITERS or tol"
+        )
+    )
+    return "\n".join(lines)
+
+
+def optimize_json_report(opt: dict) -> str:
+    """``-output json``: the wire shape verbatim."""
+    return json.dumps(opt, indent=2, sort_keys=True)
+
+
 def gang_status_table_report(status: dict) -> str:
     """The ``gang`` op's watch-status form (``kccap -gang HOST:PORT``):
     one row per gang watch — last whole-gang count, binding level,

@@ -60,8 +60,8 @@ __all__ = ["CapacityClient", "IDEMPOTENT_OPS"]
 IDEMPOTENT_OPS = frozenset(
     {
         "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
-        "topology_spread", "plan", "explain", "car", "gang", "dump",
-        "timeline", "slo", "drain_server",
+        "topology_spread", "plan", "explain", "car", "gang", "optimize",
+        "dump", "timeline", "slo", "drain_server",
         # Federation ops are pure reads over the federation tier's held
         # snapshots — a retry re-reads the fleet view, which may have
         # advanced; acceptable for the same reason dump/timeline are.
@@ -517,6 +517,25 @@ class CapacityClient:
             if v is not None and hasattr(v, "tolist"):
                 params[key] = v.tolist()
         return self.call("gang", **params)
+
+    def optimize(self, backend: str | None = None, **params) -> dict:
+        """Optimization-based packing.  Takes the sweep grammar
+        (scenario arrays or the six flag fields) plus optional
+        ``backend`` (``"lp"`` — the certified LP solve with duality
+        certificate, shadow prices, rounded integral packing and FFD
+        baseline — or ``"ffd"`` for the bug-compatible first-fit
+        reference alone), ``iters``/``tol`` solver knobs, and
+        ``verify`` (re-check the rounded packing against the
+        sequential oracle; default True).  Deterministic given the
+        snapshot, so transport retries are safe; every answer is
+        either certified or explicitly marked ``uncertified``."""
+        if backend is not None:
+            params["backend"] = backend
+        for key in ("cpu_request_milli", "mem_request_bytes", "replicas"):
+            v = params.get(key)
+            if v is not None and hasattr(v, "tolist"):
+                params[key] = v.tolist()
+        return self.call("optimize", **params)
 
     def dump(self, op: str | None = None, status: str | None = None,
              limit: int | None = None, **kw) -> dict:

@@ -976,6 +976,17 @@ class AdmissionController:
        the ``admission`` phase, then sheds with
        :class:`~..resilience.OverloadedError`.
 
+    An optional **shadow-price budget** (``price_budget`` in ``(0, 1]``)
+    adds a scarcity gate between 1 and 2: the optimizer's certified
+    dual solution prices served capacity (the ``capacity_share`` of its
+    shadow prices — 0 when demand-bound, 1 when every requested replica
+    is priced by a scarce resource), and while the last *certified*
+    observation exceeds the budget, governed compute requests shed with
+    :class:`~..resilience.OverloadedError` — "this request is worth
+    shedding: its shadow price exceeds budget".  Only certified solves
+    move the signal (an uncertified dual is a loose bound, not a
+    price), so the gate can never act on a lie.
+
     Counters are exact under concurrency (pinned by a 16-thread hammer
     in ``tests/test_plane.py``): every governed request is counted
     exactly once as admitted or shed.
@@ -989,6 +1000,7 @@ class AdmissionController:
         burst: float | None = None,
         max_queue_wait_s: float = 0.5,
         min_slack_s: float = 0.0,
+        price_budget: float = 0.0,
         registry=None,
         clock=time.monotonic,
     ) -> None:
@@ -998,6 +1010,12 @@ class AdmissionController:
             )
         if rps < 0:
             raise ValueError(f"rps must be >= 0, got {rps}")
+        if not 0.0 <= price_budget <= 1.0:
+            raise ValueError(
+                f"price_budget must be in [0, 1], got {price_budget}"
+            )
+        self.price_budget = float(price_budget)
+        self._shadow_price: float | None = None
         self.max_concurrent = int(max_concurrent)
         self.rps = float(rps)
         self.max_queue_wait_s = float(max_queue_wait_s)
@@ -1039,6 +1057,26 @@ class AdmissionController:
                     "concurrency gate.",
                 )
 
+    def observe_shadow_price(
+        self, capacity_share: float, *, certified: bool
+    ) -> None:
+        """Record one optimize solve's capacity-price signal.
+
+        Uncertified observations are DISCARDED — the budget gate only
+        ever acts on a certified dual solution.  Called by the server
+        after each ``optimize`` dispatch; harmless without a budget.
+        """
+        if not certified:
+            return
+        with self._lock:
+            self._shadow_price = float(capacity_share)
+
+    def shadow_price(self) -> float | None:
+        """The last certified capacity-price observation (None before
+        any certified solve)."""
+        with self._lock:
+            return self._shadow_price
+
     def count_shed(self, op: str, reason: str) -> None:
         """Record one shed decided OUTSIDE this controller's gates (the
         server's draining refusal uses it, so every refusal lands in the
@@ -1048,10 +1086,14 @@ class AdmissionController:
         if self._m_shed is not None:
             self._m_shed.labels(op=op, reason=reason).inc()
 
-    def admit(self, op: str, deadline=None):
+    def admit(self, op: str, deadline=None, *, priced: bool = True):
         """Gate one governed request: returns a zero-arg ``release``
         callable on admission, raises on shed.  Callers MUST invoke the
-        release in a ``finally`` (the server's dispatch does)."""
+        release in a ``finally`` (the server's dispatch does).
+        ``priced=False`` skips the shadow-price gate — the server
+        exempts the ``optimize`` op itself, since that is the dispatch
+        that refreshes the price (a price-gated refresher could latch
+        the gate shut forever)."""
         # Gate 1: deadline slack — cheapest, and shedding here must not
         # debit the token bucket (the request consumed no capacity).
         if deadline is not None:
@@ -1062,6 +1104,18 @@ class AdmissionController:
                     f"deadline slack {remaining:.3f}s <= "
                     f"{self.min_slack_s:.3f}s at admission; shedding "
                     "without dispatch"
+                )
+        # Gate 1.5: shadow-price budget — a pure read, before the token
+        # bucket (a priced-out request consumed no capacity).
+        if priced and self.price_budget > 0.0:
+            with self._lock:
+                price = self._shadow_price
+            if price is not None and price > self.price_budget:
+                self.count_shed(op, "shadow_price")
+                raise OverloadedError(
+                    f"capacity shadow price {price:.3f} exceeds budget "
+                    f"{self.price_budget:.3f}; shedding — retry another "
+                    "replica"
                 )
         # Gate 2: rps.
         if self._bucket is not None and not self._bucket.try_acquire():

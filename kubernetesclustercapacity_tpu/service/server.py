@@ -551,7 +551,7 @@ class CapacityServer:
         {
             "fit", "sweep", "sweep_multi", "place", "drain",
             "topology_spread", "plan", "explain", "car", "gang",
-            "update", "reload",
+            "optimize", "update", "reload",
         }
     )
 
@@ -632,8 +632,8 @@ class CapacityServer:
         {
             "ping", "info", "fit", "sweep", "sweep_multi", "place",
             "drain", "topology_spread", "plan", "explain", "car",
-            "gang", "dump", "timeline", "slo", "reload", "update",
-            "drain_server",
+            "gang", "optimize", "dump", "timeline", "slo", "reload",
+            "update", "drain_server",
         }
     )
 
@@ -645,6 +645,7 @@ class CapacityServer:
         {
             "fit", "sweep", "sweep_multi", "place", "drain",
             "topology_spread", "plan", "explain", "car", "gang",
+            "optimize",
         }
     )
 
@@ -720,7 +721,11 @@ class CapacityServer:
                 # parses a grid, never waits for a compute slot, never
                 # touches the device.
                 release = self._admission.admit(
-                    op_label, self._check_deadline(msg, shed=False)
+                    op_label,
+                    self._check_deadline(msg, shed=False),
+                    # optimize refreshes the shadow-price signal, so it
+                    # is never gated by it (see AdmissionController).
+                    priced=op_label != "optimize",
                 )
             result = self._dispatch_routed(msg)
             return result
@@ -1083,6 +1088,8 @@ class CapacityServer:
             return self._op_car(msg, snap, implicit_mask)
         if op == "gang":
             return self._op_gang(msg, snap, implicit_mask)
+        if op == "optimize":
+            return self._op_optimize(msg, snap, implicit_mask)
         if op == "dump":
             return self._op_dump(msg)
         if op == "timeline":
@@ -1721,6 +1728,118 @@ class CapacityServer:
             out["explain"] = gang_explain(
                 snap, grid, spec,
                 mode=snap.semantics, node_mask=implicit_mask,
+            )
+        return out
+
+    def _op_optimize(
+        self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
+    ) -> dict:
+        """Optimization-based packing over the wire: the sweep grammar
+        (scenario arrays or the six flags), answered by the chosen
+        ``backend``:
+
+        * ``"lp"`` (default) — the certified LP solve
+          (:func:`~..optimize.optimize_snapshot`): certified dual
+          bound, integral rounded packing, FFD baseline, per-resource
+          shadow prices, and the duality certificate.  A certified
+          solve also refreshes the admission controller's
+          shadow-price signal.
+        * ``"ffd"`` — the bug-compatible first-fit reference alone
+          (the production fit path's placed counts), for clients that
+          want the baseline without paying the solve.
+
+        Same semantics and implicit strict-mode taint mask as
+        fit/sweep, so the optimizer prices the capacity those ops
+        serve.
+        """
+        from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+        from kubernetesclustercapacity_tpu.optimize import (
+            OptimizeError,
+            optimize_snapshot,
+        )
+
+        backend = msg.get("backend", "lp")
+        if backend not in ("lp", "ffd"):
+            raise ValueError(
+                f"optimize backend must be 'lp' or 'ffd', got {backend!r}"
+            )
+        if "cpu_request_milli" in msg:
+            try:
+                grid = ScenarioGrid(
+                    cpu_request_milli=np.asarray(msg["cpu_request_milli"]),
+                    mem_request_bytes=np.asarray(msg["mem_request_bytes"]),
+                    replicas=np.asarray(msg.get("replicas", [1])),
+                )
+            except (ScenarioError, KeyError, TypeError, ValueError) as e:
+                raise ValueError(f"bad optimize request: {e}") from e
+        else:
+            grid = ScenarioGrid.from_scenarios([self._scenario_from_msg(msg)])
+
+        if backend == "ffd":
+            grid.validate()
+            totals, sched = sweep_snapshot(
+                snap, grid, mode=snap.semantics, node_mask=implicit_mask
+            )[:2]
+            totals = np.asarray(totals, dtype=np.int64)
+            demand = np.asarray(grid.replicas, dtype=np.int64)
+            out = {
+                "backend": "ffd",
+                "mode": snap.semantics,
+                "scenarios": grid.size,
+                "demand": demand.tolist(),
+                "ffd": np.clip(totals, 0, demand).tolist(),
+                "totals": totals.tolist(),
+                "schedulable": (totals >= demand).tolist(),
+            }
+        else:
+            kwargs = {}
+            for key, cast in (("iters", int), ("tol", float)):
+                if key in msg:
+                    v = msg[key]
+                    if isinstance(v, bool) or not isinstance(
+                        v, (int, float)
+                    ):
+                        raise ValueError(
+                            f"{key} must be a number, got {v!r}"
+                        )
+                    kwargs["max_iters" if key == "iters" else key] = cast(v)
+            verify = msg.get("verify", True)
+            if not isinstance(verify, bool):
+                raise ValueError(f"verify must be a bool, got {verify!r}")
+            try:
+                result = optimize_snapshot(
+                    snap,
+                    grid,
+                    mode=snap.semantics,
+                    node_mask=implicit_mask,
+                    verify=verify,
+                    **kwargs,
+                )
+            except (OptimizeError, ScenarioError) as e:
+                raise ValueError(f"bad optimize request: {e}") from e
+            out = result.to_wire()
+            if self._admission is not None and result.all_certified:
+                # The dual prices the capacity this server is serving:
+                # feed the worst (most scarce) scenario's capacity
+                # share to the shed-by-shadow-price gate.
+                share = max(
+                    (s["capacity_share"] for s in result.shadow),
+                    default=0.0,
+                )
+                self._admission.observe_shadow_price(
+                    share, certified=True
+                )
+        output = msg.get("output")
+        if output in ("table", "json"):
+            from kubernetesclustercapacity_tpu.report import (
+                optimize_json_report,
+                optimize_table_report,
+            )
+
+            out["report"] = (
+                optimize_table_report(out)
+                if output == "table"
+                else optimize_json_report(out)
             )
         return out
 
@@ -2434,6 +2553,15 @@ def main(argv=None) -> int:
                    dest="admission_burst", metavar="N",
                    help="token-bucket burst capacity for -admission-rps "
                         "(0 = max(rps, 1))")
+    p.add_argument("-admission-price-budget", type=float, default=0.0,
+                   dest="admission_price_budget", metavar="SHARE",
+                   help="shed-by-shadow-price: while the last CERTIFIED "
+                        "optimize solve prices more than this share of "
+                        "capacity (its shadow-price capacity_share in "
+                        "(0, 1]), compute requests shed with the "
+                        "retryable-elsewhere 'overloaded' error "
+                        "(0 = no price gate; the optimize op itself is "
+                        "never price-gated)")
     p.add_argument("-drain-timeout-s", type=float, default=10.0,
                    dest="drain_timeout_s", metavar="SECONDS",
                    help="graceful drain bound (SIGTERM/SIGINT or the "
@@ -2597,15 +2725,28 @@ def main(argv=None) -> int:
                 follower.stop()
             return 1
     admission = None
-    if args.admission_max_concurrent > 0 or args.admission_rps > 0:
+    if (
+        args.admission_max_concurrent > 0
+        or args.admission_rps > 0
+        or args.admission_price_budget > 0
+    ):
         from kubernetesclustercapacity_tpu.service.plane import (
             AdmissionController,
         )
 
+        if not 0.0 <= args.admission_price_budget <= 1.0:
+            print(
+                "ERROR : -admission-price-budget must be in [0, 1]",
+                file=sys.stderr,
+            )
+            if follower is not None:
+                follower.stop()
+            return 1
         admission = AdmissionController(
             max_concurrent=max(args.admission_max_concurrent, 0),
             rps=max(args.admission_rps, 0.0),
             burst=args.admission_burst if args.admission_burst > 0 else None,
+            price_budget=args.admission_price_budget,
             registry=REGISTRY,
         )
     plane_pub = None

@@ -226,6 +226,47 @@ def doctor_report(
 
     check("device snapshot cache", _hot_path)
 
+    def _optimizer():
+        # One tiny certified solve in-process: proves the LP/PDHG
+        # backend converges AND certifies on this host — an optimizer
+        # that cannot close its duality gap is a hard FAILED line (its
+        # bounds would be valid but useless).
+        import numpy as _np
+
+        from kubernetesclustercapacity_tpu.optimize import (
+            optimize_snapshot,
+        )
+        from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+        from kubernetesclustercapacity_tpu.snapshot import (
+            synthetic_snapshot,
+        )
+
+        snap = synthetic_snapshot(64, seed=3, shapes=4)
+        grid = ScenarioGrid(
+            cpu_request_milli=_np.array([250, 2000], dtype=_np.int64),
+            mem_request_bytes=_np.array(
+                [256 << 20, 2 << 30], dtype=_np.int64
+            ),
+            replicas=_np.array([10**6, 3], dtype=_np.int64),
+        )
+        r = optimize_snapshot(snap, grid, mode="strict")
+        if not r.all_certified:
+            return (
+                "FAILED: uncertified solve — worst gap "
+                f"{float(r.duality_gap.max()):.2e} after "
+                f"{r.iterations} iteration(s) (tol {r.tol})"
+            )
+        if r.verified is not None and not bool(r.verified.all()):
+            return "FAILED: rounded packing failed oracle verification"
+        return (
+            f"ok: certified in {r.iterations} iteration(s), worst gap "
+            f"{float(r.duality_gap.max()):.1e}, bound "
+            f"{float(r.lp_bound[0]):.1f} vs rounded "
+            f"{int(r.rounded[0])}"
+        )
+
+    check("optimizer", _optimizer)
+
     if service_addr is not None:
         # A LIVE service's resilience counters (deadline sheds, breaker
         # state, follower retry/backoff) — the doctor probes the same

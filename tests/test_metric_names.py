@@ -129,6 +129,29 @@ def test_scan_finds_the_gang_families():
     )
 
 
+def test_scan_finds_the_optimizer_families():
+    """Non-vacuous pin for the optimization backend: the walk must see
+    every kccap_opt_* family (so the README-documentation and
+    snake_case gates below actually cover them), and each must be
+    matched by a README token."""
+    names = _source_metric_names()
+    opt = {n for n in names if n.startswith("kccap_opt_")}
+    assert {
+        "kccap_opt_iterations",
+        "kccap_opt_duality_gap",
+        "kccap_opt_solve_seconds",
+        "kccap_opt_certified_total",
+    } <= opt
+    patterns = _doc_patterns()
+    undocumented = sorted(
+        n for n in opt if not any(p.fullmatch(n) for p in patterns)
+    )
+    assert not undocumented, (
+        "kccap_opt_* metrics missing from the README observability "
+        f"table: {undocumented}"
+    )
+
+
 def test_metric_names_are_prefixed_snake_case():
     bad = sorted(
         n for n in _source_metric_names() if not _SNAKE_RE.fullmatch(n)
@@ -186,6 +209,10 @@ def test_env_scan_finds_the_known_switches():
     # reads must be seen here (and README-gated below).
     assert "KCCAP_GANG_GROUPED" in {
         n for n in names if n.startswith("KCCAP_GANG")
+    }
+    # The optimizer solver knobs (and README-gated below).
+    assert {"KCCAP_OPT_ITERS", "KCCAP_OPT_TOL"} <= {
+        n for n in names if n.startswith("KCCAP_OPT")
     }
 
 

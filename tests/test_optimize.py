@@ -1,0 +1,560 @@
+"""Optimization-based packing backend (``optimize/``, the ``optimize``
+op, ``kccap -optimize``).
+
+Three independent ground truths pin the solver:
+
+* ``scipy.optimize.linprog`` on the explicit standard-form LP (gated
+  skip where scipy is absent, like the PR 8 ruff/mypy shell-outs);
+* the closed-form optimum of this structured program
+  (``lp_bound_oracle`` — demand-capped sum of per-group box bounds);
+* the sequential :func:`~kubernetesclustercapacity_tpu.oracle.
+  fit_arrays_python` walk, which every rounded integral packing must
+  fit inside.
+
+The certificate property under test is the load-bearing one: a
+``certified`` answer's duality gap and feasibility residuals are within
+tolerance, an uncertified answer still carries a VALID (merely loose)
+upper bound, and the integral rounding never exceeds either.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import kubernetesclustercapacity_tpu as kcc
+from kubernetesclustercapacity_tpu.cli import main as cli_main
+from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+from kubernetesclustercapacity_tpu.optimize import (
+    OptimizeError,
+    lp_bound_oracle,
+    opt_max_iters,
+    opt_tol,
+    optimize_snapshot,
+    verify_rounded_packing,
+)
+from kubernetesclustercapacity_tpu.optimize import lp as lp_mod
+from kubernetesclustercapacity_tpu.fixtures import synthetic_fixture
+from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+from kubernetesclustercapacity_tpu.snapshot import (
+    snapshot_from_fixture,
+    synthetic_snapshot,
+)
+
+try:
+    from scipy.optimize import linprog as _linprog
+except Exception:  # pragma: no cover - image without scipy
+    _linprog = None
+
+MIB = 1 << 20
+GIB = 1 << 30
+
+
+def _grid(cpu, mem, replicas):
+    return ScenarioGrid(
+        cpu_request_milli=np.asarray(cpu, dtype=np.int64),
+        mem_request_bytes=np.asarray(mem, dtype=np.int64),
+        replicas=np.asarray(replicas, dtype=np.int64),
+    )
+
+
+def _random_grid(rng, s, demand_hi):
+    return _grid(
+        rng.integers(50, 4000, s),
+        rng.integers(32 * MIB, 4 * GIB, s),
+        rng.integers(1, demand_hi, s),
+    )
+
+
+def _scipy_lp_optimum(snapshot, grid, mode, node_mask=None):
+    """The SAME LP handed to scipy's solver in explicit standard form:
+    max 1'x  s.t.  req_r x_g <= count_g head_gr, sum x <= d, x >= 0."""
+    head, counts, _ = lp_mod._packing_operands(
+        snapshot, mode=mode, node_mask=node_mask
+    )
+    reqs = lp_mod._req_matrix(grid)
+    caps = lp_mod._float_caps(head, counts, reqs)
+    out = []
+    for s in range(grid.size):
+        g = head.shape[0]
+        ub = caps[s].min(axis=1)  # box form of the per-(g, r) rows
+        res = _linprog(
+            c=-np.ones(g),
+            A_ub=np.ones((1, g)),
+            b_ub=[float(grid.replicas[s])],
+            bounds=list(zip(np.zeros(g), ub)),
+            method="highs",
+        )
+        assert res.status == 0, res.message
+        out.append(-res.fun)
+    return np.array(out)
+
+
+class TestSolverOracles:
+    @pytest.mark.skipif(_linprog is None, reason="scipy not installed")
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    def test_lp_bound_matches_scipy(self, mode):
+        rng = np.random.default_rng(11)
+        snap = snapshot_from_fixture(
+            synthetic_fixture(128, seed=7, unhealthy_frac=0.2),
+            semantics=mode,
+        )
+        grid = _random_grid(rng, 12, 10**7)
+        res = optimize_snapshot(snap, grid, mode=mode)
+        want = _scipy_lp_optimum(snap, grid, mode)
+        assert res.all_certified
+        # A certified gap <= tol·(1+|D|+|P|) admits ~2·tol relative
+        # deviation from the true optimum.
+        np.testing.assert_allclose(
+            res.lp_bound, want, rtol=5e-6, atol=1e-6
+        )
+
+    @pytest.mark.skipif(_linprog is None, reason="scipy not installed")
+    def test_scipy_agrees_with_closed_form(self):
+        """The closed-form oracle and scipy must agree on the same
+        instance — ties the two independent ground truths together."""
+        snap = synthetic_snapshot(128, seed=3, shapes=4)
+        grid = _random_grid(np.random.default_rng(5), 8, 10**6)
+        np.testing.assert_allclose(
+            _scipy_lp_optimum(snap, grid, "strict"),
+            lp_bound_oracle(snap, grid, mode="strict"),
+            rtol=1e-9,
+        )
+
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    @pytest.mark.parametrize("grouping", ["1", "0"])
+    def test_randomized_certified_solves(self, mode, grouping, monkeypatch):
+        """Randomized fleets with unhealthy, tainted, and masked nodes,
+        both semantics, grouped and ungrouped: every solve certifies,
+        the certificate numbers honor their own tolerance, the bound
+        matches the closed form, and the rounding chain holds
+        (ffd <= rounded <= bound in strict mode; rounded verified
+        feasible everywhere)."""
+        monkeypatch.setenv("KCCAP_GROUPING", grouping)
+        rng = np.random.default_rng(17)
+        for trial in range(4):
+            snap = snapshot_from_fixture(
+                synthetic_fixture(
+                    int(rng.integers(48, 256)),
+                    seed=int(rng.integers(10**6)),
+                    unhealthy_frac=0.15,
+                    taint_frac=0.2,
+                ),
+                semantics=mode,
+            )
+            grid = _random_grid(rng, int(rng.integers(1, 9)), 10**7)
+            mask = implicit_taint_mask(snap)
+            if mask is not None and rng.random() < 0.5:
+                extra = rng.random(snap.n_nodes) < 0.8
+                mask = mask & extra
+            res = optimize_snapshot(snap, grid, mode=mode, node_mask=mask)
+            label = f"trial {trial} mode {mode} grouping {grouping}"
+            assert res.all_certified, label
+            assert (res.duality_gap <= res.tol).all(), label
+            assert (res.primal_residual <= res.tol).all(), label
+            want = lp_bound_oracle(snap, grid, mode=mode, node_mask=mask)
+            np.testing.assert_allclose(
+                res.lp_bound, want, rtol=1e-5, atol=1e-5, err_msg=label
+            )
+            # Integral chain: rounded never exceeds the certified bound.
+            assert (
+                res.rounded.astype(float) <= res.lp_bound * (1 + res.tol) + 1e-9
+            ).all(), label
+            assert res.verified is not None and res.verified.all(), label
+            if mode == "strict":
+                # Strict first-fit is exactly the integral optimum of
+                # this separable program — the walk and the rounding
+                # must agree to the replica.
+                np.testing.assert_array_equal(
+                    res.rounded, res.ffd, err_msg=label
+                )
+                assert not res.ffd_exceeds_bound.any(), label
+
+    def test_grouped_and_ungrouped_agree(self, monkeypatch):
+        snap = synthetic_snapshot(2048, seed=9, shapes=4)
+        grid = _random_grid(np.random.default_rng(2), 6, 10**7)
+        monkeypatch.setenv("KCCAP_GROUPING", "1")
+        grouped = optimize_snapshot(snap, grid, mode="strict")
+        assert grouped.grouping_engaged and grouped.groups < snap.n_nodes
+        monkeypatch.setenv("KCCAP_GROUPING", "0")
+        flat = optimize_snapshot(snap, grid, mode="strict")
+        assert not flat.grouping_engaged
+        np.testing.assert_array_equal(grouped.rounded, flat.rounded)
+        np.testing.assert_array_equal(grouped.ffd, flat.ffd)
+        np.testing.assert_allclose(
+            grouped.lp_bound, flat.lp_bound, rtol=1e-6
+        )
+
+    def test_uncertified_bound_is_still_valid(self):
+        """Starved of iterations the solve must say so — and its loose
+        bound must STILL sit above the true optimum (the repair-based
+        certificate cannot lie, only widen)."""
+        snap = synthetic_snapshot(512, seed=21, shapes=6)
+        grid = _grid([1500], [GIB], [10**8])  # capacity-bound
+        res = optimize_snapshot(snap, grid, mode="strict", max_iters=1)
+        assert res.iterations == 1
+        assert not res.all_certified
+        assert res.to_wire()["status"] == ["uncertified"]
+        truth = lp_bound_oracle(snap, grid, mode="strict")
+        assert (res.lp_bound >= truth - 1e-6).all()
+        assert (res.rounded.astype(float) <= res.lp_bound + 1e-6).all()
+
+    def test_shadow_prices_name_the_scarce_resource(self):
+        """A memory-starved fleet must price memory, a cpu-starved one
+        cpu, and a demand-bound request must price nothing."""
+        snap = synthetic_snapshot(256, seed=13, shapes=4)
+        grid = _grid([1, 1, 500], [8 * GIB, 1, 256 * MIB], [10**9, 1, 1])
+        res = optimize_snapshot(snap, grid, mode="strict")
+        assert res.all_certified
+        mem_shadow = res.shadow[0]
+        assert mem_shadow["priced_out"]["memory"] > 0.99
+        assert mem_shadow["capacity_share"] > 0.99
+        demand_shadow = res.shadow[1]
+        assert demand_shadow["capacity_share"] == 0.0
+        assert demand_shadow["demand_price"] == pytest.approx(1.0, abs=1e-5)
+
+    def test_empty_and_degenerate_instances(self):
+        empty = synthetic_snapshot(0, seed=1)
+        grid = _grid([100], [MIB], [5])
+        res = optimize_snapshot(empty, grid, mode="strict")
+        assert res.all_certified
+        assert res.lp_bound[0] == 0.0 and res.rounded[0] == 0
+        assert not res.schedulable[0]
+
+    def test_knob_validation(self):
+        snap = synthetic_snapshot(16, seed=1)
+        grid = _grid([100], [MIB], [1])
+        with pytest.raises(OptimizeError, match="max_iters"):
+            optimize_snapshot(snap, grid, max_iters=0)
+        with pytest.raises(OptimizeError, match="tol"):
+            optimize_snapshot(snap, grid, tol=0.5)
+
+    def test_env_knobs_validated_fallback(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_OPT_ITERS", "junk")
+        assert opt_max_iters() == lp_mod.DEFAULT_MAX_ITERS
+        monkeypatch.setenv("KCCAP_OPT_ITERS", "100")  # below chunk floor
+        assert opt_max_iters() == lp_mod.DEFAULT_MAX_ITERS
+        monkeypatch.setenv("KCCAP_OPT_ITERS", "4000")
+        assert opt_max_iters() == 4000
+        monkeypatch.setenv("KCCAP_OPT_TOL", "0")
+        assert opt_tol() == lp_mod.DEFAULT_TOL
+        monkeypatch.setenv("KCCAP_OPT_TOL", "1e-4")
+        assert opt_tol() == 1e-4
+
+    def test_verify_rejects_an_infeasible_packing(self):
+        """The oracle re-check is not vacuous: inflate one group's
+        allocation beyond its integral capacity and the verifier must
+        say no."""
+        snap = synthetic_snapshot(64, seed=5, shapes=3)
+        grid = _grid([500], [256 * MIB], [10**7])
+        res = optimize_snapshot(snap, grid, mode="strict")
+        assert res.verified.all()
+        res.rounded_alloc = res.rounded_alloc.copy()
+        res.rounded_alloc[0, 0] += 10**9
+        assert not verify_rounded_packing(snap, grid, res).all()
+
+
+class TestOptimizeService:
+    @pytest.fixture()
+    def server(self):
+        from kubernetesclustercapacity_tpu.service import CapacityServer
+
+        snap = synthetic_snapshot(1500, seed=4, shapes=5)
+        srv = CapacityServer(snap, port=0)
+        srv.start()
+        yield srv
+        srv.shutdown()
+
+    def _client(self, srv):
+        from kubernetesclustercapacity_tpu.service import CapacityClient
+
+        return CapacityClient(*srv.address)
+
+    def test_op_matches_offline_engine(self, server):
+        snap = synthetic_snapshot(1500, seed=4, shapes=5)
+        grid = _grid([500, 100], [512 * MIB, 64 * MIB], [10**6, 10])
+        want = optimize_snapshot(snap, grid, mode="reference")
+        with self._client(server) as c:
+            got = c.optimize(
+                cpu_request_milli=grid.cpu_request_milli,
+                mem_request_bytes=grid.mem_request_bytes,
+                replicas=grid.replicas,
+            )
+        assert got["rounded"] == want.rounded.tolist()
+        assert got["ffd"] == want.ffd.tolist()
+        assert got["status"] == ["certified", "certified"]
+        np.testing.assert_allclose(
+            got["lp_bound"], want.lp_bound, rtol=1e-6, atol=1e-4
+        )
+
+    def test_op_six_flag_form_and_reports(self, server):
+        with self._client(server) as c:
+            r = c.optimize(
+                cpuRequests="500m", memRequests="512mb",
+                replicas="100000", output="table",
+            )
+            assert r["report"].startswith("optimized packing")
+            assert "priced-out resource" in r["report"]
+            j = c.optimize(
+                cpuRequests="500m", memRequests="512mb",
+                replicas="100000", output="json",
+            )
+            assert json.loads(j["report"])["certified"] == j["certified"]
+
+    def test_op_ffd_backend(self, server):
+        with self._client(server) as c:
+            r = c.optimize(
+                backend="ffd",
+                cpu_request_milli=[500], mem_request_bytes=[512 * MIB],
+                replicas=[10],
+            )
+            assert r["backend"] == "ffd"
+            assert r["schedulable"] == [True]
+            assert "lp_bound" not in r
+            sweep = c.sweep(
+                cpu_request_milli=[500],
+                mem_request_bytes=[512 * MIB],
+                replicas=[10],
+            )
+            assert r["totals"] == sweep["totals"]
+
+    def test_op_typed_errors(self, server):
+        with self._client(server) as c:
+            for bad in (
+                {"backend": "simplex", "cpuRequests": "1"},
+                {"iters": "many", "cpuRequests": "1"},
+                {"verify": "yes", "cpuRequests": "1"},
+                {"tol": 0.9, "cpuRequests": "1"},
+            ):
+                with pytest.raises(Exception, match="ValueError"):
+                    c.optimize(**bad)
+
+    def test_admission_price_funnel(self):
+        """Certified capacity-bound solve → price above budget → sweeps
+        shed retryable-elsewhere; optimize itself stays exempt and a
+        demand-bound solve reopens the gate.  Uncertified solves must
+        never move the price."""
+        from kubernetesclustercapacity_tpu.resilience import (
+            OverloadedError,
+        )
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+        from kubernetesclustercapacity_tpu.service.plane import (
+            AdmissionController,
+        )
+
+        snap = synthetic_snapshot(1500, seed=4, shapes=5)
+        adm = AdmissionController(price_budget=0.5)
+        srv = CapacityServer(snap, port=0, admission=adm)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                c.optimize(
+                    cpuRequests="500m", memRequests="512mb",
+                    replicas="10000000",
+                )
+                assert adm.shadow_price() == pytest.approx(1.0, abs=1e-4)
+                with pytest.raises(OverloadedError):
+                    c.sweep(
+                        cpu_request_milli=[100],
+                        mem_request_bytes=[MIB],
+                        replicas=[1],
+                    )
+                # Uncertified observations are discarded.
+                adm.observe_shadow_price(0.0, certified=False)
+                assert adm.shadow_price() == pytest.approx(1.0, abs=1e-4)
+                # optimize is exempt, and a certified demand-bound
+                # solve drops the price below budget.
+                c.optimize(
+                    cpuRequests="500m", memRequests="512mb", replicas="1"
+                )
+                assert c.sweep(
+                    cpu_request_milli=[100],
+                    mem_request_bytes=[MIB],
+                    replicas=[1],
+                )["totals"]
+        finally:
+            srv.shutdown()
+
+    def test_price_budget_validation(self):
+        from kubernetesclustercapacity_tpu.service.plane import (
+            AdmissionController,
+        )
+
+        with pytest.raises(ValueError, match="price_budget"):
+            AdmissionController(price_budget=1.5)
+
+    def test_audit_replay_round_trip(self, tmp_path):
+        from kubernetesclustercapacity_tpu.audit import (
+            AuditLog,
+            AuditReader,
+        )
+        from kubernetesclustercapacity_tpu.audit.replay import Replayer
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        snap = synthetic_snapshot(1500, seed=4, shapes=5)
+        srv = CapacityServer(
+            snap, port=0, audit_log=AuditLog(str(tmp_path))
+        )
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                c.optimize(
+                    cpuRequests="500m", memRequests="512mb",
+                    replicas="100000",
+                )
+                c.optimize(
+                    backend="ffd", cpuRequests="100m",
+                    memRequests="100mb", replicas="5",
+                )
+        finally:
+            srv.shutdown()
+        reader = AuditReader.load(str(tmp_path))
+        recs = [
+            r
+            for r in reader.records
+            if r.get("kind") == "request" and r.get("op") == "optimize"
+        ]
+        assert len(recs) == 2
+        with Replayer(reader) as rp:
+            for rec in recs:
+                out = rp.replay_record(rec)
+                assert out["status"] == "ok", out
+
+    def test_float_solver_fields_are_canonical_stripped(self):
+        from kubernetesclustercapacity_tpu.audit.log import (
+            canonical_result,
+        )
+
+        snap = synthetic_snapshot(256, seed=2, shapes=3)
+        grid = _grid([500], [256 * MIB], [10**6])
+        wire = optimize_snapshot(snap, grid, mode="strict").to_wire()
+        canon = canonical_result("optimize", wire)
+        for volatile in (
+            "lp_bound", "duality_gap", "shadow_prices", "solve_seconds",
+            "iterations", "status", "certified",
+        ):
+            assert volatile not in canon
+        for stable in ("rounded", "ffd", "demand", "schedulable", "mode"):
+            assert stable in canon
+
+    def test_metrics_funnel_and_zero_registry_pin(self, monkeypatch):
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            REGISTRY,
+        )
+
+        snap = synthetic_snapshot(64, seed=5, shapes=3)
+        grid = _grid([500], [256 * MIB], [100])
+        optimize_snapshot(snap, grid, mode="strict")
+        snap_reg = REGISTRY.snapshot()
+        certified = {
+            k: v
+            for k, v in snap_reg.items()
+            if k.startswith("kccap_opt_certified_total")
+        }
+        assert certified, sorted(snap_reg)
+        assert "kccap_opt_iterations" in snap_reg
+        assert "kccap_opt_duality_gap" in snap_reg
+        # Telemetry off: the lazy metric table must never even build.
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        monkeypatch.setattr(lp_mod, "_OPT_MET", None)
+        optimize_snapshot(snap, grid, mode="strict")
+        assert lp_mod._OPT_MET is None
+
+
+class TestOptimizeCLI:
+    def _snapshot_file(self, tmp_path, n=512):
+        snap = synthetic_snapshot(n, seed=6, shapes=4)
+        path = tmp_path / "snap.npz"
+        snap.save(str(path))
+        return str(path), snap
+
+    def test_table_and_exit_codes(self, tmp_path, capsys):
+        snap_path, _ = self._snapshot_file(tmp_path)
+        rc = cli_main([
+            "-snapshot", snap_path, "-optimize",
+            "-cpuRequests=250m", "-memRequests=128mb", "-replicas=5",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.startswith("optimized packing")
+        assert "certified" in out
+        # Unschedulable demand exits 1 (certified or not).
+        rc = cli_main([
+            "-snapshot", snap_path, "-optimize",
+            "-cpuRequests=250m", "-memRequests=128mb",
+            "-replicas=1000000000",
+        ])
+        assert rc == 1
+        assert "NOT schedulable" not in capsys.readouterr().out  # lp table
+
+    def test_json_matches_library(self, tmp_path, capsys):
+        snap_path, snap = self._snapshot_file(tmp_path)
+        rc = cli_main([
+            "-snapshot", snap_path, "-optimize", "-output", "json",
+            "-cpuRequests=250m", "-memRequests=128mb", "-replicas=5",
+        ])
+        assert rc == 0
+        got = json.loads(capsys.readouterr().out)
+        grid = ScenarioGrid.from_scenarios(
+            [
+                __import__(
+                    "kubernetesclustercapacity_tpu.scenario",
+                    fromlist=["scenario_from_flags"],
+                ).scenario_from_flags(
+                    cpuRequests="250m", cpuLimits="200m",
+                    memRequests="128mb", memLimits="200mb", replicas="5",
+                )
+            ]
+        )
+        want = optimize_snapshot(
+            snap, grid, mode="reference",
+            node_mask=implicit_taint_mask(snap),
+        )
+        assert got["rounded"] == want.rounded.tolist()
+        assert got["ffd"] == want.ffd.tolist()
+
+    def test_ffd_backend_and_grid(self, tmp_path, capsys):
+        snap_path, _ = self._snapshot_file(tmp_path)
+        rc = cli_main([
+            "-snapshot", snap_path, "-optimize", "-opt-backend", "ffd",
+            "-cpuRequests=250m", "-memRequests=128mb", "-replicas=5",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.startswith("packing (first-fit reference")
+        rc = cli_main([
+            "-snapshot", snap_path, "-optimize", "-grid", "4",
+            "-seed", "3",
+        ])
+        out = capsys.readouterr().out
+        assert "S    DEMAND" in out.replace("  ", " ") or "DEMAND" in out
+
+    def test_non_tpu_backend_refused(self, tmp_path, capsys):
+        snap_path, _ = self._snapshot_file(tmp_path)
+        rc = cli_main([
+            "-snapshot", snap_path, "-optimize", "-backend", "cpu",
+            "-cpuRequests=250m", "-memRequests=128mb", "-replicas=5",
+        ])
+        assert rc == 1
+        assert "-backend tpu" in capsys.readouterr().out
+
+
+class TestOptimizeDoctor:
+    def test_doctor_has_a_certified_optimizer_line(self):
+        from kubernetesclustercapacity_tpu.utils.doctor import (
+            doctor_report,
+        )
+
+        checks = dict(
+            doctor_report(backend_timeout_s=60.0, probe_code="print('DEVICES x')")
+        )
+        assert "optimizer" in checks
+        assert checks["optimizer"].startswith("ok: certified"), checks[
+            "optimizer"
+        ]

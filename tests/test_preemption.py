@@ -448,3 +448,242 @@ class TestServiceWire:
         t2 = srv._priority_table_for(fx2, snap)
         assert t2 is not t1
         assert srv._priority_table_for(fx2, snap) is t2
+
+
+# -- extended resources through the preemption tables ----------------------
+#
+# build_priority_table always built used_ext_ge suffix sums, but the
+# ops-layer entry points never consumed them: an extended preemptive fit
+# silently charged full (non-evictable) extended usage.  The columns now
+# wire through fit_with_preemption / sweep_preemption via
+# PriorityTable.multi_columns, with a typed refusal when the table (or
+# snapshot) lacks the requested resource.
+
+GPU = "nvidia.com/gpu"
+
+
+def _gpu_fixture(n_nodes=14, seed=11):
+    fx = _prioritized_fixture(n_nodes=n_nodes, seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    for node in fx["nodes"]:
+        node["allocatable"][GPU] = str(int(rng.integers(0, 9)))
+    for pod in fx["pods"]:
+        if rng.random() < 0.5:
+            req = pod["containers"][0]["resources"].setdefault(
+                "requests", {}
+            )
+            req[GPU] = str(int(rng.integers(1, 3)))
+    return fx
+
+
+def oracle_preemptive_fits_ext(fixture, priority, cpu_req, mem_req, gpu_req):
+    """Independent strict per-node loop counting only surviving pods,
+    GPU column included — int64 rows, min over resources, like the
+    R-dim kernel the wired path dispatches."""
+    fits = []
+    for node in fixture.get("nodes", []):
+        name = node.get("name", "")
+        alloc = node.get("allocatable", {})
+        alloc_cpu = _parse(alloc.get("cpu"), milli=True)
+        alloc_mem = _parse(alloc.get("memory"))
+        alloc_pods = _parse(alloc.get("pods"))
+        alloc_gpu = _parse(alloc.get(GPU))
+        ready, pressured = False, False
+        for c in node.get("conditions", []):
+            if c.get("type") == "Ready":
+                ready = c.get("status") == "True"
+            elif c.get("status") == "True":
+                pressured = True
+        used_cpu = used_mem = used_gpu = n_pods = 0
+        for pod in fixture.get("pods", []):
+            if pod.get("nodeName") != name or not name:
+                continue
+            if pod.get("phase") in ("Succeeded", "Failed"):
+                continue
+            if int(pod.get("priority", 0)) < priority:
+                continue
+            eff = _pod_eff(pod)
+            used_cpu += eff[0]
+            used_mem += eff[1]
+            g = 0
+            for c in pod.get("containers", []):
+                g += _parse(
+                    c.get("resources", {}).get("requests", {}).get(GPU)
+                )
+            for c in pod.get("initContainers", []):
+                g = max(
+                    g,
+                    _parse(
+                        c.get("resources", {}).get("requests", {}).get(GPU)
+                    ),
+                )
+            used_gpu += g
+            n_pods += 1
+        per = []
+        for a, u, r in (
+            (alloc_cpu, used_cpu, cpu_req),
+            (alloc_mem, used_mem, mem_req),
+            (alloc_gpu, used_gpu, gpu_req),
+        ):
+            if r <= 0:
+                continue  # zero request: row excluded from the min
+            per.append(0 if a <= u else (a - u) // r)
+        fit = min(per) if per else 2**62
+        fit = max(min(fit, max(alloc_pods - n_pods, 0)), 0)
+        fits.append(fit if (ready and not pressured) else 0)
+    return np.array(fits, dtype=np.int64)
+
+
+@pytest.fixture(scope="module")
+def gpu_setup():
+    fx = _gpu_fixture()
+    snap = snapshot_from_fixture(
+        fx, semantics="strict", extended_resources=(GPU,)
+    )
+    table = build_priority_table(fx, snap, (GPU,))
+    return fx, snap, table
+
+
+class TestExtendedPreemption:
+    def test_ext_column0_is_snapshot_usage(self, gpu_setup):
+        _, snap, t = gpu_setup
+        np.testing.assert_array_equal(
+            t.used_ext_ge[GPU][:, 0], snap.extended[GPU][1]
+        )
+        assert (t.used_ext_ge[GPU][:, -1] == 0).all()
+
+    @pytest.mark.parametrize(
+        "priority", [-(2**40), -5, 0, 1, 10, 999, 1000, 2**20, 2**40]
+    )
+    def test_fit_matches_independent_oracle(self, gpu_setup, priority):
+        fx, snap, t = gpu_setup
+        got = fit_with_preemption(
+            snap, t, 250, 96 * MIB, priority,
+            extended_requests={GPU: 1},
+        )
+        want = oracle_preemptive_fits_ext(fx, priority, 250, 96 * MIB, 1)
+        np.testing.assert_array_equal(got, want)
+
+    def test_eviction_gains_count_on_the_gpu_column(self, gpu_setup):
+        """The regression itself: a threshold above every pod priority
+        must see the FULL gpu allocatable, not the standing usage —
+        the pre-fix code charged column 0 forever."""
+        fx, snap, t = gpu_setup
+        hi = 2**40  # evicts everything
+        got = fit_with_preemption(
+            snap, t, 1, 1, hi, extended_requests={GPU: 1}
+        )
+        alloc_gpu = snap.extended[GPU][0]
+        # With 1m cpu / 1 byte mem requests the GPU row binds wherever
+        # gpu allocatable is the scarcest resource; an all-evicted
+        # cluster must fit exactly min(alloc_gpu, slots) there.
+        want = oracle_preemptive_fits_ext(fx, hi, 1, 1, 1)
+        np.testing.assert_array_equal(got, want)
+        assert (got[snap.healthy] <= np.maximum(alloc_gpu, 0)[snap.healthy]).all() or (
+            got[snap.healthy] <= snap.alloc_pods[snap.healthy]
+        ).all()
+
+    def test_sweep_matches_per_threshold_fits(self, gpu_setup):
+        fx, snap, t = gpu_setup
+        prios = np.array([-(2**40), 0, 10, 1000, 2**40], dtype=np.int64)
+        s = prios.shape[0]
+        cpu = np.full(s, 250, dtype=np.int64)
+        mem = np.full(s, 96 * MIB, dtype=np.int64)
+        gpu = np.array([1, 2, 1, 2, 1], dtype=np.int64)
+        totals, sched = sweep_preemption(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.healthy,
+            t.levels,
+            t.used_cpu_ge,
+            t.used_mem_ge,
+            t.pods_ge,
+            cpu,
+            mem,
+            prios,
+            np.ones(s, dtype=np.int64),
+            mode="strict",
+            ext_alloc=snap.extended[GPU][0][None],
+            ext_used_ge=t.used_ext_ge[GPU][None],
+            ext_reqs=gpu[:, None],
+        )
+        totals = np.asarray(totals)
+        for i, p in enumerate(prios):
+            want = fit_with_preemption(
+                snap, t, int(cpu[i]), int(mem[i]), int(p),
+                extended_requests={GPU: int(gpu[i])},
+            ).sum()
+            assert totals[i] == want, f"scenario {i} threshold {p}"
+        assert np.asarray(sched).dtype == bool
+
+    def test_sweep_ext_monotone_in_threshold(self, gpu_setup):
+        _, snap, t = gpu_setup
+        prios = np.array([-(2**40), 0, 2**40], dtype=np.int64)
+        totals, _ = sweep_preemption(
+            snap.alloc_cpu_milli,
+            snap.alloc_mem_bytes,
+            snap.alloc_pods,
+            snap.healthy,
+            t.levels,
+            t.used_cpu_ge,
+            t.used_mem_ge,
+            t.pods_ge,
+            np.full(3, 100, dtype=np.int64),
+            np.full(3, 64 * MIB, dtype=np.int64),
+            prios,
+            np.ones(3, dtype=np.int64),
+            mode="strict",
+            ext_alloc=snap.extended[GPU][0][None],
+            ext_used_ge=t.used_ext_ge[GPU][None],
+            ext_reqs=np.ones((3, 1), dtype=np.int64),
+        )
+        totals = np.asarray(totals)
+        assert totals[0] <= totals[1] <= totals[2]
+
+    def test_missing_table_columns_raise_typed(self, gpu_setup):
+        from kubernetesclustercapacity_tpu.ops.preemption import (
+            PreemptionExtendedError,
+        )
+
+        fx, snap, _ = gpu_setup
+        bare = build_priority_table(fx, snap)  # no extended columns
+        with pytest.raises(PreemptionExtendedError, match="nvidia.com/gpu"):
+            fit_with_preemption(
+                snap, bare, 250, MIB, 0, extended_requests={GPU: 1}
+            )
+
+    def test_missing_snapshot_columns_raise_typed(self):
+        from kubernetesclustercapacity_tpu.ops.preemption import (
+            PreemptionExtendedError,
+        )
+
+        fx = _gpu_fixture(8, seed=5)
+        snap = snapshot_from_fixture(fx, semantics="strict")  # no ext
+        table = build_priority_table(fx, snap, (GPU,))
+        with pytest.raises(PreemptionExtendedError, match="no extended"):
+            fit_with_preemption(
+                snap, table, 250, MIB, 0, extended_requests={GPU: 1}
+            )
+
+    def test_model_path_shares_the_assembler(self, gpu_setup):
+        """PodSpec(priority, extended_requests) through CapacityModel
+        must agree with the ops-layer entry point element for element."""
+        fx, snap, t = gpu_setup
+        model = CapacityModel(
+            snap, mode="strict", fixture=fx, priority_table=t,
+            allow_extensions=True,
+        )
+        spec = PodSpec(
+            cpu_request_milli=250,
+            mem_request_bytes=96 * MIB,
+            replicas=1,
+            priority=10,
+            extended_requests={GPU: 1},
+        )
+        got = model.evaluate(spec).fits
+        want = fit_with_preemption(
+            snap, t, 250, 96 * MIB, 10, extended_requests={GPU: 1},
+            node_mask=model._masks_for(spec),
+        )
+        np.testing.assert_array_equal(got, want)
