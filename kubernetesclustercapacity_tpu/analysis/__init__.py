@@ -15,21 +15,37 @@ theorems over the AST, checked on every tier-1 run:
   ``float()/int()/bool()`` coercion of a traced value is reachable
   from inside a jitted region.
 * **lock-discipline** (:mod:`.rules_locks`) — the guarded-field set of
-  each threaded class is inferred from its ``with self._lock:`` blocks,
-  and every read/write of a guarded field outside the lock is flagged.
+  each threaded class is inferred from its ``with self._lock:`` blocks
+  (in-place container mutations count; ctor-proven lock attrs carry
+  through inheritance), and every read/write of a guarded field
+  outside the lock is flagged.  The inference is exposed as
+  :func:`.rules_locks.lock_model` — the single model both provers use.
+* **lock-order** (:mod:`.rules_lockorder`) — the static lock-order
+  graph (lexical nesting + calls made while holding a lock, closed
+  over the call graph) must be acyclic; a cycle is a deadlock waiting
+  for its schedule, reported at both orders' exact sites.
 * **surface conformance** (:mod:`.rules_surface`) — every ``kccap_``
   metric literal, ``KCCAP_*`` env var, server op and CLI flag must be
   README-documented (and ops client-reachable): the generalized,
   engine-native form of the metric-name walk.
 * **hygiene** (:mod:`.rules_hygiene`) — a pyflakes-lite unused-import
-  walk so the tree stays clean even where ``ruff`` is not installed.
+  walk, plus the silent-thread-death rule: every resolvable
+  ``threading.Thread`` target must be try-protected (or
+  ``utils.threads.supervised``-wrapped) so no worker dies silently.
 
-Everything is AST-based: the analyzer never imports the code under
-analysis, so a broken module cannot crash the lint and lint findings
-cannot depend on the host's backends.  Findings carry severity +
-``file:line``; ``# kccap: lint-ok[rule]`` suppresses inline, and a
-checked-in baseline (``LINT_BASELINE.json``) makes adoption
-incremental.  ``kccap-lint --json`` emits the machine-readable form.
+The *lint* rules are AST-based: the analyzer never imports the code
+under analysis, so a broken module cannot crash the lint and lint
+findings cannot depend on the host's backends.  The *sanitizer*
+(:mod:`.sanitize`, ``kccap-sanitize``) is the deliberate runtime
+complement — an env-gated (``KCCAP_SANITIZE=1``) Eraser-style lockset
+race detector, observed lock-order prover, and seeded schedule fuzzer
+whose hammer (:mod:`.hammer`) certifies the package's threaded classes
+under tier-1.  Findings from BOTH flow through one workflow: severity +
+``file:line``, ``# kccap: lint-ok[rule]`` inline suppression, and the
+checked-in ``LINT_BASELINE.json``.  ``kccap-lint --json`` /
+``kccap-sanitize --json`` emit the machine-readable forms;
+``kccap-lint --diff-baseline`` is the CI mode that prints only findings
+beyond the baseline.
 """
 
 from kubernetesclustercapacity_tpu.analysis.engine import (

@@ -8,10 +8,14 @@ Usage::
     kccap-lint --write-baseline     # accept current findings as baseline
     kccap-lint --rules jit-purity,lock-discipline
     kccap-lint --no-baseline        # ignore the checked-in baseline
+    kccap-lint --diff-baseline      # CI mode: ONLY new findings, no recap
 
 Exit codes: ``0`` clean (no non-baselined findings), ``1`` findings,
 ``2`` usage/configuration error — so the tier-1 test, a pre-commit hook
-and a CI job can all gate on the same invocation.
+and a CI job can all gate on the same invocation.  ``--diff-baseline``
+prints nothing but the findings absent from the baseline (one per
+line) and exits 1 on any — no re-listing of accepted history, so a CI
+log is empty exactly when the gate passes.
 """
 
 from __future__ import annotations
@@ -43,7 +47,8 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="kccap-lint",
         description=(
             "Project-native static analysis: jit-purity prover, "
-            "lock-discipline checker, surface-conformance walks."
+            "lock-discipline checker, lock-order prover, "
+            "surface-conformance walks."
         ),
     )
     p.add_argument(
@@ -70,6 +75,14 @@ def _build_parser() -> argparse.ArgumentParser:
         help="report every finding, ignoring the baseline",
     )
     p.add_argument(
+        "--diff-baseline",
+        action="store_true",
+        dest="diff_baseline",
+        help="print only findings NOT in the baseline (no summary, no "
+        "recap of accepted history) and exit 1 on any — the CI/tier-1 "
+        "gate mode",
+    )
+    p.add_argument(
         "--write-baseline",
         action="store_true",
         help="accept the current findings into the baseline file and exit 0",
@@ -78,7 +91,8 @@ def _build_parser() -> argparse.ArgumentParser:
         "--rules",
         default=None,
         help="comma-separated rule families to run "
-        "(jit-purity,lock-discipline,surface,hygiene; default: all)",
+        "(jit-purity,lock-discipline,lock-order,surface,hygiene; "
+        "default: all)",
     )
     p.add_argument(
         "--json",
@@ -130,6 +144,12 @@ def run(argv=None) -> int:
             f"accepted) -> {baseline_path}"
         )
         return 0
+
+    if args.diff_baseline:
+        # CI mode: the log is empty exactly when the gate passes.
+        for f in result.findings:
+            print(f.render())
+        return 0 if result.clean else 1
 
     if args.as_json:
         print(json.dumps(result.to_json(), indent=2))

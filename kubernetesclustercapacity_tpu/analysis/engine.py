@@ -257,6 +257,7 @@ def _default_rules():
     from kubernetesclustercapacity_tpu.analysis import (
         rules_hygiene,
         rules_jit,
+        rules_lockorder,
         rules_locks,
         rules_surface,
     )
@@ -264,6 +265,7 @@ def _default_rules():
     return {
         "jit-purity": rules_jit.check,
         "lock-discipline": rules_locks.check,
+        "lock-order": rules_lockorder.check,
         "surface": rules_surface.check,
         "hygiene": rules_hygiene.check,
     }

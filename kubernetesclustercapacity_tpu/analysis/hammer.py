@@ -1,0 +1,420 @@
+"""The package-wide sanitize hammer: every threaded class, 16 threads,
+fuzzed schedules, seeded and replayable.
+
+Each driver builds fresh instances of one threaded class *while the
+sanitizer is installed* (so their locks are wrapped and their guarded
+fields — the statically inferred set from
+:func:`..rules_locks.lock_model` — are monitored), then hits them from
+``threads`` concurrent workers.  One :func:`run` call covers all eleven
+classes under one instrumentation window per seed; findings flow
+through the shared suppression/baseline workflow.
+
+The drivers deliberately exercise the *synchronization surface*, not
+the numerics: stubs stand in for kernels and oracles, snapshots are
+tiny, and every expected control-flow exception (admission sheds,
+breaker refusals) is caught inside the op.  What must survive is the
+locking — the detector decides whether it did.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+
+from kubernetesclustercapacity_tpu.analysis import sanitize
+from kubernetesclustercapacity_tpu.analysis.engine import Project
+from kubernetesclustercapacity_tpu.analysis.rules_locks import lock_model
+
+__all__ = ["run", "HAMMERED_CLASSES", "instrument_targets"]
+
+#: The eleven threaded classes the tier-1 gate certifies, as
+#: ``(module, class name)`` — every one must also be inferred threaded
+#: by the static model (cross-checked in tests/test_sanitize.py).
+HAMMERED_CLASSES = (
+    ("kubernetesclustercapacity_tpu.devcache", "DeviceCache"),
+    ("kubernetesclustercapacity_tpu.service.batching", "MicroBatcher"),
+    ("kubernetesclustercapacity_tpu.timeline.history", "CapacityTimeline"),
+    ("kubernetesclustercapacity_tpu.audit.log", "AuditLog"),
+    ("kubernetesclustercapacity_tpu.audit.shadow", "ShadowSampler"),
+    ("kubernetesclustercapacity_tpu.service.plane", "PlanePublisher"),
+    ("kubernetesclustercapacity_tpu.service.plane", "PlaneSubscriber"),
+    ("kubernetesclustercapacity_tpu.federation.server", "ClusterFeed"),
+    ("kubernetesclustercapacity_tpu.service.plane", "AdmissionController"),
+    ("kubernetesclustercapacity_tpu.resilience", "TokenBucket"),
+    ("kubernetesclustercapacity_tpu.resilience", "CircuitBreaker"),
+    ("kubernetesclustercapacity_tpu.telemetry.metrics", "MetricsRegistry"),
+)
+
+
+def _package_dir() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def instrument_targets(package_dir: str | None = None):
+    """``(cls, monitored fields, label)`` for every hammered class —
+    the monitored set IS the static model's guarded set, so the two
+    provers cannot drift apart."""
+    import importlib
+
+    model = lock_model(Project(package_dir or _package_dir()))
+    by_name = {}
+    for m in model.values():
+        by_name.setdefault(m.name, m)
+    out = []
+    for module, cls_name in HAMMERED_CLASSES:
+        cls = getattr(importlib.import_module(module), cls_name)
+        m = by_name.get(cls_name)
+        if m is None:
+            raise RuntimeError(
+                f"{cls_name} is hammered but the static lock model does "
+                "not infer it threaded — the provers disagree"
+            )
+        out.append((cls, tuple(sorted(m.guarded)), cls_name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-class drivers.  Each returns (ops, cleanup): ops is a list of
+# ``fn(i, t)`` callables the workers round-robin; cleanup tears the
+# instances down after the join.
+
+
+def _drive_device_cache():
+    from kubernetesclustercapacity_tpu.devcache import DeviceCache
+
+    cache = DeviceCache(max_entries=4)
+
+    class _Snap:
+        pass
+
+    snaps = [_Snap() for _ in range(4)]
+
+    def get(i, t):
+        s = snaps[(i + t) % len(snaps)]
+        cache.get(s, ("exact", 64 << (i % 2)), lambda: (i, t))
+
+    def stats(i, t):
+        cache.stats()
+
+    return [get, get, stats], lambda: None
+
+
+def _drive_micro_batcher():
+    from kubernetesclustercapacity_tpu.service.batching import MicroBatcher
+
+    mb = MicroBatcher(
+        lambda key, items: [x * 2 for x in items],
+        window_s=0.0005,
+        max_batch=8,
+    )
+
+    def submit(i, t):
+        assert mb.submit(("gen", i % 2), i) == i * 2
+
+    def stats(i, t):
+        mb.stats  # property: reads the registry families
+
+    return [submit, submit, submit, stats], lambda: None
+
+
+def _drive_timeline():
+    from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+    from kubernetesclustercapacity_tpu.timeline.history import (
+        CapacityTimeline,
+    )
+
+    tl = CapacityTimeline(depth=8)
+    snap = synthetic_snapshot(8, seed=3)
+    gen_lock = threading.Lock()
+    gen = [0]
+
+    def observe(i, t):
+        with gen_lock:
+            gen[0] += 1
+            g = gen[0]
+        tl.observe(snap, g)
+
+    def read(i, t):
+        tl.records()
+        tl.alerts()
+        tl.stats()
+
+    return [observe, read, read], tl.close
+
+
+def _drive_audit_log(tmpdir):
+    from kubernetesclustercapacity_tpu.audit.log import AuditLog
+    from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+    log = AuditLog(os.path.join(tmpdir, "audit"), checkpoint_every=4)
+    snap = synthetic_snapshot(8, seed=3)
+    gen_lock = threading.Lock()
+    gen = [0]
+
+    def generation(i, t):
+        with gen_lock:
+            gen[0] += 1
+            g = gen[0]
+        log.record_generation(snap, g)
+
+    def request(i, t):
+        log.record_request(
+            op="sweep",
+            args={"i": i, "t": t},
+            generation=gen[0],
+            status="ok",
+        )
+
+    def stats(i, t):
+        log.stats()
+        log.generation_ref(1)
+
+    return [generation, request, request, stats], log.close
+
+
+def _drive_shadow(tmpdir):
+    from kubernetesclustercapacity_tpu.audit.shadow import ShadowSampler
+
+    served = [3, 5]
+
+    sampler = ShadowSampler(
+        1.0,
+        oracle=lambda snapshot, grid, node_mask: list(served),
+        bundle_path=os.path.join(tmpdir, "bundles.jsonl"),
+        max_queue=64,
+    )
+
+    def submit(i, t):
+        sampler.maybe_submit(None, i, None, served, [True, True])
+
+    def stats(i, t):
+        sampler.stats()
+        sampler.diverged  # property
+
+    def close():
+        sampler.drain(timeout_s=10.0)
+        sampler.close()
+
+    return [submit, submit, stats], close
+
+
+def _drive_plane(tmpdir):
+    """PlanePublisher + PlaneSubscriber + ClusterFeed in one driver —
+    the federation wiring: a real leader fans frames to a subscriber
+    staging into a feed, while workers publish and read stats."""
+    from kubernetesclustercapacity_tpu.federation.server import ClusterFeed
+    from kubernetesclustercapacity_tpu.service.plane import (
+        PlanePublisher,
+        PlaneSubscriber,
+    )
+    from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+    pub = PlanePublisher(heartbeat_s=0.05)
+    feed = ClusterFeed("hammer-cluster")
+    sub = PlaneSubscriber(
+        pub.address, feed, stale_after_s=5.0, reconnect_base_s=0.01
+    )
+    snap = synthetic_snapshot(8, seed=3)
+    gen_lock = threading.Lock()
+    gen = [0]
+
+    def publish(i, t):
+        with gen_lock:
+            gen[0] += 1
+            g = gen[0]
+            # Publish order is the plane's contract (the server's
+            # coalescer serializes it); the lock models that.
+            pub.publish(snap, g)
+
+    def pub_stats(i, t):
+        pub.stats()
+
+    def sub_stats(i, t):
+        sub.stats()
+        sub.stale  # property
+        sub.applied_generation  # property
+        sub.sync_age_s()
+
+    def feed_view(i, t):
+        feed.view()
+        feed.last_verified_age_s()
+        feed.stream_stats()
+
+    def close():
+        sub.stop()
+        pub.close()
+
+    return [publish, pub_stats, sub_stats, feed_view], close
+
+
+def _drive_admission():
+    from kubernetesclustercapacity_tpu.resilience import (
+        DeadlineExpired,
+        OverloadedError,
+    )
+    from kubernetesclustercapacity_tpu.service.plane import (
+        AdmissionController,
+    )
+
+    ac = AdmissionController(max_concurrent=4, rps=10000.0)
+
+    def admit(i, t):
+        try:
+            release = ac.admit("sweep")
+        except (OverloadedError, DeadlineExpired):
+            return
+        try:
+            pass
+        finally:
+            release()
+
+    def price(i, t):
+        ac.observe_shadow_price(0.25 * (i % 4), certified=bool(i % 2))
+        ac.shadow_price()
+
+    def shed(i, t):
+        ac.count_shed("sweep", "draining")
+
+    return [admit, admit, price, shed], lambda: None
+
+
+def _drive_token_bucket():
+    from kubernetesclustercapacity_tpu.resilience import TokenBucket
+
+    tb = TokenBucket(1000.0, 64.0)
+
+    def acquire(i, t):
+        tb.try_acquire(1.0)
+
+    def avail(i, t):
+        tb.available()
+
+    return [acquire, acquire, avail], lambda: None
+
+
+def _drive_breaker():
+    from kubernetesclustercapacity_tpu.resilience import CircuitBreaker
+
+    br = CircuitBreaker(failure_threshold=3, recovery_timeout_s=0.01)
+
+    def ok(i, t):
+        if br.allow():
+            br.record_success()
+
+    def fail(i, t):
+        if br.allow():
+            br.record_failure(RuntimeError("hammer"))
+
+    def read(i, t):
+        br.state  # property
+        br.last_error  # property
+        br.snapshot()
+
+    def reset(i, t):
+        if i % 7 == 0:
+            br.reset()
+
+    return [ok, fail, read, reset], lambda: None
+
+
+def _drive_registry():
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+
+    def counter(i, t):
+        reg.counter(
+            f"kccap_hammer_c{i % 3}_total", "hammer", ("k",)
+        ).labels(k=str(t % 2)).inc()
+
+    def gauge(i, t):
+        reg.gauge(f"kccap_hammer_g{i % 2}", "hammer").set(i)
+
+    def collect(i, t):
+        reg.collect()
+        reg.snapshot()
+
+    return [counter, gauge, collect], lambda: None
+
+
+# ---------------------------------------------------------------------------
+
+
+def _spin(ops, *, threads: int, iters: int) -> list:
+    """Round-robin the ops across ``threads`` workers; unexpected
+    exceptions are collected and re-raised after the join (a hammer
+    that swallows crashes would certify garbage)."""
+    errors: list = []
+    barrier = threading.Barrier(threads)
+
+    def worker(t: int) -> None:
+        try:
+            barrier.wait(timeout=30)
+            for i in range(iters):
+                ops[(t + i) % len(ops)](i, t)
+        except Exception as e:  # noqa: BLE001 - surfaced after join
+            errors.append(e)
+
+    ts = [
+        threading.Thread(target=worker, args=(t,), daemon=True)
+        for t in range(threads)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    return errors
+
+
+def run(
+    *,
+    seed: int,
+    threads: int = 16,
+    iters: int = 40,
+    fuzz: bool = True,
+    package_dir: str | None = None,
+) -> tuple:
+    """One full hammer pass: install → drive all eleven classes →
+    report → uninstall.  Returns ``(findings, stats)`` with findings
+    relative to the repo root.  Raises if any worker crashed."""
+    targets = instrument_targets(package_dir)
+    repo_root = os.path.dirname(package_dir or _package_dir())
+    sanitize.install(seed=seed, fuzz=fuzz, classes=targets)
+    try:
+        with tempfile.TemporaryDirectory(prefix="kccap-sanitize-") as tmp:
+            drivers = (
+                _drive_device_cache(),
+                _drive_micro_batcher(),
+                _drive_timeline(),
+                _drive_audit_log(tmp),
+                _drive_shadow(tmp),
+                _drive_plane(tmp),
+                _drive_admission(),
+                _drive_token_bucket(),
+                _drive_breaker(),
+                _drive_registry(),
+            )
+            errors: list = []
+            try:
+                for ops, _cleanup in drivers:
+                    errors.extend(_spin(ops, threads=threads, iters=iters))
+            finally:
+                for _ops, cleanup in drivers:
+                    try:
+                        cleanup()
+                    except Exception as e:  # noqa: BLE001 - keep closing
+                        errors.append(e)
+        if errors:
+            raise RuntimeError(
+                f"hammer workers crashed (seed {seed}): "
+                + "; ".join(f"{type(e).__name__}: {e}" for e in errors[:5])
+            )
+        san = sanitize.current()
+        found = sanitize.findings_of(san, repo_root)
+        st = sanitize.stats_of(san)
+        return found, st
+    finally:
+        sanitize.uninstall()

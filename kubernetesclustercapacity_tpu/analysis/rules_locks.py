@@ -24,16 +24,26 @@ whose bodies run with the lock already held by their caller follow the
 Closures defined inside a method are analyzed as *outside* the lock
 even when the ``def`` lexically sits in a ``with`` block: the closure
 body runs when called, which is generally after the block exits.
+
+The inference itself — which classes are threaded, which attrs are
+their locks, which fields those locks guard — is exposed as
+:func:`lock_model` so the *dynamic* sanitizer (:mod:`.sanitize`)
+instruments exactly the set the static rule checks: one model, two
+provers, cross-checked both directions by ``tests/test_sanitize.py``.
+Lock attrs proven by construction in a base class carry into every
+subclass (resolved by base name project-wide), so ``class Sub(Base)``
+methods acquiring an inherited ``self._mu`` are analyzed too.
 """
 
 from __future__ import annotations
 
 import ast
+from dataclasses import dataclass
 
 from kubernetesclustercapacity_tpu.analysis.callgraph import dotted
 from kubernetesclustercapacity_tpu.analysis.engine import Finding, Project
 
-__all__ = ["check", "RULE"]
+__all__ = ["check", "lock_model", "ClassLockModel", "RULE"]
 
 RULE = "lock-discipline"
 
@@ -91,6 +101,20 @@ def _iter_classes(tree: ast.Module):
             yield node
 
 
+#: Method names that mutate their receiver in place: calling one on a
+#: ``self.X`` container under the lock makes X guarded state exactly
+#: like an attribute store would (``self._ring.append(...)``,
+#: ``self._pending[key] = ...`` — the attr node's ctx is Load either
+#: way, so the scanner must recognize the mutation shapes explicitly).
+_MUTATORS = frozenset(
+    {
+        "append", "appendleft", "add", "clear", "discard", "extend",
+        "insert", "move_to_end", "pop", "popitem", "popleft", "remove",
+        "setdefault", "update",
+    }
+)
+
+
 class _MethodScanner:
     """One pass over a method body tracking whether a self-lock is held
     lexically; collects under-lock writes/reads and out-of-lock
@@ -106,6 +130,21 @@ class _MethodScanner:
         for stmt in method.body:
             self._visit(stmt, assume_held)
 
+    def _container_write(self, node) -> str | None:
+        """``self.X[k] = v`` / ``del self.X[k]`` / ``self.X.append(v)``
+        -> ``"X"`` when the mutated container is a self attr."""
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            return _self_attr(node.value)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+        ):
+            return _self_attr(node.func.value)
+        return None
+
     def _visit(self, node, held: bool) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
             # Closure bodies run later, when the lock may not be held.
@@ -120,6 +159,16 @@ class _MethodScanner:
             for child in node.body:
                 self._visit(child, held or bool(acquired))
             return
+        container = self._container_write(node)
+        if (
+            container is not None
+            and container not in self.lock_attrs
+            and held
+        ):
+            # In-place container mutation under the lock: guards the
+            # field (the access itself is recorded when the inner
+            # Attribute node is visited below).
+            self.under_writes.add(container)
         attr = _self_attr(node)
         if attr is not None and attr not in self.lock_attrs:
             is_write = isinstance(node.ctx, (ast.Store, ast.Del))
@@ -130,71 +179,172 @@ class _MethodScanner:
             self._visit(child, held)
 
 
-def check(project: Project):
-    findings: list[Finding] = []
-    for src in project.files:
-        # Module-level lock ctor aliases (e.g. `from threading import Lock`).
-        lock_aliases: set[str] = set(_LOCK_CTORS)
-        for node in ast.walk(src.tree):
-            if isinstance(node, ast.ImportFrom) and node.module == "threading":
-                for alias in node.names:
-                    if alias.name in (
-                        "Lock", "RLock", "Condition", "Semaphore",
-                        "BoundedSemaphore",
-                    ):
-                        lock_aliases.add(alias.asname or alias.name)
+def _module_lock_aliases(tree: ast.Module) -> set[str]:
+    """Lock ctor names visible in this module (e.g. ``from threading
+    import Lock``) on top of the canonical dotted forms."""
+    lock_aliases: set[str] = set(_LOCK_CTORS)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                if alias.name in (
+                    "Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore",
+                ):
+                    lock_aliases.add(alias.asname or alias.name)
+    return lock_aliases
 
+
+def _ctor_proven_attrs(cls: ast.ClassDef, lock_aliases: set[str]) -> set[str]:
+    """``self.X = threading.Lock()``-style attrs in this class body."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ) and _is_lock_ctor(node.value, lock_aliases):
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+@dataclass(frozen=True)
+class ClassLockModel:
+    """One threaded class as the lock rule understands it."""
+
+    name: str  # class name
+    path: str  # repo-relative source path
+    lineno: int
+    lock_attrs: frozenset  # self attrs that ARE locks
+    guarded: frozenset  # self attrs written under a lock outside __init__
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.path, self.name)
+
+
+def _class_lock_attrs(
+    cls: ast.ClassDef,
+    lock_aliases: set[str],
+    inherited: set[str],
+) -> set[str]:
+    """Pass 1: which self attrs are locks in this class?
+
+    ``with self._x:`` where _x is not lock-like (e.g. a client used as
+    a context manager) would poison the analysis; keep only
+    lock-looking names plus ctor-proven attrs (own or inherited).
+    """
+    acquired: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired |= _lock_items(node)
+    ctor_proven = _ctor_proven_attrs(cls, lock_aliases)
+    proven = {
+        a
+        for a in acquired
+        if "lock" in a.lower() or "cv" in a.lower()
+        or "cond" in a.lower() or "sem" in a.lower()
+        or a in inherited
+    }
+    return proven | ctor_proven
+
+
+def _base_names(cls: ast.ClassDef) -> list[str]:
+    """Base-class tail names (``service.server.CapacityServer`` ->
+    ``CapacityServer``)."""
+    out: list[str] = []
+    for b in cls.bases:
+        d = dotted(b)
+        if d:
+            out.append(d.rsplit(".", 1)[-1])
+    return out
+
+
+def _scan_methods(
+    cls: ast.ClassDef, lock_attrs: set[str]
+) -> dict[str, "_MethodScanner"]:
+    scanners: dict[str, _MethodScanner] = {}
+    for method in _methods(cls):
+        scanner = _MethodScanner(lock_attrs)
+        scanner.scan(method, assume_held=method.name.endswith("_locked"))
+        scanners[method.name] = scanner
+    return scanners
+
+
+def _guarded_fields(scanners: dict[str, "_MethodScanner"]) -> set[str]:
+    """Pass 2: fields written under lock outside __init__."""
+    guarded: set[str] = set()
+    for name, scanner in scanners.items():
+        if name != "__init__":
+            guarded |= scanner.under_writes
+    return guarded
+
+
+def _ctor_index(project: Project) -> dict[str, set[str]]:
+    """Class name -> ctor-proven lock attrs, project-wide.  Base names
+    resolve against this (conservatively by bare name: a subclass in
+    another module still inherits its base's proven locks)."""
+    index: dict[str, set[str]] = {}
+    for src in project.files:
+        aliases = _module_lock_aliases(src.tree)
         for cls in _iter_classes(src.tree):
-            # -- pass 1: which attrs are locks?
-            lock_attrs: set[str] = set()
-            for node in ast.walk(cls):
-                if isinstance(node, (ast.With, ast.AsyncWith)):
-                    lock_attrs |= _lock_items(node)
-                elif isinstance(node, ast.Assign):
-                    if isinstance(node.value, ast.Call) and _is_lock_ctor(
-                        node.value, lock_aliases
-                    ):
-                        for tgt in node.targets:
-                            attr = _self_attr(tgt)
-                            if attr is not None:
-                                lock_attrs.add(attr)
-            # `with self._x:` where _x is not lock-like (e.g. a client
-            # used as a context manager) would poison the analysis; keep
-            # only lock-looking names plus ctor-proven attrs.
-            proven = {
-                a
-                for a in lock_attrs
-                if "lock" in a.lower() or "cv" in a.lower()
-                or "cond" in a.lower() or "sem" in a.lower()
-            }
-            ctor_proven = set()
-            for node in ast.walk(cls):
-                if isinstance(node, ast.Assign) and isinstance(
-                    node.value, ast.Call
-                ) and _is_lock_ctor(node.value, lock_aliases):
-                    for tgt in node.targets:
-                        attr = _self_attr(tgt)
-                        if attr is not None:
-                            ctor_proven.add(attr)
-            lock_attrs = proven | ctor_proven
+            index.setdefault(cls.name, set()).update(
+                _ctor_proven_attrs(cls, aliases)
+            )
+    return index
+
+
+def _inherited_attrs(
+    cls: ast.ClassDef, ctor_index: dict[str, set[str]]
+) -> set[str]:
+    out: set[str] = set()
+    for base in _base_names(cls):
+        out |= ctor_index.get(base, set())
+    return out
+
+
+def lock_model(project: Project) -> dict[tuple[str, str], ClassLockModel]:
+    """Threaded-class inference as data: ``(path, class) -> model``.
+
+    This is the single source of truth the static rule checks and the
+    dynamic sanitizer instruments — the two provers cannot drift apart
+    because they consume the same inference.
+    """
+    out: dict[tuple[str, str], ClassLockModel] = {}
+    ctor_index = _ctor_index(project)
+    for src in project.files:
+        lock_aliases = _module_lock_aliases(src.tree)
+        for cls in _iter_classes(src.tree):
+            lock_attrs = _class_lock_attrs(
+                cls, lock_aliases, _inherited_attrs(cls, ctor_index)
+            )
             if not lock_attrs:
                 continue
+            guarded = _guarded_fields(_scan_methods(cls, lock_attrs))
+            m = ClassLockModel(
+                name=cls.name,
+                path=src.rel_path,
+                lineno=cls.lineno,
+                lock_attrs=frozenset(lock_attrs),
+                guarded=frozenset(guarded),
+            )
+            out[m.key] = m
+    return out
 
-            # -- pass 2: guarded set = fields written under lock outside
-            # __init__ (per-method scanners, then union).
-            scanners: dict[str, _MethodScanner] = {}
-            for method in _methods(cls):
-                scanner = _MethodScanner(lock_attrs)
-                scanner.scan(
-                    method,
-                    assume_held=method.name.endswith("_locked"),
-                )
-                scanners[method.name] = scanner
-            guarded: set[str] = set()
-            for name, scanner in scanners.items():
-                if name != "__init__":
-                    guarded |= scanner.under_writes
 
+def check(project: Project):
+    findings: list[Finding] = []
+    ctor_index = _ctor_index(project)
+    for src in project.files:
+        lock_aliases = _module_lock_aliases(src.tree)
+        for cls in _iter_classes(src.tree):
+            lock_attrs = _class_lock_attrs(
+                cls, lock_aliases, _inherited_attrs(cls, ctor_index)
+            )
+            if not lock_attrs:
+                continue
+            scanners = _scan_methods(cls, lock_attrs)
+            guarded = _guarded_fields(scanners)
             if not guarded:
                 continue
 

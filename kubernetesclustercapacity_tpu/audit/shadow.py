@@ -205,8 +205,14 @@ class ShadowSampler:
                 )
             )
             if self._worker is None:
+                from kubernetesclustercapacity_tpu.utils.threads import (
+                    supervised,
+                )
+
                 self._worker = threading.Thread(
-                    target=self._run, daemon=True, name="kccap-shadow"
+                    target=supervised(self._run, name="kccap-shadow"),
+                    daemon=True,
+                    name="kccap-shadow",
                 )
                 self._worker.start()
             self._cond.notify()

@@ -36,6 +36,8 @@ So a churn storm of E events costs ``min(E, 2 + duration/min_interval_s
 from __future__ import annotations
 
 import threading
+
+from kubernetesclustercapacity_tpu.utils.threads import supervised
 import time
 
 __all__ = ["SnapshotCoalescer"]
@@ -83,7 +85,10 @@ class SnapshotCoalescer:
         # them: cache warming, timeline observation) are still flowing.
         self.last_flush_ts: float | None = None
         self.last_flush_s: float | None = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=supervised(self._run, name="kccap-coalescer"),
+            daemon=True,
+        )
         self._thread.start()
 
     def stats(self) -> dict:

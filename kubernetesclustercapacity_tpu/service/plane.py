@@ -55,6 +55,7 @@ from kubernetesclustercapacity_tpu.resilience import (
     decorrelated_jitter,
 )
 from kubernetesclustercapacity_tpu.service import protocol
+from kubernetesclustercapacity_tpu.utils.threads import supervised
 from kubernetesclustercapacity_tpu.timeline.diff import (
     SnapshotDiff,
     diff_summaries,
@@ -228,11 +229,15 @@ class PlanePublisher:
         self._listener.settimeout(0.2)
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True
+            target=supervised(self._accept_loop, name="kccap-plane-accept"),
+            daemon=True,
         )
         self._accept_thread.start()
         self._hb_thread = threading.Thread(
-            target=self._heartbeat_loop, daemon=True
+            target=supervised(
+                self._heartbeat_loop, name="kccap-plane-heartbeat"
+            ),
+            daemon=True,
         )
         self._hb_thread.start()
 
@@ -361,7 +366,9 @@ class PlanePublisher:
             except OSError:
                 return  # listener closed
             threading.Thread(
-                target=self._attach, args=(conn, addr), daemon=True
+                target=supervised(self._attach, name="kccap-plane-attach"),
+                args=(conn, addr),
+                daemon=True,
             ).start()
 
     def _attach(self, conn, addr) -> None:
@@ -642,7 +649,10 @@ class PlaneSubscriber:
         # (deregistration from the plane).
         server.set_plane_role("replica", stats_source=self.stats)
         server.add_drain_hook(self.stop)
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=supervised(self._run, name="kccap-plane-subscriber"),
+            daemon=True,
+        )
         self._thread.start()
 
     # -- observability -----------------------------------------------------

@@ -485,14 +485,15 @@ class ReplicaSet:
         results: _queue.Queue = _queue.Queue()
 
         def attempt(ep: _Endpoint, tag: str) -> None:
-            t0 = time.perf_counter()
             try:
+                t0 = time.perf_counter()
                 r = self._call_endpoint(ep, op, params, deadline)
+                self._note_latency(time.perf_counter() - t0)
+                results.put((tag, ep, r, None))
             except Exception as e:  # noqa: BLE001 - reported via the queue
+                # EVERY exit posts to the queue: a silently-dead attempt
+                # would leave the hedged read blocked on results.get().
                 results.put((tag, ep, None, e))
-                return
-            self._note_latency(time.perf_counter() - t0)
-            results.put((tag, ep, r, None))
 
         t_primary = threading.Thread(
             target=attempt, args=(primary, "primary"), daemon=True

@@ -670,8 +670,12 @@ class SLOMonitor:
                 except Exception:  # noqa: BLE001 - monitor must outlive blips
                     pass
 
+        from kubernetesclustercapacity_tpu.utils.threads import supervised
+
         self._thread = threading.Thread(
-            target=loop, name="kccap-slo-eval", daemon=True
+            target=supervised(loop, name="kccap-slo-eval"),
+            name="kccap-slo-eval",
+            daemon=True,
         )
         self._thread.start()
         return self

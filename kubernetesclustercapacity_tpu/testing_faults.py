@@ -61,6 +61,8 @@ import random
 import socket
 import struct
 import threading
+
+from kubernetesclustercapacity_tpu.utils.threads import supervised
 import time
 
 __all__ = ["FAULTS", "PARTITION_DIRECTIONS", "FaultPlan", "FaultProxy"]
@@ -252,7 +254,8 @@ class FaultProxy:
 
     def start(self) -> "FaultProxy":
         self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True
+            target=supervised(self._accept_loop, name="kccap-proxy-accept"),
+            daemon=True,
         )
         self._accept_thread.start()
         return self
@@ -291,7 +294,9 @@ class FaultProxy:
             except OSError:
                 return  # listener closed by stop()
             t = threading.Thread(
-                target=self._handle, args=(conn,), daemon=True
+                target=supervised(self._handle, name="kccap-proxy-conn"),
+                args=(conn,),
+                daemon=True,
             )
             t.start()
             self._threads.append(t)
@@ -436,7 +441,12 @@ class FaultProxy:
                     except OSError:
                         return
 
-            side = threading.Thread(target=_pump_client_to_up, daemon=True)
+            side = threading.Thread(
+                target=supervised(
+                    _pump_client_to_up, name="kccap-proxy-pump"
+                ),
+                daemon=True,
+            )
             side.start()
             while not self._stop.is_set():
                 frame = _read_frame(up)

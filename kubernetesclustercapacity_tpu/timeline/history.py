@@ -441,7 +441,7 @@ class CapacityTimeline:
                     record.watches[spec.name] = r
             record.eval_ms = (time.perf_counter() - t0) * 1e3
             self._ring.append(record)
-            self._publish_metrics(record, prev)
+            self._publish_metrics_locked(record, prev)
             self._append_log(record, transitions)
             return record
 
@@ -543,7 +543,7 @@ class CapacityTimeline:
             )
         return groups.items()
 
-    def _publish_metrics(self, record, prev) -> None:
+    def _publish_metrics_locked(self, record, prev) -> None:
         if self._m is None or not _telemetry_enabled():
             return
         m = self._m

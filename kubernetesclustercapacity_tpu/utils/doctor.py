@@ -59,6 +59,7 @@ def _probe_backend(timeout_s: float, probe_code: str = _PROBE_CODE) -> str:
         for line in proc.stdout:
             lines.append(line.rstrip("\n"))
 
+    # kccap: lint-ok[hygiene-thread-death] pump lifetime is bounded by reader.join(timeout); a late death only truncates probe output, never the report
     reader = threading.Thread(target=pump, daemon=True)
     reader.start()
     try:
@@ -225,6 +226,44 @@ def doctor_report(
         )
 
     check("device snapshot cache", _hot_path)
+
+    def _sanitizer():
+        # The concurrency-certification gate: is the dynamic sanitizer
+        # armed in THIS process, and has any supervised worker died?
+        # (No probe run here — the hammer lives in tier-1/CLI; the
+        # doctor reports the standing state an operator can act on.)
+        from kubernetesclustercapacity_tpu.analysis import sanitize
+        from kubernetesclustercapacity_tpu.utils import threads as _threads
+
+        deaths = _threads.death_count()
+        death_note = ""
+        if deaths:
+            name, err = _threads.last_death()
+            death_note = (
+                f"; WARNING {deaths} supervised thread death(s), "
+                f"last: {name}: {err}"
+            )
+        if sanitize.installed():
+            st = sanitize.stats()
+            return (
+                f"INSTALLED: seed {st['seed']}, "
+                f"{st['instrumented_classes']} class(es) instrumented, "
+                f"{st['races']} race(s) observed — a serving process "
+                "should never run instrumented" + death_note
+            )
+        if sanitize.enabled():
+            return (
+                "armed (KCCAP_SANITIZE=1): instrumentation installs on "
+                "demand; run kccap-sanitize for the seeded hammer"
+                + death_note
+            )
+        return (
+            "dormant (KCCAP_SANITIZE unset) — zero instrumentation; "
+            "races/lock-order are certified by the tier-1 hammer"
+            + death_note
+        )
+
+    check("sanitizer", _sanitizer)
 
     def _optimizer():
         # One tiny certified solve in-process: proves the LP/PDHG

@@ -22,3 +22,27 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import sys  # noqa: E402
+
+import pytest  # noqa: E402
+
+#: The interpreter's switch interval before any test ran — what the
+#: sanitizer's schedule fuzzer must restore.
+_ORIG_SWITCH_INTERVAL = sys.getswitchinterval()
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_isolation():
+    """Perturbation must never leak into unrelated tier-1 tests: after
+    EVERY test, uninstall any leftover sanitizer instrumentation and
+    restore ``sys.setswitchinterval``.  Zero overhead when the
+    sanitizer was never imported (the common case)."""
+    yield
+    mod = sys.modules.get(
+        "kubernetesclustercapacity_tpu.analysis.sanitize"
+    )
+    if mod is not None:
+        mod.uninstall()  # idempotent no-op when not installed
+    if sys.getswitchinterval() != _ORIG_SWITCH_INTERVAL:
+        sys.setswitchinterval(_ORIG_SWITCH_INTERVAL)

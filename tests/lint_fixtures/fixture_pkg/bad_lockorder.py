@@ -1,0 +1,42 @@
+"""Lock-order fixtures: an interprocedural A→B edge against a lexical
+B→A edge (the planted inversion), plus a consistently-ordered control
+class that must produce nothing."""
+
+import threading
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+
+
+def take_a_then_b():
+    with _LOCK_A:
+        _grab_b()  # expect: lock-order
+
+
+def _grab_b():
+    with _LOCK_B:
+        pass
+
+
+def take_b_then_a():
+    with _LOCK_B:
+        with _LOCK_A:  # expect: lock-order
+            pass
+
+
+class Ordered:
+    """Control: both methods take outer before inner — no cycle."""
+
+    def __init__(self) -> None:
+        self._lock_outer = threading.Lock()
+        self._lock_inner = threading.Lock()
+
+    def first(self) -> None:
+        with self._lock_outer:
+            with self._lock_inner:
+                pass
+
+    def second(self) -> None:
+        with self._lock_outer:
+            with self._lock_inner:
+                pass

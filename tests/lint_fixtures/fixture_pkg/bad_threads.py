@@ -1,0 +1,33 @@
+"""Silent-thread-death fixtures: unprotected workers (module-level and
+method targets) and a fully-protected control."""
+
+import threading
+
+
+def fragile_worker():
+    open("/nonexistent-fixture-path")
+
+
+def safe_worker():
+    try:
+        open("/nonexistent-fixture-path")
+    except Exception:
+        pass
+
+
+def spawn():
+    threading.Thread(target=fragile_worker, daemon=True).start()  # expect: hygiene-thread-death
+    threading.Thread(target=safe_worker, daemon=True).start()
+
+
+class Worker:
+    def start(self) -> None:
+        self._t = threading.Thread(target=self._run, daemon=True)  # expect: hygiene-thread-death
+        self._t.start()
+
+    def _run(self) -> None:
+        while True:
+            self._tick()
+
+    def _tick(self) -> None:
+        pass

@@ -1,0 +1,55 @@
+"""Call-graph edge cases, pinned at exact lines:
+
+* a jit root created by ``jax.jit(wrapper)`` where ``wrapper`` is a
+  ``functools.wraps``-decorated closure (the decorator-factory idiom);
+* a lambda passed to ``jax.jit`` whose body references a helper — the
+  helper must become a root;
+* threaded-class inference through inheritance: the lock is
+  ctor-proven only in the base, under a name the heuristics would
+  never accept (``_mu``).
+"""
+
+import functools
+import threading
+import time
+
+import jax
+
+
+def _decorate(f):
+    @functools.wraps(f)
+    def wrapper(x):
+        t = time.time()  # expect: jit-purity
+        return f(x) + t
+
+    return jax.jit(wrapper)
+
+
+@_decorate
+def decorated_root(x):
+    return x
+
+
+def _lam_helper(x):
+    return x * time.perf_counter()  # expect: jit-purity
+
+
+jitted_lambda = jax.jit(lambda x: _lam_helper(x))
+
+
+class _Base:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+
+
+class Derived(_Base):
+    def __init__(self) -> None:
+        super().__init__()
+        self._hits = 0
+
+    def incr(self) -> None:
+        with self._mu:
+            self._hits += 1
+
+    def racy(self) -> int:
+        return self._hits  # expect: lock-discipline

@@ -59,6 +59,7 @@ class TestReport:
             "native kernel (C++)",
             "native pod-walk (C ext)",
             "fused fast path",
+            "sanitizer",
         ):
             assert expected in names
         assert healthy(checks)

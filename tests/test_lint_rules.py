@@ -84,6 +84,8 @@ def test_every_rule_family_represented(result):
     assert "lock-discipline" in rules
     assert {"surface-metric", "surface-env", "surface-op", "surface-flag"} <= rules
     assert "hygiene-unused-import" in rules
+    assert "hygiene-thread-death" in rules
+    assert "lock-order" in rules
 
 
 def test_jit_purity_covers_every_category(result):
@@ -102,7 +104,9 @@ def test_transitive_reachability_names_the_chain(result):
     [f] = [
         f
         for f in result.findings
-        if f.rule == "jit-purity" and "time.time" in f.message
+        if f.rule == "jit-purity"
+        and "time.time" in f.message
+        and f.path.endswith("bad_jit.py")
     ]
     assert "transitive_root" in f.message and "_helper" in f.message
 
@@ -136,6 +140,70 @@ def test_locked_suffix_convention_is_honored(result):
         for f in result.findings
         if f.rule == "lock-discipline"
     )
+
+
+def test_lock_order_cycle_reports_both_edges(result):
+    """The planted inversion yields one finding per participating edge
+    — each anchored at its own acquisition order's exact site — and
+    the consistently-ordered control class yields nothing."""
+    edges = [f for f in result.findings if f.rule == "lock-order"]
+    assert len(edges) == 2
+    symbols = {f.symbol for f in edges}
+    a = "fixture_pkg.bad_lockorder:_LOCK_A"
+    b = "fixture_pkg.bad_lockorder:_LOCK_B"
+    assert symbols == {f"{a}->{b}", f"{b}->{a}"}
+    # The interprocedural edge names the callee and its inner site.
+    [inter] = [f for f in edges if f.symbol == f"{a}->{b}"]
+    assert "_grab_b" in inter.message
+    assert "bad_lockorder.py:17" in inter.message
+    # Each message points at the opposing order's site.
+    [lex] = [f for f in edges if f.symbol == f"{b}->{a}"]
+    assert f"bad_lockorder.py:{inter.line}" in lex.message
+    assert "Ordered" not in " ".join(f.message for f in edges)
+
+
+def test_thread_death_resolves_module_and_method_targets(result):
+    hits = {
+        f.symbol for f in result.findings if f.rule == "hygiene-thread-death"
+    }
+    assert "fragile_worker" in hits
+    assert "Worker.self._run" in hits
+    # The protected control worker must NOT fire.
+    assert not any("safe_worker" in s for s in hits)
+
+
+def test_wraps_decorated_closure_becomes_jit_root(result):
+    """``jax.jit(wrapper)`` where wrapper is a functools.wraps-decorated
+    closure: the closure is a root and its body is purity-checked."""
+    [f] = [
+        f
+        for f in result.findings
+        if f.rule == "jit-purity" and "_decorate.wrapper" in f.message
+    ]
+    assert "time.time" in f.message
+
+
+def test_lambda_passed_to_jit_marks_referenced_helper(result):
+    """``jax.jit(lambda x: _lam_helper(x))`` at module level: the
+    helper referenced from the lambda body is a root."""
+    [f] = [
+        f
+        for f in result.findings
+        if f.rule == "jit-purity" and "_lam_helper" in f.message
+    ]
+    assert "time.perf_counter" in f.message
+
+
+def test_threaded_class_inference_through_inheritance(result):
+    """``Derived`` acquires ``self._mu`` — ctor-proven only in its
+    base, under a name the lock-looking heuristic rejects — and its
+    unguarded read fires at the exact marked line."""
+    [f] = [
+        f
+        for f in result.findings
+        if f.rule == "lock-discipline" and f.symbol == "Derived._hits@racy"
+    ]
+    assert "self._mu" in f.message
 
 
 def test_baseline_round_trip(tmp_path, result):
@@ -174,3 +242,30 @@ def test_rules_subset_runs_only_named_families():
 def test_unknown_rule_family_rejected():
     with pytest.raises(ValueError, match="unknown rule"):
         Analyzer(Project(FIXTURE_PKG), rules=("no-such-rule",))
+
+
+# -- kccap-lint --diff-baseline (the CI/tier-1 gate mode) ------------------
+
+
+def test_diff_baseline_prints_only_new_findings(tmp_path, result, capsys):
+    from kubernetesclustercapacity_tpu.analysis import cli
+
+    # Baseline everything: the diff must be empty and exit 0, with NO
+    # recap of accepted history on stdout.
+    bl_path = os.path.join(tmp_path, "bl.json")
+    Baseline.from_findings(result.findings).save(bl_path)
+    rc = cli.run([FIXTURE_PKG, "--baseline", bl_path, "--diff-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out == ""
+
+    # Drop one entry from the baseline: exactly that finding prints,
+    # and the exit flips to 1.
+    victim = result.findings[0]
+    partial = Baseline.from_findings(result.findings[1:])
+    partial.save(bl_path)
+    rc = cli.run([FIXTURE_PKG, "--baseline", bl_path, "--diff-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [ln for ln in out.splitlines() if ln]
+    assert lines == [victim.render()]

@@ -152,6 +152,36 @@ def test_scan_finds_the_optimizer_families():
     )
 
 
+def test_scan_finds_the_sanitizer_families():
+    """Non-vacuous pin for the sanitizer tier: the walk must see every
+    kccap_sanitize_* family plus the supervised-thread death counter
+    (so the README-documentation and snake_case gates below cover
+    them), and each must be matched by a README token — the bare
+    `kccap_*` glob in prose does NOT count as documentation here, so
+    this pin is stricter than the generic gate."""
+    names = _source_metric_names()
+    san = {n for n in names if n.startswith("kccap_sanitize_")}
+    assert {
+        "kccap_sanitize_runs_total",
+        "kccap_sanitize_races_total",
+        "kccap_sanitize_lock_order_cycles_total",
+        "kccap_sanitize_instrumented_classes",
+        "kccap_sanitize_schedule_decisions_total",
+    } <= san
+    assert "kccap_thread_deaths_total" in names
+    with open(_README, encoding="utf-8") as fh:
+        readme = fh.read()
+    undocumented = sorted(
+        n
+        for n in san | {"kccap_thread_deaths_total"}
+        if f"`{n}`" not in readme
+    )
+    assert not undocumented, (
+        "sanitizer metrics missing a literal row in the README "
+        f"observability table: {undocumented}"
+    )
+
+
 def test_metric_names_are_prefixed_snake_case():
     bad = sorted(
         n for n in _source_metric_names() if not _SNAKE_RE.fullmatch(n)
@@ -202,6 +232,8 @@ def test_env_scan_finds_the_known_switches():
     # Sanity: a broken scan must fail loudly, not vacuously pass.
     names = _source_env_names()
     assert {"KCCAP_TELEMETRY", "KCCAP_DEVCACHE"} <= names
+    # The sanitizer's install gate (and README-gated below).
+    assert "KCCAP_SANITIZE" in names
     # The federation horizons: the walk must see them so the README
     # configuration-table gate below covers them.
     assert {"KCCAP_FED_STALE_AFTER_S", "KCCAP_FED_EVICT_AFTER_S"} <= names
