@@ -5,9 +5,9 @@ Each driver builds fresh instances of one threaded class *while the
 sanitizer is installed* (so their locks are wrapped and their guarded
 fields — the statically inferred set from
 :func:`..rules_locks.lock_model` — are monitored), then hits them from
-``threads`` concurrent workers.  One :func:`run` call covers all eleven
-classes under one instrumentation window per seed; findings flow
-through the shared suppression/baseline workflow.
+``threads`` concurrent workers.  One :func:`run` call covers all
+thirteen classes under one instrumentation window per seed; findings
+flow through the shared suppression/baseline workflow.
 
 The drivers deliberately exercise the *synchronization surface*, not
 the numerics: stubs stand in for kernels and oracles, snapshots are
@@ -28,7 +28,7 @@ from kubernetesclustercapacity_tpu.analysis.rules_locks import lock_model
 
 __all__ = ["run", "HAMMERED_CLASSES", "instrument_targets"]
 
-#: The eleven threaded classes the tier-1 gate certifies, as
+#: The thirteen threaded classes the tier-1 gate certifies, as
 #: ``(module, class name)`` — every one must also be inferred threaded
 #: by the static model (cross-checked in tests/test_sanitize.py).
 HAMMERED_CLASSES = (
@@ -41,6 +41,7 @@ HAMMERED_CLASSES = (
     ("kubernetesclustercapacity_tpu.service.plane", "PlaneSubscriber"),
     ("kubernetesclustercapacity_tpu.federation.server", "ClusterFeed"),
     ("kubernetesclustercapacity_tpu.service.plane", "AdmissionController"),
+    ("kubernetesclustercapacity_tpu.service.tenancy", "FairSlotQueue"),
     ("kubernetesclustercapacity_tpu.resilience", "TokenBucket"),
     ("kubernetesclustercapacity_tpu.resilience", "CircuitBreaker"),
     ("kubernetesclustercapacity_tpu.telemetry.metrics", "MetricsRegistry"),
@@ -279,6 +280,36 @@ def _drive_admission():
     return [admit, admit, price, shed], lambda: None
 
 
+def _drive_fair_queue():
+    """Adversarial schedules against the weighted-fair admission queue:
+    a saturated slot pool, skewed weights, timed-out waiters racing
+    grants, and readers — the no-tenant-starves-another class.  Every
+    acquire is paired with a release (ValueError on a pairing bug is a
+    real finding, not expected control flow)."""
+    from kubernetesclustercapacity_tpu.service.tenancy import FairSlotQueue
+
+    weights = {"hot": 8.0, "warm": 2.0, "cold": 0.5}
+    fq = FairSlotQueue(4, weight_of=lambda t: weights.get(t, 1.0))
+    tenants = ("hot", "hot", "warm", "cold", "other")
+
+    def acquire(i, t):
+        tenant = tenants[(i + t) % len(tenants)]
+        # Short timeout: under 16 saturating workers many waits expire,
+        # exercising the timeout-vs-grant race on purpose.
+        if fq.acquire(tenant, timeout=0.002):
+            fq.release(tenant)
+
+    def fast(i, t):
+        tenant = tenants[(i * 3 + t) % len(tenants)]
+        if fq.try_acquire(tenant):
+            fq.release(tenant)
+
+    def stats(i, t):
+        fq.stats()
+
+    return [acquire, acquire, fast, stats], lambda: None
+
+
 def _drive_token_bucket():
     from kubernetesclustercapacity_tpu.resilience import TokenBucket
 
@@ -377,7 +408,7 @@ def run(
     fuzz: bool = True,
     package_dir: str | None = None,
 ) -> tuple:
-    """One full hammer pass: install → drive all eleven classes →
+    """One full hammer pass: install → drive all thirteen classes →
     report → uninstall.  Returns ``(findings, stats)`` with findings
     relative to the repo root.  Raises if any worker crashed."""
     targets = instrument_targets(package_dir)
@@ -393,6 +424,7 @@ def run(
                 _drive_shadow(tmp),
                 _drive_plane(tmp),
                 _drive_admission(),
+                _drive_fair_queue(),
                 _drive_token_bucket(),
                 _drive_breaker(),
                 _drive_registry(),

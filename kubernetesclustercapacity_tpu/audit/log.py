@@ -59,11 +59,13 @@ __all__ = [
 
 _SEGMENT_RE = re.compile(r"^audit-(\d{6})\.jsonl$")
 
-#: Envelope fields never recorded in ``args``: secrets, per-attempt
+#: Envelope fields never recorded in ``args``: secrets (the shared
+#: ``token`` AND the per-tenant ``tenant_token`` — the server records
+#: the DERIVED tenant name instead, never the credential), per-attempt
 #: noise that does not change what the request MEANS (the flight
 #: recorder strips the same set from its digests), and ``op`` — a
 #: request record carries the op as its own top-level field.
-_ARGS_EXCLUDED = ("op", "token", "trace_id", "deadline")
+_ARGS_EXCLUDED = ("op", "token", "tenant_token", "trace_id", "deadline")
 
 #: Result fields that legitimately vary between record time and replay
 #: time without a semantics change: which kernel answered (fused on a

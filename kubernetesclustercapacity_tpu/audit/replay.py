@@ -169,11 +169,19 @@ class Replayer:
         )
         return out
 
-    def replay_all(self, *, ops: tuple[str, ...] | None = None) -> dict:
+    def replay_all(
+        self,
+        *,
+        ops: tuple[str, ...] | None = None,
+        tenant: str | None = None,
+    ) -> dict:
         """Verify the generation digest chain, then replay every
-        recorded request (optionally only ``ops``).  The summary dict
-        is the ``kccap -replay`` report body; ``clean`` is the exit
-        verdict (no mismatches, no replay errors, chain intact)."""
+        recorded request (optionally only ``ops``, optionally only one
+        ``tenant`` — the server stamps the DERIVED tenant into each
+        audited request's args when tenancy is armed, so one tenant's
+        traffic replays in isolation).  The summary dict is the
+        ``kccap -replay`` report body; ``clean`` is the exit verdict
+        (no mismatches, no replay errors, chain intact)."""
         chain_error = None
         try:
             verified = self._reader.verify_chain()
@@ -183,6 +191,11 @@ class Replayer:
         outcomes = []
         for rec in self._reader.requests():
             if ops is not None and rec.get("op") not in ops:
+                continue
+            if (
+                tenant is not None
+                and (rec.get("args") or {}).get("tenant") != tenant
+            ):
                 continue
             outcomes.append(self.replay_record(rec))
         counts = {"ok": 0, "mismatch": 0, "skipped": 0, "error": 0}

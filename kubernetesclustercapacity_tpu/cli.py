@@ -228,6 +228,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with -replay: reconstruct generation GEN and "
                         "verify its digest instead of replaying "
                         "requests")
+    p.add_argument("-replay-tenant", default=None, dest="replay_tenant",
+                   metavar="TENANT",
+                   help="with -replay: replay only requests the server "
+                        "attributed to TENANT (servers started with "
+                        "-tenants stamp the derived tenant into each "
+                        "audited request)")
     p.add_argument("-slo-status", default=None, dest="slo_status",
                    metavar="HOST:PORT",
                    help="render a running capacity service's SLO "
@@ -245,6 +251,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-dump-limit", type=int, default=None,
                    dest="dump_limit", metavar="N",
                    help="with -dump: only the N most recent records")
+    p.add_argument("-dump-tenant", default=None, dest="dump_tenant",
+                   metavar="TENANT",
+                   help="with -dump: only records the server attributed "
+                        "to TENANT (requires a server started with "
+                        "-tenants)")
     p.add_argument("-drain-server", default=None, dest="drain_server",
                    metavar="HOST:PORT",
                    help="gracefully drain a running capacity server: it "
@@ -832,7 +843,7 @@ def _run_dump(args) -> int:
         return 1
     try:
         with _diag_client(addr) as c:
-            result = c.dump(limit=args.dump_limit)
+            result = c.dump(limit=args.dump_limit, tenant=args.dump_tenant)
     except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
         print(f"ERROR : cannot fetch flight records from "
               f"{addr[0]}:{addr[1]}: {e}", file=sys.stderr)
@@ -1051,7 +1062,7 @@ def _run_replay(args) -> int:
                 "clean": outcome["status"] in ("ok", "skipped"),
             }
         else:
-            result = replayer.replay_all()
+            result = replayer.replay_all(tenant=args.replay_tenant)
     if args.output == "json":
         print(replay_json_report(result))
     else:

@@ -35,6 +35,7 @@ __all__ = [
     "DrainingError",
     "NotLeaderError",
     "ClusterLostError",
+    "TenantQuotaError",
     "TokenBucket",
     "WIRE_CODES",
     "decorrelated_jitter",
@@ -107,11 +108,23 @@ class ClusterLostError(RetryableElsewhere):
     wire_code = "cluster_lost"
 
 
+class TenantQuotaError(RetryableElsewhere):
+    """The calling tenant exceeded ITS OWN quota (per-tenant rps cap or
+    concurrency share) at admission.  The refusal happened before any
+    work — but unlike ``overloaded`` it is AUTHORITATIVE, not a symptom
+    of one hot replica: every replica enforces the same quota map, so a
+    multi-endpoint client must NOT fail over (it would just burn the
+    other replicas' admission budget re-refusing the same tenant).
+    Back off and retry later, or shed load at the source."""
+
+    wire_code = "tenant_quota"
+
+
 #: wire code → exception class, for the client side of the envelope.
 WIRE_CODES = {
     cls.wire_code: cls
     for cls in (RetryableElsewhere, OverloadedError, DrainingError,
-                NotLeaderError, ClusterLostError)
+                NotLeaderError, ClusterLostError, TenantQuotaError)
 }
 
 

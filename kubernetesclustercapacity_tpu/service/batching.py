@@ -25,7 +25,10 @@ the wire.
 
 Registry-backed metrics: ``kccap_batch_size`` (batch-size histogram —
 ``sum/count`` is the mean batch size), ``kccap_batch_window_wait_seconds``
-(how long leaders actually waited), and batched/solo/bypass counters.
+(how long leaders actually waited), ``kccap_batch_tenants`` (distinct
+tenants folded into each dispatched batch — cross-tenant folding is the
+multi-tenancy win: one padded dispatch, split per tenant on return,
+bit-exact vs solo), and batched/solo/bypass counters.
 """
 
 from __future__ import annotations
@@ -45,10 +48,16 @@ _FOLLOWER_TIMEOUT_S = 120.0
 
 
 class _Batch:
-    __slots__ = ("items", "closed", "full", "done", "results", "error")
+    __slots__ = (
+        "items", "tenants", "closed", "full", "done", "results", "error",
+    )
 
     def __init__(self) -> None:
         self.items: list = []
+        # Parallel to ``items``: who asked (None when tenancy is off).
+        # Results scatter back BY INDEX, so per-tenant attribution never
+        # influences — or could even touch — the combined dispatch.
+        self.tenants: list = []
         self.closed = False
         self.full = threading.Event()
         self.done = threading.Event()
@@ -112,6 +121,12 @@ class MicroBatcher:
             "Requests that bypassed batching because their deadline "
             "would expire inside the window.",
         )
+        self._m_tenants = m.histogram(
+            "kccap_batch_tenants",
+            "Distinct tenants folded into each dispatched micro-batch "
+            "(1 when tenancy is off; >1 means cross-tenant sharing).",
+            buckets=_BATCH_SIZE_BUCKETS,
+        )
 
     @property
     def stats(self) -> dict:
@@ -129,16 +144,22 @@ class MicroBatcher:
             "mean_batch_size": (total / dispatches) if dispatches else 0.0,
         }
 
-    def submit(self, key, item, *, deadline=None):
+    def submit(self, key, item, *, deadline=None, tenant=None):
         """Run ``item`` through a (possibly shared) dispatch; returns its
         own result.  Blocking — callers are the server's per-connection
-        threads, each already holding a compute slot."""
+        threads, each already holding a compute slot.
+
+        ``tenant`` is pure attribution: concurrent tenants' same-key
+        sweeps FOLD into one padded dispatch and split per tenant on
+        return (bit-exact vs solo, because the combined dispatch is
+        index-scattered and never reads the label)."""
         if deadline is not None and deadline.remaining() <= self.window_s:
             # The window would eat the caller's whole budget: dispatch
             # alone, now.  (An already-expired deadline was shed upstream.)
             self._m_bypass.inc()
             self._m_solo.inc()
             self._m_size.observe(1)
+            self._m_tenants.observe(1)
             return self._dispatch(key, [item])[0]
 
         with self._lock:
@@ -154,6 +175,7 @@ class MicroBatcher:
                 leader = True
             idx = len(batch.items)
             batch.items.append(item)
+            batch.tenants.append(tenant)
             if len(batch.items) >= self.max_batch:
                 batch.full.set()
 
@@ -189,6 +211,12 @@ class MicroBatcher:
                 raise
             finally:
                 self._m_size.observe(len(items))
+                # Distinct tenants per dispatch: None (tenancy off)
+                # counts as one anonymous tenant, so the histogram is
+                # well-defined on the pre-tenancy path too.
+                self._m_tenants.observe(
+                    len(set(batch.tenants[: len(items)])) or 1
+                )
                 if len(items) > 1:
                     self._m_batched.inc(len(items))
                 else:

@@ -116,6 +116,8 @@ class CapacityClient:
         port: int = 7077,
         *,
         token: str | None = None,
+        tenant: str | None = None,
+        tenant_token: str | None = None,
         connect_timeout_s: float = 10.0,
         timeout_s: float | None = 120.0,
         retry: RetryPolicy | None = None,
@@ -125,6 +127,16 @@ class CapacityClient:
         trace: bool = False,
         trace_log=None,
     ) -> None:
+        """``tenant`` / ``tenant_token`` ride every call's envelope for
+        multi-tenant servers (``kccap-server -tenants``): a per-tenant
+        ``tenant_token`` both authenticates and attributes; a bare
+        ``tenant`` is a label only (quota attribution without secrets).
+        A per-tenant token may equally be passed as ``token=`` — the
+        server derives identity from either field.  Both are ignored by
+        tenantless servers, so a tenant-configured client stays
+        compatible with old deployments.  Tenant-quota refusals raise
+        :class:`~...resilience.TenantQuotaError` — authoritative (every
+        replica enforces the same map): back off, don't fail over."""
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             MetricsRegistry,
         )
@@ -132,6 +144,8 @@ class CapacityClient:
 
         self._addr = (host, port)
         self._token = token
+        self._tenant = tenant
+        self._tenant_token = tenant_token
         self._connect_timeout = connect_timeout_s
         self._timeout = timeout_s
         self._retry = retry if retry is not None else RetryPolicy()
@@ -273,6 +287,10 @@ class CapacityClient:
         attempt reuses it — the retries ARE the story a trace tells)."""
         if self._token is not None:
             params.setdefault("token", self._token)
+        if self._tenant_token is not None:
+            params.setdefault("tenant_token", self._tenant_token)
+        if self._tenant is not None:
+            params.setdefault("tenant", self._tenant)
         if self._trace and "trace_id" not in params:
             from kubernetesclustercapacity_tpu.telemetry.tracing import (
                 new_trace_id,
@@ -538,13 +556,17 @@ class CapacityClient:
         return self.call("optimize", **params)
 
     def dump(self, op: str | None = None, status: str | None = None,
-             limit: int | None = None, **kw) -> dict:
+             limit: int | None = None, tenant: str | None = None,
+             **kw) -> dict:
         """The server's flight recorder: its last K dispatched requests.
 
         Filters apply SERVER-side: ``op`` keeps records of one op (sent
         as ``filter_op`` — the envelope's own ``op`` field names this
-        request), ``status`` keeps ``"ok"``/``"error"`` records, and
-        ``limit`` returns only the N most recent matches.
+        request), ``status`` keeps ``"ok"``/``"error"`` records,
+        ``tenant`` keeps one tenant's records (sent as
+        ``filter_tenant`` — the envelope's own ``tenant`` field is this
+        request's attribution), and ``limit`` returns only the N most
+        recent matches.
         """
         if op is not None:
             kw["filter_op"] = op
@@ -552,6 +574,8 @@ class CapacityClient:
             kw["status"] = status
         if limit is not None:
             kw["limit"] = limit
+        if tenant is not None:
+            kw["filter_tenant"] = tenant
         return self.call("dump", **kw)
 
     def audit_status(self, **kw) -> dict:

@@ -51,6 +51,7 @@ import time
 from kubernetesclustercapacity_tpu.resilience import (
     DeadlineExpired,
     OverloadedError,
+    TenantQuotaError,
     TokenBucket,
     decorrelated_jitter,
 )
@@ -997,6 +998,19 @@ class AdmissionController:
     move the signal (an uncertified dual is a loose bound, not a
     price), so the gate can never act on a lie.
 
+    A :class:`~.tenancy.TenantMap` (``tenants=...``) arms **per-tenant
+    quotas** between 2 and 3: each mapped tenant's own
+    :class:`~..resilience.TokenBucket` rps cap and concurrency quota,
+    shed with the AUTHORITATIVE
+    :class:`~..resilience.TenantQuotaError` (reason ``tenant_quota`` —
+    every replica enforces the same map, so clients must not fail
+    over), and the concurrency gate becomes a
+    :class:`~.tenancy.FairSlotQueue` — deficit round-robin across
+    per-tenant sub-queues instead of the global FIFO semaphore, so a
+    hot tenant's backlog cannot starve an idle tenant's first request.
+    Without a map the controller is byte-identical to the pre-tenancy
+    single-queue path (``tenant=`` is accepted and ignored).
+
     Counters are exact under concurrency (pinned by a 16-thread hammer
     in ``tests/test_plane.py``): every governed request is counted
     exactly once as admitted or shed.
@@ -1013,6 +1027,7 @@ class AdmissionController:
         price_budget: float = 0.0,
         registry=None,
         clock=time.monotonic,
+        tenants=None,
     ) -> None:
         if max_concurrent < 0:
             raise ValueError(
@@ -1030,21 +1045,46 @@ class AdmissionController:
         self.rps = float(rps)
         self.max_queue_wait_s = float(max_queue_wait_s)
         self.min_slack_s = float(min_slack_s)
+        self._tenants = tenants
+        self._fair = None
+        if tenants is not None and self.max_concurrent > 0:
+            from kubernetesclustercapacity_tpu.service.tenancy import (
+                FairSlotQueue,
+            )
+
+            self._fair = FairSlotQueue(
+                self.max_concurrent, weight_of=tenants.weight
+            )
         self._sem = (
             threading.Semaphore(self.max_concurrent)
-            if self.max_concurrent > 0
+            if self.max_concurrent > 0 and self._fair is None
             else None
         )
         self._bucket = (
             TokenBucket(self.rps, burst, clock=clock) if self.rps > 0 else None
         )
+        self._tenant_buckets: dict = {}
+        self._tenant_quota: dict = {}
+        if tenants is not None:
+            for spec in tenants.specs:
+                if spec.rps > 0:
+                    self._tenant_buckets[spec.name] = TokenBucket(
+                        spec.rps, spec.burst, clock=clock
+                    )
+                if spec.max_concurrent > 0:
+                    self._tenant_quota[spec.name] = int(spec.max_concurrent)
         self._lock = threading.Lock()
         self._queue_depth = 0
         self._admitted = 0
         self._shed: dict[str, int] = {}
+        self._tenant_active: dict[str, int] = {}
+        self._tenant_queued: dict[str, int] = {}
         self._m_admitted = None
         self._m_shed = None
         self._m_queue = None
+        self._m_tenant_admitted = None
+        self._m_tenant_shed = None
+        self._m_tenant_queue = None
         if registry is not None:
             from kubernetesclustercapacity_tpu.telemetry.metrics import (
                 enabled as _telemetry_enabled,
@@ -1066,6 +1106,29 @@ class AdmissionController:
                     "Requests currently queued at the admission "
                     "concurrency gate.",
                 )
+                if tenants is not None:
+                    # Bounded cardinality: labels come from
+                    # TenantMap.label (map-named tenants + "default" +
+                    # the "other" fold), never raw request identity.
+                    self._m_tenant_admitted = registry.counter(
+                        "kccap_tenant_admitted_total",
+                        "Requests admitted, by tenant (map-named "
+                        "tenants only; everything else folds to "
+                        "'other').",
+                        ("tenant",),
+                    )
+                    self._m_tenant_shed = registry.counter(
+                        "kccap_tenant_shed_total",
+                        "Requests shed at admission, by tenant and "
+                        "reason.",
+                        ("tenant", "reason"),
+                    )
+                    self._m_tenant_queue = registry.gauge(
+                        "kccap_tenant_queue_depth",
+                        "Requests queued at the weighted-fair "
+                        "admission gate, by tenant.",
+                        ("tenant",),
+                    )
 
     def observe_shadow_price(
         self, capacity_share: float, *, certified: bool
@@ -1096,14 +1159,18 @@ class AdmissionController:
         if self._m_shed is not None:
             self._m_shed.labels(op=op, reason=reason).inc()
 
-    def admit(self, op: str, deadline=None, *, priced: bool = True):
+    def admit(self, op: str, deadline=None, *, priced: bool = True,
+              tenant: str | None = None):
         """Gate one governed request: returns a zero-arg ``release``
         callable on admission, raises on shed.  Callers MUST invoke the
         release in a ``finally`` (the server's dispatch does).
         ``priced=False`` skips the shadow-price gate — the server
         exempts the ``optimize`` op itself, since that is the dispatch
         that refreshes the price (a price-gated refresher could latch
-        the gate shut forever)."""
+        the gate shut forever).  ``tenant`` names the calling tenant
+        for the per-tenant quota gates and the weighted-fair queue
+        (``None`` folds to ``"default"``); without a tenant map it is
+        accepted and ignored — the pre-tenancy path, byte-identical."""
         # Gate 1: deadline slack — cheapest, and shedding here must not
         # debit the token bucket (the request consumed no capacity).
         if deadline is not None:
@@ -1134,8 +1201,46 @@ class AdmissionController:
                 f"admission rps cap {self.rps:g}/s exceeded; "
                 "retry another replica"
             )
-        # Gate 3: concurrency (bounded queue).
-        if self._sem is not None:
+        # Gate 2.5: per-tenant quotas (rps cap + concurrency share).
+        # These refusals are AUTHORITATIVE — every replica enforces the
+        # same map — so the typed tenant_quota code tells multi-endpoint
+        # clients not to fail over.
+        reserved = False
+        if self._tenants is not None:
+            tenant = tenant or "default"
+            bucket = self._tenant_buckets.get(tenant)
+            if bucket is not None and not bucket.try_acquire():
+                self._shed_tenant(op, tenant, "tenant_quota")
+                spec = self._tenants.spec(tenant)
+                raise TenantQuotaError(
+                    f"tenant {tenant!r} rps cap {spec.rps:g}/s "
+                    "exceeded; back off (authoritative refusal — do "
+                    "not fail over)"
+                )
+            quota = self._tenant_quota.get(tenant, 0)
+            if quota > 0:
+                with self._lock:
+                    active = self._tenant_active.get(tenant, 0)
+                    if active < quota:
+                        self._tenant_active[tenant] = active + 1
+                        reserved = True
+                if not reserved:
+                    self._shed_tenant(op, tenant, "tenant_quota")
+                    raise TenantQuotaError(
+                        f"tenant {tenant!r} concurrency quota {quota} "
+                        "saturated; back off (authoritative refusal — "
+                        "do not fail over)"
+                    )
+        # Gate 3: concurrency (bounded queue; deficit round-robin
+        # across tenant sub-queues when a tenant map armed it).
+        if self._fair is not None:
+            try:
+                self._admit_fair(op, tenant, deadline)
+            except BaseException:
+                if reserved:
+                    self._unreserve(tenant)
+                raise
+        elif self._sem is not None:
             acquired = self._sem.acquire(blocking=False)
             if not acquired:
                 wait_s = self.max_queue_wait_s
@@ -1176,9 +1281,114 @@ class AdmissionController:
             self._admitted += 1
         if self._m_admitted is not None:
             self._m_admitted.labels(op=op).inc()
+        if self._tenants is not None:
+            if self._m_tenant_admitted is not None:
+                self._m_tenant_admitted.labels(
+                    tenant=self._tenants.label(tenant)
+                ).inc()
+            return self._release_tenant(tenant, reserved)
         if self._sem is not None:
             return self._sem.release
         return _noop
+
+    def _admit_fair(self, op: str, tenant: str, deadline) -> None:
+        """Tenancy's Gate 3: the deficit-round-robin concurrency gate,
+        with the exact bounded-wait / ``admission``-phase contract of
+        the semaphore path it replaces."""
+        if self._fair.try_acquire(tenant):
+            return
+        wait_s = self.max_queue_wait_s
+        if deadline is not None:
+            wait_s = max(0.0, min(wait_s, deadline.remaining()))
+        label = self._tenants.label(tenant)
+        with self._lock:
+            self._queue_depth += 1
+            if self._m_queue is not None:
+                self._m_queue.set(self._queue_depth)
+            depth = self._tenant_queued.get(label, 0) + 1
+            self._tenant_queued[label] = depth
+            if self._m_tenant_queue is not None:
+                self._m_tenant_queue.labels(tenant=label).set(depth)
+        from kubernetesclustercapacity_tpu.telemetry import (
+            phases as _phases,
+        )
+
+        clk = _phases.current()
+        t0 = time.perf_counter() if clk else 0.0
+        try:
+            acquired = self._fair.acquire(tenant, timeout=wait_s)
+        finally:
+            with self._lock:
+                self._queue_depth -= 1
+                if self._m_queue is not None:
+                    self._m_queue.set(self._queue_depth)
+                depth = max(0, self._tenant_queued.get(label, 0) - 1)
+                if depth:
+                    self._tenant_queued[label] = depth
+                else:
+                    self._tenant_queued.pop(label, None)
+                if self._m_tenant_queue is not None:
+                    self._m_tenant_queue.labels(tenant=label).set(depth)
+            if clk:
+                clk.record(
+                    "admission", time.perf_counter() - t0
+                )
+        if not acquired:
+            self._shed_tenant(op, tenant, "concurrency")
+            raise OverloadedError(
+                f"admission concurrency cap {self.max_concurrent} "
+                f"saturated after {wait_s:.3f}s weighted-fair queue "
+                f"wait (tenant {tenant!r}); retry another replica"
+            )
+
+    def _shed_tenant(self, op: str, tenant: str, reason: str) -> None:
+        """One tenant-attributed shed: the shared op/reason counter
+        plus the bounded-cardinality per-tenant family."""
+        self.count_shed(op, reason)
+        if self._m_tenant_shed is not None:
+            self._m_tenant_shed.labels(
+                tenant=self._tenants.label(tenant), reason=reason
+            ).inc()
+
+    def _unreserve(self, tenant: str) -> None:
+        with self._lock:
+            n = self._tenant_active.get(tenant, 0)
+            if n <= 1:
+                self._tenant_active.pop(tenant, None)
+            else:
+                self._tenant_active[tenant] = n - 1
+
+    def _release_tenant(self, tenant: str, reserved: bool):
+        """The release callable for a tenancy-armed admission: frees
+        the DRR slot (when one was held) and the tenant's quota
+        reservation, exactly once (dispatch calls it in a finally)."""
+        fair = self._fair
+
+        def release() -> None:
+            if fair is not None:
+                fair.release(tenant)
+            if reserved:
+                self._unreserve(tenant)
+
+        return release
+
+    def tenant_stats(self) -> dict | None:
+        """The ``info``/doctor tenancy section: per-tenant in-flight
+        quota reservations, shed counts by reason, and the fair
+        queue's live occupancy.  ``None`` without a tenant map."""
+        if self._tenants is None:
+            return None
+        with self._lock:
+            active = dict(self._tenant_active)
+            shed = dict(self._shed)
+        return {
+            "tenants": len(self._tenants),
+            "active": active,
+            "shed": shed,
+            "fair_queue": (
+                self._fair.stats() if self._fair is not None else None
+            ),
+        }
 
 
 def _noop() -> None:

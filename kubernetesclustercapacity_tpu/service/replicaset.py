@@ -133,6 +133,8 @@ class ReplicaSet:
         endpoints,
         *,
         token: str | None = None,
+        tenant: str | None = None,
+        tenant_token: str | None = None,
         deadline_s: float | None = None,
         connect_timeout_s: float = 5.0,
         timeout_s: float | None = 120.0,
@@ -151,7 +153,12 @@ class ReplicaSet:
         then demotes any endpoint whose federation status reports that
         cluster ``lost`` — the way it demotes a draining endpoint —
         and a typed ``cluster_lost`` refusal mid-call marks it the same
-        way while the call retries elsewhere."""
+        way while the call retries elsewhere.
+
+        ``tenant``/``tenant_token`` ride every per-endpoint client (see
+        :class:`~.client.CapacityClient`).  A ``tenant_quota`` refusal
+        is AUTHORITATIVE — every replica enforces the same map — so the
+        set surfaces it immediately instead of failing over."""
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             MetricsRegistry,
         )
@@ -166,6 +173,8 @@ class ReplicaSet:
                 )
         self._endpoints = [_Endpoint(a, breaker_factory(a)) for a in addrs]
         self._token = token
+        self._tenant = tenant
+        self._tenant_token = tenant_token
         self._deadline_s = deadline_s
         self._connect_timeout = connect_timeout_s
         self._timeout = timeout_s
@@ -347,6 +356,13 @@ class ReplicaSet:
                 except DeadlineExpired:
                     raise
                 except RetryableElsewhere as e:
+                    if e.wire_code == "tenant_quota":
+                        # AUTHORITATIVE refusal: every replica enforces
+                        # the same tenant map, so failing over would
+                        # just spend the other replicas' admission
+                        # budget re-refusing.  The quota error IS the
+                        # answer — surface it.
+                        raise
                     # The server refused before doing work: safe to try
                     # the next replica, mutations included.
                     errors.append(f"{ep.name}: {e}")
@@ -431,6 +447,8 @@ class ReplicaSet:
                 ep.addr[0],
                 ep.addr[1],
                 token=self._token,
+                tenant=self._tenant,
+                tenant_token=self._tenant_token,
                 connect_timeout_s=self._connect_timeout,
                 timeout_s=self._timeout,
                 # The set owns cross-endpoint retry; the per-endpoint

@@ -156,6 +156,7 @@ class CapacityServer:
         admission=None,
         plane=None,
         drain_timeout_s: float = 10.0,
+        tenants=None,
     ) -> None:
         """``stats_source`` is an optional zero-arg callable returning a
         JSON-able dict of upstream-feed health (e.g.
@@ -224,7 +225,19 @@ class CapacityServer:
         the LEADER of a replicated serving plane: every published
         generation (the same funnel the timeline and audit log observe)
         fans out to subscribed replica servers.  ``drain_timeout_s``
-        bounds :meth:`begin_drain`'s wait for in-flight work."""
+        bounds :meth:`begin_drain`'s wait for in-flight work.
+
+        ``tenants`` (a :class:`~.tenancy.TenantMap`) makes the tenant a
+        first-class identity on the dispatch path: every request is
+        attributed (per-tenant token → explicit ``tenant`` field →
+        ``"default"``), the identity rides admission (pass the SAME map
+        to the :class:`~.plane.AdmissionController`), the flight
+        recorder (``dump`` grows a ``tenant=`` filter), the request
+        log, the audit trail, and the bounded-cardinality
+        ``kccap_tenant_*`` metrics.  ``None`` (or ``KCCAP_TENANCY=0``
+        upstream) is the exact pre-tenancy path — old tenantless
+        clients keep working as ``"default"`` with unchanged reply
+        envelopes."""
         import os
 
         from kubernetesclustercapacity_tpu.telemetry.flightrec import (
@@ -317,6 +330,28 @@ class CapacityServer:
             ("op", "phase"),
             buckets=SUB_MS_LATENCY_BUCKETS_S,
         )
+        # Tenancy: None means the exact pre-tenancy dispatch path (no
+        # attribution, no per-tenant metrics, unchanged log/audit/flight
+        # record shapes).  The metric families are created only when a
+        # map is armed, and every label passes TenantMap.label() so the
+        # cardinality is bounded by the map (unmapped names fold to
+        # "other").
+        self._tenants = tenants
+        self._m_tenant_requests = None
+        self._m_tenant_latency = None
+        if tenants is not None:
+            self._m_tenant_requests = m.counter(
+                "kccap_tenant_requests_total",
+                "Requests dispatched, by tenant (bounded: mapped names "
+                "+ default + other).",
+                ("tenant",),
+            )
+            self._m_tenant_latency = m.histogram(
+                "kccap_tenant_request_latency_seconds",
+                "End-to-end dispatch latency, by tenant (bounded "
+                "cardinality; feeds per-tenant SLO specs).",
+                ("tenant",),
+            )
         self._flight = FlightRecorder(flight_records)
         self._flight_dump_path = flight_dump_path
         self._batcher = None
@@ -555,18 +590,23 @@ class CapacityServer:
         }
     )
 
-    def _audit_request(self, msg, op_label, gen, error, result):
+    def _audit_request(self, msg, op_label, gen, error, result, tenant=None):
         """One audit-log request record; returns its audit ref (or
         ``None``).  Best-effort: the audit trail observes dispatch, it
-        never fails it."""
+        never fails it.  When tenancy is armed the DERIVED tenant rides
+        the stripped args (tokens never do), so audit replay can filter
+        a single tenant's traffic."""
         if self._audit is None or op_label not in self._AUDITED_OPS:
             return None
         from kubernetesclustercapacity_tpu.audit.log import strip_args
 
         try:
+            args = strip_args(msg)
+            if tenant is not None:
+                args = dict(args, tenant=tenant)
             return self._audit.record_request(
                 op=op_label,
-                args=strip_args(msg),
+                args=args,
                 generation=gen,
                 status="error" if error else "ok",
                 result=result,
@@ -574,6 +614,23 @@ class CapacityServer:
             )
         except Exception:  # noqa: BLE001 - auditing never fails an op
             return None
+
+    def _tenant_of(self, msg: dict) -> str:
+        """Attribute one request to a tenant (tenancy armed only).  The
+        dedicated ``tenant_token`` field wins, then the ``token`` field
+        doubling as a per-tenant token, then an explicit ``tenant``
+        label (trusted only as a LABEL — quotas, not secrets), then the
+        ``"default"`` identity every pre-tenancy client gets, so old
+        clients keep working with unchanged reply envelopes.
+        Attribution never authenticates; `_dispatch_routed` does."""
+        t = self._tenants.tenant_of(msg.get("tenant_token"))
+        if t is None:
+            t = self._tenants.tenant_of(msg.get("token"))
+        if t is None:
+            explicit = msg.get("tenant")
+            if isinstance(explicit, str) and explicit:
+                t = explicit
+        return t or "default"
 
     def start(self) -> None:
         self._serving = True
@@ -683,7 +740,18 @@ class CapacityServer:
             raise ValueError(
                 f"trace_id must be a string, got {trace_id!r}"
             )
+        # Tenant attribution happens ONCE, up front, and rides the
+        # whole dispatch: admission quotas, the micro-batcher (via the
+        # dispatch TLS), per-tenant metrics, the request log, the audit
+        # trail, and the flight record.  None ⇔ tenancy off ⇔ the exact
+        # pre-tenancy path (no new fields anywhere).
+        tenant = self._tenant_of(msg) if self._tenants is not None else None
+        self._dispatch_tls.tenant = tenant
         self._m_requests.labels(op=op_label).inc()
+        if self._m_tenant_requests is not None:
+            self._m_tenant_requests.labels(
+                tenant=self._tenants.label(tenant)
+            ).inc()
         self._m_inflight.inc()
         clk = _phases.new_clock()
         prev_clk = _phases.activate(clk)
@@ -726,6 +794,7 @@ class CapacityServer:
                     # optimize refreshes the shadow-price signal, so it
                     # is never gated by it (see AdmissionController).
                     priced=op_label != "optimize",
+                    tenant=tenant,
                 )
             result = self._dispatch_routed(msg)
             return result
@@ -744,6 +813,11 @@ class CapacityServer:
             dur = _time.perf_counter() - t0
             self._m_inflight.dec()
             self._m_latency.labels(op=op_label).observe(dur)
+            self._dispatch_tls.tenant = None
+            if self._m_tenant_latency is not None:
+                self._m_tenant_latency.labels(
+                    tenant=self._tenants.label(tenant)
+                ).observe(dur)
             phase_items = clk.items() if clk else ()
             for ph, secs in phase_items:
                 self._m_phase.labels(op=op_label, phase=ph).observe(secs)
@@ -808,19 +882,22 @@ class CapacityServer:
                         generation=gen,
                         latency_ms=round(dur * 1e3, 3),
                         status="error" if error else "ok",
+                        **({"tenant": tenant} if tenant is not None else {}),
                         **({"error": error} if error else {}),
                     )
                 except Exception:  # noqa: BLE001 - logging must not fail ops
                     pass
-            audit_ref = self._audit_request(msg, op_label, gen, error, result)
+            audit_ref = self._audit_request(
+                msg, op_label, gen, error, result, tenant=tenant
+            )
             self._flight_record(
                 msg, op_label, trace_id, dur, error, result, gen, audit_ref,
-                phases=(clk.to_ms() if clk else None),
+                phases=(clk.to_ms() if clk else None), tenant=tenant,
             )
 
     def _flight_record(
         self, msg, op_label, trace_id, dur, error, result, gen,
-        audit_ref=None, phases=None,
+        audit_ref=None, phases=None, tenant=None,
     ) -> None:
         """One flight-recorder entry per dispatch (the failing request
         included), then — on error, when configured — the whole ring
@@ -842,6 +919,7 @@ class CapacityServer:
                 error=error,
                 audit_ref=audit_ref,
                 phases=phases,
+                tenant=tenant or "",
             )
             if error and self._flight_dump_path:
                 self._flight.dump_jsonl(self._flight_dump_path)
@@ -859,9 +937,24 @@ class CapacityServer:
             token = msg.get("token")
             # Compare as bytes: compare_digest on str raises TypeError for
             # non-ASCII, which would lock out a correct non-ASCII token.
-            if not isinstance(token, str) or not hmac.compare_digest(
+            ok = isinstance(token, str) and hmac.compare_digest(
                 token.encode(), self._auth_token.encode()
-            ):
+            )
+            if not ok and self._tenants is not None:
+                # A mapped per-tenant token authenticates too (lookup is
+                # by SHA-256 digest — hash equality, no data-dependent
+                # scan over secrets): identity and authorization ride
+                # one field, so a single-token deployment upgrades to
+                # per-tenant tokens without a wire change.  The
+                # dedicated ``tenant_token`` field also authenticates,
+                # for deployments that keep the shared token AND want
+                # tenant identity.
+                ok = (
+                    self._tenants.tenant_of(token) is not None
+                    or self._tenants.tenant_of(msg.get("tenant_token"))
+                    is not None
+                )
+            if not ok:
                 raise PermissionError("missing or invalid auth token")
         if op == "drain_server":
             return self._op_drain_server(msg)
@@ -987,6 +1080,7 @@ class CapacityServer:
                     "plane": self._plane_role is not None,
                     "admission": self._admission is not None,
                     "drain": True,
+                    "tenancy": self._tenants is not None,
                 },
                 "draining": self.draining,
             }
@@ -1051,6 +1145,22 @@ class CapacityServer:
             # shadow-oracle status — replay/audit visibility without a
             # side channel.  Opt-in for the pinned-default-shape reason
             # metrics/hot_path are.
+            # Opt-in (``info {tenancy: true}``): the tenant map shape
+            # (never tokens) plus per-tenant admission counters — the
+            # doctor's tenancy line reads this.  Opt-in for the
+            # pinned-default-shape reason the other sections are.
+            if msg.get("tenancy"):
+                if self._tenants is None:
+                    out["tenancy"] = None
+                else:
+                    out["tenancy"] = {
+                        "tenants": self._tenants.to_wire(),
+                        "admission": (
+                            self._admission.tenant_stats()
+                            if self._admission is not None
+                            else None
+                        ),
+                    }
             if msg.get("audit"):
                 out["audit"] = {
                     "enabled": (
@@ -1850,11 +1960,13 @@ class CapacityServer:
         request before it).
 
         Server-side filters — ``op`` (exact op name), ``status``
-        (``"ok"``/``"error"``), ``limit`` (the N MOST RECENT matches) —
-        so a triage client chasing "the last 5 errors" pulls 5 records,
-        not the whole ring.  ``count`` is the post-filter record count;
-        ``matched`` the pre-``limit`` match count, so a reader knows
-        how much history the filter found beyond what it was handed.
+        (``"ok"``/``"error"``), ``filter_tenant`` (exact derived tenant,
+        only meaningful when tenancy is armed), ``limit`` (the N MOST
+        RECENT matches) — so a triage client chasing "the last 5 errors" pulls
+        5 records, not the whole ring.  ``count`` is the post-filter
+        record count; ``matched`` the pre-``limit`` match count, so a
+        reader knows how much history the filter found beyond what it
+        was handed.
         """
         # ``op`` names THIS request's op on the envelope, so the filter
         # rides as ``filter_op`` (the client's ``dump(op=...)`` maps it).
@@ -1865,6 +1977,14 @@ class CapacityServer:
         if status is not None and status not in ("ok", "error"):
             raise ValueError(
                 f"status filter must be 'ok' or 'error', got {status!r}"
+            )
+        # ``tenant`` on the envelope is this request's own attribution
+        # (tenant-configured clients stamp it on every call), so the
+        # filter rides as ``filter_tenant`` — the ``filter_op`` move.
+        tenant_f = msg.get("filter_tenant")
+        if tenant_f is not None and not isinstance(tenant_f, str):
+            raise ValueError(
+                f"filter_tenant must be a string, got {tenant_f!r}"
             )
         limit = msg.get("limit")
         if limit is not None:
@@ -1877,6 +1997,8 @@ class CapacityServer:
             records = [r for r in records if r.get("op") == op_f]
         if status is not None:
             records = [r for r in records if r.get("status") == status]
+        if tenant_f is not None:
+            records = [r for r in records if r.get("tenant") == tenant_f]
         matched = len(records)
         if limit is not None:
             records = records[-limit:]
@@ -1961,6 +2083,10 @@ class CapacityServer:
                     (generation, kernel_req),
                     (snap, implicit_mask, grid),
                     deadline=self._check_deadline(msg),
+                    # Folding across tenants is the POINT (one padded
+                    # dispatch, split per tenant on return, bit-exact
+                    # vs solo) — the label only feeds accounting.
+                    tenant=getattr(self._dispatch_tls, "tenant", None),
                 )
             )
         else:
@@ -2562,6 +2688,16 @@ def main(argv=None) -> int:
                         "retryable-elsewhere 'overloaded' error "
                         "(0 = no price gate; the optimize op itself is "
                         "never price-gated)")
+    p.add_argument("-tenants", default=None, metavar="FILE",
+                   help="tenant map (YAML/JSON): named tenants with "
+                        "per-tenant auth tokens, rps caps, concurrency "
+                        "quotas, and weighted-fair admission weights; "
+                        "requests are attributed by token (old "
+                        "tenantless clients become 'default'), quota "
+                        "overage sheds with the authoritative "
+                        "'tenant_quota' error, and kccap_tenant_* "
+                        "metrics follow the identity with bounded "
+                        "cardinality (KCCAP_TENANCY=0 disables)")
     p.add_argument("-drain-timeout-s", type=float, default=10.0,
                    dest="drain_timeout_s", metavar="SECONDS",
                    help="graceful drain bound (SIGTERM/SIGINT or the "
@@ -2724,11 +2860,34 @@ def main(argv=None) -> int:
             if follower is not None:
                 follower.stop()
             return 1
+    tenants = None
+    if args.tenants:
+        from kubernetesclustercapacity_tpu.service import tenancy
+
+        if not tenancy.enabled():
+            # The escape hatch beats the flag: KCCAP_TENANCY=0 restores
+            # the exact pre-tenancy single-queue admission path even
+            # when a map is configured.
+            print(
+                "WARN  : -tenants ignored (KCCAP_TENANCY=0)",
+                file=sys.stderr,
+            )
+        else:
+            try:
+                tenants = tenancy.load_tenants(args.tenants)
+            except (OSError, tenancy.TenancyError) as e:
+                print(
+                    f"ERROR : bad tenant map: {e}", file=sys.stderr
+                )
+                if follower is not None:
+                    follower.stop()
+                return 1
     admission = None
     if (
         args.admission_max_concurrent > 0
         or args.admission_rps > 0
         or args.admission_price_budget > 0
+        or tenants is not None
     ):
         from kubernetesclustercapacity_tpu.service.plane import (
             AdmissionController,
@@ -2748,6 +2907,7 @@ def main(argv=None) -> int:
             burst=args.admission_burst if args.admission_burst > 0 else None,
             price_budget=args.admission_price_budget,
             registry=REGISTRY,
+            tenants=tenants,
         )
     plane_pub = None
     if args.plane_port:
@@ -2795,6 +2955,7 @@ def main(argv=None) -> int:
         admission=admission,
         plane=plane_pub,
         drain_timeout_s=max(args.drain_timeout_s, 0.0),
+        tenants=tenants,
     )
     subscriber = None
     if args.plane_leader:

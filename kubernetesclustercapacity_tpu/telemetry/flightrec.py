@@ -27,9 +27,11 @@ from collections import deque
 
 __all__ = ["FlightRecorder", "args_digest", "result_digest"]
 
-#: Envelope fields never folded into a digest: secrets, and fields that
-#: vary per attempt without changing what the request MEANS.
-_DIGEST_EXCLUDED = ("token", "trace_id", "deadline")
+#: Envelope fields never folded into a digest: secrets (the shared
+#: ``token`` AND the per-tenant ``tenant_token`` — a per-tenant secret
+#: is still a secret), and fields that vary per attempt without
+#: changing what the request MEANS.
+_DIGEST_EXCLUDED = ("token", "tenant_token", "trace_id", "deadline")
 
 _DIGEST_HEX = 16  # 64 bits of SHA-256 — plenty for correlation, tiny on disk
 
@@ -86,6 +88,7 @@ class FlightRecorder:
         ts: float | None = None,
         audit_ref: str | None = None,
         phases: dict | None = None,
+        tenant: str = "",
     ) -> None:
         """``audit_ref`` — the ``segment:offset`` pointer into the
         server's audit log for this same request (when auditing is on),
@@ -93,7 +96,9 @@ class FlightRecorder:
         DIR -replay-ref REF``.  ``phases`` — the request's per-phase
         latency decomposition (``{phase: ms}``, the
         :class:`~.phases.PhaseClock`'s compact form), so a slow request
-        pasted from a dump is self-explaining."""
+        pasted from a dump is self-explaining.  ``tenant`` — the DERIVED
+        tenant identity (never a token); empty when tenancy is off, and
+        then absent from the record so pre-tenancy dumps are unchanged."""
         rec = {
             "seq": 0,  # assigned under the lock
             "ts": time.time() if ts is None else ts,
@@ -105,6 +110,8 @@ class FlightRecorder:
             "status": status,
             "result_digest": result_digest,
         }
+        if tenant:
+            rec["tenant"] = tenant
         if error:
             rec["error"] = error
         if audit_ref:

@@ -88,7 +88,7 @@ _LATENCY_RE = re.compile(
 
 _ENTRY_KEYS = frozenset(
     {
-        "name", "op", "latency", "availability",
+        "name", "op", "tenant", "latency", "availability",
         "short_window_s", "long_window_s", "fast_burn",
     }
 )
@@ -105,6 +105,10 @@ class SLOSpec:
     name: str
     kind: str  # "latency" | "availability"
     op: str | None = None  # None = every op
+    #: Latency only: evaluate over ONE tenant's requests (the server's
+    #: kccap_tenant_request_latency_seconds family) instead of per op.
+    #: Use the map's names — unmapped traffic folds to "other".
+    tenant: str | None = None
     quantile: float | None = None  # latency: 0.99 for p99
     threshold_s: float | None = None  # latency objective bound
     target: float | None = None  # availability: 0.999
@@ -133,6 +137,9 @@ class SLOSpec:
             "name": self.name,
             "kind": self.kind,
             "op": self.op,
+            # Present only when set: tenantless specs keep their exact
+            # pre-tenancy wire shape.
+            **({"tenant": self.tenant} if self.tenant is not None else {}),
             "objective": self.objective,
             "budget": self.budget,
             "short_window_s": self.short_window_s,
@@ -185,6 +192,18 @@ def _parse_entry(i: int, entry) -> SLOSpec:
     op = entry.get("op")
     if op is not None and (not isinstance(op, str) or not op):
         raise SLOError(f"slo {name!r}: 'op' must be a non-empty string")
+    tenant = entry.get("tenant")
+    if tenant is not None and (not isinstance(tenant, str) or not tenant):
+        raise SLOError(
+            f"slo {name!r}: 'tenant' must be a non-empty string"
+        )
+    if tenant is not None and op is not None:
+        # Per-tenant latency reads the tenant-labeled family, which has
+        # no op dimension — the combination would silently mean "ignore
+        # op", so it errors instead.
+        raise SLOError(
+            f"slo {name!r}: 'tenant' and 'op' are mutually exclusive"
+        )
     has_latency = "latency" in entry
     has_avail = "availability" in entry
     if has_latency == has_avail:
@@ -231,8 +250,15 @@ def _parse_entry(i: int, entry) -> SLOSpec:
         if threshold_s <= 0:
             raise SLOError(f"slo {name!r}: latency bound must be > 0")
         return SLOSpec(
-            name=name, kind="latency", op=op, quantile=q,
+            name=name, kind="latency", op=op, tenant=tenant, quantile=q,
             threshold_s=threshold_s, **windows,
+        )
+    if tenant is not None:
+        # Availability is op-scoped (errors carry an op, not a tenant);
+        # per-tenant availability would need a tenant-labeled error
+        # family this server does not keep (bounded cardinality).
+        raise SLOError(
+            f"slo {name!r}: 'tenant' is only valid on latency objectives"
         )
     target = _parse_fraction(name, "availability", entry["availability"])
     return SLOSpec(name=name, kind="availability", op=op, target=target,
@@ -401,9 +427,23 @@ def registry_source(registry):
 
     def read(spec: SLOSpec) -> tuple[int, int]:
         if spec.kind == "latency":
+            fam = lat
+            if spec.tenant is not None:
+                # Created idempotently with the server's exact
+                # declaration; lazily, so tenantless deployments never
+                # grow the family in their registry snapshot.
+                fam = registry.histogram(
+                    "kccap_tenant_request_latency_seconds",
+                    "End-to-end dispatch latency, by tenant (bounded "
+                    "cardinality; feeds per-tenant SLO specs).",
+                    ("tenant",),
+                )
             total = bad = 0
-            for key, child in lat._items():
-                if spec.op is not None and key[0] != spec.op:
+            for key, child in fam._items():
+                if spec.tenant is not None:
+                    if key[0] != spec.tenant:
+                        continue
+                elif spec.op is not None and key[0] != spec.op:
                     continue
                 total += child.count
                 bad += _hist_bad_count(child, spec.threshold_s)

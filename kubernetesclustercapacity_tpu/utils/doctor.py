@@ -364,6 +364,58 @@ def doctor_report(
 
         check("capacity service", _service)
 
+        # Multi-tenancy: is a tenant map armed, how many tenants, who
+        # is being shed.  A server without -tenants reports a soft
+        # "off" line (single-tenant deployments are the default, not a
+        # failure).  Separate connection for the usual isolation reason.
+        def _tenancy():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                info = c.info(tenancy=True)
+            caps = info.get("capabilities") or {}
+            ten = info.get("tenancy")
+            if not caps.get("tenancy") or not isinstance(ten, dict):
+                return "off (no -tenants map; single-tenant admission)"
+            # info's "tenants" key carries TenantMap.to_wire(), which
+            # nests the spec list under its own "tenants" key.
+            tmap = ten.get("tenants") or {}
+            specs = tmap.get("tenants") or [] if isinstance(
+                tmap, dict
+            ) else tmap
+            parts = [f"ok: {len(specs)} tenant(s)"]
+            adm = ten.get("admission")
+            if isinstance(adm, dict):
+                active = adm.get("active") or {}
+                shed = adm.get("shed") or {}
+                if active:
+                    parts.append(
+                        "active="
+                        + ",".join(
+                            f"{t}:{n}" for t, n in sorted(active.items())
+                        )
+                    )
+                total_shed = sum(shed.values()) if shed else 0
+                parts.append(f"tenant_shed={total_shed}")
+                fq = adm.get("fair_queue")
+                if isinstance(fq, dict):
+                    parts.append(
+                        f"fair_queue={fq.get('free')}/{fq.get('slots')} free"
+                        f" waiting={fq.get('waiting')}"
+                    )
+            return " ".join(parts)
+
+        check("tenancy", _tenancy)
+
         # The service's capacity timeline: generation history + watch
         # alert states — the "did capacity drift while nobody looked"
         # line.  Same short budgets; separate connection so a timeline

@@ -182,6 +182,37 @@ def test_scan_finds_the_sanitizer_families():
     )
 
 
+def test_scan_finds_the_tenancy_families():
+    """Non-vacuous pin for the multi-tenancy tier: the walk must see
+    every kccap_tenant_* family plus the batcher's tenant-spread
+    histogram (so the README-documentation and snake_case gates below
+    actually cover them), and each must have a literal backticked
+    README row — the bare `kccap_*` glob in prose does NOT count as
+    documentation here, so this pin is stricter than the generic
+    gate."""
+    names = _source_metric_names()
+    ten = {n for n in names if n.startswith("kccap_tenant_")}
+    assert {
+        "kccap_tenant_admitted_total",
+        "kccap_tenant_shed_total",
+        "kccap_tenant_queue_depth",
+        "kccap_tenant_requests_total",
+        "kccap_tenant_request_latency_seconds",
+    } <= ten
+    assert "kccap_batch_tenants" in names
+    with open(_README, encoding="utf-8") as fh:
+        readme = fh.read()
+    undocumented = sorted(
+        n
+        for n in ten | {"kccap_batch_tenants"}
+        if f"`{n}`" not in readme
+    )
+    assert not undocumented, (
+        "tenancy metrics missing a literal row in the README "
+        f"observability table: {undocumented}"
+    )
+
+
 def test_metric_names_are_prefixed_snake_case():
     bad = sorted(
         n for n in _source_metric_names() if not _SNAKE_RE.fullmatch(n)
@@ -246,6 +277,8 @@ def test_env_scan_finds_the_known_switches():
     assert {"KCCAP_OPT_ITERS", "KCCAP_OPT_TOL"} <= {
         n for n in names if n.startswith("KCCAP_OPT")
     }
+    # The tenancy kill switch (and README-gated below).
+    assert "KCCAP_TENANCY" in names
 
 
 def test_every_env_var_is_documented_in_readme():

@@ -808,7 +808,7 @@ class TestHandshake:
             # ...and the handshake advertises the new families.
             assert info["capabilities"] == {
                 "protocol": 2, "plane": False, "admission": False,
-                "drain": True,
+                "drain": True, "tenancy": False,
             }
             sock.close()
         finally:
