@@ -2826,6 +2826,155 @@ def _run() -> None:
                         ladder["gang_1m_error"] = (
                             f"{type(e).__name__}: {e}"
                         )
+
+                # --- capacity forecasting + planning on the grouped
+                # 1M-node fixture: the horizon axis folds into the
+                # scenario axis, so a 32-step x 64-sample projection is
+                # ONE grouped launch of 2048 scenarios.  Parity is
+                # gated vs the pure numpy seed-replay oracle over the
+                # FULL ungrouped 1M rows at a reduced horizon (the
+                # dispatch path is H-invariant; a full H=32 numpy
+                # replay would dwarf the bench budget): per-step totals
+                # element-for-element, every ladder, and every
+                # time-to-breach.  The plan row times the certified
+                # catalog purchase end to end (including its own
+                # cannot-lie numpy certification); an uncertified plan
+                # voids the timing, never the status field.  Own try: a
+                # forecast failure must not void the rows above.
+                # KCC_BENCH_FORECAST=0 skips; KCC_BENCH_FORECAST_STEPS
+                # sizes the timed horizon.
+                if diffs == 0 and os.environ.get(
+                    "KCC_BENCH_FORECAST", "1"
+                ) != "0":
+                    try:
+                        from kubernetesclustercapacity_tpu.forecast import (
+                            horizon_oracle as _fc_oracle,
+                            parse_catalog as _fc_catalog,
+                            plan_capacity as _fc_plan,
+                            project_horizon as _fc_eval,
+                        )
+                        from kubernetesclustercapacity_tpu.stochastic.distributions import (  # noqa: E501
+                            StochasticSpec as _FcSpec,
+                            UsageDistribution as _FcDist,
+                        )
+
+                        fc_spec = _FcSpec(
+                            cpu=_FcDist(
+                                kind="normal", mean=500.0, std=150.0
+                            ),
+                            memory=_FcDist(
+                                kind="lognormal",
+                                mean=float(1 << 30),
+                                sigma=0.4,
+                            ),
+                            replicas=n1m,
+                            samples=64,
+                            seed=13,
+                        )
+                        fc_kw = dict(
+                            step_s=3600.0,
+                            growth_cpu_per_s=1e-5,
+                            growth_mem_per_s=0.0,
+                            mode="reference",
+                            node_mask=None,
+                        )
+                        fc_par = _fc_eval(
+                            snap1m, fc_spec, steps=4, **fc_kw
+                        )
+                        fc_want = _fc_oracle(
+                            snap1m, fc_spec, steps=4, **fc_kw
+                        )
+                        fc_diffs = int(
+                            (fc_par.totals != fc_want.totals).sum()
+                        )
+                        for q, lad in fc_par.quantiles.items():
+                            fc_diffs += int(
+                                (lad != fc_want.quantiles[q]).sum()
+                            )
+                        fc_diffs += sum(
+                            fc_par.time_to_breach_s[q]
+                            != fc_want.time_to_breach_s[q]
+                            for q in fc_par.time_to_breach_s
+                        )
+                        ladder["forecast_parity_diffs"] = fc_diffs
+                        if fc_diffs == 0:
+                            fc_steps = max(2, int(os.environ.get(
+                                "KCC_BENCH_FORECAST_STEPS", 32
+                            )))
+                            _fc_eval(  # warm: compile + devcache
+                                snap1m, fc_spec, steps=fc_steps, **fc_kw
+                            )
+                            best_fc = None
+                            for _ in range(3):
+                                t0 = time.perf_counter()
+                                _fc_eval(
+                                    snap1m, fc_spec,
+                                    steps=fc_steps, **fc_kw
+                                )
+                                dt = time.perf_counter() - t0
+                                best_fc = (
+                                    dt
+                                    if best_fc is None
+                                    else min(best_fc, dt)
+                                )
+                            ladder["forecast_1m_steps"] = fc_steps
+                            ladder["forecast_1m_scenarios"] = (
+                                fc_steps * 64
+                            )
+                            ladder["forecast_1m_horizon_ms"] = round(
+                                best_fc * 1e3, 3
+                            )
+                            # The planner: cheapest certified purchase
+                            # restoring today's p95 + 5000 replicas,
+                            # from a two-shape catalog.
+                            fc_catalog = _fc_catalog([
+                                {
+                                    "name": "small", "cpu": "8",
+                                    "memory": "32gb", "pods": 110,
+                                    "unit_cost": 2.0,
+                                },
+                                {
+                                    "name": "big", "cpu": "32",
+                                    "memory": "128gb", "pods": 250,
+                                    "unit_cost": 7.0,
+                                },
+                            ])
+                            fc_target = (
+                                int(fc_par.quantiles[0.95][0]) + 5_000
+                            )
+                            plan_1m = _fc_plan(
+                                snap1m, fc_spec, fc_catalog,
+                                target=fc_target, quantile=0.95,
+                                mode="reference",
+                            )
+                            ladder["plan_certified"] = int(
+                                plan_1m.certified
+                            )
+                            if plan_1m.certified:
+                                best_plan = None
+                                for _ in range(3):
+                                    t0 = time.perf_counter()
+                                    _fc_plan(
+                                        snap1m, fc_spec, fc_catalog,
+                                        target=fc_target,
+                                        quantile=0.95,
+                                        mode="reference",
+                                    )
+                                    dt = time.perf_counter() - t0
+                                    best_plan = (
+                                        dt
+                                        if best_plan is None
+                                        else min(best_plan, dt)
+                                    )
+                                ladder["plan_1m_ms"] = round(
+                                    best_plan * 1e3, 3
+                                )
+                        # mismatch voids the timings, never the parity
+                        # or certification fields.
+                    except Exception as e:  # noqa: BLE001 - best-effort row
+                        ladder["forecast_1m_error"] = (
+                            f"{type(e).__name__}: {e}"
+                        )
             del snap1m
         except Exception as e:  # noqa: BLE001 - scale entry is best-effort
             ladder["nodes_1m_error"] = f"{type(e).__name__}: {e}"

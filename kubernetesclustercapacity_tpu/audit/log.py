@@ -96,6 +96,21 @@ _VOLATILE_RESULT_FIELDS_BY_OP = {
             "verified", "groups", "grouping_engaged",
         }
     ),
+    # The forecast's integer ladders and time-to-breach are exact
+    # order statistics over exact integer sweeps — they stay in the
+    # digest; only the wall-time measurement is volatile.
+    "forecast": frozenset({"eval_ms"}),
+    # The catalog plan keeps its INTEGER answer (buy counts, projected
+    # capacity, satisfiability) in the digest; float solver artifacts
+    # (bounds, prices, costs, the certificate verdict) replay host-
+    # dependent exactly like the optimize op's.
+    "plan": frozenset(
+        {
+            "lp_bound", "gap_pct", "shadow_prices", "demand_price",
+            "total_cost", "status", "certified", "uncertified_reason",
+            "eval_ms", "drain",
+        }
+    ),
 }
 
 _DIGEST_HEX = 16  # matches flightrec/timeline truncation

@@ -41,7 +41,15 @@ __all__ = ["Replayer", "replay_shadow_bundle"]
 #: closed-form integer packing answer (rounded/FFD totals, demand,
 #: schedulability) — every float solver artifact is per-op
 #: canonical-stripped, so a TPU-recorded solve verifies on a CPU.
-_REPLAYABLE = frozenset({"sweep", "explain", "fit", "gang", "optimize"})
+#: ``forecast`` qualifies because growth rates ride the request args
+#: explicitly (the server refuses to fit trends; that happens client-
+#: side from the audit log itself) — the projection is a pure, seeded
+#: function of the reconstructed snapshot.  ``plan`` (the catalog
+#: form) likewise: the per-op canonical digest keeps only the integer
+#: purchase answer, stripping the float bounds/prices/certificates.
+_REPLAYABLE = frozenset(
+    {"sweep", "explain", "fit", "gang", "optimize", "forecast", "plan"}
+)
 
 #: fit/sweep args that pull in raw fixture objects or columns outside
 #: the audit vocabulary — present means "recorded, not replayable".
@@ -110,6 +118,21 @@ class Replayer:
             return (
                 "gang watch-status form reads the live timeline, "
                 "not the snapshot"
+            )
+        if op == "forecast" and "usage" not in args:
+            # Same split as gang: the status form is timeline state.
+            return (
+                "forecast watch-status form reads the live timeline, "
+                "not the snapshot"
+            )
+        if op == "plan" and "catalog" not in args:
+            # The legacy node_template form consumes the capacity
+            # model's fixture view, which the audit vocabulary does
+            # not carry; only the catalog form is a pure snapshot
+            # function.
+            return (
+                "plan node_template form reads the capacity model, "
+                "not the snapshot alone"
             )
         blocked = sorted(_FIXTURE_ARGS & set(args))
         if blocked:

@@ -178,6 +178,38 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="with -car-spec: override the spec's sampling "
                         "seed (explicit seeds make every run replayable)")
+    p.add_argument("-forecast", default=None, metavar="HOST:PORT",
+                   help="render a running capacity service's forecast "
+                        "status (per horizon watch: current capacity at "
+                        "its quantile, projected horizon minimum, "
+                        "time-to-breach, alert state) and exit; -output "
+                        "json selects the structured form; exit 1 while "
+                        "any horizon watch is breached (or none are "
+                        "configured)")
+    p.add_argument("-forecast-spec", default="", dest="forecast_spec",
+                   metavar="FILE",
+                   help="offline capacity forecast: load a stochastic "
+                        "usage spec extended with a horizon block "
+                        "(steps, step_s) and either explicit growth "
+                        "rates (growth: cpu_per_s/memory_per_s) or an "
+                        "audit_dir to fit them from verified history, "
+                        "then project the quantile ladder over the "
+                        "horizon against the -snapshot source; exit 1 "
+                        "when any projected quantile crosses the "
+                        "threshold within the horizon")
+    p.add_argument("-plan", default="", dest="plan_spec", metavar="FILE",
+                   help="offline certified capacity plan: load a "
+                        "stochastic usage spec (plus optional target, "
+                        "quantile, drain fields) and answer 'cheapest "
+                        "node set from -catalog that restores the "
+                        "quantile to the target' for the -snapshot "
+                        "source, with an LP lower bound and host-side "
+                        "certification; exit 1 unless the plan is "
+                        "certified")
+    p.add_argument("-catalog", default="", metavar="FILE",
+                   help="with -plan: the node-shape catalog (YAML/JSON: "
+                        "shapes with name, cpu, memory, pods, "
+                        "unit_cost, max_count)")
     p.add_argument("-gang", default=None, metavar="HOST:PORT",
                    help="render a running capacity service's gang-watch "
                         "status (per gang watch: last whole-gang count, "
@@ -350,6 +382,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.car:
         return _run_car_status(args)
 
+    if args.forecast:
+        return _run_forecast_status(args)
+
     if args.gang:
         return _run_gang_status(args)
 
@@ -426,6 +461,8 @@ def main(argv: list[str] | None = None) -> int:
             mode = (
                 "drain" if args.drain else
                 "car" if args.car_spec else
+                "forecast" if args.forecast_spec else
+                "plan" if args.plan_spec else
                 "gang" if args.gang_spec else
                 "optimize" if args.optimize else
                 "explain" if args.explain else
@@ -494,6 +531,10 @@ def _run_command(args) -> int:
 
     if args.car_spec:
         return _run_car_spec(args, snapshot)
+    if args.forecast_spec:
+        return _run_forecast_spec(args, snapshot)
+    if args.plan_spec:
+        return _run_plan(args, snapshot)
     if args.gang_spec:
         return _run_gang_spec(args, snapshot)
     if args.optimize:
@@ -648,6 +689,263 @@ def _run_car_spec(args, snapshot) -> int:
     else:
         print(car_table_report(result.to_wire()))
     return 0 if result.schedulable else 1
+
+
+def _run_forecast_status(args) -> int:
+    """-forecast HOST:PORT: fetch and render a service's forecast
+    (horizon) watch status.  Exits by the verdict, like -car: a
+    breached horizon watch — "the p95 capacity crosses the threshold
+    within the horizon" — is a scriptable failure, and so is a server
+    with no horizon watches at all."""
+    from kubernetesclustercapacity_tpu.report import (
+        forecast_status_json_report,
+        forecast_status_table_report,
+    )
+
+    addr = _parse_addr("-forecast", args.forecast)
+    if addr is None:
+        return 1
+    try:
+        with _diag_client(addr) as c:
+            result = c.forecast()
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot fetch forecast status from "
+              f"{addr[0]}:{addr[1]}: {e}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(forecast_status_json_report(result))
+    else:
+        print(forecast_status_table_report(result))
+    if not result.get("enabled", False):
+        return 1
+    return 1 if result.get("breached") else 0
+
+
+def _load_operator_doc(path: str):
+    """YAML-when-PyYAML-else-strict-JSON — the same loader split every
+    operator file (watchlist, stochastic spec, catalog) uses."""
+    import json as _json
+
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        import yaml  # type: ignore[import-untyped]
+
+        data = yaml.safe_load(text)
+    except ImportError:
+        try:
+            data = _json.loads(text)
+        except ValueError as e:
+            raise ValueError(
+                f"{path}: not valid JSON (and PyYAML is unavailable): {e}"
+            ) from e
+    except Exception as e:  # yaml.YAMLError — malformed document
+        raise ValueError(f"{path}: cannot parse: {e}") from e
+    return data
+
+
+def _run_forecast_spec(args, snapshot) -> int:
+    """-forecast-spec FILE: offline horizon projection against the
+    -snapshot source.
+
+    The file extends the stochastic usage-spec grammar with a
+    ``horizon:`` block (steps, step_s), an optional ``threshold``, and
+    growth provenance: either explicit ``growth: {cpu_per_s,
+    memory_per_s}`` relative rates or ``audit_dir:`` pointing at a
+    kccap-server audit log, in which case the trend is Theil–Sen
+    fitted from the digest-verified generations.  Exits 1 when any
+    projected quantile crosses the threshold within the horizon."""
+    from kubernetesclustercapacity_tpu.forecast import (
+        DEFAULT_STEP_S,
+        DEFAULT_STEPS,
+        max_steps,
+        project_horizon,
+        trend_from_audit,
+    )
+    from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+    from kubernetesclustercapacity_tpu.report import (
+        forecast_json_report,
+        forecast_table_report,
+    )
+    from kubernetesclustercapacity_tpu.stochastic import (
+        DistributionError,
+        InsufficientHistoryError,
+        parse_stochastic_spec,
+    )
+
+    if args.backend != "tpu":
+        print("ERROR : -forecast-spec runs on the JAX kernels (-backend "
+              "tpu); cpu/native backends are fit-only cross-checks "
+              "...exiting")
+        return 1
+    try:
+        doc = _load_operator_doc(args.forecast_spec)
+    except (OSError, ValueError) as e:
+        print(f"ERROR : bad -forecast-spec: {e}")
+        return 1
+    if not isinstance(doc, dict):
+        print("ERROR : bad -forecast-spec: expected a mapping")
+        return 1
+    doc = dict(doc)
+    horizon = doc.pop("horizon", None) or {}
+    growth = doc.pop("growth", None)
+    audit_dir = doc.pop("audit_dir", None)
+    threshold = doc.pop("threshold", None)
+    quantiles = doc.pop("quantiles", None)
+    try:
+        spec = parse_stochastic_spec(doc)
+        if not isinstance(horizon, dict) or not set(horizon) <= {
+            "steps", "step_s"
+        }:
+            raise ValueError(
+                "horizon: wants a mapping with steps and/or step_s"
+            )
+        steps = horizon.get("steps", DEFAULT_STEPS)
+        step_s = horizon.get("step_s", DEFAULT_STEP_S)
+        if (growth is None) == (audit_dir is None):
+            raise ValueError(
+                "exactly one of growth: {cpu_per_s, memory_per_s} or "
+                "audit_dir: is required"
+            )
+        if threshold is not None and (
+            isinstance(threshold, bool) or not isinstance(threshold, int)
+        ):
+            raise ValueError(f"threshold: expected an int, got {threshold!r}")
+        if quantiles is not None:
+            if not isinstance(quantiles, list) or not quantiles:
+                raise ValueError("quantiles: expected a non-empty list")
+            quantiles = tuple(float(q) for q in quantiles)
+    except (DistributionError, ValueError, TypeError) as e:
+        print(f"ERROR : bad -forecast-spec: {e}")
+        return 1
+
+    trend_wire = {}
+    degraded = False
+    if audit_dir is not None:
+        try:
+            fit_cpu, series = trend_from_audit(audit_dir, "cpu", "usage")
+            fit_mem, _ = trend_from_audit(audit_dir, "memory", "usage")
+        except (OSError, InsufficientHistoryError, ValueError) as e:
+            print(f"ERROR : cannot fit trend from {audit_dir}: {e}")
+            return 1
+        growth_cpu = max(fit_cpu.relative_slope_per_s, 0.0)
+        growth_mem = max(fit_mem.relative_slope_per_s, 0.0)
+        degraded = series.degraded_time_axis
+        trend_wire = {
+            "source": str(audit_dir),
+            "cpu": fit_cpu.to_wire(),
+            "memory": fit_mem.to_wire(),
+        }
+    else:
+        if not isinstance(growth, dict) or not set(growth) <= {
+            "cpu_per_s", "memory_per_s"
+        }:
+            print("ERROR : bad -forecast-spec: growth wants cpu_per_s "
+                  "and/or memory_per_s")
+            return 1
+        try:
+            growth_cpu = float(growth.get("cpu_per_s", 0.0))
+            growth_mem = float(growth.get("memory_per_s", 0.0))
+        except (TypeError, ValueError):
+            print("ERROR : bad -forecast-spec: growth rates must be numbers")
+            return 1
+    try:
+        result = project_horizon(
+            snapshot, spec,
+            steps=int(steps), step_s=float(step_s),
+            growth_cpu_per_s=growth_cpu, growth_mem_per_s=growth_mem,
+            mode=args.semantics or snapshot.semantics,
+            node_mask=implicit_taint_mask(snapshot),
+            **({"quantiles": quantiles} if quantiles else {}),
+            threshold=threshold,
+            degraded_time_axis=degraded,
+        )
+    except (DistributionError, ValueError, TypeError) as e:
+        print(f"ERROR : {e} (steps must stay within "
+              f"KCCAP_FORECAST_MAX_STEPS={max_steps()})")
+        return 1
+    result.trend = trend_wire
+    wire = result.to_wire()
+    if args.output == "json":
+        print(forecast_json_report(wire))
+    else:
+        print(forecast_table_report(wire))
+    return 1 if wire["breached_within_horizon"] else 0
+
+
+def _run_plan(args, snapshot) -> int:
+    """-plan FILE -catalog FILE: offline certified capacity planning
+    against the -snapshot source.
+
+    The plan file is the stochastic usage-spec grammar plus optional
+    ``target`` (replicas to restore, default the spec's), ``quantile``
+    (default 0.95) and ``drain: true`` (also compute the scale-down
+    dual).  Exits 0 only when the plan is certified — an uncertified
+    answer is a scriptable failure, exactly like -optimize."""
+    from kubernetesclustercapacity_tpu.forecast import (
+        PlannerError,
+        load_catalog,
+        plan_capacity,
+    )
+    from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+    from kubernetesclustercapacity_tpu.report import (
+        plan_json_report,
+        plan_table_report,
+    )
+    from kubernetesclustercapacity_tpu.stochastic import (
+        DistributionError,
+        parse_stochastic_spec,
+    )
+
+    if args.backend != "tpu":
+        print("ERROR : -plan runs on the JAX kernels (-backend tpu); "
+              "cpu/native backends are fit-only cross-checks ...exiting")
+        return 1
+    if not args.catalog:
+        print("ERROR : -plan needs -catalog FILE (the node-shape "
+              "catalog to buy from) ...exiting")
+        return 1
+    try:
+        catalog = load_catalog(args.catalog)
+    except (OSError, PlannerError) as e:
+        print(f"ERROR : bad -catalog: {e}")
+        return 1
+    try:
+        doc = _load_operator_doc(args.plan_spec)
+    except (OSError, ValueError) as e:
+        print(f"ERROR : bad -plan: {e}")
+        return 1
+    if not isinstance(doc, dict):
+        print("ERROR : bad -plan: expected a mapping")
+        return 1
+    doc = dict(doc)
+    target = doc.pop("target", None)
+    quantile = doc.pop("quantile", 0.95)
+    drain = doc.pop("drain", False)
+    try:
+        spec = parse_stochastic_spec(doc)
+        if target is not None and (
+            isinstance(target, bool) or not isinstance(target, int)
+        ):
+            raise ValueError(f"target: expected an int, got {target!r}")
+        if not isinstance(drain, bool):
+            raise ValueError(f"drain: expected a bool, got {drain!r}")
+        result = plan_capacity(
+            snapshot, spec, catalog,
+            target=target, quantile=float(quantile),
+            mode=args.semantics or snapshot.semantics,
+            node_mask=implicit_taint_mask(snapshot),
+            drain=drain,
+        )
+    except (DistributionError, PlannerError, ValueError, TypeError) as e:
+        print(f"ERROR : bad -plan: {e}")
+        return 1
+    wire = result.to_wire()
+    if args.output == "json":
+        print(plan_json_report(wire))
+    else:
+        print(plan_table_report(wire))
+    return 0 if result.certified else 1
 
 
 def _run_gang_status(args) -> int:

@@ -1,0 +1,44 @@
+"""Capacity forecasting & autoscaler planning (ROADMAP follow-on to the
+stochastic engine): time-to-breach and certified "what to buy", derived
+from verified history.
+
+Three layers, each pinned against an independent oracle:
+
+* :mod:`.trend` — robust Theil–Sen demand/supply trends replayed from
+  the audit log's digest-verified generations (timestamps from the
+  records, never the wall clock — the same history always fits the same
+  trend);
+* :mod:`.horizon` — the trend composed with the counter-based sampler:
+  P50/P95/P99 capacity projected over an ``[H]``-step horizon as ONE
+  batched ``[H×S]`` sweep dispatch through the production kernel path,
+  reduced host-side to ``time_to_breach_s`` per quantile;
+* :mod:`.planner` — the LP-duality answer to "cheapest node set that
+  restores P95 headroom" over a declarative shape catalog, plus the
+  scale-down dual ("which nodes drain for free"), with cannot-lie
+  host-side certification: a plan is ``certified`` or explicitly not,
+  never silently wrong.
+"""
+
+from kubernetesclustercapacity_tpu.forecast.horizon import (  # noqa: F401
+    DEFAULT_STEP_S,
+    DEFAULT_STEPS,
+    HorizonResult,
+    horizon_oracle,
+    max_steps,
+    project_horizon,
+)
+from kubernetesclustercapacity_tpu.forecast.planner import (  # noqa: F401
+    CatalogShape,
+    PlannerError,
+    PlanResult,
+    apply_plan,
+    load_catalog,
+    parse_catalog,
+    plan_capacity,
+)
+from kubernetesclustercapacity_tpu.forecast.trend import (  # noqa: F401
+    TrendFit,
+    fit_trend,
+    trend_from_audit,
+    trend_oracle,
+)

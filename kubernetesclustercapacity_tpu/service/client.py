@@ -61,7 +61,7 @@ IDEMPOTENT_OPS = frozenset(
     {
         "ping", "info", "fit", "sweep", "sweep_multi", "place", "drain",
         "topology_spread", "plan", "explain", "car", "gang", "optimize",
-        "dump", "timeline", "slo", "drain_server",
+        "forecast", "dump", "timeline", "slo", "drain_server",
         # Federation ops are pure reads over the federation tier's held
         # snapshots — a retry re-reads the fleet view, which may have
         # advanced; acceptable for the same reason dump/timeline are.
@@ -493,9 +493,28 @@ class CapacityClient:
         """Capacity under a maxSkew topology spread constraint."""
         return self.call("topology_spread", topology_key=topology_key, **flags)
 
-    def plan(self, node_template: dict, **flags) -> dict:
-        """Scale-up plan: nodes of this shape needed to fit the spec."""
-        return self.call("plan", node_template=node_template, **flags)
+    def plan(
+        self,
+        node_template: dict | None = None,
+        *,
+        catalog=None,
+        **flags,
+    ) -> dict:
+        """Scale-up plan.  With ``catalog`` (a node-shape list/mapping
+        plus ``usage`` and optional ``target``/``quantile``/``drain``),
+        runs the certified shape planner — cheapest catalog purchase
+        restoring the quantile capacity, with LP bound and cannot-lie
+        certification.  With ``node_template``, the legacy homogeneous
+        ``nodes_needed`` count.  Exactly one of the two is required."""
+        if (node_template is None) == (catalog is None):
+            raise TypeError(
+                "plan() wants exactly one of node_template= or catalog="
+            )
+        if catalog is not None:
+            flags["catalog"] = catalog
+        else:
+            flags["node_template"] = node_template
+        return self.call("plan", **flags)
 
     def explain(self, **flags) -> dict:
         """Why the fit stops where it does: binding constraint per node,
@@ -515,6 +534,21 @@ class CapacityClient:
         if usage is not None:
             params["usage"] = usage
         return self.call("car", **params)
+
+    def forecast(self, usage: dict | None = None, **params) -> dict:
+        """Capacity forecast.  With ``usage`` (the capacity-at-risk
+        distribution block) plus ``steps``/``step_s`` and an explicit
+        ``growth={"cpu_per_s": ..., "memory_per_s": ...}`` relative-
+        rate block, projects the capacity quantiles over the horizon
+        and returns per-step ladders plus ``time_to_breach_s`` —
+        seed-deterministic and a pure function of the served snapshot,
+        so transport retries (and audit replays) re-answer
+        identically.  Without ``usage``, returns the server's forecast-
+        watch status (projected minima, time to breach, alert
+        states)."""
+        if usage is not None:
+            params["usage"] = usage
+        return self.call("forecast", **params)
 
     def gang(self, ranks: int | None = None, **params) -> dict:
         """Gang capacity.  With ``ranks`` (plus the six per-rank flag

@@ -586,7 +586,7 @@ class CapacityServer:
         {
             "fit", "sweep", "sweep_multi", "place", "drain",
             "topology_spread", "plan", "explain", "car", "gang",
-            "optimize", "update", "reload",
+            "optimize", "forecast", "update", "reload",
         }
     )
 
@@ -689,8 +689,8 @@ class CapacityServer:
         {
             "ping", "info", "fit", "sweep", "sweep_multi", "place",
             "drain", "topology_spread", "plan", "explain", "car",
-            "gang", "optimize", "dump", "timeline", "slo", "reload",
-            "update", "drain_server",
+            "gang", "optimize", "forecast", "dump", "timeline", "slo",
+            "reload", "update", "drain_server",
         }
     )
 
@@ -702,7 +702,7 @@ class CapacityServer:
         {
             "fit", "sweep", "sweep_multi", "place", "drain",
             "topology_spread", "plan", "explain", "car", "gang",
-            "optimize",
+            "optimize", "forecast",
         }
     )
 
@@ -1191,11 +1191,13 @@ class CapacityServer:
         if op == "topology_spread":
             return self._op_topology_spread(msg, snap, fixture)
         if op == "plan":
-            return self._op_plan(msg, snap, fixture)
+            return self._op_plan(msg, snap, fixture, implicit_mask)
         if op == "explain":
             return self._op_explain(msg, snap, implicit_mask)
         if op == "car":
             return self._op_car(msg, snap, implicit_mask)
+        if op == "forecast":
+            return self._op_forecast(msg, snap, implicit_mask)
         if op == "gang":
             return self._op_gang(msg, snap, implicit_mask)
         if op == "optimize":
@@ -1626,13 +1628,32 @@ class CapacityServer:
         }
 
     def _op_plan(
-        self, msg: dict, snap: ClusterSnapshot, fixture: dict | None
+        self,
+        msg: dict,
+        snap: ClusterSnapshot,
+        fixture: dict | None,
+        implicit_mask=None,
     ) -> dict:
-        """Scale-up planning — :meth:`CapacityModel.nodes_needed` over
-        the wire (``nodes_needed`` is null when unsatisfiable)."""
+        """Scale-up planning over the wire, two forms:
+
+        * **catalog** (``catalog`` present): the certified planner —
+          :func:`~..forecast.planner.plan_capacity` over a declarative
+          node-shape catalog, answering "cheapest node set restoring
+          the quantile capacity to ``target``" with the LP lower
+          bound, cannot-lie certification, shadow prices, and (with
+          ``drain: true``) the scale-down dual;
+        * **node_template** (legacy): homogeneous
+          :meth:`CapacityModel.nodes_needed` (``nodes_needed`` is null
+          when unsatisfiable).
+        """
+        if "catalog" in msg:
+            return self._op_plan_catalog(msg, snap, implicit_mask)
         template = msg.get("node_template")
         if not isinstance(template, dict):
-            raise ValueError("plan wants a node_template object")
+            raise ValueError(
+                "plan wants a node_template object (or a 'catalog' "
+                "for the certified shape planner)"
+            )
         scenario = self._scenario_from_msg(msg)
         spec = self._spec_from_msg(msg, scenario)
         try:
@@ -1647,6 +1668,80 @@ class CapacityServer:
             "nodes_needed": plan.nodes_needed,
             "satisfiable": plan.satisfiable,
         }
+
+    def _op_plan_catalog(
+        self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
+    ) -> dict:
+        """The catalog form of the ``plan`` op: a stochastic usage spec
+        plus a node-shape catalog → the certified cheapest purchase.
+        The served semantics and implicit strict-mode taint mask apply
+        exactly as they do to ``car``, so the plan restores the same
+        capacity those ops report."""
+        from kubernetesclustercapacity_tpu.forecast.planner import (
+            PlannerError,
+            parse_catalog,
+            plan_capacity,
+        )
+        from kubernetesclustercapacity_tpu.stochastic.distributions import (
+            DistributionError,
+            parse_stochastic_spec,
+        )
+
+        if "usage" not in msg:
+            raise ValueError(
+                "plan with a catalog wants a 'usage' distribution "
+                "block (the demand the purchase must hold)"
+            )
+        data = {"usage": msg["usage"]}
+        for field in ("replicas", "samples", "seed", "confidence"):
+            if field in msg:
+                data[field] = msg[field]
+        try:
+            spec = parse_stochastic_spec(data)
+            catalog = parse_catalog(msg["catalog"])
+        except (DistributionError, PlannerError) as e:
+            raise ValueError(str(e)) from e
+        target = msg.get("target")
+        if target is not None and (
+            isinstance(target, bool) or not isinstance(target, int)
+        ):
+            raise ValueError("plan target must be an integer")
+        quantile = msg.get("quantile", 0.95)
+        if isinstance(quantile, bool) or not isinstance(
+            quantile, (int, float)
+        ):
+            raise ValueError("plan quantile must be a number in (0, 1)")
+        drain = msg.get("drain", False)
+        if not isinstance(drain, bool):
+            raise ValueError("plan drain must be a boolean")
+        mask = implicit_mask
+        try:
+            result = plan_capacity(
+                snap,
+                spec,
+                catalog,
+                target=target,
+                quantile=float(quantile),
+                mode=snap.semantics,
+                node_mask=mask,
+                drain=drain,
+            )
+        except PlannerError as e:
+            raise ValueError(str(e)) from e
+        out = result.to_wire()
+        output = msg.get("output")
+        if output in ("table", "json"):
+            from kubernetesclustercapacity_tpu.report import (
+                plan_json_report,
+                plan_table_report,
+            )
+
+            out["report"] = (
+                plan_table_report(out)
+                if output == "table"
+                else plan_json_report(out)
+            )
+        return out
 
     def _op_explain(
         self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
@@ -1776,6 +1871,129 @@ class CapacityServer:
             )
         if clk:
             clk.record("serialize", _time.perf_counter() - t0)
+        return out
+
+    def _op_forecast(
+        self, msg: dict, snap: ClusterSnapshot, implicit_mask=None
+    ) -> dict:
+        """Capacity forecasting over the wire, two forms:
+
+        * **evaluate** (``usage`` present): the capacity-at-risk spec
+          plus a projection — ``steps``/``step_s`` and an EXPLICIT
+          ``growth`` block (``{cpu_per_s, memory_per_s}`` relative
+          rates) — answered with per-step capacity quantile ladders and
+          ``time_to_breach_s``.  Growth is explicit by design: the op
+          stays a pure function of the served snapshot, so an audited
+          forecast re-answers identically on ``kccap -replay`` (trend
+          FITTING from history lives client-side in
+          :func:`~..forecast.trend.trend_from_audit`, where the audit
+          log is);
+        * **watch status** (no ``usage``): the forecast slice of the
+          timeline — per horizon watch the projected minimum, time to
+          breach, and alert state (what ``kccap -forecast HOST:PORT``
+          renders and exits by).
+        """
+        from kubernetesclustercapacity_tpu.forecast.horizon import (
+            DEFAULT_STEP_S,
+            DEFAULT_STEPS,
+            project_horizon,
+        )
+        from kubernetesclustercapacity_tpu.stochastic.distributions import (
+            DistributionError,
+            parse_stochastic_spec,
+        )
+
+        if "usage" not in msg:
+            tl = self._timeline
+            watches = tl.forecast_status() if tl is not None else {}
+            if not watches:
+                return {"enabled": False, "watches": {}, "breached": []}
+            return {
+                "enabled": True,
+                "generation": self.generation,
+                "watches": watches,
+                "breached": tl.forecast_breached(),
+            }
+        data = {"usage": msg["usage"]}
+        for field in ("replicas", "samples", "seed", "confidence"):
+            if field in msg:
+                data[field] = msg[field]
+        try:
+            spec = parse_stochastic_spec(data)
+        except DistributionError as e:
+            raise ValueError(str(e)) from e
+        steps = msg.get("steps", DEFAULT_STEPS)
+        if isinstance(steps, bool) or not isinstance(steps, int):
+            raise ValueError("forecast steps must be an integer")
+        step_s = msg.get("step_s", DEFAULT_STEP_S)
+        if isinstance(step_s, bool) or not isinstance(step_s, (int, float)):
+            raise ValueError("forecast step_s must be a number")
+        growth = msg.get("growth", {})
+        if not isinstance(growth, dict):
+            raise ValueError(
+                "forecast growth must be an object like "
+                '{"cpu_per_s": 1e-6, "memory_per_s": 0}'
+            )
+        unknown = set(growth) - {"cpu_per_s", "memory_per_s"}
+        if unknown:
+            raise ValueError(
+                f"unknown growth field(s) {sorted(unknown)} "
+                "(want cpu_per_s/memory_per_s)"
+            )
+        rates = {}
+        for key in ("cpu_per_s", "memory_per_s"):
+            v = growth.get(key, 0.0)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(f"growth.{key} must be a number")
+            rates[key] = float(v)
+        threshold = msg.get("threshold")
+        if threshold is not None and (
+            isinstance(threshold, bool) or not isinstance(threshold, int)
+        ):
+            raise ValueError("forecast threshold must be an integer")
+        quantiles = msg.get("quantiles")
+        if quantiles is not None:
+            if not isinstance(quantiles, list) or not quantiles:
+                raise ValueError("quantiles must be a non-empty list")
+            for q in quantiles:
+                if (
+                    isinstance(q, bool)
+                    or not isinstance(q, (int, float))
+                    or not 0.0 < float(q) < 1.0
+                ):
+                    raise ValueError(
+                        f"quantiles must lie strictly inside (0, 1), "
+                        f"got {q!r}"
+                    )
+            quantiles = tuple(float(q) for q in quantiles)
+        try:
+            result = project_horizon(
+                snap,
+                spec,
+                steps=steps,
+                step_s=float(step_s),
+                growth_cpu_per_s=rates["cpu_per_s"],
+                growth_mem_per_s=rates["memory_per_s"],
+                mode=snap.semantics,
+                node_mask=implicit_mask,
+                **({"quantiles": quantiles} if quantiles else {}),
+                threshold=threshold,
+            )
+        except ValueError as e:
+            raise ValueError(f"bad forecast request: {e}") from e
+        out = result.to_wire()
+        output = msg.get("output")
+        if output in ("table", "json"):
+            from kubernetesclustercapacity_tpu.report import (
+                forecast_json_report,
+                forecast_table_report,
+            )
+
+            out["report"] = (
+                forecast_table_report(out)
+                if output == "table"
+                else forecast_json_report(out)
+            )
         return out
 
     def _op_gang(
@@ -3057,6 +3275,12 @@ def main(argv=None) -> int:
                 # A breached gang watch is the all-or-nothing analog of
                 # a CaR breach: "fewer than N whole gangs fit" is a
                 # promise the serving tier can no longer make.
+                return False
+            if timeline is not None and timeline.forecast_breached():
+                # A breached forecast watch says the projected quantile
+                # capacity crosses the threshold INSIDE the horizon —
+                # the whole value of forecasting is flipping health
+                # BEFORE the outage, while a purchase can still land.
                 return False
             if subscriber is not None and subscriber.stale:
                 return False

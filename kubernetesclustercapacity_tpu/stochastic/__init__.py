@@ -41,6 +41,8 @@ from kubernetesclustercapacity_tpu.stochastic.distributions import (  # noqa: F4
 )
 from kubernetesclustercapacity_tpu.stochastic.history import (  # noqa: F401
     InsufficientHistoryError,
+    SeriesHistory,
     UsageHistory,
+    extract_series,
     extract_usage_history,
 )

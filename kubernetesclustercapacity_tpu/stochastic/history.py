@@ -33,11 +33,29 @@ from kubernetesclustercapacity_tpu.stochastic.distributions import (
 
 __all__ = [
     "InsufficientHistoryError",
+    "SeriesHistory",
     "UsageHistory",
+    "extract_series",
     "extract_usage_history",
 ]
 
 _RESOURCES = ("cpu", "memory")
+
+#: Series kinds :func:`extract_series` can walk out of the audit log:
+#: ``usage`` is the demand side (cluster-wide requested totals), and
+#: ``allocatable`` the supply side (what the fleet could hold) — a trend
+#: fit needs both, because "when do we run out" is a question about the
+#: gap, not either line alone.
+_SERIES_KINDS = ("usage", "allocatable")
+
+_SERIES_FIELDS = {
+    ("cpu", "usage"): "used_cpu_req_milli",
+    ("memory", "usage"): "used_mem_req_bytes",
+    ("cpu", "allocatable"): "alloc_cpu_milli",
+    ("memory", "allocatable"): "alloc_mem_bytes",
+    ("pods", "usage"): "pods_count",
+    ("pods", "allocatable"): "alloc_pods",
+}
 
 
 class InsufficientHistoryError(RuntimeError):
@@ -171,4 +189,107 @@ def extract_usage_history(
         weights=weights,
         observations=observations,
         generations=contributing,
+    )
+
+
+@dataclass(frozen=True)
+class SeriesHistory:
+    """A per-generation cluster-wide total as a time series.
+
+    ``ts`` is the time axis in seconds (the generation records' own
+    wall-clock stamps — never re-sampled at load time, so the same audit
+    directory always yields the same series); ``totals`` the cluster-wide
+    sum of the selected column per generation, as float64 (sums of int64
+    columns can exceed the int64 range on wrapped carriers — the trend
+    fit is statistical, not bit-exact arithmetic).
+
+    ``degraded_time_axis`` is True when the recorded timestamps were
+    unusable (non-monotone, missing, or zero-span): the series falls
+    back to RECORD ORDER (``ts = 0, 1, 2, ...``) rather than crashing or
+    silently mis-ordering — a trend fitted on a degraded axis is still a
+    trend per *generation*, just not per second, and every downstream
+    surface carries the flag.
+    """
+
+    resource: str
+    kind: str
+    ts: np.ndarray  # [T] float64 seconds
+    totals: np.ndarray  # [T] float64 cluster-wide totals
+    generations: np.ndarray  # [T] int64 generation numbers
+    degraded_time_axis: bool
+
+    def to_wire(self) -> dict:
+        return {
+            "resource": self.resource,
+            "kind": self.kind,
+            "points": int(self.ts.shape[0]),
+            "span_s": float(self.ts[-1] - self.ts[0])
+            if self.ts.shape[0]
+            else 0.0,
+            "degraded_time_axis": self.degraded_time_axis,
+        }
+
+
+def extract_series(
+    source,
+    resource: str = "cpu",
+    kind: str = "usage",
+    *,
+    min_points: int = 2,
+) -> SeriesHistory:
+    """Walk an audit log into a per-generation total time series.
+
+    ``resource`` is ``cpu``/``memory``/``pods``; ``kind`` selects the
+    demand column (``usage``: the ``used_*`` requested totals) or the
+    supply column (``allocatable``).  Every generation reconstructs
+    through the digest-verified replay path; totals are summed with
+    Python ints (no int64 overflow on wrapped carriers) and returned as
+    float64.
+
+    Timestamps are verified monotone non-decreasing with a positive
+    span; otherwise the series degrades to record order with
+    ``degraded_time_axis=True`` (see :class:`SeriesHistory`).  Raises
+    :class:`InsufficientHistoryError` with what WAS found when fewer
+    than ``min_points`` generations exist.
+    """
+    field_name = _SERIES_FIELDS.get((resource, kind))
+    if field_name is None:
+        raise ValueError(
+            f"unknown series ({resource!r}, {kind!r}); resource must be "
+            "cpu/memory/pods and kind one of "
+            f"{_SERIES_KINDS}"
+        )
+    reader = _load_reader(source)
+    gens = reader.generations()
+    if len(gens) < max(min_points, 1):
+        raise InsufficientHistoryError(
+            f"only {len(gens)} generation record(s); a series needs "
+            f">= {min_points}",
+            generations=len(gens),
+        )
+    ts: list[float] = []
+    totals: list[float] = []
+    numbers: list[int] = []
+    for rec in gens:
+        snap = reader.snapshot_at(rec["generation"])
+        col = np.asarray(getattr(snap, field_name), dtype=np.int64)
+        totals.append(float(sum(int(v) for v in col)))
+        raw_ts = rec.get("ts")
+        ts.append(float(raw_ts) if isinstance(raw_ts, (int, float)) else -1.0)
+        numbers.append(int(rec["generation"]))
+    axis = np.asarray(ts, dtype=np.float64)
+    degraded = bool(
+        np.any(axis < 0)
+        or np.any(np.diff(axis) < 0)
+        or axis[-1] <= axis[0]
+    )
+    if degraded:
+        axis = np.arange(len(ts), dtype=np.float64)
+    return SeriesHistory(
+        resource=resource,
+        kind=kind,
+        ts=axis,
+        totals=np.asarray(totals, dtype=np.float64),
+        generations=np.asarray(numbers, dtype=np.int64),
+        degraded_time_axis=degraded,
     )

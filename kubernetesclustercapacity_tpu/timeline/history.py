@@ -158,6 +158,17 @@ class WatchResult:
     gang_count: int = 0
     gang_binding: str | None = None
     gang_summary: str = ""
+    #: Forecast watch fields (``horizon_s`` non-None marks one):
+    #: ``total`` stays the NOW (h=0) quantile capacity, while
+    #: ``horizon_min_capacity`` is the minimum projected capacity
+    #: across the horizon (what the alert machine thresholds) and
+    #: ``time_to_breach_s`` the projected seconds until the quantile
+    #: first crosses the threshold — ``None`` when the trend is flat
+    #: or the ring's history is insufficient to fit one.
+    horizon_s: float | None = None
+    time_to_breach_s: float | None = None
+    horizon_min_capacity: int | None = None
+    degraded_time_axis: bool = False
 
     def to_wire(self) -> dict:
         out = {
@@ -179,6 +190,11 @@ class WatchResult:
                 "binding": self.gang_binding,
                 "summary": self.gang_summary,
             }
+        if self.horizon_s is not None:
+            out["horizon_s"] = self.horizon_s
+            out["time_to_breach_s"] = self.time_to_breach_s
+            out["horizon_min_capacity"] = self.horizon_min_capacity
+            out["degraded_time_axis"] = self.degraded_time_axis
         return out
 
 
@@ -260,11 +276,21 @@ class CapacityTimeline:
         self._alerts = {
             w.name: WatchAlert(w.name, w.min_replicas) for w in self.watches
         }
+        #: Names of the forecast (horizon) watches — quantile watches
+        #: that project forward; they report under the
+        #: ``kccap_forecast_*`` family, NOT the CaR one (each watch
+        #: belongs to exactly one alert funnel).
+        self._horizon_names = frozenset(
+            w.name for w in self.watches if w.horizon_steps is not None
+        )
         #: Names of the capacity-at-risk (quantile) watches — the slice
         #: whose breaches additionally flip ``/healthz`` and the
         #: ``kccap_car_*`` gauges.
-        self._car_names = frozenset(
-            w.name for w in self.watches if w.quantile is not None
+        self._car_names = (
+            frozenset(
+                w.name for w in self.watches if w.quantile is not None
+            )
+            - self._horizon_names
         )
         #: Names of the gang watches — the slice whose breaches (like
         #: the CaR slice's) flip ``/healthz`` and the ``kccap_gang_*``
@@ -369,6 +395,41 @@ class CapacityTimeline:
                         ),
                     }
                 )
+            if self._horizon_names:
+                # The forecast family, registered only when a horizon
+                # watch exists (same conditional-shape policy as the
+                # CaR and gang families above).
+                self._m.update(
+                    {
+                        "forecast_capacity": registry.gauge(
+                            "kccap_forecast_capacity",
+                            "Minimum projected quantile capacity "
+                            "across the watch's forecast horizon.",
+                            ("watch",),
+                        ),
+                        "forecast_ttb": registry.gauge(
+                            "kccap_forecast_time_to_breach_seconds",
+                            "Projected seconds until the quantile "
+                            "capacity first crosses the watch "
+                            "threshold (-1 = no breach inside the "
+                            "horizon, or no usable trend).",
+                            ("watch",),
+                        ),
+                        "forecast_alert_state": registry.gauge(
+                            "kccap_forecast_alert_state",
+                            "Forecast watch alert state "
+                            "(0=ok, 1=recovered, 2=breached).",
+                            ("watch",),
+                        ),
+                        "forecast_eval": registry.histogram(
+                            "kccap_forecast_eval_seconds",
+                            "Wall time of one forecast watch "
+                            "evaluation (trend fit + one batched "
+                            "horizon sweep).",
+                            ("watch",),
+                        ),
+                    }
+                )
 
     # -- observation -------------------------------------------------------
     def observe(
@@ -432,10 +493,22 @@ class CapacityTimeline:
                         continue
                     if spec.gang is not None:
                         r = self._evaluate_gang(snapshot, spec, mode, mask)
+                    elif spec.horizon_steps is not None:
+                        r = self._evaluate_horizon_locked(
+                            snapshot, spec, mode, mask, record
+                        )
                     else:
                         r = self._evaluate_car(snapshot, spec, mode, mask)
                     alert = self._alerts[spec.name]
-                    transition = alert.update(r.total, record.generation)
+                    # A forecast watch alerts on the horizon MINIMUM —
+                    # "will breach" is the point of a forecast; plain
+                    # watches alert on the evaluated total as before.
+                    alert_total = (
+                        r.horizon_min_capacity
+                        if r.horizon_min_capacity is not None
+                        else r.total
+                    )
+                    transition = alert.update(alert_total, record.generation)
                     if transition is not None:
                         transitions.append((transition, alert))
                     record.watches[spec.name] = r
@@ -500,6 +573,135 @@ class CapacityTimeline:
             prob_fit=res.prob_fit,
             samples=res.n_samples,
             car_eval_ms=res.eval_ms,
+        )
+
+    def _evaluate_horizon_locked(
+        self,
+        snapshot: ClusterSnapshot,
+        spec: WatchSpec,
+        mode: str,
+        mask,
+        record: GenerationRecord,
+    ) -> WatchResult:
+        """One forecast watch against one generation.
+
+        Fits a Theil–Sen demand trend over the timeline's OWN ring
+        (the records' observation stamps — never the wall clock at fit
+        time, so re-observing the same history re-fits the same trend),
+        then projects the watch's usage samples along it as ONE batched
+        ``[H×S]`` sweep.  ``total`` stays the h=0 quantile capacity;
+        the alert machine thresholds the horizon MINIMUM, and
+        ``time_to_breach_s`` says when.  With fewer than 3 ring records
+        or a flat/shrinking trend the watch degrades to a plain
+        capacity-at-risk evaluation with ``time_to_breach_s = None`` —
+        explicitly no forecast, never a fabricated one.
+        """
+        from kubernetesclustercapacity_tpu.forecast.horizon import (
+            project_horizon,
+        )
+        from kubernetesclustercapacity_tpu.forecast.trend import fit_trend
+        from kubernetesclustercapacity_tpu.stochastic.distributions import (
+            StochasticSpec,
+        )
+        from kubernetesclustercapacity_tpu.stochastic.history import (
+            InsufficientHistoryError,
+        )
+
+        horizon_s = (spec.horizon_steps - 1) * spec.horizon_step_s
+        # The ring has not been appended yet — the series is the ring
+        # plus the generation under observation.  Summary rows follow
+        # diff.NODE_FIELDS order: index 3 = used_cpu_req_milli,
+        # index 4 = used_mem_req_bytes.
+        recs = list(self._ring) + [record]
+        growth_cpu = growth_mem = 0.0
+        degraded = False
+        fitted = False
+        if len(recs) >= 3:
+            axis = np.asarray([r.ts for r in recs], dtype=np.float64)
+            degraded = bool(
+                np.any(np.diff(axis) < 0) or axis[-1] <= axis[0]
+            )
+            if degraded:
+                axis = np.arange(len(recs), dtype=np.float64)
+            cpu_tot = [
+                float(sum(row[3] for row in r.summary.values()))
+                for r in recs
+            ]
+            mem_tot = [
+                float(sum(row[4] for row in r.summary.values()))
+                for r in recs
+            ]
+            try:
+                fit_cpu = fit_trend(
+                    axis, cpu_tot, degraded_time_axis=degraded
+                )
+                fit_mem = fit_trend(
+                    axis, mem_tot, degraded_time_axis=degraded
+                )
+                growth_cpu = max(fit_cpu.relative_slope_per_s, 0.0)
+                growth_mem = max(fit_mem.relative_slope_per_s, 0.0)
+                fitted = True
+            except (InsufficientHistoryError, ValueError):
+                fitted = False
+        if not fitted or (growth_cpu == 0.0 and growth_mem == 0.0):
+            # No trend (or a flat/shrinking one): the honest forecast
+            # is "no projected breach" — a plain CaR evaluation with an
+            # explicit null time-to-breach.
+            r = self._evaluate_car(snapshot, spec, mode, mask)
+            r.horizon_s = horizon_s
+            r.time_to_breach_s = None
+            r.horizon_min_capacity = None
+            r.degraded_time_axis = degraded
+            return r
+        s_spec = StochasticSpec(
+            cpu=spec.usage_cpu,
+            memory=spec.usage_mem,
+            replicas=spec.scenario.replicas,
+            samples=spec.samples,
+            seed=spec.seed,
+        )
+        threshold = (
+            spec.min_replicas
+            if spec.min_replicas is not None
+            else spec.scenario.replicas
+        )
+        hr = project_horizon(
+            snapshot,
+            s_spec,
+            steps=spec.horizon_steps,
+            step_s=spec.horizon_step_s,
+            growth_cpu_per_s=growth_cpu,
+            growth_mem_per_s=growth_mem,
+            mode=mode,
+            node_mask=mask,
+            quantiles=(spec.quantile,),
+            threshold=threshold,
+            degraded_time_axis=degraded,
+        )
+        total = int(hr.quantiles[spec.quantile][0])
+        min_cap = hr.min_capacity(spec.quantile)
+        # Node-granular fits/bindings come from the pod-level explain of
+        # the watch's own scenario (the gang-watch convention) so delta
+        # attribution works unchanged.
+        grid = ScenarioGrid.from_scenarios([spec.scenario])
+        ex = explain_snapshot(snapshot, grid, mode=mode, node_mask=mask)
+        return WatchResult(
+            name=spec.name,
+            mode=mode,
+            total=total,
+            schedulable=total >= spec.scenario.replicas,
+            breached=min_cap < (spec.min_replicas or 0),
+            min_replicas=spec.min_replicas,
+            binding_counts=ex.binding_counts(0),
+            fits=np.asarray(ex.fits[0], dtype=np.int64),
+            quantile=spec.quantile,
+            prob_fit=None,
+            samples=hr.n_samples,
+            car_eval_ms=hr.eval_ms,
+            horizon_s=horizon_s,
+            time_to_breach_s=hr.time_to_breach_s[spec.quantile],
+            horizon_min_capacity=min_cap,
+            degraded_time_axis=degraded,
         )
 
     def _evaluate_gang(
@@ -568,7 +770,11 @@ class CapacityTimeline:
                 m["gang_alert_state"].labels(watch=spec.name).set(
                     self._alerts[spec.name].state_code
                 )
-            if spec.quantile is not None and "car_replicas" in m:
+            if (
+                spec.quantile is not None
+                and spec.horizon_steps is None
+                and "car_replicas" in m
+            ):
                 m["car_replicas"].labels(watch=spec.name).set(r.total)
                 if r.prob_fit is not None:
                     m["car_prob_fit"].labels(watch=spec.name).set(
@@ -578,6 +784,23 @@ class CapacityTimeline:
                     self._alerts[spec.name].state_code
                 )
                 m["car_eval"].labels(watch=spec.name).observe(
+                    r.car_eval_ms / 1e3
+                )
+            if spec.horizon_steps is not None and "forecast_capacity" in m:
+                m["forecast_capacity"].labels(watch=spec.name).set(
+                    r.horizon_min_capacity
+                    if r.horizon_min_capacity is not None
+                    else r.total
+                )
+                m["forecast_ttb"].labels(watch=spec.name).set(
+                    round(r.time_to_breach_s, 3)
+                    if r.time_to_breach_s is not None
+                    else -1
+                )
+                m["forecast_alert_state"].labels(watch=spec.name).set(
+                    self._alerts[spec.name].state_code
+                )
+                m["forecast_eval"].labels(watch=spec.name).observe(
                     r.car_eval_ms / 1e3
                 )
             before = (
@@ -798,6 +1021,55 @@ class CapacityTimeline:
                 if n in self._gang_names and a.state == "breached"
             )
 
+    def forecast_breached(self) -> list[str]:
+        """Forecast watches currently breached — the slice of alert
+        state that flips ``/healthz`` to 503 (like :meth:`car_breached`:
+        a breached forecast says the projected quantile capacity
+        crosses the threshold INSIDE the horizon — the one alert whose
+        whole value is arriving before the outage does)."""
+        if not self._horizon_names:
+            return []
+        with self._lock:
+            return sorted(
+                n
+                for n, a in self._alerts.items()
+                if n in self._horizon_names and a.state == "breached"
+            )
+
+    def forecast_status(self) -> dict:
+        """Per-forecast-watch status (the ``forecast`` op's watch view /
+        the doctor's "capacity forecast" line): last h=0 and horizon-
+        minimum quantile capacities, time to breach, alert state."""
+        with self._lock:
+            last = self._ring[-1] if self._ring else None
+            out: dict[str, dict] = {}
+            for spec in self.watches:
+                if spec.horizon_steps is None:
+                    continue
+                r = last.watches.get(spec.name) if last else None
+                out[spec.name] = {
+                    "quantile": spec.quantile,
+                    "min_replicas": spec.min_replicas,
+                    "steps": spec.horizon_steps,
+                    "step_s": spec.horizon_step_s,
+                    "horizon_s": (spec.horizon_steps - 1)
+                    * spec.horizon_step_s,
+                    "last_total": r.total if r else None,
+                    "horizon_min_capacity": (
+                        r.horizon_min_capacity if r else None
+                    ),
+                    "time_to_breach_s": (
+                        r.time_to_breach_s if r else None
+                    ),
+                    "degraded_time_axis": (
+                        r.degraded_time_axis if r else False
+                    ),
+                    "samples": r.samples if r else 0,
+                    "seed": spec.seed,
+                    "alert": self._alerts[spec.name].to_wire(),
+                }
+            return out
+
     def gang_status(self) -> dict:
         """Per-gang-watch status (the ``gang`` op's watch view / the
         doctor's "gang capacity" line): last whole-gang count, the
@@ -829,7 +1101,9 @@ class CapacityTimeline:
             last = self._ring[-1] if self._ring else None
             out: dict[str, dict] = {}
             for spec in self.watches:
-                if spec.quantile is None:
+                if spec.quantile is None or spec.horizon_steps is not None:
+                    # Horizon watches report under forecast_status —
+                    # each watch belongs to exactly one funnel.
                     continue
                 r = last.watches.get(spec.name) if last else None
                 out[spec.name] = {
@@ -879,6 +1153,13 @@ class CapacityTimeline:
                 n
                 for n, s in alerts.items()
                 if n in self._gang_names and s == "breached"
+            )
+        if self._horizon_names:
+            # And the forecast slice only when horizon watches exist.
+            out["forecast_breached"] = sorted(
+                n
+                for n, s in alerts.items()
+                if n in self._horizon_names and s == "breached"
             )
         return out
 

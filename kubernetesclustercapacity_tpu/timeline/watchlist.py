@@ -63,6 +63,30 @@ The block parses through :func:`~..topology.gang.parse_gang_block`
 ``gang`` and ``quantile`` are mutually exclusive — a stochastic gang
 watch would need a semantics nobody has defined, so it is rejected,
 not guessed.
+
+**Forecast (horizon) watches**: a ``horizon`` block turns a
+capacity-at-risk watch predictive — "alert when the P95 capacity is
+forecast to cross ``min_replicas`` anywhere inside the horizon"::
+
+    watches:
+      - name: web-p95-weekly
+        pod: {cpuRequests: 500m, memRequests: 1gb, replicas: "40"}
+        quantile: 0.95
+        usage:
+          cpu: {dist: normal, mean: 500m, std: 150m}
+        horizon:
+          steps: 24             # projection steps (default 16)
+          step_s: 3600          # seconds per step (default 3600)
+        min_replicas: 30
+
+The timeline fits a Theil–Sen demand trend over its OWN generation
+ring (record timestamps, never the wall clock), projects the watch's
+usage samples along it, and breaches on the MINIMUM projected quantile
+capacity across the horizon — surfacing ``time_to_breach_s`` on the
+watch result.  ``horizon`` requires ``quantile`` and is mutually
+exclusive with ``gang``; unlike a plain capacity-at-risk watch,
+all-point usage IS allowed here (growth scaling makes even a point
+vary across the horizon).
 """
 
 from __future__ import annotations
@@ -121,6 +145,11 @@ class WatchSpec:
     #: (a :class:`~..topology.gang.GangSpec`); ``min_replicas`` then
     #: thresholds GANGS, not pods.
     gang: object | None = None
+    #: Forecast watch: project the quantile capacity ``horizon_steps``
+    #: steps of ``horizon_step_s`` seconds ahead along the timeline's
+    #: fitted demand trend; breach on the horizon MINIMUM.
+    horizon_steps: int | None = None
+    horizon_step_s: float = 3600.0
 
     def to_wire(self) -> dict:
         """JSON-able description (rides the ``timeline`` op)."""
@@ -141,6 +170,11 @@ class WatchSpec:
             out["usage"] = {
                 "cpu": self.usage_cpu.to_wire(),
                 "memory": self.usage_mem.to_wire(),
+            }
+        if self.horizon_steps is not None:
+            out["horizon"] = {
+                "steps": self.horizon_steps,
+                "step_s": self.horizon_step_s,
             }
         return out
 
@@ -186,7 +220,7 @@ def _parse_entry(i: int, entry) -> WatchSpec:
             )
     extra = set(entry) - {
         "name", "pod", "semantics", "min_replicas",
-        "quantile", "usage", "samples", "seed", "gang",
+        "quantile", "usage", "samples", "seed", "gang", "horizon",
     }
     if extra:
         raise WatchError(
@@ -205,28 +239,92 @@ def _parse_entry(i: int, entry) -> WatchSpec:
                 "exclusive (stochastic gang capacity is undefined — "
                 "pick one)"
             )
+        if "horizon" in entry:
+            raise WatchError(
+                f"watch {name!r}: 'gang' and 'horizon' are mutually "
+                "exclusive (a forecast projects usage quantiles, not "
+                "gang packings — pick one)"
+            )
         try:
             gang = parse_gang_block(entry["gang"])
         except GangSpecError as e:
             raise WatchError(f"watch {name!r}: {e}") from e
+    horizon_steps, horizon_step_s = _parse_horizon_block(name, entry)
     quantile, usage_cpu, usage_mem, samples, seed = _parse_stochastic_fields(
-        name, entry, scenario
+        name, entry, scenario, has_horizon=horizon_steps is not None
     )
     return WatchSpec(
         name=name, scenario=scenario, mode=mode, min_replicas=min_replicas,
         quantile=quantile, usage_cpu=usage_cpu, usage_mem=usage_mem,
         samples=samples, seed=seed, gang=gang,
+        horizon_steps=horizon_steps, horizon_step_s=horizon_step_s,
     )
 
 
-def _parse_stochastic_fields(name: str, entry: dict, scenario: Scenario):
+def _parse_horizon_block(name: str, entry: dict) -> tuple[int | None, float]:
+    """The forecast grammar of one watch entry: ``horizon`` with
+    optional ``steps``/``step_s``.  Requires ``quantile`` (a forecast
+    projects a quantile, not a point fit); bounds come from
+    :func:`~..forecast.horizon.max_steps` so a watchlist cannot smuggle
+    in a sweep the server would refuse as a one-shot op."""
+    if "horizon" not in entry:
+        return None, 3600.0
+    if "quantile" not in entry:
+        raise WatchError(
+            f"watch {name!r}: 'horizon' requires a 'quantile' — a "
+            "forecast projects a capacity quantile over time"
+        )
+    block = entry["horizon"]
+    if block is None:
+        block = {}
+    if not isinstance(block, dict):
+        raise WatchError(
+            f"watch {name!r}: 'horizon' must be a mapping, got {block!r}"
+        )
+    unknown = set(block) - {"steps", "step_s"}
+    if unknown:
+        raise WatchError(
+            f"watch {name!r}: unknown horizon field(s) {sorted(unknown)} "
+            "(want steps/step_s)"
+        )
+    from kubernetesclustercapacity_tpu.forecast.horizon import (
+        DEFAULT_STEPS,
+        max_steps,
+    )
+
+    steps = block.get("steps", DEFAULT_STEPS)
+    if isinstance(steps, bool) or not isinstance(steps, int):
+        raise WatchError(f"watch {name!r}: horizon.steps must be an integer")
+    cap = max_steps()
+    if not 1 <= steps <= cap:
+        raise WatchError(
+            f"watch {name!r}: horizon.steps must be in [1, {cap}], "
+            f"got {steps}"
+        )
+    step_s = block.get("step_s", 3600.0)
+    if isinstance(step_s, bool) or not isinstance(step_s, (int, float)):
+        raise WatchError(f"watch {name!r}: horizon.step_s must be a number")
+    step_s = float(step_s)
+    if not step_s > 0.0:
+        raise WatchError(
+            f"watch {name!r}: horizon.step_s must be > 0, got {step_s:g}"
+        )
+    return steps, step_s
+
+
+def _parse_stochastic_fields(
+    name: str, entry: dict, scenario: Scenario, *, has_horizon: bool = False
+):
     """The capacity-at-risk grammar of one watch entry: ``quantile``
     (strictly inside (0, 1)), ``usage`` distributions (missing
     resources default to a point at the pod's own request), ``samples``
     and ``seed``.  Hard rejections — quantile without usage, usage
     without quantile, out-of-range quantiles, all-point usage — each
     with an error naming the watch, so a typo'd watch never silently
-    evaluates as something else."""
+    evaluates as something else.  A ``horizon`` watch relaxes the
+    usage requirements: growth scaling makes even a point distribution
+    vary across the projection, so all-point (or absent) usage is
+    meaningful there."""
     quantile = entry.get("quantile")
     usage = entry.get("usage")
     if quantile is None:
@@ -248,12 +346,14 @@ def _parse_stochastic_fields(name: str, entry: dict, scenario: Scenario):
             f"watch {name!r}: quantile must be strictly inside (0, 1), "
             f"got {quantile:g}"
         )
-    if usage is None:
+    if usage is None and not has_horizon:
         raise WatchError(
             f"watch {name!r}: quantile needs a 'usage' distribution "
             "block — a point-request watch has no usage uncertainty, so "
             "every quantile would equal the plain fit"
         )
+    if usage is None:
+        usage = {}
     if not isinstance(usage, dict):
         raise WatchError(f"watch {name!r}: 'usage' must be a mapping")
     extra = set(usage) - {"cpu", "memory"}
@@ -284,7 +384,7 @@ def _parse_stochastic_fields(name: str, entry: dict, scenario: Scenario):
         )
     except DistributionError as e:
         raise WatchError(f"watch {name!r}: {e}") from e
-    if usage_cpu.degenerate and usage_mem.degenerate:
+    if usage_cpu.degenerate and usage_mem.degenerate and not has_horizon:
         raise WatchError(
             f"watch {name!r}: every usage distribution is a point — the "
             f"P{quantile * 100:g} capacity would always equal the plain "

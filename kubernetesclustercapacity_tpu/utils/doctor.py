@@ -541,6 +541,52 @@ def doctor_report(
 
         check("gang capacity", _gang)
 
+        # The service's forecast (horizon) watches: the projected
+        # quantile minimum over each watch's horizon and the
+        # time-to-breach.  A breached horizon watch is a hard FAILED
+        # line — "the p95 capacity crosses the threshold within the
+        # horizon" is the early-warning statement an autoscaler plans
+        # against, and it fires BEFORE the plain quantile watch does.
+        # Same short budgets; separate connection so a forecast-op
+        # failure cannot contaminate the lines above.
+        def _forecast():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                status = c.forecast()
+            if not status.get("enabled", False):
+                return "not configured (no horizon: watches in -watch)"
+            parts = []
+            for name in sorted(status.get("watches", {})):
+                w = status["watches"][name]
+                ttb = w.get("time_to_breach_s")
+                parts.append(
+                    f"{name}=p{w['quantile'] * 100:g}:"
+                    f"min{w.get('horizon_min_capacity')}"
+                    f"(ttb={'-' if ttb is None else f'{ttb:g}s'},"
+                    f"{w['alert']['state']})"
+                )
+            breached = status.get("breached", [])
+            if breached:
+                return (
+                    "FAILED: forecast breach — "
+                    + ", ".join(breached)
+                    + " projected below min_replicas within their "
+                    "horizon; " + " ".join(parts)
+                )
+            return "ok: " + " ".join(parts)
+
+        check("capacity forecast", _forecast)
+
         # The service's audit log + shadow oracle: is correctness being
         # continuously observed, and has it ever been caught lying?  A
         # recorded divergence is a hard FAILED line — it means a served

@@ -1,0 +1,389 @@
+"""The forecast layers against their independent oracles: the Theil–Sen
+trend vs a scalar-statistics comparator, the one-dispatch `[H×S]`
+horizon sweep vs the pure-numpy seed-replay oracle (both semantics, all
+four GROUPING×DEVCACHE kernel paths), and the catalog planner's
+cannot-lie certification with its LP bound and drain dual."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.audit.log import AuditLog
+from kubernetesclustercapacity_tpu.forecast import (
+    CatalogShape,
+    PlannerError,
+    apply_plan,
+    fit_trend,
+    horizon_oracle,
+    parse_catalog,
+    plan_capacity,
+    project_horizon,
+    trend_from_audit,
+    trend_oracle,
+)
+from kubernetesclustercapacity_tpu.masks import implicit_taint_mask
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+from kubernetesclustercapacity_tpu.stochastic import (
+    InsufficientHistoryError,
+    extract_series,
+    parse_stochastic_spec,
+)
+from kubernetesclustercapacity_tpu.timeline.watchlist import (
+    WatchError,
+    parse_watchlist,
+)
+
+USAGE = {
+    "cpu": {"dist": "normal", "mean": "500m", "std": "150m"},
+    "memory": {"dist": "lognormal", "mean": "1gb", "sigma": 0.4},
+}
+
+CATALOG = {
+    "shapes": [
+        {"name": "small", "cpu": "4", "memory": "16gb", "pods": 110,
+         "unit_cost": 1.0},
+        {"name": "big", "cpu": "16", "memory": "128gb", "pods": 250,
+         "unit_cost": 6.5},
+    ]
+}
+
+
+def _spec(**over):
+    doc = {
+        "usage": USAGE,
+        "replicas": 40,
+        "samples": 32,
+        "seed": 7,
+        "confidence": 0.95,
+        **over,
+    }
+    return parse_stochastic_spec(doc)
+
+
+def _fits_close(a, b):
+    assert a.n == b.n
+    assert a.slope_per_s == pytest.approx(b.slope_per_s, rel=1e-12, abs=1e-12)
+    assert a.intercept == pytest.approx(b.intercept, rel=1e-12, abs=1e-9)
+    assert a.mad == pytest.approx(b.mad, rel=1e-12, abs=1e-9)
+
+
+class TestTrendFit:
+    def test_exact_linear_series(self):
+        t = np.arange(12, dtype=np.float64) * 60.0
+        y = 100.0 + 2.5 * t
+        fit = fit_trend(t, y)
+        assert fit.slope_per_s == pytest.approx(2.5)
+        assert fit.intercept == pytest.approx(100.0)
+        assert fit.mad == pytest.approx(0.0)
+        assert fit.level == pytest.approx(y[-1])
+        assert fit.value_at(0.0) == pytest.approx(100.0)
+
+    @pytest.mark.parametrize("shape", ["flat", "linear", "step", "noisy"])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_scalar_oracle(self, shape, seed):
+        rng = np.random.default_rng(seed * 100 + hash(shape) % 97)
+        n = int(rng.integers(3, 40))
+        t = np.cumsum(rng.uniform(1.0, 120.0, size=n))
+        if shape == "flat":
+            y = np.full(n, float(rng.uniform(10, 1000)))
+        elif shape == "linear":
+            y = rng.uniform(-5, 5) * t + rng.uniform(0, 100)
+        elif shape == "step":
+            y = np.where(t > t[n // 2], 500.0, 100.0)
+        else:
+            y = 50.0 + 0.3 * t + rng.normal(0, 20, size=n)
+        _fits_close(fit_trend(t, y), trend_oracle(t, y))
+
+    def test_outlier_robustness(self):
+        # Theil–Sen shrugs off a single spiked observation that would
+        # wreck least squares — and still agrees with the oracle.
+        t = np.arange(21, dtype=np.float64) * 30.0
+        y = 10.0 + 1.0 * t
+        y[10] += 1e6
+        fit = fit_trend(t, y)
+        _fits_close(fit, trend_oracle(t, y))
+        assert fit.slope_per_s == pytest.approx(1.0, rel=0.05)
+
+    def test_insufficient_and_bad_axes(self):
+        with pytest.raises(InsufficientHistoryError):
+            fit_trend([0.0], [1.0])
+        with pytest.raises(InsufficientHistoryError):
+            fit_trend([5.0, 5.0, 5.0], [1.0, 2.0, 3.0])  # zero span
+        with pytest.raises(ValueError):
+            fit_trend([2.0, 1.0], [1.0, 2.0])  # decreasing
+        with pytest.raises(ValueError):
+            fit_trend([[0.0, 1.0]], [1.0, 2.0])  # not 1-D
+
+    def test_relative_slope_guards_nonpositive_level(self):
+        t = np.arange(4, dtype=np.float64)
+        fit = fit_trend(t, -10.0 - t)
+        assert fit.level < 0
+        assert fit.relative_slope_per_s == 0.0
+        growing = fit_trend(t, 100.0 + 10.0 * t)
+        assert growing.relative_slope_per_s == pytest.approx(
+            10.0 / growing.level
+        )
+
+
+class TestSeriesFromAudit:
+    def _audit_dir(self, tmp_path, *, ts_of=lambda g: 1000.0 + g * 60.0,
+                   gens=6):
+        d = str(tmp_path / "audit")
+        base = synthetic_snapshot(10, seed=4)
+        with AuditLog(d, checkpoint_every=3) as log:
+            for g in range(1, gens + 1):
+                snap = dataclasses.replace(
+                    base,
+                    used_cpu_req_milli=(
+                        np.asarray(base.used_cpu_req_milli) + 50 * g
+                    ).astype(np.int64),
+                )
+                log.record_generation(snap, g, ts=ts_of(g))
+        return d, base
+
+    def test_extract_series_totals_and_axis(self, tmp_path):
+        d, base = self._audit_dir(tmp_path)
+        s = extract_series(d, "cpu", "usage")
+        assert not s.degraded_time_axis
+        assert s.ts[0] == 1060.0 and s.ts[-1] == 1360.0
+        base_total = int(np.asarray(base.used_cpu_req_milli).sum())
+        expect = [base_total + 50 * 10 * g for g in range(1, 7)]
+        assert s.totals.tolist() == [float(v) for v in expect]
+        # Supply side is flat in this history.
+        alloc = extract_series(d, "cpu", "allocatable")
+        assert len(set(alloc.totals.tolist())) == 1
+
+    def test_degraded_axis_falls_back_to_record_order(self, tmp_path):
+        d, _ = self._audit_dir(tmp_path, ts_of=lambda g: 777.0)
+        s = extract_series(d, "memory", "usage")
+        assert s.degraded_time_axis
+        assert s.ts.tolist() == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+        fit, series = trend_from_audit(d, "cpu", "usage")
+        assert fit.degraded_time_axis and series.degraded_time_axis
+
+    def test_trend_from_audit_matches_direct_fit(self, tmp_path):
+        d, _ = self._audit_dir(tmp_path)
+        fit, series = trend_from_audit(d, "cpu", "usage")
+        _fits_close(fit, fit_trend(series.ts, series.totals))
+        # 50 millicores per node per generation, 10 nodes, 60 s apart.
+        assert fit.slope_per_s == pytest.approx(500.0 / 60.0)
+        assert fit.relative_slope_per_s > 0
+
+    def test_too_little_history_is_typed(self, tmp_path):
+        d, _ = self._audit_dir(tmp_path, gens=2)
+        with pytest.raises(InsufficientHistoryError):
+            trend_from_audit(d, "cpu", "usage")
+        with pytest.raises(ValueError):
+            extract_series(d, "gpu", "usage")
+
+
+class TestHorizon:
+    @pytest.mark.parametrize("mode", ["reference", "strict"])
+    def test_dispatch_matches_oracle(self, mode):
+        snap = synthetic_snapshot(24, seed=9)
+        spec = _spec(samples=24, seed=3)
+        mask = implicit_taint_mask(snap)
+        kw = dict(steps=6, step_s=1800.0, growth_cpu_per_s=2e-5,
+                  growth_mem_per_s=1e-5, mode=mode, node_mask=mask)
+        got = project_horizon(snap, spec, **kw)
+        want = horizon_oracle(snap, spec, **kw)
+        assert np.array_equal(got.totals, want.totals)
+        for q in got.quantiles:
+            assert got.quantiles[q].tolist() == want.quantiles[q].tolist()
+            assert got.time_to_breach_s[q] == want.time_to_breach_s[q]
+
+    def test_four_way_kernel_path_pin(self, monkeypatch):
+        """GROUPING×DEVCACHE on/off answer bit-identically — the
+        one-dispatch horizon grid takes every kernel path."""
+        snap = synthetic_snapshot(32, seed=5)
+        spec = _spec(samples=16, seed=8)
+        results = []
+        for grouping in ("1", "0"):
+            for devcache in ("1", "0"):
+                monkeypatch.setenv("KCCAP_GROUPING", grouping)
+                monkeypatch.setenv("KCCAP_DEVCACHE", devcache)
+                r = project_horizon(
+                    snap, spec, steps=4, step_s=600.0,
+                    growth_cpu_per_s=5e-5,
+                )
+                results.append(r.totals)
+        for other in results[1:]:
+            assert np.array_equal(results[0], other)
+
+    def test_time_to_breach_closed_form(self):
+        """Deterministic point usage on one fresh node: the breach step
+        is pure arithmetic, so ttb is checkable by hand."""
+        snap = dataclasses.replace(
+            synthetic_snapshot(1, seed=0),
+            alloc_cpu_milli=np.array([100_000], dtype=np.int64),
+            alloc_mem_bytes=np.array([1 << 50], dtype=np.int64),
+            alloc_pods=np.array([10_000], dtype=np.int64),
+            used_cpu_req_milli=np.array([0], dtype=np.int64),
+            used_mem_req_bytes=np.array([0], dtype=np.int64),
+            pods_count=np.array([0], dtype=np.int64),
+            healthy=np.array([True]),
+        )
+        spec = parse_stochastic_spec({
+            "usage": {"cpu": {"dist": "point", "value": "1000m"},
+                      "memory": {"dist": "point", "value": 1024}},
+            "replicas": 100, "samples": 4, "seed": 1,
+        })
+        # capacity(h) = 100000 // round(1000 * (1 + 0.25 * h)); the
+        # p-anything ladder is flat across samples (point usage).
+        r = project_horizon(
+            snap, spec, steps=8, step_s=900.0,
+            growth_cpu_per_s=0.25 / 900.0, threshold=67,
+        )
+        ladder = r.quantiles[0.95].tolist()
+        expect = [100_000 // round(1000 * (1 + 0.25 * h)) for h in range(8)]
+        assert ladder == expect
+        # First step with capacity < 67 is h=2 (100000//1500=66).
+        assert r.time_to_breach_s[0.95] == pytest.approx(2 * 900.0)
+        assert r.breached_within_horizon(0.95)
+        assert r.min_capacity(0.95) == min(expect)
+
+    def test_validation_and_cap(self, monkeypatch):
+        snap = synthetic_snapshot(4, seed=2)
+        spec = _spec(samples=4)
+        for bad in (0, -1, True, 1.5):
+            with pytest.raises(ValueError):
+                project_horizon(snap, spec, steps=bad)
+        with pytest.raises(ValueError):
+            project_horizon(snap, spec, steps=2, step_s=0.0)
+        monkeypatch.setenv("KCCAP_FORECAST_MAX_STEPS", "3")
+        with pytest.raises(ValueError, match="KCCAP_FORECAST_MAX_STEPS"):
+            project_horizon(snap, spec, steps=4)
+        project_horizon(snap, spec, steps=3)  # at the cap: fine
+
+    def test_wire_shape(self):
+        snap = synthetic_snapshot(8, seed=1)
+        r = project_horizon(snap, _spec(samples=8), steps=3,
+                            growth_cpu_per_s=1e-4)
+        w = r.to_wire()
+        assert w["steps"] == 3 and w["horizon_s"] == 2 * 3600.0
+        assert set(w["quantiles"]) == {"p50", "p90", "p95", "p99"}
+        for label, ladder in w["quantiles"].items():
+            assert len(ladder) == 3
+            assert w["now"][label] == ladder[0]
+        assert set(w["breached_within_horizon"]) <= set(w["quantiles"])
+
+
+class TestPlanner:
+    def test_catalog_grammar(self):
+        shapes = parse_catalog(CATALOG)
+        assert [s.name for s in shapes] == ["small", "big"]
+        assert shapes[0].cpu_milli == 4000
+        assert parse_catalog(CATALOG["shapes"]) == shapes  # bare list
+        with pytest.raises(PlannerError, match="duplicate"):
+            parse_catalog([CATALOG["shapes"][0]] * 2)
+        with pytest.raises(PlannerError):
+            parse_catalog([{**CATALOG["shapes"][0], "bogus": 1}])
+        with pytest.raises(PlannerError):
+            parse_catalog([{**CATALOG["shapes"][0], "unit_cost": 0}])
+        with pytest.raises(PlannerError):
+            parse_catalog([{**CATALOG["shapes"][0], "cpu": "4x"}])
+
+    def test_certified_plan_restores_target(self):
+        snap = synthetic_snapshot(20, seed=6)
+        spec = _spec(replicas=300, samples=32, seed=11)
+        catalog = parse_catalog(CATALOG)
+        res = plan_capacity(snap, spec, catalog, target=300, quantile=0.9)
+        assert res.certified and res.status == "certified"
+        assert res.projected_quantile_capacity >= 300
+        assert res.lp_bound <= res.total_cost + 1e-9
+        assert res.satisfiable
+        # Apply the purchase: the grown cluster needs nothing more.
+        grown = apply_plan(snap, catalog, res.buy)
+        assert grown.n_nodes == snap.n_nodes + sum(res.buy.values())
+        again = plan_capacity(grown, spec, catalog, target=300, quantile=0.9)
+        assert again.certified and sum(again.buy.values()) == 0
+        assert again.base_quantile_capacity >= 300
+
+    def test_unsatisfiable_is_never_certified(self):
+        snap = synthetic_snapshot(4, seed=3)
+        tiny = (CatalogShape(name="t", cpu_milli=1000,
+                             mem_bytes=1 << 30, pods=4, unit_cost=1.0,
+                             max_count=2),)
+        res = plan_capacity(snap, _spec(replicas=10 ** 6), tiny,
+                            target=10 ** 6)
+        assert not res.satisfiable
+        assert not res.certified
+        assert res.status == "uncertified"
+        assert res.uncertified_reason
+
+    def test_drain_dual_is_verified(self):
+        snap = synthetic_snapshot(30, seed=12)
+        spec = _spec(replicas=50, samples=24, seed=5)
+        res = plan_capacity(snap, spec, parse_catalog(CATALOG),
+                            target=50, drain=True)
+        d = res.drain
+        assert d is not None and d["evaluated"]
+        assert d["free_verified"] is True
+        assert d["quantile_after_drain"] >= min(
+            50, res.base_quantile_capacity
+        )
+        assert d["free_count"] + d["surplus_count"] <= snap.n_nodes
+
+    def test_apply_plan_appends_fresh_nodes(self):
+        snap = synthetic_snapshot(3, seed=1)
+        catalog = parse_catalog(CATALOG)
+        grown = apply_plan(snap, catalog, {"small": 2})
+        assert grown.n_nodes == 5
+        assert list(grown.names[-2:]) == ["small-plan-0", "small-plan-1"]
+        assert grown.alloc_cpu_milli[-1] == 4000
+        assert grown.pods_count[-1] == 0 and bool(grown.healthy[-1])
+        with pytest.raises(PlannerError):
+            apply_plan(snap, catalog, {"nope": 1})
+
+
+class TestWatchGrammar:
+    def _entry(self, **over):
+        return {
+            "name": "fc",
+            "pod": {"cpuRequests": "500m", "memRequests": "1gb",
+                    "replicas": "40"},
+            "quantile": 0.95,
+            "usage": {"cpu": USAGE["cpu"]},
+            "samples": 16,
+            "seed": 1,
+            "min_replicas": 10,
+            "horizon": {"steps": 4, "step_s": 600},
+            **over,
+        }
+
+    def test_horizon_block_parses_with_defaults(self):
+        spec = parse_watchlist({"watches": [self._entry()]})[0]
+        assert spec.horizon_steps == 4 and spec.horizon_step_s == 600.0
+        assert spec.to_wire()["horizon"] == {"steps": 4, "step_s": 600.0}
+        wl = parse_watchlist({"watches": [self._entry(horizon={})]})
+        assert wl[0].horizon_steps == 16  # DEFAULT_STEPS
+        # Horizon relaxes the all-point-usage rejection: growth scaling
+        # makes even a pure point spec vary across the projection.
+        entry = self._entry(horizon={"steps": 2})
+        del entry["usage"]
+        wl = parse_watchlist({"watches": [entry]})
+        assert wl[0].horizon_steps == 2
+
+    @pytest.mark.parametrize(
+        "mutation, fragment",
+        [
+            ({"horizon": {"steps": 0}}, "steps"),
+            ({"horizon": {"steps": 4, "bogus": 1}}, "unknown horizon"),
+            ({"horizon": {"step_s": -5}}, "step_s"),
+            ({"horizon": "soon"}, "mapping"),
+            ({"horizon": {"steps": 10 ** 9}}, "steps"),
+        ],
+    )
+    def test_bad_horizon_blocks(self, mutation, fragment):
+        with pytest.raises(WatchError, match=fragment):
+            parse_watchlist({"watches": [self._entry(**mutation)]})
+
+    def test_horizon_requires_quantile_and_excludes_gang(self):
+        entry = self._entry()
+        del entry["quantile"], entry["usage"], entry["samples"], entry["seed"]
+        with pytest.raises(WatchError, match="quantile"):
+            parse_watchlist({"watches": [entry]})
+        bad = self._entry(gang={"ranks": 4})
+        with pytest.raises(WatchError, match="mutually"):
+            parse_watchlist({"watches": [bad]})

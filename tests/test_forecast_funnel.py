@@ -1,0 +1,463 @@
+"""The forecasting acceptance chain, end to end on one stack: a
+synthetic growth history fits a trend, the horizon watch projects a
+breach BEFORE the plain capacity dips, every surface fires
+(kccap_forecast_* gauges, /healthz 503, doctor FAILED, `kccap
+-forecast` exit 1), and applying the planner's recommended purchase
+recovers it.  Plus the service `forecast`/`plan` ops, their audit
+records, and the replay contract."""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.cli import main as cli_main
+from kubernetesclustercapacity_tpu.forecast import (
+    apply_plan,
+    fit_trend,
+    horizon_oracle,
+    parse_catalog,
+    plan_capacity,
+)
+from kubernetesclustercapacity_tpu.service import (
+    CapacityClient,
+    CapacityServer,
+)
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+from kubernetesclustercapacity_tpu.stochastic.distributions import (
+    StochasticSpec,
+)
+from kubernetesclustercapacity_tpu.telemetry.metrics import MetricsRegistry
+from kubernetesclustercapacity_tpu.timeline import CapacityTimeline
+from kubernetesclustercapacity_tpu.timeline.watchlist import parse_watchlist
+
+USAGE_CPU = {"dist": "normal", "mean": "500m", "std": "150m"}
+
+USAGE = {
+    "cpu": USAGE_CPU,
+    "memory": {"dist": "lognormal", "mean": "1gb", "sigma": 0.4},
+}
+
+#: One horizon watch: p95 capacity projected 6 hours out, breach when
+#: the projected MINIMUM dips under 600 replicas.
+FC_WATCHLIST = {
+    "watches": [
+        {
+            "name": "web-h",
+            "pod": {
+                "cpuRequests": "500m",
+                "memRequests": "1gb",
+                "replicas": "40",
+            },
+            "quantile": 0.95,
+            "usage": {"cpu": USAGE_CPU},
+            "samples": 32,
+            "seed": 3,
+            "min_replicas": 600,
+            "horizon": {"steps": 6, "step_s": 3600},
+        },
+    ]
+}
+
+CATALOG = parse_catalog({
+    "shapes": [
+        {"name": "mid", "cpu": "8", "memory": "32gb", "pods": 110,
+         "unit_cost": 2.0},
+    ]
+})
+
+#: Linear demand ramp: generation g carries g·RAMP_MILLI of used cpu on
+#: node 0 (totals 0, T, 2T, ... — the steepest relative slope a linear
+#: ramp admits), flat memory.
+RAMP_MILLI = 36_000
+
+
+def _with_ramp(base, g):
+    used = np.zeros(base.n_nodes, dtype=np.int64)
+    used[0] = RAMP_MILLI * g
+    return dataclasses.replace(base, used_cpu_req_milli=used)
+
+
+def _watch_stochastic_spec(tl_watch):
+    return StochasticSpec(
+        cpu=tl_watch.usage_cpu,
+        memory=tl_watch.usage_mem,
+        replicas=tl_watch.scenario.replicas,
+        samples=tl_watch.samples,
+        seed=tl_watch.seed,
+    )
+
+
+class TestForecastFunnel:
+    @pytest.fixture()
+    def stack(self):
+        reg = MetricsRegistry()
+        specs = parse_watchlist(FC_WATCHLIST)
+        tl = CapacityTimeline(specs, depth=8, registry=reg)
+        base = _with_ramp(synthetic_snapshot(40, seed=6), 0)
+        srv = CapacityServer(base, port=0, timeline=tl, registry=reg)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as client:
+                yield srv, client, base, reg, tl, specs[0]
+        finally:
+            srv.shutdown()
+            tl.close()
+
+    def test_growth_history_drives_every_surface(self, stack):
+        from kubernetesclustercapacity_tpu.telemetry.exposition import (
+            start_metrics_server,
+        )
+        from kubernetesclustercapacity_tpu.utils.doctor import doctor_report
+
+        srv, client, base, reg, tl, wspec = stack
+        host, port = srv.address
+
+        # Short history (the fixture observed generation 0): the watch
+        # degrades to a plain CaR evaluation — explicitly NO forecast.
+        status = client.forecast()
+        assert status["enabled"] is True
+        w = status["watches"]["web-h"]
+        assert w["time_to_breach_s"] is None
+        assert w["horizon_min_capacity"] is None
+        assert w["last_total"] > 600  # plenty of capacity today
+        assert status["breached"] == []
+        assert cli_main(["-forecast", f"{host}:{port}"]) == 0
+
+        # Feed the growth history: one generation per hour, demand
+        # ramping linearly.  With >= 3 records the Theil–Sen trend
+        # fits, and its projection crosses min_replicas within the
+        # horizon while TODAY'S capacity is still fine — the forecast
+        # fires before the plain quantile watch would.
+        # (Timestamps continue from the server's own initial
+        # observation so the axis stays monotone — one record an hour.)
+        t0 = tl.records()[-1].ts
+        snaps = {g: _with_ramp(base, g) for g in (1, 2, 3)}
+        for g in (1, 2, 3):
+            tl.observe(snaps[g], g, ts=t0 + 3600.0 * g)
+
+        status = client.forecast()
+        w = status["watches"]["web-h"]
+        assert status["breached"] == ["web-h"]
+        assert w["last_total"] > 600  # today is healthy...
+        assert w["horizon_min_capacity"] < 600  # ...the projection not
+        assert w["time_to_breach_s"] is not None
+        assert w["alert"]["state"] == "breached"
+        assert not w["degraded_time_axis"]
+
+        # The served time-to-breach matches the pure-numpy oracle fed
+        # the identical fitted trend — ttb is derived state, not vibes.
+        recs = tl.records()
+        axis = np.asarray([r.ts for r in recs], dtype=np.float64)
+        cpu_tot = [
+            float(sum(row[3] for row in r.summary.values())) for r in recs
+        ]
+        fit = fit_trend(axis, cpu_tot)
+        want = horizon_oracle(
+            snaps[3],
+            _watch_stochastic_spec(wspec),
+            steps=6,
+            step_s=3600.0,
+            growth_cpu_per_s=max(fit.relative_slope_per_s, 0.0),
+            quantiles=(0.95,),
+            threshold=600,
+        )
+        assert w["time_to_breach_s"] == want.time_to_breach_s[0.95]
+        assert w["horizon_min_capacity"] == want.min_capacity(0.95)
+
+        # 1. kccap_forecast_* metric families moved.
+        s = reg.snapshot()
+        lbl = 'watch="web-h"'
+        assert s["kccap_forecast_alert_state"]["values"][lbl] == 2
+        assert (
+            s["kccap_forecast_capacity"]["values"][lbl]
+            == w["horizon_min_capacity"]
+        )
+        assert (
+            s["kccap_forecast_time_to_breach_seconds"]["values"][lbl]
+            == w["time_to_breach_s"]
+        )
+        assert s["kccap_watch_breaches_total"]["values"][lbl] >= 1
+
+        # 2. /healthz 503 with the forecast_breached vector in the body.
+        ms = start_metrics_server(
+            reg,
+            healthy=lambda: not tl.forecast_breached(),
+            status=lambda: {"timeline": tl.stats()},
+        )
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(ms.url + "/healthz")
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["ok"] is False
+            assert body["timeline"]["forecast_breached"] == ["web-h"]
+        finally:
+            ms.shutdown()
+
+        # 3. doctor: hard FAILED line naming the watch.
+        checks = dict(
+            doctor_report(
+                backend_timeout_s=30.0,
+                probe_code="print('DEVICES 0.0s cpu x1')",
+                service_addr=srv.address,
+            )
+        )
+        line = checks["capacity forecast"]
+        assert line.startswith("FAILED") and "web-h" in line
+
+        # 4. `kccap -forecast HOST:PORT` exit 1 while breached.
+        assert cli_main(["-forecast", f"{host}:{port}"]) == 1
+
+        # 5. Buy our way out: plan the cheapest purchase that keeps the
+        # projected minimum above the bar, apply it, keep the demand
+        # ramp going.  The forecast recovers BECAUSE of the purchase —
+        # the trend itself keeps growing.
+        plan = plan_capacity(
+            snaps[3],
+            _watch_stochastic_spec(wspec),
+            CATALOG,
+            target=1600,
+            quantile=0.95,
+        )
+        assert plan.certified and sum(plan.buy.values()) > 0
+        grown = apply_plan(snaps[3], CATALOG, plan.buy)
+        tl.observe(_with_ramp(grown, 4), 4, ts=t0 + 4 * 3600.0)
+
+        status = client.forecast()
+        w = status["watches"]["web-h"]
+        assert status["breached"] == []
+        assert w["alert"]["state"] == "recovered"
+        assert w["time_to_breach_s"] is None  # no breach in the horizon
+        assert w["horizon_min_capacity"] >= 600
+        assert cli_main(["-forecast", f"{host}:{port}"]) == 0
+        checks = dict(
+            doctor_report(
+                backend_timeout_s=30.0,
+                probe_code="print('DEVICES 0.0s cpu x1')",
+                service_addr=srv.address,
+            )
+        )
+        assert checks["capacity forecast"].startswith("ok:")
+
+    def test_timeline_wire_and_report_carry_ttb(self, stack):
+        srv, client, base, _, tl, _ = stack
+        t0 = tl.records()[-1].ts
+        for g in (1, 2, 3):
+            tl.observe(_with_ramp(base, g), g, ts=t0 + 3600.0 * g)
+        t = client.timeline()
+        wt = t["records"][-1]["watches"]["web-h"]
+        assert wt["horizon_s"] == 5 * 3600.0
+        assert wt["time_to_breach_s"] is not None
+        assert wt["horizon_min_capacity"] < 600
+        assert wt["degraded_time_axis"] is False
+        from kubernetesclustercapacity_tpu.report import (
+            timeline_table_report,
+        )
+
+        text = timeline_table_report(t)
+        assert "forecast (latest generation):" in text
+        assert "ttb" in text
+
+    def test_stats_section_only_with_horizon_watches(self):
+        tl = CapacityTimeline(
+            parse_watchlist(
+                {"watches": [{"name": "p", "pod": {"cpuRequests": "1"}}]}
+            ),
+            depth=4,
+        )
+        assert "forecast_breached" not in tl.stats()
+        assert tl.forecast_breached() == []
+        assert tl.forecast_status() == {}
+
+
+class TestForecastOp:
+    @pytest.fixture()
+    def server(self):
+        snap = synthetic_snapshot(24, seed=9)
+        srv = CapacityServer(snap, port=0)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as client:
+                yield srv, client, snap
+        finally:
+            srv.shutdown()
+
+    def test_explicit_growth_matches_offline_oracle(self, server):
+        _, client, snap = server
+        wire = client.forecast(
+            usage=USAGE, replicas=40, samples=24, seed=5,
+            steps=4, step_s=1800,
+            growth={"cpu_per_s": 3e-5, "memory_per_s": 1e-5},
+        )
+        from kubernetesclustercapacity_tpu.stochastic.distributions import (
+            parse_stochastic_spec,
+        )
+
+        want = horizon_oracle(
+            snap,
+            parse_stochastic_spec(
+                {"usage": USAGE, "replicas": 40, "samples": 24, "seed": 5}
+            ),
+            steps=4, step_s=1800.0,
+            growth_cpu_per_s=3e-5, growth_mem_per_s=1e-5,
+        ).to_wire()
+        assert wire["quantiles"] == want["quantiles"]
+        assert wire["time_to_breach_s"] == want["time_to_breach_s"]
+        assert wire["steps"] == 4 and wire["samples"] == 24
+
+    def test_status_form_disabled_without_horizon_watches(self, server):
+        _, client, _ = server
+        assert client.forecast() == {
+            "enabled": False, "watches": {}, "breached": [],
+        }
+
+    @pytest.mark.parametrize(
+        "params, fragment",
+        [
+            ({"usage": USAGE, "steps": 0}, "steps"),
+            ({"usage": USAGE, "step_s": -1}, "step_s"),
+            ({"usage": USAGE, "growth": {"bogus": 1}}, "growth"),
+            ({"usage": USAGE, "growth": "fast"}, "growth"),
+            ({"usage": USAGE, "quantiles": [2.0]}, "(0, 1)"),
+            ({"usage": USAGE, "threshold": "soon"}, "threshold"),
+        ],
+    )
+    def test_bad_requests_error_cleanly(self, server, params, fragment):
+        _, client, _ = server
+        with pytest.raises(RuntimeError) as ei:
+            client.forecast(**params)
+        assert fragment in str(ei.value)
+
+    def test_rendered_reports(self, server):
+        _, client, _ = server
+        out = client.forecast(
+            usage=USAGE, samples=16, steps=2,
+            growth={"cpu_per_s": 1e-5}, output="table",
+        )
+        assert out["report"].startswith("capacity forecast")
+        out = client.forecast(
+            usage=USAGE, samples=16, steps=2,
+            growth={"cpu_per_s": 1e-5}, output="json",
+        )
+        assert json.loads(out["report"])["steps"] == 2
+
+
+class TestPlanOp:
+    @pytest.fixture()
+    def server(self):
+        snap = synthetic_snapshot(16, seed=2)
+        srv = CapacityServer(snap, port=0)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as client:
+                yield srv, client, snap
+        finally:
+            srv.shutdown()
+
+    def test_catalog_plan_matches_offline(self, server):
+        _, client, snap = server
+        catalog_doc = {
+            "shapes": [
+                {"name": "mid", "cpu": "8", "memory": "32gb",
+                 "pods": 110, "unit_cost": 2.0},
+            ]
+        }
+        wire = client.plan(
+            catalog=catalog_doc, usage=USAGE, replicas=100,
+            samples=24, seed=7, target=600, quantile=0.9,
+        )
+        from kubernetesclustercapacity_tpu.stochastic.distributions import (
+            parse_stochastic_spec,
+        )
+
+        want = plan_capacity(
+            snap,
+            parse_stochastic_spec(
+                {"usage": USAGE, "replicas": 100, "samples": 24, "seed": 7}
+            ),
+            parse_catalog(catalog_doc),
+            target=600, quantile=0.9,
+        ).to_wire()
+        assert wire["buy"] == want["buy"]
+        assert wire["certified"] == want["certified"] is True
+        assert wire["projected_quantile_capacity"] >= 600
+
+    def test_uncertified_is_reported_never_upgraded(self, server):
+        _, client, _ = server
+        wire = client.plan(
+            catalog=[{"name": "t", "cpu": "1", "memory": "1gb",
+                      "pods": 4, "unit_cost": 1.0, "max_count": 1}],
+            usage=USAGE, replicas=10 ** 6, samples=16, seed=1,
+            target=10 ** 6,
+        )
+        assert wire["certified"] is False
+        assert wire["status"] == "uncertified"
+        assert wire["uncertified_reason"]
+        assert wire["satisfiable"] is False
+
+    def test_plan_wants_exactly_one_form(self, server):
+        _, client, _ = server
+        with pytest.raises(TypeError):
+            client.plan()
+        with pytest.raises(RuntimeError, match="catalog"):
+            # catalog form needs a usage spec
+            client.plan(catalog=[{"name": "t", "cpu": "1",
+                                  "memory": "1gb", "unit_cost": 1.0}])
+
+
+class TestAuditAndReplay:
+    def test_forecast_and_plan_ops_replay(self, tmp_path):
+        from kubernetesclustercapacity_tpu.audit.log import (
+            AuditLog,
+            AuditReader,
+        )
+        from kubernetesclustercapacity_tpu.audit.replay import Replayer
+
+        d = str(tmp_path / "audit")
+        audit = AuditLog(d)
+        server = CapacityServer(
+            synthetic_snapshot(12, seed=4), port=0, batch_window_ms=0.0,
+            audit_log=audit,
+        )
+        try:
+            server.dispatch({
+                "op": "forecast", "usage": USAGE, "replicas": 40,
+                "samples": 16, "seed": 2, "steps": 3,
+                "growth": {"cpu_per_s": 2e-5},
+            })
+            server.dispatch({
+                "op": "plan",
+                "catalog": [{"name": "m", "cpu": "8", "memory": "32gb",
+                             "unit_cost": 2.0}],
+                "usage": USAGE, "replicas": 50, "samples": 16,
+                "seed": 2, "target": 300,
+            })
+            server.dispatch({"op": "forecast"})  # status form
+        finally:
+            server.shutdown()
+            audit.close()
+        reader = AuditReader.load(d)
+        with Replayer(reader) as replayer:
+            result = replayer.replay_all()
+        assert result["chain_error"] is None
+        assert result["counts"]["mismatch"] == 0
+        assert result["counts"]["error"] == 0
+        by_op: dict = {}
+        for o in result["outcomes"]:
+            by_op.setdefault(o["op"], []).append(o)
+        # The pure-function forms re-answer bit-for-bit; the watch-
+        # status form is timeline state, recorded but unreplayable by
+        # construction.
+        assert [o["status"] for o in by_op["plan"]] == ["ok"]
+        assert sorted(o["status"] for o in by_op["forecast"]) == [
+            "ok", "skipped",
+        ]
+        (skipped,) = [
+            o for o in by_op["forecast"] if o["status"] == "skipped"
+        ]
+        assert "watch-status" in skipped["reason"]
+        assert result["clean"]

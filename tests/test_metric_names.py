@@ -152,6 +152,29 @@ def test_scan_finds_the_optimizer_families():
     )
 
 
+def test_scan_finds_the_forecast_families():
+    """Non-vacuous pin for the forecast tier: the walk must see every
+    kccap_forecast_* family (so the README-documentation and
+    snake_case gates below actually cover them), and each must be
+    matched by a README token."""
+    names = _source_metric_names()
+    fc = {n for n in names if n.startswith("kccap_forecast_")}
+    assert {
+        "kccap_forecast_capacity",
+        "kccap_forecast_time_to_breach_seconds",
+        "kccap_forecast_alert_state",
+        "kccap_forecast_eval_seconds",
+    } <= fc
+    patterns = _doc_patterns()
+    undocumented = sorted(
+        n for n in fc if not any(p.fullmatch(n) for p in patterns)
+    )
+    assert not undocumented, (
+        "kccap_forecast_* metrics missing from the README observability "
+        f"table: {undocumented}"
+    )
+
+
 def test_scan_finds_the_sanitizer_families():
     """Non-vacuous pin for the sanitizer tier: the walk must see every
     kccap_sanitize_* family plus the supervised-thread death counter
@@ -279,6 +302,8 @@ def test_env_scan_finds_the_known_switches():
     }
     # The tenancy kill switch (and README-gated below).
     assert "KCCAP_TENANCY" in names
+    # The forecast projection cap (and README-gated below).
+    assert "KCCAP_FORECAST_MAX_STEPS" in names
 
 
 def test_every_env_var_is_documented_in_readme():
