@@ -627,6 +627,7 @@ def main() -> None:
             _host_side_metrics(metrics)
             _hot_path_metrics(metrics)
             _shadow_overhead_metrics(metrics)
+            _tracing_overhead_metrics(metrics)
             _serving_slo_metrics(metrics)
             _tenancy_metrics(metrics)
             _federation_metrics(metrics)
@@ -1703,6 +1704,97 @@ def _shadow_overhead_metrics(out: dict | None = None) -> dict:
                     st["divergences"] if drained else None
                 )
             sampler.close()
+    return out
+
+
+def _tracing_overhead_metrics(out: dict | None = None) -> dict:
+    """Distributed-tracing request-path cost (ISSUE 18's artifact): the
+    same sweep served three ways — tracing off (no trace log), IDs-only
+    (envelope propagation + ring buffering, every body dropped at the
+    tail-sampling decision), and fully sampled (every span body
+    written) — client-observed p50 over 21 requests each.
+
+    The tracing contract is that ID minting is always-on cheap and the
+    tail-sampling ring keeps span retention off the reply path; these
+    rows keep that claim in the BENCH trajectory.  Every reply in all
+    three modes is checked against the sequential oracle and the
+    latency rows are only emitted when ``trace_parity_diffs`` is 0 —
+    instrumenting the path must change no answer.
+    ``KCC_BENCH_TRACING=0`` skips it.
+    """
+    import statistics
+    import tempfile
+
+    if out is None:
+        out = {}
+    if os.environ.get("KCC_BENCH_TRACING", "1") == "0":
+        return out
+    from kubernetesclustercapacity_tpu.oracle import fit_arrays_python
+    from kubernetesclustercapacity_tpu.service.client import CapacityClient
+    from kubernetesclustercapacity_tpu.service.server import CapacityServer
+    from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+    snap = synthetic_snapshot(512, seed=29)
+    cpu, mem = [100, 250, 900], [10 ** 8, 3 * 10 ** 8, 10 ** 9]
+    reps_ = [1, 4, 16]
+    oracle = []
+    for c, m in zip(cpu, mem):
+        fits = fit_arrays_python(
+            snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+            snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+            snap.pods_count, int(c), int(m), mode=snap.semantics,
+            healthy=snap.healthy,
+        )
+        oracle.append(int(sum(fits)))
+
+    parity_diffs = 0
+    keys = (
+        ("off", "trace_overhead_p50_ms_off"),
+        ("ids_only", "trace_overhead_p50_ms_ids_only"),
+        ("sampled", "trace_overhead_p50_ms_sampled"),
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        for mode, key in keys:
+            kw = {}
+            if mode != "off":
+                # "errors" keeps the full record/ring path hot but drops
+                # every body at finish (no request errs here): the pure
+                # propagation + buffering cost.
+                kw = {
+                    "trace_log": os.path.join(tmp, f"{mode}.jsonl"),
+                    "trace_sample": (
+                        "errors" if mode == "ids_only" else "always"
+                    ),
+                }
+            srv = CapacityServer(snap, port=0, batch_window_ms=0.0, **kw)
+            srv.start()
+            times = []
+            try:
+                with CapacityClient(
+                    *srv.address, trace=(mode != "off")
+                ) as c:
+                    c.sweep(  # connection + dispatch warm-up, untimed
+                        cpu_request_milli=cpu, mem_request_bytes=mem,
+                        replicas=reps_,
+                    )
+                    for _ in range(21):
+                        t0 = time.perf_counter()
+                        r = c.sweep(
+                            cpu_request_milli=cpu, mem_request_bytes=mem,
+                            replicas=reps_,
+                        )
+                        times.append((time.perf_counter() - t0) * 1e3)
+                        if r["totals"] != oracle:
+                            parity_diffs += 1
+            finally:
+                srv.shutdown()
+            out[key] = round(statistics.median(times), 3)
+    out["trace_parity_diffs"] = parity_diffs
+    if parity_diffs:
+        # A traced reply differing from the oracle voids the latency
+        # comparison: drop the rows, keep the verdict.
+        for _mode, key in keys:
+            out.pop(key, None)
     return out
 
 
@@ -3134,6 +3226,9 @@ def _run() -> None:
         # Shadow-sampler request-path cost (PR-6): sweep p50 at
         # 0%/1%/10% sample rates must stay indistinguishable.
         _shadow_overhead_metrics(ladder)
+        # Tracing request-path cost (PR-18): sweep p50 with tracing off /
+        # IDs-only / fully sampled — rows gated on oracle parity.
+        _tracing_overhead_metrics(ladder)
         # Federated fleet sweep (PR-12): 4 grouped 1M-node clusters, one
         # batched dispatch, one cluster partitioned mid-run — gated on
         # per-cluster numpy-oracle parity and explicit stale annotation.

@@ -83,14 +83,18 @@ def main() -> None:
               f"sum={hist['sum'] * 1e3:.2f} ms")
         assert hist["count"] == 3
 
-        # And the traced sweep round-tripped into the JSONL span log:
+        # And the traced sweep round-tripped into the JSONL span log.
+        # The trace holds the request span plus its phase children
+        # (op="phase:..."), so select the request span by op:
         spans = [
             json.loads(ln) for ln in open(trace_path, encoding="utf-8")
         ]
         mine = [s for s in spans if s["trace_id"] == trace_id]
-        print(f"trace {trace_id[:8]}…: op={mine[0]['op']} "
-              f"{mine[0]['duration_ms']} ms {mine[0]['status']}")
-        assert mine[0]["op"] == "sweep" and mine[0]["status"] == "ok"
+        req = next(s for s in mine if s["op"] == "sweep")
+        print(f"trace {trace_id[:8]}…: op={req['op']} "
+              f"{req['duration_ms']} ms {req['status']} "
+              f"(+{len(mine) - 1} phase span(s))")
+        assert req["status"] == "ok"
     finally:
         metrics.shutdown()
         server.shutdown()

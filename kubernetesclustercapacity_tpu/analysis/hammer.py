@@ -6,7 +6,7 @@ sanitizer is installed* (so their locks are wrapped and their guarded
 fields — the statically inferred set from
 :func:`..rules_locks.lock_model` — are monitored), then hits them from
 ``threads`` concurrent workers.  One :func:`run` call covers all
-thirteen classes under one instrumentation window per seed; findings
+fourteen classes under one instrumentation window per seed; findings
 flow through the shared suppression/baseline workflow.
 
 The drivers deliberately exercise the *synchronization surface*, not
@@ -28,7 +28,7 @@ from kubernetesclustercapacity_tpu.analysis.rules_locks import lock_model
 
 __all__ = ["run", "HAMMERED_CLASSES", "instrument_targets"]
 
-#: The thirteen threaded classes the tier-1 gate certifies, as
+#: The fourteen threaded classes the tier-1 gate certifies, as
 #: ``(module, class name)`` — every one must also be inferred threaded
 #: by the static model (cross-checked in tests/test_sanitize.py).
 HAMMERED_CLASSES = (
@@ -45,6 +45,7 @@ HAMMERED_CLASSES = (
     ("kubernetesclustercapacity_tpu.resilience", "TokenBucket"),
     ("kubernetesclustercapacity_tpu.resilience", "CircuitBreaker"),
     ("kubernetesclustercapacity_tpu.telemetry.metrics", "MetricsRegistry"),
+    ("kubernetesclustercapacity_tpu.telemetry.tracectx", "TailSampler"),
 )
 
 
@@ -371,6 +372,70 @@ def _drive_registry():
     return [counter, gauge, collect], lambda: None
 
 
+def _drive_tail_sampler():
+    """The tail-sampling ring under exact-count audit: every span body
+    ever recorded must end the run as kept, dropped, or still buffered
+    — and kept must equal what actually reached the sink.  Off-by-one
+    races in the ring's eviction/flush accounting have nowhere to
+    hide."""
+    from kubernetesclustercapacity_tpu.telemetry.tracectx import TailSampler
+
+    class _CountingSink:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.written = 0
+
+        def record(self, **fields):
+            with self.lock:
+                self.written += 1
+
+    sink = _CountingSink()
+    ts = TailSampler(sink, "rate:3", max_traces=8, max_spans_per_trace=4)
+    issued = [0]
+    issued_lock = threading.Lock()
+
+    def _record(tid):
+        ts.record(trace_id=tid, span_id="s", duration_ms=1.0, op="hammer")
+        with issued_lock:
+            issued[0] += 1
+
+    def own_trace(i, t):
+        # The normal request shape: buffer a few spans, decide, finish.
+        tid = f"T{t}.{i}"
+        _record(tid)
+        _record(tid)
+        ts.finish(tid, keep=ts.decide("hammer", 0.001, None))
+
+    def hot_trace(i, t):
+        # Every thread piles into the same two traces: contends the
+        # per-trace span cap and the max_traces eviction path.
+        _record(f"hot{i % 2}")
+
+    def finish_hot(i, t):
+        ts.finish(f"hot{i % 2}", keep=bool(i % 2))
+
+    def stats(i, t):
+        ts.stats()
+
+    def cleanup():
+        with ts._lock:
+            buffered = sum(len(b) for b in ts._ring.values())
+            kept, dropped = ts.kept_spans, ts.dropped_spans
+        if kept != sink.written:
+            raise AssertionError(
+                f"tail-sampler ledger drifted from the sink: "
+                f"kept={kept} written={sink.written}"
+            )
+        if kept + dropped + buffered != issued[0]:
+            raise AssertionError(
+                "tail-sampler lost or invented spans: "
+                f"kept={kept} + dropped={dropped} + buffered={buffered} "
+                f"!= issued={issued[0]}"
+            )
+
+    return [own_trace, own_trace, hot_trace, finish_hot, stats], cleanup
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -428,6 +493,7 @@ def run(
                 _drive_token_bucket(),
                 _drive_breaker(),
                 _drive_registry(),
+                _drive_tail_sampler(),
             )
             errors: list = []
             try:

@@ -15,7 +15,12 @@ Four walks, each its own rule id (suppressions/baselines key on them):
 * ``surface-op`` — every op in the server's ``_KNOWN_OPS`` is
   README-documented and client-reachable (named in the client source);
 * ``surface-flag`` — every ``add_argument("-flag")`` literal in the
-  package is README-documented.
+  package is README-documented;
+* ``surface-span`` — every field keyword a ``span(...)`` emission call
+  passes (including ``**{...}`` dict-splat keys) is in the documented
+  ``SPAN_FIELDS`` vocabulary, the same way phase names are pinned to
+  ``phases.PHASES`` — trace consumers grep spans by field name, so an
+  off-vocabulary field is a silently unqueryable one.
 """
 
 from __future__ import annotations
@@ -218,6 +223,56 @@ def _check_flags(project: Project, readme: str):
                 )
 
 
+def _span_call_fields(node: ast.Call):
+    """The field-name literals one ``span(...)`` call passes: explicit
+    keywords plus every string key of a ``**{...}`` splat (the
+    conditional-field idiom ``**({"error": e} if e else {})``)."""
+    for kw in node.keywords:
+        if kw.arg is not None:
+            yield kw.arg, kw.value.lineno if hasattr(kw.value, "lineno") else node.lineno
+        else:
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Dict):
+                    for key in sub.keys:
+                        if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str
+                        ):
+                            yield key.value, key.lineno
+
+
+def _check_spans(project: Project):
+    from kubernetesclustercapacity_tpu.telemetry.tracectx import SPAN_FIELDS
+
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            is_span = (
+                isinstance(func, ast.Name) and func.id == "span"
+            ) or (
+                isinstance(func, ast.Attribute) and func.attr == "span"
+            )
+            if not is_span:
+                continue
+            for field, line in _span_call_fields(node):
+                if field not in SPAN_FIELDS:
+                    yield Finding(
+                        rule="surface-span",
+                        severity="error",
+                        path=src.rel_path,
+                        line=line,
+                        col=node.col_offset,
+                        message=(
+                            f"span field `{field}` is outside the "
+                            "documented SPAN_FIELDS vocabulary "
+                            "(telemetry/tracectx.py) — emission would "
+                            "silently drop it"
+                        ),
+                        symbol=field,
+                    )
+
+
 def check(project: Project):
     readme = project.readme_text()
     findings: list[Finding] = []
@@ -225,4 +280,5 @@ def check(project: Project):
     findings.extend(_check_envs(project, readme))
     findings.extend(_check_ops(project, readme))
     findings.extend(_check_flags(project, readme))
+    findings.extend(_check_spans(project))
     return findings

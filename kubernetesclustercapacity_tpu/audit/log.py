@@ -65,7 +65,10 @@ _SEGMENT_RE = re.compile(r"^audit-(\d{6})\.jsonl$")
 #: noise that does not change what the request MEANS (the flight
 #: recorder strips the same set from its digests), and ``op`` — a
 #: request record carries the op as its own top-level field.
-_ARGS_EXCLUDED = ("op", "token", "tenant_token", "trace_id", "deadline")
+_ARGS_EXCLUDED = (
+    "op", "token", "tenant_token", "trace_id", "deadline",
+    "parent_span_id", "trace_sampled", "trace_hops",
+)
 
 #: Result fields that legitimately vary between record time and replay
 #: time without a semantics change: which kernel answered (fused on a
@@ -373,10 +376,14 @@ class AuditLog:
         result=None,
         error: str | None = None,
         ts=None,
+        trace_sampled: bool | None = None,
     ) -> str:
         """One request record; returns its ``segment:offset`` audit ref
         (the flight recorder attaches it, so ``dump`` output points
-        straight back into this log)."""
+        straight back into this log).  ``trace_sampled`` — the tail
+        sampler's verdict for this request (``None``, no sampler armed,
+        keeps the record shape unchanged): a replayed divergence can
+        say up front whether a retained trace tree backs it."""
         rec = {
             "kind": "request",
             "ts": time.time() if ts is None else float(ts),
@@ -388,6 +395,8 @@ class AuditLog:
                 "" if result is None else canonical_result_digest(op, result)
             ),
         }
+        if trace_sampled is not None:
+            rec["trace_sampled"] = bool(trace_sampled)
         if error:
             rec["error"] = error
         with self._lock:

@@ -168,11 +168,15 @@ class ShadowSampler:
         *,
         node_mask=None,
         ts=None,
+        trace_id=None,
     ) -> bool:
         """Sampling decision + queue append; the ENTIRE request-path
         cost.  Returns whether this sweep was sampled.  ``totals`` /
         ``schedulable`` are the served answers (host arrays/lists);
-        ``node_mask`` is the mask the serving dispatch applied."""
+        ``node_mask`` is the mask the serving dispatch applied.
+        ``trace_id`` is the originating request's trace — a divergence
+        bundle that names it can be joined straight to the retained
+        span tree of the request that produced the bad answer."""
         if self.sample_rate <= 0.0:
             return False
         with self._cond:
@@ -202,6 +206,7 @@ class ShadowSampler:
                         node_mask, dtype=bool
                     ).copy(),
                     time.time() if ts is None else float(ts),
+                    trace_id if isinstance(trace_id, str) else None,
                 )
             )
             if self._worker is None:
@@ -239,7 +244,8 @@ class ShadowSampler:
                     self._cond.notify_all()
 
     def _check(
-        self, snapshot, generation, grid, totals, schedulable, node_mask, ts
+        self, snapshot, generation, grid, totals, schedulable, node_mask,
+        ts, trace_id=None,
     ) -> None:
         if self._oracle is not None:
             oracle = [
@@ -282,6 +288,7 @@ class ShadowSampler:
         bundle = {
             "kind": "shadow_divergence",
             "ts": ts,
+            **({"trace_id": trace_id} if trace_id else {}),
             "generation": generation,
             "digest": snapshot_digest(snapshot),
             "semantics": snapshot.semantics,

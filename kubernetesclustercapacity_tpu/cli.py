@@ -327,6 +327,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with -doctor: also probe a federation "
                         "endpoint (cluster states, generations) — a "
                         "lost cluster is a hard FAILED line")
+    p.add_argument("-trace-tree", default=None, dest="trace_tree",
+                   metavar="TRACE_ID",
+                   help="stitch one distributed trace back together "
+                        "from per-process span logs (-trace-logs) and "
+                        "print the tree, critical path, and dominating "
+                        "phase; -output json selects the structured "
+                        "form; exit 1 when the trace is not found or "
+                        "the critical path is refused (clock skew)")
+    p.add_argument("-trace-logs", default="", dest="trace_logs",
+                   metavar="DIR[,DIR...]",
+                   help="with -trace-tree: comma-separated trace-log "
+                        "files or directories (directories contribute "
+                        "every *.jsonl plus .1 rotations) — one per "
+                        "process in the topology")
     return p
 
 
@@ -408,6 +422,9 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.replay:
         return _run_replay(args)
+
+    if args.trace_tree:
+        return _run_trace_tree(args)
 
     # Telemetry surfaces (both opt-in, zero cost otherwise): a scrape
     # endpoint over the process registry — the fused-path counters and
@@ -1366,6 +1383,38 @@ def _run_replay(args) -> int:
     else:
         print(replay_table_report(result))
     return 0 if result["clean"] else 1
+
+
+def _run_trace_tree(args) -> int:
+    """-trace-tree TRACE_ID: the offline analyzer of the tracing
+    subsystem — stitch one trace's spans from per-process JSONL logs
+    into a tree (parent linkage only, never wall clock), compute the
+    greedy critical path, and name the dominating contributor.  Exits
+    by the verdict: 0 only when the trace was found and attribution
+    was not refused."""
+    from kubernetesclustercapacity_tpu.report import (
+        trace_json_report,
+        trace_table_report,
+    )
+    from kubernetesclustercapacity_tpu.telemetry.traceview import (
+        analyze_trace,
+    )
+
+    if not args.trace_logs:
+        print(
+            "ERROR : -trace-tree needs -trace-logs DIR[,DIR...] "
+            "(the per-process span logs to stitch)",
+            file=sys.stderr,
+        )
+        return 1
+    tree = analyze_trace(args.trace_logs, args.trace_tree)
+    if args.output == "json":
+        print(trace_json_report(tree))
+    else:
+        print(trace_table_report(tree))
+    if not tree.get("found"):
+        return 1
+    return 0 if not tree["critical_path"].get("refused") else 1
 
 
 def _run_explain(args, snapshot, scenario) -> int:

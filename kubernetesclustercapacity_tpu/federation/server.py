@@ -304,7 +304,16 @@ class FederationServer:
         registry=None,
         clock=time.monotonic,
         seed: int | None = None,
+        trace_log=None,
+        trace_sample: str = "always",
     ) -> None:
+        """``trace_log`` (a path or :class:`~..telemetry.TraceLog`)
+        records one span tree per federation query: a ``fed:{op}``
+        request span plus one ``fed:member`` child per cluster in the
+        fleet — lost clusters included, marked ``state: "lost"``, so a
+        trace of a degraded query SHOWS the hole instead of silently
+        omitting it.  ``trace_sample`` follows the ``-trace-sample``
+        grammar (see :func:`~..telemetry.tracectx.parse_sample_spec`)."""
         if stale_after_s is None:
             stale_after_s = float(os.environ.get(_STALE_ENV, 10.0))
         if evict_after_s is None:
@@ -335,6 +344,25 @@ class FederationServer:
         self._m_stale = None
         self._m_gen = None
         self._m_sweeps = None
+        if isinstance(trace_log, str):
+            from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                TraceLog,
+            )
+
+            trace_log = TraceLog(trace_log)
+        self._trace_sink = None
+        if trace_log is not None:
+            from kubernetesclustercapacity_tpu.telemetry.tracectx import (
+                TailSampler,
+            )
+
+            self._trace_sink = TailSampler(
+                trace_log, trace_sample, registry=registry
+            )
+        # Per-dispatch-thread scratch: the survey vector the handler
+        # saw (and how long evaluation took), read back by dispatch()
+        # to emit the fed:member child spans.
+        self._dispatch_tls = threading.local()
         self.registry = registry
         if registry is not None:
             from kubernetesclustercapacity_tpu.telemetry.metrics import (
@@ -539,6 +567,79 @@ class FederationServer:
                 token.encode(), self._auth_token.encode()
             ):
                 raise PermissionError("missing or invalid auth token")
+        if self._trace_sink is None:
+            return self._route(op, msg)
+        # Traced dispatch: the fed:{op} request span plus one
+        # fed:member child per cluster (from the survey vector the
+        # handler stashed) — emitted at request END so the whole tree
+        # rides one tail-sampling verdict.
+        from kubernetesclustercapacity_tpu.telemetry import (
+            tracectx as _tracectx,
+        )
+
+        ctx = _tracectx.from_wire(msg)
+        parent = msg.get("parent_span_id")
+        if not isinstance(parent, str) or not parent:
+            parent = None
+        self._dispatch_tls.survey = None
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        error: str | None = None
+        try:
+            return self._route(op, msg)
+        except Exception as e:
+            error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            survey = getattr(self._dispatch_tls, "survey", None)
+            self._dispatch_tls.survey = None
+            if ctx is not None:
+                dur = time.perf_counter() - t0
+                op_label = op if op in self._KNOWN_OPS else "unknown"
+                if survey is not None:
+                    vector, eval_s = survey
+                    for name, entry in sorted(vector.items()):
+                        lost = entry.get("state") == "lost"
+                        _tracectx.span(
+                            self._trace_sink,
+                            ts=time.time(),
+                            trace_id=ctx.trace_id,
+                            span_id=_tracectx.new_span_id(),
+                            parent_span_id=ctx.span_id,
+                            op="fed:member",
+                            service="fed",
+                            cluster=name,
+                            state=entry.get("state"),
+                            generation=entry.get("generation"),
+                            # Included members shared ONE batched
+                            # evaluation; a lost member costs nothing
+                            # (and contributes nothing).
+                            duration_ms=(
+                                0.0 if lost else round(eval_s * 1e3, 3)
+                            ),
+                            status="error" if lost else "ok",
+                            **({"error": "cluster lost"} if lost else {}),
+                        )
+                _tracectx.span(
+                    self._trace_sink,
+                    ts=time.time(),
+                    start_ts=wall0,
+                    trace_id=ctx.trace_id,
+                    span_id=ctx.span_id,
+                    **({"parent_span_id": parent} if parent else {}),
+                    op=f"fed:{op_label}",
+                    service="fed",
+                    hops=ctx.hops,
+                    duration_ms=round(dur * 1e3, 3),
+                    status="error" if error else "ok",
+                    **({"error": error} if error else {}),
+                )
+                keep = self._trace_sink.decide(
+                    op_label, dur, error, forced=ctx.sampled
+                )
+                self._trace_sink.finish(ctx.trace_id, keep=keep)
+
+    def _route(self, op, msg: dict) -> dict | str:
         if op == "info":
             return self._op_info()
         if op == "fed_status":
@@ -551,11 +652,20 @@ class FederationServer:
             return self._op_spillover(msg)
         raise ValueError(f"unknown op {op!r}")
 
+    def tracing_stats(self) -> dict:
+        """Tracing posture for doctor: is the fed endpoint emitting
+        spans, and what is the tail sampler holding/dropping."""
+        out: dict = {"armed": self._trace_sink is not None}
+        if self._trace_sink is not None:
+            out.update(self._trace_sink.stats())
+        return out
+
     def _op_info(self) -> dict:
         status = self.status()
         return {
             "clusters": status["counts"]["total"],
             "federation": status,
+            "tracing": self.tracing_stats(),
             # The handshake vocabulary multi-endpoint clients gate on:
             # this endpoint speaks federation ops, not the single-server
             # compute surface.
@@ -628,7 +738,13 @@ class FederationServer:
         split, every row annotated by the degradation vector."""
         grid = self._grid_from_msg(msg)
         vector, included, excluded = self._survey()
+        self._dispatch_tls.survey = (vector, 0.0)
+        t_eval0 = time.perf_counter()
         per_cluster = self._per_cluster_totals(included, grid)
+        self._dispatch_tls.survey = (
+            vector,
+            time.perf_counter() - t_eval0,
+        )
         s = grid.size
         totals = np.zeros(s, dtype=np.int64)
         for t in per_cluster.values():
@@ -662,7 +778,13 @@ class FederationServer:
         if not isinstance(costs, dict):
             raise ValueError(f"costs must be an object, got {costs!r}")
         vector, included, excluded = self._survey()
+        self._dispatch_tls.survey = (vector, 0.0)
+        t_eval0 = time.perf_counter()
         per_cluster = self._per_cluster_totals(included, grid)
+        self._dispatch_tls.survey = (
+            vector,
+            time.perf_counter() - t_eval0,
+        )
         replicas = int(np.asarray(grid.replicas)[0])
         rows = []
         for name, _snap, gen in included:
@@ -712,6 +834,7 @@ class FederationServer:
                 f"spillover evaluates one scenario, got {grid.size}"
             )
         vector, included, excluded = self._survey()
+        self._dispatch_tls.survey = (vector, 0.0)
         if target not in vector:
             raise FederationError(f"unknown cluster {target!r}")
         if vector[target]["state"] == "lost":
@@ -723,7 +846,12 @@ class FederationServer:
                 "unknowable — resync it or query another federation "
                 "endpoint"
             )
+        t_eval0 = time.perf_counter()
         per_cluster = self._per_cluster_totals(included, grid)
+        self._dispatch_tls.survey = (
+            vector,
+            time.perf_counter() - t_eval0,
+        )
         target_snap = next(s for n, s, _g in included if n == target)
         demand = msg.get("demand")
         if demand is None:
@@ -828,6 +956,20 @@ def main(argv=None) -> int:
                         "(or $KCCAP_AUTH_TOKEN is), every op except ping "
                         "must carry it, and plane subscriptions present "
                         "it to the cluster leaders")
+    p.add_argument("-trace-log", default=None, dest="trace_log",
+                   metavar="PATH",
+                   help="append fed:{op} request spans and fed:member "
+                        "per-cluster child spans as JSONL here (feeds "
+                        "kccap -trace-tree)")
+    p.add_argument("-trace-log-max-bytes", type=int, default=16 * 2**20,
+                   dest="trace_log_max_bytes", metavar="BYTES",
+                   help="rotate the trace log at this size (one .1 "
+                        "rollover, default 16MiB)")
+    p.add_argument("-trace-sample", default="always", dest="trace_sample",
+                   metavar="SPEC",
+                   help="tail-sampling policy for span bodies: always | "
+                        "p99-breach | errors | rate:N (span IDs still "
+                        "propagate when bodies are dropped)")
     args = p.parse_args(argv)
 
     auth_token = os.environ.get("KCCAP_AUTH_TOKEN") or None
@@ -862,6 +1004,28 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+    from kubernetesclustercapacity_tpu.telemetry.process import (
+        register_process_metrics,
+    )
+    from kubernetesclustercapacity_tpu.telemetry.tracectx import (
+        parse_sample_spec,
+    )
+
+    try:
+        parse_sample_spec(args.trace_sample)
+    except ValueError as e:
+        print(f"ERROR : {e}", file=sys.stderr)
+        return 1
+    trace_log = None
+    if args.trace_log:
+        from kubernetesclustercapacity_tpu.telemetry.tracing import (
+            TraceLog,
+        )
+
+        trace_log = TraceLog(
+            args.trace_log, max_bytes=args.trace_log_max_bytes
+        )
+    register_process_metrics(REGISTRY)
 
     try:
         fed = FederationServer(
@@ -873,6 +1037,8 @@ def main(argv=None) -> int:
             auth_token=auth_token,
             plane_token=auth_token,
             registry=REGISTRY,
+            trace_log=trace_log,
+            trace_sample=args.trace_sample,
         )
     except (OSError, ValueError, FederationError) as e:
         print(f"ERROR : {e}", file=sys.stderr)

@@ -51,6 +51,8 @@ __all__ = [
     "forecast_status_json_report",
     "plan_table_report",
     "plan_json_report",
+    "trace_table_report",
+    "trace_json_report",
 ]
 
 _RULE = "=" * 110  # the reference prints 110 '=' (ClusterCapacity.go:142,149)
@@ -1131,6 +1133,106 @@ def replay_table_report(result: dict) -> str:
 def replay_json_report(result: dict) -> str:
     """``kccap -replay -output json``: the replay summary verbatim."""
     return json.dumps(result, indent=2, sort_keys=True)
+
+
+def trace_table_report(tree: dict) -> str:
+    """``kccap -trace-tree`` as operator-readable text: the assembled
+    span tree (parent linkage only — indentation IS causality), the
+    greedy critical path with per-step self time, and the dominating
+    contributor in the ``phases`` vocabulary.  A clock-skew refusal is
+    reported as a refusal, never as a confident wrong answer."""
+    tid = tree.get("trace_id", "")
+    if not tree.get("found"):
+        return (
+            f"trace {tid}: no spans found in the given logs\n"
+            "verdict: NOT FOUND — wrong -trace-logs directories, or the "
+            "trace's bodies were dropped by tail sampling on every hop"
+        )
+    lines = [
+        f"trace {tid}: {tree.get('spans', 0)} span(s) across "
+        + (", ".join(tree.get("processes", [])) or "unknown processes")
+        + (
+            f"  (orphaned: {tree['orphans']})"
+            if tree.get("orphans")
+            else ""
+        )
+    ]
+    skew = tree.get("clock_skew_spans", [])
+    if skew:
+        lines.append(
+            f"clock skew: {len(skew)} span(s) with negative durations "
+            "flagged (wall-clock stepped mid-span): " + ", ".join(skew)
+        )
+
+    def _walk(node, depth, seen):
+        if id(node) in seen or depth > 64:
+            return
+        seen.add(id(node))
+        flags = []
+        if node.get("clock_skew"):
+            flags.append("CLOCK_SKEW")
+        if node.get("status") not in (None, "ok"):
+            flags.append(str(node.get("status")).upper())
+        for key in ("hedge", "winner", "leader"):
+            if node.get(key):
+                flags.append(key)
+        if node.get("failover_reason"):
+            flags.append(f"failover={node['failover_reason']}")
+        if node.get("cluster"):
+            flags.append(f"cluster={node['cluster']}")
+        if node.get("state") and node.get("state") != "fresh":
+            flags.append(f"state={node['state']}")
+        dur = node.get("duration_ms")
+        lines.append(
+            "  " * depth
+            + f"- {node.get('op', '?')} [{node.get('service', '?')}] "
+            + (f"{dur:g}ms" if isinstance(dur, (int, float)) else "?ms")
+            + (("  " + " ".join(flags)) if flags else "")
+        )
+        for child in node.get("children", ()):
+            _walk(child, depth + 1, seen)
+
+    seen: set = set()
+    for root in tree.get("roots", []):
+        _walk(root, 1, seen)
+    cp = tree.get("critical_path") or {}
+    if cp.get("refused"):
+        lines.append(
+            "critical path: REFUSED ("
+            + cp["refused"]
+            + (
+                ") — a poisoned (negative) duration is on the path; "
+                "fix the host clock or read the raw spans"
+                if cp["refused"] == "clock_skew"
+                else ") — nothing to attribute"
+            )
+        )
+        return "\n".join(lines)
+    lines.append(f"critical path ({cp.get('total_ms', 0.0):g}ms end-to-end):")
+    for step in cp.get("path", []):
+        lines.append(
+            f"  {step.get('op', '?'):<24} [{step.get('service', '?'):<10}] "
+            f"{step.get('duration_ms', 0.0):>10g}ms  "
+            f"self {step.get('self_ms', 0.0):g}ms"
+            + (
+                f"  {str(step.get('status')).upper()}"
+                if step.get("status")
+                else ""
+            )
+        )
+    dom = cp.get("dominant")
+    if dom:
+        lines.append(
+            f"verdict: dominated by {dom['name']} — {dom['ms']:g}ms "
+            f"({dom['share'] * 100:.1f}% of end-to-end)"
+        )
+    return "\n".join(lines)
+
+
+def trace_json_report(tree: dict) -> str:
+    """``kccap -trace-tree -output json``: the assembled tree (nested
+    ``children``) plus ``critical_path`` verbatim."""
+    return json.dumps(tree, indent=2, sort_keys=True)
 
 
 def table_report(

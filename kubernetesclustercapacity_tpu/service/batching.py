@@ -50,6 +50,7 @@ _FOLLOWER_TIMEOUT_S = 120.0
 class _Batch:
     __slots__ = (
         "items", "tenants", "closed", "full", "done", "results", "error",
+        "leader_span_id",
     )
 
     def __init__(self) -> None:
@@ -63,6 +64,11 @@ class _Batch:
         self.done = threading.Event()
         self.results: list | None = None
         self.error: str | None = None
+        # The leader's "batch:dispatch" span id, minted when the batch
+        # opens so followers can LINK to it (links, not parentage:
+        # a follower's request is caused by its own caller; it merely
+        # rode the leader's dispatch).
+        self.leader_span_id: str | None = None
 
 
 class MicroBatcher:
@@ -81,6 +87,7 @@ class MicroBatcher:
         window_s: float = 0.0015,
         max_batch: int = 32,
         registry=None,
+        trace_sink=None,
     ) -> None:
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             MetricsRegistry,
@@ -92,6 +99,11 @@ class MicroBatcher:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self._dispatch = dispatch
+        # Span sink (a TailSampler or TraceLog; None = no tracing):
+        # batch leaders record a "batch:dispatch" span, followers a
+        # "batch:join" span linked to it — the trace-tree form of "who
+        # rode whose kernel launch".
+        self._trace_sink = trace_sink
         self.window_s = float(window_s)
         self.max_batch = int(max_batch)
         self._lock = threading.Lock()
@@ -144,7 +156,7 @@ class MicroBatcher:
             "mean_batch_size": (total / dispatches) if dispatches else 0.0,
         }
 
-    def submit(self, key, item, *, deadline=None, tenant=None):
+    def submit(self, key, item, *, deadline=None, tenant=None, trace=None):
         """Run ``item`` through a (possibly shared) dispatch; returns its
         own result.  Blocking — callers are the server's per-connection
         threads, each already holding a compute slot.
@@ -152,7 +164,15 @@ class MicroBatcher:
         ``tenant`` is pure attribution: concurrent tenants' same-key
         sweeps FOLD into one padded dispatch and split per tenant on
         return (bit-exact vs solo, because the combined dispatch is
-        index-scattered and never reads the label)."""
+        index-scattered and never reads the label).
+
+        ``trace`` is the caller's
+        :class:`~..telemetry.tracectx.TraceContext` (``None`` when the
+        request is untraced): the leader's combined dispatch lands as a
+        "batch:dispatch" child span of ITS request; every follower
+        records a "batch:join" span under its OWN request whose
+        ``links`` field names the leader's dispatch span — cross-trace
+        causality without fake parentage."""
         if deadline is not None and deadline.remaining() <= self.window_s:
             # The window would eat the caller's whole budget: dispatch
             # alone, now.  (An already-expired deadline was shed upstream.)
@@ -171,6 +191,12 @@ class MicroBatcher:
                 or len(batch.items) >= self.max_batch
             ):
                 batch = _Batch()
+                if self._trace_sink is not None:
+                    from kubernetesclustercapacity_tpu.telemetry.tracing import (  # noqa: E501
+                        new_span_id,
+                    )
+
+                    batch.leader_span_id = new_span_id()
                 self._pending[key] = batch
                 leader = True
             idx = len(batch.items)
@@ -222,16 +248,62 @@ class MicroBatcher:
                 else:
                     self._m_solo.inc()
                 batch.done.set()
+                if trace is not None and self._trace_sink is not None:
+                    from kubernetesclustercapacity_tpu.telemetry import (
+                        tracectx as _tracectx,
+                    )
+
+                    _tracectx.span(
+                        self._trace_sink,
+                        ts=time.time(),
+                        trace_id=trace.trace_id,
+                        span_id=batch.leader_span_id,
+                        parent_span_id=trace.span_id,
+                        op="batch:dispatch",
+                        service="server",
+                        leader=True,
+                        batch_size=len(items),
+                        duration_ms=round(
+                            (time.perf_counter() - t0) * 1e3, 3
+                        ),
+                        status="error" if batch.error else "ok",
+                    )
         else:
-            t0 = time.perf_counter() if clk else 0.0
+            t0 = time.perf_counter()
             done = batch.done.wait(_FOLLOWER_TIMEOUT_S)
+            wait_s = time.perf_counter() - t0
             # A follower's whole batching story is this wait: the
             # remainder of the leader's window plus the combined kernel
             # dispatch it rode.  Its own clock never sees device phases
             # — the leader's does — so batch_wait is the honest
             # per-request attribution.
             if clk:
-                clk.record("batch_wait", time.perf_counter() - t0)
+                clk.record("batch_wait", wait_s)
+            if trace is not None and self._trace_sink is not None:
+                from kubernetesclustercapacity_tpu.telemetry import (
+                    tracectx as _tracectx,
+                )
+                from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                    new_span_id,
+                )
+
+                _tracectx.span(
+                    self._trace_sink,
+                    ts=time.time(),
+                    trace_id=trace.trace_id,
+                    span_id=new_span_id(),
+                    parent_span_id=trace.span_id,
+                    op="batch:join",
+                    service="server",
+                    leader=False,
+                    **(
+                        {"links": [batch.leader_span_id]}
+                        if batch.leader_span_id
+                        else {}
+                    ),
+                    duration_ms=round(wait_s * 1e3, 3),
+                    status="ok" if done else "error",
+                )
             if not done:
                 raise RuntimeError(
                     "micro-batch dispatch timed out waiting for its leader"

@@ -306,14 +306,24 @@ class CapacityClient:
         retryable_op = op in IDEMPOTENT_OPS
         self._m["calls"].inc()
         call_span_id = None
+        _new_span = None
+        # A caller-supplied ``parent_span_id`` (the ReplicaSet's attempt
+        # span, the fed's member span) becomes the CALL span's parent;
+        # the envelope's own parent is rewritten per attempt below so
+        # the server's request span hangs under the attempt that
+        # actually reached it.
+        caller_parent = params.get("parent_span_id")
+        if not isinstance(caller_parent, str) or not caller_parent:
+            caller_parent = None
         if self._trace_log is not None:
             from kubernetesclustercapacity_tpu.telemetry.tracing import (
-                new_span_id,
+                new_span_id as _new_span,
             )
 
-            call_span_id = new_span_id()
+            call_span_id = _new_span()
         trace_id = params.get("trace_id") or ""
         t_call0 = time.perf_counter()
+        wall_call0 = time.time()
         call_error: str | None = None
         prev_delay: float | None = None
         attempt = 0
@@ -332,7 +342,17 @@ class CapacityClient:
                             else ""
                         )
                     )
+                attempt_span_id = None
+                if _new_span is not None and trace_id:
+                    # The server's request span parents to THIS attempt:
+                    # retries (and the ReplicaSet's hedges) become
+                    # sibling subtrees, each owning the server-side
+                    # children of the wire call it actually made.
+                    attempt_span_id = _new_span()
+                    msg["parent_span_id"] = attempt_span_id
+                    msg.setdefault("trace_hops", 1)
                 t_attempt0 = time.perf_counter()
+                wall_attempt0 = time.time()
                 try:
                     result = self._attempt(msg, deadline)
                 except Exception as e:
@@ -341,6 +361,8 @@ class CapacityClient:
                         backoff_before,
                         time.perf_counter() - t_attempt0,
                         error=f"{type(e).__name__}: {e}",
+                        span_id=attempt_span_id,
+                        start_ts=wall_attempt0,
                     )
                     transport = RetryPolicy.is_transport_error(e)
                     if transport and self._breaker is not None:
@@ -379,6 +401,7 @@ class CapacityClient:
                 self._record_attempt_span(
                     op, trace_id, call_span_id, attempt, backoff_before,
                     time.perf_counter() - t_attempt0, error=None,
+                    span_id=attempt_span_id, start_ts=wall_attempt0,
                 )
                 if self._breaker is not None:
                     self._breaker.record_success()
@@ -390,16 +413,20 @@ class CapacityClient:
             self._record_call_span(
                 op, trace_id, call_span_id, attempt,
                 time.perf_counter() - t_call0, call_error,
+                parent_span_id=caller_parent, start_ts=wall_call0,
             )
 
     def _record_attempt_span(
         self, op, trace_id, call_span_id, attempt, backoff_s, duration_s,
-        *, error,
+        *, error, span_id=None, start_ts=None,
     ) -> None:
         """One child span per transport attempt (parent: the call span)
         — the satellite that makes retry storms visible: attempt index,
-        the backoff slept before this attempt, and what failed.  Spans
-        are observability: they never fail the call they observe."""
+        the backoff slept before this attempt, and what failed.
+        ``span_id`` is the id the attempt's wire envelope already
+        announced as the server's parent (minted up front), so the
+        server's request span hangs under this one.  Spans are
+        observability: they never fail the call they observe."""
         if self._trace_log is None:
             return
         from kubernetesclustercapacity_tpu.telemetry.tracing import (
@@ -409,10 +436,12 @@ class CapacityClient:
         try:
             self._trace_log.record(
                 ts=time.time(),
+                **({"start_ts": start_ts} if start_ts is not None else {}),
                 trace_id=trace_id,
-                span_id=new_span_id(),
+                span_id=span_id or new_span_id(),
                 parent_span_id=call_span_id,
                 op=f"{op}:attempt",
+                service="client",
                 attempt=attempt,
                 backoff_ms=round(backoff_s * 1e3, 3),
                 duration_ms=round(duration_s * 1e3, 3),
@@ -423,18 +452,28 @@ class CapacityClient:
             pass
 
     def _record_call_span(
-        self, op, trace_id, call_span_id, attempts, duration_s, error
+        self, op, trace_id, call_span_id, attempts, duration_s, error,
+        parent_span_id=None, start_ts=None,
     ) -> None:
         """The call-level span the attempt spans parent to (its
-        ``attempts`` field is the retry count at a glance)."""
+        ``attempts`` field is the retry count at a glance).
+        ``parent_span_id`` links it under the caller's own span when
+        one rode in on the params (ReplicaSet attempt, fed member)."""
         if self._trace_log is None:
             return
         try:
             self._trace_log.record(
                 ts=time.time(),
+                **({"start_ts": start_ts} if start_ts is not None else {}),
                 trace_id=trace_id,
                 span_id=call_span_id,
+                **(
+                    {"parent_span_id": parent_span_id}
+                    if parent_span_id
+                    else {}
+                ),
                 op=f"client:{op}",
+                service="client",
                 attempts=attempts,
                 duration_ms=round(duration_s * 1e3, 3),
                 status="error" if error else "ok",
@@ -591,7 +630,7 @@ class CapacityClient:
 
     def dump(self, op: str | None = None, status: str | None = None,
              limit: int | None = None, tenant: str | None = None,
-             **kw) -> dict:
+             sampled: bool | None = None, **kw) -> dict:
         """The server's flight recorder: its last K dispatched requests.
 
         Filters apply SERVER-side: ``op`` keeps records of one op (sent
@@ -599,8 +638,10 @@ class CapacityClient:
         request), ``status`` keeps ``"ok"``/``"error"`` records,
         ``tenant`` keeps one tenant's records (sent as
         ``filter_tenant`` — the envelope's own ``tenant`` field is this
-        request's attribution), and ``limit`` returns only the N most
-        recent matches.
+        request's attribution), ``sampled`` keeps records by the tail
+        sampler's verdict (``True`` = a retained trace tree backs the
+        record, so ``kccap -trace-tree`` will find it), and ``limit``
+        returns only the N most recent matches.
         """
         if op is not None:
             kw["filter_op"] = op
@@ -610,6 +651,8 @@ class CapacityClient:
             kw["limit"] = limit
         if tenant is not None:
             kw["filter_tenant"] = tenant
+        if sampled is not None:
+            kw["sampled"] = sampled
         return self.call("dump", **kw)
 
     def audit_status(self, **kw) -> dict:

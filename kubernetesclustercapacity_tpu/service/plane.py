@@ -183,9 +183,22 @@ class PlanePublisher:
         max_queue: int = 128,
         heartbeat_s: float = 2.0,
         registry=None,
+        trace_log=None,
     ) -> None:
         import socket as _socket
 
+        if isinstance(trace_log, str):
+            from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                TraceLog,
+            )
+
+            trace_log = TraceLog(trace_log)
+        # ``plane:publish`` spans: each published generation mints a
+        # fresh trace, and the frame carries (trace_id, span_id) as
+        # additive fields so every subscriber's ``plane:stage`` span
+        # joins the SAME tree.  The digest covers the snapshot, not the
+        # frame, so the trace fields never perturb verification.
+        self._trace_log = trace_log
         self._token = token
         self._max_queue = int(max_queue)
         self._heartbeat_s = float(heartbeat_s)
@@ -252,6 +265,8 @@ class PlanePublisher:
         in publish order on the publisher thread; best-effort per
         subscriber (a full queue ejects that subscriber, never fails the
         publish)."""
+        t0 = time.perf_counter()
+        wall0 = time.time()
         summary = node_summary(snapshot)
         digest = snapshot_digest(snapshot)
         with self._lock:
@@ -263,6 +278,14 @@ class PlanePublisher:
                 frame = self._diff_frame_locked(
                     summary, snapshot, generation, digest
                 )
+            if self._trace_log is not None:
+                from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                    new_span_id,
+                    new_trace_id,
+                )
+
+                frame["trace_id"] = new_trace_id()
+                frame["span_id"] = new_span_id()
             self._summary = summary
             self._names = list(snapshot.names)
             self._taints = list(snapshot.taints or [])
@@ -272,6 +295,24 @@ class PlanePublisher:
             self._digest = digest
             self._published += 1
             self._offer_all_locked(frame)
+        if self._trace_log is not None:
+            from kubernetesclustercapacity_tpu.telemetry import (
+                tracectx as _tracectx,
+            )
+
+            _tracectx.span(
+                self._trace_log,
+                ts=time.time(),
+                start_ts=wall0,
+                trace_id=frame["trace_id"],
+                span_id=frame["span_id"],
+                op="plane:publish",
+                service="plane",
+                kind=frame["kind"],
+                generation=int(generation),
+                duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                status="ok",
+            )
 
     def _checkpoint_frame_locked(
         self, summary, snapshot, generation, digest
@@ -578,9 +619,21 @@ class PlaneSubscriber:
         registry=None,
         clock=time.monotonic,
         on_apply=None,
+        trace_log=None,
     ) -> None:
         import random as _random
 
+        if isinstance(trace_log, str):
+            from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                TraceLog,
+            )
+
+            trace_log = TraceLog(trace_log)
+        # ``plane:stage`` spans, parented to the publisher's
+        # ``plane:publish`` span via the (trace_id, span_id) the frame
+        # carries — the cross-process replication link of the trace
+        # tree.
+        self._trace_log = trace_log
         self._leader = tuple(leader)
         self._server = server
         self._token = token
@@ -887,6 +940,8 @@ class PlaneSubscriber:
             snapshot_from_summary,
         )
 
+        t_stage0 = time.perf_counter()
+        wall_stage0 = time.time()
         generation = int(frame["generation"])
         with self._lock:
             current = self._generation
@@ -932,6 +987,37 @@ class PlaneSubscriber:
             self._m_generation.set(generation)
         if self._m_applied is not None:
             self._m_applied.labels(result="applied").inc()
+        if self._trace_log is not None:
+            tid = frame.get("trace_id")
+            pid = frame.get("span_id")
+            if isinstance(tid, str) and tid:
+                from kubernetesclustercapacity_tpu.telemetry import (
+                    tracectx as _tracectx,
+                )
+                from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                    new_span_id,
+                )
+
+                _tracectx.span(
+                    self._trace_log,
+                    ts=time.time(),
+                    start_ts=wall_stage0,
+                    trace_id=tid,
+                    span_id=new_span_id(),
+                    **(
+                        {"parent_span_id": pid}
+                        if isinstance(pid, str) and pid
+                        else {}
+                    ),
+                    op="plane:stage",
+                    service="plane",
+                    kind=str(frame.get("kind", "")),
+                    generation=generation,
+                    duration_ms=round(
+                        (time.perf_counter() - t_stage0) * 1e3, 3
+                    ),
+                    status="ok",
+                )
         if self._on_apply is not None:
             try:
                 self._on_apply(generation)

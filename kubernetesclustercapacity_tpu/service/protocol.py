@@ -102,6 +102,23 @@ Any request may additionally carry:
     :mod:`..telemetry.tracing`).  The server stamps it into its span
     record when started with ``-trace-log``, so one client-side ID finds
     the request in the server's trace log; it never changes the reply.
+``parent_span_id``
+    the caller's span for THIS hop (see :mod:`..telemetry.tracectx`) —
+    the receiver's request span parents to it, which is what lets the
+    offline analyzer (``kccap -trace-tree``) stitch per-process span
+    logs into one tree without comparing wall clocks.
+``trace_sampled``
+    the caller's sticky tail-sampling decision (bool).  ``true`` forces
+    every downstream hop to keep its span bodies for this trace even if
+    its own ``-trace-sample`` predicate would drop them, so a kept trace
+    is whole rather than a ragged subset.
+``trace_hops``
+    propagation depth (int), incremented per hop and capped at
+    ``tracectx.MAX_HOPS`` — a forwarding loop degrades to untraced
+    requests instead of unbounded envelope growth.
+
+All three ride only alongside ``trace_id`` and, like it, never change
+the reply — a server without tracing armed ignores them.
 
 Responses: ``{"ok": true, "result": ...}`` or ``{"ok": false, "error": "..."}``.
 Every response envelope also carries ``generation`` — the snapshot

@@ -146,6 +146,7 @@ class ReplicaSet:
         hedge_max_delay_s: float = 1.0,
         registry=None,
         trace: bool = False,
+        trace_log=None,
         cluster: str | None = None,
     ) -> None:
         """``cluster`` names the federation cluster this set's queries
@@ -158,7 +159,13 @@ class ReplicaSet:
         ``tenant``/``tenant_token`` ride every per-endpoint client (see
         :class:`~.client.CapacityClient`).  A ``tenant_quota`` refusal
         is AUTHORITATIVE — every replica enforces the same map — so the
-        set surfaces it immediately instead of failing over."""
+        set surfaces it immediately instead of failing over.
+
+        ``trace_log`` (a path or :class:`~..telemetry.TraceLog`) records
+        the set's own spans: one ``rs:{op}`` span per call, with one
+        ``rs:attempt`` child per endpoint try carrying the endpoint,
+        the hedge/winner flags, and the failover reason — the trace
+        form of the failover story the metrics only count."""
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             MetricsRegistry,
         )
@@ -189,6 +196,13 @@ class ReplicaSet:
         self._hedge_min = float(hedge_min_delay_s)
         self._hedge_max = float(hedge_max_delay_s)
         self._trace = bool(trace)
+        if isinstance(trace_log, str):
+            from kubernetesclustercapacity_tpu.telemetry.tracing import (
+                TraceLog,
+            )
+
+            trace_log = TraceLog(trace_log)
+        self._trace_log = trace_log
         self._cluster = cluster
         self._lock = threading.Lock()
         self._watermark = 0
@@ -324,6 +338,61 @@ class ReplicaSet:
         deadline = Deadline.after(budget) if budget is not None else None
         self._m_calls.labels(op=op).inc()
         hedgeable = self._hedge and op in IDEMPOTENT_OPS
+        # Trace context: adopt the caller's (params carried a
+        # trace_id) or originate one.  Every endpoint try below gets an
+        # "rs:attempt" child span; the wire envelope each try sends
+        # names THAT attempt as the server's parent, so failovers and
+        # hedges become sibling subtrees under this call's span.
+        rs_ctx = None
+        caller_parent = params.get("parent_span_id")
+        if not isinstance(caller_parent, str) or not caller_parent:
+            caller_parent = None
+        if self._trace_log is not None:
+            from kubernetesclustercapacity_tpu.telemetry import (
+                tracectx as _tracectx,
+            )
+
+            rs_ctx = _tracectx.from_wire(params) or _tracectx.TraceContext()
+            params = dict(params, trace_id=rs_ctx.trace_id)
+        wall_call0 = time.time()
+        t_call0 = time.perf_counter()
+        call_error: str | None = None
+        try:
+            return self._call_loop(
+                op, params, deadline, hedgeable, rs_ctx
+            )
+        except Exception as e:
+            call_error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            if rs_ctx is not None:
+                from kubernetesclustercapacity_tpu.telemetry import (
+                    tracectx as _tracectx,
+                )
+
+                _tracectx.span(
+                    self._trace_log,
+                    ts=time.time(),
+                    start_ts=wall_call0,
+                    trace_id=rs_ctx.trace_id,
+                    span_id=rs_ctx.span_id,
+                    **(
+                        {"parent_span_id": caller_parent}
+                        if caller_parent
+                        else {}
+                    ),
+                    op=f"rs:{op}",
+                    service="replicaset",
+                    duration_ms=round(
+                        (time.perf_counter() - t_call0) * 1e3, 3
+                    ),
+                    status="error" if call_error else "ok",
+                    **({"error": call_error} if call_error else {}),
+                )
+
+    def _call_loop(self, op, params, deadline, hedgeable, rs_ctx):
+        """The failover/hedging loop behind :meth:`call` (split out so
+        the call span wraps every exit path exactly once)."""
         errors: list[str] = []
         stale_seen = 0
         prev_delay: float | None = None
@@ -338,21 +407,31 @@ class ReplicaSet:
                 if not ep.breaker.allow():
                     errors.append(f"{ep.name}: breaker open")
                     self._m_failover.labels(cause="breaker_open").inc()
+                    self._attempt_span(
+                        rs_ctx, None, ep, time.time(), 0.0,
+                        reason="breaker_open", error="breaker open",
+                    )
                     continue
+                att_id, att_params = self._attempt_params(rs_ctx, params)
+                wall_att0 = time.time()
+                t0 = time.perf_counter()
                 try:
                     if hedgeable:
                         result, gen, won_by_hedge = self._attempt_hedged(
-                            ep, op, params, deadline
+                            ep, op, params, deadline, rs_ctx
                         )
                         if won_by_hedge:
                             self._m_hedge_wins.inc()
                     else:
-                        t0 = time.perf_counter()
                         result = self._call_endpoint(
-                            ep, op, params, deadline
+                            ep, op, att_params, deadline
                         )
                         self._note_latency(time.perf_counter() - t0)
                         gen = ep.last_generation
+                        self._attempt_span(
+                            rs_ctx, att_id, ep, wall_att0,
+                            time.perf_counter() - t0, winner=True,
+                        )
                 except DeadlineExpired:
                     raise
                 except RetryableElsewhere as e:
@@ -372,10 +451,22 @@ class ReplicaSet:
                         # queried cluster: demote like draining.
                         ep.lost = True
                     self._m_failover.labels(cause=e.wire_code).inc()
+                    if not hedgeable:  # hedged legs record their own
+                        self._attempt_span(
+                            rs_ctx, att_id, ep, wall_att0,
+                            time.perf_counter() - t0,
+                            reason=e.wire_code, error=str(e),
+                        )
                     continue
                 except CircuitOpenError as e:
                     errors.append(f"{ep.name}: {e}")
                     self._m_failover.labels(cause="breaker_open").inc()
+                    if not hedgeable:
+                        self._attempt_span(
+                            rs_ctx, att_id, ep, wall_att0,
+                            time.perf_counter() - t0,
+                            reason="breaker_open", error=str(e),
+                        )
                     continue
                 except Exception as e:
                     transport = RetryPolicy.is_transport_error(e)
@@ -384,6 +475,13 @@ class ReplicaSet:
                     ep.breaker.record_failure(f"{type(e).__name__}: {e}")
                     errors.append(f"{ep.name}: {type(e).__name__}: {e}")
                     self._m_failover.labels(cause="transport").inc()
+                    if not hedgeable:
+                        self._attempt_span(
+                            rs_ctx, att_id, ep, wall_att0,
+                            time.perf_counter() - t0,
+                            reason="transport",
+                            error=f"{type(e).__name__}: {e}",
+                        )
                     if op not in IDEMPOTENT_OPS:
                         # The mutation may have executed before the
                         # transport died: at-most-once forbids resending
@@ -403,6 +501,10 @@ class ReplicaSet:
                 errors.append(f"{ep.name}: {verdict}")
                 self._m_stale.inc()
                 self._m_failover.labels(cause="stale").inc()
+                self._attempt_span(
+                    rs_ctx, None, ep, wall_att0, 0.0,
+                    reason="stale", error=verdict,
+                )
             if round_i + 1 < self._rounds:
                 prev_delay = self._backoff.next_delay(prev_delay)
                 if deadline is not None:
@@ -421,6 +523,58 @@ class ReplicaSet:
         raise ReplicaSetError(
             f"all {len(self._endpoints)} endpoint(s) failed for {op!r} "
             f"after {len(errors)} attempt(s): {'; '.join(errors[-4:])}"
+        )
+
+    # -- attempt tracing ---------------------------------------------------
+    def _attempt_params(self, rs_ctx, params):
+        """``(attempt_span_id, params_for_the_wire)`` for one endpoint
+        try: the envelope announces the ATTEMPT span as the server's
+        parent (hops advanced), so each failover/hedge leg owns its own
+        server-side subtree.  ``(None, params)`` untraced."""
+        if rs_ctx is None:
+            return None, params
+        from kubernetesclustercapacity_tpu.telemetry.tracing import (
+            new_span_id,
+        )
+
+        att_id = new_span_id()
+        wire = rs_ctx.to_wire()
+        wire["parent_span_id"] = att_id
+        return att_id, dict(params, **wire)
+
+    def _attempt_span(
+        self, rs_ctx, span_id, ep, start_ts, duration_s, *,
+        hedge=False, winner=False, reason=None, error=None,
+    ) -> None:
+        """One "rs:attempt" child span under the call span: which
+        endpoint, whether it was the hedged leg, whether it won the
+        race, and — on failure — the failover cause (the same
+        vocabulary as ``kccap_replicaset_failovers_total``)."""
+        if rs_ctx is None or self._trace_log is None:
+            return
+        from kubernetesclustercapacity_tpu.telemetry import (
+            tracectx as _tracectx,
+        )
+        from kubernetesclustercapacity_tpu.telemetry.tracing import (
+            new_span_id,
+        )
+
+        _tracectx.span(
+            self._trace_log,
+            ts=time.time(),
+            start_ts=start_ts,
+            trace_id=rs_ctx.trace_id,
+            span_id=span_id or new_span_id(),
+            parent_span_id=rs_ctx.span_id,
+            op="rs:attempt",
+            service="replicaset",
+            endpoint=ep.name,
+            hedge=bool(hedge),
+            winner=bool(winner),
+            **({"failover_reason": reason} if reason else {}),
+            duration_ms=round(duration_s * 1e3, 3),
+            status="error" if (error or reason) else "ok",
+            **({"error": error} if error else {}),
         )
 
     def _rotation(self) -> list[_Endpoint]:
@@ -495,20 +649,54 @@ class ReplicaSet:
             if len(self._latencies) > 64:
                 del self._latencies[0]
 
-    def _attempt_hedged(self, primary: _Endpoint, op, params, deadline):
+    def _attempt_hedged(
+        self, primary: _Endpoint, op, params, deadline, rs_ctx=None
+    ):
         """Primary attempt plus (after the hedge delay) one secondary on
         the next healthy endpoint; first answer wins.  Returns
         ``(result, generation, won_by_hedge)``; raises the primary's
-        error when both fail."""
+        error when both fail.
+
+        Each leg records its own "rs:attempt" span (``hedge`` flags the
+        secondary); the race's winner — the first leg to SUCCEED, which
+        is the leg whose answer the caller gets — carries ``winner:
+        true``, so a hedged read always shows exactly two sibling
+        attempt spans with one winner."""
         results: _queue.Queue = _queue.Queue()
+        race_lock = threading.Lock()
+        race = {"won": False}
 
         def attempt(ep: _Endpoint, tag: str) -> None:
+            att_id = None
+            wall0 = None
+            t0 = None
             try:
+                att_id, att_params = self._attempt_params(rs_ctx, params)
+                wall0 = time.time()
                 t0 = time.perf_counter()
-                r = self._call_endpoint(ep, op, params, deadline)
+                r = self._call_endpoint(ep, op, att_params, deadline)
                 self._note_latency(time.perf_counter() - t0)
+                with race_lock:
+                    won = not race["won"]
+                    race["won"] = True
+                self._attempt_span(
+                    rs_ctx, att_id, ep, wall0,
+                    time.perf_counter() - t0,
+                    hedge=tag == "hedge", winner=won,
+                )
                 results.put((tag, ep, r, None))
             except Exception as e:  # noqa: BLE001 - reported via the queue
+                self._attempt_span(
+                    rs_ctx, att_id, ep, wall0 or 0.0,
+                    (time.perf_counter() - t0) if t0 is not None else 0.0,
+                    hedge=tag == "hedge",
+                    reason=(
+                        "transport"
+                        if RetryPolicy.is_transport_error(e)
+                        else getattr(e, "wire_code", None)
+                    ),
+                    error=f"{type(e).__name__}: {e}",
+                )
                 # EVERY exit posts to the queue: a silently-dead attempt
                 # would leave the hedged read blocked on results.get().
                 results.put((tag, ep, None, e))

@@ -144,6 +144,7 @@ class CapacityServer:
         stats_source=None,
         registry=None,
         trace_log=None,
+        trace_sample: str = "always",
         flight_records: int = 256,
         flight_dump_path: str | None = None,
         batch_window_ms: float = 1.0,
@@ -169,8 +170,13 @@ class CapacityServer:
         servers/tests never share counters; pass the process registry —
         as ``main`` does — to fold server metrics into one scrape).
         ``trace_log`` (a path or :class:`~..telemetry.TraceLog`) records
-        one JSONL span per dispatched request, carrying the caller's
-        ``trace_id`` when the request rode one.
+        one JSONL span tree per dispatched request, carrying the
+        caller's ``trace_id`` when the request rode one.
+        ``trace_sample`` picks which requests keep their span bodies
+        (``always | p99-breach | errors | rate:N`` — see
+        :func:`~..telemetry.tracectx.parse_sample_spec`); ids still
+        propagate downstream for every request regardless, so an
+        upstream hop that DID sample keeps a complete tree.
 
         ``flight_records`` sizes the flight recorder — the ring buffer
         of the last K dispatched requests served by the ``dump`` op.
@@ -354,6 +360,23 @@ class CapacityServer:
             )
         self._flight = FlightRecorder(flight_records)
         self._flight_dump_path = flight_dump_path
+        # Tail-based sampling: span ids are ALWAYS minted (cheap, keeps
+        # cross-process propagation armed); span bodies route through
+        # the sampler, which buffers them per trace and flushes or drops
+        # the whole tree at end of request once the predicate has the
+        # request's full latency/error picture.
+        self._trace_sink = None
+        if self._trace_log is not None:
+            from kubernetesclustercapacity_tpu.telemetry.tracectx import (
+                TailSampler,
+            )
+
+            self._trace_sink = TailSampler(
+                self._trace_log,
+                trace_sample,
+                latency=self._m_latency,
+                registry=m,
+            )
         self._batcher = None
         if batch_window_ms and batch_window_ms > 0:
             from kubernetesclustercapacity_tpu.service.batching import (
@@ -365,6 +388,7 @@ class CapacityServer:
                 window_s=float(batch_window_ms) / 1e3,
                 max_batch=batch_max,
                 registry=m,
+                trace_sink=self._trace_sink,
             )
         # Per-dispatch-thread context: the snapshot generation captured
         # under the dispatch lock, so the flight record says which
@@ -411,6 +435,18 @@ class CapacityServer:
     def flight_recorder(self):
         """The server's request flight recorder (read-mostly surface)."""
         return self._flight
+
+    def tracing_stats(self) -> dict:
+        """Distributed-tracing status (the ``info {tracing: true}``
+        section and the doctor's tracing line): whether span recording
+        is armed, the sampling policy, and the kept/dropped ledger."""
+        out: dict = {
+            "armed": self._trace_sink is not None,
+            "request_log": self._request_log is not None,
+        }
+        if self._trace_sink is not None:
+            out.update(self._trace_sink.stats())
+        return out
 
     @property
     def timeline(self):
@@ -590,12 +626,18 @@ class CapacityServer:
         }
     )
 
-    def _audit_request(self, msg, op_label, gen, error, result, tenant=None):
+    def _audit_request(
+        self, msg, op_label, gen, error, result, tenant=None,
+        trace_sampled=None,
+    ):
         """One audit-log request record; returns its audit ref (or
         ``None``).  Best-effort: the audit trail observes dispatch, it
         never fails it.  When tenancy is armed the DERIVED tenant rides
         the stripped args (tokens never do), so audit replay can filter
-        a single tenant's traffic."""
+        a single tenant's traffic.  ``trace_sampled`` is the tail
+        sampler's verdict for this request (``None`` = no sampler),
+        recorded so a replayed divergence knows whether a trace tree
+        exists for it."""
         if self._audit is None or op_label not in self._AUDITED_OPS:
             return None
         from kubernetesclustercapacity_tpu.audit.log import strip_args
@@ -611,6 +653,7 @@ class CapacityServer:
                 status="error" if error else "ok",
                 result=result,
                 error=error,
+                trace_sampled=trace_sampled,
             )
         except Exception:  # noqa: BLE001 - auditing never fails an op
             return None
@@ -740,6 +783,28 @@ class CapacityServer:
             raise ValueError(
                 f"trace_id must be a string, got {trace_id!r}"
             )
+        # Full trace context (the additive ``tracectx.WIRE_FIELDS``
+        # envelope), parsed up front so the request span id exists for
+        # the WHOLE dispatch — the micro-batcher parents its
+        # join/dispatch spans to it through the dispatch TLS.  An
+        # untraced request (no caller ``trace_id``) still gets a span
+        # id at record time (the request-log join needs one) but no
+        # trace linkage.
+        from kubernetesclustercapacity_tpu.telemetry import (
+            tracectx as _tracectx,
+        )
+
+        trace_armed = (
+            self._trace_sink is not None or self._request_log is not None
+        )
+        span_ctx = _tracectx.from_wire(msg) if trace_armed else None
+        parent_span_id = msg.get("parent_span_id")
+        if not isinstance(parent_span_id, str) or not parent_span_id:
+            parent_span_id = None
+        self._dispatch_tls.trace_ctx = (
+            span_ctx if self._trace_sink is not None else None
+        )
+        wall0 = _time.time()
         # Tenant attribution happens ONCE, up front, and rides the
         # whole dispatch: admission quotas, the micro-batcher (via the
         # dispatch TLS), per-tenant metrics, the request log, the audit
@@ -812,7 +877,15 @@ class CapacityServer:
             _phases.restore(prev_clk)
             dur = _time.perf_counter() - t0
             self._m_inflight.dec()
-            self._m_latency.labels(op=op_label).observe(dur)
+            # Exemplar: the last trace id to land in each latency
+            # bucket, exposed in OpenMetrics exemplar syntax — the
+            # metrics→traces join ("what was a p99 request? here's one").
+            self._m_latency.labels(op=op_label).observe(
+                dur,
+                exemplar=(
+                    span_ctx.trace_id if span_ctx is not None else None
+                ),
+            )
             self._dispatch_tls.tenant = None
             if self._m_tenant_latency is not None:
                 self._m_tenant_latency.labels(
@@ -831,47 +904,66 @@ class CapacityServer:
             # Persisted (not cleared) for the reply envelope: the
             # handler thread reads it right after dispatch returns.
             self._dispatch_tls.last_generation = gen
+            # Tail verdict BEFORE emission: the request span rides the
+            # same keep/drop decision as its buffered children, and the
+            # verdict lands in the flight/audit records as
+            # ``trace_sampled``.  An upstream hop's sticky decision
+            # (envelope ``trace_sampled: true``) forces keep.
+            sampled = None
+            if span_ctx is not None and self._trace_sink is not None:
+                sampled = self._trace_sink.decide(
+                    op_label, dur, error, forced=span_ctx.sampled
+                )
+            self._dispatch_tls.trace_ctx = None
             # One span ID correlates the trace-log span with the JSON
             # request-log line — minted only when something records it.
             span_id = None
-            if self._trace_log is not None or self._request_log is not None:
-                from kubernetesclustercapacity_tpu.telemetry.tracing import (
-                    new_span_id,
+            if trace_armed:
+                span_id = (
+                    span_ctx.span_id
+                    if span_ctx is not None
+                    else _tracectx.new_span_id()
                 )
-
-                span_id = new_span_id()
-            if self._trace_log is not None:
-                try:
-                    self._trace_log.record(
+            if self._trace_sink is not None:
+                _tracectx.span(
+                    self._trace_sink,
+                    ts=_time.time(),
+                    start_ts=wall0,
+                    trace_id=span_ctx.trace_id if span_ctx else "",
+                    span_id=span_id,
+                    **(
+                        {"parent_span_id": parent_span_id}
+                        if span_ctx is not None and parent_span_id
+                        else {}
+                    ),
+                    op=op_label,
+                    service="server",
+                    **({"hops": span_ctx.hops} if span_ctx else {}),
+                    duration_ms=round(dur * 1e3, 3),
+                    status="error" if error else "ok",
+                    **({"error": error} if error else {}),
+                )
+                # One child span per recorded phase, parented to the
+                # request span — the decomposition in trace form, so
+                # a trace viewer shows WHERE inside the dispatch the
+                # time went (span_id still joins the request log).
+                for ph, secs in phase_items:
+                    _tracectx.span(
+                        self._trace_sink,
                         ts=_time.time(),
-                        trace_id=trace_id or "",
-                        span_id=span_id,
-                        op=op_label,
-                        duration_ms=round(dur * 1e3, 3),
-                        status="error" if error else "ok",
-                        **({"error": error} if error else {}),
+                        trace_id=span_ctx.trace_id if span_ctx else "",
+                        span_id=_tracectx.new_span_id(),
+                        parent_span_id=span_id,
+                        op=f"phase:{ph}",
+                        phase=ph,
+                        service="server",
+                        duration_ms=round(secs * 1e3, 3),
+                        status="ok",
                     )
-                    # One child span per recorded phase, parented to the
-                    # request span — the decomposition in trace form, so
-                    # a trace viewer shows WHERE inside the dispatch the
-                    # time went (span_id still joins the request log).
-                    from kubernetesclustercapacity_tpu.telemetry.tracing import (  # noqa: E501
-                        new_span_id as _new_span_id,
+                if span_ctx is not None:
+                    self._trace_sink.finish(
+                        span_ctx.trace_id, keep=bool(sampled)
                     )
-
-                    for ph, secs in phase_items:
-                        self._trace_log.record(
-                            ts=_time.time(),
-                            trace_id=trace_id or "",
-                            span_id=_new_span_id(),
-                            parent_span_id=span_id,
-                            op=f"phase:{ph}",
-                            phase=ph,
-                            duration_ms=round(secs * 1e3, 3),
-                            status="ok",
-                        )
-                except Exception:  # noqa: BLE001 - tracing must not fail ops
-                    pass
             if self._request_log is not None:
                 try:
                     self._request_log.record(
@@ -888,16 +980,18 @@ class CapacityServer:
                 except Exception:  # noqa: BLE001 - logging must not fail ops
                     pass
             audit_ref = self._audit_request(
-                msg, op_label, gen, error, result, tenant=tenant
+                msg, op_label, gen, error, result, tenant=tenant,
+                trace_sampled=sampled,
             )
             self._flight_record(
                 msg, op_label, trace_id, dur, error, result, gen, audit_ref,
                 phases=(clk.to_ms() if clk else None), tenant=tenant,
+                trace_sampled=sampled,
             )
 
     def _flight_record(
         self, msg, op_label, trace_id, dur, error, result, gen,
-        audit_ref=None, phases=None, tenant=None,
+        audit_ref=None, phases=None, tenant=None, trace_sampled=None,
     ) -> None:
         """One flight-recorder entry per dispatch (the failing request
         included), then — on error, when configured — the whole ring
@@ -920,6 +1014,7 @@ class CapacityServer:
                 audit_ref=audit_ref,
                 phases=phases,
                 tenant=tenant or "",
+                trace_sampled=trace_sampled,
             )
             if error and self._flight_dump_path:
                 self._flight.dump_jsonl(self._flight_dump_path)
@@ -1161,6 +1256,13 @@ class CapacityServer:
                             else None
                         ),
                     }
+            # Opt-in (``info {tracing: true}``): distributed-tracing
+            # status — whether span propagation is armed, the sampling
+            # policy, and the kept/dropped span ledger.  The doctor's
+            # tracing line reads this; opt-in for the
+            # pinned-default-shape reason the other sections are.
+            if msg.get("tracing"):
+                out["tracing"] = self.tracing_stats()
             if msg.get("audit"):
                 out["audit"] = {
                     "enabled": (
@@ -2204,6 +2306,15 @@ class CapacityServer:
             raise ValueError(
                 f"filter_tenant must be a string, got {tenant_f!r}"
             )
+        # ``sampled`` filters on the tail sampler's recorded verdict:
+        # True = records whose trace tree was retained (a ``-trace-tree``
+        # will find them), False = records whose tree was dropped.
+        # Records with no verdict (no sampler armed) match neither.
+        sampled_f = msg.get("sampled")
+        if sampled_f is not None and not isinstance(sampled_f, bool):
+            raise ValueError(
+                f"sampled filter must be a boolean, got {sampled_f!r}"
+            )
         limit = msg.get("limit")
         if limit is not None:
             if isinstance(limit, bool) or not isinstance(limit, int):
@@ -2217,6 +2328,10 @@ class CapacityServer:
             records = [r for r in records if r.get("status") == status]
         if tenant_f is not None:
             records = [r for r in records if r.get("tenant") == tenant_f]
+        if sampled_f is not None:
+            records = [
+                r for r in records if r.get("trace_sampled") is sampled_f
+            ]
         matched = len(records)
         if limit is not None:
             records = records[-limit:]
@@ -2305,6 +2420,7 @@ class CapacityServer:
                     # dispatch, split per tenant on return, bit-exact
                     # vs solo) — the label only feeds accounting.
                     tenant=getattr(self._dispatch_tls, "tenant", None),
+                    trace=getattr(self._dispatch_tls, "trace_ctx", None),
                 )
             )
         else:
@@ -2330,9 +2446,11 @@ class CapacityServer:
         # dispatcher's).  Best-effort by the observability contract.
         if self._shadow is not None:
             try:
+                ctx = getattr(self._dispatch_tls, "trace_ctx", None)
                 self._shadow.maybe_submit(
                     snap, generation, grid, totals, sched,
                     node_mask=implicit_mask,
+                    trace_id=ctx.trace_id if ctx is not None else None,
                 )
             except Exception:  # noqa: BLE001 - monitoring never fails ops
                 pass
@@ -2756,6 +2874,13 @@ def main(argv=None) -> int:
                    dest="trace_log_max_bytes", metavar="N",
                    help="rotate the -trace-log file to PATH.1 once it "
                         "exceeds N bytes (0 = unbounded)")
+    p.add_argument("-trace-sample", default="always", dest="trace_sample",
+                   metavar="SPEC",
+                   help="tail-based sampling policy for -trace-log span "
+                        "bodies: always | p99-breach | errors | rate:N "
+                        "(ids still propagate for every request; the "
+                        "keep/drop decision happens at request END so "
+                        "breaching requests keep their whole span tree)")
     p.add_argument("-flight-records", type=int, default=256,
                    dest="flight_records", metavar="K",
                    help="flight-recorder depth: remember the last K "
@@ -2986,6 +3111,24 @@ def main(argv=None) -> int:
         trace_log = TraceLog(
             args.trace_log, max_bytes=max(args.trace_log_max_bytes, 0)
         )
+    try:
+        from kubernetesclustercapacity_tpu.telemetry.tracectx import (
+            parse_sample_spec,
+        )
+
+        parse_sample_spec(args.trace_sample)
+    except ValueError as e:
+        print(f"ERROR : {e}", file=sys.stderr)
+        if follower is not None:
+            follower.stop()
+        return 1
+    # Process self-telemetry (RSS/fds/threads/GC + build info) on the
+    # same registry the scrape serves — no-op under KCCAP_TELEMETRY=0.
+    from kubernetesclustercapacity_tpu.telemetry.process import (
+        register_process_metrics,
+    )
+
+    register_process_metrics(REGISTRY)
     if args.node_bucket_floor > 0:
         from kubernetesclustercapacity_tpu import devcache
 
@@ -3146,6 +3289,7 @@ def main(argv=None) -> int:
             plane_pub = PlanePublisher(
                 host=args.host, port=args.plane_port,
                 token=auth_token, registry=REGISTRY,
+                trace_log=trace_log,
             )
         except OSError as e:
             print(f"ERROR : cannot bind plane port: {e}", file=sys.stderr)
@@ -3161,6 +3305,7 @@ def main(argv=None) -> int:
         stats_source=follower.stats if follower is not None else None,
         registry=REGISTRY,
         trace_log=trace_log,
+        trace_sample=args.trace_sample,
         flight_records=max(args.flight_records, 1),
         flight_dump_path=args.flight_dump,
         batch_window_ms=max(args.batch_window_ms, 0.0),
@@ -3204,6 +3349,7 @@ def main(argv=None) -> int:
             token=auth_token,
             stale_after_s=max(args.plane_stale_after_s, 0.1),
             registry=REGISTRY,
+            trace_log=trace_log,
         )
     metrics_server = None
     coalescer_ref: list = []  # filled below; healthz closes over it

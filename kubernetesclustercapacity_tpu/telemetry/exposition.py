@@ -59,8 +59,27 @@ def _label_block(labelnames, key, extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def _exemplar_suffix(ex: dict | None, le: str) -> str:
+    """The OpenMetrics exemplar tail for one bucket sample —
+    `` # {trace_id="..."} value ts`` — or ``""`` when the bucket never
+    carried one.  Classic v0.0.4 parsers that split on the LAST space
+    still read the line once they strip the `` # `` comment tail (the
+    test-side ``parse_exposition`` does exactly that)."""
+    if not ex:
+        return ""
+    entry = ex.get(le)
+    if entry is None:
+        return ""
+    return (
+        f' # {{trace_id="{escape_label_value(entry["trace_id"])}"}}'
+        f' {_format_value(entry["value"])} {entry["ts"]:.3f}'
+    )
+
+
 def render_text(registry: MetricsRegistry) -> str:
-    """The registry as Prometheus text format v0.0.4 (one scrape body)."""
+    """The registry as Prometheus text format v0.0.4 (one scrape body).
+    Histogram buckets that recorded an exemplar carry it in OpenMetrics
+    exemplar syntax — the metrics→traces join, no grepping required."""
     lines: list[str] = []
     for fam in registry.collect():
         lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
@@ -68,12 +87,14 @@ def render_text(registry: MetricsRegistry) -> str:
         for key, child in fam._items():
             if isinstance(child, _HistogramChild):
                 snap = child.snapshot()
+                exemplars = snap.get("exemplars")
                 for le, cum in snap["buckets"].items():
                     le_pair = 'le="%s"' % le
                     lines.append(
                         f"{fam.name}_bucket"
                         f"{_label_block(fam.labelnames, key, le_pair)}"
                         f" {_format_value(cum)}"
+                        f"{_exemplar_suffix(exemplars, le)}"
                     )
                 lines.append(
                     f"{fam.name}_sum{_label_block(fam.labelnames, key)}"

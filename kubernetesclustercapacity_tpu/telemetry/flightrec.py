@@ -30,8 +30,12 @@ __all__ = ["FlightRecorder", "args_digest", "result_digest"]
 #: Envelope fields never folded into a digest: secrets (the shared
 #: ``token`` AND the per-tenant ``tenant_token`` — a per-tenant secret
 #: is still a secret), and fields that vary per attempt without
-#: changing what the request MEANS.
-_DIGEST_EXCLUDED = ("token", "tenant_token", "trace_id", "deadline")
+#: changing what the request MEANS (the whole trace-context envelope:
+#: ids, the remote parent span, the sampling verdict, the hop count).
+_DIGEST_EXCLUDED = (
+    "token", "tenant_token", "trace_id", "deadline",
+    "parent_span_id", "trace_sampled", "trace_hops",
+)
 
 _DIGEST_HEX = 16  # 64 bits of SHA-256 — plenty for correlation, tiny on disk
 
@@ -89,6 +93,7 @@ class FlightRecorder:
         audit_ref: str | None = None,
         phases: dict | None = None,
         tenant: str = "",
+        trace_sampled: bool | None = None,
     ) -> None:
         """``audit_ref`` — the ``segment:offset`` pointer into the
         server's audit log for this same request (when auditing is on),
@@ -98,7 +103,11 @@ class FlightRecorder:
         :class:`~.phases.PhaseClock`'s compact form), so a slow request
         pasted from a dump is self-explaining.  ``tenant`` — the DERIVED
         tenant identity (never a token); empty when tenancy is off, and
-        then absent from the record so pre-tenancy dumps are unchanged."""
+        then absent from the record so pre-tenancy dumps are unchanged.
+        ``trace_sampled`` — the tail-sampling verdict for this request
+        (True = its full span tree was retained in the trace log), so a
+        ``-replay`` of a divergence knows whether a trace exists for it;
+        ``None`` (no sampler armed) keeps the record shape unchanged."""
         rec = {
             "seq": 0,  # assigned under the lock
             "ts": time.time() if ts is None else ts,
@@ -112,6 +121,8 @@ class FlightRecorder:
         }
         if tenant:
             rec["tenant"] = tenant
+        if trace_sampled is not None:
+            rec["trace_sampled"] = bool(trace_sampled)
         if error:
             rec["error"] = error
         if audit_ref:

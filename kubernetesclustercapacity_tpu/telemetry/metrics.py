@@ -236,7 +236,9 @@ class Gauge(_Family):
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+    __slots__ = (
+        "_lock", "_buckets", "_counts", "_sum", "_count", "_exemplars",
+    )
 
     def __init__(self, lock: threading.Lock, buckets: tuple) -> None:
         self._lock = lock
@@ -244,9 +246,16 @@ class _HistogramChild:
         self._counts = [0] * len(buckets)
         self._sum = 0.0
         self._count = 0
+        # Lazily-allocated per-bucket exemplars: bucket index (len(
+        # buckets) = the +Inf bucket) -> (trace_id, value, ts).  Memory
+        # is bounded by the bucket count — LAST exemplar wins, which is
+        # exactly the metrics→traces join an operator wants ("show me a
+        # recent trace that landed in this latency bucket").
+        self._exemplars: dict | None = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         value = float(value)
+        idx = len(self._buckets)  # +Inf unless a finite bucket matches
         with self._lock:
             self._sum += value
             self._count += 1
@@ -255,20 +264,40 @@ class _HistogramChild:
                     self._counts[i] += 1
                     # Non-cumulative internally; exposition/snapshot
                     # cumulate so one observe is one increment.
+                    idx = i
                     break
+            if exemplar:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                import time as _time
+
+                self._exemplars[idx] = (
+                    str(exemplar), value, _time.time()
+                )
 
     def snapshot(self) -> dict:
         """``{"buckets": {le: cumulative}, "sum": s, "count": n}`` with
-        the ``+Inf`` bucket explicit (== count, by construction)."""
+        the ``+Inf`` bucket explicit (== count, by construction).  When
+        any observation carried an exemplar, an ``"exemplars"`` entry
+        maps the bucket's ``le`` string to
+        ``{"trace_id", "value", "ts"}``."""
         with self._lock:
             counts = list(self._counts)
             total, s = self._count, self._sum
+            ex = dict(self._exemplars) if self._exemplars else None
         out, acc = {}, 0
         for b, c in zip(self._buckets, counts):
             acc += c
             out[_format_value(b)] = acc
         out["+Inf"] = total
-        return {"buckets": out, "sum": s, "count": total}
+        snap = {"buckets": out, "sum": s, "count": total}
+        if ex:
+            les = [_format_value(b) for b in self._buckets] + ["+Inf"]
+            snap["exemplars"] = {
+                les[i]: {"trace_id": t, "value": v, "ts": ts}
+                for i, (t, v, ts) in ex.items()
+            }
+        return snap
 
     @property
     def count(self) -> int:
@@ -313,8 +342,10 @@ class Histogram(_Family):
     def _make_child(self) -> _HistogramChild:
         return _HistogramChild(self._lock, self.buckets)
 
-    def observe(self, value: float, **labels) -> None:
-        self.labels(**labels).observe(value)
+    def observe(
+        self, value: float, exemplar: str | None = None, **labels
+    ) -> None:
+        self.labels(**labels).observe(value, exemplar=exemplar)
 
 
 class MetricsRegistry:

@@ -144,12 +144,21 @@ class Span:
         self._trace_log = trace_log
         self._extra = dict(extra or {})
         self._t0: float | None = None
+        self._wall0: float | None = None
 
     def __enter__(self) -> "Span":
         self._t0 = time.perf_counter()
+        self._wall0 = time.time()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        # duration_s is MONOTONIC (perf_counter): a wall-clock step
+        # mid-span (NTP slew, operator date change) can never yield a
+        # negative duration.  The wall clock appears only as the
+        # ``start_ts``/``ts`` anchors — which IS where a step shows up,
+        # so the trace analyzer flags spans whose recorded duration is
+        # negative (foreign/legacy writers) as ``clock_skew`` instead of
+        # feeding them to the critical path.
         self.duration_s = time.perf_counter() - (self._t0 or 0.0)
         if exc is not None:
             self.error = f"{exc_type.__name__}: {exc}"
@@ -158,6 +167,7 @@ class Span:
         if self._trace_log is not None:
             rec = {
                 "ts": time.time(),
+                "start_ts": getattr(self, "_wall0", None),
                 "trace_id": self.trace_id,
                 "span_id": self.span_id,
                 "op": self.op,

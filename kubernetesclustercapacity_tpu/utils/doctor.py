@@ -742,6 +742,46 @@ def doctor_report(
 
         check("flight recorder", _flight)
 
+        # Tracing posture: is the server emitting spans at all, what
+        # tail-sampling policy gates the bodies, and is the ring
+        # shedding (dropped spans mean traces are losing limbs under
+        # load — raise max_spans or tighten the sample spec).
+        def _tracing():
+            from kubernetesclustercapacity_tpu.resilience import RetryPolicy
+            from kubernetesclustercapacity_tpu.service.client import (
+                CapacityClient,
+            )
+
+            with CapacityClient(
+                *service_addr,
+                connect_timeout_s=5.0,
+                timeout_s=5.0,
+                retry=RetryPolicy(max_attempts=2, base_delay_s=0.1),
+                deadline_s=5.0,
+            ) as c:
+                tr = c.info(tracing=True).get("tracing", {})
+            if not tr.get("armed", False):
+                return (
+                    "not configured (-trace-log off"
+                    + (
+                        "; request log armed"
+                        if tr.get("request_log")
+                        else ""
+                    )
+                    + ")"
+                )
+            parts = [
+                f"ok: sample={tr.get('spec')}",
+                f"buffered={tr.get('buffered_traces')}",
+                f"kept={tr.get('kept_spans')}",
+            ]
+            dropped = tr.get("dropped_spans", 0)
+            if dropped:
+                parts.append(f"dropped={dropped} (ring shedding)")
+            return " ".join(parts)
+
+        check("tracing", _tracing)
+
     if federation_addr is not None:
         # The federation tier's degradation vector: which clusters are
         # fresh, which serve explicitly-stale views, and which are LOST.

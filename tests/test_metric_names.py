@@ -236,6 +236,33 @@ def test_scan_finds_the_tenancy_families():
     )
 
 
+def test_scan_finds_the_tracing_and_process_families():
+    """Non-vacuous pin for the tracing tier: the walk must see the
+    tail sampler's decision counter plus every process self-telemetry
+    family (so the README-documentation and snake_case gates below
+    actually cover them), and each must have a literal backticked
+    README row — the bare `kccap_*` glob in prose does NOT count as
+    documentation here, so this pin is stricter than the generic
+    gate."""
+    names = _source_metric_names()
+    tracing = {
+        "kccap_trace_spans_total",
+        "kccap_process_rss_bytes",
+        "kccap_process_open_fds",
+        "kccap_process_threads",
+        "kccap_process_gc_collections_total",
+        "kccap_build_info",
+    }
+    assert tracing <= names
+    with open(_README, encoding="utf-8") as fh:
+        readme = fh.read()
+    undocumented = sorted(n for n in tracing if f"`{n}`" not in readme)
+    assert not undocumented, (
+        "tracing/process metrics missing a literal row in the README "
+        f"observability table: {undocumented}"
+    )
+
+
 def test_metric_names_are_prefixed_snake_case():
     bad = sorted(
         n for n in _source_metric_names() if not _SNAKE_RE.fullmatch(n)
@@ -375,4 +402,81 @@ def test_phase_vocabulary_is_snake_case_and_in_readme():
     assert not missing, (
         "phases missing from the README's phase table: "
         + ", ".join(missing)
+    )
+
+
+def _source_span_fields() -> dict[str, set[str]]:
+    """Every field-name literal any ``span(...)`` emission call in the
+    package passes — explicit keywords plus string keys of ``**{...}``
+    splats (the conditional-field idiom ``**({"error": e} if e else
+    {})``) — keyed by ``path:line``.  The AST walk mirrors
+    ``kccap-lint``'s ``surface-span`` rule so the vocabulary gate
+    stands even when the analyzer is skipped."""
+    import ast
+
+    sites: dict[str, set[str]] = {}
+    for root, dirs, files in os.walk(_PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                is_span = (
+                    isinstance(func, ast.Name) and func.id == "span"
+                ) or (
+                    isinstance(func, ast.Attribute) and func.attr == "span"
+                )
+                if not is_span:
+                    continue
+                fields: set[str] = set()
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        fields.add(kw.arg)
+                        continue
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Dict):
+                            for key in sub.keys:
+                                if isinstance(
+                                    key, ast.Constant
+                                ) and isinstance(key.value, str):
+                                    fields.add(key.value)
+                if fields:
+                    rel = os.path.relpath(path, _REPO)
+                    sites[f"{rel}:{node.lineno}"] = fields
+    return sites
+
+
+def test_span_field_scan_finds_the_emission_sites():
+    # Sanity: a broken scan must fail loudly, not vacuously pass — the
+    # server emits request spans, the batcher leader/follower spans,
+    # the federation member spans, the replicaset attempt spans.
+    sites = _source_span_fields()
+    emitted = set().union(*sites.values())
+    assert {
+        "trace_id", "span_id", "parent_span_id", "duration_ms",
+        "links", "batch_size", "cluster", "hedge",
+    } <= emitted
+    assert len(sites) >= 8
+
+
+def test_every_span_field_is_in_the_vocabulary():
+    from kubernetesclustercapacity_tpu.telemetry.tracectx import (
+        SPAN_FIELDS,
+    )
+
+    rogue = {
+        site: sorted(fields - SPAN_FIELDS)
+        for site, fields in _source_span_fields().items()
+        if fields - SPAN_FIELDS
+    }
+    assert not rogue, (
+        "span fields emitted outside the documented SPAN_FIELDS "
+        "vocabulary (telemetry/tracectx.py) — emission silently drops "
+        f"them: {rogue}"
     )

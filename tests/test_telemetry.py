@@ -8,6 +8,7 @@ parsed by those same rules is trustworthy end-to-end.
 
 import json
 import os
+import re
 import threading
 import urllib.request
 
@@ -34,15 +35,23 @@ FIXTURE = os.path.join(
 )
 
 
-def parse_exposition(text: str) -> dict:
+def parse_exposition(text: str, exemplars: dict | None = None) -> dict:
     """Parse text-format v0.0.4 back into {name{labels}: float} — the
-    test-side half of the exposition contract."""
+    test-side half of the exposition contract.  OpenMetrics exemplar
+    tails (`` # {trace_id="..."} value ts``) are stripped before the
+    value parse; pass ``exemplars={}`` to collect them as
+    {name{labels}: trace_id}."""
     samples = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
-        name_labels, _, value = line.rpartition(" ")
+        sample, sep, tail = line.partition(" # ")
+        name_labels, _, value = sample.rpartition(" ")
         samples[name_labels] = float(value.replace("+Inf", "inf"))
+        if sep and exemplars is not None:
+            m = re.search(r'trace_id="([^"]*)"', tail)
+            if m:
+                exemplars[name_labels] = m.group(1)
     return samples
 
 
@@ -204,6 +213,63 @@ class TestExposition:
         r = MetricsRegistry()
         r.counter("hh_total", "line1\nline2 \\ backslash")
         assert "# HELP hh_total line1\\nline2 \\\\ backslash" in render_text(r)
+
+
+class TestExemplars:
+    """OpenMetrics exemplar tails: the metrics→traces join must
+    round-trip through the same parser the scrape contract leans on —
+    values parse unchanged, the trace id comes back out."""
+
+    def test_exemplar_round_trips_through_the_parser(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", "h", ("op",), buckets=(0.5, 1.5))
+        tid = new_trace_id()
+        h.observe(1.0, exemplar=tid, op="fit")
+        h.observe(9.0, op="fit")  # exemplar-less observation rides along
+        text = render_text(r)
+        exemplars: dict = {}
+        samples = parse_exposition(text, exemplars=exemplars)
+        # The tail never perturbs the value parse ...
+        assert samples['lat_seconds_bucket{op="fit",le="1.5"}'] == 1
+        assert samples['lat_seconds_bucket{op="fit",le="+Inf"}'] == 2
+        assert samples['lat_seconds_count{op="fit"}'] == 2
+        # ... and the trace id lands on exactly the bucket it hit.
+        assert exemplars == {'lat_seconds_bucket{op="fit",le="1.5"}': tid}
+
+    def test_last_exemplar_wins_per_bucket(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", "h", buckets=(1.0,))
+        first, last = new_trace_id(), new_trace_id()
+        h.observe(0.5, exemplar=first)
+        h.observe(0.7, exemplar=last)
+        exemplars: dict = {}
+        parse_exposition(render_text(r), exemplars=exemplars)
+        assert exemplars == {'lat_seconds_bucket{le="1"}': last}
+
+    def test_no_exemplar_no_tail(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", "h", buckets=(1.0,))
+        h.observe(0.5)
+        assert " # " not in render_text(r)
+
+    def test_scraped_metrics_exemplar_round_trip(self):
+        # The acceptance form: an exemplar-bearing /metrics body fetched
+        # over HTTP parses clean and yields the trace id.
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", "h", buckets=(1.0,))
+        tid = new_trace_id()
+        h.observe(0.5, exemplar=tid)
+        srv = start_metrics_server(r)
+        try:
+            body = urllib.request.urlopen(
+                srv.url + "/metrics"
+            ).read().decode()
+        finally:
+            srv.shutdown()
+        exemplars: dict = {}
+        samples = parse_exposition(body, exemplars=exemplars)
+        assert samples['lat_seconds_bucket{le="1"}'] == 1
+        assert exemplars['lat_seconds_bucket{le="1"}'] == tid
 
 
 class TestMetricsServer:

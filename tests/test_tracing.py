@@ -1,0 +1,820 @@
+"""Distributed tracing (ISSUE 18): envelope propagation on every link,
+tail-based sampling over a bounded ring, the clock-skew-tolerant
+offline analyzer, and the chaos/e2e acceptance suite.
+
+The acceptance bar: one ``fed_sweep``-bearing trace assembled from
+per-process JSONL logs into ONE tree containing client-attempt,
+admission-phase, batch-join, fed-member and device-dispatch spans; the
+critical path's dominating phase agreeing with the phase histograms;
+``KCCAP_TELEMETRY=0`` pinning zero registry traffic and byte-identical
+replies; a seeded partition mid-fleet-query leaving the lost cluster's
+span marked ``lost``, never absent.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetesclustercapacity_tpu.federation import FederationServer
+from kubernetesclustercapacity_tpu.service.client import CapacityClient
+from kubernetesclustercapacity_tpu.service.plane import AdmissionController
+from kubernetesclustercapacity_tpu.service.replicaset import ReplicaSet
+from kubernetesclustercapacity_tpu.service.server import CapacityServer
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+from kubernetesclustercapacity_tpu.telemetry.metrics import MetricsRegistry
+from kubernetesclustercapacity_tpu.telemetry.tracectx import (
+    MAX_HOPS,
+    SPAN_FIELDS,
+    TailSampler,
+    TraceContext,
+    TraceSampleError,
+    from_wire,
+    parse_sample_spec,
+    span,
+)
+from kubernetesclustercapacity_tpu.telemetry.tracing import (
+    TraceLog,
+    new_span_id,
+    new_trace_id,
+)
+from kubernetesclustercapacity_tpu.telemetry.traceview import (
+    analyze_trace,
+    assemble_tree,
+    critical_path,
+    load_spans,
+)
+from kubernetesclustercapacity_tpu.testing_faults import FaultPlan, FaultProxy
+
+CPU = [100, 500]
+MEM = [10 ** 8, 5 * 10 ** 8]
+REPS = [1, 8]
+GRID = {
+    "cpu_request_milli": CPU,
+    "mem_request_bytes": MEM,
+    "replicas": REPS,
+}
+
+
+def _lines(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Context propagation primitives
+# ---------------------------------------------------------------------------
+class TestTraceContext:
+    def test_wire_round_trip_advances_hops_and_parents(self):
+        ctx = TraceContext(hops=2)
+        wire = ctx.to_wire()
+        assert wire["trace_id"] == ctx.trace_id
+        assert wire["parent_span_id"] == ctx.span_id
+        assert wire["trace_hops"] == 3
+        assert "trace_sampled" not in wire  # only sent once sticky
+        got = from_wire(wire)
+        assert got.trace_id == ctx.trace_id
+        assert got.hops == 3
+        assert got.span_id != ctx.span_id  # fresh span for THIS hop
+        assert got.sampled is False
+
+    def test_sampled_verdict_is_sticky_across_the_wire(self):
+        ctx = TraceContext(sampled=True)
+        wire = ctx.to_wire()
+        assert wire["trace_sampled"] is True
+        assert from_wire(wire).sampled is True
+
+    def test_hop_cap_stops_propagation_not_the_request(self):
+        assert TraceContext(hops=MAX_HOPS).to_wire() == {}
+        assert TraceContext(hops=MAX_HOPS - 1).to_wire()["trace_hops"] == MAX_HOPS
+
+    def test_from_wire_without_trace_id_is_untraced(self):
+        assert from_wire({}) is None
+        assert from_wire({"trace_id": ""}) is None
+        assert from_wire({"trace_id": 7}) is None
+
+    def test_from_wire_degrades_malformed_optionals(self):
+        got = from_wire(
+            {"trace_id": "t" * 32, "trace_hops": "nope",
+             "trace_sampled": "yes"}
+        )
+        assert got.hops == 0
+        assert got.sampled is False  # only literal True forces keep
+
+    def test_child_shares_trace_and_verdict(self):
+        ctx = TraceContext(sampled=True, hops=4)
+        kid = ctx.child()
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id != ctx.span_id
+        assert kid.sampled and kid.hops == 4
+
+
+class TestSpanEmission:
+    def test_off_vocabulary_fields_are_dropped_not_written(self, tmp_path):
+        log = TraceLog(str(tmp_path / "t.jsonl"))
+        span(log, trace_id="x", span_id="y", duration_ms=1.0, op="demo",
+             not_a_field="boom")
+        (rec,) = _lines(str(tmp_path / "t.jsonl"))
+        assert "not_a_field" not in rec
+        assert set(rec) <= SPAN_FIELDS
+
+    def test_none_sink_and_raising_sink_never_fail_the_op(self):
+        span(None, trace_id="x")
+
+        class Bomb:
+            def record(self, **fields):
+                raise RuntimeError("sink down")
+
+        span(Bomb(), trace_id="x", op="demo")  # must not raise
+
+
+class TestSampleSpec:
+    @pytest.mark.parametrize(
+        "spec,want",
+        [("always", ("always", 1)), ("p99-breach", ("p99-breach", 1)),
+         ("errors", ("errors", 1)), ("rate:3", ("rate", 3)),
+         (" always ", ("always", 1))],
+    )
+    def test_grammar_accepts(self, spec, want):
+        assert parse_sample_spec(spec) == want
+
+    @pytest.mark.parametrize("spec", ["", "rate:0", "rate:x", "sometimes",
+                                      "rate:-1", "p99"])
+    def test_grammar_rejects(self, spec):
+        with pytest.raises(TraceSampleError):
+            parse_sample_spec(spec)
+
+
+# ---------------------------------------------------------------------------
+# Tail-based sampling
+# ---------------------------------------------------------------------------
+class TestTailSampler:
+    def test_always_writes_through_without_buffering(self, tmp_path):
+        log = TraceLog(str(tmp_path / "t.jsonl"))
+        ts = TailSampler(log, "always")
+        ts.record(trace_id="a", span_id="s", duration_ms=1.0, op="x")
+        assert len(_lines(str(tmp_path / "t.jsonl"))) == 1  # pre-finish
+        assert ts.kept_spans == 1 and ts.stats()["buffered_traces"] == 0
+
+    def test_errors_spec_keeps_only_errored_requests(self, tmp_path):
+        log = TraceLog(str(tmp_path / "t.jsonl"))
+        ts = TailSampler(log, "errors")
+        for tid in ("ok1", "bad"):
+            ts.record(trace_id=tid, span_id="s", duration_ms=1.0, op="x")
+        assert _lines(str(tmp_path / "t.jsonl")) == []  # all buffered
+        ts.finish("ok1", keep=ts.decide("x", 0.001, None))
+        ts.finish("bad", keep=ts.decide("x", 0.001, "ValueError: boom"))
+        kept = _lines(str(tmp_path / "t.jsonl"))
+        assert [r["trace_id"] for r in kept] == ["bad"]
+        assert ts.dropped_spans == 1 and ts.kept_spans == 1
+
+    def test_rate_n_is_deterministic_and_keeps_the_first(self, tmp_path):
+        ts = TailSampler(TraceLog(str(tmp_path / "t.jsonl")), "rate:3")
+        verdicts = [ts.decide("x", 0.001, None) for _ in range(7)]
+        assert verdicts == [True, False, False, True, False, False, True]
+
+    def test_forced_keep_overrides_the_predicate(self, tmp_path):
+        ts = TailSampler(TraceLog(str(tmp_path / "t.jsonl")), "errors")
+        assert ts.decide("x", 0.001, None, forced=True) is True
+
+    def test_ring_evicts_oldest_trace_and_counts_the_loss(self, tmp_path):
+        log = TraceLog(str(tmp_path / "t.jsonl"))
+        reg = MetricsRegistry()
+        ts = TailSampler(log, "errors", max_traces=2, registry=reg)
+        for tid in ("t1", "t2", "t3"):  # t3 evicts t1
+            ts.record(trace_id=tid, span_id="s", duration_ms=1.0, op="x")
+        assert ts.stats()["buffered_traces"] == 2
+        assert ts.dropped_spans == 1
+        ts.finish("t1", keep=True)  # evicted: nothing to flush
+        assert _lines(str(tmp_path / "t.jsonl")) == []
+        snap = reg.snapshot()["kccap_trace_spans_total"]
+        assert snap["values"]['decision="dropped"'] == 1
+
+    def test_per_trace_span_cap_sheds_the_excess(self, tmp_path):
+        log = TraceLog(str(tmp_path / "t.jsonl"))
+        ts = TailSampler(log, "errors", max_spans_per_trace=2)
+        for i in range(5):
+            ts.record(trace_id="t", span_id=f"s{i}", duration_ms=1.0, op="x")
+        ts.finish("t", keep=True)
+        assert len(_lines(str(tmp_path / "t.jsonl"))) == 2
+        assert ts.dropped_spans == 3
+
+    def test_stats_shape(self, tmp_path):
+        ts = TailSampler(TraceLog(str(tmp_path / "t.jsonl")), "rate:2")
+        assert set(ts.stats()) == {
+            "spec", "buffered_traces", "kept_spans", "dropped_spans"
+        }
+        assert ts.stats()["spec"] == "rate:2"
+
+    def test_hammer_driver_exact_counts_under_16_threads(self):
+        """Satellite (d): the sanitize hammer's TailSampler driver —
+        16 threads of record/finish/evict churn, then the ledgers must
+        balance EXACTLY: kept == sink-written, and kept + dropped +
+        still-buffered == issued.  Lost or invented spans fail."""
+        from kubernetesclustercapacity_tpu.analysis import hammer
+
+        ops, cleanup = hammer._drive_tail_sampler()
+        errors = hammer._spin(ops, threads=16, iters=200)
+        assert errors == []
+        cleanup()  # raises AssertionError on any ledger drift
+
+    def test_tail_sampler_is_on_the_sanitize_gate(self):
+        from kubernetesclustercapacity_tpu.analysis import hammer
+
+        assert (
+            "kubernetesclustercapacity_tpu.telemetry.tracectx",
+            "TailSampler",
+        ) in hammer.HAMMERED_CLASSES
+
+
+# ---------------------------------------------------------------------------
+# The offline analyzer: clock-skew tolerance is the point
+# ---------------------------------------------------------------------------
+class TestAnalyzer:
+    def _write(self, path, spans):
+        with open(path, "w") as fh:
+            for s in spans:
+                fh.write(json.dumps(s) + "\n")
+
+    def test_negative_duration_flags_skew_and_refuses_the_path(
+        self, tmp_path
+    ):
+        """Satellite (a): durations are monotonic by construction, so a
+        negative one means a corrupt/foreign log — the analyzer flags
+        the span ``clock_skew`` and refuses to claim a critical path
+        through it rather than reporting garbage."""
+        log = str(tmp_path / "p1.jsonl")
+        self._write(log, [
+            {"trace_id": "T", "span_id": "root", "op": "a",
+             "service": "x", "duration_ms": 10.0},
+            {"trace_id": "T", "span_id": "kid", "parent_span_id": "root",
+             "op": "b", "service": "x", "duration_ms": -3.0},
+        ])
+        tree = analyze_trace([log], "T")
+        assert tree["found"] and "kid" in tree["clock_skew_spans"]
+        assert tree["critical_path"]["refused"] == "clock_skew"
+
+    def test_skew_off_the_path_does_not_refuse(self, tmp_path):
+        log = str(tmp_path / "p1.jsonl")
+        self._write(log, [
+            {"trace_id": "T", "span_id": "root", "op": "a",
+             "service": "x", "duration_ms": 10.0},
+            {"trace_id": "T", "span_id": "fast", "parent_span_id": "root",
+             "op": "b", "service": "x", "duration_ms": 9.0,
+             "phase": "device_exec"},
+        ])
+        # A skewed span in a DIFFERENT trace never poisons this one.
+        with open(log, "a") as fh:
+            fh.write(json.dumps({
+                "trace_id": "U", "span_id": "z", "op": "c",
+                "service": "x", "duration_ms": -1.0,
+            }) + "\n")
+        cp = analyze_trace([log], "T")["critical_path"]
+        assert not cp.get("refused")
+        assert cp["dominant"]["name"] == "device_exec"
+
+    def test_orphans_are_promoted_and_counted_never_dropped(self, tmp_path):
+        log = str(tmp_path / "p1.jsonl")
+        self._write(log, [
+            {"trace_id": "T", "span_id": "lonely",
+             "parent_span_id": "never-arrived", "op": "a", "service": "x",
+             "duration_ms": 1.0},
+        ])
+        tree = assemble_tree(load_spans([log]), "T")
+        assert tree["orphans"] == 1 and len(tree["roots"]) == 1
+
+    def test_multi_process_stitching_needs_no_clock_agreement(
+        self, tmp_path
+    ):
+        # Two "processes" with wall clocks 1000s apart: linkage alone
+        # must assemble them (parent ids, never timestamps).
+        self._write(str(tmp_path / "client.jsonl"), [
+            {"trace_id": "T", "span_id": "c1", "op": "rs:sweep",
+             "service": "replicaset", "duration_ms": 12.0,
+             "ts": 2_000_000.0},
+        ])
+        self._write(str(tmp_path / "server.jsonl"), [
+            {"trace_id": "T", "span_id": "s1", "parent_span_id": "c1",
+             "op": "sweep", "service": "server", "duration_ms": 10.0,
+             "ts": 1_000.0},
+        ])
+        tree = analyze_trace([str(tmp_path)], "T")
+        assert tree["processes"] == ["replicaset", "server"]
+        (root,) = tree["roots"]
+        assert [c["span_id"] for c in root["children"]] == ["s1"]
+
+    def test_unknown_trace_reports_not_found(self, tmp_path):
+        self._write(str(tmp_path / "p.jsonl"), [])
+        tree = analyze_trace([str(tmp_path)], "missing")
+        assert not tree["found"]
+
+
+# ---------------------------------------------------------------------------
+# Flight/audit records carry the tail verdict (satellite c)
+# ---------------------------------------------------------------------------
+class TestSampledRecords:
+    def test_flight_records_carry_verdict_and_dump_filters_on_it(
+        self, tmp_path
+    ):
+        snap = synthetic_snapshot(16, seed=3)
+        srv = CapacityServer(
+            snap, port=0, batch_window_ms=0.0,
+            trace_log=str(tmp_path / "t.jsonl"), trace_sample="errors",
+        )
+        srv.start()
+        try:
+            with CapacityClient(*srv.address, trace=True) as c:
+                c.sweep(**GRID)  # ok -> dropped by the "errors" spec
+                with pytest.raises(RuntimeError):
+                    c.call("sweep", cpu_request_milli=[100],
+                           mem_request_bytes=[1], replicas=[1, 2, 3])
+                kept = c.dump(sampled=True)["records"]
+                dropped = c.dump(sampled=False)["records"]
+            assert [r["status"] for r in kept] == ["error"]
+            assert kept[0]["trace_sampled"] is True
+            assert all(r["trace_sampled"] is False for r in dropped)
+            assert any(r["op"] == "sweep" for r in dropped)
+        finally:
+            srv.shutdown()
+
+    def test_dump_sampled_filter_rejects_non_bool(self, tmp_path):
+        snap = synthetic_snapshot(16, seed=3)
+        srv = CapacityServer(snap, port=0, batch_window_ms=0.0)
+        srv.start()
+        try:
+            with CapacityClient(*srv.address) as c:
+                with pytest.raises(RuntimeError):
+                    c.call("dump", sampled="yes")
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# KCCAP_TELEMETRY=0: zero registry traffic, byte-identical replies
+# ---------------------------------------------------------------------------
+class TestTelemetryDisabled:
+    def test_no_trace_counter_registered_and_replies_identical(
+        self, tmp_path, monkeypatch
+    ):
+        snap = synthetic_snapshot(24, seed=9)
+
+        def answer(**kw):
+            srv = CapacityServer(snap, port=0, batch_window_ms=0.0, **kw)
+            srv.start()
+            try:
+                with CapacityClient(*srv.address, trace=True) as c:
+                    return c.sweep(**GRID), srv.registry.snapshot()
+            finally:
+                srv.shutdown()
+
+        baseline, _ = answer()
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        traced, reg = answer(
+            trace_log=str(tmp_path / "t.jsonl"), trace_sample="always"
+        )
+        # Byte-identical replies: arming tracing changed no answer.
+        assert json.dumps(traced, sort_keys=True) == json.dumps(
+            baseline, sort_keys=True
+        )
+        # Zero registry traffic from the sampler: the decision counter
+        # is never even registered when telemetry is off.
+        assert "kccap_trace_spans_total" not in reg
+
+    def test_enabled_sampler_registers_the_decision_counter(self, tmp_path):
+        reg = MetricsRegistry()
+        ts = TailSampler(
+            TraceLog(str(tmp_path / "t.jsonl")), "always", registry=reg
+        )
+        ts.record(trace_id="a", span_id="s", duration_ms=1.0, op="x")
+        snap = reg.snapshot()["kccap_trace_spans_total"]
+        assert snap["values"]['decision="kept"'] == 1
+
+
+# ---------------------------------------------------------------------------
+# Chaos: seeded partition mid-fleet-query, hedged siblings (satellite d)
+# ---------------------------------------------------------------------------
+class TestChaosPropagation:
+    def test_partitioned_cluster_span_is_lost_never_absent(self, tmp_path):
+        """A seeded FaultProxy partition severs one leader's plane
+        stream mid-run; past the eviction horizon a traced fleet query
+        must still parse into a tree whose member span for the lost
+        cluster says ``lost`` — a degraded query SHOWS the hole."""
+        now = [0.0]
+        from kubernetesclustercapacity_tpu.service.plane import (
+            PlanePublisher,
+        )
+
+        names = ("east", "west", "north")
+        leaders, pubs, proxies = {}, {}, {}
+        for i, name in enumerate(names):
+            pub = PlanePublisher(heartbeat_s=0.1)
+            srv = CapacityServer(
+                synthetic_snapshot(16, seed=20 + i), port=0, plane=pub,
+                batch_window_ms=0.0,
+            )
+            srv.start()
+            proxies[name] = FaultProxy(
+                pub.address, FaultPlan([]), stream=True
+            ).start()
+            leaders[name], pubs[name] = srv, pub
+        fed = FederationServer(
+            {n: proxies[n].address for n in names},
+            stale_after_s=2.0, evict_after_s=6.0,
+            clock=lambda: now[0], seed=11,
+            trace_log=str(tmp_path / "fed.jsonl"), trace_sample="always",
+        ).start()
+        rs = ReplicaSet(
+            [fed.address], connect_timeout_s=5.0, timeout_s=30.0,
+            trace_log=str(tmp_path / "rs.jsonl"),
+        )
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and any(
+                c["state"] != "fresh"
+                for c in fed.status()["clusters"].values()
+            ):
+                time.sleep(0.02)
+            proxies["east"].partition("both")
+            # Advance the injected clock until east ages past the evict
+            # horizon.  (A heartbeat frame already in flight when the
+            # partition landed may re-verify once — advancing each
+            # iteration makes the transition inevitable, never racy.)
+            deadline = time.monotonic() + 15
+            while (
+                time.monotonic() < deadline
+                and fed.status()["clusters"]["east"]["state"] != "lost"
+            ):
+                now[0] += 10.0
+                time.sleep(0.05)
+            # The clock stops advancing; the survivors' heartbeats
+            # re-verify them at the final clock reading -> fresh again.
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and any(
+                fed.status()["clusters"][n]["state"] != "fresh"
+                for n in ("west", "north")
+            ):
+                time.sleep(0.02)
+            reply = rs.call("fed_sweep", **GRID)
+            assert reply["excluded"] == ["east"]
+
+            tid = _lines(str(tmp_path / "rs.jsonl"))[-1]["trace_id"]
+            tree = analyze_trace([str(tmp_path)], tid)
+            assert tree["found"]
+
+            def nodes(n):
+                yield n
+                for ch in n.get("children", ()):
+                    yield from nodes(ch)
+
+            flat = [s for r in tree["roots"] for s in nodes(r)]
+            members = {
+                s["cluster"]: s for s in flat if s["op"] == "fed:member"
+            }
+            assert set(members) == set(names)  # lost is PRESENT
+            assert members["east"]["state"] == "lost"
+            assert members["east"]["status"] == "error"
+            assert members["east"]["duration_ms"] == 0.0
+            assert all(
+                members[n]["state"] == "fresh" for n in ("west", "north")
+            )
+            # The request span chains under the client's attempt span.
+            ops = {s["op"] for s in flat}
+            assert {"rs:fed_sweep", "rs:attempt", "fed:fed_sweep"} <= ops
+            assert not tree["critical_path"].get("refused")
+        finally:
+            rs.close()
+            fed.close()
+            for name in names:
+                proxies[name].stop()
+                pubs[name].close()
+                leaders[name].shutdown()
+
+    def test_hedged_read_has_exactly_two_sibling_attempts_one_winner(
+        self, tmp_path
+    ):
+        """A stalled primary forces the hedge: the trace must show
+        exactly two sibling ``rs:attempt`` spans under the call span,
+        the winner flagged — the race made visible."""
+        snap = synthetic_snapshot(16, seed=7)
+        slow = CapacityServer(snap, port=0, batch_window_ms=0.0)
+        fast = CapacityServer(snap, port=0, batch_window_ms=0.0)
+        slow.start()
+        fast.start()
+        # Every frame through the primary stalls 1.5s; the hedge fires
+        # after ~hedge_max/4 = 50ms and wins on the fast replica.
+        proxy = FaultProxy(
+            slow.address, FaultPlan(["stall"] * 64), stall_s=1.5
+        ).start()
+        rs = ReplicaSet(
+            [proxy.address, fast.address],
+            connect_timeout_s=5.0, timeout_s=30.0, hedge=True,
+            hedge_min_delay_s=0.01, hedge_max_delay_s=0.2,
+            trace_log=str(tmp_path / "rs.jsonl"),
+        )
+        try:
+            r = rs.sweep(**GRID)
+            assert r["totals"]
+            # The losing (stalled) attempt's span lands when its stall
+            # finally drains — AFTER the hedge already won the call.
+            tid, attempts = None, []
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and len(attempts) < 2:
+                spans = _lines(str(tmp_path / "rs.jsonl"))
+                calls = [s for s in spans if s["op"] == "rs:sweep"]
+                if not calls:
+                    time.sleep(0.05)
+                    continue
+                tid = calls[-1]["trace_id"]
+                attempts = [
+                    s for s in spans
+                    if s["op"] == "rs:attempt" and s["trace_id"] == tid
+                ]
+                time.sleep(0.05)
+            assert len(attempts) == 2
+            assert [a["hedge"] for a in attempts].count(True) == 1
+            winners = [a for a in attempts if a.get("winner")]
+            assert len(winners) == 1
+            assert winners[0]["hedge"] is True  # the hedge won the race
+            call = [s for s in spans if s["op"] == "rs:sweep"
+                    and s["trace_id"] == tid]
+            assert len(call) == 1
+            assert {a["parent_span_id"] for a in attempts} == {
+                call[0]["span_id"]
+            }  # true siblings
+        finally:
+            rs.close()
+            proxy.stop()
+            slow.shutdown()
+            fast.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The e2e acceptance tree
+# ---------------------------------------------------------------------------
+class TestEndToEndTree:
+    def test_one_tree_from_client_to_device_dispatch(self, tmp_path):
+        """The acceptance tree: ONE driver-rooted trace crossing every
+        link — three concurrent traced sweeps through an admission-
+        controlled micro-batching server (two admitted immediately form
+        the batch: leader dispatch + follower join, linked; the third
+        waits at the 2-slot concurrency gate, which is what records the
+        admission phase), one heavy sweep through a second server (the
+        device-dispatch branch the critical path runs down), and a
+        fleet query through a federation with one cluster lost — all
+        assembled from five per-process JSONL logs, with the critical
+        path's dominating phase agreeing with the phase histograms."""
+        batch_srv = CapacityServer(
+            synthetic_snapshot(64, seed=13), port=0,
+            batch_window_ms=50.0,
+            admission=AdmissionController(max_concurrent=2, rps=1000.0),
+            trace_log=str(tmp_path / "server_batch.jsonl"),
+            trace_sample="always",
+        )
+        batch_srv.start()
+        heavy_srv = CapacityServer(
+            synthetic_snapshot(2048, seed=14), port=0,
+            batch_window_ms=0.0,
+            trace_log=str(tmp_path / "server_heavy.jsonl"),
+            trace_sample="always",
+        )
+        heavy_srv.start()
+        now = [0.0]
+        fed = FederationServer(
+            stale_after_s=2.0, evict_after_s=6.0, clock=lambda: now[0],
+            trace_log=str(tmp_path / "fed.jsonl"), trace_sample="always",
+        )
+        fed.inject("east", synthetic_snapshot(16, seed=1))
+        fed.start()
+        now[0] = 10.0  # east ages past evict_after_s -> lost ...
+        # ... while the survivors re-verify at the advanced clock.
+        fed.inject("west", synthetic_snapshot(16, seed=2))
+        fed.inject("north", synthetic_snapshot(16, seed=3))
+        rs = ReplicaSet(
+            [fed.address], connect_timeout_s=5.0, timeout_s=30.0,
+            trace_log=str(tmp_path / "rs.jsonl"),
+        )
+        driver_log = TraceLog(str(tmp_path / "driver.jsonl"))
+        ctx = TraceContext()
+        grid = 16384
+        heavy = {
+            "cpu_request_milli": [100 + i % 7 for i in range(grid)],
+            "mem_request_bytes": [10 ** 8] * grid,
+            "replicas": [1] * grid,
+        }
+        small = {
+            "cpu_request_milli": CPU,
+            "mem_request_bytes": MEM,
+            "replicas": REPS,
+        }
+        t0 = time.perf_counter()
+        try:
+            # Untraced warm-ups: every traced request below must take
+            # an already-compiled device path, so the critical path
+            # measures the serving topology (not one-time compilation)
+            # and the phase histogram never double-counts the dominant
+            # phase.  The batch server warms the COMBINED shape (the
+            # two batched grids concatenated) AND the solo shape (the
+            # gate-delayed third request dispatches alone, after the
+            # batch of two releases its slots); the fed warms its
+            # concatenated fleet dispatch.
+            with CapacityClient(*heavy_srv.address) as c:
+                c.call("sweep", **heavy)
+            with CapacityClient(*batch_srv.address) as c:
+                c.call(
+                    "sweep",
+                    cpu_request_milli=CPU * 2,
+                    mem_request_bytes=MEM * 2,
+                    replicas=REPS * 2,
+                )
+                c.call("sweep", **small)
+            fed.dispatch({"op": "fed_sweep", **GRID})
+            # Histogram baseline AFTER the warm-ups: the heavy server
+            # serves exactly ONE more request (the traced sweep), so
+            # the snapshot delta below is that request's phase seconds
+            # and nothing else — the warm-up's compile-path phases
+            # never contaminate the ±15% agreement check.
+            heavy_base = heavy_srv.registry.snapshot()[
+                "kccap_phase_seconds"
+            ]
+
+            barrier = threading.Barrier(4)
+            errs = []
+            t0 = time.perf_counter()
+
+            def against(addr, params):
+                def run():
+                    try:
+                        with CapacityClient(*addr) as c:
+                            c.call("ping")  # connect before the barrier
+                            barrier.wait(timeout=10)
+                            c.call("sweep", **params, **ctx.to_wire())
+                    except Exception as e:  # noqa: BLE001 - checked below
+                        errs.append(e)
+                return run
+
+            workers = [
+                threading.Thread(target=against(batch_srv.address, small)),
+                threading.Thread(target=against(batch_srv.address, small)),
+                threading.Thread(target=against(batch_srv.address, small)),
+                threading.Thread(target=against(heavy_srv.address, heavy)),
+            ]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join(timeout=60)
+            assert errs == []
+            reply = rs.call("fed_sweep", **GRID, **ctx.to_wire())
+            assert reply["excluded"] == ["east"]
+        finally:
+            span(
+                driver_log, ts=time.time(),
+                trace_id=ctx.trace_id, span_id=ctx.span_id,
+                op="e2e:driver", service="client",
+                duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                status="ok",
+            )
+            rs.close()
+            fed.close()
+            heavy_hist = heavy_srv.registry.snapshot()[
+                "kccap_phase_seconds"
+            ]
+            batch_srv.shutdown()
+            heavy_srv.shutdown()
+
+        tree = analyze_trace([str(tmp_path)], ctx.trace_id)
+        assert tree["found"]
+        assert len(tree["roots"]) == 1  # ONE tree, driver-rooted
+        assert tree["roots"][0]["op"] == "e2e:driver"
+        assert tree["orphans"] == 0
+        assert sorted(tree["processes"]) == [
+            "client", "fed", "replicaset", "server"
+        ]
+
+        def nodes(n):
+            yield n
+            for ch in n.get("children", ()):
+                yield from nodes(ch)
+
+        flat = list(nodes(tree["roots"][0]))
+        ops = {s["op"] for s in flat}
+        # The five acceptance span kinds, one tree:
+        assert "rs:attempt" in ops                      # client attempt
+        assert "phase:admission" in ops                 # admission gate
+        assert "batch:join" in ops                      # follower join
+        assert "batch:dispatch" in ops                  # leader dispatch
+        assert "fed:member" in ops                      # federation fan
+        assert "phase:device_exec" in ops               # device dispatch
+        # Two dispatches: the pair that beat the gate, and the delayed
+        # third going solo.  The follower's join LINKS to the pair's
+        # leader span — never to the solo dispatch.
+        dispatches = sorted(
+            (s for s in flat if s["op"] == "batch:dispatch"),
+            key=lambda s: s["batch_size"],
+        )
+        assert [s["batch_size"] for s in dispatches] == [1, 2]
+        join = next(s for s in flat if s["op"] == "batch:join")
+        assert join["links"] == [dispatches[1]["span_id"]]
+        # Lost cluster present in the tree, marked — never absent.
+        members = {
+            s["cluster"]: s for s in flat if s["op"] == "fed:member"
+        }
+        assert set(members) == {"east", "west", "north"}
+        assert members["east"]["state"] == "lost"
+        # Durations are monotonic: no span may be negative (satellite a).
+        assert all(s["duration_ms"] >= 0 for s in flat)
+        assert not tree["clock_skew_spans"]
+
+        cp = tree["critical_path"]
+        assert not cp.get("refused") and cp["path"]
+        # The path runs driver -> heavy sweep -> its dominating phase.
+        assert [s["op"] for s in cp["path"][:2]] == ["e2e:driver", "sweep"]
+        dom = cp["dominant"]["name"]
+        # The dominating contributor reads in ``phases`` vocabulary and
+        # agrees with the phase histogram's total for that phase within
+        # 15% — the one-trace story and the fleet story name the same
+        # cost (same request, same clock, two independent recorders).
+        base_s = {
+            label: h["sum"]
+            for label, h in heavy_base["values"].items()
+        }
+        hist_ms = {}
+        for label, h in heavy_hist["values"].items():
+            if 'phase="' in label:
+                ph = label.split('phase="', 1)[1].split('"', 1)[0]
+                delta = h["sum"] - base_s.get(label, 0.0)
+                hist_ms[ph] = hist_ms.get(ph, 0.0) + delta * 1e3
+        assert dom in hist_ms
+        assert hist_ms[dom] > 0
+        assert (
+            abs(cp["phase_ms"][dom] - hist_ms[dom]) <= 0.15 * hist_ms[dom]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Process self-telemetry (satellite b)
+# ---------------------------------------------------------------------------
+class TestProcessTelemetry:
+    def test_gauges_register_and_read_live_values(self):
+        from kubernetesclustercapacity_tpu.telemetry.process import (
+            register_process_metrics,
+        )
+
+        reg = MetricsRegistry()
+        register_process_metrics(reg, version="1.2.3-test")
+        snap = reg.snapshot()
+        for name in (
+            "kccap_process_rss_bytes", "kccap_process_open_fds",
+            "kccap_process_threads", "kccap_process_gc_collections_total",
+        ):
+            (value,) = snap[name]["values"].values()
+            # Live callback values: threads/gc are always knowable and
+            # positive; rss/fds may report -1 only on exotic platforms.
+            assert value != 0
+        info = snap["kccap_build_info"]
+        assert info["values"] == {'version="1.2.3-test"': 1.0}
+
+    def test_threads_gauge_tracks_reality(self):
+        from kubernetesclustercapacity_tpu.telemetry.process import (
+            register_process_metrics,
+        )
+
+        reg = MetricsRegistry()
+        register_process_metrics(reg)
+
+        def read():
+            return reg.snapshot()["kccap_process_threads"]["values"][""]
+
+        before = read()
+        ev = threading.Event()
+        ts = [threading.Thread(target=ev.wait) for _ in range(4)]
+        for t in ts:
+            t.start()
+        try:
+            assert read() >= before + 4
+        finally:
+            ev.set()
+            for t in ts:
+                t.join()
+
+    def test_registration_is_idempotent(self):
+        from kubernetesclustercapacity_tpu.telemetry.process import (
+            register_process_metrics,
+        )
+
+        reg = MetricsRegistry()
+        register_process_metrics(reg)
+        register_process_metrics(reg)  # server restart path: no raise
+
+    def test_disabled_telemetry_registers_nothing(self, monkeypatch):
+        from kubernetesclustercapacity_tpu.telemetry.process import (
+            register_process_metrics,
+        )
+
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        reg = MetricsRegistry()
+        register_process_metrics(reg)
+        assert "kccap_process_threads" not in reg.snapshot()
