@@ -120,6 +120,73 @@ def _drive_micro_batcher():
     return [submit, submit, submit, stats], lambda: None
 
 
+def _drive_fold_queue():
+    """The generalized cross-spec fold queue (ISSUE 19): the same
+    MicroBatcher class, but driven the way the server now drives it —
+    keys carrying (generation, semantics, kernel family), weighted
+    items, per-member deadlines racing the window budget, tenant tags
+    flowing into a fold_hook, and a dispatcher that answers per-member
+    slices out of one concatenated launch.  The deadline-bypass path
+    and the leader's hook/histogram bookkeeping all run under the
+    sanitizer here."""
+    from kubernetesclustercapacity_tpu.resilience import Deadline
+    from kubernetesclustercapacity_tpu.service.batching import MicroBatcher
+
+    hook_lock = threading.Lock()
+    hook_calls = [0]
+
+    def fold_hook(tenants):
+        with hook_lock:
+            hook_calls[0] += 1
+        assert len(tenants) >= 1
+
+    def dispatch(key, items):
+        # One folded "launch": every member's answer is its own item
+        # scaled — per-member slicing of a shared result, shaped like
+        # the server's scatter loop.
+        _gen, _sem, _fam = key
+        return [(spec, spec * 2) for spec in items]
+
+    mb = MicroBatcher(
+        dispatch, window_s=0.0008, max_batch=8, fold_hook=fold_hook
+    )
+    keys = (
+        (("g", 0), "reference", "auto"),
+        (("g", 0), "strict", "auto"),
+        (("g", 1), "reference", "pallas"),
+    )
+
+    def folded(i, t):
+        key = keys[(i + t) % len(keys)]
+        got = mb.submit(
+            key,
+            i,
+            tenant=f"team-{t % 3}",
+            weight=1 + i % 4,
+        )
+        assert got == (i, i * 2)
+
+    def racing_deadline(i, t):
+        # Deadlines straddling the window budget: some members join,
+        # some bypass solo — the per-member decision runs under the
+        # batch lock and must never double-dispatch.
+        key = keys[i % len(keys)]
+        got = mb.submit(
+            key,
+            i,
+            deadline=Deadline.after(0.0002 + (i % 5) * 0.0004),
+            tenant=f"team-{i % 2}",
+        )
+        assert got == (i, i * 2)
+
+    def stats(i, t):
+        st = mb.stats
+        assert st["fold_rate"] >= 0.0
+        assert st["mean_folded_specs"] >= 0.0
+
+    return [folded, folded, racing_deadline, stats], lambda: None
+
+
 def _drive_timeline():
     from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
     from kubernetesclustercapacity_tpu.timeline.history import (
@@ -473,8 +540,9 @@ def run(
     fuzz: bool = True,
     package_dir: str | None = None,
 ) -> tuple:
-    """One full hammer pass: install → drive all thirteen classes →
-    report → uninstall.  Returns ``(findings, stats)`` with findings
+    """One full hammer pass: install → drive all fourteen classes
+    (the MicroBatcher twice: once as the legacy coalescer, once as the
+    generalized fold queue) → report → uninstall.  Returns ``(findings, stats)`` with findings
     relative to the repo root.  Raises if any worker crashed."""
     targets = instrument_targets(package_dir)
     repo_root = os.path.dirname(package_dir or _package_dir())
@@ -484,6 +552,7 @@ def run(
             drivers = (
                 _drive_device_cache(),
                 _drive_micro_batcher(),
+                _drive_fold_queue(),
                 _drive_timeline(),
                 _drive_audit_log(tmp),
                 _drive_shadow(tmp),

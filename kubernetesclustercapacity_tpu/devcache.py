@@ -43,6 +43,7 @@ __all__ = [
     "DeviceCache",
     "CACHE",
     "enabled",
+    "donate_enabled",
     "node_bucket",
     "scenario_bucket",
     "node_bucket_floor",
@@ -71,6 +72,21 @@ def enabled() -> bool:
     pre-cache dispatch behavior.
     """
     return os.environ.get("KCCAP_DEVCACHE", "1") != "0"
+
+
+def donate_enabled() -> bool:
+    """Donated-resident-buffer switch (``KCCAP_DONATE=0`` disables).
+
+    Checked per publish: off restores the exact pre-donation publish
+    path — invalidate the retired generation, cold-stage the new one —
+    byte-for-byte (pinned by test).  On, a snapshot publish re-stages
+    only CHANGED columns (:meth:`DeviceCache.stage_replace`): unchanged
+    columns stay device-resident across generations, and changed ones
+    re-upload through a ``donate_argnums`` jit so the retired buffer's
+    device memory is reusable for the incoming column instead of
+    doubling peak HBM during the swap.
+    """
+    return os.environ.get("KCCAP_DONATE", "1") != "0"
 
 
 def node_bucket_floor() -> int:
@@ -140,6 +156,17 @@ def _metrics() -> dict:
                         "Device-cache misses (staged fresh), by form.",
                         ("form",),
                     ),
+                    "donate": REGISTRY.counter(
+                        "kccap_donate_columns_total",
+                        "Per-column dispositions of a donated-resident "
+                        "snapshot publish (stage_replace): reused = "
+                        "column unchanged, kept device-resident; "
+                        "donated = re-uploaded through the "
+                        "donate_argnums jit; restaged = plain cold "
+                        "upload (CPU backend, bucket change, or a "
+                        "concurrent in-flight holder).",
+                        ("disposition",),
+                    ),
                 }
     return _MET
 
@@ -148,6 +175,31 @@ def _telemetry_enabled() -> bool:
     from kubernetesclustercapacity_tpu.telemetry.metrics import enabled as en
 
     return en()
+
+
+_DONATE_JIT = None
+_donate_lock = threading.Lock()
+
+
+def _donate_jit():
+    """The donated-replace program, built lazily (importing this module
+    must not touch JAX).  ``donate_argnums=(0,)`` marks the retired
+    generation's column as dead on entry, so XLA may alias the output —
+    the incoming column's bytes — into its device buffer; the select
+    reads both operands, keeping the aliasing opportunity real rather
+    than letting an identity program fold away.  Bit-exact: the output
+    is ``new``, element for element, on every carrier dtype."""
+    global _DONATE_JIT
+    with _donate_lock:
+        if _DONATE_JIT is None:
+            import jax
+            import jax.numpy as jnp
+
+            def _replace(old, new):
+                return jnp.where(jnp.bool_(True), new, old)
+
+            _DONATE_JIT = jax.jit(_replace, donate_argnums=(0,))
+    return _DONATE_JIT
 
 
 class DeviceCache:
@@ -377,6 +429,111 @@ class DeviceCache:
                     self.pallas_arrays(snapshot)
             except Exception:  # noqa: BLE001 - warm is an optimization
                 pass
+
+    def stage_replace(self, old, new) -> dict:
+        """Donated-resident publish: retire ``old``'s cache entries and
+        stage ``new``'s exact-form columns, re-uploading ONLY what
+        changed.
+
+        The retired generation's staged exact tuple is popped under the
+        cache lock first — no new dispatch can acquire it after this
+        point — then each of ``new``'s seven bucket-padded columns is
+        compared bit-for-bit against ``old``'s on the host:
+
+        * identical → the already-resident device array is carried into
+          the new generation's entry (zero transfer — the common case:
+          a watch event touches a handful of nodes, not the fleet);
+        * changed → re-uploaded through the ``donate_argnums=(0,)`` jit
+          when safe (non-CPU backend, and no in-flight dispatch still
+          holds the retired tuple — donating a buffer a running kernel
+          reads would be a use-after-free), so XLA may alias the new
+          column into the retired buffer's HBM;
+        * otherwise (CPU backend, node-bucket change, concurrent
+          holder, no prior staging) → a plain cold upload, identical to
+          the pre-donation path.
+
+        Values are bit-identical in every case — the staged tuple is
+        byte-equal to what :meth:`exact_arrays` would build fresh
+        (pinned by test).  Non-exact forms (pallas tiles, grouped) are
+        dropped with the old generation; the caller re-warms them.
+        Returns ``{"reused": int, "donated": int, "restaged": int}``
+        per-column dispositions (also counted on
+        ``kccap_donate_columns_total``).  Callers gate on
+        :func:`donate_enabled` — this method assumes the hatch is open.
+        """
+        import sys
+
+        import jax
+        import jax.numpy as jnp
+
+        counts = {"reused": 0, "donated": 0, "restaged": 0}
+        old_staged: dict = {}
+        if old is not None and old is not new:
+            with self._lock:
+                tok = old.__dict__.get("_devcache_token")
+                if tok is not None:
+                    for key in [k for k in self._entries if k[0] == tok]:
+                        v = self._entries.pop(key)
+                        if len(key) == 3 and key[1] == "exact":
+                            old_staged[key[2]] = v
+        if not enabled():
+            return counts
+        b = node_bucket(new.n_nodes)
+        prior = old_staged.get(b)
+        if prior is not None and old.n_nodes > b:
+            prior = None  # custom-bucket staging: shapes won't line up
+        # An in-flight dispatch that grabbed the tuple before the pop
+        # still holds a reference; donating its buffers would free
+        # device memory out from under a running kernel.  After the pop
+        # the only expected holders are `old_staged` and `prior`
+        # (+1 for getrefcount's own argument) — anything above that is
+        # a concurrent reader, so fall back to plain uploads.
+        may_donate = (
+            prior is not None
+            and jax.default_backend() != "cpu"
+            and sys.getrefcount(prior) <= 3
+        )
+
+        def col7(snap):
+            return (
+                snap.alloc_cpu_milli, snap.alloc_mem_bytes, snap.alloc_pods,
+                snap.used_cpu_req_milli, snap.used_mem_req_bytes,
+                snap.pods_count, snap.healthy,
+            )
+
+        pad_new = b - new.n_nodes
+        pad_old = b - old.n_nodes if prior is not None else 0
+        staged = []
+        for i, col in enumerate(col7(new)):
+            col = np.asarray(col)
+            col_p = np.pad(col, (0, pad_new)) if pad_new else col
+            if prior is not None:
+                old_col = np.asarray(col7(old)[i])
+                old_p = (
+                    np.pad(old_col, (0, pad_old)) if pad_old else old_col
+                )
+                if np.array_equal(col_p, old_p):
+                    staged.append(prior[i])
+                    counts["reused"] += 1
+                    continue
+                if may_donate:
+                    staged.append(_donate_jit()(prior[i], col_p))
+                    counts["donated"] += 1
+                    continue
+            staged.append(jnp.asarray(col_p))
+            counts["restaged"] += 1
+        full = (self._token(new), "exact", b)  # token before the lock
+        with self._lock:
+            self._entries[full] = tuple(staged)
+            self._entries.move_to_end(full)
+            while len(self._entries) > self._max_entries:
+                self._entries.popitem(last=False)
+        if _telemetry_enabled():
+            met = _metrics()["donate"]
+            for disposition, c in counts.items():
+                if c:
+                    met.labels(disposition=disposition).inc(c)
+        return counts
 
     def invalidate(self, snapshot=None) -> None:
         """Drop a snapshot's entries (or everything when ``None``) —

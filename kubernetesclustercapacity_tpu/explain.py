@@ -69,6 +69,7 @@ __all__ = [
     "explain_per_node",
     "explain_grid",
     "explain_snapshot",
+    "sweep_explain_snapshot",
 ]
 
 # Attribution codes, in tie-break order (cpu ≺ memory ≺ pods); health and
@@ -566,3 +567,154 @@ def explain_snapshot(
             None if node_mask is None else np.asarray(node_mask, dtype=bool)
         ),
     )
+
+def sweep_explain_snapshot(
+    snapshot: ClusterSnapshot,
+    grid: ScenarioGrid,
+    *,
+    mode: str | None = None,
+    node_mask=None,
+):
+    """Fused sweep+explain dispatch: ONE device launch answering both
+    "how many fit" and "what binds" for every scenario.
+
+    The super-kernel (:func:`..ops.fit.sweep_explain_grid` /
+    ``sweep_explain_grouped``) computes the sweep totals on-device from
+    the attribution kernel's fits — which are pinned bit-identical to
+    ``fit_per_node``'s, so the totals are bit-exact against a solo
+    :func:`..ops.fit.sweep_snapshot` and the per-node outputs bit-exact
+    against :func:`explain_snapshot`, in both modes, grouped or not.
+    Rides the device cache's bucket-padded node staging when enabled
+    (padded rows contribute zero in both modes, exactly as in the
+    bucketed sweep; no scenario-axis padding — the ``[S, N]``
+    attribution output makes pad probes pure waste).  The grouped route
+    folds ``node_mask`` into the per-group effective counts for the
+    on-device totals (a masked node's fit is zero in every mode) and
+    re-applies it per node after expansion, the same contract as
+    :func:`explain_snapshot`.
+
+    Returns ``(totals[S], schedulable[S], ExplainResult, kernel_name)``
+    — all numpy; ``kernel_name`` is the honest compilewatch family
+    (there is no Pallas route: the attribution needs int64 quotients).
+    """
+    import time as _time
+
+    from kubernetesclustercapacity_tpu import devcache as _devcache
+    from kubernetesclustercapacity_tpu.ops.fit import (
+        sweep_explain_grid,
+        sweep_explain_grouped,
+    )
+    from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+    from kubernetesclustercapacity_tpu.telemetry.compilewatch import (
+        observe_dispatch,
+    )
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        enabled as _telemetry_enabled,
+    )
+
+    mode = mode or snapshot.semantics
+    grid.validate()
+    clk = _phases.current()
+    n = snapshot.n_nodes
+    grouped = grouped_for_dispatch(snapshot)
+    if grouped is not None:
+        g = grouped.n_groups
+        counts = grouped.effective_counts(node_mask)
+        if _devcache.enabled():
+            staged = _devcache.CACHE.grouped_arrays(grouped)
+            arrays = staged[:7]
+            bucket = int(arrays[0].shape[0])
+            if node_mask is None:
+                counts_p = staged[7]
+            else:
+                counts_p = (
+                    np.pad(counts, (0, bucket - g)) if bucket > g else counts
+                )
+            label = f"xla_int64_sweep_explain_grouped@g{bucket}"
+        else:
+            arrays = (
+                grouped.alloc_cpu_milli, grouped.alloc_mem_bytes,
+                grouped.alloc_pods, grouped.used_cpu_req_milli,
+                grouped.used_mem_req_bytes, grouped.pods_count,
+                grouped.healthy,
+            )
+            counts_p = counts
+            label = "xla_int64_sweep_explain_grouped"
+        t0 = _time.perf_counter()
+        out = sweep_explain_grouped(
+            *arrays, counts_p,
+            grid.cpu_request_milli, grid.mem_request_bytes, grid.replicas,
+            mode=mode,
+        )
+        kernel = "xla_int64_sweep_explain_grouped"
+        cols = g
+    else:
+        if _devcache.enabled():
+            arrays = _devcache.CACHE.exact_arrays(snapshot)
+            bucket = int(arrays[0].shape[0])
+            mask = node_mask
+            if mask is not None:
+                mask = np.asarray(mask, dtype=bool)
+                if bucket > n:
+                    mask = np.pad(mask, (0, bucket - n))
+            label = f"xla_int64_sweep_explain@n{bucket}"
+        else:
+            arrays = (
+                snapshot.alloc_cpu_milli, snapshot.alloc_mem_bytes,
+                snapshot.alloc_pods, snapshot.used_cpu_req_milli,
+                snapshot.used_mem_req_bytes, snapshot.pods_count,
+                snapshot.healthy,
+            )
+            mask = node_mask
+            label = "xla_int64_sweep_explain"
+        t0 = _time.perf_counter()
+        out = sweep_explain_grid(
+            *arrays,
+            grid.cpu_request_milli, grid.mem_request_bytes, grid.replicas,
+            mode=mode, node_mask=mask,
+        )
+        kernel = "xla_int64_sweep_explain"
+        cols = n
+    t_launch = _time.perf_counter()
+    totals = np.asarray(out[0])
+    schedulable = np.asarray(out[1])
+    per_node = tuple(np.asarray(o)[:, :cols] for o in out[2:])
+    t_done = _time.perf_counter()
+    kind = None
+    if _telemetry_enabled():
+        kind = observe_dispatch(label, t_done - t0)
+    if clk:
+        if kind == "compile":
+            clk.record("compile", t_done - t0)
+        else:
+            clk.record("device_exec", t_launch - t0)
+            clk.record("fetch", t_done - t_launch)
+    fits, code, cpu_fit, mem_fit, slots = per_node
+    if grouped is not None:
+        fits = grouped.expand(fits)
+        code = grouped.expand(code)
+        cpu_fit = grouped.expand(cpu_fit)
+        mem_fit = grouped.expand(mem_fit)
+        slots = grouped.expand(slots)
+        if node_mask is not None:
+            mask_row = np.asarray(node_mask, dtype=bool)[None, :]
+            fits = np.where(mask_row, fits, 0)
+            code = np.where(
+                mask_row, code, np.int32(BINDING_MASKED)
+            ).astype(code.dtype)
+    result = ExplainResult(
+        snapshot=snapshot,
+        mode=mode,
+        cpu_request_milli=np.asarray(grid.cpu_request_milli),
+        mem_request_bytes=np.asarray(grid.mem_request_bytes),
+        replicas=np.asarray(grid.replicas),
+        fits=fits,
+        binding=code,
+        cpu_fit=cpu_fit,
+        mem_fit=mem_fit,
+        slots=slots,
+        node_mask=(
+            None if node_mask is None else np.asarray(node_mask, dtype=bool)
+        ),
+    )
+    return totals, schedulable, result, kernel

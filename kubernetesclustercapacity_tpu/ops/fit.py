@@ -54,6 +54,11 @@ __all__ = [
     "grouped_device_arrays",
     "fit_per_node_multi",
     "sweep_grid_multi",
+    "sweep_explain_grid",
+    "sweep_explain_grouped",
+    "sweep_quantiles_grid",
+    "sweep_quantiles_grouped",
+    "sweep_quantiles_snapshot",
 ]
 
 _INT64_MAX = np.iinfo(np.int64).max
@@ -569,6 +574,7 @@ def sweep_grid_bucketed(
     node_mask=None,
     return_per_node: bool = False,
     snapshot: ClusterSnapshot | None = None,
+    sync: bool = True,
 ):
     """Shape-bucketed exact sweep: :func:`sweep_grid` behind the bucket
     ladder, sliced back to the true ``[S]``/``[S, N]`` shapes.
@@ -582,6 +588,18 @@ def sweep_grid_bucketed(
     from the :mod:`..devcache` (the per-request host→device upload
     disappears); with ``KCCAP_DEVCACHE=0`` this is exactly the plain
     :func:`sweep_grid` call.  Returns numpy arrays.
+
+    ``sync=False`` requests ASYNC dispatch: the jitted call's device
+    arrays are returned unsynced (wrapped to host-slice to the true
+    shapes at materialization — never a device-side slice program) so
+    the caller can overlap the device→host wait with other host work
+    and record it as the ``fetch_overlap`` phase at materialization.
+    The async route only engages on the devcache path for a kernel
+    label compilewatch has already seen (a first dispatch must be
+    timed whole to classify as compile) — otherwise this falls back to
+    the synchronous path and returns numpy as usual, so callers must
+    branch on the returned array type, and the values are bit-identical
+    either way (same jit, same inputs; only the sync point moves).
     """
     import time as _time
 
@@ -630,6 +648,38 @@ def sweep_grid_bucketed(
     cpu_p, mem_p, rep_p = _pad_scenarios_bucketed(
         cpu_reqs, mem_reqs, replicas, _devcache.scenario_bucket(s)
     )
+    label = f"xla_int64@n{bucket}"
+    if not sync:
+        # Async route: launch and hand back the device arrays without
+        # the block_until_ready sync — the caller materializes later
+        # under ``fetch_overlap``.  Only once the label is steady-state
+        # (or telemetry is off entirely): a first dispatch per padded
+        # shape must be host-timed through the sync to classify as
+        # compile, so it stays on the synchronous path below.
+        allow_async = True
+        if _telemetry_enabled():
+            from kubernetesclustercapacity_tpu.telemetry.compilewatch import (
+                seen_kernels,
+            )
+
+            allow_async = label in seen_kernels()
+        if allow_async:
+            t0 = _time.perf_counter() if clk else 0.0
+            out = sweep_grid(
+                *arrays, cpu_p, mem_p, rep_p,
+                mode=mode, node_mask=mask, return_per_node=return_per_node,
+            )
+            if clk:
+                clk.record("device_exec", _time.perf_counter() - t0)
+            result = (
+                _AsyncView(out[0], slice(None, s)),
+                _AsyncView(out[1], slice(None, s)),
+            )
+            if return_per_node:
+                result += (
+                    _AsyncView(out[2], (slice(None, s), slice(None, n))),
+                )
+            return result
     t0 = _time.perf_counter()
     out = sweep_grid(
         *arrays, cpu_p, mem_p, rep_p,
@@ -651,7 +701,7 @@ def sweep_grid_bucketed(
             observe_dispatch,
         )
 
-        kind = observe_dispatch(f"xla_int64@n{bucket}", t_done - t0)
+        kind = observe_dispatch(label, t_done - t0)
     if clk:
         if kind == "compile":
             # First dispatch of this padded shape: the wall time is
@@ -668,6 +718,25 @@ def sweep_grid_bucketed(
     return result
 
 
+class _AsyncView:
+    """An unsynced device result, host-sliced to its true shape at
+    materialization (the numpy ``__array__`` protocol, so the caller's
+    ``np.asarray`` is the sync point).  Slicing the *device* array to
+    the true shape instead would dispatch a fresh XLA slice program per
+    (bucket, true-shape) pair — a first-sight compile that dwarfs the
+    launch the async route exists to overlap."""
+
+    __slots__ = ("_dev", "_key")
+
+    def __init__(self, dev, key) -> None:
+        self._dev = dev
+        self._key = key
+
+    def __array__(self, dtype=None, copy=None):
+        host = np.asarray(self._dev)[self._key]
+        return host if dtype is None else np.asarray(host, dtype)
+
+
 def sweep_snapshot(
     snapshot: ClusterSnapshot,
     grid: ScenarioGrid,
@@ -675,6 +744,7 @@ def sweep_snapshot(
     mode: str = "reference",
     return_per_node: bool = False,
     node_mask=None,
+    sync: bool = True,
 ):
     """Convenience wrapper: ``ClusterSnapshot`` × ``ScenarioGrid`` → results.
 
@@ -690,6 +760,13 @@ def sweep_snapshot(
     (:func:`sweep_grouped_bucketed`) when
     :func:`..snapshot.grouped_for_dispatch` says it pays —
     ``KCCAP_GROUPING=0`` restores the ungrouped dispatch exactly.
+
+    ``sync=False`` requests async dispatch on the ungrouped devcache
+    path (see :func:`sweep_grid_bucketed`): the return MAY be unsynced
+    ``jax.Array`` futures for the caller to materialize under
+    ``fetch_overlap``; the grouped route always materializes (its
+    group→node bookkeeping is host-side anyway).  Values are
+    bit-identical either way.
     """
     import time as _time
 
@@ -735,14 +812,275 @@ def sweep_snapshot(
         return_per_node=return_per_node,
         node_mask=node_mask,
         snapshot=snapshot,
+        sync=sync,
     )
-    if _telemetry_enabled():
+    if _telemetry_enabled() and isinstance(out[0], np.ndarray):
         # Host-side, after the np.asarray sync — the first dispatch per
         # kernel label lands as compile time, the rest as steady-state
         # (telemetry/compilewatch; never called inside jitted code).
+        # An async dispatch (device arrays returned) skips the coarse
+        # label: its host-timed interval excludes the device wait, and
+        # the per-bucket label already carries the compile story.
         from kubernetesclustercapacity_tpu.telemetry.compilewatch import (
             observe_dispatch,
         )
 
         observe_dispatch("xla_int64", _time.perf_counter() - t0)
     return out
+
+
+# -- fused super-kernels ----------------------------------------------------
+#
+# The "how many fit and what binds" question used to cost two-three
+# launches (sweep, then explain, then sometimes a quantile reduce on
+# host).  Each fused kernel below is ONE jitted program answering the
+# combined question, so a folded micro-batch that mixes sweep and
+# explain members — or a capacity-at-risk evaluation — pays a single
+# dispatch.  Fusion is at the XLA level: the explain attribution needs
+# the full int64 per-resource quotients, which the Pallas i32 fast path
+# cannot carry, so the fused programs ride the exact kernel's arithmetic
+# (bit-exactness against the sequential two-op path is therefore by
+# construction — the fits ARE fit_per_node's, pinned in tests).
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def sweep_explain_grid(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+    node_mask=None,
+):
+    """Fused sweep+explain: one launch → totals, schedulability AND the
+    per-node binding attribution for every scenario.
+
+    Returns ``(totals[S], schedulable[S], fits[S, N], code[S, N],
+    cpu_fit[S, N], mem_fit[S, N], slots[S, N])`` — the first two are
+    exactly :func:`sweep_grid`'s outputs (the explain kernel's fit is
+    pinned bit-identical to :func:`fit_per_node`), the rest exactly
+    :func:`..explain.explain_grid`'s.  The late import keeps the
+    ``explain → ops.fit`` dependency acyclic (it runs at trace time).
+    """
+    from kubernetesclustercapacity_tpu.explain import explain_grid
+
+    fits, code, cpu_fit, mem_fit, slots = explain_grid(
+        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+        pods_count, healthy, cpu_reqs, mem_reqs,
+        mode=mode, node_mask=node_mask,
+    )
+    totals = jnp.sum(fits, axis=1)
+    schedulable = totals >= jnp.asarray(replicas, jnp.int64)
+    return totals, schedulable, fits, code, cpu_fit, mem_fit, slots
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def sweep_explain_grouped(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    counts,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+):
+    """Grouped fused sweep+explain: attribution over ``G`` node-shape
+    groups with count-weighted totals (the same weighted-sum bit-exactness
+    argument as :func:`sweep_grid_grouped`; a node_mask folds into
+    ``counts`` upstream and re-applies per node after expansion).
+    Outputs are ``[S]`` / ``[S, G]``.
+    """
+    from kubernetesclustercapacity_tpu.explain import explain_grid
+
+    fits, code, cpu_fit, mem_fit, slots = explain_grid(
+        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+        pods_count, healthy, cpu_reqs, mem_reqs, mode=mode,
+    )
+    counts = jnp.asarray(counts, jnp.int64)
+    totals = jnp.sum(fits * counts[None, :], axis=1)
+    schedulable = totals >= jnp.asarray(replicas, jnp.int64)
+    return totals, schedulable, fits, code, cpu_fit, mem_fit, slots
+
+
+@partial(jax.jit, static_argnames=("mode", "q_indices"))
+def sweep_quantiles_grid(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+    q_indices: tuple = (),
+    node_mask=None,
+):
+    """Fused sweep+quantile: the Monte Carlo sample sweep AND the order
+    statistics in one launch (the capacity-at-risk hot path).
+
+    ``q_indices`` is the STATIC tuple of sorted-ascending order-statistic
+    indices (:func:`..stochastic.car.quantile_index` per quantile — the
+    host computes them from ``(S, q)`` alone).  The sort is a stable
+    argsort, so the realizing sample index under ties is the SAME
+    permutation numpy's stable host-side argsort yields — quantile
+    values and sample attribution are bit-identical to the unfused
+    reduction, pinned by test.  Returns ``(totals[S], schedulable[S],
+    qvals[len(q)], qidx[len(q)])``.
+    """
+    totals, schedulable = sweep_grid(
+        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+        pods_count, healthy, cpu_reqs, mem_reqs, replicas,
+        mode=mode, node_mask=node_mask,
+    )
+    order = jnp.argsort(totals, stable=True)
+    qi = jnp.asarray(q_indices, jnp.int32)
+    return totals, schedulable, totals[order][qi], order[qi]
+
+
+@partial(jax.jit, static_argnames=("mode", "q_indices"))
+def sweep_quantiles_grouped(
+    alloc_cpu,
+    alloc_mem,
+    alloc_pods,
+    used_cpu,
+    used_mem,
+    pods_count,
+    healthy,
+    counts,
+    cpu_reqs,
+    mem_reqs,
+    replicas,
+    *,
+    mode: str = "reference",
+    q_indices: tuple = (),
+):
+    """Grouped twin of :func:`sweep_quantiles_grid` (count-weighted
+    totals; a node_mask folds into ``counts`` upstream)."""
+    totals, schedulable = sweep_grid_grouped(
+        alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+        pods_count, healthy, counts, cpu_reqs, mem_reqs, replicas,
+        mode=mode,
+    )
+    order = jnp.argsort(totals, stable=True)
+    qi = jnp.asarray(q_indices, jnp.int32)
+    return totals, schedulable, totals[order][qi], order[qi]
+
+
+def sweep_quantiles_snapshot(
+    snapshot: ClusterSnapshot,
+    grid: ScenarioGrid,
+    *,
+    mode: str | None = None,
+    node_mask=None,
+    q_indices: tuple = (),
+):
+    """Dispatch entry for the fused sweep+quantile kernel: devcache
+    node staging, the grouped route when it pays, compilewatch labels —
+    the same ladder as :func:`sweep_snapshot`, minus scenario-axis
+    padding (pad probes would enter the SORT; the sample count is fixed
+    per spec, so there is no shape churn to bucket away).  Returns
+    numpy ``(totals[S], schedulable[S], qvals, qidx, kernel_name)``.
+    """
+    import time as _time
+
+    from kubernetesclustercapacity_tpu import devcache as _devcache
+    from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+    from kubernetesclustercapacity_tpu.telemetry.compilewatch import (
+        observe_dispatch,
+    )
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        enabled as _telemetry_enabled,
+    )
+
+    mode = mode or snapshot.semantics
+    grid.validate()
+    q_indices = tuple(int(i) for i in q_indices)
+    clk = _phases.current()
+    grouped = grouped_for_dispatch(snapshot)
+    if grouped is not None:
+        g = grouped.n_groups
+        counts = grouped.effective_counts(node_mask)
+        if _devcache.enabled():
+            staged = _devcache.CACHE.grouped_arrays(grouped)
+            arrays = staged[:7]
+            bucket = int(arrays[0].shape[0])
+            if node_mask is None:
+                counts_p = staged[7]
+            else:
+                counts_p = (
+                    np.pad(counts, (0, bucket - g)) if bucket > g else counts
+                )
+            label = f"xla_int64_sweep_qtile_grouped@g{bucket}"
+        else:
+            arrays = (
+                grouped.alloc_cpu_milli, grouped.alloc_mem_bytes,
+                grouped.alloc_pods, grouped.used_cpu_req_milli,
+                grouped.used_mem_req_bytes, grouped.pods_count,
+                grouped.healthy,
+            )
+            counts_p = counts
+            label = "xla_int64_sweep_qtile_grouped"
+        t0 = _time.perf_counter()
+        out = sweep_quantiles_grouped(
+            *arrays, counts_p,
+            grid.cpu_request_milli, grid.mem_request_bytes, grid.replicas,
+            mode=mode, q_indices=q_indices,
+        )
+        kernel = "xla_int64_sweep_qtile_grouped"
+    else:
+        if _devcache.enabled():
+            arrays = _devcache.CACHE.exact_arrays(snapshot)
+            bucket = int(arrays[0].shape[0])
+            n = snapshot.n_nodes
+            mask = node_mask
+            if mask is not None:
+                mask = np.asarray(mask, dtype=bool)
+                if bucket > n:
+                    mask = np.pad(mask, (0, bucket - n))
+            label = f"xla_int64_sweep_qtile@n{bucket}"
+        else:
+            arrays = (
+                snapshot.alloc_cpu_milli, snapshot.alloc_mem_bytes,
+                snapshot.alloc_pods, snapshot.used_cpu_req_milli,
+                snapshot.used_mem_req_bytes, snapshot.pods_count,
+                snapshot.healthy,
+            )
+            mask = node_mask
+            label = "xla_int64_sweep_qtile"
+        t0 = _time.perf_counter()
+        out = sweep_quantiles_grid(
+            *arrays,
+            grid.cpu_request_milli, grid.mem_request_bytes, grid.replicas,
+            mode=mode, q_indices=q_indices, node_mask=mask,
+        )
+        kernel = "xla_int64_sweep_qtile"
+    t_launch = _time.perf_counter()
+    out = tuple(np.asarray(o) for o in out)
+    t_done = _time.perf_counter()
+    kind = None
+    if _telemetry_enabled():
+        kind = observe_dispatch(label, t_done - t0)
+    if clk:
+        if kind == "compile":
+            clk.record("compile", t_done - t0)
+        else:
+            clk.record("device_exec", t_launch - t0)
+            clk.record("fetch", t_done - t_launch)
+    return out[0], out[1], out[2], out[3], kernel

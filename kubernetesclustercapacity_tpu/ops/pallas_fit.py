@@ -63,6 +63,7 @@ __all__ = [
     "sweep_pallas",
     "sweep_auto",
     "sweep_snapshot_auto",
+    "sweep_explain_snapshot_auto",
     "fast_path_error",
     "fast_path_breaker_snapshot",
     "last_dispatch_fast_path",
@@ -769,6 +770,7 @@ def sweep_auto(
     node_mask=None,
     interpret: bool | None = None,
     force_exact: bool = False,
+    sync: bool = True,
     _snapshot=None,
 ):
     """Fast path when eligible, exact int64 path otherwise — always bit-exact.
@@ -791,6 +793,15 @@ def sweep_auto(
     the device-resident cache: the fused path reuses its staged int32
     node tiles and the exact fallback its bucket-padded int64 arrays —
     identical numbers, minus the per-request upload.
+
+    ``sync=False`` threads the async-dispatch contract down to the exact
+    bucketed path (:func:`..fit.sweep_grid_bucketed`): when that path can
+    return without blocking it yields device ``jax.Array`` futures instead
+    of numpy, letting the caller overlap the fetch with its next batch
+    window (``fetch_overlap``).  The Pallas fused path materializes numpy
+    internally (its np.asarray IS the sync point), so async applies only
+    to the XLA fallback — callers must branch on the returned array type
+    either way.  Values are bit-identical regardless.
     """
     import time as _time
 
@@ -908,11 +919,15 @@ def sweep_auto(
     totals, sched = sweep_grid_bucketed(
         alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem, pods_count,
         healthy, cpu_reqs, mem_reqs, replicas, mode=mode,
-        node_mask=node_mask, snapshot=_snapshot,
+        node_mask=node_mask, snapshot=_snapshot, sync=sync,
     )
-    if tel is not None:
+    if tel is not None and isinstance(totals, np.ndarray):
         # np.asarray blocked on the device result above — same sync
-        # policy as the fused branch.
+        # policy as the fused branch.  An async dispatch (sync=False,
+        # jax.Array result) never blocked, so host-timing it here would
+        # record launch latency as kernel latency — skip the coarse
+        # label; the bucketed label inside sweep_grid_bucketed already
+        # carried the compile classification for this shape.
         dt = _time.perf_counter() - t0
         tel["latency"].labels(kernel="xla_int64").observe(dt)
         _compilewatch.observe_dispatch("xla_int64", dt)
@@ -1044,6 +1059,7 @@ def sweep_snapshot_auto(
     kernel: str = "auto",
     interpret: bool | None = None,
     node_mask=None,
+    sync: bool = True,
 ):
     """Production sweep entry: fastest kernel that is provably bit-exact.
 
@@ -1064,6 +1080,14 @@ def sweep_snapshot_auto(
     ``interpret=None`` auto-selects Pallas interpret mode off-TPU.
     Returns ``(totals[S], schedulable[S], kernel_name)`` with numpy arrays
     and the kernel actually used.
+
+    ``sync=False`` opts into async dispatch where supported (the exact
+    XLA devcache path on an ungrouped snapshot with a warm compile
+    cache): totals/schedulable come back as ``jax.Array`` futures and
+    the caller blocks only when it serializes — the folded-sweep
+    server path's ``fetch_overlap``.  Grouped and Pallas routes stay
+    synchronous (their reductions materialize internally); callers
+    branch on the returned type.  Bit-identical values either way.
     """
     if kernel not in ("auto", "exact"):
         raise ValueError(f"unknown kernel {kernel!r}")
@@ -1099,5 +1123,33 @@ def sweep_snapshot_auto(
         node_mask=node_mask,
         interpret=interpret,
         force_exact=(kernel == "exact"),
+        sync=sync,
         _snapshot=snapshot,
+    )
+
+def sweep_explain_snapshot_auto(
+    snapshot,
+    grid,
+    *,
+    mode: str = "reference",
+    node_mask=None,
+):
+    """Auto entry for the fused sweep+explain super-kernel.
+
+    Mirrors :func:`sweep_snapshot_auto`'s signature so the service's
+    folded dispatcher can route a mixed sweep/explain batch through one
+    call — but there is deliberately NO Pallas route here: the explain
+    attribution carries the full int64 per-resource quotients
+    (``cpu_fit``/``mem_fit``/``slots``), which the i32 lane kernel
+    cannot represent, so every fused sweep+explain dispatch is the
+    exact XLA program (:func:`..fit.sweep_explain_grid`) and the
+    kernel label says so honestly.  Delegates to
+    :func:`...explain.sweep_explain_snapshot`, which owns the devcache
+    staging, grouped expansion and compilewatch labeling.  Returns
+    ``(ExplainResult, kernel_name)``.
+    """
+    from kubernetesclustercapacity_tpu.explain import sweep_explain_snapshot
+
+    return sweep_explain_snapshot(
+        snapshot, grid, mode=mode, node_mask=node_mask
     )

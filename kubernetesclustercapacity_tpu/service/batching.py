@@ -49,16 +49,23 @@ _FOLLOWER_TIMEOUT_S = 120.0
 
 class _Batch:
     __slots__ = (
-        "items", "tenants", "closed", "full", "done", "results", "error",
-        "leader_span_id",
+        "items", "tenants", "weights", "closed", "full", "done", "results",
+        "error", "leader_span_id", "opened_at",
     )
 
-    def __init__(self) -> None:
+    def __init__(self, opened_at: float = 0.0) -> None:
         self.items: list = []
         # Parallel to ``items``: who asked (None when tenancy is off).
         # Results scatter back BY INDEX, so per-tenant attribution never
         # influences — or could even touch — the combined dispatch.
         self.tenants: list = []
+        # Parallel to ``items``: scenario rows each member contributes
+        # to the folded dispatch (the fold-accounting weight).
+        self.weights: list = []
+        # When the leader opened the window (the batcher's clock) — a
+        # joiner's bypass decision compares its deadline against the
+        # REMAINING window, not the full one.
+        self.opened_at = opened_at
         self.closed = False
         self.full = threading.Event()
         self.done = threading.Event()
@@ -88,6 +95,8 @@ class MicroBatcher:
         max_batch: int = 32,
         registry=None,
         trace_sink=None,
+        fold_hook=None,
+        clock=None,
     ) -> None:
         from kubernetesclustercapacity_tpu.telemetry.metrics import (
             MetricsRegistry,
@@ -104,6 +113,14 @@ class MicroBatcher:
         # "batch:join" span linked to it — the trace-tree form of "who
         # rode whose kernel launch".
         self._trace_sink = trace_sink
+        # Fold-accounting hook: called once per MULTI-request dispatch
+        # with the members' tenant labels (service/tenancy.py's
+        # FoldAccounting when tenancy is armed; None otherwise).
+        # Strictly best-effort — accounting must never fail a dispatch.
+        self._fold_hook = fold_hook
+        # Injectable monotonic clock (tests freeze it to pin the
+        # joiner-bypass window arithmetic); production uses perf_counter.
+        self._clock = clock if clock is not None else time.perf_counter
         self.window_s = float(window_s)
         self.max_batch = int(max_batch)
         self._lock = threading.Lock()
@@ -139,6 +156,13 @@ class MicroBatcher:
             "(1 when tenancy is off; >1 means cross-tenant sharing).",
             buckets=_BATCH_SIZE_BUCKETS,
         )
+        self._m_specs = m.histogram(
+            "kccap_fold_specs",
+            "Scenario rows folded into each dispatched micro-batch "
+            "(sum of member weights; sum/count = mean folded specs per "
+            "launch — the cross-spec amortization factor).",
+            buckets=_BATCH_SIZE_BUCKETS + (256, 512, 1024),
+        )
 
     @property
     def stats(self) -> dict:
@@ -146,17 +170,30 @@ class MicroBatcher:
         size = self._m_size.labels()
         dispatches = size.count
         total = size.sum
+        specs = self._m_specs.labels()
+        batched = int(self._m_batched.value)
+        solo = int(self._m_solo.value)
+        requests = batched + solo
         return {
             "window_ms": self.window_s * 1e3,
             "max_batch": self.max_batch,
             "dispatches": dispatches,
-            "batched_requests": int(self._m_batched.value),
-            "solo_requests": int(self._m_solo.value),
+            "batched_requests": batched,
+            "solo_requests": solo,
             "deadline_bypass": int(self._m_bypass.value),
             "mean_batch_size": (total / dispatches) if dispatches else 0.0,
+            # Fraction of requests that actually shared a launch, and
+            # the mean scenario rows per launch — the two numbers the
+            # open-loop serving bench row reports.
+            "fold_rate": (batched / requests) if requests else 0.0,
+            "mean_folded_specs": (
+                (specs.sum / specs.count) if specs.count else 0.0
+            ),
         }
 
-    def submit(self, key, item, *, deadline=None, tenant=None, trace=None):
+    def submit(
+        self, key, item, *, deadline=None, tenant=None, trace=None, weight=1
+    ):
         """Run ``item`` through a (possibly shared) dispatch; returns its
         own result.  Blocking — callers are the server's per-connection
         threads, each already holding a compute slot.
@@ -166,6 +203,17 @@ class MicroBatcher:
         return (bit-exact vs solo, because the combined dispatch is
         index-scattered and never reads the label).
 
+        ``weight`` is the scenario-row count this member contributes to
+        the folded dispatch (fold accounting only — never consulted by
+        the dispatch itself).
+
+        Deadline bypass is per member against the batch it would
+        ACTUALLY join: a leader's wait budget is the full window, but a
+        joiner's is only the window's remainder — so each member's OWN
+        deadline is consulted (never just the leader's), and a joiner
+        whose budget would expire before the leader dispatches goes
+        solo instead of riding a batch it cannot afford.
+
         ``trace`` is the caller's
         :class:`~..telemetry.tracectx.TraceContext` (``None`` when the
         request is untraced): the leader's combined dispatch lands as a
@@ -173,37 +221,59 @@ class MicroBatcher:
         records a "batch:join" span under its OWN request whose
         ``links`` field names the leader's dispatch span — cross-trace
         causality without fake parentage."""
-        if deadline is not None and deadline.remaining() <= self.window_s:
-            # The window would eat the caller's whole budget: dispatch
-            # alone, now.  (An already-expired deadline was shed upstream.)
+        solo = False
+        with self._lock:
+            batch = self._pending.get(key)
+            joinable = (
+                batch is not None
+                and not batch.closed
+                and len(batch.items) < self.max_batch
+            )
+            if deadline is not None:
+                # The wait this member would actually sign up for: the
+                # whole window when it would open a fresh batch, the
+                # REMAINING window when it would join an open one.
+                budget = (
+                    max(
+                        0.0,
+                        self.window_s
+                        - (self._clock() - batch.opened_at),
+                    )
+                    if joinable
+                    else self.window_s
+                )
+                if deadline.remaining() <= budget:
+                    # The wait would eat the caller's whole budget:
+                    # dispatch alone, now.  (An already-expired deadline
+                    # was shed upstream.)
+                    solo = True
+            if not solo:
+                leader = False
+                if not joinable:
+                    batch = _Batch(opened_at=self._clock())
+                    if self._trace_sink is not None:
+                        from kubernetesclustercapacity_tpu.telemetry.tracing import (  # noqa: E501
+                            new_span_id,
+                        )
+
+                        batch.leader_span_id = new_span_id()
+                    self._pending[key] = batch
+                    leader = True
+                idx = len(batch.items)
+                batch.items.append(item)
+                batch.tenants.append(tenant)
+                batch.weights.append(weight)
+                if len(batch.items) >= self.max_batch:
+                    batch.full.set()
+        if solo:
+            # Outside the lock: a bypass dispatch must never hold the
+            # fold queue shut while its kernel runs.
             self._m_bypass.inc()
             self._m_solo.inc()
             self._m_size.observe(1)
             self._m_tenants.observe(1)
+            self._m_specs.observe(weight)
             return self._dispatch(key, [item])[0]
-
-        with self._lock:
-            batch = self._pending.get(key)
-            leader = False
-            if (
-                batch is None
-                or batch.closed
-                or len(batch.items) >= self.max_batch
-            ):
-                batch = _Batch()
-                if self._trace_sink is not None:
-                    from kubernetesclustercapacity_tpu.telemetry.tracing import (  # noqa: E501
-                        new_span_id,
-                    )
-
-                    batch.leader_span_id = new_span_id()
-                self._pending[key] = batch
-                leader = True
-            idx = len(batch.items)
-            batch.items.append(item)
-            batch.tenants.append(tenant)
-            if len(batch.items) >= self.max_batch:
-                batch.full.set()
 
         from kubernetesclustercapacity_tpu.telemetry import phases as _phases
 
@@ -243,8 +313,16 @@ class MicroBatcher:
                 self._m_tenants.observe(
                     len(set(batch.tenants[: len(items)])) or 1
                 )
+                self._m_specs.observe(
+                    sum(batch.weights[: len(items)]) or 1
+                )
                 if len(items) > 1:
                     self._m_batched.inc(len(items))
+                    if self._fold_hook is not None:
+                        try:
+                            self._fold_hook(batch.tenants[: len(items)])
+                        except Exception:  # noqa: BLE001 - accounting
+                            pass  # must never fail a dispatch
                 else:
                     self._m_solo.inc()
                 batch.done.set()

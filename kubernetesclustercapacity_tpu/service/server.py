@@ -112,6 +112,52 @@ class _ThreadingServer(socketserver.ThreadingTCPServer):
                 pass
 
 
+class _FoldedFetch:
+    """Shared device->host materialization for one async folded dispatch.
+
+    The whole batch rides ONE pair of ``jax.Array`` futures; the first
+    member to build its response pays the single sync (and device->host
+    transfer), everyone after slices the cached host arrays.  Slicing
+    the *device* arrays per member instead would launch a fresh XLA
+    slice program per (offset, length) — a compile on first sight that
+    dwarfs the dispatch the fold exists to amortize.
+    """
+
+    def __init__(self, totals, sched) -> None:
+        self._totals, self._sched = totals, sched
+        self._lock = threading.Lock()
+        self._np: tuple | None = None
+
+    def arrays(self) -> tuple:
+        with self._lock:
+            if self._np is None:
+                self._np = (
+                    np.asarray(self._totals),
+                    np.asarray(self._sched),
+                )
+                self._totals = self._sched = None
+            return self._np
+
+
+class _FoldedSlice:
+    """One member's ``[offset:end]`` view of a :class:`_FoldedFetch`.
+
+    Materializes through the numpy ``__array__`` protocol, so the
+    response path's ``np.asarray`` is the (timed) sync point.
+    """
+
+    def __init__(self, fetch: _FoldedFetch, which: int, offset: int,
+                 end: int) -> None:
+        self._fetch = fetch
+        self._which = which
+        self._offset = offset
+        self._end = end
+
+    def __array__(self, dtype=None, copy=None):
+        view = self._fetch.arrays()[self._which][self._offset:self._end]
+        return np.asarray(view) if dtype is None else np.asarray(view, dtype)
+
+
 class CapacityServer:
     """Serve capacity queries for one snapshot over the framed-JSON protocol.
 
@@ -383,12 +429,25 @@ class CapacityServer:
                 MicroBatcher,
             )
 
+            fold_hook = None
+            if self._tenants is not None:
+                from kubernetesclustercapacity_tpu.service import (
+                    tenancy as _tenancy,
+                )
+
+                if _tenancy.enabled():
+                    # Cross-tenant fold attribution: the batcher reports
+                    # each multi-request dispatch's member tenants so
+                    # the per-tenant metrics can say whose work shared
+                    # a launch.
+                    fold_hook = _tenancy.FoldAccounting(self._tenants, m)
             self._batcher = MicroBatcher(
                 self._dispatch_sweep_batch,
                 window_s=float(batch_window_ms) / 1e3,
                 max_batch=batch_max,
                 registry=m,
                 trace_sink=self._trace_sink,
+                fold_hook=fold_hook,
             )
         # Per-dispatch-thread context: the snapshot generation captured
         # under the dispatch lock, so the flight record says which
@@ -1863,9 +1922,27 @@ class CapacityServer:
 
         scenario = self._scenario_from_msg(msg)
         grid = ScenarioGrid.from_scenarios([scenario])
-        result = explain_snapshot(
-            snap, grid, mode=snap.semantics, node_mask=implicit_mask
-        )
+        if self._batcher is not None:
+            # Explain folds into the SAME queue as plain sweeps (key:
+            # generation + semantics + the "auto" kernel family sweeps
+            # default to).  A mixed batch rides the fused sweep+explain
+            # super-kernel; this member takes its [S, N] row slice.
+            generation = getattr(self._dispatch_tls, "generation", None)
+            if generation is None:
+                generation = ("snap-id", id(snap))
+            grid.validate()
+            result, _kernel = self._batcher.submit(
+                (generation, snap.semantics, "auto"),
+                ("explain", snap, implicit_mask, grid),
+                deadline=self._check_deadline(msg),
+                tenant=getattr(self._dispatch_tls, "tenant", None),
+                trace=getattr(self._dispatch_tls, "trace_ctx", None),
+                weight=grid.size,
+            )
+        else:
+            result = explain_snapshot(
+                snap, grid, mode=snap.semantics, node_mask=implicit_mask
+            )
         total = int(result.totals[0])
         out = {
             "total": total,
@@ -2407,20 +2484,23 @@ class CapacityServer:
         if self._batcher is not None:
             # Validate BEFORE joining a batch: a bad grid must fail its
             # own request, never a batch it rode into.  Keyed by the
-            # captured generation + kernel choice, so only requests whose
-            # combined dispatch is semantically identical ever share a
-            # launch (snap and implicit_mask are generation-determined).
+            # captured generation + served semantics + kernel family —
+            # requests with DIFFERENT pod specs (even different tenants)
+            # fold into one padded dispatch and split per request on
+            # return (snap and implicit_mask are generation-determined,
+            # so nothing else can diverge inside a key).
             grid.validate()
             totals, sched, kernel, attempted, attempt_error = (
                 self._batcher.submit(
-                    (generation, kernel_req),
-                    (snap, implicit_mask, grid),
+                    (generation, snap.semantics, kernel_req),
+                    ("sweep", snap, implicit_mask, grid),
                     deadline=self._check_deadline(msg),
                     # Folding across tenants is the POINT (one padded
                     # dispatch, split per tenant on return, bit-exact
                     # vs solo) — the label only feeds accounting.
                     tenant=getattr(self._dispatch_tls, "tenant", None),
                     trace=getattr(self._dispatch_tls, "trace_ctx", None),
+                    weight=grid.size,
                 )
             )
         else:
@@ -2440,6 +2520,27 @@ class CapacityServer:
                 node_mask=implicit_mask,
             )
             attempted, attempt_error = last_dispatch_fast_path()
+
+        # Async pipelining: a folded batch answers with ``jax.Array``
+        # futures (dispatch enqueued, not fetched) so the leader's
+        # launch overlaps the NEXT batch's accumulation window.  Block
+        # on device->host transfer at the last possible moment — here,
+        # just before the response is built — and account the stall to
+        # its own phase so the overlap is visible in evidence.
+        from kubernetesclustercapacity_tpu.telemetry import phases as _phases
+
+        if not isinstance(totals, np.ndarray):
+            clk_f = _phases.current()
+            if clk_f:
+                import time as _time
+
+                t0 = _time.perf_counter()
+                totals = np.asarray(totals)
+                sched = np.asarray(sched)
+                clk_f.record("fetch_overlap", _time.perf_counter() - t0)
+            else:
+                totals = np.asarray(totals)
+                sched = np.asarray(sched)
 
         # Shadow-oracle sampling: decision + queue append only (the
         # oracle walk runs on the sampler's worker thread, never this
@@ -2462,8 +2563,6 @@ class CapacityServer:
         # dispatch).  A stale breaker error must never ride an
         # exact-kernel response — the breaker's standing state lives in
         # the info op instead.
-        from kubernetesclustercapacity_tpu.telemetry import phases as _phases
-
         clk = _phases.current()
         if clk:
             import time as _time
@@ -2495,31 +2594,54 @@ class CapacityServer:
         }
 
     def _dispatch_sweep_batch(self, key, items) -> list:
-        """One kernel launch for a micro-batch of plain sweeps.
+        """One kernel launch for a micro-batch of folded requests.
 
-        ``items`` are ``(snap, implicit_mask, grid)`` tuples sharing one
-        snapshot generation and kernel choice; their scenario rows
-        concatenate along the existing scenario axis, dispatch once, and
-        scatter back per request.  A batch of one takes EXACTLY the solo
-        path, so batching a single request is bit-identical (and
-        observably identical) to no batching at all.
+        ``items`` are ``(op, snap, implicit_mask, grid)`` tuples sharing
+        one snapshot generation, served semantics, and kernel family —
+        ``op`` is ``"sweep"`` or ``"explain"``.  Scenario rows from ALL
+        members concatenate along the existing scenario axis (different
+        pod specs, different tenants — the key already guarantees the
+        dispatch is semantically identical), launch once, and scatter
+        back per request.  A batch of one takes EXACTLY the solo path,
+        so batching a single request is bit-identical (and observably
+        identical) to no batching at all.
+
+        * all-sweep batches dispatch **async** (``sync=False``): members
+          receive ``jax.Array`` slices and block on the device->host
+          fetch only at response-build time (``fetch_overlap`` phase),
+          so the launch overlaps the next batch's window;
+        * batches containing an explain ride the fused
+          ``sweep+explain`` super-kernel — sweep members read the
+          fused totals (pinned bit-identical to the sweep kernel's),
+          explain members take ``[S, N]`` row slices of the one
+          per-node result.
         """
         from kubernetesclustercapacity_tpu.ops.pallas_fit import (
             last_dispatch_fast_path,
+            sweep_explain_snapshot_auto,
             sweep_snapshot_auto,
         )
 
-        _generation, kernel_req = key
-        snap, mask, _ = items[0]
+        _generation, _semantics, kernel_req = key
+        _op0, snap, mask, _grid0 = items[0]
         if len(items) == 1:
-            grid = items[0][2]
+            op, _, _, grid = items[0]
+            if op == "explain":
+                from kubernetesclustercapacity_tpu.explain import (
+                    explain_snapshot,
+                )
+
+                result = explain_snapshot(
+                    snap, grid, mode=snap.semantics, node_mask=mask
+                )
+                return [(result, "explain")]
             totals, sched, kernel = sweep_snapshot_auto(
                 snap, grid, mode=snap.semantics, kernel=kernel_req,
                 node_mask=mask,
             )
             attempted, err = last_dispatch_fast_path()
             return [(totals, sched, kernel, attempted, err)]
-        grids = [item[2] for item in items]
+        grids = [item[3] for item in items]
         combined = ScenarioGrid(
             cpu_request_milli=np.concatenate(
                 [g.cpu_request_milli for g in grids]
@@ -2529,17 +2651,75 @@ class CapacityServer:
             ),
             replicas=np.concatenate([g.replicas for g in grids]),
         )
-        totals, sched, kernel = sweep_snapshot_auto(
-            snap, combined, mode=snap.semantics, kernel=kernel_req,
-            node_mask=mask,
-        )
-        attempted, err = last_dispatch_fast_path()
-        out, offset = [], 0
-        for g in grids:
-            end = offset + g.size
-            out.append(
-                (totals[offset:end], sched[offset:end], kernel, attempted, err)
+        if any(item[0] == "explain" for item in items):
+            totals, sched, full, kernel = sweep_explain_snapshot_auto(
+                snap, combined, mode=snap.semantics, node_mask=mask
             )
+            attempted, err = False, None
+        else:
+            totals, sched, kernel = sweep_snapshot_auto(
+                snap, combined, mode=snap.semantics, kernel=kernel_req,
+                node_mask=mask, sync=False,
+            )
+            attempted, err = last_dispatch_fast_path()
+            full = None
+        # One shared sync for the whole batch when the dispatch really
+        # went async (jax.Array futures): members scatter host-slicing
+        # views, never per-member device slices (each of those would be
+        # its own XLA slice program — a compile per fold composition).
+        fetch = (
+            _FoldedFetch(totals, sched)
+            if not isinstance(totals, np.ndarray)
+            else None
+        )
+        out, offset = [], 0
+        for (op, _, _, g) in items:
+            end = offset + g.size
+            if op == "explain":
+                from kubernetesclustercapacity_tpu.explain import (
+                    ExplainResult,
+                )
+
+                out.append((
+                    ExplainResult(
+                        snapshot=snap,
+                        mode=full.mode,
+                        cpu_request_milli=full.cpu_request_milli[
+                            offset:end
+                        ],
+                        mem_request_bytes=full.mem_request_bytes[
+                            offset:end
+                        ],
+                        replicas=full.replicas[offset:end],
+                        fits=full.fits[offset:end],
+                        binding=full.binding[offset:end],
+                        cpu_fit=full.cpu_fit[offset:end],
+                        mem_fit=full.mem_fit[offset:end],
+                        slots=full.slots[offset:end],
+                        node_mask=full.node_mask,
+                    ),
+                    kernel,
+                ))
+            elif fetch is not None:
+                out.append(
+                    (
+                        _FoldedSlice(fetch, 0, offset, end),
+                        _FoldedSlice(fetch, 1, offset, end),
+                        kernel,
+                        attempted,
+                        err,
+                    )
+                )
+            else:
+                out.append(
+                    (
+                        totals[offset:end],
+                        sched[offset:end],
+                        kernel,
+                        attempted,
+                        err,
+                    )
+                )
             offset = end
         return out
 
@@ -2686,10 +2866,21 @@ class CapacityServer:
                 generation = self._generation
             else:
                 self._generation = generation
-        if old is not snapshot:
-            devcache.CACHE.invalidate(old)
-        if warm:
-            devcache.CACHE.warm(snapshot)
+        if old is not snapshot and warm and devcache.enabled() \
+                and devcache.donate_enabled():
+            # Donated resident publish: the retired generation's staged
+            # exact columns carry over where unchanged and re-upload
+            # through the donate_argnums jit where not — a watch event
+            # that touched a handful of nodes re-transfers only those
+            # columns instead of the fleet.  KCCAP_DONATE=0 restores
+            # the invalidate+warm publish below byte-for-byte.
+            devcache.CACHE.stage_replace(old, snapshot)
+            devcache.CACHE.warm(snapshot, forms=("pallas",))
+        else:
+            if old is not snapshot:
+                devcache.CACHE.invalidate(old)
+            if warm:
+                devcache.CACHE.warm(snapshot)
         # Timeline observation rides the SAME publisher thread as the
         # warm pre-stage (the coalescer's worker under -follow), AFTER
         # warming — the watchlist evaluation hits a warm device cache,

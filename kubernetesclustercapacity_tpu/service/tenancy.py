@@ -40,6 +40,7 @@ __all__ = [
     "TenantSpec",
     "TenantMap",
     "FairSlotQueue",
+    "FoldAccounting",
     "parse_tenants",
     "load_tenants",
     "enabled",
@@ -211,6 +212,54 @@ class TenantMap:
         return {
             "tenants": [s.to_wire() for s in self.specs],
         }
+
+
+class FoldAccounting:
+    """Cross-tenant fold attribution: who shared whose kernel launch.
+
+    The micro-batcher's fold queue coalesces concurrent requests across
+    tenants into one padded dispatch (bit-exact vs solo — the combined
+    dispatch is index-scattered and never reads the label), which makes
+    "whose work rode that launch" invisible to the per-tenant admission
+    metrics.  This is the batcher's ``fold_hook``: called once per
+    MULTI-request dispatch with the members' tenant identities, it
+    counts each member on ``kccap_tenant_folded_requests_total`` under
+    its bounded :meth:`TenantMap.label` (so a tenant-id flood cannot
+    explode the label set) and bumps ``kccap_fold_cross_tenant_total``
+    when the fold actually crossed a tenant boundary — the number the
+    multi-tenant amortization claim rests on.  Pure attribution: it
+    influences nothing and must never fail a dispatch (the batcher
+    swallows exceptions, and this class raises none by construction).
+    """
+
+    def __init__(self, tenant_map: TenantMap | None, registry) -> None:
+        self._map = tenant_map
+        self._folded = registry.counter(
+            "kccap_tenant_folded_requests_total",
+            "Requests served as members of a multi-request folded "
+            "dispatch, by (bounded) tenant label.",
+            ("tenant",),
+        )
+        self._cross = registry.counter(
+            "kccap_fold_cross_tenant_total",
+            "Folded dispatches whose members spanned more than one "
+            "tenant (one padded launch shared across tenant "
+            "boundaries).",
+        )
+
+    def _label(self, tenant) -> str:
+        if not isinstance(tenant, str) or not tenant:
+            return "other"  # anonymous member (tenancy off for it)
+        if self._map is None:
+            return "other"
+        return self._map.label(tenant)
+
+    def __call__(self, tenants) -> None:
+        labels = [self._label(t) for t in tenants]
+        for lab in labels:
+            self._folded.labels(tenant=lab).inc()
+        if len(set(labels)) > 1:
+            self._cross.inc()
 
 
 def parse_tenants(data) -> TenantMap:

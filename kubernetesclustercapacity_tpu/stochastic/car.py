@@ -33,7 +33,10 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from kubernetesclustercapacity_tpu.ops.fit import sweep_snapshot
+from kubernetesclustercapacity_tpu.ops.fit import (
+    sweep_quantiles_snapshot,
+    sweep_snapshot,
+)
 from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
 from kubernetesclustercapacity_tpu.snapshot import ClusterSnapshot
 from kubernetesclustercapacity_tpu.stochastic.distributions import (
@@ -146,6 +149,7 @@ def capacity_at_risk(
     node_mask=None,
     quantiles: tuple[float, ...] = DEFAULT_QUANTILES,
     bindings: bool = True,
+    fused: bool = True,
 ) -> CaRResult:
     """Evaluate one stochastic spec against a snapshot.
 
@@ -155,6 +159,15 @@ def capacity_at_risk(
     live sweep — same node_mask conventions, same semantics modes), and
     reduces the per-sample totals to capacity quantiles, the mean, and
     the probability of fitting ``spec.replicas``.
+
+    ``fused=True`` (the default) runs the sweep AND the order-statistic
+    reduction as ONE device launch (:func:`..ops.fit.sweep_quantiles_snapshot`):
+    the quantile indices are computed host-side from ``(S, q)`` alone
+    and gathered from an on-device stable argsort — a stable sort's
+    permutation is algorithm-independent, so the quantile values and
+    realizing sample indices are bit-identical to the host-side
+    ``np.argsort(kind="stable")`` reduction (``fused=False``, the
+    pre-fusion path, kept as the oracle twin and pinned equal by test).
 
     ``bindings=True`` additionally explains the quantile-realizing
     scenarios (one explain pass over ``len(quantiles)`` rows): which
@@ -171,20 +184,32 @@ def capacity_at_risk(
         mem_request_bytes=mem,
         replicas=np.full(n, int(spec.replicas), dtype=np.int64),
     )
-    totals, sched = sweep_snapshot(
-        snapshot, grid, mode=mode, node_mask=node_mask
-    )
-    totals = np.asarray(totals, dtype=np.int64)
-    # Host-side reduction: a stable argsort so the quantile-realizing
-    # SAMPLE index (not just the value) is deterministic under ties.
-    order = np.argsort(totals, kind="stable")
-    sorted_totals = totals[order]
     qvals: dict[float, int] = {}
     qsamples: dict[float, int] = {}
-    for q in quantiles:
-        i = quantile_index(n, q)
-        qvals[q] = int(sorted_totals[i])
-        qsamples[q] = int(order[i])
+    if fused:
+        qs = tuple(quantiles)
+        q_indices = tuple(quantile_index(n, q) for q in qs)
+        totals, sched, qv, qx, _kernel = sweep_quantiles_snapshot(
+            snapshot, grid, mode=mode, node_mask=node_mask,
+            q_indices=q_indices,
+        )
+        totals = np.asarray(totals, dtype=np.int64)
+        for j, q in enumerate(qs):
+            qvals[q] = int(qv[j])
+            qsamples[q] = int(qx[j])
+    else:
+        totals, sched = sweep_snapshot(
+            snapshot, grid, mode=mode, node_mask=node_mask
+        )
+        totals = np.asarray(totals, dtype=np.int64)
+        # Host-side reduction: a stable argsort so the quantile-realizing
+        # SAMPLE index (not just the value) is deterministic under ties.
+        order = np.argsort(totals, kind="stable")
+        sorted_totals = totals[order]
+        for q in quantiles:
+            i = quantile_index(n, q)
+            qvals[q] = int(sorted_totals[i])
+            qsamples[q] = int(order[i])
     result = CaRResult(
         spec=spec,
         mode=mode,

@@ -32,6 +32,12 @@ the dispatch:
 ``fetch``
     the device→host materialization — ``np.asarray`` /
     ``block_until_ready`` in ``ops/fit.py`` and ``ops/pallas_fit.py``;
+``fetch_overlap``
+    the deferred materialization of an async dispatch: the kernel
+    returned ``jax.Array`` futures and the request blocked on the
+    bytes only at response-build time, so this wait OVERLAPPED the
+    next batch's window/dispatch instead of serializing behind it
+    (``service/server.py``'s folded sweep path);
 ``serialize``
     building the wire response (``tolist`` and report rendering).
 
@@ -80,6 +86,7 @@ PHASES = (
     "compile",
     "device_exec",
     "fetch",
+    "fetch_overlap",
     "serialize",
 )
 

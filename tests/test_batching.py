@@ -169,6 +169,94 @@ class TestMechanics:
         with pytest.raises(ValueError):
             MicroBatcher(lambda k, i: [], window_s=0.01, max_batch=0)
 
+    def test_follower_with_tight_deadline_bypasses(self):
+        """Regression: the bypass decision is PER MEMBER against the
+        batch it would actually join.  A follower whose deadline cannot
+        survive the leader's remaining window must go solo — consulting
+        only the leader's deadline (or comparing followers against the
+        FULL window) strands the follower behind a wait it cannot
+        afford.  The injected clock makes the remaining-window budget
+        deterministic."""
+        fake = [100.0]
+        calls = []
+        leader_started = threading.Event()
+
+        def dispatch(key, items):
+            calls.append(list(items))
+            return [len(items)] * len(items)
+
+        b = MicroBatcher(
+            dispatch, window_s=0.5, max_batch=8, clock=lambda: fake[0]
+        )
+        results = {}
+
+        def leader():
+            leader_started.set()
+            results["leader"] = b.submit("k", "L")
+
+        t = threading.Thread(target=leader)
+        t.start()
+        leader_started.wait(5)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and b.stats["dispatches"] == 0:
+            # The leader's batch is open (pending) once submit enters its
+            # window wait; poll until the follower can observably join.
+            with b._lock:
+                if "k" in b._pending:
+                    break
+            time.sleep(0.001)
+        # Injected clock: 0.2s of the 0.5s window "elapsed" -> remaining
+        # budget 0.3s.  This follower's 0.1s deadline is tighter: solo.
+        fake[0] = 100.2
+        out = b.submit("k", "tight", deadline=Deadline.after(0.1))
+        assert out == 1  # dispatched alone, immediately
+        assert b.stats["deadline_bypass"] == 1
+        t.join(10)
+        assert results["leader"] == 1  # the leader's batch never saw it
+        assert sorted(len(c) for c in calls) == [1, 1]
+
+    def test_follower_joins_when_remaining_window_fits(self):
+        """The flip side: a follower whose deadline is tighter than the
+        FULL window but roomier than the REMAINING window must still
+        join (bypassing it would spend a dispatch the deadline never
+        required)."""
+        fake = [100.0]
+        calls = []
+        leader_started = threading.Event()
+
+        def dispatch(key, items):
+            calls.append(list(items))
+            return [len(items)] * len(items)
+
+        b = MicroBatcher(
+            dispatch, window_s=0.5, max_batch=2, clock=lambda: fake[0]
+        )
+        results = {}
+
+        def leader():
+            leader_started.set()
+            results["leader"] = b.submit("k", "L")
+
+        t = threading.Thread(target=leader)
+        t.start()
+        leader_started.wait(5)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with b._lock:
+                if "k" in b._pending:
+                    break
+            time.sleep(0.001)
+        # 0.45s of the 0.5s window "elapsed" -> remaining budget 0.05s.
+        # A 0.2s deadline would have bypassed against the full 0.5s
+        # window; against the honest remainder it joins (and max_batch=2
+        # dispatches the pair immediately).
+        fake[0] = 100.45
+        out = b.submit("k", "roomy", deadline=Deadline.after(0.2))
+        t.join(10)
+        assert out == 2 and results["leader"] == 2  # one shared dispatch
+        assert b.stats["deadline_bypass"] == 0
+        assert [len(c) for c in calls] == [2]
+
 
 def _sweep_dispatch(snap, mode):
     """The server-style combined dispatch: concatenate scenario rows,

@@ -389,3 +389,137 @@ class TestGspmdStaging:
         assert stage_gspmd_arrays(plan, snap) is stage_gspmd_arrays(
             plan, snap
         )
+
+
+class TestDonatedResidentBuffers:
+    """stage_replace: the donated-resident publish path (ISSUE 19).
+
+    Contracts: only CHANGED columns re-upload (unchanged device arrays
+    carry over by identity); the staged tuple is byte-equal to a fresh
+    exact_arrays build in every disposition mix; the retired
+    generation's entries are gone; KCCAP_DONATE=0 gates the whole path
+    off at the caller seam (donate_enabled), and sweeps answer
+    byte-identically with the hatch open or closed.
+    """
+
+    def _mutate_some(self, snap, n_changed=5):
+        import dataclasses
+
+        used = snap.used_cpu_req_milli.copy()
+        used[:n_changed] += 17
+        return dataclasses.replace(snap, used_cpu_req_milli=used)
+
+    def test_unchanged_columns_reused_by_identity(self):
+        cache = devcache.DeviceCache()
+        old = synthetic_snapshot(200, seed=31)
+        prior = cache.exact_arrays(old)
+        new = self._mutate_some(old)
+        counts = cache.stage_replace(old, new)
+        # One column changed (used_cpu_req_milli, index 3): six carry
+        # over without any transfer, one re-uploads.
+        assert counts["reused"] == 6
+        assert counts["donated"] + counts["restaged"] == 1
+        staged = cache.exact_arrays(new)
+        for i in (0, 1, 2, 4, 5, 6):
+            assert staged[i] is prior[i]
+
+    def test_staged_tuple_byte_equal_to_fresh_build(self):
+        cache = devcache.DeviceCache()
+        old = synthetic_snapshot(200, seed=32)
+        cache.exact_arrays(old)
+        new = self._mutate_some(old, n_changed=11)
+        cache.stage_replace(old, new)
+        staged = cache.exact_arrays(new)
+        fresh = devcache.DeviceCache().exact_arrays(new)
+        assert len(staged) == len(fresh)
+        for a, b in zip(staged, fresh):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_old_generation_entries_are_retired(self):
+        cache = devcache.DeviceCache()
+        old = synthetic_snapshot(200, seed=33)
+        cache.exact_arrays(old)
+        cache.pallas_arrays(old)
+        new = self._mutate_some(old)
+        cache.stage_replace(old, new)
+        st = cache.stats()
+        assert st["entries"] == 1  # only new's staged exact tuple
+        # A fresh exact_arrays(new) is a HIT on the staged entry — the
+        # publish pre-paid the staging a dispatch would have done.
+        before = cache.stats()["misses"]
+        cache.exact_arrays(new)
+        assert cache.stats()["misses"] == before
+
+    def test_node_count_change_within_bucket_stages(self):
+        import dataclasses
+
+        cache = devcache.DeviceCache()
+        old = synthetic_snapshot(200, seed=35)
+        cache.exact_arrays(old)
+        bigger = synthetic_snapshot(205, seed=35)
+        counts = cache.stage_replace(old, bigger)
+        assert sum(counts.values()) == 7
+        staged = cache.exact_arrays(bigger)
+        fresh = devcache.DeviceCache().exact_arrays(bigger)
+        for a, b in zip(staged, fresh):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert dataclasses is not None
+
+    def test_no_prior_staging_is_a_cold_publish(self):
+        cache = devcache.DeviceCache()
+        old = synthetic_snapshot(200, seed=36)  # never staged
+        new = self._mutate_some(old)
+        counts = cache.stage_replace(old, new)
+        assert counts == {"reused": 0, "donated": 0, "restaged": 7}
+        staged = cache.exact_arrays(new)
+        fresh = devcache.DeviceCache().exact_arrays(new)
+        for a, b in zip(staged, fresh):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_donate_enabled_env_hatch(self, monkeypatch):
+        monkeypatch.delenv("KCCAP_DONATE", raising=False)
+        assert devcache.donate_enabled() is True
+        monkeypatch.setenv("KCCAP_DONATE", "0")
+        assert devcache.donate_enabled() is False
+        monkeypatch.setenv("KCCAP_DONATE", "1")
+        assert devcache.donate_enabled() is True
+
+    @pytest.mark.parametrize("donate", ("0", "1"))
+    def test_server_publish_byte_identical_either_hatch(
+        self, donate, monkeypatch
+    ):
+        """The KCCAP_DONATE pin: a replace_snapshot publish answers the
+        SAME bytes whether the donated-resident path ran or the
+        invalidate+rewarm path did."""
+        from kubernetesclustercapacity_tpu.service import (
+            CapacityClient,
+            CapacityServer,
+        )
+
+        monkeypatch.setenv("KCCAP_DONATE", donate)
+        old = synthetic_snapshot(200, seed=37)
+        new = self._mutate_some(old, n_changed=9)
+        srv = CapacityServer(old, port=0, batch_window_ms=0.0)
+        srv.start()
+        try:
+            srv.replace_snapshot(new)
+            c = CapacityClient(*srv.address)
+            got = c.sweep(
+                cpu_request_milli=[100, 450, 900],
+                mem_request_bytes=[10 ** 8, 3 * 10 ** 8, 10 ** 9],
+                replicas=[1, 2, 4],
+            )
+            c.close()
+        finally:
+            srv.shutdown()
+        grid = ScenarioGrid(
+            cpu_request_milli=np.array([100, 450, 900]),
+            mem_request_bytes=np.array([10 ** 8, 3 * 10 ** 8, 10 ** 9]),
+            replicas=np.array([1, 2, 4]),
+        )
+        want, want_sched = sweep_grid(
+            *_snapshot_args(new), grid.cpu_request_milli,
+            grid.mem_request_bytes, grid.replicas, mode=new.semantics,
+        )
+        assert got["totals"] == list(np.asarray(want))
+        assert got["schedulable"] == list(np.asarray(want_sched))

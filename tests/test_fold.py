@@ -1,0 +1,275 @@
+"""Cross-spec request folding: the ISSUE-19 serving contract.
+
+Concurrent requests whose pod specs DIFFER — across tenants, across
+ops — fold into one padded scenario dispatch keyed only by
+(generation, semantics, kernel family) and split per request on return.
+The property under test is bit-exactness: every folded answer equals
+the same request served solo, in both semantics modes and across the
+KCCAP_GROUPING x KCCAP_DEVCACHE matrix; explain members of a mixed
+batch (served by the fused sweep+explain super-kernel) match the
+unbatched explain op field for field; and the evidence actually lands
+(fold_rate, mean_folded_specs, the fetch_overlap phase on async folded
+sweeps).
+"""
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.service import (
+    CapacityClient,
+    CapacityServer,
+)
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+
+def _random_specs(rng, k):
+    """k sweep requests, every one a DIFFERENT spec (sizes 1-3)."""
+    specs = []
+    for _ in range(k):
+        s = int(rng.integers(1, 4))
+        specs.append(
+            dict(
+                cpu_request_milli=rng.integers(50, 2000, size=s).tolist(),
+                mem_request_bytes=(
+                    rng.integers(1, 2048, size=s) * (1 << 20)
+                ).tolist(),
+                replicas=rng.integers(1, 8, size=s).tolist(),
+            )
+        )
+    return specs
+
+
+def _snapshot(mode, grouping):
+    # 2048 nodes / 23 distinct shapes clears the grouping node floor and
+    # compression gate; 300 nodes stays safely under the floor so the
+    # ungrouped dispatch is what actually runs.
+    if grouping == "1":
+        snap = synthetic_snapshot(2048, seed=5, shapes=23)
+    else:
+        snap = synthetic_snapshot(300, seed=5)
+    if mode == "strict":
+        healthy = snap.healthy.copy()
+        healthy[::7] = False
+        snap = dataclasses.replace(snap, semantics="strict", healthy=healthy)
+    return snap
+
+
+def _serve_folded(snap, specs, explains=(), window_ms=250.0):
+    """One batched server; all requests released through a barrier so
+    they land inside one fold window.  Returns (sweep results, explain
+    results, batcher stats, flight records)."""
+    srv = CapacityServer(
+        snap, port=0, batch_window_ms=window_ms, batch_max=64
+    )
+    srv.start()
+    try:
+        results = [None] * len(specs)
+        exp = [None] * len(explains)
+        errors = []
+        barrier = threading.Barrier(len(specs) + len(explains))
+
+        def sweep(i):
+            try:
+                c = CapacityClient(*srv.address)
+                barrier.wait()
+                results[i] = c.sweep(**specs[i])
+                c.close()
+            except Exception as e:  # noqa: BLE001 - surfaced via errors
+                errors.append(e)
+
+        def explain(j):
+            try:
+                c = CapacityClient(*srv.address)
+                barrier.wait()
+                exp[j] = c.explain(**explains[j])
+                c.close()
+            except Exception as e:  # noqa: BLE001 - surfaced via errors
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=sweep, args=(i,))
+            for i in range(len(specs))
+        ] + [
+            threading.Thread(target=explain, args=(j,))
+            for j in range(len(explains))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        return results, exp, dict(srv._batcher.stats), srv._flight.records()
+    finally:
+        srv.shutdown()
+
+
+def _serve_solo(snap, specs, explains=()):
+    srv = CapacityServer(snap, port=0, batch_window_ms=0.0)
+    srv.start()
+    try:
+        c = CapacityClient(*srv.address)
+        res = [c.sweep(**s) for s in specs]
+        exp = [c.explain(**e) for e in explains]
+        c.close()
+        return res, exp
+    finally:
+        srv.shutdown()
+
+
+class TestCrossSpecFoldParity:
+    @pytest.mark.parametrize("mode", ("reference", "strict"))
+    @pytest.mark.parametrize("grouping", ("0", "1"))
+    @pytest.mark.parametrize("devc", ("0", "1"))
+    def test_folded_bit_identical_to_solo(
+        self, mode, grouping, devc, monkeypatch
+    ):
+        monkeypatch.setenv("KCCAP_GROUPING", grouping)
+        monkeypatch.setenv("KCCAP_DEVCACHE", devc)
+        snap = _snapshot(mode, grouping)
+        rng = np.random.default_rng(1234 + (grouping == "1") * 2 + (devc == "1"))
+        specs = _random_specs(rng, 6)
+        folded, _, stats, _ = _serve_folded(snap, specs)
+        solo, _ = _serve_solo(snap, specs)
+        for i, (f, s) in enumerate(zip(folded, solo)):
+            assert f["totals"] == s["totals"], i
+            assert f["schedulable"] == s["schedulable"], i
+            assert f["scenarios"] == s["scenarios"], i
+        # The point of the exercise: DIFFERENT specs actually shared a
+        # launch (the barrier puts all six well inside one window).
+        assert stats["batched_requests"] >= 2
+        assert stats["fold_rate"] > 0.0
+        assert stats["mean_folded_specs"] > 1.0
+
+    @pytest.mark.parametrize("mode", ("reference", "strict"))
+    def test_mixed_sweep_explain_fold_matches_solo(self, mode):
+        """Mixed batches ride the fused sweep+explain super-kernel:
+        sweep members and explain members BOTH answer bit-identically
+        to their unbatched twins."""
+        snap = _snapshot(mode, "0")
+        rng = np.random.default_rng(77)
+        specs = _random_specs(rng, 4)
+        explains = [
+            dict(cpuRequests="150m", memRequests="3mb", replicas="5"),
+            dict(cpuRequests="900m", memRequests="800mb", replicas="2"),
+        ]
+        folded, fexp, stats, _ = _serve_folded(snap, specs, explains)
+        solo, sexp = _serve_solo(snap, specs, explains)
+        for i, (f, s) in enumerate(zip(folded, solo)):
+            assert f["totals"] == s["totals"], i
+            assert f["schedulable"] == s["schedulable"], i
+        for j, (f, s) in enumerate(zip(fexp, sexp)):
+            assert f == s, j
+        assert stats["batched_requests"] >= 2
+
+    def test_cross_tenant_requests_fold(self):
+        """Tenancy labels are pure attribution: requests from DIFFERENT
+        tenants fold into one dispatch, answers split bit-exactly, and
+        the FoldAccounting counters say whose work shared the launch
+        (kccap_fold_cross_tenant_total > 0)."""
+        from kubernetesclustercapacity_tpu.service.tenancy import (
+            parse_tenants,
+        )
+
+        snap = _snapshot("reference", "0")
+        specs = _random_specs(np.random.default_rng(9), 4)
+        tm = parse_tenants(
+            [
+                {"name": "team-0", "rps": 1000},
+                {"name": "team-1", "rps": 1000},
+            ]
+        )
+        srv = CapacityServer(
+            snap, port=0, batch_window_ms=250.0, batch_max=16, tenants=tm
+        )
+        srv.start()
+        try:
+            errors = []
+            barrier = threading.Barrier(len(specs))
+            results = [None] * len(specs)
+
+            def issue(i):
+                try:
+                    c = CapacityClient(*srv.address)
+                    barrier.wait()
+                    results[i] = c.call(
+                        "sweep", tenant=f"team-{i % 2}", **specs[i]
+                    )
+                    c.close()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=issue, args=(i,))
+                for i in range(len(specs))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert not errors, errors
+            stats = srv._batcher.stats
+            assert stats["batched_requests"] >= 2
+            solo, _ = _serve_solo(snap, specs)
+            for got, want in zip(results, solo):
+                assert got["totals"] == want["totals"]
+            metrics = srv.registry.snapshot()
+            cross = metrics["kccap_fold_cross_tenant_total"]["values"]
+            assert sum(cross.values()) >= 1
+            folded = metrics["kccap_tenant_folded_requests_total"]["values"]
+            assert sum(folded.values()) >= 4
+            assert {"tenant=\"team-0\"", "tenant=\"team-1\""} <= set(
+                folded
+            ), folded
+        finally:
+            srv.shutdown()
+
+
+class TestFoldEvidence:
+    def test_folded_sweeps_record_fetch_overlap_phase(self, monkeypatch):
+        """All-sweep folded batches dispatch async: every member's
+        flight record shows a fetch_overlap phase (the deferred
+        device->host materialization), and solo dispatches never do."""
+        monkeypatch.setenv("KCCAP_TELEMETRY", "1")
+        snap = _snapshot("reference", "0")
+        specs = _random_specs(np.random.default_rng(3), 4)
+        _folded, _, stats, records = _serve_folded(snap, specs)
+        assert stats["batched_requests"] >= 2
+        sweep_phases = [
+            r["phases"] for r in records
+            if r["op"] == "sweep" and r.get("phases")
+        ]
+        assert any(
+            "fetch_overlap" in p for p in sweep_phases
+        ), sweep_phases
+        # And the solo twin never records one (batch of one is the
+        # exact synchronous path).
+        srv = CapacityServer(snap, port=0, batch_window_ms=0.0)
+        srv.start()
+        try:
+            c = CapacityClient(*srv.address)
+            c.sweep(**specs[0])
+            c.close()
+            solo_phases = [
+                r["phases"] for r in srv._flight.records()
+                if r["op"] == "sweep" and r.get("phases")
+            ]
+            assert solo_phases and all(
+                "fetch_overlap" not in p for p in solo_phases
+            )
+        finally:
+            srv.shutdown()
+
+    def test_fold_stats_shape(self):
+        """fold_rate / mean_folded_specs are well-defined before any
+        traffic (0.0, not NaN/ZeroDivision)."""
+        from kubernetesclustercapacity_tpu.service.batching import (
+            MicroBatcher,
+        )
+
+        b = MicroBatcher(lambda k, items: list(items), window_s=0.01)
+        st = b.stats
+        assert st["fold_rate"] == 0.0
+        assert st["mean_folded_specs"] == 0.0

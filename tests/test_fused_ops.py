@@ -1,0 +1,189 @@
+"""Fused super-kernels vs their sequential-op oracles (ISSUE 19).
+
+Three fusions, one contract each — bit-exact against the pre-fusion
+composition in BOTH semantics modes, grouped and ungrouped, masked and
+unmasked:
+
+* ``sweep_explain_snapshot``: one launch answering totals AND per-node
+  attribution == ``sweep_snapshot`` + ``explain_snapshot`` run
+  sequentially;
+* ``sweep_quantiles_snapshot``: sweep + on-device stable-argsort
+  order statistics == the host-side ``np.argsort(kind="stable")``
+  reduction (stable sorts share one permutation regardless of
+  algorithm);
+* ``capacity_at_risk(fused=True)``: the CaR evaluator on the fused
+  quantile kernel == ``fused=False`` (the exact pre-fusion host path),
+  field for field.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubernetesclustercapacity_tpu.explain import (
+    explain_snapshot,
+    sweep_explain_snapshot,
+)
+from kubernetesclustercapacity_tpu.ops.fit import (
+    sweep_quantiles_snapshot,
+    sweep_snapshot,
+)
+from kubernetesclustercapacity_tpu.scenario import random_scenario_grid
+from kubernetesclustercapacity_tpu.snapshot import synthetic_snapshot
+
+
+def _snap(mode, grouped):
+    snap = (
+        synthetic_snapshot(2048, seed=3, shapes=23)
+        if grouped
+        else synthetic_snapshot(300, seed=3)
+    )
+    if mode == "strict":
+        healthy = snap.healthy.copy()
+        healthy[::5] = False
+        snap = dataclasses.replace(snap, semantics="strict", healthy=healthy)
+    return snap
+
+
+def _mask(snap, masked):
+    if not masked:
+        return None
+    mask = np.ones(snap.n_nodes, dtype=bool)
+    mask[::3] = False
+    return mask
+
+
+class TestFusedSweepExplain:
+    @pytest.mark.parametrize("mode", ("reference", "strict"))
+    @pytest.mark.parametrize("grouped", (False, True))
+    @pytest.mark.parametrize("masked", (False, True))
+    def test_matches_sequential_ops(self, mode, grouped, masked):
+        snap = _snap(mode, grouped)
+        grid = random_scenario_grid(7, seed=11)
+        mask = _mask(snap, masked)
+        totals, sched, result, kernel = sweep_explain_snapshot(
+            snap, grid, mode=mode, node_mask=mask
+        )
+        want_totals, want_sched = sweep_snapshot(
+            snap, grid, mode=mode, node_mask=mask
+        )
+        want = explain_snapshot(snap, grid, mode=mode, node_mask=mask)
+        np.testing.assert_array_equal(totals, want_totals)
+        np.testing.assert_array_equal(sched, want_sched)
+        np.testing.assert_array_equal(result.fits, want.fits)
+        np.testing.assert_array_equal(result.binding, want.binding)
+        np.testing.assert_array_equal(result.cpu_fit, want.cpu_fit)
+        np.testing.assert_array_equal(result.mem_fit, want.mem_fit)
+        np.testing.assert_array_equal(result.slots, want.slots)
+        np.testing.assert_array_equal(result.totals, want.totals)
+        assert result.mode == want.mode == mode
+        if grouped and mask is None:
+            # The degenerate fleet must actually take the grouped route
+            # (the test would otherwise prove nothing about it).
+            assert "grouped" in kernel
+
+    def test_fused_totals_equal_explain_totals(self):
+        # The fusion's core identity: totals ARE the attribution fits
+        # summed on-device — not a second sweep that could drift.
+        snap = _snap("reference", False)
+        grid = random_scenario_grid(5, seed=2)
+        totals, _, result, _ = sweep_explain_snapshot(snap, grid)
+        np.testing.assert_array_equal(totals, result.fits.sum(axis=1))
+
+
+class TestFusedQuantiles:
+    @pytest.mark.parametrize("mode", ("reference", "strict"))
+    @pytest.mark.parametrize("grouped", (False, True))
+    @pytest.mark.parametrize("masked", (False, True))
+    def test_matches_host_stable_argsort(self, mode, grouped, masked):
+        snap = _snap(mode, grouped)
+        grid = random_scenario_grid(64, seed=13)
+        mask = _mask(snap, masked)
+        q_indices = (0, 3, 31, 63)
+        totals, sched, qvals, qidx, kernel = sweep_quantiles_snapshot(
+            snap, grid, mode=mode, node_mask=mask, q_indices=q_indices
+        )
+        want_totals, want_sched = sweep_snapshot(
+            snap, grid, mode=mode, node_mask=mask
+        )
+        np.testing.assert_array_equal(totals, want_totals)
+        np.testing.assert_array_equal(sched, want_sched)
+        order = np.argsort(want_totals, kind="stable")
+        np.testing.assert_array_equal(qvals, want_totals[order][list(q_indices)])
+        np.testing.assert_array_equal(qidx, order[list(q_indices)])
+        if grouped and mask is None:
+            assert "grouped" in kernel
+
+    def test_ties_resolve_identically(self):
+        # Stability is the whole bit-exactness argument: a fleet where
+        # many samples produce IDENTICAL totals must still gather the
+        # same realizing indices as the host reduction.
+        snap = _snap("reference", False)
+        g = random_scenario_grid(8, seed=4)
+        import numpy as _np
+
+        from kubernetesclustercapacity_tpu.scenario import ScenarioGrid
+
+        grid = ScenarioGrid(
+            cpu_request_milli=_np.tile(g.cpu_request_milli[:2], 16),
+            mem_request_bytes=_np.tile(g.mem_request_bytes[:2], 16),
+            replicas=_np.tile(g.replicas[:2], 16),
+        )
+        q_indices = tuple(range(0, 32, 5))
+        totals, _, qvals, qidx, _ = sweep_quantiles_snapshot(
+            snap, grid, q_indices=q_indices
+        )
+        order = np.argsort(totals, kind="stable")
+        np.testing.assert_array_equal(qidx, order[list(q_indices)])
+        np.testing.assert_array_equal(qvals, totals[order][list(q_indices)])
+
+
+class TestFusedCaR:
+    @pytest.mark.parametrize("mode", ("reference", "strict"))
+    @pytest.mark.parametrize("grouped", (False, True))
+    def test_fused_equals_host_path(self, mode, grouped):
+        from kubernetesclustercapacity_tpu.stochastic.car import (
+            capacity_at_risk,
+        )
+        from kubernetesclustercapacity_tpu.stochastic.distributions import (
+            StochasticSpec,
+            UsageDistribution,
+        )
+
+        snap = _snap(mode, grouped)
+        spec = StochasticSpec(
+            cpu=UsageDistribution(kind="normal", mean=400.0, std=120.0),
+            memory=UsageDistribution(
+                kind="normal", mean=3e8, std=8e7
+            ),
+            replicas=4,
+            samples=256,
+            seed=21,
+        )
+        fused = capacity_at_risk(snap, spec, mode=mode)
+        host = capacity_at_risk(snap, spec, mode=mode, fused=False)
+        assert fused.quantiles == host.quantiles
+        assert fused.quantile_samples == host.quantile_samples
+        assert fused.mean == host.mean
+        assert fused.prob_fit == host.prob_fit
+        assert fused.bindings == host.bindings
+        np.testing.assert_array_equal(fused.totals, host.totals)
+        np.testing.assert_array_equal(fused.samples_cpu, host.samples_cpu)
+        np.testing.assert_array_equal(fused.samples_mem, host.samples_mem)
+
+    def test_fused_respects_donate_and_devcache_off(self, monkeypatch):
+        # The escape hatches compose: with the devcache disabled the
+        # fused kernel still answers identically (no staging, no
+        # buckets).
+        monkeypatch.setenv("KCCAP_DEVCACHE", "0")
+        snap = _snap("reference", False)
+        grid = random_scenario_grid(16, seed=6)
+        totals, _, qvals, qidx, kernel = sweep_quantiles_snapshot(
+            snap, grid, q_indices=(0, 15)
+        )
+        order = np.argsort(totals, kind="stable")
+        np.testing.assert_array_equal(qidx, order[[0, 15]])
+        # No devcache -> no bucketed staging -> no @bucket suffix on
+        # the compilewatch label.
+        assert "@" not in kernel

@@ -236,6 +236,31 @@ def test_scan_finds_the_tenancy_families():
     )
 
 
+def test_scan_finds_the_fold_and_donate_families():
+    """Non-vacuous pin for the request-folding tier (ISSUE 19): the
+    walk must see the batcher's spec-spread histogram, the cross-tenant
+    fold counters, and the devcache donation disposition counter (so
+    the README-documentation and snake_case gates below actually cover
+    them), and each must have a literal backticked README row — the
+    bare `kccap_*` glob in prose does NOT count as documentation here,
+    so this pin is stricter than the generic gate."""
+    names = _source_metric_names()
+    fold = {
+        "kccap_fold_specs",
+        "kccap_fold_cross_tenant_total",
+        "kccap_tenant_folded_requests_total",
+        "kccap_donate_columns_total",
+    }
+    assert fold <= names
+    with open(_README, encoding="utf-8") as fh:
+        readme = fh.read()
+    undocumented = sorted(n for n in fold if f"`{n}`" not in readme)
+    assert not undocumented, (
+        "fold/donate metrics missing a literal row in the README "
+        f"observability table: {undocumented}"
+    )
+
+
 def test_scan_finds_the_tracing_and_process_families():
     """Non-vacuous pin for the tracing tier: the walk must see the
     tail sampler's decision counter plus every process self-telemetry
@@ -331,6 +356,30 @@ def test_env_scan_finds_the_known_switches():
     assert "KCCAP_TENANCY" in names
     # The forecast projection cap (and README-gated below).
     assert "KCCAP_FORECAST_MAX_STEPS" in names
+    # The donation escape hatch (and README-gated below).
+    assert "KCCAP_DONATE" in names
+
+
+def test_bench_serving_knobs_are_documented_in_readme():
+    """The bench harness's open-loop serving knobs live outside the
+    package (bench.py), so the package env walk cannot see them — pin
+    the README rows literally instead."""
+    with open(_README, encoding="utf-8") as fh:
+        readme = fh.read()
+    missing = sorted(
+        k
+        for k in (
+            "KCC_BENCH_SERVING_FOLD_RPS",
+            "KCC_BENCH_SERVING_FOLD_DURATION_S",
+            "KCC_BENCH_SERVING_FOLD_BURST",
+            "KCC_BENCH_SERVING_FOLD_WINDOW_MS",
+        )
+        if f"`{k}`" not in readme
+    )
+    assert not missing, (
+        "bench serving knobs missing from the README configuration "
+        f"table: {missing}"
+    )
 
 
 def test_every_env_var_is_documented_in_readme():

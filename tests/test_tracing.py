@@ -641,7 +641,7 @@ class TestEndToEndTree:
                 "kccap_phase_seconds"
             ]
 
-            barrier = threading.Barrier(4)
+            barrier = threading.Barrier(3)
             errs = []
             t0 = time.perf_counter()
 
@@ -656,11 +656,20 @@ class TestEndToEndTree:
                         errs.append(e)
                 return run
 
+            # The heavy traced sweep runs ALONE on the device, before
+            # the batch cohort: the critical path must deterministically
+            # descend into ITS phases (the device-dispatch branch).  On
+            # one shared device, concurrent folded members would block
+            # behind the heavy kernel — their (honestly recorded)
+            # fetch_overlap drain would edge past the heavy request on
+            # the critical path by exactly the batch window, turning
+            # the dominant-phase check into a race.
+            with CapacityClient(*heavy_srv.address) as c:
+                c.call("sweep", **heavy, **ctx.to_wire())
             workers = [
                 threading.Thread(target=against(batch_srv.address, small)),
                 threading.Thread(target=against(batch_srv.address, small)),
                 threading.Thread(target=against(batch_srv.address, small)),
-                threading.Thread(target=against(heavy_srv.address, heavy)),
             ]
             for w in workers:
                 w.start()
