@@ -6,7 +6,7 @@ sanitizer is installed* (so their locks are wrapped and their guarded
 fields — the statically inferred set from
 :func:`..rules_locks.lock_model` — are monitored), then hits them from
 ``threads`` concurrent workers.  One :func:`run` call covers all
-fourteen classes under one instrumentation window per seed; findings
+fifteen classes under one instrumentation window per seed; findings
 flow through the shared suppression/baseline workflow.
 
 The drivers deliberately exercise the *synchronization surface*, not
@@ -28,7 +28,7 @@ from kubernetesclustercapacity_tpu.analysis.rules_locks import lock_model
 
 __all__ = ["run", "HAMMERED_CLASSES", "instrument_targets"]
 
-#: The fourteen threaded classes the tier-1 gate certifies, as
+#: The fifteen threaded classes the tier-1 gate certifies, as
 #: ``(module, class name)`` — every one must also be inferred threaded
 #: by the static model (cross-checked in tests/test_sanitize.py).
 HAMMERED_CLASSES = (
@@ -46,6 +46,7 @@ HAMMERED_CLASSES = (
     ("kubernetesclustercapacity_tpu.resilience", "CircuitBreaker"),
     ("kubernetesclustercapacity_tpu.telemetry.metrics", "MetricsRegistry"),
     ("kubernetesclustercapacity_tpu.telemetry.tracectx", "TailSampler"),
+    ("kubernetesclustercapacity_tpu.telemetry.memledger", "DeviceLedger"),
 )
 
 
@@ -503,6 +504,103 @@ def _drive_tail_sampler():
     return [own_trace, own_trace, hot_trace, finish_hot, stats], cleanup
 
 
+def _drive_memledger():
+    """The device-memory ledger under exact-bytes audit: workers stage
+    and retire leaf containers in per-thread slots (mirrored in a
+    ledger-independent book) while reconcilers sweep with the mirror as
+    the injected live-array view and readers scrape.  The mirror is
+    maintained so it always covers the ledger (add-before-register,
+    retire-before-remove), so a reconcile mid-race may see suspects but
+    never a sustained leak.  After the join the ledger must equal the
+    mirror to the byte — accounting that drifts under contention is
+    exactly the silent HBM leak the ledger exists to catch."""
+    from kubernetesclustercapacity_tpu.telemetry.memledger import (
+        DeviceLedger,
+    )
+
+    class _Leaf:
+        __slots__ = ("nbytes",)
+
+        def __init__(self, nbytes):
+            self.nbytes = nbytes
+
+    ledger = DeviceLedger()
+    mirror_lock = threading.Lock()
+    # (thread, slot) -> (container, nbytes); each thread stages only
+    # into its own slots, so the mirror ordering invariant holds.
+    mirror: dict = {}
+    forms = ("exact", "grouped", "pallas", "fold_fetch")
+
+    def _unstage(key):
+        with mirror_lock:
+            entry = mirror.get(key)
+        if entry is None:
+            return
+        ledger.retire(entry[0])
+        with mirror_lock:
+            del mirror[key]
+
+    def stage(i, t):
+        key = (t, i % 4)
+        _unstage(key)
+        leaves = tuple(
+            _Leaf(64 * (1 + (i + t + k) % 3)) for k in range(2)
+        )
+        nbytes = sum(x.nbytes for x in leaves)
+        with mirror_lock:
+            mirror[key] = (leaves, nbytes)
+        ledger.register(leaves, forms[(i + t) % len(forms)])
+
+    def retire(i, t):
+        _unstage((t, (i + 1) % 4))
+
+    def reconcile(i, t):
+        # Snapshot + reconcile under the mirror lock: a live view that
+        # raced a register would mark fresh leaves missing, and id
+        # reuse after a free could turn that transient into a phantom
+        # "sustained" leak.  Real deployments reconcile against
+        # jax.live_arrays() taken inside the call; the hammer's mirror
+        # must be at least that coherent.  (Safe: no worker holds the
+        # ledger lock while taking the mirror lock.)
+        with mirror_lock:
+            live = [
+                leaf for c, _ in mirror.values() for leaf in c
+            ]
+            audit = ledger.reconcile(live_arrays=live)
+        assert audit["sustained_missing_bytes"] == 0
+
+    def read(i, t):
+        ledger.stats()
+        ledger.total_bytes()
+        ledger.peak_bytes()
+        ledger.budget_breached()
+
+    def cleanup():
+        with mirror_lock:
+            expected = sum(n for _, n in mirror.values())
+            count = len(mirror)
+        st = ledger.stats()
+        if st["total_bytes"] != expected or st["entries"] != count:
+            raise AssertionError(
+                "memledger drifted from the mirror book: "
+                f"total={st['total_bytes']} expected={expected} "
+                f"entries={st['entries']} expected_entries={count}"
+            )
+        if st["registered"] - st["retired"] != count:
+            raise AssertionError(
+                "memledger lost or invented registrations: "
+                f"registered={st['registered']} retired={st['retired']} "
+                f"live_entries={count}"
+            )
+        if ledger.leaking():
+            raise AssertionError(
+                "memledger reported a sustained leak under a mirror "
+                "that always covered the book"
+            )
+
+    return [stage, stage, retire, reconcile, read], cleanup
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -540,7 +638,7 @@ def run(
     fuzz: bool = True,
     package_dir: str | None = None,
 ) -> tuple:
-    """One full hammer pass: install → drive all fourteen classes
+    """One full hammer pass: install → drive all fifteen classes
     (the MicroBatcher twice: once as the legacy coalescer, once as the
     generalized fold queue) → report → uninstall.  Returns ``(findings, stats)`` with findings
     relative to the repo root.  Raises if any worker crashed."""
@@ -563,6 +661,7 @@ def run(
                 _drive_breaker(),
                 _drive_registry(),
                 _drive_tail_sampler(),
+                _drive_memledger(),
             )
             errors: list = []
             try:

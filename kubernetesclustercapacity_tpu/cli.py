@@ -341,6 +341,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "files or directories (directories contribute "
                         "every *.jsonl plus .1 rotations) — one per "
                         "process in the topology")
+    p.add_argument("-profile", default=None, metavar="HOST:PORT",
+                   help="collect a collapsed flamegraph window from a "
+                        "running capacity service's sampling profiler "
+                        "(/debug/profile on its metrics port), print "
+                        "the phase-attribution summary, and exit; "
+                        "-output json selects the structured form; "
+                        "exit 1 when the server's profiler is off")
+    p.add_argument("-profile-seconds", type=float, default=5.0,
+                   dest="profile_seconds", metavar="SECONDS",
+                   help="with -profile: how long the server samples "
+                        "before replying (server caps at 300)")
+    p.add_argument("-profile-out", default="", dest="profile_out",
+                   metavar="FILE",
+                   help="with -profile: write the collapsed profile "
+                        "to FILE (flamegraph.pl/speedscope food) "
+                        "instead of stdout")
+    p.add_argument("-bench-diff", nargs="+", default=None,
+                   dest="bench_diff", metavar="OLD_NEW_OR_DIR",
+                   help="compare two bench artifacts (OLD.json "
+                        "NEW.json) under the committed per-row noise "
+                        "thresholds and exit 1 on any regression; a "
+                        "single directory argument walks every "
+                        "BENCH_r*.json round in order (trajectory "
+                        "mode); degraded rounds and missing rows are "
+                        "named, never failed; -output json selects "
+                        "the structured artifact")
+    p.add_argument("-bench-thresholds", default="",
+                   dest="bench_thresholds", metavar="FILE",
+                   help="with -bench-diff: the per-row noise model "
+                        "(default: BENCH_THRESHOLDS.json next to the "
+                        "inputs, else built-in defaults)")
     return p
 
 
@@ -426,6 +457,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.trace_tree:
         return _run_trace_tree(args)
 
+    if args.profile:
+        return _run_profile(args)
+
+    if args.bench_diff:
+        return _run_bench_diff(args)
+
     # Telemetry surfaces (both opt-in, zero cost otherwise): a scrape
     # endpoint over the process registry — the fused-path counters and
     # kernel-latency histograms the sweep below feeds — and a JSONL
@@ -437,7 +474,14 @@ def main(argv: list[str] | None = None) -> int:
             start_metrics_server,
         )
         from kubernetesclustercapacity_tpu.telemetry.metrics import REGISTRY
+        from kubernetesclustercapacity_tpu.telemetry.process import (
+            register_process_metrics,
+        )
 
+        # The offline CLI serves the same first-questions gauges (RSS,
+        # fds, threads, build) a long-running server does — a -grid
+        # sweep scraped mid-run was previously blind to them.
+        register_process_metrics(REGISTRY)
         try:
             metrics_server = start_metrics_server(
                 REGISTRY, port=args.metrics_port
@@ -1415,6 +1459,117 @@ def _run_trace_tree(args) -> int:
     if not tree.get("found"):
         return 1
     return 0 if not tree["critical_path"].get("refused") else 1
+
+
+def _run_profile(args) -> int:
+    """-profile HOST:PORT: ask a running server's sampling profiler
+    for a collapsed flamegraph window (``/debug/profile`` on its
+    metrics port), write the fold, and summarize the phase attribution
+    — the view that answers "WHICH frames inside serialize?"."""
+    from urllib.request import urlopen
+
+    from kubernetesclustercapacity_tpu.telemetry.profiler import (
+        dominant_phase,
+        phase_counts,
+        top_frame,
+    )
+
+    addr = _parse_addr("-profile", args.profile)
+    if addr is None:
+        return 1
+    seconds = max(float(args.profile_seconds), 0.0)
+    url = (f"http://{addr[0]}:{addr[1]}/debug/profile"
+           f"?seconds={seconds:g}")
+    try:
+        with urlopen(url, timeout=seconds + 30.0) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except Exception as e:  # noqa: BLE001 - a CLI reports, never tracebacks
+        print(f"ERROR : cannot fetch profile from "
+              f"{addr[0]}:{addr[1]}: {e} (a server started with "
+              "-metrics-port serves /debug/profile there)",
+              file=sys.stderr)
+        return 1
+    if text.startswith("# profiler disabled"):
+        print(text.strip(), file=sys.stderr)
+        return 1
+    counts = phase_counts(text)
+    total = sum(counts.values())
+    phase, share = dominant_phase(text)
+    if args.profile_out:
+        with open(args.profile_out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"collapsed profile ({total} sample(s)) written to "
+              f"{args.profile_out}", file=sys.stderr)
+    if args.output == "json":
+        print(json.dumps({
+            "seconds": seconds,
+            "samples": total,
+            "phase_samples": counts,
+            "dominant_phase": phase,
+            "dominant_share": round(share, 4),
+            "top_frame": top_frame(text),
+            "top_frame_dominant_phase": (
+                top_frame(text, phase) if phase else None
+            ),
+        }, indent=2, sort_keys=True))
+    else:
+        if not args.profile_out:
+            sys.stdout.write(text)
+        for name in sorted(counts, key=lambda p: -counts[p]):
+            print(f"# phase {name}: {counts[name]} sample(s)",
+                  file=sys.stderr)
+        if phase is not None:
+            print(f"# dominant phase: {phase} "
+                  f"({share * 100:.1f}% of attributed samples; top "
+                  f"frame {top_frame(text, phase)})", file=sys.stderr)
+    return 0
+
+
+def _run_bench_diff(args) -> int:
+    """-bench-diff OLD NEW (or DIR): the typed comparator over bench
+    artifacts — exit 1 only on a threshold-breaching regression on a
+    comparable, parity-clean row; exit 2 on usage errors (bad JSON,
+    bad thresholds, wrong argument shape)."""
+    from kubernetesclustercapacity_tpu.analysis import benchdiff
+
+    paths = args.bench_diff
+    trajectory_dir = None
+    if len(paths) == 1 and os.path.isdir(paths[0]):
+        trajectory_dir = paths[0]
+    elif len(paths) != 2:
+        print("ERROR : -bench-diff wants OLD.json NEW.json (or one "
+              "directory for trajectory mode)", file=sys.stderr)
+        return 2
+    th_path = args.bench_thresholds or None
+    if th_path is None:
+        anchor = trajectory_dir or os.path.dirname(
+            os.path.abspath(paths[1])
+        )
+        cand = os.path.join(anchor, benchdiff.THRESHOLDS_FILENAME)
+        if os.path.exists(cand):
+            th_path = cand
+    try:
+        th = benchdiff.load_thresholds(th_path)
+        if trajectory_dir is not None:
+            diffs = benchdiff.trajectory(trajectory_dir, th)
+        else:
+            diffs = [benchdiff.diff_files(paths[0], paths[1], th)]
+    except (OSError, ValueError) as e:
+        print(f"ERROR : {e}", file=sys.stderr)
+        return 2
+    regressions = sum(len(d.regressions) for d in diffs)
+    if args.output == "json":
+        print(json.dumps({
+            "thresholds": th_path,
+            "pairs": [d.to_json() for d in diffs],
+            "regressions": regressions,
+            "clean": regressions == 0,
+        }, indent=2))
+    elif trajectory_dir is not None:
+        print(benchdiff.render_trajectory(diffs))
+    else:
+        print(benchdiff.render(diffs[0]))
+    return 1 if regressions else 0
 
 
 def _run_explain(args, snapshot, scenario) -> int:

@@ -35,9 +35,12 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from collections import OrderedDict
 
 import numpy as np
+
+from kubernetesclustercapacity_tpu.telemetry import memledger as _memledger
 
 __all__ = [
     "DeviceCache",
@@ -202,6 +205,19 @@ def _donate_jit():
     return _DONATE_JIT
 
 
+def _retire_remaining(entries: "OrderedDict[tuple, object]") -> None:
+    """Finalizer body for a dying :class:`DeviceCache`: un-book whatever
+    it still held so the ledger never accrues stale entries.  Swallows
+    everything — it can run during interpreter shutdown."""
+    try:
+        values = list(entries.values())
+        entries.clear()
+        for v in values:
+            _memledger.retire(v)
+    except Exception:
+        pass
+
+
 class DeviceCache:
     """Thread-safe LRU of device-staged node arrays, keyed per snapshot.
 
@@ -225,6 +241,13 @@ class DeviceCache:
         self._hits = 0
         self._misses = 0
         self._next_token = 0
+        # The ledger books entries by identity the moment they are
+        # staged; if this cache object is dropped (short-lived caches in
+        # tools/tests) its buffers die with it, and without this
+        # finalizer the book would keep them forever — a false
+        # "sustained leak" on the next reconcile.  The callback holds
+        # the entries dict, never ``self``.
+        weakref.finalize(self, _retire_remaining, self._entries)
 
     def _token(self, snapshot) -> int:
         tok = snapshot.__dict__.get("_devcache_token")
@@ -255,7 +278,8 @@ class DeviceCache:
                 # devcache phase (the decomposition must show what the
                 # escape hatch costs).
                 t0 = time.perf_counter()
-                value = build()
+                with clk.live("devcache"):
+                    value = build()
                 clk.record("devcache", time.perf_counter() - t0)
                 return value
             return build()
@@ -279,16 +303,29 @@ class DeviceCache:
             # as the answering request's ``devcache`` phase (a hit
             # records nothing: that IS the cache working).
             t0 = time.perf_counter()
-            value = build()
+            with clk.live("devcache"):
+                value = build()
             clk.record("devcache", time.perf_counter() - t0)
         else:
             value = build()
+        # Book BEFORE the value becomes poppable: once it is in
+        # ``_entries`` a concurrent eviction/invalidate may retire it,
+        # and a retire that races ahead of a late register would leave
+        # the book with a stale leaf forever (a false sustained leak).
+        if _memledger.enabled():
+            _memledger.register(value, form)
+        evicted: list = []
         with self._lock:
+            prev = self._entries.get(full)
+            if prev is not None:
+                evicted.append(prev)  # double-build race: last store wins
             self._entries[full] = value
             self._entries.move_to_end(full)
             self._misses += 1
             while len(self._entries) > self._max_entries:
-                self._entries.popitem(last=False)
+                evicted.append(self._entries.popitem(last=False)[1])
+        for v in evicted:
+            _memledger.retire(v)
         if _telemetry_enabled():
             _metrics()["misses"].labels(form=form).inc()
         return value
@@ -469,13 +506,17 @@ class DeviceCache:
         counts = {"reused": 0, "donated": 0, "restaged": 0}
         old_staged: dict = {}
         if old is not None and old is not new:
+            retired: list = []
             with self._lock:
                 tok = old.__dict__.get("_devcache_token")
                 if tok is not None:
                     for key in [k for k in self._entries if k[0] == tok]:
                         v = self._entries.pop(key)
+                        retired.append(v)
                         if len(key) == 3 and key[1] == "exact":
                             old_staged[key[2]] = v
+            for v in retired:
+                _memledger.retire(v)
         if not enabled():
             return counts
         b = node_bucket(new.n_nodes)
@@ -522,12 +563,23 @@ class DeviceCache:
                     continue
             staged.append(jnp.asarray(col_p))
             counts["restaged"] += 1
+        staged_t = tuple(staged)
         full = (self._token(new), "exact", b)  # token before the lock
+        # Book before the store — same retire-races-register hazard as
+        # :meth:`get`.
+        if _memledger.enabled():
+            _memledger.register(staged_t, "exact")
+        evicted: list = []
         with self._lock:
-            self._entries[full] = tuple(staged)
+            prev = self._entries.get(full)
+            if prev is not None:
+                evicted.append(prev)
+            self._entries[full] = staged_t
             self._entries.move_to_end(full)
             while len(self._entries) > self._max_entries:
-                self._entries.popitem(last=False)
+                evicted.append(self._entries.popitem(last=False)[1])
+        for v in evicted:
+            _memledger.retire(v)
         if _telemetry_enabled():
             met = _metrics()["donate"]
             for disposition, c in counts.items():
@@ -539,15 +591,19 @@ class DeviceCache:
         """Drop a snapshot's entries (or everything when ``None``) —
         called on snapshot swap so retired device buffers free promptly
         instead of waiting out the LRU."""
+        dropped: list = []
         with self._lock:
             if snapshot is None:
+                dropped.extend(self._entries.values())
                 self._entries.clear()
-                return
-            tok = snapshot.__dict__.get("_devcache_token")
-            if tok is None:
-                return  # never cached: nothing to drop
-            for key in [k for k in self._entries if k[0] == tok]:
-                del self._entries[key]
+            else:
+                tok = snapshot.__dict__.get("_devcache_token")
+                if tok is None:
+                    return  # never cached: nothing to drop
+                for key in [k for k in self._entries if k[0] == tok]:
+                    dropped.append(self._entries.pop(key))
+        for v in dropped:
+            _memledger.retire(v)
 
     def stats(self) -> dict:
         """JSON-able counters for doctor / the info op / bench.py."""

@@ -459,17 +459,19 @@ def sweep_grouped_bucketed(
 
     if not _devcache.enabled():
         t0 = _time.perf_counter() if clk else 0.0
-        out = sweep_grid_grouped(
-            grouped.alloc_cpu_milli, grouped.alloc_mem_bytes,
-            grouped.alloc_pods, grouped.used_cpu_req_milli,
-            grouped.used_mem_req_bytes, grouped.pods_count,
-            grouped.healthy, counts, cpu_reqs, mem_reqs, replicas,
-            mode=mode, return_per_group=return_per_node,
-        )
+        with clk.live("device_exec"):
+            out = sweep_grid_grouped(
+                grouped.alloc_cpu_milli, grouped.alloc_mem_bytes,
+                grouped.alloc_pods, grouped.used_cpu_req_milli,
+                grouped.used_mem_req_bytes, grouped.pods_count,
+                grouped.healthy, counts, cpu_reqs, mem_reqs, replicas,
+                mode=mode, return_per_group=return_per_node,
+            )
         if clk:
             t1 = _time.perf_counter()
             clk.record("device_exec", t1 - t0)
-            out = tuple(np.asarray(o) for o in out)
+            with clk.live("fetch"):
+                out = tuple(np.asarray(o) for o in out)
             clk.record("fetch", _time.perf_counter() - t1)
         else:
             out = tuple(np.asarray(o) for o in out)
@@ -488,12 +490,14 @@ def sweep_grouped_bucketed(
         cpu_reqs, mem_reqs, replicas, _devcache.scenario_bucket(s)
     )
     t0 = _time.perf_counter()
-    out = sweep_grid_grouped(
-        *arrays, counts_p, cpu_p, mem_p, rep_p,
-        mode=mode, return_per_group=return_per_node,
-    )
+    with clk.live("device_exec"):
+        out = sweep_grid_grouped(
+            *arrays, counts_p, cpu_p, mem_p, rep_p,
+            mode=mode, return_per_group=return_per_node,
+        )
     t_launch = _time.perf_counter()
-    out = tuple(np.asarray(o) for o in out)
+    with clk.live("fetch"):
+        out = tuple(np.asarray(o) for o in out)
     t_done = _time.perf_counter()
     kind = None
     if _telemetry_enabled():
@@ -612,15 +616,18 @@ def sweep_grid_bucketed(
     clk = _phases.current()
     if not _devcache.enabled():
         t0 = _time.perf_counter() if clk else 0.0
-        out = sweep_grid(
-            alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
-            pods_count, healthy, cpu_reqs, mem_reqs, replicas,
-            mode=mode, node_mask=node_mask, return_per_node=return_per_node,
-        )
+        with clk.live("device_exec"):
+            out = sweep_grid(
+                alloc_cpu, alloc_mem, alloc_pods, used_cpu, used_mem,
+                pods_count, healthy, cpu_reqs, mem_reqs, replicas,
+                mode=mode, node_mask=node_mask,
+                return_per_node=return_per_node,
+            )
         if clk:
             t1 = _time.perf_counter()
             clk.record("device_exec", t1 - t0)
-            out = tuple(np.asarray(o) for o in out)
+            with clk.live("fetch"):
+                out = tuple(np.asarray(o) for o in out)
             clk.record("fetch", _time.perf_counter() - t1)
             return out
         return tuple(np.asarray(o) for o in out)
@@ -665,10 +672,12 @@ def sweep_grid_bucketed(
             allow_async = label in seen_kernels()
         if allow_async:
             t0 = _time.perf_counter() if clk else 0.0
-            out = sweep_grid(
-                *arrays, cpu_p, mem_p, rep_p,
-                mode=mode, node_mask=mask, return_per_node=return_per_node,
-            )
+            with clk.live("device_exec"):
+                out = sweep_grid(
+                    *arrays, cpu_p, mem_p, rep_p,
+                    mode=mode, node_mask=mask,
+                    return_per_node=return_per_node,
+                )
             if clk:
                 clk.record("device_exec", _time.perf_counter() - t0)
             result = (
@@ -681,16 +690,18 @@ def sweep_grid_bucketed(
                 )
             return result
     t0 = _time.perf_counter()
-    out = sweep_grid(
-        *arrays, cpu_p, mem_p, rep_p,
-        mode=mode, node_mask=mask, return_per_node=return_per_node,
-    )
+    with clk.live("device_exec"):
+        out = sweep_grid(
+            *arrays, cpu_p, mem_p, rep_p,
+            mode=mode, node_mask=mask, return_per_node=return_per_node,
+        )
     # The jitted call returns asynchronously-dispatched device arrays;
     # the numpy materialization below is the block_until_ready sync.
     # Timed apart so the phase clock can split launch (device_exec)
     # from the device→host wait+transfer (fetch).
     t_launch = _time.perf_counter()
-    out = tuple(np.asarray(o) for o in out)
+    with clk.live("fetch"):
+        out = tuple(np.asarray(o) for o in out)
     t_done = _time.perf_counter()
     kind = None
     if _telemetry_enabled():
@@ -1038,11 +1049,13 @@ def sweep_quantiles_snapshot(
             counts_p = counts
             label = "xla_int64_sweep_qtile_grouped"
         t0 = _time.perf_counter()
-        out = sweep_quantiles_grouped(
-            *arrays, counts_p,
-            grid.cpu_request_milli, grid.mem_request_bytes, grid.replicas,
-            mode=mode, q_indices=q_indices,
-        )
+        with clk.live("device_exec"):
+            out = sweep_quantiles_grouped(
+                *arrays, counts_p,
+                grid.cpu_request_milli, grid.mem_request_bytes,
+                grid.replicas,
+                mode=mode, q_indices=q_indices,
+            )
         kernel = "xla_int64_sweep_qtile_grouped"
     else:
         if _devcache.enabled():
@@ -1065,14 +1078,17 @@ def sweep_quantiles_snapshot(
             mask = node_mask
             label = "xla_int64_sweep_qtile"
         t0 = _time.perf_counter()
-        out = sweep_quantiles_grid(
-            *arrays,
-            grid.cpu_request_milli, grid.mem_request_bytes, grid.replicas,
-            mode=mode, q_indices=q_indices, node_mask=mask,
-        )
+        with clk.live("device_exec"):
+            out = sweep_quantiles_grid(
+                *arrays,
+                grid.cpu_request_milli, grid.mem_request_bytes,
+                grid.replicas,
+                mode=mode, q_indices=q_indices, node_mask=mask,
+            )
         kernel = "xla_int64_sweep_qtile"
     t_launch = _time.perf_counter()
-    out = tuple(np.asarray(o) for o in out)
+    with clk.live("fetch"):
+        out = tuple(np.asarray(o) for o in out)
     t_done = _time.perf_counter()
     kind = None
     if _telemetry_enabled():

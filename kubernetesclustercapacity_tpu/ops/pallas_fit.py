@@ -729,15 +729,16 @@ def sweep_pallas(
 
     clk = _phases.current()
     t0 = _time.perf_counter() if clk else 0.0
-    if use_rcp:
-        recips = tuple(scenario_reciprocals(args[i]) for i in (6, 7))
-        totals = _sweep_pallas_padded_rcp(
-            *args, *recips, mk, ct, strict=strict, interpret=interpret
-        )
-    else:
-        totals = _sweep_pallas_padded(
-            *args, mk, ct, strict=strict, interpret=interpret
-        )
+    with clk.live("device_exec"):
+        if use_rcp:
+            recips = tuple(scenario_reciprocals(args[i]) for i in (6, 7))
+            totals = _sweep_pallas_padded_rcp(
+                *args, *recips, mk, ct, strict=strict, interpret=interpret
+            )
+        else:
+            totals = _sweep_pallas_padded(
+                *args, mk, ct, strict=strict, interpret=interpret
+            )
     if clk:
         # Launch vs device→host sync, timed apart (same split as the
         # exact wrapper): the jitted call dispatches asynchronously and
@@ -746,7 +747,8 @@ def sweep_pallas(
         # dispatch as a first call.
         t_launch = _time.perf_counter()
         clk.record("device_exec", t_launch - t0)
-        totals = np.asarray(totals)[:s]
+        with clk.live("fetch"):
+            totals = np.asarray(totals)[:s]
         clk.record("fetch", _time.perf_counter() - t_launch)
     else:
         totals = np.asarray(totals)[:s]

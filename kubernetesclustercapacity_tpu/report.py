@@ -1163,6 +1163,13 @@ def trace_table_report(tree: dict) -> str:
             f"clock skew: {len(skew)} span(s) with negative durations "
             "flagged (wall-clock stepped mid-span): " + ", ".join(skew)
         )
+    in_flight = tree.get("in_flight", [])
+    if in_flight:
+        lines.append(
+            f"in flight: {len(in_flight)} span(s) recorded without a "
+            "usable duration (process died mid-request?) excluded "
+            "from assembly: " + ", ".join(in_flight)
+        )
 
     def _walk(node, depth, seen):
         if id(node) in seen or depth > 64:

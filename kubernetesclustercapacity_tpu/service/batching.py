@@ -280,7 +280,8 @@ class MicroBatcher:
         clk = _phases.current()
         if leader:
             t0 = time.perf_counter()
-            batch.full.wait(self.window_s)
+            with clk.live("batch_wait"):
+                batch.full.wait(self.window_s)
             with self._lock:
                 # Close under the same lock appends take: every item is
                 # either in this snapshot or in a successor batch.
@@ -348,7 +349,8 @@ class MicroBatcher:
                     )
         else:
             t0 = time.perf_counter()
-            done = batch.done.wait(_FOLLOWER_TIMEOUT_S)
+            with clk.live("batch_wait"):
+                done = batch.done.wait(_FOLLOWER_TIMEOUT_S)
             wait_s = time.perf_counter() - t0
             # A follower's whole batching story is this wait: the
             # remainder of the leader's window plus the combined kernel

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import socketserver
 import threading
+import weakref
 
 import numpy as np
 
@@ -33,6 +34,9 @@ from kubernetesclustercapacity_tpu.snapshot import (
     publish_group_metrics as _snapshot_publish_group_metrics,
 )
 from kubernetesclustercapacity_tpu.sources import resolve_source
+from kubernetesclustercapacity_tpu.telemetry import (
+    memledger as _memledger,
+)
 
 __all__ = ["CapacityServer"]
 
@@ -112,6 +116,19 @@ class _ThreadingServer(socketserver.ThreadingTCPServer):
                 pass
 
 
+def _retire_fold_box(box: list) -> None:
+    """Finalizer body for a dying :class:`_FoldedFetch` that never
+    materialized: un-book its staged pair so the ledger stays honest.
+    Swallows everything — it can run during interpreter shutdown."""
+    try:
+        staged = box[0]
+        box[0] = None
+        if staged is not None:
+            _memledger.retire(staged)
+    except Exception:
+        pass
+
+
 class _FoldedFetch:
     """Shared device->host materialization for one async folded dispatch.
 
@@ -124,9 +141,22 @@ class _FoldedFetch:
     """
 
     def __init__(self, totals, sched) -> None:
+        # The staged pair is registered with the device-memory ledger
+        # under its own container identity and retired at the single
+        # materialization below — an abandoned fold (a member that
+        # never built its response) shows up as booked bytes the
+        # reconciler can name, not silent HBM.
+        self._staged: tuple | None = (totals, sched)
         self._totals, self._sched = totals, sched
         self._lock = threading.Lock()
         self._np: tuple | None = None
+        _memledger.register(self._staged, "fold_fetch")
+        # While the fetch object is alive an unmaterialized fold is
+        # booked HBM the reconciler can name; once it dies the buffers
+        # die with it, so the book entry must go too (the box — never
+        # ``self`` — rides in the finalizer).
+        self._staged_box: list = [self._staged]
+        weakref.finalize(self, _retire_fold_box, self._staged_box)
 
     def arrays(self) -> tuple:
         with self._lock:
@@ -136,6 +166,10 @@ class _FoldedFetch:
                     np.asarray(self._sched),
                 )
                 self._totals = self._sched = None
+                if self._staged is not None:
+                    _memledger.retire(self._staged)
+                    self._staged = None
+                    self._staged_box[0] = None
             return self._np
 
 
@@ -879,6 +913,11 @@ class CapacityServer:
         self._m_inflight.inc()
         clk = _phases.new_clock()
         prev_clk = _phases.activate(clk)
+        if clk:
+            # Live (op, tenant) attribution for the sampling profiler:
+            # a sample landing anywhere in this dispatch carries the op
+            # and tenant; phase blocks add the third coordinate.
+            _phases.live_set(op=op_label, tenant=tenant)
         t0 = _time.perf_counter()
         error: str | None = None
         result = None
@@ -933,6 +972,8 @@ class CapacityServer:
                 with self._drain_cv:
                     self._active_gated -= 1
                     self._drain_cv.notify_all()
+            if clk:
+                _phases.live_clear()
             _phases.restore(prev_clk)
             dur = _time.perf_counter() - t0
             self._m_inflight.dec()
@@ -1133,7 +1174,8 @@ class CapacityServer:
             clk = _phases.current()
             t0 = _time.perf_counter() if clk else 0.0
             try:
-                acquired = self._inflight.acquire(timeout=wait_s)
+                with clk.live("queue_wait"):
+                    acquired = self._inflight.acquire(timeout=wait_s)
             finally:
                 self._m_slot_wait.dec()
                 if clk:
@@ -1583,24 +1625,22 @@ class CapacityServer:
             )
 
         # Report rendering + list conversion is the fit op's serialize
-        # phase (host string/JSON work, no device involvement).
+        # phase (host string/JSON work, no device involvement).  The
+        # phase() block (vs a bare record) also marks the live
+        # attribution table so profiler samples landing here say
+        # "serialize".
         from kubernetesclustercapacity_tpu.telemetry import phases as _phases
 
         clk = _phases.current()
-        if clk:
-            import time as _time
-
-            t0 = _time.perf_counter()
-        report = self._render_report(msg, snap, fits, scenario)
-        total = int(fits.sum())
-        out = {
-            "total": total,
-            "schedulable": total >= scenario.replicas,
-            "fits": fits.tolist(),
-            "report": report,
-        }
-        if clk:
-            clk.record("serialize", _time.perf_counter() - t0)
+        with clk.phase("serialize"):
+            report = self._render_report(msg, snap, fits, scenario)
+            total = int(fits.sum())
+            out = {
+                "total": total,
+                "schedulable": total >= scenario.replicas,
+                "fits": fits.tolist(),
+                "report": report,
+            }
         return out
 
     @staticmethod
@@ -2031,25 +2071,20 @@ class CapacityServer:
         from kubernetesclustercapacity_tpu.telemetry import phases as _phases
 
         clk = _phases.current()
-        if clk:
-            import time as _time
+        with clk.phase("serialize"):
+            out = result.to_wire()
+            output = msg.get("output")
+            if output in ("table", "json"):
+                from kubernetesclustercapacity_tpu.report import (
+                    car_json_report,
+                    car_table_report,
+                )
 
-            t0 = _time.perf_counter()
-        out = result.to_wire()
-        output = msg.get("output")
-        if output in ("table", "json"):
-            from kubernetesclustercapacity_tpu.report import (
-                car_json_report,
-                car_table_report,
-            )
-
-            out["report"] = (
-                car_table_report(out)
-                if output == "table"
-                else car_json_report(out)
-            )
-        if clk:
-            clk.record("serialize", _time.perf_counter() - t0)
+                out["report"] = (
+                    car_table_report(out)
+                    if output == "table"
+                    else car_json_report(out)
+                )
         return out
 
     def _op_forecast(
@@ -2535,8 +2570,9 @@ class CapacityServer:
                 import time as _time
 
                 t0 = _time.perf_counter()
-                totals = np.asarray(totals)
-                sched = np.asarray(sched)
+                with clk_f.live("fetch_overlap"):
+                    totals = np.asarray(totals)
+                    sched = np.asarray(sched)
                 clk_f.record("fetch_overlap", _time.perf_counter() - t0)
             else:
                 totals = np.asarray(totals)
@@ -2564,11 +2600,8 @@ class CapacityServer:
         # exact-kernel response — the breaker's standing state lives in
         # the info op instead.
         clk = _phases.current()
-        if clk:
-            import time as _time
-
-            t0 = _time.perf_counter()
-            out = {
+        with clk.phase("serialize"):
+            return {
                 "totals": totals.tolist(),
                 "schedulable": sched.tolist(),
                 "scenarios": grid.size,
@@ -2579,19 +2612,6 @@ class CapacityServer:
                     else {}
                 ),
             }
-            clk.record("serialize", _time.perf_counter() - t0)
-            return out
-        return {
-            "totals": totals.tolist(),
-            "schedulable": sched.tolist(),
-            "scenarios": grid.size,
-            "kernel": kernel,
-            **(
-                {"fast_path_error": attempt_error}
-                if attempted and attempt_error
-                else {}
-            ),
-        }
 
     def _dispatch_sweep_batch(self, key, items) -> list:
         """One kernel launch for a micro-batch of folded requests.
@@ -3057,6 +3077,20 @@ def main(argv=None) -> int:
                    metavar="PORT",
                    help="serve Prometheus /metrics and /healthz on this "
                         "port (0 = disabled); binds the -host address")
+    p.add_argument("-profile-hz", type=float, default=0.0,
+                   dest="profile_hz", metavar="HZ",
+                   help="continuous-profiler sampling rate (0 = "
+                        "KCCAP_PROFILE_HZ or the 29 Hz default); the "
+                        "profiler itself arms with the server unless "
+                        "KCCAP_PROFILER=0, and serves collapsed "
+                        "flamegraphs at /debug/profile?seconds=N on "
+                        "the metrics port")
+    p.add_argument("-device-budget-bytes", type=int, default=0,
+                   dest="device_budget_bytes", metavar="BYTES",
+                   help="device-memory budget: when the ledger's live "
+                        "staged bytes exceed this, healthz carries a "
+                        "budget_breached signal and the doctor's "
+                        "device-memory line FAILs (0 = no budget)")
     p.add_argument("-trace-log", default=None, dest="trace_log",
                    metavar="PATH",
                    help="append one JSONL span per dispatched request "
@@ -3320,6 +3354,19 @@ def main(argv=None) -> int:
     )
 
     register_process_metrics(REGISTRY)
+    # The continuous profiler rides the whole serve (KCCAP_PROFILER=0
+    # pins it to zero threads + zero registry calls), and the device
+    # ledger's optional budget arms here.
+    from kubernetesclustercapacity_tpu.telemetry.profiler import (
+        start_profiler,
+        stop_profiler,
+    )
+
+    profiler = start_profiler(
+        args.profile_hz if args.profile_hz > 0 else None
+    )
+    if args.device_budget_bytes > 0:
+        _memledger.LEDGER.set_budget(args.device_budget_bytes)
     if args.node_bucket_floor > 0:
         from kubernetesclustercapacity_tpu import devcache
 
@@ -3586,6 +3633,17 @@ def main(argv=None) -> int:
                 out["plane"] = subscriber.stats()
             if server.draining:
                 out["draining"] = True
+            if _memledger.enabled():
+                # The device-byte book behind the liveness answer: a
+                # reconcile runs on every probe so a sustained leak is
+                # caught by the same scraper that reads the gauges.
+                try:
+                    _memledger.LEDGER.reconcile()
+                except Exception:  # noqa: BLE001 - audit != liveness
+                    pass
+                out["device_memory"] = _memledger.LEDGER.stats()
+            if profiler is not None:
+                out["profiler"] = profiler.stats()
             return out
 
         def _overall_healthy() -> bool:
@@ -3623,8 +3681,23 @@ def main(argv=None) -> int:
                 return False
             if server.draining:
                 return False
+            if _memledger.enabled() and (
+                _memledger.LEDGER.leaking()
+                or _memledger.LEDGER.budget_breached()
+            ):
+                # A sustained device-memory discrepancy (staged bytes
+                # the backend no longer accounts for) or a breached HBM
+                # budget: this replica's device footprint can no longer
+                # be trusted, and the balancer must see it before the
+                # allocator OOMs a kernel.
+                return False
             return True
 
+        debug_handlers = (
+            {"/debug/profile": profiler.debug_handler}
+            if profiler is not None
+            else None
+        )
         try:
             metrics_server = start_metrics_server(
                 REGISTRY,
@@ -3632,6 +3705,7 @@ def main(argv=None) -> int:
                 port=args.metrics_port,
                 healthy=_overall_healthy,
                 status=_healthz_status,
+                debug=debug_handlers,
             )
         except OSError as e:
             print(f"ERROR : cannot bind metrics port: {e}", file=sys.stderr)
@@ -3780,6 +3854,7 @@ def main(argv=None) -> int:
             shadow.close()
         if audit_log is not None:
             audit_log.close()
+        stop_profiler()
         server.shutdown()
     return 0
 

@@ -127,6 +127,14 @@ class MetricsServer:
     still answers.  A raise surfaces as ``{"status_error": ...}`` and
     flips the reply to 503: a status source that cannot report is
     indistinguishable from a wedged feed.
+
+    ``debug`` is an optional ``{path: handler}`` map of extra GET
+    endpoints (e.g. ``/debug/profile``); each handler takes the raw
+    query string and returns ``(content_type, body_bytes)``.  Handlers
+    run on the request's own thread (the threading server means a
+    handler that sleeps — the profiler's collection window — blocks
+    only its caller, never scrapes).  A raising handler is a 500 with
+    the error named, same crash-isolation rule as ``healthy``.
     """
 
     def __init__(
@@ -137,6 +145,7 @@ class MetricsServer:
         port: int = 0,
         healthy=None,
         status=None,
+        debug=None,
     ) -> None:
         import http.server
         import time
@@ -148,6 +157,7 @@ class MetricsServer:
         self.registry = registry
         self._healthy = healthy
         self._status = status
+        self._debug = dict(debug or {})
         # Scrape self-report: the time each exposition render takes,
         # visible in the very scrape it measures (the previous render's
         # sample — a scrape cannot carry its own final timing).  Skipped
@@ -206,6 +216,23 @@ class MetricsServer:
                         body,
                         head,
                     )
+                elif path in outer._debug:
+                    query = (
+                        self.path.split("?", 1)[1]
+                        if "?" in self.path
+                        else ""
+                    )
+                    try:
+                        ctype, body = outer._debug[path](query)
+                    except Exception as e:  # noqa: BLE001 - see class doc
+                        self._reply(
+                            500,
+                            "text/plain; charset=utf-8",
+                            f"{type(e).__name__}: {e}\n".encode(),
+                            head,
+                        )
+                        return
+                    self._reply(200, ctype, body, head)
                 else:
                     self._reply(
                         404, "text/plain; charset=utf-8", b"not found\n",
@@ -261,9 +288,11 @@ def start_metrics_server(
     port: int = 0,
     healthy=None,
     status=None,
+    debug=None,
 ) -> MetricsServer:
     """Construct AND start a :class:`MetricsServer` (the one-liner every
     embedder wants; ``port=0`` picks a free port — read ``.address``)."""
     return MetricsServer(
-        registry, host=host, port=port, healthy=healthy, status=status
+        registry, host=host, port=port, healthy=healthy, status=status,
+        debug=debug,
     ).start()

@@ -1,0 +1,350 @@
+"""Device-memory ledger: HBM accounting that cannot leak silently.
+
+The devcache/donation/fold machinery (PR 4, PR 19) holds device buffers
+whose total size was, until now, unknown and unaudited: staged snapshot
+tuples (exact / grouped / pallas / gspmd forms), donated replacement
+columns, and the async fold path's in-flight ``_FoldedFetch`` device
+futures.  This module is the single book those sites write:
+
+* **register/retire by identity** — every staging site registers the
+  container it stores (a tuple of device arrays) with its form label;
+  retirement happens at the exact point the container leaves the cache
+  (LRU eviction, ``invalidate``, ``stage_replace``'s pop, fold
+  materialization).  The ledger holds NO strong references — devcache's
+  donation guard (``sys.getrefcount(prior) <= 3``) and JAX's buffer
+  lifetimes must be unaffected by being observed — so entries are keyed
+  on container id with per-leaf ``(id, nbytes)`` pairs captured at
+  registration.
+* **gauges** — ``kccap_device_bytes{form}`` (live bytes per form) and
+  ``kccap_device_peak_bytes`` (high-watermark), both callback gauges so
+  a scrape always reads the current book.
+* **reconciliation** — :meth:`DeviceLedger.reconcile` checks every
+  tracked leaf against ``jax.live_arrays()`` identity.  A tracked leaf
+  that is gone from the backend's own accounting means a site freed
+  memory without telling the book — and a buffer the book believes
+  live that is not, is exactly how an HBM leak hides.  A discrepancy
+  must be SUSTAINED (same leaf missing on two consecutive reconciles)
+  before it trips the leak :class:`~..timeline.alerts.WatchAlert`,
+  which feeds ``/healthz`` and the doctor "device memory" line.
+* **budget** — ``-device-budget-bytes`` arms :meth:`set_budget`; live
+  bytes above it flip ``budget_breached`` (a signal, not an admission
+  gate — the operator chooses the response).
+
+Hot-path rule: when telemetry is off (``KCCAP_TELEMETRY=0``) or the
+dedicated hatch is thrown (``KCCAP_MEMLEDGER=0``), :func:`enabled` is
+False, every hook site skips the ledger entirely, and this module makes
+zero registry calls — pinned by test.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from kubernetesclustercapacity_tpu.timeline.alerts import WatchAlert
+
+__all__ = [
+    "DeviceLedger",
+    "LEDGER",
+    "enabled",
+    "register",
+    "retire",
+    "device_memory_status",
+]
+
+
+def enabled() -> bool:
+    """Ledger armed?  ``KCCAP_MEMLEDGER=0`` is the dedicated hatch;
+    ``KCCAP_TELEMETRY=0`` disables it too (the book rides the telemetry
+    substrate and must cost nothing when that is off)."""
+    if os.environ.get("KCCAP_MEMLEDGER", "1") == "0":
+        return False
+    from kubernetesclustercapacity_tpu.telemetry.metrics import (
+        enabled as _telemetry_enabled,
+    )
+
+    return _telemetry_enabled()
+
+
+def _leaves(value) -> list:
+    """Flatten a staged container into its array leaves (tuples/lists
+    nest; anything with ``nbytes`` is a leaf; the rest is ignored —
+    staging sites store tuples of jax arrays by construction)."""
+    out: list = []
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (tuple, list)):
+            stack.extend(v)
+        elif hasattr(v, "nbytes"):
+            out.append(v)
+    return out
+
+
+class DeviceLedger:
+    """The process-wide device-byte book (thread-safe; all mutable state
+    under ``self._lock`` — hammered by ``analysis/hammer.py``).
+
+    Entries are keyed on the *container's* id: the same object a cache
+    stores is the same object it later evicts, so identity is exact.
+    Per-leaf ``(id, nbytes)`` pairs are captured at registration for the
+    reconciler; no strong references are taken (see module docstring).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # container id -> (form, total_nbytes, ((leaf_id, nbytes), ...))
+        self._entries: dict[int, tuple] = {}
+        self._by_form: dict[str, int] = {}
+        self._total = 0
+        self._peak = 0
+        self._registered = 0
+        self._retired = 0
+        self._budget: int | None = None
+        self._suspects: set[int] = set()
+        self._leaked_bytes = 0
+        self._reconciles = 0
+        self._alert = WatchAlert(name="device_memory", min_replicas=0)
+        self._gauge_forms: set[str] = set()
+
+    # -- write side (the staging sites) ------------------------------
+
+    def register(self, value, form: str) -> int:
+        """Book ``value`` (a staged container) under ``form``; returns
+        the byte count booked.  Re-registering the same container id
+        replaces the previous entry (double-build races in the devcache
+        store last-wins — so does the book)."""
+        form = str(form)
+        leaves = _leaves(value)
+        pairs = tuple((id(a), int(a.nbytes)) for a in leaves)
+        nbytes = sum(n for _, n in pairs)
+        key = id(value)
+        with self._lock:
+            prev = self._entries.get(key)
+            if prev is not None:
+                self._by_form[prev[0]] -= prev[1]
+                self._total -= prev[1]
+                self._retired += 1
+            self._entries[key] = (form, nbytes, pairs)
+            self._by_form[form] = self._by_form.get(form, 0) + nbytes
+            self._total += nbytes
+            self._registered += 1
+            if self._total > self._peak:
+                self._peak = self._total
+        self._ensure_gauges(form)
+        return nbytes
+
+    def retire(self, value) -> int:
+        """Unbook a container at the moment it leaves its cache;
+        returns the bytes released (0 for a container never booked —
+        retiring twice is harmless, staying booked forever is the bug
+        the reconciler exists to catch)."""
+        key = id(value)
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return 0
+            form, nbytes, _ = entry
+            self._by_form[form] -= nbytes
+            self._total -= nbytes
+            self._retired += 1
+            return nbytes
+
+    def set_budget(self, nbytes: int | None) -> None:
+        with self._lock:
+            self._budget = int(nbytes) if nbytes else None
+
+    def reset(self) -> None:
+        """Forget everything (tests and the hammer's cleanup)."""
+        with self._lock:
+            self._entries.clear()
+            self._by_form.clear()
+            self._total = 0
+            self._peak = 0
+            self._registered = 0
+            self._retired = 0
+            self._suspects = set()
+            self._leaked_bytes = 0
+            self._reconciles = 0
+            self._alert = WatchAlert(name="device_memory", min_replicas=0)
+
+    # -- read side ---------------------------------------------------
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._total
+
+    def form_bytes(self, form: str) -> int:
+        with self._lock:
+            return self._by_form.get(form, 0)
+
+    def peak_bytes(self) -> int:
+        with self._lock:
+            return self._peak
+
+    def budget_breached(self) -> bool:
+        with self._lock:
+            return self._budget is not None and self._total > self._budget
+
+    def leaking(self) -> bool:
+        """True while the last reconcile found a SUSTAINED discrepancy
+        (the alert is in its breached state)."""
+        with self._lock:
+            return self._alert.state == "breached"
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": enabled(),
+                "total_bytes": self._total,
+                "peak_bytes": self._peak,
+                "by_form": dict(self._by_form),
+                "entries": len(self._entries),
+                "registered": self._registered,
+                "retired": self._retired,
+                "budget_bytes": self._budget,
+                "budget_breached": (
+                    self._budget is not None and self._total > self._budget
+                ),
+                "reconciles": self._reconciles,
+                "leaked_bytes": self._leaked_bytes,
+                "leak_alert": self._alert.to_wire(),
+            }
+
+    # -- reconciliation ----------------------------------------------
+
+    def reconcile(self, live_arrays=None) -> dict:
+        """Audit the book against the backend's own accounting.
+
+        ``live_arrays`` defaults to ``jax.live_arrays()``; tests inject
+        their own.  Every tracked leaf must be identity-present among
+        the live arrays; a leaf missing on TWO consecutive reconciles is
+        counted as leaked bytes and trips the leak alert (one miss is a
+        suspect only — a concurrent eviction between our snapshot and
+        jax's walk must not page anyone).  Returns the audit dict.
+        """
+        if live_arrays is None:
+            import jax
+
+            live_arrays = jax.live_arrays()
+        live_ids = {id(a) for a in live_arrays}
+        with self._lock:
+            missing: set[int] = set()
+            missing_bytes = 0
+            sustained_bytes = 0
+            for form, nbytes, pairs in self._entries.values():
+                for leaf_id, leaf_bytes in pairs:
+                    if leaf_id in live_ids:
+                        continue
+                    missing.add(leaf_id)
+                    missing_bytes += leaf_bytes
+                    if leaf_id in self._suspects:
+                        sustained_bytes += leaf_bytes
+            self._reconciles += 1
+            self._suspects = missing
+            self._leaked_bytes = sustained_bytes
+            # WatchAlert breaches on total < min_replicas: feed the
+            # negated discrepancy so "any sustained leaked byte" is the
+            # breach and zero is healthy.
+            transition = self._alert.update(
+                -sustained_bytes, self._reconciles
+            )
+            return {
+                "live_arrays": len(live_ids),
+                "tracked_entries": len(self._entries),
+                "tracked_bytes": self._total,
+                "missing_bytes": missing_bytes,
+                "sustained_missing_bytes": sustained_bytes,
+                "leaking": self._alert.state == "breached",
+                "transition": transition,
+            }
+
+    # -- gauges ------------------------------------------------------
+
+    def _ensure_gauges(self, form: str) -> None:
+        """Idempotently attach the callback gauges (per-form on first
+        sight of the form; peak once).  Outside the lock — registry
+        callbacks must never nest under ledger state."""
+        if not enabled():
+            return
+        with self._lock:
+            if form in self._gauge_forms:
+                return
+            first = not self._gauge_forms
+            self._gauge_forms.add(form)
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            REGISTRY,
+        )
+
+        g = REGISTRY.gauge(
+            "kccap_device_bytes",
+            "Live device bytes booked by the memory ledger, by staged "
+            "form.",
+            ("form",),
+        )
+        g.labels(form=form).set_function(
+            lambda f=form: float(self.form_bytes(f))
+        )
+        if first:
+            REGISTRY.gauge(
+                "kccap_device_peak_bytes",
+                "High-watermark of ledger-booked device bytes since "
+                "process start.",
+            ).labels().set_function(lambda: float(self.peak_bytes()))
+
+
+#: The process-wide book every staging site writes.
+LEDGER = DeviceLedger()
+
+
+def register(value, form: str) -> None:
+    """Module-level hook the staging sites call (no-op when the ledger
+    is off — the zero-registry-call rule)."""
+    if enabled():
+        LEDGER.register(value, form)
+
+
+def retire(value) -> None:
+    """Unconditional, unlike :func:`register` — a buffer booked while
+    the ledger was armed must come OFF the book even if the hatch has
+    since been thrown (a hatch flip mid-process would otherwise turn
+    every retirement into a stale leaf, i.e. a false sustained leak).
+    Pure bookkeeping: touches no registry, so the zero-registry-call
+    pin for the off state still holds."""
+    LEDGER.retire(value)
+
+
+def device_memory_status() -> str:
+    """The doctor's "device memory" line: FAILED on a sustained leak or
+    a breached budget, soft otherwise."""
+    if not enabled():
+        return (
+            "off (KCCAP_MEMLEDGER=0 or KCCAP_TELEMETRY=0) — device "
+            "bytes unaudited"
+        )
+    st = LEDGER.stats()
+    mib = st["total_bytes"] / (1 << 20)
+    peak = st["peak_bytes"] / (1 << 20)
+    forms = " ".join(
+        f"{f}={b / (1 << 20):.1f}MiB"
+        for f, b in sorted(st["by_form"].items())
+        if b
+    )
+    if st["leak_alert"]["state"] == "breached":
+        return (
+            f"FAILED: device-memory leak — {st['leaked_bytes']} "
+            "booked byte(s) missing from jax.live_arrays() on "
+            "consecutive reconciles; "
+            f"live={mib:.1f}MiB peak={peak:.1f}MiB"
+        )
+    if st["budget_breached"]:
+        return (
+            f"FAILED: device budget breached — live {mib:.1f}MiB over "
+            f"budget {st['budget_bytes'] / (1 << 20):.1f}MiB"
+        )
+    return (
+        f"ok: live={mib:.1f}MiB peak={peak:.1f}MiB "
+        f"entries={st['entries']} "
+        f"registered={st['registered']} retired={st['retired']}"
+        + (f" [{forms}]" if forms else "")
+    )

@@ -72,6 +72,9 @@ __all__ = [
     "current",
     "activate",
     "restore",
+    "live_set",
+    "live_clear",
+    "live_snapshot",
 ]
 
 #: The fixed phase vocabulary.  Every phase name recorded anywhere in
@@ -95,6 +98,22 @@ _PHASE_SET = frozenset(PHASES)
 
 class PhaseError(ValueError):
     """A phase name outside the fixed vocabulary."""
+
+
+class _NullCtx:
+    """A reusable no-op context manager (module singleton) — so
+    ``with clk.phase(...):`` on the null clock allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
 
 
 class _NullClock:
@@ -126,15 +145,80 @@ class _NullClock:
     def total_s(self) -> float:
         return 0.0
 
-    @contextmanager
     def phase(self, name: str):
-        yield
+        return _NULL_CTX
+
+    def live(self, name: str):
+        return _NULL_CTX
 
 
 #: The one instance every disabled dispatch shares (``new_clock`` under
 #: ``KCCAP_TELEMETRY=0``, and :func:`current` on a thread with no active
 #: clock).
 NULL_CLOCK = _NullClock()
+
+
+# ---------------------------------------------------------------------------
+# Live cross-thread attribution: the sampling profiler's join point.
+#
+# The phase clock accumulates *post hoc* — by the time ``items()`` is
+# readable the request is over.  The profiler needs the opposite view:
+# "what is thread T doing RIGHT NOW?".  This table publishes, per thread
+# ident, the ``(op, tenant, phase)`` triple currently in flight, written
+# by the dispatch (``live_set``) and by :meth:`PhaseClock.phase` on
+# enter/exit, and read by the sampler thread (``live_snapshot``).  It is
+# deliberately tiny: one dict under one lock, entries removed when the
+# request finishes, and NEVER touched on the ``KCCAP_TELEMETRY=0`` path
+# (every writer is gated on clock truthiness, same as the clocks
+# themselves).
+# ---------------------------------------------------------------------------
+
+_live_lock = threading.Lock()
+_live: dict[int, tuple] = {}
+
+
+def live_set(op=None, tenant=None) -> None:
+    """Publish ``(op, tenant)`` as the calling thread's in-flight work
+    (phase starts unset; :meth:`PhaseClock.phase` fills it)."""
+    with _live_lock:
+        _live[threading.get_ident()] = (op, tenant, None)
+
+
+def live_clear() -> None:
+    """Retire the calling thread's attribution entry (request done)."""
+    with _live_lock:
+        _live.pop(threading.get_ident(), None)
+
+
+def live_snapshot() -> dict:
+    """A point-in-time copy ``{thread_ident: (op, tenant, phase)}`` —
+    the sampler's read side."""
+    with _live_lock:
+        return dict(_live)
+
+
+def _live_enter_phase(ident: int, name: str):
+    """Mark ``name`` as ``ident``'s current phase; returns the previous
+    entry (or ``None``) for :func:`_live_exit_phase`."""
+    with _live_lock:
+        prev = _live.get(ident)
+        if prev is None:
+            _live[ident] = (None, None, name)
+        else:
+            _live[ident] = (prev[0], prev[1], name)
+        return prev
+
+
+def _live_exit_phase(ident: int, prev) -> None:
+    """Undo :func:`_live_enter_phase` (phases nest — restore the outer
+    entry, or remove the one we created)."""
+    with _live_lock:
+        if prev is None:
+            cur = _live.get(ident)
+            if cur is not None and cur[0] is None and cur[1] is None:
+                del _live[ident]
+        else:
+            _live[ident] = prev
 
 
 class PhaseClock:
@@ -190,12 +274,42 @@ class PhaseClock:
 
     @contextmanager
     def phase(self, name: str):
-        """Time a block into ``name`` (host-side convenience)."""
+        """Time a block into ``name`` (host-side convenience).  Also
+        publishes ``name`` to the live attribution table so a profiler
+        sample landing inside the block carries the phase."""
+        if name not in _PHASE_SET:
+            raise PhaseError(
+                f"unknown phase {name!r} (vocabulary: {PHASES})"
+            )
+        ident = threading.get_ident()
+        prev = _live_enter_phase(ident, name)
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.record(name, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _live_exit_phase(ident, prev)
+            self.record(name, dt)
+
+    @contextmanager
+    def live(self, name: str):
+        """Publish ``name`` as the calling thread's live phase for the
+        block WITHOUT timing or recording anything — for sites that
+        measure with explicit ``perf_counter`` pairs and classify the
+        window post hoc (the kernel wrappers' compile/device_exec
+        split), so a profiler sample landing inside still carries a
+        phase.  The accounting stays with the site's own ``record``
+        calls; this is attribution only."""
+        if name not in _PHASE_SET:
+            raise PhaseError(
+                f"unknown phase {name!r} (vocabulary: {PHASES})"
+            )
+        ident = threading.get_ident()
+        prev = _live_enter_phase(ident, name)
+        try:
+            yield
+        finally:
+            _live_exit_phase(ident, prev)
 
     def items(self) -> list[tuple[str, float]]:
         """``(phase, accumulated_seconds)`` pairs in vocabulary order

@@ -33,6 +33,7 @@ decomposition.
 from __future__ import annotations
 
 import json
+import math
 import os
 
 __all__ = [
@@ -98,16 +99,33 @@ def assemble_tree(spans: list[dict], trace_id: str) -> dict:
     """One trace's spans → a parent-linked tree.
 
     Returns ``{trace_id, found, spans, processes, roots, orphans,
-    clock_skew_spans}``.  Each node is the span record plus a
-    ``children`` list (log order) and, where applicable, a
+    clock_skew_spans, in_flight}``.  Each node is the span record plus
+    a ``children`` list (log order) and, where applicable, a
     ``clock_skew: True`` flag.  A span whose parent never appears in
     any log (the parent's process lost it, or its trace was dropped by
     tail sampling there) is promoted to a root and counted in
     ``orphans`` — present-but-unparented beats silently absent.
+
+    A span carrying the ``duration_ms`` KEY but no usable number
+    (``null``/string/NaN — a process that crashed mid-request wrote
+    the start of its record but never the end) is an **in-flight**
+    span: it is excluded from assembly and named in ``in_flight``.
+    Before this rule such a span entered the tree with an implied
+    duration of 0, silently zeroing its own step and inflating its
+    parent's self time — a poisoned attribution with no warning.
     """
     mine: dict[str, dict] = {}
+    in_flight: list[str] = []
     for rec in spans:
         if rec.get("trace_id") != trace_id:
+            continue
+        dur = rec.get("duration_ms")
+        if (
+            isinstance(dur, bool)
+            or not isinstance(dur, (int, float))
+            or not math.isfinite(dur)
+        ):
+            in_flight.append(str(rec.get("span_id")))
             continue
         node = dict(rec)
         node["children"] = []
@@ -150,6 +168,7 @@ def assemble_tree(spans: list[dict], trace_id: str) -> dict:
         "clock_skew_spans": sorted(
             n["span_id"] for n in mine.values() if n.get("clock_skew")
         ),
+        "in_flight": sorted(in_flight),
     }
 
 

@@ -265,6 +265,46 @@ def doctor_report(
 
     check("sanitizer", _sanitizer)
 
+    def _profiler():
+        # The continuous profiler's standing state: armed/sampling/off.
+        # Off is soft (a configuration, not a failure); a profiler whose
+        # supervised sampler died shows up in the sanitizer line's
+        # thread-death note.
+        from kubernetesclustercapacity_tpu.telemetry.profiler import (
+            profiler_status,
+        )
+
+        return profiler_status()
+
+    check("profiler", _profiler)
+
+    def _device_memory():
+        # The device-memory book: live/peak staged bytes and the leak
+        # alert.  A sustained reconcile discrepancy or a breached HBM
+        # budget is a hard FAILED line — silent device leaks are the
+        # incident class the ledger exists to make impossible.
+        from kubernetesclustercapacity_tpu.telemetry.memledger import (
+            device_memory_status,
+            enabled as _ledger_enabled,
+        )
+        from kubernetesclustercapacity_tpu.telemetry.memledger import (
+            LEDGER,
+        )
+
+        if _ledger_enabled():
+            # In-process reconcile against jax.live_arrays(): config
+            # state only when jax never initialized a backend here.
+            import sys as _sys
+
+            if "jax" in _sys.modules:
+                try:
+                    LEDGER.reconcile()
+                except Exception:  # noqa: BLE001 - audit must not abort
+                    pass
+        return device_memory_status()
+
+    check("device memory", _device_memory)
+
     def _optimizer():
         # One tiny certified solve in-process: proves the LP/PDHG
         # backend converges AND certifies on this host — an optimizer

@@ -1,0 +1,268 @@
+"""The bench regression gate: direction inference, the committed noise
+model, parity gating, degraded artifacts, missing/renamed rows,
+trajectory mode, and the ``kccap -bench-diff`` exit codes."""
+
+import json
+import pathlib
+
+import pytest
+
+from kubernetesclustercapacity_tpu.analysis import benchdiff
+from kubernetesclustercapacity_tpu.cli import main
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _write(path, doc):
+    path = str(path)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return path
+
+
+class TestDirections:
+    @pytest.mark.parametrize("name,expect", [
+        ("serving_p50_ms", "lower_is_better"),
+        ("pack_seconds", "lower_is_better"),
+        ("heap_bytes", "lower_is_better"),
+        ("serving_rps", "higher_is_better"),
+        ("ingest_per_sec", "higher_is_better"),
+        ("fold_throughput", "higher_is_better"),
+        ("serving_fold_requests", "informational"),
+        ("n", "informational"),
+    ])
+    def test_inference_by_name_shape(self, name, expect):
+        assert benchdiff.infer_direction(name) == expect
+
+
+class TestThresholds:
+    def test_default_merges_under_override(self):
+        th = benchdiff.Thresholds({
+            "default": {"rel_tol": 0.1},
+            "rows": {"value": {"direction": "lower_is_better"}},
+        })
+        eff = th.for_row("value")
+        assert eff["direction"] == "lower_is_better"
+        assert eff["rel_tol"] == 0.1  # inherited from default
+        assert eff["abs_tol"] == 0.05  # built-in default survives
+        assert eff["gate"] is None
+
+    def test_auto_direction_resolves_by_name(self):
+        th = benchdiff.Thresholds()
+        assert th.for_row("x_ms")["direction"] == "lower_is_better"
+        assert th.for_row("x_rps")["direction"] == "higher_is_better"
+
+    def test_unknown_direction_rejected(self):
+        with pytest.raises(ValueError, match="unknown direction"):
+            benchdiff.Thresholds({"rows": {"x": {"direction": "up"}}})
+
+    def test_missing_file_means_builtin_defaults(self, tmp_path):
+        th = benchdiff.load_thresholds(str(tmp_path / "nope.json"))
+        assert th.for_row("anything_ms")["rel_tol"] == 0.25
+
+    def test_committed_thresholds_file_loads(self):
+        th = benchdiff.load_thresholds(
+            str(_REPO_ROOT / benchdiff.THRESHOLDS_FILENAME)
+        )
+        eff = th.for_row("serving_p50_ms")
+        assert eff["gate"] == "serving_parity_diffs"
+        assert eff["direction"] == "lower_is_better"
+
+
+class TestDiffRows:
+    def test_regression_must_clear_both_tolerances(self):
+        th = benchdiff.Thresholds()
+        rows, _, _ = benchdiff.diff_rows(
+            {"a_ms": 10.0, "b_ms": 10.0, "c_ms": 0.02},
+            # a: +100% and +10 — regression.  b: +10% — inside rel_tol.
+            # c: +150% but +0.03 absolute — inside abs_tol (noise on a
+            # microsecond-scale row).
+            {"a_ms": 20.0, "b_ms": 11.0, "c_ms": 0.05},
+            th,
+        )
+        verdicts = {r.name: r.verdict for r in rows}
+        assert verdicts == {
+            "a_ms": "regression", "b_ms": "ok", "c_ms": "ok",
+        }
+
+    def test_improvement_and_higher_is_better(self):
+        th = benchdiff.Thresholds()
+        rows, _, _ = benchdiff.diff_rows(
+            {"a_ms": 20.0, "tput_rps": 100.0},
+            {"a_ms": 10.0, "tput_rps": 50.0},
+            th,
+        )
+        verdicts = {r.name: r.verdict for r in rows}
+        assert verdicts["a_ms"] == "improved"
+        assert verdicts["tput_rps"] == "regression"
+
+    def test_informational_rows_never_regress(self):
+        th = benchdiff.Thresholds()
+        rows, _, _ = benchdiff.diff_rows(
+            {"requests": 10.0}, {"requests": 1000.0}, th
+        )
+        assert rows[0].verdict == "informational"
+
+    def test_gate_voids_the_row_on_either_side(self):
+        th = benchdiff.Thresholds({"rows": {
+            "p50_ms": {"gate": "parity_diffs",
+                       "direction": "lower_is_better"},
+        }})
+        # Nonzero parity on ONE side: gated, even though the number
+        # doubled.
+        rows, _, _ = benchdiff.diff_rows(
+            {"p50_ms": 10.0, "parity_diffs": 0.0},
+            {"p50_ms": 20.0, "parity_diffs": 1.0},
+            th,
+        )
+        by = {r.name: r for r in rows}
+        assert by["p50_ms"].verdict == "gated"
+        assert "parity_diffs" in by["p50_ms"].note
+        # Gate row absent entirely: also gated, named.
+        rows, _, _ = benchdiff.diff_rows(
+            {"p50_ms": 10.0}, {"p50_ms": 20.0}, th
+        )
+        assert rows[0].verdict == "gated"
+        assert "missing" in rows[0].note
+
+    def test_missing_and_added_rows_are_named(self):
+        th = benchdiff.Thresholds()
+        _, missing, added = benchdiff.diff_rows(
+            {"kept_ms": 1.0, "dropped_ms": 2.0},
+            {"kept_ms": 1.0, "fresh_ms": 3.0},
+            th,
+        )
+        assert missing == ["dropped_ms"]
+        assert added == ["fresh_ms"]
+
+    def test_zero_old_value_is_infinite_rel_change(self):
+        th = benchdiff.Thresholds()
+        rows, _, _ = benchdiff.diff_rows(
+            {"a_ms": 0.0}, {"a_ms": 1.0}, th
+        )
+        assert rows[0].verdict == "regression"
+        assert rows[0].to_json()["rel_change"] is None
+
+
+class TestArtifactShapes:
+    def test_flat_dict_is_rows_directly(self, tmp_path):
+        p = _write(tmp_path / "a.json", {"x_ms": 1.5, "label": "str",
+                                         "flag": True})
+        rows, degraded = benchdiff.load_rows(p)
+        assert rows == {"x_ms": 1.5}  # strings and bools skipped
+        assert degraded is None
+
+    def test_wrapper_contributes_parsed(self, tmp_path):
+        p = _write(tmp_path / "a.json",
+                   {"n": 1, "cmd": ["bench"], "rc": 0,
+                    "parsed": {"x_ms": 2.0}})
+        rows, degraded = benchdiff.load_rows(p)
+        assert rows == {"x_ms": 2.0} and degraded is None
+
+    def test_degraded_wrapper_is_named_never_failed(self, tmp_path):
+        th = benchdiff.Thresholds()
+        old = _write(tmp_path / "old.json",
+                     {"cmd": ["bench"], "parsed": None})
+        new = _write(tmp_path / "new.json", {"x_ms": 1.0})
+        bd = benchdiff.diff_files(old, new, th)
+        assert not bd.comparable
+        assert "no parsed JSON tail" in bd.old_degraded
+        assert bd.regressions == []
+        assert "never" in benchdiff.render(bd)
+
+    def test_error_tail_is_degraded(self, tmp_path):
+        p = _write(tmp_path / "a.json",
+                   {"cmd": ["bench"],
+                    "parsed": {"error": "OOM", "value": None}})
+        rows, degraded = benchdiff.load_rows(p)
+        assert rows == {} and "OOM" in degraded
+
+    def test_non_object_artifact_is_a_usage_error(self, tmp_path):
+        p = _write(tmp_path / "a.json", [1, 2, 3])
+        with pytest.raises(ValueError):
+            benchdiff.load_rows(p)
+
+
+class TestTrajectory:
+    def test_walks_consecutive_rounds_in_order(self, tmp_path):
+        th = benchdiff.Thresholds()
+        _write(tmp_path / "BENCH_r01.json", {"a_ms": 1.0})
+        _write(tmp_path / "BENCH_r02.json", {"a_ms": 1.01})
+        _write(tmp_path / "BENCH_r03.json", {"a_ms": 9.0})
+        diffs = benchdiff.trajectory(str(tmp_path), th)
+        assert len(diffs) == 2
+        assert [len(bd.regressions) for bd in diffs] == [0, 1]
+        assert "2 pair(s)" in benchdiff.render_trajectory(diffs)
+
+    def test_needs_two_rounds(self, tmp_path):
+        _write(tmp_path / "BENCH_r01.json", {"a_ms": 1.0})
+        with pytest.raises(ValueError, match=">= 2"):
+            benchdiff.trajectory(str(tmp_path), benchdiff.Thresholds())
+
+
+class TestCLI:
+    def _thresholds(self, tmp_path):
+        return _write(tmp_path / "BENCH_THRESHOLDS.json", {
+            "default": {"direction": "auto", "rel_tol": 0.25,
+                        "abs_tol": 0.05},
+            "rows": {},
+        })
+
+    def test_clean_pair_exits_zero(self, tmp_path, capsys):
+        old = _write(tmp_path / "old.json", {"a_ms": 10.0})
+        new = _write(tmp_path / "new.json", {"a_ms": 10.5})
+        rc = main(["-bench-diff", old, new,
+                   "-bench-thresholds", self._thresholds(tmp_path)])
+        assert rc == 0
+        assert "0 regression(s)" in capsys.readouterr().out
+
+    def test_planted_regression_exits_one(self, tmp_path, capsys):
+        old = _write(tmp_path / "old.json",
+                     {"a_ms": 10.0, "gone_ms": 1.0})
+        new = _write(tmp_path / "new.json",
+                     {"a_ms": 30.0, "fresh_ms": 2.0})
+        rc = main(["-bench-diff", old, new,
+                   "-bench-thresholds", self._thresholds(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSION a_ms" in out
+        assert "missing    gone_ms" in out
+        assert "added      fresh_ms" in out
+
+    def test_json_output_is_structured(self, tmp_path, capsys):
+        old = _write(tmp_path / "old.json", {"a_ms": 10.0})
+        new = _write(tmp_path / "new.json", {"a_ms": 30.0})
+        rc = main(["-bench-diff", old, new, "-output", "json",
+                   "-bench-thresholds", self._thresholds(tmp_path)])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["clean"] is False
+        assert doc["regressions"] == 1
+        assert doc["pairs"][0]["regressions"] == ["a_ms"]
+
+    def test_directory_arg_runs_trajectory(self, tmp_path, capsys):
+        self._thresholds(tmp_path)
+        _write(tmp_path / "BENCH_r01.json", {"a_ms": 1.0})
+        _write(tmp_path / "BENCH_r02.json", {"a_ms": 1.02})
+        rc = main(["-bench-diff", str(tmp_path)])
+        assert rc == 0
+        assert "trajectory:" in capsys.readouterr().out
+
+    def test_usage_errors_exit_two(self, tmp_path, capsys):
+        assert main(["-bench-diff", "one-arg-not-a-dir"]) == 2
+        capsys.readouterr()
+        a = _write(tmp_path / "a.json", [1])
+        b = _write(tmp_path / "b.json", {"x_ms": 1.0})
+        assert main(["-bench-diff", a, b]) == 2
+
+    @pytest.mark.slow
+    def test_committed_history_r04_to_r05_is_clean(self, capsys):
+        """The repo's own latest comparable rounds must pass the gate
+        with the committed thresholds (acceptance criterion)."""
+        r04 = _REPO_ROOT / "BENCH_r04.json"
+        r05 = _REPO_ROOT / "BENCH_r05.json"
+        if not (r04.exists() and r05.exists()):
+            pytest.skip("committed bench artifacts not present")
+        rc = main(["-bench-diff", str(r04), str(r05)])
+        capsys.readouterr()
+        assert rc == 0

@@ -1,0 +1,226 @@
+"""Device-memory ledger: identity bookkeeping, the budget signal, the
+sustained-leak reconciler, the doctor line, and the
+``KCCAP_MEMLEDGER=0`` zero-registry hatch.  (The 16-thread concurrency
+hammer lives in ``analysis/hammer.py``; this file pins semantics.)"""
+
+import pytest
+
+from kubernetesclustercapacity_tpu.telemetry import memledger
+
+
+class _Leaf:
+    """Stands in for a device array: identity + ``nbytes`` is all the
+    ledger reads (it takes no strong references)."""
+
+    __slots__ = ("nbytes",)
+
+    def __init__(self, nbytes: int) -> None:
+        self.nbytes = nbytes
+
+
+def _container(*sizes):
+    return tuple(_Leaf(s) for s in sizes)
+
+
+@pytest.fixture()
+def ledger(monkeypatch):
+    """A private book with the gauge side effects stubbed out — unit
+    tests must not attach callbacks to the global registry (the enabled
+    gauge path is exercised end-to-end via the server/devcache)."""
+    led = memledger.DeviceLedger()
+    monkeypatch.setattr(led, "_ensure_gauges", lambda form: None)
+    return led
+
+
+class TestBookkeeping:
+    def test_register_books_leaf_bytes_by_form(self, ledger):
+        c = _container(100, 28)
+        assert ledger.register(c, "exact") == 128
+        assert ledger.total_bytes() == 128
+        assert ledger.form_bytes("exact") == 128
+        assert ledger.peak_bytes() == 128
+
+    def test_retire_releases_and_unknown_is_harmless(self, ledger):
+        c = _container(64)
+        ledger.register(c, "grouped")
+        assert ledger.retire(c) == 64
+        assert ledger.total_bytes() == 0
+        # Retiring twice (or something never booked) returns 0 —
+        # staying booked forever is the bug, not double-retiring.
+        assert ledger.retire(c) == 0
+        assert ledger.retire(object()) == 0
+
+    def test_reregister_same_container_last_wins(self, ledger):
+        c = _container(50)
+        ledger.register(c, "exact")
+        ledger.register(c, "grouped")  # devcache double-build race
+        assert ledger.total_bytes() == 50
+        assert ledger.form_bytes("exact") == 0
+        assert ledger.form_bytes("grouped") == 50
+        st = ledger.stats()
+        assert st["entries"] == 1
+        assert st["registered"] == 2 and st["retired"] == 1
+
+    def test_peak_is_a_high_watermark(self, ledger):
+        a, b = _container(100), _container(200)
+        ledger.register(a, "exact")
+        ledger.register(b, "exact")
+        ledger.retire(a)
+        ledger.retire(b)
+        assert ledger.total_bytes() == 0
+        assert ledger.peak_bytes() == 300
+
+    def test_nested_containers_flatten_to_leaves(self, ledger):
+        nested = (_Leaf(1), [_Leaf(2), (_Leaf(4), "not-a-leaf")], None)
+        assert ledger.register(nested, "fold_fetch") == 7
+
+    def test_dying_devcache_retires_its_booked_bytes(
+        self, ledger, monkeypatch
+    ):
+        """A short-lived DeviceCache must un-book its entries when it is
+        collected — otherwise the global book accrues stale leaves and
+        the reconciler reports a false sustained leak (doctor FAILED
+        after any tool that staged through an ephemeral cache)."""
+        import gc
+
+        from kubernetesclustercapacity_tpu import devcache
+
+        monkeypatch.delenv("KCCAP_DEVCACHE", raising=False)
+        monkeypatch.setattr(memledger, "LEDGER", ledger)
+
+        class _Snap:
+            pass
+
+        cache = devcache.DeviceCache()
+        cache.get(_Snap(), ("exact",), lambda: _container(4096))
+        assert ledger.total_bytes() == 4096
+        del cache
+        gc.collect()
+        assert ledger.total_bytes() == 0
+
+
+class TestBudget:
+    def test_budget_breach_is_a_signal_not_a_gate(self, ledger):
+        ledger.set_budget(100)
+        assert not ledger.budget_breached()
+        c = _container(150)
+        ledger.register(c, "exact")  # register still succeeds
+        assert ledger.budget_breached()
+        assert ledger.stats()["budget_breached"]
+        ledger.retire(c)
+        assert not ledger.budget_breached()
+        ledger.set_budget(None)
+        assert ledger.stats()["budget_bytes"] is None
+
+
+class TestReconcile:
+    def test_one_miss_is_a_suspect_two_is_a_leak(self, ledger):
+        c = _container(10, 20)
+        keep, lost = c
+        ledger.register(c, "exact")
+        # All leaves visible: clean.
+        audit = ledger.reconcile(live_arrays=[keep, lost])
+        assert audit["missing_bytes"] == 0 and not audit["leaking"]
+        # First miss: suspect only — a concurrent eviction between our
+        # snapshot and the backend's walk must not page anyone.
+        audit = ledger.reconcile(live_arrays=[keep])
+        assert audit["missing_bytes"] == 20
+        assert audit["sustained_missing_bytes"] == 0
+        assert not audit["leaking"] and not ledger.leaking()
+        # Same leaf missing again: sustained — the alert trips.
+        audit = ledger.reconcile(live_arrays=[keep])
+        assert audit["sustained_missing_bytes"] == 20
+        assert audit["leaking"] and ledger.leaking()
+        assert ledger.stats()["leaked_bytes"] == 20
+        # The leaf coming back clears suspect state and the alert.
+        audit = ledger.reconcile(live_arrays=[keep, lost])
+        assert audit["sustained_missing_bytes"] == 0
+        assert not ledger.leaking()
+
+    def test_reset_forgets_everything(self, ledger):
+        c = _container(10)
+        ledger.register(c, "exact")
+        ledger.reconcile(live_arrays=[])
+        ledger.reconcile(live_arrays=[])
+        assert ledger.leaking()
+        ledger.reset()
+        assert ledger.total_bytes() == 0
+        assert ledger.peak_bytes() == 0
+        assert not ledger.leaking()
+        assert ledger.stats()["reconciles"] == 0
+
+
+class TestDoctorLine:
+    def test_leak_line_is_failed(self, ledger, monkeypatch):
+        monkeypatch.setattr(memledger, "LEDGER", ledger)
+        c = _container(10)
+        ledger.register(c, "exact")
+        ledger.reconcile(live_arrays=[])
+        ledger.reconcile(live_arrays=[])
+        line = memledger.device_memory_status()
+        assert line.startswith("FAILED: device-memory leak")
+
+    def test_budget_line_is_failed(self, ledger, monkeypatch):
+        monkeypatch.setattr(memledger, "LEDGER", ledger)
+        ledger.set_budget(1)
+        ledger.register(_container(100), "exact")
+        line = memledger.device_memory_status()
+        assert line.startswith("FAILED: device budget breached")
+
+    def test_ok_line_carries_the_book(self, ledger, monkeypatch):
+        monkeypatch.setattr(memledger, "LEDGER", ledger)
+        ledger.register(_container(1 << 20), "exact")
+        line = memledger.device_memory_status()
+        assert line.startswith("ok:")
+        assert "exact=1.0MiB" in line
+
+
+class TestLedgerOff:
+    def test_dedicated_hatch_disables(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_MEMLEDGER", "0")
+        assert not memledger.enabled()
+
+    def test_telemetry_off_disables_too(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        assert not memledger.enabled()
+
+    def test_module_hooks_are_noops_when_off(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_MEMLEDGER", "0")
+        led = memledger.DeviceLedger()
+        monkeypatch.setattr(memledger, "LEDGER", led)
+        memledger.register(_container(100), "exact")
+        memledger.retire(_container(100))
+        assert led.stats()["registered"] == 0
+
+    def test_retire_still_unbooks_after_hatch_flip(
+        self, ledger, monkeypatch
+    ):
+        """A buffer booked while armed must come off the book even if
+        the hatch is thrown before its cache retires it — otherwise a
+        telemetry-off window (hatch parity tests, an operator toggling
+        the env) turns every retirement into a stale leaf and the
+        reconciler reports a false sustained leak."""
+        monkeypatch.setattr(memledger, "LEDGER", ledger)
+        c = _container(512)
+        memledger.register(c, "exact")
+        assert ledger.total_bytes() == 512
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        memledger.retire(c)
+        assert ledger.total_bytes() == 0
+
+    def test_zero_registry_calls_when_off(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_MEMLEDGER", "0")
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            REGISTRY,
+        )
+
+        def boom(*a, **kw):
+            raise AssertionError("registry touched with ledger off")
+
+        monkeypatch.setattr(REGISTRY, "gauge", boom)
+        # Even a DIRECT register books privately but must skip gauges.
+        memledger.DeviceLedger().register(_container(8), "exact")
+
+    def test_doctor_line_says_off(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_MEMLEDGER", "0")
+        assert memledger.device_memory_status().startswith("off")

@@ -1,0 +1,229 @@
+"""Continuous profiler: collapsed-stack folding joined to the live
+phase table, the analysis helpers ``kccap -profile`` and bench share,
+and the ``KCCAP_PROFILER=0`` hatch's zero-thread / zero-registry pin."""
+
+import threading
+
+import pytest
+
+from kubernetesclustercapacity_tpu.telemetry import phases
+from kubernetesclustercapacity_tpu.telemetry import profiler as prof_mod
+from kubernetesclustercapacity_tpu.telemetry.profiler import (
+    SamplingProfiler,
+    attribution_counts,
+    dominant_phase,
+    phase_counts,
+    render_collapsed,
+    top_frame,
+)
+
+# A hand-built collapsed profile: three attributed stacks (two op=sweep
+# with tenant, one without tenant) and one unattributed bench loop.
+COLLAPSED = (
+    "op=sweep;tenant=acme;phase=device_exec;server:dispatch;"
+    "fit:sweep_auto 6\n"
+    "op=sweep;tenant=acme;phase=serialize;server:_respond;"
+    "report:render 3\n"
+    "op=sweep;phase=fetch;server:dispatch;fit:_materialize 1\n"
+    "bench:_arrival_loop;threading:wait 10\n"
+)
+
+
+class TestCollapsedAnalysis:
+    def test_phase_counts_includes_the_unattributed_bucket(self):
+        assert phase_counts(COLLAPSED) == {
+            "device_exec": 6,
+            "serialize": 3,
+            "fetch": 1,
+            "-": 10,
+        }
+
+    def test_attribution_counts_by_op_and_tenant(self):
+        assert attribution_counts(COLLAPSED, "op") == {
+            "sweep": 10,
+            "-": 10,
+        }
+        assert attribution_counts(COLLAPSED, "tenant") == {
+            "acme": 9,
+            "-": 11,
+        }
+
+    def test_dominant_phase_is_over_attributed_samples_only(self):
+        phase, share = dominant_phase(COLLAPSED)
+        assert phase == "device_exec"
+        assert share == pytest.approx(0.6)
+
+    def test_dominant_phase_none_when_nothing_attributed(self):
+        assert dominant_phase("a:b;c:d 5\n") == (None, 0.0)
+
+    def test_top_frame_skips_attribution_prefixes(self):
+        # The heaviest REAL leaf overall is the bench wait loop...
+        assert top_frame(COLLAPSED) == "threading:wait"
+        # ...but restricted to a phase, the prefixes never win even
+        # though they lead every attributed stack.
+        assert top_frame(COLLAPSED, phase="device_exec") == "fit:sweep_auto"
+        assert top_frame(COLLAPSED, phase="serialize") == "report:render"
+
+    def test_render_collapsed_sorts_heaviest_first(self):
+        text = render_collapsed({"a:b": 1, "c:d": 9, "e:f": 5})
+        assert text.splitlines() == ["c:d 9", "e:f 5", "a:b 1"]
+        assert render_collapsed({}) == ""
+
+    def test_comment_and_blank_lines_are_ignored(self):
+        text = "# profiler header\n\na:b;c:d 4\n"
+        assert phase_counts(text) == {"-": 4}
+
+
+class TestLiveAttribution:
+    def test_phase_block_publishes_and_clears(self):
+        clk = phases.PhaseClock()
+        ident = threading.get_ident()
+        with clk.phase("serialize"):
+            assert phases.live_snapshot()[ident] == (
+                None, None, "serialize",
+            )
+        assert ident not in phases.live_snapshot()
+
+    def test_live_block_publishes_without_recording(self):
+        clk = phases.PhaseClock()
+        ident = threading.get_ident()
+        with clk.live("device_exec"):
+            assert phases.live_snapshot()[ident] == (
+                None, None, "device_exec",
+            )
+        assert ident not in phases.live_snapshot()
+        # Attribution only: the accounting stays with the site's own
+        # record() calls.
+        assert clk.items() == []
+        assert clk.counts() == {}
+
+    def test_live_nests_and_restores_the_outer_phase(self):
+        clk = phases.PhaseClock()
+        ident = threading.get_ident()
+        with clk.phase("devcache"):
+            with clk.live("fetch"):
+                assert phases.live_snapshot()[ident][2] == "fetch"
+            assert phases.live_snapshot()[ident][2] == "devcache"
+        assert ident not in phases.live_snapshot()
+
+    def test_live_preserves_op_and_tenant(self):
+        ident = threading.get_ident()
+        phases.live_set(op="sweep", tenant="acme")
+        try:
+            clk = phases.PhaseClock()
+            with clk.live("device_exec"):
+                assert phases.live_snapshot()[ident] == (
+                    "sweep", "acme", "device_exec",
+                )
+            assert phases.live_snapshot()[ident] == ("sweep", "acme", None)
+        finally:
+            phases.live_clear()
+        assert ident not in phases.live_snapshot()
+
+    def test_live_rejects_unknown_phase(self):
+        clk = phases.PhaseClock()
+        with pytest.raises(phases.PhaseError):
+            with clk.live("warp_drive"):
+                pass
+
+    def test_null_clock_live_is_the_shared_noop(self):
+        # Same singleton context as phase(): zero allocations per call.
+        ctx = phases.NULL_CLOCK.live("device_exec")
+        assert ctx is phases.NULL_CLOCK.phase("serialize")
+        ident = threading.get_ident()
+        with phases.NULL_CLOCK.live("device_exec"):
+            assert ident not in phases.live_snapshot()
+
+
+class TestSampler:
+    def _worker(self, ready, release):
+        phases.live_set(op="sweep", tenant="acme")
+        clk = phases.PhaseClock()
+        try:
+            with clk.live("device_exec"):
+                ready.set()
+                release.wait(10)
+        finally:
+            phases.live_clear()
+
+    def test_sample_once_joins_the_live_table(self):
+        prof = SamplingProfiler(hz=50)
+        ready, release = threading.Event(), threading.Event()
+        t = threading.Thread(target=self._worker, args=(ready, release))
+        t.start()
+        try:
+            assert ready.wait(10)
+            prof.sample_once()
+        finally:
+            release.set()
+            t.join(10)
+        samples, counts = prof.snapshot()
+        assert samples == 1
+        text = render_collapsed(counts)
+        assert phase_counts(text).get("device_exec", 0) >= 1
+        assert attribution_counts(text, "op").get("sweep", 0) >= 1
+        assert attribution_counts(text, "tenant").get("acme", 0) >= 1
+
+    def test_snapshot_accumulates_and_stats_report(self):
+        # The sampler folds every thread EXCEPT its caller, so park a
+        # helper for it to see.
+        prof = SamplingProfiler(hz=7)
+        release = threading.Event()
+        t = threading.Thread(target=release.wait, args=(10,))
+        t.start()
+        try:
+            prof.sample_once()
+            prof.sample_once()
+        finally:
+            release.set()
+            t.join(10)
+        samples, counts = prof.snapshot()
+        assert samples == 2
+        assert counts  # the parked helper's stack at minimum
+        for stack in counts:
+            for frame in stack.split(";"):
+                assert " " not in frame
+        st = prof.stats()
+        assert st["hz"] == 7.0
+        assert st["samples"] == 2
+        assert st["running"] is False
+
+
+class TestProfilerOff:
+    def test_dedicated_hatch_disables(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_PROFILER", "0")
+        assert not prof_mod.enabled()
+
+    def test_telemetry_off_disables_too(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_TELEMETRY", "0")
+        assert not prof_mod.enabled()
+
+    def test_start_spawns_no_thread_and_touches_no_registry(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("KCCAP_PROFILER", "0")
+        from kubernetesclustercapacity_tpu.telemetry.metrics import (
+            REGISTRY,
+        )
+
+        def boom(*a, **kw):
+            raise AssertionError("registry touched with profiler off")
+
+        monkeypatch.setattr(REGISTRY, "counter", boom)
+        prof = SamplingProfiler()
+        assert prof.start() is prof
+        assert not prof.running()
+        ctype, body = prof.debug_handler("seconds=0")
+        assert body.startswith(b"# profiler disabled")
+
+    def test_singleton_start_returns_none(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_PROFILER", "0")
+        assert prof_mod.start_profiler() is None
+
+    def test_env_hz_parsing(self, monkeypatch):
+        monkeypatch.setenv("KCCAP_PROFILE_HZ", "53")
+        assert SamplingProfiler().hz == 53.0
+        monkeypatch.setenv("KCCAP_PROFILE_HZ", "not-a-number")
+        assert SamplingProfiler().hz == float(prof_mod.DEFAULT_HZ)
+        monkeypatch.setenv("KCCAP_PROFILE_HZ", "-3")
+        assert SamplingProfiler().hz == float(prof_mod.DEFAULT_HZ)

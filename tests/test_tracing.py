@@ -277,6 +277,28 @@ class TestAnalyzer:
         assert not cp.get("refused")
         assert cp["dominant"]["name"] == "device_exec"
 
+    def test_in_flight_span_is_excluded_and_named_not_zeroed(
+        self, tmp_path
+    ):
+        """Regression: a span written with a null ``duration_ms`` (a
+        process that died mid-request flushed its half-record) used to
+        enter assembly as duration 0 and silently zero the subtree's
+        self-time.  It must be EXCLUDED from the tree and NAMED in
+        ``in_flight`` instead."""
+        log = str(tmp_path / "p1.jsonl")
+        self._write(log, [
+            {"trace_id": "T", "span_id": "root", "op": "a",
+             "service": "x", "duration_ms": 10.0},
+            {"trace_id": "T", "span_id": "dead", "parent_span_id": "root",
+             "op": "b", "service": "x", "duration_ms": None},
+        ])
+        tree = assemble_tree(load_spans([log]), "T")
+        assert tree["in_flight"] == ["dead"]
+        (root,) = tree["roots"]
+        assert [c["span_id"] for c in root["children"]] == []
+        # A finite-duration trace reports no in-flight spans.
+        assert analyze_trace([log], "T")["in_flight"] == ["dead"]
+
     def test_orphans_are_promoted_and_counted_never_dropped(self, tmp_path):
         log = str(tmp_path / "p1.jsonl")
         self._write(log, [
